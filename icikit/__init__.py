@@ -1,0 +1,27 @@
+"""icikit — TPU-native parallel-computing framework.
+
+A brand-new JAX/XLA/Pallas framework with the capabilities of the reference
+MPI suite (masrul/Parallel-Computing-MPI): hand-rolled collective
+communication algorithms expressed as ``ppermute`` schedules on a device
+mesh, four distributed sorting algorithms, and a dynamic-load-balancing
+study over a peg-solitaire DFS workload — each with self-verifying
+benchmark harnesses turned into real tests.
+
+Layer map (mirrors SURVEY.md §1, made explicit):
+
+- ``icikit.utils``    — L1' runtime: mesh, deterministic RNG, timing,
+                        watchdog, algorithm registry (replaces the
+                        reference's compile-time ``#define`` config).
+- ``icikit.parallel`` — L2' collective algorithms: ring, recursive
+                        doubling, e-cube, hypercube, naive, wraparound,
+                        plus XLA-native baselines (the "vendor MPI" role).
+- ``icikit.ops``      — Pallas/local compute kernels (sort, merge).
+- ``icikit.models``   — L3' workloads: distributed sorts, peg solitaire.
+- ``icikit.bench``    — L4' benchmark harness: sweeps, verification,
+                        timing, backend comparison.
+"""
+
+__version__ = "0.1.0"
+
+from icikit.utils.mesh import make_mesh, mesh_axis_size  # noqa: F401
+from icikit.utils.registry import get_algorithm, list_algorithms  # noqa: F401

@@ -1,0 +1,31 @@
+"""L2' — the collective-algorithm library (the heart of the framework).
+
+Every hand-rolled MPI collective in the reference becomes a ``ppermute``
+schedule running inside ``shard_map`` on a named mesh axis; XLA's native
+collectives (``all_gather``/``all_to_all``/``psum``) play the "vendor
+MPI" role the reference benchmarked against (SURVEY.md §5.8).
+
+Terminology note: the reference calls its *broadcast-semantics* collective
+"AllToAll" (every rank ends with every rank's block — i.e. an allgather,
+``Communication/src/main.cc:38-223``) and the true transpose collective
+"AllToAllPersonalized" (rank i sends distinct block j to rank j,
+``:234-388``). We use the standard names: ``allgather`` and ``alltoall``.
+"""
+
+from icikit.parallel.allgather import (  # noqa: F401
+    ALLGATHER_ALGORITHMS,
+    all_gather_blocks,
+)
+from icikit.parallel.alltoall import (  # noqa: F401
+    ALLTOALL_ALGORITHMS,
+    all_to_all_blocks,
+)
+from icikit.parallel.allreduce import (  # noqa: F401
+    ALLREDUCE_ALGORITHMS,
+    all_reduce,
+)
+from icikit.parallel.collops import (  # noqa: F401
+    broadcast,
+    gather_blocks,
+    scatter_blocks,
+)

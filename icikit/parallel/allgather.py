@@ -1,0 +1,131 @@
+"""All-gather algorithm family.
+
+The reference calls this collective "AllToAll": every rank broadcasts its
+own block so all ranks end with all p blocks
+(``Communication/src/main.cc:38-223``). That is an allgather in standard
+terminology, and each hand-rolled variant becomes a ``ppermute`` schedule
+here:
+
+- ``naive``               — C2, ``main.cc:39-61``: p-1 nonblocking
+  pairwise sends of the own block (Isend/Irecv + Waitall → p-1
+  independent rotation ``ppermute``\\ s, free for XLA to overlap).
+- ``ring``                — C4, ``main.cc:190-223``: p-1 shift-by-one
+  steps forwarding the block just received.
+- ``recursive_doubling``  — C3, ``main.cc:63-188``: ⌈log2 p⌉ XOR-partner
+  rounds with message volume doubling each round. The reference's "twin"
+  trick for non-power-of-2 p is replaced by an explicit power-of-2
+  constraint (SURVEY.md §7 "hard parts": decide per algorithm).
+- ``xla``                 — the vendor baseline (``jax.lax.all_gather``
+  over ICI), playing the role Intel MPI played in the reference study.
+
+All per-shard schedules share the canonical skeleton of the reference's
+seven kernels (SURVEY.md §3.4): (1) place own block in its result slot,
+(2) loop over rounds, (3) partner by XOR or modular arithmetic,
+(4) exchange; verification lives in the harness, never in the kernel.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from icikit.parallel.shmap import (
+    build_collective,
+    register_family,
+    shift_perm,
+    xor_perm,
+)
+from icikit.utils.mesh import DEFAULT_AXIS, ilog2, is_pow2
+from icikit.utils.registry import register_algorithm
+
+
+def _own_block_first(block: jax.Array, p: int, r: jax.Array) -> jax.Array:
+    """Step (1) of the shared skeleton: own block into its result slot."""
+    out = jnp.zeros((p,) + block.shape[1:], block.dtype)
+    return lax.dynamic_update_slice_in_dim(out, block, r, 0)
+
+
+@register_algorithm("allgather", "naive")
+def _naive(block: jax.Array, axis: str, p: int) -> jax.Array:
+    """p-1 independent rotations of the own block (C2)."""
+    r = lax.axis_index(axis)
+    out = _own_block_first(block, p, r)
+    recvs = [lax.ppermute(block, axis, shift_perm(p, i)) for i in range(1, p)]
+    for i, recv in enumerate(recvs, start=1):
+        out = lax.dynamic_update_slice_in_dim(out, recv, jnp.mod(r - i, p), 0)
+    return out
+
+
+@register_algorithm("allgather", "ring")
+def _ring(block: jax.Array, axis: str, p: int) -> jax.Array:
+    """p-1 shift-by-one steps, forwarding what was just received (C4).
+
+    The reference's even/odd send-first deadlock discipline
+    (``main.cc:206-216``) is unnecessary here — ``ppermute`` is
+    deadlock-free by construction.
+    """
+    r = lax.axis_index(axis)
+    out = _own_block_first(block, p, r)
+    cur = block
+    for i in range(1, p):
+        cur = lax.ppermute(cur, axis, shift_perm(p, 1))
+        out = lax.dynamic_update_slice_in_dim(out, cur, jnp.mod(r - i, p), 0)
+    return out
+
+
+@register_algorithm("allgather", "recursive_doubling")
+def _recursive_doubling(block: jax.Array, axis: str, p: int) -> jax.Array:
+    """⌈log2 p⌉ XOR-partner rounds, volume doubling each round (C3).
+
+    After round i each device holds the 2^(i+1)-aligned group of blocks
+    containing its own rank; the group is contiguous, so each round is one
+    static-size dynamic slice + ``ppermute`` + one update.
+    """
+    if not is_pow2(p):
+        raise ValueError(
+            "recursive_doubling requires a power-of-2 device count "
+            f"(got {p}); the reference's virtual-twin workaround "
+            "(Communication/src/main.cc:71-75) is intentionally not "
+            "reproduced — use 'ring' or 'naive' for other sizes")
+    r = lax.axis_index(axis)
+    out = _own_block_first(block, p, r)
+    for i in range(ilog2(p)):
+        step = 1 << i
+        base = (r >> i) << i  # start of my currently-valid aligned group
+        chunk = lax.dynamic_slice_in_dim(out, base, step, 0)
+        recv = lax.ppermute(chunk, axis, xor_perm(p, step))
+        out = lax.dynamic_update_slice_in_dim(out, recv, base ^ step, 0)
+    return out
+
+
+@register_algorithm("allgather", "xla")
+def _xla(block: jax.Array, axis: str, p: int) -> jax.Array:
+    """Vendor baseline: XLA's native all_gather over ICI."""
+    del p
+    return lax.all_gather(block, axis, axis=0, tiled=True)
+
+
+ALLGATHER_ALGORITHMS = ("naive", "ring", "recursive_doubling", "xla")
+
+register_family("allgather", "sharded",
+                lambda impl, axis, p: lambda b: impl(b, axis, p)[None])
+
+
+def all_gather_blocks(x: jax.Array, mesh, axis: str = DEFAULT_AXIS,
+                      algorithm: str = "ring") -> jax.Array:
+    """Distributed allgather of block-sharded ``x``.
+
+    Args:
+      x: global array of shape ``(p, ...)``, sharded along dim 0 — device
+        d owns block ``x[d]``.
+      algorithm: one of ``ALLGATHER_ALGORITHMS``.
+
+    Returns:
+      Array of shape ``(p, p, ...)``: ``out[d]`` is device d's fully
+      assembled copy of all p blocks (the reference's per-rank recv
+      buffer, ``Communication/src/main.cc:405-407``); the harness
+      verifies every device's copy, as every rank verified in the
+      reference (``:436-441``).
+    """
+    return build_collective("allgather", algorithm, mesh, axis)(x)

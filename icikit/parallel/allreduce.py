@@ -1,0 +1,117 @@
+"""All-reduce algorithm family (north-star target, BASELINE.md).
+
+The reference uses vendor ``MPI_Reduce``/collectives for its timing
+reports and studies hand-rolled algorithms for the all-to-all families;
+the build's north star (BASELINE.json) extends the same science to
+allreduce: hand-rolled recursive-doubling and ring
+(reduce-scatter + allgather) schedules benchmarked against XLA's
+``psum`` over ICI.
+
+Implementations take the reduction ``op`` by name ("sum"/"max"/"min")
+so the XLA variant can dispatch to the matching native collective.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from icikit.parallel.shmap import (
+    build_collective,
+    register_family,
+    shift_perm,
+    xor_perm,
+)
+from icikit.utils.mesh import DEFAULT_AXIS, ilog2, is_pow2
+from icikit.utils.registry import register_algorithm
+
+_OPS = {
+    "sum": (jnp.add, lambda ax: lambda x: lax.psum(x, ax)),
+    "max": (jnp.maximum, lambda ax: lambda x: lax.pmax(x, ax)),
+    "min": (jnp.minimum, lambda ax: lambda x: lax.pmin(x, ax)),
+}
+
+
+@register_algorithm("allreduce", "recursive_doubling")
+def _recursive_doubling(x: jax.Array, axis: str, p: int, op: str) -> jax.Array:
+    """log p XOR-partner rounds, full message each round.
+
+    Latency-optimal (ts·log p); bandwidth cost tw·m·log p — the classic
+    small-message winner, mirroring the reference's recursive-doubling
+    analysis (report.pdf §2.2).
+    """
+    if not is_pow2(p):
+        raise ValueError("recursive_doubling allreduce requires power-of-2 p")
+    combine = _OPS[op][0]
+    for i in range(ilog2(p)):
+        recv = lax.ppermute(x, axis, xor_perm(p, 1 << i))
+        x = combine(x, recv)
+    return x
+
+
+@register_algorithm("allreduce", "ring")
+def _ring(x: jax.Array, axis: str, p: int, op: str) -> jax.Array:
+    """Ring reduce-scatter followed by ring allgather.
+
+    Bandwidth-optimal: 2·m·(p-1)/p per device — the schedule ICI
+    all-reduces actually use, built by hand from ``ppermute``. Inputs
+    whose leading dim is not divisible by p are zero-padded (safe for
+    sum/max/min: padded lanes only ever combine with other padded lanes
+    and are sliced off).
+    """
+    m = x.shape[0]
+    pad = (-m) % p
+    if pad:
+        x = jnp.concatenate([x, jnp.zeros((pad,) + x.shape[1:], x.dtype)])
+    combine = _OPS[op][0]
+    csz = (m + pad) // p
+    acc = x.reshape((p, csz) + x.shape[1:])
+    r = lax.axis_index(axis)
+    # Reduce-scatter: after p-1 steps device r owns the full reduction of
+    # chunk (r+1) mod p.
+    for s in range(p - 1):
+        i_send = jnp.mod(r - s, p)
+        i_recv = jnp.mod(r - s - 1, p)
+        blk = lax.dynamic_slice_in_dim(acc, i_send, 1, 0)
+        recv = lax.ppermute(blk, axis, shift_perm(p, 1))
+        mine = lax.dynamic_slice_in_dim(acc, i_recv, 1, 0)
+        acc = lax.dynamic_update_slice_in_dim(acc, combine(mine, recv), i_recv, 0)
+    # All-gather of the completed chunks around the same ring.
+    for s in range(p - 1):
+        i_send = jnp.mod(r + 1 - s, p)
+        i_recv = jnp.mod(r - s, p)
+        blk = lax.dynamic_slice_in_dim(acc, i_send, 1, 0)
+        recv = lax.ppermute(blk, axis, shift_perm(p, 1))
+        acc = lax.dynamic_update_slice_in_dim(acc, recv, i_recv, 0)
+    out = acc.reshape((p * csz,) + x.shape[1:])
+    return out[:m] if pad else out
+
+
+@register_algorithm("allreduce", "xla")
+def _xla(x: jax.Array, axis: str, p: int, op: str) -> jax.Array:
+    """Vendor baseline: XLA's native psum/pmax/pmin over ICI."""
+    del p
+    return _OPS[op][1](axis)(x)
+
+
+ALLREDUCE_ALGORITHMS = ("recursive_doubling", "ring", "xla")
+
+register_family(
+    "allreduce", "sharded",
+    lambda impl, axis, p, op: lambda b: impl(b[0], axis, p, op)[None])
+
+
+def all_reduce(x: jax.Array, mesh, axis: str = DEFAULT_AXIS,
+               algorithm: str = "xla", op: str = "sum") -> jax.Array:
+    """Distributed elementwise reduction.
+
+    Args:
+      x: global array of shape ``(p, ...)`` sharded along dim 0; device
+        d contributes ``x[d]``.
+
+    Returns:
+      Array of the same shape/sharding with ``out[d]`` = the full
+      reduction (every device ends with the reduced value).
+    """
+    return build_collective("allreduce", algorithm, mesh, axis, (op,))(x)

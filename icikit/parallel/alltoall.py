@@ -1,0 +1,154 @@
+"""All-to-all (personalized) algorithm family — the distributed transpose.
+
+The reference's "AllToAllPersonalized" (``Communication/src/main.cc:234-388``):
+rank i holds p distinct blocks and sends block j to rank j. Variants:
+
+- ``wraparound`` — C8, ``main.cc:370-387``: p-1 ``Sendrecv`` rotation
+  steps; step i sends block (r+i) mod p to that rank.
+- ``naive``      — C7, ``main.cc:342-368``: the same peer pattern posted
+  all at once (Isend/Irecv + Waitall → independent ``ppermute``\\ s). In
+  XLA both compile to the same dataflow; they are kept as distinct
+  schedules for parity and so the benchmark can show the equivalence.
+- ``ecube``      — C5, ``main.cc:237-263``: p-1 XOR-partner exchange
+  steps (partner ``r ^ i``), power-of-2 only.
+- ``hypercube``  — C6, ``main.cc:265-340``: log p rounds exchanging the
+  p/2 blocks whose destination's i-th bit differs; equivalent to a
+  distributed matrix transpose (report.pdf p.6 Fig.4). The reference's
+  implementation is invalid C++ (SURVEY.md §2 defects) — this is the
+  *intended* semantics, expressed as a bit-axis swap: round i reshapes
+  the p-slot buffer so bit i of the slot index is its own axis, swaps
+  the opposite half with partner ``r ^ 2^i``, sending exactly p/2·m per
+  round.
+- ``xla``        — vendor baseline: ``jax.lax.all_to_all`` over ICI.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from icikit.parallel.shmap import (
+    build_collective,
+    register_family,
+    shift_perm,
+    xor_perm,
+)
+from icikit.utils.mesh import DEFAULT_AXIS, ilog2, is_pow2
+from icikit.utils.registry import register_algorithm
+
+
+def _require_pow2(name: str, p: int):
+    if not is_pow2(p):
+        raise ValueError(
+            f"{name} all-to-all requires a power-of-2 device count (got "
+            f"{p}); use 'wraparound', 'naive', or 'xla' for other sizes")
+
+
+@register_algorithm("alltoall", "wraparound")
+def _wraparound(buf: jax.Array, axis: str, p: int) -> jax.Array:
+    """p-1 rotation steps, sequentially accumulated (C8)."""
+    r = lax.axis_index(axis)
+    out = jnp.zeros_like(buf)
+    own = lax.dynamic_slice_in_dim(buf, r, 1, 0)
+    out = lax.dynamic_update_slice_in_dim(out, own, r, 0)
+    for i in range(1, p):
+        send = lax.dynamic_slice_in_dim(buf, jnp.mod(r + i, p), 1, 0)
+        recv = lax.ppermute(send, axis, shift_perm(p, i))
+        out = lax.dynamic_update_slice_in_dim(out, recv, jnp.mod(r - i, p), 0)
+    return out
+
+
+@register_algorithm("alltoall", "naive")
+def _naive(buf: jax.Array, axis: str, p: int) -> jax.Array:
+    """Same peer pattern as wraparound, posted as independent exchanges (C7)."""
+    r = lax.axis_index(axis)
+    out = jnp.zeros_like(buf)
+    own = lax.dynamic_slice_in_dim(buf, r, 1, 0)
+    out = lax.dynamic_update_slice_in_dim(out, own, r, 0)
+    recvs = [
+        lax.ppermute(
+            lax.dynamic_slice_in_dim(buf, jnp.mod(r + i, p), 1, 0),
+            axis, shift_perm(p, i))
+        for i in range(1, p)
+    ]
+    for i, recv in enumerate(recvs, start=1):
+        out = lax.dynamic_update_slice_in_dim(out, recv, jnp.mod(r - i, p), 0)
+    return out
+
+
+@register_algorithm("alltoall", "ecube")
+def _ecube(buf: jax.Array, axis: str, p: int) -> jax.Array:
+    """p-1 XOR-partner direct exchanges (C5).
+
+    The reference's lower-rank-sends-first ordering
+    (``main.cc:251-261``) is structural deadlock avoidance that
+    ``ppermute`` makes unnecessary.
+    """
+    _require_pow2("ecube", p)
+    r = lax.axis_index(axis)
+    out = jnp.zeros_like(buf)
+    own = lax.dynamic_slice_in_dim(buf, r, 1, 0)
+    out = lax.dynamic_update_slice_in_dim(out, own, r, 0)
+    for i in range(1, p):
+        partner = r ^ i
+        send = lax.dynamic_slice_in_dim(buf, partner, 1, 0)
+        recv = lax.ppermute(send, axis, xor_perm(p, i))
+        out = lax.dynamic_update_slice_in_dim(out, recv, partner, 0)
+    return out
+
+
+@register_algorithm("alltoall", "hypercube")
+def _hypercube(buf: jax.Array, axis: str, p: int) -> jax.Array:
+    """log p rounds, p/2 blocks per round — store-and-forward routing (C6).
+
+    Invariant: after round i, slot d of every device holds a block whose
+    destination agrees with the device's rank on bits 0..i; after all
+    rounds, slot s of rank r holds the block src s sent to dst r.
+    """
+    _require_pow2("hypercube", p)
+    r = lax.axis_index(axis)
+    out = buf
+    m_shape = buf.shape[1:]
+    for i in range(ilog2(p)):
+        bit = 1 << i
+        # Reshape so bit i of the slot index becomes its own axis …
+        view = out.reshape((p // (2 * bit), 2, bit) + m_shape)
+        my_bit = (r >> i) & 1
+        # … then the p/2 blocks routed through the partner are one slice.
+        send = lax.dynamic_slice_in_dim(view, 1 - my_bit, 1, axis=1)
+        recv = lax.ppermute(send, axis, xor_perm(p, bit))
+        view = lax.dynamic_update_slice_in_dim(view, recv, 1 - my_bit, 1)
+        out = view.reshape((p,) + m_shape)
+    return out
+
+
+@register_algorithm("alltoall", "xla")
+def _xla(buf: jax.Array, axis: str, p: int) -> jax.Array:
+    """Vendor baseline: XLA's native all_to_all over ICI."""
+    del p
+    return lax.all_to_all(buf, axis, split_axis=0, concat_axis=0, tiled=True)
+
+
+ALLTOALL_ALGORITHMS = ("wraparound", "naive", "ecube", "hypercube", "xla")
+
+register_family("alltoall", "sharded",
+                lambda impl, axis, p: lambda b: impl(b[0], axis, p)[None])
+
+
+def all_to_all_blocks(x: jax.Array, mesh, axis: str = DEFAULT_AXIS,
+                      algorithm: str = "wraparound") -> jax.Array:
+    """Distributed transpose of per-destination blocks.
+
+    Args:
+      x: global array of shape ``(p, p, ...)`` sharded along dim 0 —
+        device s owns row ``x[s]``, whose slot d is the block destined
+        for device d.
+
+    Returns:
+      Array of the same shape/sharding, equal to ``swapaxes(x, 0, 1)``:
+      device d ends with ``out[d, s] = x[s, d]`` — exactly the
+      reference's verification condition
+      (``Communication/src/main.cc:478-486``).
+    """
+    return build_collective("alltoall", algorithm, mesh, axis)(x)

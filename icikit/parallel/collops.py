@@ -1,0 +1,221 @@
+"""Broadcast / scatter / gather algorithm families (north-star sweep,
+BASELINE.md: "broadcast + scatter/gather bandwidth sweep 1KB-64MB").
+
+Hand-rolled linear, ring, and binomial-tree schedules built from
+``ppermute`` (including partial permutations, the analog of targeted
+``MPI_Send``), against XLA-native formulations as the vendor baseline.
+The binomial trees run in *relative-rank* space ``rr = (r - root) mod p``
+so any root works with the same static schedule; ``root`` is a static
+Python int (it selects the permutation tables).
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from icikit.parallel.shmap import (
+    build_collective,
+    register_family,
+    shift_perm,
+)
+from icikit.utils.mesh import DEFAULT_AXIS, is_pow2
+from icikit.utils.registry import register_algorithm
+
+# ---------------------------------------------------------------------------
+# broadcast: root's block -> every device
+# ---------------------------------------------------------------------------
+
+
+@register_algorithm("broadcast", "ring")
+def _bcast_ring(block, axis, p, root):
+    """p-1 shift-by-one steps; device root+k receives the payload at step k."""
+    r = lax.axis_index(axis)
+    cur = jnp.where(r == root, block, jnp.zeros_like(block))
+    for _ in range(p - 1):
+        recv = lax.ppermute(cur, axis, shift_perm(p, 1))
+        cur = jnp.where(r == root, cur, recv)
+    return cur
+
+
+@register_algorithm("broadcast", "binomial")
+def _bcast_binomial(block, axis, p, root):
+    """⌈log2 p⌉ doubling rounds: holders rr < 2^i send to rr + 2^i."""
+    r = lax.axis_index(axis)
+    rr = jnp.mod(r - root, p)
+    cur = jnp.where(r == root, block, jnp.zeros_like(block))
+    for i in range(max(1, math.ceil(math.log2(p))) if p > 1 else 0):
+        step = 1 << i
+        perm = [((root + j) % p, (root + j + step) % p)
+                for j in range(step) if j + step < p]
+        if not perm:
+            break
+        recv = lax.ppermute(cur, axis, perm)
+        is_recv = (rr >= step) & (rr < min(p, 2 * step))
+        cur = jnp.where(is_recv, recv, cur)
+    return cur
+
+
+@register_algorithm("broadcast", "xla")
+def _bcast_xla(block, axis, p, root):
+    """Vendor baseline: masked psum (XLA lowers this to a broadcast-like
+    collective over ICI)."""
+    del p
+    r = lax.axis_index(axis)
+    return lax.psum(jnp.where(r == root, block, jnp.zeros_like(block)), axis)
+
+
+BROADCAST_ALGORITHMS = ("ring", "binomial", "xla")
+
+register_family(
+    "broadcast", "sharded",
+    lambda impl, axis, p, root: lambda b: impl(b[0], axis, p, root)[None])
+
+
+def broadcast(x: jax.Array, mesh, axis: str = DEFAULT_AXIS,
+              algorithm: str = "binomial", root: int = 0) -> jax.Array:
+    """Broadcast device ``root``'s block to all devices.
+
+    ``x``: global ``(p, ...)`` sharded on dim 0. Returns the same shape
+    with ``out[d] = x[root]`` for every d.
+    """
+    return build_collective("broadcast", algorithm, mesh, axis, (root,))(x)
+
+
+# ---------------------------------------------------------------------------
+# scatter: root holds p blocks -> device d gets block d
+# ---------------------------------------------------------------------------
+
+
+@register_algorithm("scatter", "linear")
+def _scatter_linear(buf, axis, p, root):
+    """Root sends each block directly via a partial permutation (the
+    targeted-``MPI_Send`` analog)."""
+    r = lax.axis_index(axis)
+    out = jnp.where(r == root, buf[root], jnp.zeros_like(buf[0]))
+    for j in range(1, p):
+        d = (root + j) % p
+        recv = lax.ppermute(buf[d][None], axis, [(root, d)])[0]
+        out = jnp.where(r == d, recv, out)
+    return out
+
+
+@register_algorithm("scatter", "binomial")
+def _scatter_binomial(buf, axis, p, root):
+    """Halving binomial tree: log p rounds, message size halves each round."""
+    if not is_pow2(p):
+        raise ValueError("binomial scatter requires power-of-2 p")
+    r = lax.axis_index(axis)
+    rr = jnp.mod(r - root, p)
+    # Work in relative block order: rel[k] = block for device (root+k)%p.
+    rel = jnp.roll(buf, -root, axis=0)
+    rel = jnp.where(r == root, rel, jnp.zeros_like(rel))
+    half = p // 2
+    while half >= 1:
+        seg = lax.dynamic_slice_in_dim(rel, jnp.mod(rr + half, p), half, 0)
+        perm = [((root + j) % p, (root + j + half) % p)
+                for j in range(0, p, 2 * half)]
+        recv = lax.ppermute(seg, axis, perm)
+        is_recv = jnp.mod(rr, 2 * half) == half
+        mine = lax.dynamic_slice_in_dim(rel, rr, half, 0)
+        rel = lax.dynamic_update_slice_in_dim(
+            rel, jnp.where(is_recv, recv, mine), rr, 0)
+        half //= 2
+    return lax.dynamic_slice_in_dim(rel, rr, 1, 0)[0]
+
+
+@register_algorithm("scatter", "xla")
+def _scatter_xla(buf, axis, p, root):
+    """Vendor baseline: broadcast root's buffer, each device slices its
+    block (XLA has no native scatter collective)."""
+    del p
+    r = lax.axis_index(axis)
+    full = lax.psum(jnp.where(r == root, buf, jnp.zeros_like(buf)), axis)
+    return lax.dynamic_slice_in_dim(full, r, 1, 0)[0]
+
+
+SCATTER_ALGORITHMS = ("linear", "binomial", "xla")
+
+register_family(
+    "scatter", "replicated",
+    lambda impl, axis, p, root: lambda b: impl(b, axis, p, root)[None])
+
+
+def scatter_blocks(x: jax.Array, mesh, axis: str = DEFAULT_AXIS,
+                   algorithm: str = "binomial", root: int = 0) -> jax.Array:
+    """Scatter root's ``(p, ...)`` buffer: device d receives block d.
+
+    ``x``: global ``(p, ...)`` *replicated* (only root's copy is used —
+    the schedules never read another device's buffer). Returns global
+    ``(p, ...)`` sharded on dim 0 with ``out[d] = x[d]``.
+    """
+    return build_collective("scatter", algorithm, mesh, axis, (root,))(x)
+
+
+# ---------------------------------------------------------------------------
+# gather: device blocks -> root holds all p blocks
+# ---------------------------------------------------------------------------
+
+
+@register_algorithm("gather", "linear")
+def _gather_linear(block, axis, p, root):
+    """Each device sends its block straight to root (partial perms)."""
+    buf = jnp.zeros((p,) + block.shape[1:], block.dtype)
+    buf = buf.at[root].set(block[0])
+    for j in range(1, p):
+        d = (root + j) % p
+        recv = lax.ppermute(block, axis, [(d, root)])
+        buf = buf.at[d].set(recv[0])
+    return buf
+
+
+@register_algorithm("gather", "binomial")
+def _gather_binomial(block, axis, p, root):
+    """Doubling binomial tree: reverse of binomial scatter."""
+    if not is_pow2(p):
+        raise ValueError("binomial gather requires power-of-2 p")
+    r = lax.axis_index(axis)
+    rr = jnp.mod(r - root, p)
+    rel = jnp.zeros((p,) + block.shape[1:], block.dtype)
+    rel = lax.dynamic_update_slice_in_dim(rel, block, rr, 0)
+    half = 1
+    while half < p:
+        seg = lax.dynamic_slice_in_dim(rel, rr, half, 0)
+        perm = [((root + j + half) % p, (root + j) % p)
+                for j in range(0, p, 2 * half)]
+        recv = lax.ppermute(seg, axis, perm)
+        is_recv = jnp.mod(rr, 2 * half) == 0
+        tgt = jnp.mod(rr + half, p)
+        mine = lax.dynamic_slice_in_dim(rel, tgt, half, 0)
+        rel = lax.dynamic_update_slice_in_dim(
+            rel, jnp.where(is_recv, recv, mine), tgt, 0)
+        half *= 2
+    return jnp.roll(rel, root, axis=0)
+
+
+@register_algorithm("gather", "xla")
+def _gather_xla(block, axis, p, root):
+    """Vendor baseline: XLA all_gather (root simply keeps the result)."""
+    del p, root
+    return lax.all_gather(block, axis, axis=0, tiled=True)
+
+
+GATHER_ALGORITHMS = ("linear", "binomial", "xla")
+
+register_family(
+    "gather", "sharded",
+    lambda impl, axis, p, root: lambda b: impl(b, axis, p, root)[None])
+
+
+def gather_blocks(x: jax.Array, mesh, axis: str = DEFAULT_AXIS,
+                  algorithm: str = "binomial", root: int = 0) -> jax.Array:
+    """Gather all blocks to device ``root``.
+
+    ``x``: global ``(p, ...)`` sharded on dim 0. Returns ``(p, p, ...)``
+    stacked per-device buffers; ``out[root]`` is the assembled gather
+    (other rows are unspecified for the tree schedules).
+    """
+    return build_collective("gather", algorithm, mesh, axis, (root,))(x)

@@ -1,0 +1,60 @@
+"""shard_map plumbing shared by every collective family.
+
+Each family module registers (a) its algorithm variants in the runtime
+registry and (b) one *adapter* here describing how a per-shard block maps
+through an implementation. ``build_collective`` then owns the single copy
+of the lru_cache + jit + shard_map wrapping, so schedule code stays pure.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+from typing import Callable, Dict, Tuple
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+try:  # jax >= 0.6 promotes shard_map to the top level
+    from jax import shard_map as _shard_map
+except ImportError:  # pragma: no cover - older jax
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+from icikit.utils.registry import get_algorithm
+
+shard_map = _shard_map
+
+# family -> (input_kind, adapter); adapter(impl, axis, p, *extra) returns the
+# per-shard function. input_kind "sharded" = block-sharded along the axis,
+# "replicated" = every device sees the full operand.
+_FAMILIES: Dict[str, Tuple[str, Callable]] = {}
+
+
+def register_family(family: str, input_kind: str, adapter: Callable) -> None:
+    _FAMILIES[family] = (input_kind, adapter)
+
+
+@lru_cache(maxsize=None)
+def build_collective(family: str, algorithm: str, mesh, axis: str,
+                     extra: tuple = ()):
+    """Build (and cache) the jitted shard_map program for a collective."""
+    input_kind, adapter = _FAMILIES[family]
+    impl = get_algorithm(family, algorithm)
+    p = mesh.shape[axis]
+    per_shard = adapter(impl, axis, p, *extra)
+    in_specs = P(axis) if input_kind == "sharded" else P()
+    return jax.jit(shard_map(per_shard, mesh=mesh, in_specs=in_specs,
+                             out_specs=P(axis)))
+
+
+def xor_perm(p: int, mask: int):
+    """Partner permutation ``j -> j ^ mask`` (a valid permutation for any
+    mask in [1, p) when p is a power of two). The reference's hypercube
+    partner rule ``myid ^ 2^i`` (``Communication/src/main.cc:84``) and
+    e-cube rule ``myid ^ i`` (``:250``)."""
+    return [(j, j ^ mask) for j in range(p)]
+
+
+def shift_perm(p: int, shift: int):
+    """Rotation permutation ``j -> (j + shift) % p`` — the ring/wraparound
+    partner rule (``Communication/src/main.cc:198-221``, ``:379-385``)."""
+    return [(j, (j + shift) % p) for j in range(p)]

@@ -1,0 +1,23 @@
+"""L1' runtime core: mesh construction, deterministic RNG, timing,
+watchdog, and the runtime algorithm registry.
+
+Replaces the reference's L1 (``Dynamic-Load-Balancing/src/utilities.{h,cc}``:
+``chopsigs_`` signal traps + ``get_timer`` stopwatch) and its compile-time
+``#define`` configuration mechanism (``Communication/src/main.cc:8-10``).
+"""
+
+from icikit.utils.mesh import (  # noqa: F401
+    DEFAULT_AXIS,
+    ilog2,
+    is_pow2,
+    make_mesh,
+    mesh_axis_size,
+    replicate,
+    shard_along,
+)
+from icikit.utils.registry import (  # noqa: F401
+    get_algorithm,
+    list_algorithms,
+    register_algorithm,
+)
+from icikit.utils.timing import Stopwatch, timeit  # noqa: F401

@@ -1,0 +1,69 @@
+"""Device-mesh construction and sharding helpers.
+
+The reference's MPI communicator (``MPI_Comm_size``/``MPI_Comm_rank``,
+``Communication/src/main.cc:396-400``) maps to a 1-D
+``jax.sharding.Mesh``: devices play the role of ranks,
+``jax.lax.axis_index`` the role of ``MPI_Comm_rank``. Sub-communicators
+(``MPI_Comm_split``, ``Parallel-Sorting/src/psort.cc:403-413``) map to
+index masking within the full mesh (see ``icikit.models.sort.quicksort``).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DEFAULT_AXIS = "p"
+
+
+def is_pow2(n: int) -> bool:
+    """True iff n is a positive power of two (reference ``pow2``/``log2``
+    helpers, ``Communication/src/main.cc:18-29``)."""
+    return n > 0 and (n & (n - 1)) == 0
+
+
+def ilog2(n: int) -> int:
+    """Exact integer log2; raises for non-powers-of-two."""
+    if not is_pow2(n):
+        raise ValueError(f"{n} is not a power of two")
+    return n.bit_length() - 1
+
+
+def make_mesh(n_devices: int | None = None, axis_name: str = DEFAULT_AXIS,
+              devices=None) -> Mesh:
+    """Build a 1-D device mesh of ``n_devices`` (default: all local devices).
+
+    This is the framework's ``MPI_Init`` + ``MPI_Comm_size`` analog: every
+    distributed entry point takes a mesh and an axis name.
+    """
+    if devices is None:
+        devices = jax.devices()
+    if n_devices is None:
+        n_devices = len(devices)
+    if n_devices > len(devices):
+        raise ValueError(
+            f"requested {n_devices} devices but only {len(devices)} available")
+    return Mesh(np.asarray(devices[:n_devices]), (axis_name,))
+
+
+def mesh_axis_size(mesh: Mesh, axis_name: str = DEFAULT_AXIS) -> int:
+    """Number of devices along ``axis_name`` (``MPI_Comm_size``)."""
+    return mesh.shape[axis_name]
+
+
+def shard_along(x, mesh: Mesh, axis_name: str = DEFAULT_AXIS, dim: int = 0):
+    """Place ``x`` on the mesh, block-sharded along array dim ``dim``.
+
+    The reference's block decomposition: each rank owns ``n/p`` contiguous
+    elements (``Parallel-Sorting/src/psort.cc:556-562``).
+    """
+    spec = [None] * x.ndim
+    spec[dim] = axis_name
+    return jax.device_put(x, NamedSharding(mesh, P(*spec)))
+
+
+def replicate(x, mesh: Mesh):
+    """Place ``x`` fully replicated on every device of the mesh."""
+    return jax.device_put(x, NamedSharding(mesh, P()))

@@ -1,0 +1,71 @@
+"""Deterministic, partition-invariant input generation.
+
+The reference guarantees the *same global random sequence regardless of p*
+by chaining an ``erand48`` seed through ranks sequentially
+(``Parallel-Sorting/src/psort.cc:575-614``: rank k receives the evolved
+seed from rank k-1, generates its block, forwards the seed). That design
+is deliberately serial — p-1 sequential network hops.
+
+JAX's threefry PRNG is counter-based, so the same property falls out with
+zero communication: ``jax.random.uniform(key, (n,))`` is a pure function
+of (key, global index). Generating the globally-shaped array under a
+sharding constraint gives each device exactly its block of the one global
+sequence, in parallel — same invariant, actually parallel.
+
+``odd_dist_warp`` reproduces the reference's skewed ``ODD_DIST``
+distribution (``psort.cc:598-609``): ``val = (val ** (1 + 3*i/n)) ** 2``
+with i the global element index — position-dependent skew that stresses
+splitter selection and load balance in the sorting study.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def odd_dist_warp(vals: jax.Array, global_offset=0, global_n: int | None = None):
+    """Apply the reference's position-dependent skew to uniform(0,1) draws.
+
+    ``vals`` may be the full global array (default) or a local block, in
+    which case ``global_offset``/``global_n`` locate it in the global
+    sequence (``global_offset`` may be a traced scalar inside shard_map).
+    Reference: ``Parallel-Sorting/src/psort.cc:600-609``.
+    """
+    if global_n is None:
+        global_n = vals.size
+    i = jnp.arange(vals.size, dtype=vals.dtype).reshape(vals.shape) + global_offset
+    exponent = 1.0 + 3.0 * i / global_n
+    return jnp.power(vals, exponent) ** 2
+
+
+def uniform_global(key: jax.Array, n: int, dtype=jnp.float32, odd_dist: bool = False):
+    """The global input sequence: identical for every partitioning.
+
+    Counter-based analog of the reference's seed-chained generator
+    (``psort.cc:575-614``) — the test suite asserts the p-invariance the
+    reference only documents in a comment (``:575-581``).
+    """
+    vals = jax.random.uniform(key, (n,), dtype=dtype)
+    if odd_dist:
+        vals = odd_dist_warp(vals)
+    return vals
+
+
+def uniform_block(key: jax.Array, n: int, start: int, block: int,
+                  dtype=jnp.float32, odd_dist: bool = False):
+    """Generate elements [start, start+block) of the length-n global
+    sequence, without materializing the rest.
+
+    Uses the counter-based property directly: fold the *global* element
+    index into the key per element. Matches ``uniform_global`` only in
+    distribution, not bit-for-bit; use it when n is too large to
+    materialize per device. For bit-exact p-invariance across partitions,
+    both sides must use this same function.
+    """
+    idx = start + jnp.arange(block)
+    keys = jax.vmap(lambda i: jax.random.fold_in(key, i))(idx)
+    vals = jax.vmap(lambda k: jax.random.uniform(k, (), dtype=dtype))(keys)
+    if odd_dist:
+        vals = odd_dist_warp(vals, global_offset=start, global_n=n)
+    return vals

@@ -1,0 +1,66 @@
+"""Timing utilities.
+
+The reference's protocol (``Parallel-Sorting/src/psort.cc:617-655``,
+``Communication/src/main.cc:418-449``) is: ``MPI_Barrier`` → reset-on-read
+``get_timer()`` (``utilities.cc:61-68``) → work → ``get_timer()`` →
+``MPI_Reduce(MPI_MAX)`` → rank 0 prints; per-run mean = total / test_runs.
+
+On TPU the analog needs two extra pieces the reference didn't: a
+``block_until_ready`` fence (dispatch is asynchronous) and warm-up runs to
+separate XLA compilation from steady-state execution. Max-over-devices is
+implicit in a single-process runtime — ``block_until_ready`` waits for the
+slowest device.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+
+import jax
+
+
+class Stopwatch:
+    """Reset-on-read stopwatch (reference ``get_timer``,
+    ``Dynamic-Load-Balancing/src/utilities.cc:61-68``)."""
+
+    def __init__(self):
+        self._last = time.perf_counter()
+
+    def __call__(self) -> float:
+        now = time.perf_counter()
+        elapsed = now - self._last
+        self._last = now
+        return elapsed
+
+
+@dataclass
+class TimeitResult:
+    mean_s: float          # per-run mean, as the reference reports
+    total_s: float
+    runs: int
+    per_run_s: list        # individual run times
+
+    @property
+    def best_s(self) -> float:
+        return min(self.per_run_s)
+
+
+def timeit(fn, *args, runs: int = 10, warmup: int = 2) -> TimeitResult:
+    """Time ``fn(*args)`` with device fencing.
+
+    Mirrors the reference's ``test_runs`` repetition loop
+    (``Communication/src/main.cc:427-443``) with the TPU-necessary warm-up
+    and ``block_until_ready`` fences added.
+    """
+    for _ in range(warmup):
+        jax.block_until_ready(fn(*args))
+    per_run = []
+    watch = Stopwatch()
+    for _ in range(runs):
+        watch()
+        jax.block_until_ready(fn(*args))
+        per_run.append(watch())
+    total = sum(per_run)
+    return TimeitResult(mean_s=total / runs, total_s=total, runs=runs,
+                        per_run_s=per_run)

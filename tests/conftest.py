@@ -1,0 +1,47 @@
+"""Test configuration: simulate an 8-device mesh on CPU.
+
+The reference could only test multi-rank behavior on a real PBS cluster
+(SURVEY.md §4.6); here XLA's host-platform device-count simulation makes
+"multi-node without a cluster" an actual capability. These env vars must
+be set before jax is imported anywhere.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"  # force: the env may pre-select a TPU
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+import pytest  # noqa: E402
+
+# Plugins (jaxtyping) may import jax before this conftest runs, locking in
+# env-derived config defaults — override via the config API, which works
+# any time before backend initialization.
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+
+from icikit.utils.mesh import make_mesh  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def mesh8():
+    return make_mesh(8)
+
+
+@pytest.fixture(scope="session")
+def mesh4():
+    return make_mesh(4)
+
+
+@pytest.fixture(scope="session")
+def mesh1():
+    return make_mesh(1)
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _check_devices():
+    assert jax.device_count() >= 8, (
+        "expected >= 8 simulated CPU devices; XLA_FLAGS not applied?")

@@ -1,0 +1,125 @@
+"""Oracle tests for allreduce / broadcast / scatter / gather."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from icikit.parallel import all_reduce, broadcast, gather_blocks, scatter_blocks
+from icikit.parallel.allreduce import ALLREDUCE_ALGORITHMS
+from icikit.parallel.collops import (
+    BROADCAST_ALGORITHMS,
+    GATHER_ALGORITHMS,
+    SCATTER_ALGORITHMS,
+)
+from icikit.utils.mesh import make_mesh, replicate, shard_along
+
+
+def _data(p, m, seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.integers(-100, 100, size=(p, m)).astype(np.int32)
+
+
+@pytest.mark.parametrize("algorithm", ALLREDUCE_ALGORITHMS)
+@pytest.mark.parametrize("m", [8, 64, 100])  # 100: not divisible by p -> pad path
+def test_allreduce_sum(mesh8, algorithm, m):
+    p = 8
+    data = _data(p, m)
+    x = shard_along(jnp.asarray(data), mesh8)
+    out = np.asarray(all_reduce(x, mesh8, algorithm=algorithm))
+    expected = data.sum(axis=0)
+    for d in range(p):
+        np.testing.assert_array_equal(out[d], expected)
+
+
+@pytest.mark.parametrize("algorithm", ALLREDUCE_ALGORITHMS)
+@pytest.mark.parametrize("op,npop", [("max", np.max), ("min", np.min)])
+def test_allreduce_minmax(mesh8, algorithm, op, npop):
+    p, m = 8, 16
+    data = _data(p, m, seed=2)
+    x = shard_along(jnp.asarray(data), mesh8)
+    out = np.asarray(all_reduce(x, mesh8, algorithm=algorithm, op=op))
+    expected = npop(data, axis=0)
+    for d in range(p):
+        np.testing.assert_array_equal(out[d], expected)
+
+
+@pytest.mark.parametrize("algorithm", BROADCAST_ALGORITHMS)
+@pytest.mark.parametrize("root", [0, 3, 7])
+def test_broadcast(mesh8, algorithm, root):
+    p, m = 8, 32
+    data = _data(p, m, seed=3)
+    x = shard_along(jnp.asarray(data), mesh8)
+    out = np.asarray(broadcast(x, mesh8, algorithm=algorithm, root=root))
+    for d in range(p):
+        np.testing.assert_array_equal(out[d], data[root])
+
+
+@pytest.mark.parametrize("algorithm", SCATTER_ALGORITHMS)
+@pytest.mark.parametrize("root", [0, 5])
+def test_scatter(mesh8, algorithm, root):
+    p, m = 8, 16
+    data = _data(p, m, seed=4)
+    x = replicate(jnp.asarray(data), mesh8)
+    out = np.asarray(scatter_blocks(x, mesh8, algorithm=algorithm, root=root))
+    np.testing.assert_array_equal(out, data)
+
+
+@pytest.mark.parametrize("algorithm", GATHER_ALGORITHMS)
+@pytest.mark.parametrize("root", [0, 2])
+def test_gather(mesh8, algorithm, root):
+    p, m = 8, 16
+    data = _data(p, m, seed=5)
+    x = shard_along(jnp.asarray(data), mesh8)
+    out = np.asarray(gather_blocks(x, mesh8, algorithm=algorithm, root=root))
+    np.testing.assert_array_equal(out[root], data)
+
+
+@pytest.mark.parametrize("algorithm", BROADCAST_ALGORITHMS)
+@pytest.mark.parametrize("root", [0, 2, 5])
+def test_broadcast_non_pow2(algorithm, root):
+    """All broadcast schedules support any p — including binomial, the
+    default, whose perm-truncation path only triggers off powers of 2."""
+    p, m = 6, 8
+    mesh = make_mesh(p)
+    data = _data(p, m, seed=6)
+    x = shard_along(jnp.asarray(data), mesh)
+    out = np.asarray(broadcast(x, mesh, algorithm=algorithm, root=root))
+    for d in range(p):
+        np.testing.assert_array_equal(out[d], data[root])
+
+
+@pytest.mark.parametrize("algorithm", ["linear", "xla"])
+def test_scatter_non_pow2(algorithm):
+    p, m = 6, 8
+    mesh = make_mesh(p)
+    data = _data(p, m, seed=7)
+    x = replicate(jnp.asarray(data), mesh)
+    out = np.asarray(scatter_blocks(x, mesh, algorithm=algorithm, root=1))
+    np.testing.assert_array_equal(out, data)
+
+
+def test_p1_degenerate_mesh(mesh1):
+    """p=1: every schedule degenerates to identity (zero-round loops)."""
+    from icikit.parallel import all_gather_blocks, all_to_all_blocks
+    data = _data(1, 8, seed=8)
+    x = shard_along(jnp.asarray(data), mesh1)
+    np.testing.assert_array_equal(
+        np.asarray(all_gather_blocks(x, mesh1, algorithm="ring"))[0], data)
+    np.testing.assert_array_equal(
+        np.asarray(all_reduce(x, mesh1, algorithm="recursive_doubling")), data)
+    np.testing.assert_array_equal(
+        np.asarray(broadcast(x, mesh1, algorithm="binomial")), data)
+    t = _data(1, 8, seed=9).reshape(1, 1, 8)
+    xt = shard_along(jnp.asarray(t), mesh1)
+    np.testing.assert_array_equal(
+        np.asarray(all_to_all_blocks(xt, mesh1, algorithm="hypercube")), t)
+
+
+def test_registry_lists_xla_everywhere():
+    """Every family's vendor baseline is discoverable via the registry
+    (one binary runs and compares all variants — SURVEY.md §5.6)."""
+    from icikit.utils.registry import get_algorithm, list_algorithms
+    for family in ("allgather", "alltoall", "allreduce", "broadcast",
+                   "scatter", "gather"):
+        assert "xla" in list_algorithms(family)
+        assert get_algorithm(family, "xla") is not None

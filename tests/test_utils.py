@@ -1,0 +1,91 @@
+"""Tests for the runtime core: mesh, registry, timing, RNG invariance."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from icikit.utils.mesh import ilog2, is_pow2, make_mesh, mesh_axis_size, shard_along
+from icikit.utils.prandom import odd_dist_warp, uniform_block, uniform_global
+from icikit.utils.registry import get_algorithm, list_algorithms, register_algorithm
+from icikit.utils.timing import Stopwatch, timeit
+
+
+def test_pow2_helpers():
+    assert [is_pow2(n) for n in [1, 2, 3, 4, 6, 8]] == \
+        [True, True, False, True, False, True]
+    assert ilog2(8) == 3
+    with pytest.raises(ValueError):
+        ilog2(6)
+
+
+def test_make_mesh(mesh8):
+    assert mesh_axis_size(mesh8) == 8
+    with pytest.raises(ValueError):
+        make_mesh(1024)
+
+
+def test_registry():
+    @register_algorithm("_testfam", "a")
+    def impl_a():
+        return "a"
+
+    assert get_algorithm("_testfam", "a") is impl_a
+    assert list_algorithms("_testfam") == ["a"]
+    with pytest.raises(KeyError, match="unknown algorithm"):
+        get_algorithm("_testfam", "missing")
+    with pytest.raises(ValueError, match="duplicate"):
+        register_algorithm("_testfam", "a")(impl_a)
+
+
+def test_stopwatch_resets_on_read():
+    watch = Stopwatch()
+    t1 = watch()
+    t2 = watch()
+    assert t1 >= 0 and t2 >= 0
+
+
+def test_timeit_reports_mean():
+    res = timeit(lambda x: x + 1, jnp.ones(8), runs=3, warmup=1)
+    assert res.runs == 3
+    assert res.total_s == pytest.approx(sum(res.per_run_s))
+    assert res.mean_s == pytest.approx(res.total_s / 3)
+
+
+def test_rng_partition_invariance(mesh8):
+    """The reference's seed-chain guarantees the same global sequence for
+    any p (psort.cc:575-581); here the same invariant holds by
+    construction — assert it for the sharded-generation path."""
+    key = jax.random.key(42)
+    n = 1 << 12
+    ref = np.asarray(uniform_global(key, n))
+    sharded = shard_along(uniform_global(key, n).reshape(8, -1), mesh8)
+    np.testing.assert_array_equal(np.asarray(sharded).ravel(), ref)
+
+    # block generator is self-consistent across partitionings
+    a = np.concatenate([np.asarray(uniform_block(key, n, i * (n // 4), n // 4))
+                        for i in range(4)])
+    b = np.concatenate([np.asarray(uniform_block(key, n, i * (n // 8), n // 8))
+                        for i in range(8)])
+    np.testing.assert_array_equal(a, b)
+
+
+def test_odd_dist_warp_matches_reference_formula():
+    """val = (val^(1 + 3*i/n))^2, psort.cc:600-609."""
+    n = 100
+    vals = np.linspace(0.01, 0.99, n).astype(np.float32)
+    warped = np.asarray(odd_dist_warp(jnp.asarray(vals)))
+    i = np.arange(n, dtype=np.float32)
+    expected = (vals ** (1.0 + 3.0 * i / n)) ** 2
+    np.testing.assert_allclose(warped, expected, rtol=1e-5)
+    # block path agrees with global path
+    blk = np.asarray(odd_dist_warp(jnp.asarray(vals[40:60]), 40, n))
+    np.testing.assert_allclose(blk, expected[40:60], rtol=1e-5)
+
+
+def test_odd_dist_skews_low():
+    """The warp pushes mass toward 0 increasingly with position —
+    the load-imbalance stressor for the sorting study."""
+    key = jax.random.key(0)
+    vals = np.asarray(uniform_global(key, 1 << 14, odd_dist=True))
+    assert (vals < 0.5).mean() > 0.6

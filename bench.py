@@ -1,0 +1,54 @@
+"""Headline benchmark — prints ONE JSON line.
+
+Metric: distributed-sort throughput in keys/s, benchmarked on all local
+devices (one TPU chip under the driver). Baseline: the north-star target
+from BASELINE.md — bitonic sort of 2^28 int32 keys in < 1 s on v4-8,
+i.e. 268.4M keys/s; ``vs_baseline`` > 1.0 beats it.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from icikit.utils.mesh import make_mesh, mesh_axis_size
+    from icikit.utils.timing import timeit
+
+    n = 1 << 27  # 134M keys: largest size that stays comfortable in HBM
+    mesh = make_mesh()
+    p = mesh_axis_size(mesh)
+
+    key = jax.random.key(0)
+    keys = jax.random.randint(key, (n,), jnp.iinfo(jnp.int32).min,
+                              jnp.iinfo(jnp.int32).max, dtype=jnp.int32)
+
+    try:
+        from icikit.models.sort import sort as dist_sort
+
+        def run(x):
+            return dist_sort(x, mesh)
+        kind = "bitonic_sort"
+    except ImportError:  # sorts not built yet: single-device local path
+        run = jax.jit(jnp.sort)
+        kind = "local_sort"
+
+    keys = jax.block_until_ready(keys)
+    res = timeit(run, keys, runs=5, warmup=2)
+    keys_per_s = n / res.best_s
+    baseline = (1 << 28) / 1.0  # 2^28 keys in 1 s
+    print(json.dumps({
+        "metric": f"{kind}_throughput_p{p}_n2e27_int32",
+        "value": round(keys_per_s, 1),
+        "unit": "keys/s",
+        "vs_baseline": round(keys_per_s / baseline, 4),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

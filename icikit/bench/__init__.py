@@ -1,0 +1,16 @@
+"""L4' — the benchmark harness.
+
+The reference's driver layer (``Communication/src/main.cc:390-502``,
+``Parallel-Sorting/src/psort.cc:525-663``): generate deterministic
+inputs, sweep problem sizes, invoke the kernels, self-verify in-line,
+report max-over-ranks timings. Here the same shape, with the upgrades the
+reference lacked: every algorithm variant runs in one process (runtime
+registry instead of ``#ifdef``), results are machine-readable JSON, and
+verification failures are reported per-record instead of killing the run.
+"""
+
+from icikit.bench.harness import (  # noqa: F401
+    BenchRecord,
+    sweep_collective,
+    sweep_family,
+)

@@ -1,0 +1,172 @@
+"""Collective benchmark harness: sweep, verify, time, report.
+
+Reproduces the reference's benchmark science
+(``Communication/src/main.cc:390-502``; report.pdf Figs. 2-6) on a TPU
+mesh: message-size sweeps 2^0..2^16 ints with hand-rolled algorithms
+side-by-side against the XLA/ICI "vendor" baseline. Payloads carry the
+reference's rank-derived arithmetic patterns and every device's result
+is verified against the closed-form expectation each run
+(``main.cc:431-441``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from dataclasses import dataclass
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from icikit.parallel.allgather import all_gather_blocks
+from icikit.parallel.allreduce import all_reduce
+from icikit.parallel.alltoall import all_to_all_blocks
+from icikit.parallel.collops import broadcast, gather_blocks, scatter_blocks
+from icikit.utils.mesh import DEFAULT_AXIS, mesh_axis_size, replicate, shard_along
+from icikit.utils.timing import timeit
+
+# Default sweep from the reference driver: msize = 2^l, l = 0,4,8,12,16
+# for all-to-all (main.cc:422-423) and l <= 12 for personalized (:458).
+REFERENCE_SWEEP = tuple(1 << l for l in range(0, 17, 4))
+REFERENCE_SWEEP_PERSONALIZED = tuple(1 << l for l in range(0, 13, 4))
+
+
+@dataclass
+class BenchRecord:
+    family: str
+    algorithm: str
+    p: int
+    msize: int            # elements per block (the reference's "message size")
+    dtype: str
+    bytes_per_block: int
+    runs: int
+    mean_s: float
+    best_s: float
+    busbw_gbps: float     # effective per-device bus bandwidth
+    verified: bool
+
+    def to_json(self) -> str:
+        return json.dumps(dataclasses.asdict(self))
+
+
+def _bus_bytes(family: str, p: int, block_bytes: int) -> float:
+    """Bytes each device must move for one collective — the standard
+    effective-bandwidth normalizations (so algorithms of one family are
+    comparable, like the reference's time-vs-msize curves)."""
+    if family in ("allgather", "alltoall"):
+        return (p - 1) * block_bytes
+    if family in ("scatter", "gather"):
+        # the root link carries p-1 blocks either direction
+        return (p - 1) * block_bytes
+    if family == "allreduce":
+        return 2 * block_bytes * (p - 1) / p
+    if family == "broadcast":
+        return block_bytes
+    raise ValueError(family)
+
+
+def _pattern(p: int, msize: int, dtype) -> np.ndarray:
+    """Rank-and-element-derived payload (main.cc:431-433)."""
+    src = np.arange(p)[:, None]
+    k = np.arange(msize)[None, :]
+    return ((src * 7919 + k * 13) % 1000).astype(dtype)
+
+
+def _setup(family: str, mesh, axis: str, msize: int, dtype):
+    """Build (input, run_fn_factory, verify_fn) for one family."""
+    p = mesh_axis_size(mesh, axis)
+    if family in ("allgather", "broadcast", "gather", "allreduce"):
+        data = _pattern(p, msize, dtype)
+        x = shard_along(jnp.asarray(data), mesh, axis)
+    elif family == "alltoall":
+        data = _pattern(p * p, msize, dtype).reshape(p, p, msize)
+        x = shard_along(jnp.asarray(data), mesh, axis)
+    elif family == "scatter":
+        data = _pattern(p, msize, dtype)
+        x = replicate(jnp.asarray(data), mesh)
+    else:
+        raise ValueError(family)
+
+    fns = {
+        "allgather": all_gather_blocks,
+        "alltoall": all_to_all_blocks,
+        "allreduce": all_reduce,
+        "broadcast": broadcast,
+        "scatter": scatter_blocks,
+        "gather": gather_blocks,
+    }
+    run = lambda alg: fns[family](x, mesh, axis, algorithm=alg)
+
+    def verify(out) -> bool:
+        o = np.asarray(out)
+        if family == "allgather":
+            return all(np.array_equal(o[d], data) for d in range(p))
+        if family == "alltoall":
+            return np.array_equal(o, data.swapaxes(0, 1))
+        if family == "allreduce":
+            exp = data.sum(axis=0)
+            return all(np.array_equal(o[d], exp) for d in range(p))
+        if family == "broadcast":
+            return all(np.array_equal(o[d], data[0]) for d in range(p))
+        if family == "scatter":
+            return np.array_equal(o, data)
+        if family == "gather":
+            return np.array_equal(o[0], data)
+        return False
+
+    return run, verify
+
+
+def sweep_collective(mesh, family: str, algorithm: str,
+                     sizes: Sequence[int] = REFERENCE_SWEEP,
+                     dtype=jnp.int32, runs: int = 10, warmup: int = 2,
+                     axis: str = DEFAULT_AXIS) -> list[BenchRecord]:
+    """Benchmark one algorithm across a message-size sweep."""
+    p = mesh_axis_size(mesh, axis)
+    records = []
+    for msize in sizes:
+        run, verify = _setup(family, mesh, axis, msize, np.dtype(dtype))
+        verified = bool(verify(jax.block_until_ready(run(algorithm))))
+        res = timeit(run, algorithm, runs=runs, warmup=warmup)
+        block_bytes = msize * np.dtype(dtype).itemsize
+        records.append(BenchRecord(
+            family=family, algorithm=algorithm, p=p, msize=msize,
+            dtype=np.dtype(dtype).name, bytes_per_block=block_bytes,
+            runs=runs, mean_s=res.mean_s, best_s=res.best_s,
+            busbw_gbps=_bus_bytes(family, p, block_bytes) / res.best_s / 1e9,
+            verified=verified))
+    return records
+
+
+def sweep_family(mesh, family: str, algorithms: Sequence[str] | None = None,
+                 **kw) -> list[BenchRecord]:
+    """The reference's comparison study: every variant of a family
+    side-by-side (report.pdf Figs. 2-6), skipping variants whose
+    constraints (e.g. power-of-2) the mesh does not meet."""
+    from icikit.utils.mesh import UnsupportedMeshError
+    from icikit.utils.registry import list_algorithms
+    records = []
+    for alg in (algorithms or list_algorithms(family)):
+        try:
+            records.extend(sweep_collective(mesh, family, alg, **kw))
+        except UnsupportedMeshError:
+            continue  # constraint not met on this mesh (e.g. non-pow2)
+    return records
+
+
+def format_table(records: list[BenchRecord]) -> str:
+    """Human-readable comparison table (the reference printed per-run
+    means to stdout; main.cc:447-449)."""
+    if not records:
+        return "(no records)"
+    hdr = (f"{'family':<10} {'algorithm':<20} {'p':>3} {'msize':>8} "
+           f"{'mean_us':>10} {'best_us':>10} {'busbw GB/s':>11} {'ok':>3}")
+    lines = [hdr, "-" * len(hdr)]
+    for r in records:
+        lines.append(
+            f"{r.family:<10} {r.algorithm:<20} {r.p:>3} {r.msize:>8} "
+            f"{r.mean_s * 1e6:>10.1f} {r.best_s * 1e6:>10.1f} "
+            f"{r.busbw_gbps:>11.3f} {'✓' if r.verified else '✗':>3}")
+    return "\n".join(lines)

@@ -1,0 +1,71 @@
+"""CLI entry point for the collective benchmark harness.
+
+Usage (simulated 8-device mesh on CPU):
+
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \\
+        python -m icikit.bench.run --family allgather
+
+On TPU hardware, run without overrides to use all local devices. This
+replaces the reference's one-binary-per-algorithm + PBS redirection ops
+model (``Communication/Data/sub.sh``): one process sweeps every variant
+and emits machine-readable JSON next to the human table.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--family", default="allgather",
+                    choices=["allgather", "alltoall", "allreduce",
+                             "broadcast", "scatter", "gather"])
+    ap.add_argument("--algorithms", default=None,
+                    help="comma-separated variant names (default: all)")
+    ap.add_argument("--sizes", default=None,
+                    help="comma-separated block sizes in elements "
+                         "(default: the reference sweep 2^0..2^16 step 2^4)")
+    ap.add_argument("--devices", type=int, default=None,
+                    help="mesh size (default: all local devices)")
+    ap.add_argument("--runs", type=int, default=10)
+    ap.add_argument("--warmup", type=int, default=2)
+    ap.add_argument("--dtype", default="int32")
+    ap.add_argument("--json", dest="json_path", default=None,
+                    help="write records as JSON lines to this path")
+    args = ap.parse_args(argv)
+
+    import jax.numpy as jnp
+
+    from icikit.bench.harness import (
+        REFERENCE_SWEEP,
+        REFERENCE_SWEEP_PERSONALIZED,
+        format_table,
+        sweep_family,
+    )
+    from icikit.utils.mesh import make_mesh
+
+    mesh = make_mesh(args.devices)
+    sizes = (tuple(int(s) for s in args.sizes.split(","))
+             if args.sizes else
+             (REFERENCE_SWEEP_PERSONALIZED if args.family == "alltoall"
+              else REFERENCE_SWEEP))
+    algorithms = args.algorithms.split(",") if args.algorithms else None
+    records = sweep_family(mesh, args.family, algorithms, sizes=sizes,
+                           dtype=jnp.dtype(args.dtype), runs=args.runs,
+                           warmup=args.warmup)
+    print(format_table(records))
+    if args.json_path:
+        with open(args.json_path, "w") as f:
+            for r in records:
+                f.write(r.to_json() + "\n")
+    if not all(r.verified for r in records):
+        print("VERIFICATION FAILURES present", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -36,7 +36,7 @@ from icikit.parallel.shmap import (
     shift_perm,
     xor_perm,
 )
-from icikit.utils.mesh import DEFAULT_AXIS, ilog2, is_pow2
+from icikit.utils.mesh import DEFAULT_AXIS, UnsupportedMeshError, ilog2, is_pow2
 from icikit.utils.registry import register_algorithm
 
 
@@ -83,7 +83,7 @@ def _recursive_doubling(block: jax.Array, axis: str, p: int) -> jax.Array:
     static-size dynamic slice + ``ppermute`` + one update.
     """
     if not is_pow2(p):
-        raise ValueError(
+        raise UnsupportedMeshError(
             "recursive_doubling requires a power-of-2 device count "
             f"(got {p}); the reference's virtual-twin workaround "
             "(Communication/src/main.cc:71-75) is intentionally not "

@@ -23,7 +23,7 @@ from icikit.parallel.shmap import (
     shift_perm,
     xor_perm,
 )
-from icikit.utils.mesh import DEFAULT_AXIS, ilog2, is_pow2
+from icikit.utils.mesh import DEFAULT_AXIS, UnsupportedMeshError, ilog2, is_pow2
 from icikit.utils.registry import register_algorithm
 
 _OPS = {
@@ -42,7 +42,8 @@ def _recursive_doubling(x: jax.Array, axis: str, p: int, op: str) -> jax.Array:
     analysis (report.pdf §2.2).
     """
     if not is_pow2(p):
-        raise ValueError("recursive_doubling allreduce requires power-of-2 p")
+        raise UnsupportedMeshError(
+            "recursive_doubling allreduce requires power-of-2 p")
     combine = _OPS[op][0]
     for i in range(ilog2(p)):
         recv = lax.ppermute(x, axis, xor_perm(p, 1 << i))

@@ -34,13 +34,13 @@ from icikit.parallel.shmap import (
     shift_perm,
     xor_perm,
 )
-from icikit.utils.mesh import DEFAULT_AXIS, ilog2, is_pow2
+from icikit.utils.mesh import DEFAULT_AXIS, UnsupportedMeshError, ilog2, is_pow2
 from icikit.utils.registry import register_algorithm
 
 
 def _require_pow2(name: str, p: int):
     if not is_pow2(p):
-        raise ValueError(
+        raise UnsupportedMeshError(
             f"{name} all-to-all requires a power-of-2 device count (got "
             f"{p}); use 'wraparound', 'naive', or 'xla' for other sizes")
 

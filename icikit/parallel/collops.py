@@ -22,7 +22,7 @@ from icikit.parallel.shmap import (
     register_family,
     shift_perm,
 )
-from icikit.utils.mesh import DEFAULT_AXIS, is_pow2
+from icikit.utils.mesh import DEFAULT_AXIS, UnsupportedMeshError, is_pow2
 from icikit.utils.registry import register_algorithm
 
 # ---------------------------------------------------------------------------
@@ -107,7 +107,7 @@ def _scatter_linear(buf, axis, p, root):
 def _scatter_binomial(buf, axis, p, root):
     """Halving binomial tree: log p rounds, message size halves each round."""
     if not is_pow2(p):
-        raise ValueError("binomial scatter requires power-of-2 p")
+        raise UnsupportedMeshError("binomial scatter requires power-of-2 p")
     r = lax.axis_index(axis)
     rr = jnp.mod(r - root, p)
     # Work in relative block order: rel[k] = block for device (root+k)%p.
@@ -176,7 +176,7 @@ def _gather_linear(block, axis, p, root):
 def _gather_binomial(block, axis, p, root):
     """Doubling binomial tree: reverse of binomial scatter."""
     if not is_pow2(p):
-        raise ValueError("binomial gather requires power-of-2 p")
+        raise UnsupportedMeshError("binomial gather requires power-of-2 p")
     r = lax.axis_index(axis)
     rr = jnp.mod(r - root, p)
     rel = jnp.zeros((p,) + block.shape[1:], block.dtype)

@@ -18,6 +18,12 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 DEFAULT_AXIS = "p"
 
 
+class UnsupportedMeshError(ValueError):
+    """An algorithm's mesh constraint (e.g. power-of-2 device count) is
+    not met. Distinct from generic ValueError so harness code can skip
+    constrained variants without masking real errors."""
+
+
 def is_pow2(n: int) -> bool:
     """True iff n is a positive power of two (reference ``pow2``/``log2``
     helpers, ``Communication/src/main.cc:18-29``)."""

@@ -20,6 +20,29 @@ from dataclasses import dataclass
 import jax
 
 
+def fence(out):
+    """Force completion of ``out`` and return it.
+
+    ``jax.block_until_ready`` alone is not a reliable fence on every
+    platform: remote-tunneled backends have been observed returning
+    immediately for repeated structurally-identical executions, which
+    makes naive timing loops report near-zero times. Pulling a
+    data-dependent scalar per output leaf (both corners, so first and
+    last shard of a sharded result are covered) forces the execution to
+    actually finish.
+    """
+    for leaf in jax.tree_util.tree_leaves(out):
+        if hasattr(leaf, "ndim") and hasattr(leaf, "__getitem__"):
+            if leaf.size == 0:
+                jax.block_until_ready(leaf)
+            elif leaf.ndim == 0:
+                jax.device_get(leaf)
+            else:
+                jax.device_get(leaf[(0,) * leaf.ndim])
+                jax.device_get(leaf[(-1,) * leaf.ndim])
+    return out
+
+
 class Stopwatch:
     """Reset-on-read stopwatch (reference ``get_timer``,
     ``Dynamic-Load-Balancing/src/utilities.cc:61-68``)."""
@@ -46,21 +69,46 @@ class TimeitResult:
         return min(self.per_run_s)
 
 
-def timeit(fn, *args, runs: int = 10, warmup: int = 2) -> TimeitResult:
+def timeit(fn, *args, runs: int = 10, warmup: int = 2,
+           sync: str = "auto") -> TimeitResult:
     """Time ``fn(*args)`` with device fencing.
 
     Mirrors the reference's ``test_runs`` repetition loop
     (``Communication/src/main.cc:427-443``) with the TPU-necessary warm-up
-    and ``block_until_ready`` fences added.
+    and completion fences added. ``sync``: "block" uses
+    ``jax.block_until_ready``; "transfer" uses the corner-scalar
+    transfer fence; "auto" picks "block" on CPU (cheap and reliable
+    there) and "transfer" elsewhere (see ``fence``).
     """
+    if sync == "auto":
+        sync = "block" if jax.default_backend() == "cpu" else "transfer"
+    if sync not in ("block", "transfer"):
+        raise ValueError(f"sync must be 'auto', 'block' or 'transfer', "
+                         f"got {sync!r}")
+    wait = jax.block_until_ready if sync == "block" else fence
+    out = None
     for _ in range(warmup):
-        jax.block_until_ready(fn(*args))
+        out = wait(fn(*args))
+    fence_s = 0.0
+    if sync == "transfer" and out is not None:
+        # The transfer fence adds host round-trips inside the timed
+        # region; measure its cost on an already-complete output and
+        # subtract, so small/latency-bound workloads aren't reported as
+        # fence-latency. (Fencing overhead is re-measured per timeit call
+        # since it depends on the output pytree.)
+        w = Stopwatch()
+        costs = []
+        for _ in range(3):
+            w()
+            fence(out)
+            costs.append(w())
+        fence_s = min(costs)
     per_run = []
     watch = Stopwatch()
     for _ in range(runs):
         watch()
-        jax.block_until_ready(fn(*args))
-        per_run.append(watch())
+        wait(fn(*args))
+        per_run.append(max(watch() - fence_s, 1e-9))
     total = sum(per_run)
     return TimeitResult(mean_s=total / runs, total_s=total, runs=runs,
                         per_run_s=per_run)

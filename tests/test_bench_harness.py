@@ -1,0 +1,49 @@
+"""Smoke tests for the benchmark harness and driver entry points."""
+
+import json
+import subprocess
+import sys
+
+import jax.numpy as jnp
+
+from icikit.bench.harness import format_table, sweep_collective, sweep_family
+
+
+def test_sweep_collective_verifies(mesh4):
+    recs = sweep_collective(mesh4, "allgather", "ring", sizes=[4, 16],
+                            runs=2, warmup=1)
+    assert len(recs) == 2
+    assert all(r.verified for r in recs)
+    assert all(r.busbw_gbps > 0 for r in recs)
+    assert json.loads(recs[0].to_json())["family"] == "allgather"
+
+
+def test_sweep_family_skips_constrained(mesh4):
+    recs = sweep_family(mesh4, "alltoall", sizes=[4], runs=1, warmup=1)
+    algs = {r.algorithm for r in recs}
+    assert {"wraparound", "naive", "ecube", "hypercube", "xla"} <= algs
+    assert all(r.verified for r in recs)
+    table = format_table(recs)
+    assert "hypercube" in table
+
+
+def test_sweep_allreduce_all_variants(mesh4):
+    recs = sweep_family(mesh4, "allreduce", sizes=[16], runs=1, warmup=1)
+    assert {r.algorithm for r in recs} == {"recursive_doubling", "ring", "xla"}
+    assert all(r.verified for r in recs)
+
+
+def test_graft_entry_single_chip():
+    import __graft_entry__
+    import jax
+    import numpy as np
+
+    fn, args = __graft_entry__.entry()
+    out = jax.block_until_ready(jax.jit(fn)(*args))
+    arr = np.asarray(out).ravel()
+    assert (np.diff(arr) >= 0).all()
+
+
+def test_graft_dryrun_multichip():
+    import __graft_entry__
+    __graft_entry__.dryrun_multichip(8)

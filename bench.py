@@ -27,15 +27,15 @@ def main():
     keys = jax.random.randint(key, (n,), jnp.iinfo(jnp.int32).min,
                               jnp.iinfo(jnp.int32).max, dtype=jnp.int32)
 
-    try:
-        from icikit.models.sort import sort as dist_sort
+    from icikit.models.sort import sort as dist_sort
+    from icikit.utils.mesh import is_pow2
 
-        def run(x):
-            return dist_sort(x, mesh)
-        kind = "bitonic_sort"
-    except ImportError:  # sorts not built yet: single-device local path
-        run = jax.jit(jnp.sort)
-        kind = "local_sort"
+    # bitonic needs power-of-2 p; fall back like sweep_family does
+    alg = "bitonic" if is_pow2(p) else "sample"
+
+    def run(x):
+        return dist_sort(x, mesh, algorithm=alg)
+    kind = f"{alg}_sort"
 
     keys = jax.block_until_ready(keys)
     res = timeit(run, keys, runs=5, warmup=2)

@@ -1,0 +1,2 @@
+"""L3' — workloads: the distributed sorting algorithms and the
+dynamic-load-balancing peg-solitaire study."""

@@ -1,0 +1,53 @@
+"""Distributed sorting algorithms (the reference's Parallel-Sorting suite).
+
+Four algorithms, selectable at runtime (the reference hard-codes the
+choice at the call site, ``psort.cc:647``):
+
+- ``bitonic``        — C14: hypercube compare-split network; fully
+                       static shapes, the TPU flagship.
+- ``sample``         — C15: splitters from an allgathered sample set.
+- ``sample_bitonic`` — C16: splitters sorted by the distributed bitonic
+                       sort (the report's winner among sample variants).
+- ``quicksort``      — C17: recursive sub-cube partitioning by
+                       median-of-medians pivots.
+
+All take a flat array of any length, pad with dtype-max sentinels to
+equal blocks, sort across the mesh, and return the flat sorted array.
+``check_sort`` is the distributed inversion-count verifier (C18).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+
+from icikit.models.sort.bitonic import bitonic_sort_blocks
+from icikit.models.sort.common import prepare_blocks, take_sorted
+from icikit.models.sort.quicksort import hypercube_quicksort_blocks
+from icikit.models.sort.sample import sample_sort_blocks
+from icikit.models.sort.verify import check_sort, check_sort_shard  # noqa: F401
+from icikit.utils.mesh import DEFAULT_AXIS
+from icikit.utils.registry import get_algorithm, register_algorithm
+
+# Block-level implementations, registry-discoverable like every other
+# algorithm family (signature: (x2d, mesh, axis, **kw) -> sorted x2d).
+register_algorithm("sort", "bitonic")(bitonic_sort_blocks)
+register_algorithm("sort", "sample")(
+    partial(sample_sort_blocks, splitter="allgather"))
+register_algorithm("sort", "sample_bitonic")(
+    partial(sample_sort_blocks, splitter="bitonic"))
+register_algorithm("sort", "quicksort")(hypercube_quicksort_blocks)
+
+SORT_ALGORITHMS = ("bitonic", "sample", "sample_bitonic", "quicksort")
+
+
+def sort(x: jax.Array, mesh, axis: str = DEFAULT_AXIS,
+         algorithm: str = "bitonic", **kw) -> jax.Array:
+    """Sort flat ``x`` ascending across the mesh; returns the flat
+    sorted array (same length and dtype)."""
+    impl = get_algorithm("sort", algorithm)
+    n = x.shape[0]
+    blocks, _ = prepare_blocks(x, mesh, axis,
+                               pow2_local=(algorithm == "bitonic"))
+    return take_sorted(impl(blocks, mesh, axis, **kw), n)

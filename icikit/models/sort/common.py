@@ -1,0 +1,161 @@
+"""Shared plumbing for the distributed sorts: padding/sharding, sentinels,
+and the capacity-padded ragged redistribution primitive.
+
+The reference's ragged exchanges (``MPI_Alltoallv`` in sample sort,
+``psort.cc:277``; variable ``MPI_Send/Recv`` + ``MPI_Get_count`` in
+quicksort, ``:440-482``) have no direct XLA analog: TPU programs need
+static shapes. The design (SURVEY.md §7 "hard parts") is capacity-padded
+exchange: fixed-capacity buffers + explicit count vectors, with overflow
+*detected* and surfaced rather than silently truncated.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from icikit.utils.mesh import DEFAULT_AXIS, mesh_axis_size, shard_along
+
+
+def sentinel_for(dtype) -> jax.Array:
+    """Largest representable value — pads buffers so padding sorts last
+    (replacing the reference's degenerate ``INT_MAX`` sentinel for
+    double data, ``psort.cc:234`` — a recorded defect)."""
+    dtype = jnp.dtype(dtype)
+    if jnp.issubdtype(dtype, jnp.floating):
+        return jnp.array(jnp.inf, dtype)
+    return jnp.array(jnp.iinfo(dtype).max, dtype)
+
+
+def ceil_to(n: int, mult: int) -> int:
+    return -(-n // mult) * mult
+
+
+def next_pow2(n: int) -> int:
+    return 1 if n <= 1 else 1 << (n - 1).bit_length()
+
+
+def prepare_blocks(x: jax.Array, mesh, axis: str = DEFAULT_AXIS,
+                   pow2_local: bool = False):
+    """Pad flat ``x`` with sentinels to p equal blocks and shard.
+
+    The reference spreads the remainder over low ranks
+    (``psort.cc:556-562``); sentinel-padding to equal blocks keeps
+    shapes static and the padding sorts harmlessly to the global tail.
+    Returns (sharded (p, n_loc) array, n_loc).
+    """
+    p = mesh_axis_size(mesh, axis)
+    n = x.shape[0]
+    n_loc = max(1, -(-n // p))  # >=1 so empty inputs stay shape-valid
+    if pow2_local:
+        n_loc = next_pow2(n_loc)
+    total = n_loc * p
+    if total != n:
+        fill = jnp.full((total - n,), sentinel_for(x.dtype), x.dtype)
+        x = jnp.concatenate([x, fill])
+    return shard_along(x.reshape(p, n_loc), mesh, axis), n_loc
+
+
+def take_sorted(out2d: jax.Array, n: int) -> jax.Array:
+    """Strip sentinel padding from the sorted (p, n_loc) result."""
+    return out2d.reshape(-1)[:n]
+
+
+# ---------------------------------------------------------------------------
+# capacity-padded ragged exchange (per-shard; call inside shard_map)
+# ---------------------------------------------------------------------------
+
+
+def pack_segments(a: jax.Array, starts: jax.Array, counts: jax.Array,
+                  cap: int) -> jax.Array:
+    """Pack p contiguous segments of local array ``a`` into (p, cap) rows
+    padded with sentinels. ``starts``/``counts``: (p,) int32, traced.
+
+    Because locally sorted data makes destination buckets contiguous
+    (the reference histograms into contiguous buckets, psort.cc:241-250),
+    packing is one vectorized gather — no per-bucket loop.
+    """
+    idx = starts[:, None] + jnp.arange(cap)[None, :]
+    valid = jnp.arange(cap)[None, :] < counts[:, None]
+    gathered = a[jnp.clip(idx, 0, a.shape[0] - 1)]
+    return jnp.where(valid, gathered, sentinel_for(a.dtype))
+
+
+def unpack_rows(rows: jax.Array, counts: jax.Array):
+    """Flatten (p, cap) rows with per-row valid ``counts`` into a flat
+    (p*cap,) array whose invalid lanes are sentinels, plus total count."""
+    cap = rows.shape[1]
+    valid = jnp.arange(cap)[None, :] < counts[:, None]
+    flat = jnp.where(valid, rows, sentinel_for(rows.dtype)).reshape(-1)
+    return flat, counts.sum()
+
+
+def exchange_counts(counts: jax.Array, axis: str) -> jax.Array:
+    """Given my per-destination ``counts`` (p,), return per-source counts
+    destined to me (p,) — the ``MPI_Alltoall`` of counts at
+    ``psort.cc:263``, as a tiny ``all_to_all``."""
+    return lax.all_to_all(counts[:, None], axis, split_axis=0,
+                          concat_axis=0, tiled=True)[:, 0]
+
+
+def ragged_all_to_all(a: jax.Array, starts: jax.Array, counts: jax.Array,
+                      cap: int, axis: str):
+    """Send contiguous segment d of ``a`` to device d; receive segments.
+
+    Returns (rows (p, cap) sentinel-padded, recv_counts (p,), overflow
+    flag). ``overflow`` is 1 if any segment anywhere exceeded ``cap``
+    (content would be truncated) — callers surface it on the host.
+    """
+    overflow = lax.psum((counts > cap).any().astype(jnp.int32), axis)
+    packed = pack_segments(a, starts, counts, cap)
+    rows = lax.all_to_all(packed, axis, split_axis=0, concat_axis=0,
+                          tiled=True)
+    recv_counts = jnp.minimum(exchange_counts(counts, axis), cap)
+    return rows, recv_counts, overflow
+
+
+def rebalance_sorted(flat: jax.Array, count: jax.Array, n_loc: int,
+                     axis: str, p: int) -> jax.Array:
+    """Redistribute globally-sorted-but-ragged data to exactly ``n_loc``
+    per device, preserving order.
+
+    Input per-shard: ``flat`` sorted ascending with ``count`` valid
+    elements (sentinel tail). Globally the valid runs concatenated in
+    rank order are sorted. Output: (n_loc,) — device k ends with global
+    positions [k*n_loc, (k+1)*n_loc), padded with sentinels past the
+    global total.
+
+    This is the regular-shape answer to the reference's "local sizes
+    change" property (``psort.cc:274``): one extra capacity-padded
+    all-to-all instead of leaving ragged results in place.
+    """
+    r = lax.axis_index(axis)
+    all_counts = lax.all_gather(count[None], axis, axis=0, tiled=True)  # (p,)
+    offsets = jnp.cumsum(all_counts) - all_counts            # my run starts
+    my_off = offsets[r]
+    # Piece for dest d: my elements whose global position lands in
+    # [d*n_loc, (d+1)*n_loc) — contiguous because my run is contiguous.
+    d_idx = jnp.arange(p)
+    seg_lo = jnp.clip(d_idx * n_loc - my_off, 0, count)
+    seg_hi = jnp.clip((d_idx + 1) * n_loc - my_off, 0, count)
+    starts = seg_lo
+    counts = seg_hi - seg_lo
+    rows, recv_counts, overflow = ragged_all_to_all(
+        flat, starts, counts, n_loc, axis)
+    del overflow  # a piece within [k*n_loc,(k+1)*n_loc) can't exceed n_loc
+    # Place received pieces: piece from src s starts at global position
+    # max(offsets[s], k*n_loc); its local offset is that minus k*n_loc.
+    base = r * n_loc
+    piece_off = jnp.clip(offsets - base, 0, n_loc)
+    # out[t] = rows[s, t - piece_off[s]] where s is the piece covering t.
+    t = jnp.arange(n_loc)
+    # src covering t: the last s with piece_off[s] <= t and count>0; since
+    # pieces tile [0, n_loc) in order, searchsorted on piece ends works.
+    piece_end = piece_off + recv_counts
+    s_of_t = jnp.clip(jnp.searchsorted(piece_end, t, side="right"), 0, p - 1)
+    col = jnp.clip(t - piece_off[s_of_t], 0, n_loc - 1)
+    vals = rows[s_of_t, col]
+    in_range = t < piece_end[-1]  # pieces tile [0, total-valid-here)
+    return jnp.where(in_range, vals, sentinel_for(flat.dtype))

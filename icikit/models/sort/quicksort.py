@@ -1,0 +1,148 @@
+"""Distributed hypercube quicksort.
+
+Reference: ``parallel_quick_sort`` (``Parallel-Sorting/src/psort.cc:
+377-490``): d = log2 p rounds; round i splits the world into 2^i
+sub-communicators (``MPI_Comm_split`` by ``color = myid / 2^(d-i)``,
+``:403-413``), picks a median-of-medians pivot within each sub-cube
+(``:421-426``), partitions locally at ``lower_bound(pivot)`` (``:429``),
+and exchanges halves across the sub-cube's top bit (``:432-482``) with
+``MPI_Get_count`` sizing the variable receive. Buffers are
+over-allocated to absorb skew (``:385``).
+
+TPU redesign (SURVEY.md §7 "hard parts"):
+- No communicator splitting: the full mesh runs every round; a device's
+  sub-cube is the aligned group of its rank bits, and the "allgather
+  medians within sub-comm" becomes a full-mesh allgather + a dynamic
+  slice of the group's window. ICI traffic is the same order; the
+  schedule stays static.
+- The variable-size exchange becomes a fixed-capacity segment exchange
+  (one partner per round, so a plain ``ppermute`` of a packed row) with
+  explicit counts and overflow detection; capacity plays the role of
+  the reference's over-allocation, but checked.
+- Ragged final sizes are re-balanced to exact equal blocks
+  (``common.rebalance_sorted``) so the output is regular.
+
+Caveat: data equal to the dtype's maximum value collides with the
+sentinel and may be miscounted; use sample sort for such data.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from icikit.models.sort.common import rebalance_sorted, sentinel_for
+from icikit.parallel.shmap import shard_map, xor_perm
+from icikit.utils.mesh import DEFAULT_AXIS, UnsupportedMeshError, ilog2, is_pow2
+
+
+def hypercube_quicksort_shard(a: jax.Array, axis: str, p: int, cap: int):
+    """Per-shard hypercube quicksort. Returns (sorted (n_loc,) block,
+    overflow flag). ``cap`` >= n_loc is the working-buffer capacity."""
+    if not is_pow2(p):
+        raise UnsupportedMeshError(
+            f"hypercube quicksort requires a power-of-2 device count "
+            f"(got {p}), as in the reference (psort.cc:378-382)")
+    n_loc = a.shape[0]
+    sent = sentinel_for(a.dtype)
+    if p == 1:
+        return jnp.sort(a), jnp.zeros((), jnp.int32)
+
+    r = lax.axis_index(axis)
+    d = ilog2(p)
+    # Working buffer: valid prefix of `count` elements, sentinel tail.
+    buf = jnp.full((cap,), sent, a.dtype)
+    buf = lax.dynamic_update_slice_in_dim(buf, a, 0, 0)
+    count = jnp.asarray(n_loc, jnp.int32)
+    overflow = jnp.zeros((), jnp.int32)
+    t = jnp.arange(cap, dtype=jnp.int32)
+
+    for i in range(d):
+        g = p >> i          # sub-cube size this round
+        half = g >> 1
+        base = (r // g) * g  # my sub-cube's first rank (the color split)
+        buf = jnp.sort(buf)  # local sort; sentinels stay at the tail
+        # Median of my valid prefix, then median-of-medians in my group
+        # (psort.cc:407-426). Empty prefix contributes the sentinel.
+        my_med = jnp.where(
+            count > 0, buf[jnp.clip((count - 1) // 2, 0, cap - 1)], sent)
+        meds = lax.all_gather(my_med[None], axis, axis=0, tiled=True)
+        gmeds = lax.dynamic_slice_in_dim(meds, base, g, 0)
+        pivot = jnp.sort(gmeds)[half]
+        # Partition at lower_bound(pivot) (:429). side="left" keeps
+        # elements == pivot in the upper half, like the reference.
+        k = jnp.minimum(
+            jnp.searchsorted(buf, pivot, side="left").astype(jnp.int32),
+            count)
+        low_count = k
+        high_count = count - k
+        in_low = (r & half) == 0
+        # Low side keeps [0,k) and ships [k,count); high side ships [0,k)
+        # and keeps [k,count) (:440-482).
+        send_start = jnp.where(in_low, low_count, 0)
+        send_count = jnp.where(in_low, high_count, low_count)
+        keep_start = jnp.where(in_low, 0, low_count)
+        keep_count = jnp.where(in_low, low_count, high_count)
+
+        seg = jnp.where(t < send_count,
+                        buf[jnp.clip(send_start + t, 0, cap - 1)], sent)
+        perm = xor_perm(p, half)
+        recv = lax.ppermute(seg, axis, perm)
+        recv_count = lax.ppermute(send_count[None], axis, perm)[0]
+
+        new_count = keep_count + recv_count
+        overflow = overflow | (new_count > cap).astype(jnp.int32)
+        recv_used = jnp.minimum(recv_count, cap - keep_count)
+        kept_vals = buf[jnp.clip(keep_start + t, 0, cap - 1)]
+        recv_vals = recv[jnp.clip(t - keep_count, 0, cap - 1)]
+        buf = jnp.where(t < keep_count, kept_vals,
+                        jnp.where(t < keep_count + recv_used, recv_vals,
+                                  sent))
+        count = jnp.minimum(new_count, jnp.asarray(cap, jnp.int32))
+
+    buf = jnp.sort(buf)  # final local sort (:486)
+    overflow = lax.psum(overflow, axis)
+    out = rebalance_sorted(buf, count, n_loc, axis, p)
+    return out, overflow
+
+
+@lru_cache(maxsize=None)
+def _build(mesh, axis, cap):
+    p = mesh.shape[axis]
+
+    def per_shard(b):
+        out, overflow = hypercube_quicksort_shard(b[0], axis, p, cap)
+        return out[None], overflow[None]
+
+    return jax.jit(shard_map(per_shard, mesh=mesh, in_specs=P(axis),
+                             out_specs=(P(axis), P(axis))))
+
+
+def hypercube_quicksort_blocks(x2d: jax.Array, mesh,
+                               axis: str = DEFAULT_AXIS,
+                               cap_factor: float = 2.0,
+                               max_cap_factor: float = 8.0):
+    """Sort block-sharded (p, n_loc) data globally ascending.
+
+    The working capacity starts at ``cap_factor * n_loc`` (the
+    reference over-allocated to n total, ``psort.cc:385``) and doubles
+    on detected overflow up to ``max_cap_factor``; beyond that a
+    RuntimeError reports irreducible skew.
+    """
+    p, n_loc = x2d.shape
+    f = cap_factor
+    while True:
+        cap = int(f * n_loc)
+        out, overflow = _build(mesh, axis, cap)(x2d)
+        if int(jax.device_get(overflow.sum())) == 0:
+            return out
+        f *= 2
+        if f > max_cap_factor:
+            raise RuntimeError(
+                f"hypercube quicksort overflowed capacity {cap} "
+                f"(cap_factor {f / 2}); data skew exceeds max_cap_factor="
+                f"{max_cap_factor} — raise it or use sample sort")

@@ -1,0 +1,14 @@
+"""Local compute kernels (the per-core work under the distributed sorts).
+
+The reference's per-rank hot kernels are ``std::sort`` and the linear
+compare-split merge (``Parallel-Sorting/src/psort.cc:116-164``). Here the
+local sort is XLA's sort and the merge is a Batcher bitonic-merge
+network (``icikit.ops.merge``) — O(n log n) vectorized min/max stages
+that map straight onto the TPU VPU, with an optional Pallas kernel.
+"""
+
+from icikit.ops.merge import (  # noqa: F401
+    bitonic_merge,
+    compare_split_max,
+    compare_split_min,
+)

@@ -1,0 +1,56 @@
+"""Bitonic merge network and compare-split halves.
+
+The reference's compare-split (``Parallel-Sorting/src/psort.cc:116-164``)
+exchanges full buffers then does a *linear merge from one end*, keeping
+exactly ``loc_size`` elements (max variant merges tail-down ``:127-137``,
+min variant head-up ``:152-162``). A sequential two-pointer merge is
+hostile to a vector unit, so the TPU design uses Batcher's classic
+identity instead: for ascending sorted ``a`` and ``b``,
+
+    L = min(a, reverse(b)),  H = max(a, reverse(b))
+
+are each *bitonic*, every element of L <= every element of H, and
+{L, H} = the n smallest / n largest of the 2n inputs. One elementwise
+min/max pass replaces the merge decision, and a log2(n)-stage bitonic
+merge network (pure min/max on strided halves — VPU-shaped work) turns
+the kept half back into sorted order.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from icikit.utils.mesh import is_pow2
+
+
+def bitonic_merge(v: jax.Array) -> jax.Array:
+    """Sort a *bitonic* vector ascending via Batcher's merge network.
+
+    log2(n) stages of elementwise min/max over halves; requires
+    power-of-2 length (callers pad — see ``models.sort.common``).
+    Falls back to ``jnp.sort`` for non-power-of-2 lengths.
+    """
+    n = v.shape[0]
+    if not is_pow2(n):
+        return jnp.sort(v)
+    k = n // 2
+    while k >= 1:
+        w = v.reshape(-1, 2, k)
+        lo = jnp.minimum(w[:, 0], w[:, 1])
+        hi = jnp.maximum(w[:, 0], w[:, 1])
+        v = jnp.concatenate([lo[:, None], hi[:, None]], axis=1).reshape(-1)
+        k //= 2
+    return v
+
+
+def compare_split_min(a: jax.Array, b: jax.Array) -> jax.Array:
+    """The n smallest of sorted ``a`` + sorted ``b``, sorted ascending
+    (reference ``compare_split_min``, ``psort.cc:142-164``)."""
+    return bitonic_merge(jnp.minimum(a, b[::-1]))
+
+
+def compare_split_max(a: jax.Array, b: jax.Array) -> jax.Array:
+    """The n largest of sorted ``a`` + sorted ``b``, sorted ascending
+    (reference ``compare_split_max``, ``psort.cc:116-140``)."""
+    return bitonic_merge(jnp.maximum(a, b[::-1]))

@@ -1,0 +1,121 @@
+"""Tests for the distributed sorts: the reference's inversion-count
+oracle (psort.cc:497-520) plus exact-match against numpy, over uniform
+and ODD_DIST-skewed inputs (the splitter/load-balance stressor)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from icikit.models.sort import SORT_ALGORITHMS, check_sort, sort
+from icikit.models.sort.common import prepare_blocks
+from icikit.ops.merge import bitonic_merge, compare_split_max, compare_split_min
+from icikit.utils.mesh import make_mesh, shard_along
+from icikit.utils.prandom import uniform_global
+
+
+def _inputs(kind, n, seed=0):
+    if kind == "uniform_f32":
+        return np.asarray(uniform_global(jax.random.key(seed), n))
+    if kind == "odd_dist":
+        return np.asarray(uniform_global(jax.random.key(seed), n,
+                                         odd_dist=True))
+    if kind == "int32":
+        rng = np.random.default_rng(seed)
+        return rng.integers(-2**31, 2**31 - 1, size=n).astype(np.int32)
+    if kind == "dups":
+        rng = np.random.default_rng(seed)
+        return rng.integers(0, 7, size=n).astype(np.int32)
+    raise ValueError(kind)
+
+
+def test_bitonic_merge_network():
+    rng = np.random.default_rng(0)
+    a = np.sort(rng.standard_normal(64).astype(np.float32))
+    b = np.sort(rng.standard_normal(64).astype(np.float32))
+    both = np.sort(np.concatenate([a, b]))
+    lo = np.asarray(compare_split_min(jnp.asarray(a), jnp.asarray(b)))
+    hi = np.asarray(compare_split_max(jnp.asarray(a), jnp.asarray(b)))
+    np.testing.assert_array_equal(lo, both[:64])
+    np.testing.assert_array_equal(hi, both[64:])
+    # bitonic input sorts exactly
+    v = np.concatenate([a, b[::-1]])
+    np.testing.assert_array_equal(np.asarray(bitonic_merge(jnp.asarray(v))),
+                                  np.sort(v))
+
+
+@pytest.mark.parametrize("algorithm", SORT_ALGORITHMS)
+@pytest.mark.parametrize("kind", ["uniform_f32", "odd_dist", "int32", "dups"])
+def test_sort_matches_numpy(mesh8, algorithm, kind):
+    n = 1 << 12
+    data = _inputs(kind, n)
+    out = np.asarray(sort(jnp.asarray(data), mesh8, algorithm=algorithm))
+    np.testing.assert_array_equal(out, np.sort(data))
+
+
+@pytest.mark.parametrize("algorithm", SORT_ALGORITHMS)
+def test_sort_ragged_length(mesh8, algorithm):
+    """Lengths not divisible by p exercise the sentinel-padding path."""
+    n = 1000  # 1000 = 8*125, and bitonic pads n_loc 125 -> 128
+    data = _inputs("int32", n, seed=3)
+    out = np.asarray(sort(jnp.asarray(data), mesh8, algorithm=algorithm))
+    np.testing.assert_array_equal(out, np.sort(data))
+
+
+@pytest.mark.parametrize("algorithm", SORT_ALGORITHMS)
+def test_sort_p4(mesh4, algorithm):
+    n = 1 << 10
+    data = _inputs("odd_dist", n, seed=5)
+    out = np.asarray(sort(jnp.asarray(data), mesh4, algorithm=algorithm))
+    np.testing.assert_array_equal(out, np.sort(data))
+
+
+def test_sort_p1(mesh1):
+    data = _inputs("int32", 100, seed=7)
+    for alg in SORT_ALGORITHMS:
+        out = np.asarray(sort(jnp.asarray(data), mesh1, algorithm=alg))
+        np.testing.assert_array_equal(out, np.sort(data))
+
+
+def test_sample_sort_overflow_retry(mesh8):
+    """All-equal data lands in one bucket — the worst skew; the initial
+    capacity overflows and the retry path must still sort correctly."""
+    data = np.full(1 << 10, 42, np.int32)
+    data[::7] = 41
+    out = np.asarray(sort(jnp.asarray(data), mesh8, algorithm="sample"))
+    np.testing.assert_array_equal(out, np.sort(data))
+
+
+def test_check_sort_counts_errors(mesh8):
+    n = 1 << 10
+    good = np.sort(_inputs("int32", n, seed=9))
+    blocks, _ = prepare_blocks(jnp.asarray(good), mesh8)
+    assert check_sort(blocks, mesh8) == 0
+    bad = good.copy()
+    bad[10], bad[500] = bad[500], bad[10]  # two cross-block inversions
+    blocks_bad, _ = prepare_blocks(jnp.asarray(bad), mesh8)
+    assert check_sort(blocks_bad, mesh8) > 0
+
+
+def test_sort_rejects_unknown(mesh8):
+    with pytest.raises(KeyError, match="unknown algorithm"):
+        sort(jnp.zeros(16, jnp.int32), mesh8, algorithm="shellsort")
+
+
+@pytest.mark.parametrize("algorithm", SORT_ALGORITHMS)
+def test_sort_empty_input(mesh8, algorithm):
+    out = np.asarray(sort(jnp.zeros((0,), jnp.int32), mesh8,
+                          algorithm=algorithm))
+    assert out.shape == (0,)
+
+
+def test_sort_registry_lists_all():
+    from icikit.utils.registry import list_algorithms
+    assert set(list_algorithms("sort")) == set(SORT_ALGORITHMS)
+
+
+def test_bitonic_non_pow2_mesh_raises():
+    from icikit.utils.mesh import UnsupportedMeshError
+    mesh = make_mesh(6)
+    with pytest.raises(UnsupportedMeshError):
+        sort(jnp.zeros(64, jnp.int32), mesh, algorithm="bitonic")

@@ -1,0 +1,38 @@
+"""Peg-solitaire dynamic-load-balancing study (reference
+``Dynamic-Load-Balancing/``, SURVEY.md C21-C25).
+
+The reference pairs a serial exponential-cost DFS puzzle solver with an
+MPI master/worker task farm; the variable per-puzzle cost is the load
+imbalance the farm exists to absorb. The TPU-native re-design:
+
+- boards are uint32 bitmasks, the DFS is a ``lax.while_loop`` with an
+  explicit stack, batched with ``vmap`` (``game.py``);
+- scheduling happens at the *batch* level: static equal chunks per
+  device vs. a dynamic host-side work queue feeding devices as they
+  drain (``scheduler.py``) — the honest TPU analog of the pull-model
+  master/worker protocol (``Dynamic-Load-Balancing/src/main.cc:83-193``);
+- datasets use the reference's on-disk format (count line + 25-char
+  board rows) with difficulty-graded generators (``dataset.py``).
+"""
+
+from icikit.models.solitaire.game import (  # noqa: F401
+    BoardBatch,
+    parse_board,
+    render_board,
+    pretty_board,
+    render_solution,
+    solve_batch,
+    solve_one_py,
+    replay_moves,
+)
+from icikit.models.solitaire.dataset import (  # noqa: F401
+    load_dataset,
+    save_dataset,
+    generate_dataset,
+)
+from icikit.models.solitaire.scheduler import (  # noqa: F401
+    solve_static,
+    solve_dynamic,
+    solve_host,
+    SolveReport,
+)

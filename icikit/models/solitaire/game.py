@@ -1,0 +1,329 @@
+"""Peg-solitaire game model and DFS solver, TPU-native.
+
+The reference models the 5x5 board as an enum array with a recursive
+solver (``Dynamic-Load-Balancing/src/game.h:24-48``, ``game.cc:121-138``).
+Here a board is two uint32 bitmasks — ``pegs`` (bit c set iff cell c
+holds a peg) and ``playable`` (bit c set iff cell c is not NA) — so a
+move is three bit operations and move validation for all 100 (cell,
+direction) candidates is one vectorized mask. The exhaustive DFS becomes
+a ``lax.while_loop`` over an explicit stack (XLA needs static control
+flow; recursion is not traceable), and ``vmap`` batches boards so the
+MXU-adjacent vector units chew 100-wide validity masks per board per
+step.
+
+Rules (reference ``game.cc:54-97``): a move is named by its destination
+hole (i, j) and a direction d; the peg two cells away in direction d
+jumps over the adjacent peg into the hole, and both source cells become
+holes. Move enumeration order is (i, j, d) lexicographic
+(``game.cc:99-107``), which this module preserves exactly so the first
+solution found matches the reference solver's.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+IDIM = 5
+JDIM = 5
+N_CELLS = IDIM * JDIM
+N_MOVES = N_CELLS * 4
+MAX_DEPTH = N_CELLS  # a solution removes at most 24 pegs
+
+# Solver status codes
+RUNNING, SOLVED, EXHAUSTED, STEP_LIMIT = 0, 1, 2, 3
+
+# Direction deltas, in the reference's order (game.cc:58-75):
+# 0: jump from (i+2, j) upward; 1: from (i-2, j); 2: from (i, j+2);
+# 3: from (i, j-2).
+_DIRS = ((1, 0), (-1, 0), (0, 1), (0, -1))
+
+
+def _build_move_tables():
+    """Static tables over all 100 (destination cell, direction) moves.
+
+    For move m = cell * 4 + d: DEST/MID/FAR are single-bit masks for the
+    destination hole, the jumped peg, and the jumping peg; GEOM marks
+    moves whose far cell is on the board (reference bounds checks,
+    ``game.cc:85-95``).
+    """
+    dest = np.zeros(N_MOVES, np.uint32)
+    mid = np.zeros(N_MOVES, np.uint32)
+    far = np.zeros(N_MOVES, np.uint32)
+    geom = np.zeros(N_MOVES, bool)
+    for c in range(N_CELLS):
+        i, j = divmod(c, JDIM)
+        for d, (di, dj) in enumerate(_DIRS):
+            m = c * 4 + d
+            fi, fj = i + 2 * di, j + 2 * dj
+            dest[m] = 1 << c
+            if 0 <= fi < IDIM and 0 <= fj < JDIM:
+                geom[m] = True
+                mid[m] = 1 << ((i + di) * JDIM + (j + dj))
+                far[m] = 1 << (fi * JDIM + fj)
+    return dest, mid, far, geom
+
+
+_DEST_NP, _MID_NP, _FAR_NP, _GEOM_NP = _build_move_tables()
+
+
+# ---------------------------------------------------------------------------
+# Board encoding (reference game_state::Init/SaveBoard, game.cc:26-53)
+
+def parse_board(s: str) -> tuple[int, int]:
+    """Parse a 25-char board string ('0' hole, '1' peg, other NA) into
+    (pegs, playable) bitmasks."""
+    if len(s) != N_CELLS:
+        raise ValueError(f"board string must be {N_CELLS} chars, got {len(s)}")
+    pegs = playable = 0
+    for c, ch in enumerate(s):
+        if ch == "1":
+            pegs |= 1 << c
+            playable |= 1 << c
+        elif ch == "0":
+            playable |= 1 << c
+    return pegs, playable
+
+
+def render_board(pegs: int, playable: int) -> str:
+    """Inverse of parse_board: '0'/'1'/'2' per cell (reference SaveBoard
+    encoding, ``game.cc:40-53``)."""
+    out = []
+    for c in range(N_CELLS):
+        if pegs >> c & 1:
+            out.append("1")
+        elif playable >> c & 1:
+            out.append("0")
+        else:
+            out.append("2")
+    return "".join(out)
+
+
+def pretty_board(pegs: int, playable: int) -> str:
+    """Human rendering: 'X' peg, '*' hole, ' ' NA, one row per line.
+
+    Matches the reference's ``Print`` (``game.cc:108-118``), including
+    its column-major row order: output row r lists cells (i=0..4, j=r).
+    """
+    lines = []
+    for j in range(JDIM):
+        row = []
+        for i in range(IDIM):
+            c = i * JDIM + j
+            if pegs >> c & 1:
+                row.append("X")
+            elif playable >> c & 1:
+                row.append("*")
+            else:
+                row.append(" ")
+        lines.append("".join(row))
+    return "\n".join(lines) + "\n"
+
+
+@dataclass
+class BoardBatch:
+    """A batch of boards as parallel uint32 bitmask arrays."""
+
+    pegs: np.ndarray      # uint32[B]
+    playable: np.ndarray  # uint32[B]
+
+    @classmethod
+    def from_strings(cls, boards: list[str]) -> "BoardBatch":
+        parsed = [parse_board(b) for b in boards]
+        return cls(
+            pegs=np.array([p for p, _ in parsed], np.uint32),
+            playable=np.array([q for _, q in parsed], np.uint32))
+
+    def to_strings(self) -> list[str]:
+        return [render_board(int(p), int(q))
+                for p, q in zip(self.pegs, self.playable)]
+
+    def __len__(self) -> int:
+        return len(self.pegs)
+
+    def __getitem__(self, idx) -> "BoardBatch":
+        return BoardBatch(pegs=np.atleast_1d(self.pegs[idx]),
+                          playable=np.atleast_1d(self.playable[idx]))
+
+
+def apply_move(pegs: int, m: int) -> int:
+    """Apply move m to a pegs mask (reference makeMove, game.cc:54-76)."""
+    return int((pegs | int(_DEST_NP[m]))
+               & ~(int(_MID_NP[m]) | int(_FAR_NP[m])) & 0x1FFFFFF)
+
+
+def _valid_mask_py(pegs: int, playable: int) -> np.ndarray:
+    """bool[100] move-validity mask (reference validMove, game.cc:78-97)."""
+    pegs = np.uint32(pegs)
+    playable = np.uint32(playable)
+    return (_GEOM_NP
+            & ((pegs & _MID_NP) == _MID_NP)
+            & ((pegs & _FAR_NP) == _FAR_NP)
+            & ((playable & _DEST_NP) != 0)
+            & ((pegs & _DEST_NP) == 0))
+
+
+def replay_moves(pegs: int, playable: int, moves) -> list[int]:
+    """Replay a move sequence from an initial board, validating each move
+    against the game rules. Returns the sequence of peg states (initial
+    included). Raises if any move is illegal — the test oracle for
+    solver outputs."""
+    states = [pegs]
+    for m in moves:
+        m = int(m)
+        if not _valid_mask_py(pegs, playable)[m]:
+            raise ValueError(f"illegal move {m} from state {pegs:#x}")
+        pegs = apply_move(pegs, m)
+        states.append(pegs)
+    return states
+
+
+def render_solution(board: str, moves) -> str:
+    """Render a solved game as board states joined by '-->', the
+    reference's solution_found message payload
+    (``Dynamic-Load-Balancing/src/main.cc:169-177``)."""
+    pegs, playable = parse_board(board)
+    states = replay_moves(pegs, playable, moves)
+    parts = [pretty_board(states[0], playable)]
+    for s in states[1:]:
+        parts.append("-->\n")
+        parts.append(pretty_board(s, playable))
+    return "".join(parts)
+
+
+# ---------------------------------------------------------------------------
+# Pure-Python reference solver (test oracle)
+
+def solve_one_py(pegs: int, playable: int,
+                 max_steps: int | None = None) -> tuple[bool, list[int], int]:
+    """Iterative DFS in plain Python, identical move order to the JAX
+    kernel. Returns (solved, moves, nodes_visited). The oracle the JAX
+    solver is tested against (SURVEY.md §4 — the rebuild turns the
+    reference's self-verifying harness into real tests)."""
+    stack = [(pegs, 0)]
+    moves: list[int] = []
+    steps = 0
+    while stack:
+        steps += 1
+        if max_steps is not None and steps > max_steps:
+            return False, [], steps
+        cur, resume = stack[-1]
+        valid = np.flatnonzero(_valid_mask_py(cur, playable))
+        valid = valid[valid >= resume]
+        if valid.size == 0:
+            if bin(cur).count("1") == 1:
+                return True, moves, steps
+            stack.pop()
+            if moves:
+                moves.pop()
+            continue
+        m = int(valid[0])
+        stack[-1] = (cur, m + 1)
+        stack.append((apply_move(cur, m), 0))
+        moves.append(m)
+    return False, [], steps
+
+
+# ---------------------------------------------------------------------------
+# JAX solver kernel
+
+_DEST = jnp.asarray(_DEST_NP)
+_MID = jnp.asarray(_MID_NP)
+_FAR = jnp.asarray(_FAR_NP)
+_GEOM = jnp.asarray(_GEOM_NP)
+_MOVE_IDX = jnp.arange(N_MOVES, dtype=jnp.int32)
+
+
+def _solve_one(pegs, playable, max_steps):
+    """Single-board exhaustive DFS as a ``lax.while_loop`` over an
+    explicit stack (the traceable form of the reference's recursion,
+    ``game.cc:121-138``).
+
+    State per depth: the pegs mask and a resume index (the next move
+    index to try at that node), so each loop iteration either descends
+    into the first untried valid move or backtracks. A node with no
+    valid moves and exactly one peg is a win (``game.cc:124-125`` — with
+    one peg no move can be valid, so checking at dead ends only is
+    exact).
+    """
+    pegs = pegs.astype(jnp.uint32)
+    playable = playable.astype(jnp.uint32)
+
+    stack_pegs = jnp.zeros(MAX_DEPTH + 1, jnp.uint32).at[0].set(pegs)
+    stack_resume = jnp.zeros(MAX_DEPTH + 1, jnp.int32)
+    moves = jnp.full(MAX_DEPTH, -1, jnp.int32)
+    state = (jnp.int32(RUNNING), jnp.int32(0), jnp.int32(0),
+             stack_pegs, stack_resume, moves)
+
+    def cond(st):
+        status, _, steps, *_ = st
+        return (status == RUNNING) & (steps < max_steps)
+
+    def body(st):
+        status, depth, steps, stack_pegs, stack_resume, moves = st
+        cur = stack_pegs[depth]
+        valid = (_GEOM
+                 & ((cur & _MID) == _MID)
+                 & ((cur & _FAR) == _FAR)
+                 & ((playable & _DEST) != 0)
+                 & ((cur & _DEST) == 0)
+                 & (_MOVE_IDX >= stack_resume[depth]))
+        has = valid.any()
+        first = jnp.argmax(valid).astype(jnp.int32)
+
+        # Descend: push the child state, remember where to resume here.
+        child = (cur | _DEST[first]) & ~(_MID[first] | _FAR[first])
+        stack_pegs = stack_pegs.at[depth + 1].set(
+            jnp.where(has, child, stack_pegs[depth + 1]))
+        stack_resume = stack_resume.at[depth].set(
+            jnp.where(has, first + 1, stack_resume[depth]))
+        stack_resume = stack_resume.at[depth + 1].set(
+            jnp.where(has, 0, stack_resume[depth + 1]))
+        moves = moves.at[depth].set(jnp.where(has, first, moves[depth]))
+
+        # Dead end: win iff one peg remains, else backtrack (or exhaust).
+        won = lax.population_count(cur) == 1
+        status = jnp.where(
+            has, status,
+            jnp.where(won, SOLVED,
+                      jnp.where(depth == 0, EXHAUSTED, status)))
+        depth = jnp.where(has, depth + 1,
+                          jnp.maximum(depth - 1, 0)).astype(jnp.int32)
+        # On a win keep depth as-is: it equals the solution length.
+        depth = jnp.where(status == SOLVED, st[1], depth)
+        return (status, depth, steps + 1, stack_pegs, stack_resume, moves)
+
+    status, depth, steps, _, _, moves = lax.while_loop(cond, body, state)
+    status = jnp.where(status == RUNNING, STEP_LIMIT, status)
+    solved = status == SOLVED
+    n_moves = jnp.where(solved, depth, 0)
+    moves = jnp.where((_MOVE_IDX[:MAX_DEPTH] < n_moves) & solved,
+                      moves, -1)
+    return solved, n_moves, moves, steps, status
+
+
+@jax.jit
+def _solve_batch_jit(pegs, playable, max_steps):
+    return jax.vmap(_solve_one, in_axes=(0, 0, None))(
+        pegs, playable, jnp.int32(max_steps))
+
+
+def solve_batch(pegs, playable, max_steps: int = 2_000_000_000):
+    """Solve a batch of boards. Returns (solved bool[B], n_moves int32[B],
+    moves int32[B, 25], steps int32[B], status int32[B]).
+
+    ``steps`` is the per-board DFS node count — the load-imbalance signal
+    the scheduling study measures. Under ``vmap`` every lane runs until
+    the slowest lane in the batch finishes; that cost structure is
+    exactly why batch-level dynamic scheduling (``scheduler.py``)
+    matters, mirroring why the reference farms puzzles out dynamically
+    (``Dynamic-Load-Balancing/README.md:5``).
+    """
+    pegs = jnp.asarray(pegs, jnp.uint32)
+    playable = jnp.asarray(playable, jnp.uint32)
+    return _solve_batch_jit(pegs, playable, max_steps)

@@ -1,0 +1,251 @@
+"""Batch-level scheduling for the peg-solitaire workload: the TPU-native
+master/worker study.
+
+The reference's farm (``Dynamic-Load-Balancing/src/main.cc``) is a pull
+model: rank 0 holds the game list; workers send ``work_need`` and
+receive 8-game chunks (``:91-103``) until the list drains, so fast
+workers automatically absorb more of the variable-cost DFS work. On TPU
+there are no per-rank processes to message — the analog is at the batch
+level: the host is the master, devices are the workers, and a chunk is
+a fixed-shape board batch dispatched to whichever device drains first.
+
+Two strategies, so the imbalance study is measurable (the point of the
+reference sub-repo, ``Dynamic-Load-Balancing/README.md:5``):
+
+- ``solve_static``: each device gets one equal contiguous slice up
+  front (what MPI folklore calls block decomposition). Wall time is the
+  unluckiest device's total.
+- ``solve_dynamic``: the pull model. A lock-protected cursor over
+  fixed-size chunks; one host thread per device plays the client loop
+  (request chunk -> solve -> report), mirroring tags
+  work_need/work_avail/terminate (``main.cc:16-20``) as plain control
+  flow.
+
+All chunks share one padded shape so XLA compiles the solver exactly
+once; padding boards are empty (zero pegs: they exhaust in one DFS step
+and can never count as solutions, since a win needs exactly one peg).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+
+import numpy as np
+
+import jax
+
+from icikit.models.solitaire.game import (
+    MAX_DEPTH,
+    BoardBatch,
+    render_board,
+    render_solution,
+    solve_batch,
+)
+
+DEFAULT_CHUNK = 8  # reference chunk_size (main.cc:15)
+
+
+@dataclass
+class SolveReport:
+    """Results + scheduling telemetry for one solve run."""
+
+    solved: np.ndarray    # bool[B]
+    n_moves: np.ndarray   # int32[B]
+    moves: np.ndarray     # int32[B, MAX_DEPTH]
+    steps: np.ndarray     # int32[B] DFS nodes per board (cost signal)
+    status: np.ndarray    # int32[B]
+    wall_s: float
+    strategy: str
+    chunk_size: int
+    per_worker_games: list = field(default_factory=list)
+    per_worker_steps: list = field(default_factory=list)
+
+    @property
+    def n_solutions(self) -> int:
+        return int(self.solved.sum())
+
+    @property
+    def imbalance(self) -> float:
+        """max/mean of per-worker DFS-node totals; 1.0 = perfectly
+        balanced. The quantity dynamic scheduling exists to shrink."""
+        s = np.asarray(self.per_worker_steps, dtype=np.float64)
+        if s.size == 0 or s.mean() == 0:
+            return 1.0
+        return float(s.max() / s.mean())
+
+
+def _pad(batch: BoardBatch, to: int) -> BoardBatch:
+    pad = to - len(batch)
+    if pad <= 0:
+        return batch
+    return BoardBatch(
+        pegs=np.concatenate([batch.pegs, np.zeros(pad, np.uint32)]),
+        playable=np.concatenate([batch.playable, np.zeros(pad, np.uint32)]))
+
+
+def solve_static(batch: BoardBatch, devices=None,
+                 max_steps: int = 2_000_000_000) -> SolveReport:
+    """Equal up-front split: device d gets the d-th contiguous slice.
+
+    One async dispatch per device, then a single barrier — the launches
+    overlap, so wall time = slowest device, exactly the static-schedule
+    cost model.
+    """
+    if devices is None:
+        devices = jax.devices()
+    n = len(batch)
+    p = max(1, min(len(devices), n))
+    per = -(-n // p)  # ceil
+    padded = _pad(batch, per * p)
+
+    outs = []
+    t0 = time.perf_counter()
+    for d in range(p):
+        sl = slice(d * per, (d + 1) * per)
+        pg = jax.device_put(padded.pegs[sl], devices[d])
+        pl = jax.device_put(padded.playable[sl], devices[d])
+        outs.append(solve_batch(pg, pl, max_steps))
+    outs = jax.block_until_ready(outs)
+    wall = time.perf_counter() - t0
+
+    parts = [tuple(np.asarray(o) for o in out) for out in outs]
+    solved = np.concatenate([pt[0] for pt in parts])[:n]
+    n_moves = np.concatenate([pt[1] for pt in parts])[:n]
+    moves = np.concatenate([pt[2] for pt in parts])[:n]
+    steps = np.concatenate([pt[3] for pt in parts])[:n]
+    status = np.concatenate([pt[4] for pt in parts])[:n]
+
+    per_games, per_steps = [], []
+    for d in range(p):
+        real = min(per, max(0, n - d * per))
+        per_games.append(real)
+        per_steps.append(int(parts[d][3][:real].sum()))
+    return SolveReport(solved=solved, n_moves=n_moves, moves=moves,
+                       steps=steps, status=status, wall_s=wall,
+                       strategy="static", chunk_size=per,
+                       per_worker_games=per_games,
+                       per_worker_steps=per_steps)
+
+
+def solve_dynamic(batch: BoardBatch, devices=None,
+                  chunk_size: int = DEFAULT_CHUNK,
+                  max_steps: int = 2_000_000_000) -> SolveReport:
+    """Pull-model dynamic schedule: a shared cursor over fixed-size
+    chunks; one host thread per device requests, solves, and reports
+    until the queue drains (reference client loop, ``main.cc:146-191``,
+    with the Iprobe/tag protocol collapsed into thread-safe control
+    flow — there is no message to probe for when master and workers
+    share an address space)."""
+    if devices is None:
+        devices = jax.devices()
+    n = len(batch)
+    n_chunks = -(-n // chunk_size) if n else 0
+    padded = _pad(batch, n_chunks * chunk_size)
+    p = max(1, min(len(devices), max(n_chunks, 1)))
+
+    cursor_lock = threading.Lock()
+    cursor = [0]
+    results: list = [None] * n_chunks
+    per_games = [0] * p
+    per_steps = [0] * p
+    errors: list = []
+
+    def next_chunk() -> int:
+        with cursor_lock:
+            i = cursor[0]
+            cursor[0] += 1
+            return i
+
+    def worker(w: int):
+        dev = devices[w]
+        try:
+            while True:
+                i = next_chunk()
+                if i >= n_chunks:
+                    return  # terminate tag (main.cc:93-97)
+                sl = slice(i * chunk_size, (i + 1) * chunk_size)
+                pg = jax.device_put(padded.pegs[sl], dev)
+                pl = jax.device_put(padded.playable[sl], dev)
+                out = jax.block_until_ready(solve_batch(pg, pl, max_steps))
+                results[i] = tuple(np.asarray(o) for o in out)
+                real = min(chunk_size, max(0, n - i * chunk_size))
+                per_games[w] += real
+                per_steps[w] += int(results[i][3][:real].sum())
+        except BaseException as e:  # surface worker crashes to the caller
+            errors.append(e)
+
+    t0 = time.perf_counter()
+    if n_chunks:
+        threads = [threading.Thread(target=worker, args=(w,), daemon=True)
+                   for w in range(p)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    wall = time.perf_counter() - t0
+    if errors:
+        raise errors[0]
+
+    if n_chunks:
+        solved = np.concatenate([r[0] for r in results])[:n]
+        n_moves = np.concatenate([r[1] for r in results])[:n]
+        moves = np.concatenate([r[2] for r in results])[:n]
+        steps = np.concatenate([r[3] for r in results])[:n]
+        status = np.concatenate([r[4] for r in results])[:n]
+    else:
+        solved = np.zeros(0, bool)
+        n_moves = steps = status = np.zeros(0, np.int32)
+        moves = np.zeros((0, MAX_DEPTH), np.int32)
+    return SolveReport(solved=solved, n_moves=n_moves, moves=moves,
+                       steps=steps, status=status, wall_s=wall,
+                       strategy="dynamic", chunk_size=chunk_size,
+                       per_worker_games=per_games,
+                       per_worker_steps=per_steps)
+
+
+def solve_host(batch: BoardBatch, n_threads: int = 0,
+               chunk_size: int = DEFAULT_CHUNK,
+               max_steps: int = 2_000_000_000) -> SolveReport:
+    """Native host backend: the C++ DFS solver behind a C++ thread-pool
+    work queue (``icikit/native/src/solver.cc``). This is the role the
+    reference's whole program played — native workers pulling chunks —
+    kept as a first-class backend so the study can compare host-native
+    vs TPU-vectorized execution the way the reference compared
+    hand-rolled vs vendor collectives (SURVEY.md §5.8)."""
+    from icikit import native
+
+    t0 = time.perf_counter()
+    solved, n_moves, moves, steps = native.solve_batch(
+        batch.pegs, batch.playable, max_steps=max_steps,
+        n_threads=n_threads, chunk_size=chunk_size)
+    wall = time.perf_counter() - t0
+    status = np.where(solved, 1, np.where(steps >= max_steps, 3, 2))
+    # The native pool does its own chunk accounting internally; per-worker
+    # telemetry is aggregate-only here.
+    return SolveReport(solved=solved, n_moves=n_moves, moves=moves,
+                       steps=steps.astype(np.int64), status=status,
+                       wall_s=wall, strategy="host", chunk_size=chunk_size,
+                       per_worker_games=[len(batch)],
+                       per_worker_steps=[int(steps.sum())])
+
+
+def write_solutions(path, batch: BoardBatch, report: SolveReport) -> int:
+    """Write every solved game's move-sequence rendering (board states
+    joined by '-->') to ``path``, then return the solution count — the
+    server's output-file + "found N solutions" behavior
+    (``main.cc:104-106``, ``:135``). Unlike the reference, server-solved
+    and client-solved games are treated identically (the reference only
+    wrote client solutions — SURVEY.md §2 defect 3)."""
+    count = 0
+    with open(path, "w") as f:
+        for b in range(len(batch)):
+            if not report.solved[b]:
+                continue
+            board = render_board(int(batch.pegs[b]), int(batch.playable[b]))
+            ms = report.moves[b][:int(report.n_moves[b])]
+            f.write(render_solution(board, ms))
+            f.write("\n")
+            count += 1
+    return count

@@ -13,7 +13,6 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 from jax import lax
 
 from icikit.utils.mesh import DEFAULT_AXIS, mesh_axis_size, shard_along

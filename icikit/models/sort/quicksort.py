@@ -22,8 +22,9 @@ TPU redesign (SURVEY.md §7 "hard parts"):
 - Ragged final sizes are re-balanced to exact equal blocks
   (``common.rebalance_sorted``) so the output is regular.
 
-Caveat: data equal to the dtype's maximum value collides with the
-sentinel and may be miscounted; use sample sort for such data.
+Validity is tracked by explicit counts, not sentinel comparison, so
+data equal to the dtype's maximum value (the sentinel) sorts correctly;
+sentinels only serve to keep invalid tails at the buffer end.
 """
 
 from __future__ import annotations

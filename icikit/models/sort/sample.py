@@ -41,7 +41,7 @@ from icikit.parallel.shmap import shard_map
 from icikit.utils.mesh import DEFAULT_AXIS
 
 
-def _splitters_allgather(a: jax.Array, samples: jax.Array, axis: str,
+def _splitters_allgather(samples: jax.Array, axis: str,
                          p: int) -> jax.Array:
     """C15 splitter selection: allgather all p(p-1) samples, sort the
     full set everywhere, pick p-1 evenly spaced global splitters
@@ -52,7 +52,7 @@ def _splitters_allgather(a: jax.Array, samples: jax.Array, axis: str,
     return s[idx]
 
 
-def _splitters_bitonic(a: jax.Array, samples: jax.Array, axis: str,
+def _splitters_bitonic(samples: jax.Array, axis: str,
                        p: int) -> jax.Array:
     """C16 splitter selection: bitonic-sort the sample set *in parallel*
     across devices (each device holds one length-(p-1) splitter vector),
@@ -79,9 +79,9 @@ def sample_sort_shard(a: jax.Array, axis: str, p: int, cap: int,
     samp_idx = (jnp.arange(1, p) * n_loc) // p
     samples = a[samp_idx]
     if splitter == "bitonic":
-        splitters = _splitters_bitonic(a, samples, axis, p)
+        splitters = _splitters_bitonic(samples, axis, p)
     else:
-        splitters = _splitters_allgather(a, samples, axis, p)
+        splitters = _splitters_allgather(samples, axis, p)
 
     # Buckets are contiguous in the sorted local array: histogram by
     # binary search instead of the reference's linear scan (:241-250).

@@ -1,0 +1,219 @@
+"""ctypes binding for the icikit native runtime (``libicikit.so``).
+
+The library is built lazily on first use (``make -C icikit/native``) and
+every entry point has a pure-Python fallback, so the framework degrades
+gracefully on hosts without a toolchain. ``available()`` reports which
+path is active; tests assert the native path on this image.
+
+Native pieces (reference counterparts in parentheses):
+- ``install_traps``/``watchdog`` — crash containment + runaway-job alarm
+  (``chopsigs_``, ``utilities.cc:49-58``);
+- ``monotonic_s`` — monotonic clock (``get_timer``'s ``MPI_Wtime``);
+- ``parse_boards`` — reference-format dataset parser (``main.cc:49-66``);
+- ``solve``/``solve_batch`` — host DFS solver + threaded work-queue
+  batch driver (``game.cc:121-138`` + the ``Server``/``Client`` farm).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_LIB_PATH = os.path.join(_HERE, "libicikit.so")
+
+_lock = threading.Lock()
+_lib = None
+_build_error: str | None = None
+MAX_DEPTH = 25
+
+
+def _try_load():
+    global _lib, _build_error
+    with _lock:
+        if _lib is not None or _build_error is not None:
+            return _lib
+        if not os.path.exists(_LIB_PATH):
+            try:
+                subprocess.run(
+                    ["make", "-C", _HERE, "-s"], check=True,
+                    capture_output=True, text=True, timeout=120)
+            except (subprocess.SubprocessError, OSError) as e:
+                out = getattr(e, "stderr", "") or str(e)
+                _build_error = f"native build failed: {out.strip()[:500]}"
+                return None
+        try:
+            lib = ctypes.CDLL(_LIB_PATH)
+        except OSError as e:
+            _build_error = f"native load failed: {e}"
+            return None
+        lib.ik_install_traps.restype = ctypes.c_int
+        lib.ik_watchdog.argtypes = [ctypes.c_uint]
+        lib.ik_trap_count.restype = ctypes.c_int
+        lib.ik_watchdog_soft.argtypes = [ctypes.c_int]
+        lib.ik_monotonic_s.restype = ctypes.c_double
+        lib.ik_monotonic_ns.restype = ctypes.c_int64
+        lib.ik_parse_boards.restype = ctypes.c_int64
+        lib.ik_parse_boards.argtypes = [
+            ctypes.c_char_p, ctypes.c_size_t,
+            ctypes.POINTER(ctypes.c_uint32), ctypes.POINTER(ctypes.c_uint32),
+            ctypes.c_int64]
+        lib.ik_solve.restype = ctypes.c_int
+        lib.ik_solve.argtypes = [
+            ctypes.c_uint32, ctypes.c_uint32, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int64)]
+        lib.ik_solve_batch.restype = ctypes.c_int
+        lib.ik_solve_batch.argtypes = [
+            ctypes.POINTER(ctypes.c_uint32), ctypes.POINTER(ctypes.c_uint32),
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_int, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int64)]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    """True iff the native library is loaded (building it if needed)."""
+    return _try_load() is not None
+
+
+def build_error() -> str | None:
+    """The reason the native path is unavailable, if it is."""
+    _try_load()
+    return _build_error
+
+
+def install_traps() -> bool:
+    """Install fatal-signal traps; False if only the Python fallback
+    (which covers SIGALRM via the signal module, not SIGSEGV) applied."""
+    lib = _try_load()
+    if lib is not None:
+        return lib.ik_install_traps() == 0
+    return False
+
+
+def watchdog(seconds: int) -> None:
+    """Arm the runaway-job alarm; 0 disarms."""
+    lib = _try_load()
+    if lib is not None:
+        lib.ik_watchdog(int(seconds))
+    else:
+        import signal
+        signal.alarm(int(seconds))
+
+
+def watchdog_soft(enable: bool) -> None:
+    lib = _try_load()
+    if lib is not None:
+        lib.ik_watchdog_soft(1 if enable else 0)
+
+
+def trap_count() -> int:
+    lib = _try_load()
+    return lib.ik_trap_count() if lib is not None else 0
+
+
+def monotonic_s() -> float:
+    lib = _try_load()
+    if lib is not None:
+        return float(lib.ik_monotonic_s())
+    import time
+    return time.monotonic()
+
+
+def parse_boards(text: bytes) -> tuple[np.ndarray, np.ndarray]:
+    """Parse reference-format dataset bytes -> (pegs, playable) uint32
+    arrays. Falls back to the Python parser when native is unavailable."""
+    if isinstance(text, str):
+        text = text.encode()
+    lib = _try_load()
+    if lib is None:
+        from icikit.models.solitaire.game import BoardBatch
+        tokens = text.decode().split()
+        if not tokens or not tokens[0].isdigit():
+            raise ValueError("dataset parse error: bad header")
+        n = int(tokens[0])
+        if len(tokens) - 1 < n:
+            raise ValueError(
+                "dataset parse error: fewer rows than header promises")
+        b = BoardBatch.from_strings(tokens[1:n + 1])
+        return b.pegs, b.playable
+    # Capacity from the header without a full parse: first token.
+    head = text.split(None, 1)[0] if text.split() else b""
+    try:
+        cap = int(head)
+    except ValueError:
+        raise ValueError("dataset parse error: bad header") from None
+    pegs = np.zeros(max(cap, 1), np.uint32)
+    playable = np.zeros(max(cap, 1), np.uint32)
+    n = lib.ik_parse_boards(
+        text, len(text),
+        pegs.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+        playable.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)), cap)
+    if n < 0:
+        reasons = {-1: "bad header", -2: "bad board row",
+                   -3: "fewer rows than header promises",
+                   -4: "capacity too small"}
+        raise ValueError(
+            f"dataset parse error: {reasons.get(int(n), f'code {n}')}")
+    return pegs[:n], playable[:n]
+
+
+def solve(pegs: int, playable: int,
+          max_steps: int = 2**62) -> tuple[bool, list[int], int]:
+    """Native single-board DFS; returns (solved, moves, steps). Falls
+    back to the Python oracle."""
+    lib = _try_load()
+    if lib is None:
+        from icikit.models.solitaire.game import solve_one_py
+        return solve_one_py(pegs, playable)
+    n_moves = ctypes.c_int32(0)
+    steps = ctypes.c_int64(0)
+    moves = (ctypes.c_int32 * MAX_DEPTH)()
+    st = lib.ik_solve(pegs, playable, max_steps,
+                      ctypes.byref(n_moves), moves, ctypes.byref(steps))
+    return st == 1, list(moves[:n_moves.value]), int(steps.value)
+
+
+def solve_batch(pegs: np.ndarray, playable: np.ndarray,
+                max_steps: int = 2**62, n_threads: int = 0,
+                chunk_size: int = 8):
+    """Native threaded work-queue batch solve. Returns (solved bool[B],
+    n_moves int32[B], moves int32[B,25], steps int64[B])."""
+    pegs = np.ascontiguousarray(pegs, np.uint32)
+    playable = np.ascontiguousarray(playable, np.uint32)
+    n = len(pegs)
+    lib = _try_load()
+    if lib is None:
+        from icikit.models.solitaire.game import solve_one_py
+        solved = np.zeros(n, bool)
+        n_moves = np.zeros(n, np.int32)
+        moves = np.full((n, MAX_DEPTH), -1, np.int32)
+        steps = np.zeros(n, np.int64)
+        for i in range(n):
+            ok, ms, st = solve_one_py(int(pegs[i]), int(playable[i]),
+                                      max_steps)
+            solved[i] = ok
+            n_moves[i] = len(ms)
+            moves[i, :len(ms)] = ms
+            steps[i] = st
+        return solved, n_moves, moves, steps
+    solved = np.zeros(n, np.uint8)
+    n_moves = np.zeros(n, np.int32)
+    moves = np.full((n, MAX_DEPTH), -1, np.int32)
+    steps = np.zeros(n, np.int64)
+    if n:
+        lib.ik_solve_batch(
+            pegs.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+            playable.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+            n, max_steps, n_threads, chunk_size,
+            solved.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            n_moves.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            moves.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            steps.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
+    return solved.astype(bool), n_moves, moves, steps

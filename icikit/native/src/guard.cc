@@ -1,0 +1,62 @@
+/* Crash containment + watchdog (reference C10, utilities.cc:18-58).
+ *
+ * The reference traps SIGBUS/SEGV/ILL/SYS/FPE/ALRM into an error line
+ * plus MPI_Abort so a crashing rank cannot wedge the batch queue. The
+ * single-process TPU runtime keeps the same discipline: fatal signals
+ * produce one diagnostic line and a hard exit (XLA's async runtime can
+ * otherwise hang on a wedged device thread). A soft mode lets tests
+ * exercise the handler without dying.
+ */
+#include "icikit.h"
+
+#include <signal.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <unistd.h>
+
+static volatile sig_atomic_t g_soft = 0;
+static volatile sig_atomic_t g_traps = 0;
+
+static const char* signame(int sig) {
+  switch (sig) {
+    case SIGBUS:  return "a bus error";
+    case SIGSEGV: return "a segmentation violation";
+    case SIGILL:  return "an illegal instruction";
+    case SIGSYS:  return "an illegal system call";
+    case SIGFPE:  return "a floating point exception";
+    case SIGALRM: return "the watchdog alarm (runaway job)";
+    default:      return "an unexpected signal";
+  }
+}
+
+static void trap_handler(int sig) {
+  g_traps = g_traps + 1;
+  if (g_soft) return;
+  /* write() is async-signal-safe; fprintf is not. */
+  const char* pre = "ERROR: icikit terminated due to ";
+  const char* name = signame(sig);
+  ssize_t r;
+  r = write(2, pre, 32);
+  size_t n = 0; while (name[n]) n++;
+  r = write(2, name, n);
+  r = write(2, "\n", 1);
+  (void)r;
+  _exit(2);
+}
+
+int ik_install_traps(void) {
+  struct sigaction sa;
+  sa.sa_handler = trap_handler;
+  sigemptyset(&sa.sa_mask);
+  sa.sa_flags = 0;
+  const int sigs[] = {SIGBUS, SIGSEGV, SIGILL, SIGSYS, SIGFPE, SIGALRM};
+  for (size_t i = 0; i < sizeof(sigs) / sizeof(sigs[0]); ++i)
+    if (sigaction(sigs[i], &sa, NULL) != 0) return -1;
+  return 0;
+}
+
+void ik_watchdog(unsigned seconds) { alarm(seconds); }
+
+int ik_trap_count(void) { return (int)g_traps; }
+
+void ik_watchdog_soft(int enable) { g_soft = enable ? 1 : 0; }

@@ -1,0 +1,69 @@
+/* icikit native runtime — C ABI.
+ *
+ * TPU-native counterpart of the reference's C++ runtime layer
+ * (Dynamic-Load-Balancing/src/utilities.{h,cc}): crash containment,
+ * watchdog, monotonic timing, plus the host-side pieces that wrap the
+ * JAX compute path — a fast dataset parser and a native peg-solitaire
+ * DFS solver the scheduler can use as a host work-queue backend.
+ * Exposed as a plain C ABI so Python binds via ctypes (no pybind11 in
+ * this toolchain).
+ */
+#ifndef ICIKIT_NATIVE_H
+#define ICIKIT_NATIVE_H
+
+#include <stdint.h>
+#include <stddef.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+/* guard.cc — signal traps + runaway-job watchdog (reference chopsigs_,
+ * utilities.cc:49-58). Returns 0 on success. */
+int ik_install_traps(void);
+/* Arm (or re-arm) the watchdog alarm; 0 disarms (reference alarm(sleep_time),
+ * utilities.cc:57). */
+void ik_watchdog(unsigned seconds);
+/* Number of trapped fatal signals seen (for tests: handlers normally
+ * terminate, but SIGALRM with ik_watchdog_soft(1) only counts). */
+int ik_trap_count(void);
+/* Soft mode: trapped signals increment the counter instead of exiting
+ * (so tests can exercise the handler without dying). */
+void ik_watchdog_soft(int enable);
+
+/* timer.cc — monotonic clock (reference get_timer over MPI_Wtime,
+ * utilities.cc:61-68; reset-on-read semantics live in Python). */
+double ik_monotonic_s(void);
+int64_t ik_monotonic_ns(void);
+
+/* dataset.cc — parse a reference-format dataset buffer (count line +
+ * 25-char '0'/'1'/'2' board rows, Dynamic-Load-Balancing/src/main.cc:49-66)
+ * into peg/playable bitmasks. Returns the number of boards parsed, or
+ * a negative error code:
+ *  -1 empty/garbled header, -2 bad row length/char, -3 fewer rows than
+ *  the header promises, -4 capacity too small. */
+int64_t ik_parse_boards(const char* text, size_t len,
+                        uint32_t* pegs, uint32_t* playable,
+                        int64_t capacity);
+
+/* solver.cc — iterative exhaustive DFS over a 25-cell bitmask board,
+ * identical (i, j, dir) move order to the reference validMoveList
+ * (game.cc:99-107) and to the JAX kernel. Returns 1 solved, 0 exhausted,
+ * 2 step limit. n_moves/moves/steps are outputs; moves must hold 25. */
+int ik_solve(uint32_t pegs, uint32_t playable, int64_t max_steps,
+             int32_t* n_moves, int32_t* moves, int64_t* steps);
+
+/* Solve a batch with an OpenMP-free thread pool + atomic work queue —
+ * the native master/worker (reference Server/Client, main.cc:34-193,
+ * with tags collapsed into an atomic cursor). chunk_size games are
+ * claimed per pull. Outputs are per-board. Returns 0. */
+int ik_solve_batch(const uint32_t* pegs, const uint32_t* playable,
+                   int64_t n_boards, int64_t max_steps, int n_threads,
+                   int chunk_size, uint8_t* solved, int32_t* n_moves,
+                   int32_t* moves /* n_boards*25 */, int64_t* steps);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* ICIKIT_NATIVE_H */

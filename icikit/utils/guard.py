@@ -1,0 +1,44 @@
+"""Process hardening: crash traps + runaway-job watchdog.
+
+The reference wraps every ``main`` in ``chopsigs_()``
+(``Dynamic-Load-Balancing/src/utilities.cc:49-58``): trap fatal signals
+into a diagnostic line + abort, and arm an alarm so a hung run cannot
+wedge the batch queue. Same discipline here, implemented in the native
+runtime (``icikit/native/src/guard.cc``) with a Python-signal fallback;
+CLI entry points call ``chopsigs()`` first, as every reference ``main``
+does (``psort.cc:532``, ``main.cc:196``).
+"""
+
+from __future__ import annotations
+
+# Reference watchdog budgets: 1200 s (utilities.cc:10), 540 s / 120 s
+# debug (psort.cc:17, :539-543).
+DEFAULT_TIMEOUT_S = 1200
+DEBUG_TIMEOUT_S = 120
+
+
+def chopsigs(timeout_s: int = DEFAULT_TIMEOUT_S) -> bool:
+    """Install fatal-signal traps and arm the watchdog. Returns True if
+    the native trap path is active (False means only the alarm is armed,
+    via Python's signal module)."""
+    from icikit import native
+
+    ok = native.install_traps()
+    if not ok:
+        # Fallback: at least make the watchdog fire as a Python exception.
+        import signal
+
+        def _alarm(signum, frame):
+            raise TimeoutError(
+                f"icikit watchdog: run exceeded {timeout_s} s")
+
+        signal.signal(signal.SIGALRM, _alarm)
+    native.watchdog(timeout_s)
+    return ok
+
+
+def disarm() -> None:
+    """Cancel the watchdog (for interactive use after a guarded run)."""
+    from icikit import native
+
+    native.watchdog(0)

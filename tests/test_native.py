@@ -1,0 +1,149 @@
+"""Tests for the native C++ runtime (icikit/native): guard, timer,
+dataset parser, DFS solver, thread-pool batch driver.
+
+The native solver must be bit-identical to the Python oracle and the
+JAX kernel — same (i, j, dir) move order, same first solution, same
+node counts — so every backend of the DLB study is interchangeable.
+"""
+
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+from icikit import native
+from icikit.models.solitaire.dataset import generate_dataset, save_dataset
+from icikit.models.solitaire.game import solve_one_py
+from icikit.models.solitaire.scheduler import solve_host
+
+
+pytestmark = pytest.mark.skipif(
+    not native.available(),
+    reason=f"native runtime unavailable: {native.build_error()}")
+
+
+def test_native_available_on_this_image():
+    # The build toolchain is baked into this image; the native path must
+    # be active, not silently degraded.
+    assert native.available(), native.build_error()
+
+
+def test_monotonic_clock():
+    a = native.monotonic_s()
+    b = native.monotonic_s()
+    assert b >= a > 0
+
+
+def test_parse_boards_matches_python():
+    ds = generate_dataset(40, "easy", seed=21)
+    text = (f"{len(ds)}\n" + "\n".join(ds.to_strings()) + "\n").encode()
+    pegs, playable = native.parse_boards(text)
+    assert (pegs == ds.pegs).all()
+    assert (playable == ds.playable).all()
+
+
+def test_parse_boards_errors():
+    with pytest.raises(ValueError, match="header"):
+        native.parse_boards(b"x\n")
+    with pytest.raises(ValueError, match="fewer rows"):
+        native.parse_boards(b"3\n" + b"1" * 25 + b"\n")
+    with pytest.raises(ValueError, match="row"):
+        native.parse_boards(b"1\n111\n")
+
+
+def test_parse_boards_tolerates_extra_whitespace():
+    row = b"1" * 25
+    pegs, _ = native.parse_boards(b"  2 \r\n" + row + b"\r\n\n " + row)
+    assert len(pegs) == 2
+
+
+def test_native_solver_matches_oracle():
+    ds = generate_dataset(48, "medium", seed=31)
+    for i in range(len(ds)):
+        ok, ms, nodes = solve_one_py(int(ds.pegs[i]), int(ds.playable[i]))
+        nok, nms, nnodes = native.solve(int(ds.pegs[i]), int(ds.playable[i]))
+        assert ok == nok
+        assert nodes == nnodes
+        if ok:
+            assert ms == nms
+
+
+def test_native_step_limit():
+    ds = generate_dataset(4, "medium", seed=33, solvable_fraction=0.0)
+    for i in range(len(ds)):
+        ok, ms, nodes = native.solve(int(ds.pegs[i]), int(ds.playable[i]),
+                                     max_steps=3)
+        assert nodes <= 3
+
+
+def test_native_batch_threaded_deterministic():
+    ds = generate_dataset(100, "easy", seed=41)
+    s1, nm1, mv1, st1 = native.solve_batch(ds.pegs, ds.playable, n_threads=1)
+    s8, nm8, mv8, st8 = native.solve_batch(ds.pegs, ds.playable, n_threads=8)
+    assert (s1 == s8).all()
+    assert (nm1 == nm8).all()
+    assert (mv1 == mv8).all()
+    assert (st1 == st8).all()
+
+
+def test_solve_host_report():
+    ds = generate_dataset(64, "easy", seed=51)
+    rep = solve_host(ds, n_threads=4)
+    oracle = sum(solve_one_py(int(ds.pegs[i]), int(ds.playable[i]))[0]
+                 for i in range(len(ds)))
+    assert rep.n_solutions == oracle
+    assert rep.strategy == "host"
+
+
+def test_empty_batch():
+    s, nm, mv, st = native.solve_batch(
+        np.zeros(0, np.uint32), np.zeros(0, np.uint32))
+    assert len(s) == 0
+
+
+def test_watchdog_soft_counts_alarm():
+    # Soft mode: the trapped SIGALRM increments a counter instead of
+    # killing the process — exercised in a subprocess anyway for
+    # isolation from the test runner's signal state.
+    code = textwrap.dedent("""
+        import time
+        from icikit import native
+        assert native.available()
+        native.watchdog_soft(True)
+        assert native.install_traps()
+        before = native.trap_count()
+        native.watchdog(1)
+        time.sleep(1.5)
+        assert native.trap_count() == before + 1
+        print("SOFT-OK")
+    """)
+    r = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                       text=True, timeout=120, cwd="/root/repo")
+    assert "SOFT-OK" in r.stdout, r.stderr
+
+
+def test_watchdog_hard_kills_runaway():
+    # Hard mode is the reference's whole point (utilities.cc:49-58): a
+    # hung run dies with a diagnostic instead of wedging the queue.
+    code = textwrap.dedent("""
+        import time
+        from icikit.utils.guard import chopsigs
+        chopsigs(1)
+        time.sleep(30)
+        print("SHOULD-NOT-PRINT")
+    """)
+    r = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                       text=True, timeout=120, cwd="/root/repo")
+    assert "SHOULD-NOT-PRINT" not in r.stdout
+    assert "watchdog" in r.stderr or "ERROR" in r.stderr
+
+
+def test_load_dataset_uses_native_path(tmp_path):
+    ds = generate_dataset(16, "easy", seed=61)
+    path = tmp_path / "g.dat"
+    save_dataset(path, ds)
+    from icikit.models.solitaire.dataset import load_dataset
+    back = load_dataset(path)
+    assert (back.pegs == ds.pegs).all()

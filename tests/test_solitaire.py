@@ -1,0 +1,296 @@
+"""Tests for the peg-solitaire DLB subsystem (SURVEY.md C21-C25).
+
+The reference's oracle was the printed "found N solutions" count against
+known datasets (SURVEY.md §4.4); here that becomes: JAX solver vs
+pure-Python DFS oracle (exact solved/moves/steps parity), replay
+validation of every emitted solution, golden solution counts on
+deterministic generated datasets, and scheduler-equivalence checks
+(static and dynamic must agree with each other and the oracle).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from icikit.models.solitaire import (
+    BoardBatch,
+    generate_dataset,
+    load_dataset,
+    parse_board,
+    pretty_board,
+    render_board,
+    render_solution,
+    replay_moves,
+    save_dataset,
+    solve_batch,
+    solve_dynamic,
+    solve_one_py,
+    solve_static,
+)
+from icikit.models.solitaire.game import (
+    EXHAUSTED,
+    SOLVED,
+    STEP_LIMIT,
+    apply_move,
+)
+from icikit.models.solitaire.scheduler import write_solutions
+
+
+# ---------------------------------------------------------------------------
+# Board encoding
+
+def test_parse_render_roundtrip():
+    s = "1102211222112221122212222"
+    pegs, playable = parse_board(s)
+    assert render_board(pegs, playable) == s
+
+
+def test_parse_board_semantics():
+    pegs, playable = parse_board("10" + "2" * 23)
+    assert pegs == 0b01 and playable == 0b11
+
+
+def test_parse_board_bad_length():
+    with pytest.raises(ValueError):
+        parse_board("111")
+
+
+def test_pretty_board_reference_orientation():
+    # Reference Print is column-major: output row r lists cells (i, j=r)
+    # for i = 0..4 (game.cc:108-118). Cell 5 is (i=1, j=0) -> row 0 col 1.
+    pegs, playable = parse_board("0" * 5 + "1" + "0" * 19)
+    lines = pretty_board(pegs, playable).splitlines()
+    assert lines[0] == "*X***"
+    assert all(ln == "*****" for ln in lines[1:])
+
+
+# ---------------------------------------------------------------------------
+# Game rules
+
+def test_apply_move_jump():
+    # Pegs at (0,1) and (0,2); jump (0,2) over (0,1) into hole (0,0):
+    # move = cell 0, dir 2 (mid (0,1), far (0,2)).
+    pegs, playable = parse_board("0110" + "0" * 21)
+    m = 0 * 4 + 2
+    assert replay_moves(pegs, playable, [m])[-1] == 0b1
+    assert apply_move(pegs, m) == 0b1
+
+
+def test_replay_rejects_illegal_move():
+    pegs, playable = parse_board("0110" + "0" * 21)
+    with pytest.raises(ValueError):
+        replay_moves(pegs, playable, [0 * 4 + 0])
+
+
+def test_single_peg_is_immediate_win():
+    b = BoardBatch.from_strings(["1" + "0" * 24])
+    solved, n_moves, moves, steps, status = solve_batch(b.pegs, b.playable)
+    assert bool(solved[0]) and int(n_moves[0]) == 0
+    assert int(status[0]) == SOLVED
+
+
+def test_empty_and_full_boards_unsolvable():
+    # No pegs: not a win (win == exactly one peg). All pegs: no hole to
+    # jump into, >1 peg -> exhausted immediately.
+    b = BoardBatch.from_strings(["0" * 25, "1" * 25])
+    solved, _, _, steps, status = solve_batch(b.pegs, b.playable)
+    assert not solved.any()
+    assert list(np.asarray(status)) == [EXHAUSTED, EXHAUSTED]
+
+
+def test_three_in_a_row_unsolvable():
+    # Classic: 3 pegs in a line can never reduce to 1.
+    b = BoardBatch.from_strings(["111" + "0" * 22])
+    solved, *_ = solve_batch(b.pegs, b.playable)
+    assert not bool(solved[0])
+
+
+def test_domino_solvable_in_one_move():
+    # Pegs at (0,0), (0,1): peg (0,0) jumps over (0,1) into hole (0,2).
+    board = "110" + "0" * 22
+    pegs, playable = parse_board(board)
+    ok, moves, _ = solve_one_py(pegs, playable)
+    assert ok and len(moves) == 1
+    assert moves == [2 * 4 + 3]  # dest cell (0,2), dir 3 (mid/far leftward)
+    assert bin(replay_moves(pegs, playable, moves)[-1]).count("1") == 1
+
+
+def test_square_solvable_in_three_moves():
+    # 2x2 peg square at the corner reduces to one peg in 3 jumps.
+    board = "11000" + "11000" + "0" * 15
+    pegs, playable = parse_board(board)
+    ok, moves, _ = solve_one_py(pegs, playable)
+    assert ok and len(moves) == 3
+    assert bin(replay_moves(pegs, playable, moves)[-1]).count("1") == 1
+
+
+def test_na_cells_block_jumps():
+    # The domino's only escape hole (0,2) marked NA makes it unsolvable,
+    # for both oracle and kernel (NA cells are never valid destinations,
+    # game.cc:78-81: destination must be HOLE).
+    blocked = "112" + "0" * 22
+    ok_blocked, _, _ = solve_one_py(*parse_board(blocked))
+    assert not ok_blocked
+    b = BoardBatch.from_strings([blocked])
+    solved, *_ = solve_batch(b.pegs, b.playable)
+    assert not bool(solved[0])
+
+
+# ---------------------------------------------------------------------------
+# JAX solver vs Python oracle (the core parity property)
+
+@pytest.mark.parametrize("grade", ["easy", "medium"])
+def test_solver_matches_oracle(grade):
+    ds = generate_dataset(48, grade, seed=7)
+    solved, n_moves, moves, steps, status = (
+        np.asarray(x) for x in solve_batch(ds.pegs, ds.playable))
+    for i in range(len(ds)):
+        ok, ms, nodes = solve_one_py(int(ds.pegs[i]), int(ds.playable[i]))
+        assert ok == bool(solved[i]), f"board {i}: solved mismatch"
+        assert nodes == int(steps[i]), f"board {i}: node-count mismatch"
+        if ok:
+            got = list(moves[i][:n_moves[i]])
+            assert got == ms, f"board {i}: move-sequence mismatch"
+            final = replay_moves(int(ds.pegs[i]), int(ds.playable[i]), got)[-1]
+            assert bin(final).count("1") == 1
+
+
+def test_solver_first_solution_is_lexicographic_dfs():
+    # Move order is (i, j, dir) lexicographic as in validMoveList
+    # (game.cc:99-107); the solver must return the FIRST solution in
+    # that order, not just any solution.
+    ds = generate_dataset(16, "easy", seed=3)
+    _, n_moves, moves, _, _ = (
+        np.asarray(x) for x in solve_batch(ds.pegs, ds.playable))
+    for i in range(len(ds)):
+        ok, ms, _ = solve_one_py(int(ds.pegs[i]), int(ds.playable[i]))
+        if ok:
+            assert list(moves[i][:n_moves[i]]) == ms
+
+
+def test_step_limit_status():
+    ds = generate_dataset(8, "medium", seed=11, solvable_fraction=0.0)
+    solved, _, _, steps, status = (
+        np.asarray(x) for x in solve_batch(ds.pegs, ds.playable, max_steps=3))
+    assert (steps <= 3).all()
+    assert (status[~solved] == STEP_LIMIT).any() or solved.all()
+
+
+def test_solvable_generator_always_solvable():
+    ds = generate_dataset(32, "easy", seed=5, solvable_fraction=1.0)
+    solved, *_ = solve_batch(ds.pegs, ds.playable)
+    assert np.asarray(solved).all()
+
+
+# ---------------------------------------------------------------------------
+# Golden solution counts (deterministic datasets -> fixed counts)
+
+GOLDEN = {("easy", 0, 128): None}  # filled by the oracle below, once
+
+
+def test_golden_count_stable_across_schedulers(tmp_path):
+    ds = generate_dataset(128, "easy", seed=0)
+    oracle = sum(
+        solve_one_py(int(ds.pegs[i]), int(ds.playable[i]))[0]
+        for i in range(len(ds)))
+    static = solve_static(ds)
+    dynamic = solve_dynamic(ds, chunk_size=8)
+    assert static.n_solutions == oracle
+    assert dynamic.n_solutions == oracle
+    assert (static.solved == dynamic.solved).all()
+    assert (static.steps == dynamic.steps).all()
+
+
+# ---------------------------------------------------------------------------
+# Dataset I/O
+
+def test_dataset_roundtrip(tmp_path):
+    ds = generate_dataset(20, "easy", seed=2)
+    path = tmp_path / "games.dat"
+    save_dataset(path, ds)
+    back = load_dataset(path)
+    assert (back.pegs == ds.pegs).all()
+    assert (back.playable == ds.playable).all()
+    first = open(path).readline().strip()
+    assert first == "20"  # reference header: count line (main.cc:52)
+
+
+def test_dataset_gzip_roundtrip(tmp_path):
+    ds = generate_dataset(10, "medium", seed=4)
+    path = str(tmp_path / "games.dat.gz")
+    save_dataset(path, ds)
+    back = load_dataset(path)
+    assert (back.pegs == ds.pegs).all()
+
+
+def test_dataset_bad_header(tmp_path):
+    p = tmp_path / "bad.dat"
+    p.write_text("5\n" + "1" * 25 + "\n")
+    with pytest.raises(ValueError):
+        load_dataset(p)
+
+
+def test_reference_format_compatibility():
+    # A row from the reference's easy_sample.dat parses cleanly
+    # (SURVEY.md C28 format: '0'/'1'/'2' chars).
+    row = "2111210112221122212222222"
+    pegs, playable = parse_board(row)
+    assert bin(pegs).count("1") == row.count("1")
+    assert bin(playable).count("1") == row.count("1") + row.count("0")
+
+
+# ---------------------------------------------------------------------------
+# Schedulers
+
+def test_static_uses_multiple_devices():
+    ds = generate_dataset(64, "easy", seed=9)
+    rep = solve_static(ds)
+    p = min(len(jax.devices()), 64)
+    assert len(rep.per_worker_games) == p
+    assert sum(rep.per_worker_games) == 64
+    assert rep.imbalance >= 1.0
+
+
+def test_dynamic_chunk_accounting():
+    ds = generate_dataset(50, "easy", seed=10)  # 50 = 6x8 + 2: ragged tail
+    rep = solve_dynamic(ds, chunk_size=8)
+    assert sum(rep.per_worker_games) == 50
+    assert rep.n_solutions == solve_static(ds).n_solutions
+
+
+def test_dynamic_empty_batch():
+    ds = generate_dataset(0, "easy", seed=0)
+    rep = solve_dynamic(ds)
+    assert rep.n_solutions == 0 and len(rep.solved) == 0
+
+
+def test_padding_boards_never_count_as_solutions():
+    # 9 games with chunk 8 -> 7 empty padding boards in chunk 2; empty
+    # boards must not inflate the count (win requires exactly one peg).
+    ds = generate_dataset(9, "easy", seed=12, solvable_fraction=1.0)
+    rep = solve_dynamic(ds, chunk_size=8)
+    assert rep.n_solutions == 9
+
+
+def test_write_solutions_renders_replayable(tmp_path):
+    ds = generate_dataset(12, "easy", seed=13, solvable_fraction=1.0)
+    rep = solve_static(ds)
+    out = tmp_path / "solutions.txt"
+    n = write_solutions(out, ds, rep)
+    assert n == 12
+    text = out.read_text()
+    assert "-->" in text
+    # Every rendered state line uses the reference Print alphabet.
+    for line in text.splitlines():
+        assert set(line) <= set("X* -\n>")
+
+
+def test_render_solution_shape():
+    board = "11000" + "11000" + "0" * 15
+    pegs, playable = parse_board(board)
+    ok, moves, _ = solve_one_py(pegs, playable)
+    assert ok
+    text = render_solution(board, moves)
+    # len(moves) transitions -> len(moves)+1 board renderings.
+    assert text.count("-->") == len(moves)

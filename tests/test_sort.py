@@ -86,6 +86,26 @@ def test_sample_sort_overflow_retry(mesh8):
     np.testing.assert_array_equal(out, np.sort(data))
 
 
+def test_quicksort_overflow_retry(mesh8):
+    """All-equal data: every round's pivot equals every element, so one
+    side of each partition absorbs nearly everything — the capacity must
+    double (possibly twice) and the retried sort must still be exact."""
+    data = np.full(1 << 10, 42, np.int32)
+    out = np.asarray(sort(jnp.asarray(data), mesh8, algorithm="quicksort"))
+    np.testing.assert_array_equal(out, np.sort(data))
+
+
+def test_quicksort_irreducible_skew_raises(mesh8):
+    """Skew beyond max_cap_factor must surface as RuntimeError, not a
+    silently truncated result."""
+    from icikit.models.sort.quicksort import hypercube_quicksort_blocks
+    data = np.full(1 << 10, 7, np.int32)
+    blocks, _ = prepare_blocks(jnp.asarray(data), mesh8)
+    with pytest.raises(RuntimeError, match="skew"):
+        hypercube_quicksort_blocks(blocks, mesh8, cap_factor=1.0,
+                                   max_cap_factor=1.0)
+
+
 def test_check_sort_counts_errors(mesh8):
     n = 1 << 10
     good = np.sort(_inputs("int32", n, seed=9))

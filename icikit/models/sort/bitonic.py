@@ -23,6 +23,7 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from icikit.ops.merge import bitonic_merge
+from icikit.ops.pallas_sort import local_sort
 from icikit.parallel.shmap import shard_map, xor_perm
 from icikit.utils.mesh import DEFAULT_AXIS, UnsupportedMeshError, ilog2, is_pow2
 
@@ -39,7 +40,7 @@ def bitonic_sort_shard(a: jax.Array, axis: str, p: int) -> jax.Array:
         raise UnsupportedMeshError(
             f"bitonic sort requires a power-of-2 device count (got {p}), "
             "as in the reference (psort.cc:168-172)")
-    a = jnp.sort(a)
+    a = local_sort(a)  # Pallas network on TPU, jnp.sort elsewhere
     if p == 1:
         return a
     r = lax.axis_index(axis)

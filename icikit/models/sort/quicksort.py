@@ -37,6 +37,7 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from icikit.models.sort.common import rebalance_sorted, sentinel_for
+from icikit.ops.pallas_sort import local_sort
 from icikit.parallel.shmap import shard_map, xor_perm
 from icikit.utils.mesh import DEFAULT_AXIS, UnsupportedMeshError, ilog2, is_pow2
 
@@ -51,7 +52,7 @@ def hypercube_quicksort_shard(a: jax.Array, axis: str, p: int, cap: int):
     n_loc = a.shape[0]
     sent = sentinel_for(a.dtype)
     if p == 1:
-        return jnp.sort(a), jnp.zeros((), jnp.int32)
+        return local_sort(a), jnp.zeros((), jnp.int32)
 
     r = lax.axis_index(axis)
     d = ilog2(p)
@@ -66,7 +67,7 @@ def hypercube_quicksort_shard(a: jax.Array, axis: str, p: int, cap: int):
         g = p >> i          # sub-cube size this round
         half = g >> 1
         base = (r // g) * g  # my sub-cube's first rank (the color split)
-        buf = jnp.sort(buf)  # local sort; sentinels stay at the tail
+        buf = local_sort(buf)  # local sort; sentinels stay at the tail
         # Median of my valid prefix, then median-of-medians in my group
         # (psort.cc:407-426). Empty prefix contributes the sentinel.
         my_med = jnp.where(
@@ -105,7 +106,7 @@ def hypercube_quicksort_shard(a: jax.Array, axis: str, p: int, cap: int):
                                   sent))
         count = jnp.minimum(new_count, jnp.asarray(cap, jnp.int32))
 
-    buf = jnp.sort(buf)  # final local sort (:486)
+    buf = local_sort(buf)  # final local sort (:486)
     overflow = lax.psum(overflow, axis)
     out = rebalance_sorted(buf, count, n_loc, axis, p)
     return out, overflow

@@ -37,6 +37,7 @@ from icikit.models.sort.common import (
     rebalance_sorted,
     unpack_rows,
 )
+from icikit.ops.pallas_sort import local_sort
 from icikit.parallel.shmap import shard_map
 from icikit.utils.mesh import DEFAULT_AXIS
 
@@ -72,7 +73,7 @@ def sample_sort_shard(a: jax.Array, axis: str, p: int, cap: int,
     invalid (the host wrapper retries with the safe capacity n_loc).
     """
     n_loc = a.shape[0]
-    a = jnp.sort(a)
+    a = local_sort(a)
     if p == 1:
         return a, jnp.zeros((), jnp.int32)
 
@@ -93,7 +94,7 @@ def sample_sort_shard(a: jax.Array, axis: str, p: int, cap: int,
     rows, recv_counts, overflow = ragged_all_to_all(a, starts, counts,
                                                     cap, axis)
     flat, valid = unpack_rows(rows, recv_counts)
-    flat = jnp.sort(flat)  # final local sort (:281); sentinels to tail
+    flat = local_sort(flat)  # final local sort (:281); sentinels to tail
     out = rebalance_sorted(flat, valid, n_loc, axis, p)
     return out, overflow
 

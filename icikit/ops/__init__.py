@@ -7,6 +7,7 @@ network (``icikit.ops.merge``) — O(n log n) vectorized min/max stages
 that map straight onto the TPU VPU, with an optional Pallas kernel.
 """
 
+from icikit.ops.pallas_sort import local_sort, merge_bitonic as merge_bitonic_pallas  # noqa: F401
 from icikit.ops.merge import (  # noqa: F401
     bitonic_merge,
     compare_split_max,

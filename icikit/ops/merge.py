@@ -24,16 +24,23 @@ import jax.numpy as jnp
 from icikit.utils.mesh import is_pow2
 
 
-def bitonic_merge(v: jax.Array) -> jax.Array:
+def bitonic_merge(v: jax.Array, backend: str = "auto") -> jax.Array:
     """Sort a *bitonic* vector ascending via Batcher's merge network.
 
     log2(n) stages of elementwise min/max over halves; requires
     power-of-2 length (callers pad — see ``models.sort.common``).
-    Falls back to ``jnp.sort`` for non-power-of-2 lengths.
+    Falls back to ``jnp.sort`` for non-power-of-2 lengths. On TPU,
+    large merges dispatch to the fused Pallas network
+    (``icikit.ops.pallas_sort.merge_bitonic``), which runs the whole
+    stage cascade in VMEM instead of one HBM pass per stage.
     """
     n = v.shape[0]
     if not is_pow2(n):
         return jnp.sort(v)
+    from icikit.ops.pallas_sort import _resolve_backend, merge_bitonic
+    resolved = _resolve_backend(backend, v.dtype, n)
+    if resolved in ("pallas", "interpret"):
+        return merge_bitonic(v, backend=resolved)
     k = n // 2
     while k >= 1:
         w = v.reshape(-1, 2, k)

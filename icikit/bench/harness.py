@@ -129,7 +129,13 @@ def sweep_collective(mesh, family: str, algorithm: str,
     for msize in sizes:
         run, verify = _setup(family, mesh, axis, msize, np.dtype(dtype))
         verified = bool(verify(jax.block_until_ready(run(algorithm))))
-        res = timeit(run, algorithm, runs=runs, warmup=warmup)
+        # Named host annotation around the whole timing loop so profiler
+        # traces attribute device work per collective/size (SURVEY.md
+        # §5.1) — outside the timed region, so timings stay comparable
+        # whether or not a profiler session is active.
+        with jax.profiler.TraceAnnotation(
+                f"{family}/{algorithm}/p{p}/m{msize}"):
+            res = timeit(run, algorithm, runs=runs, warmup=warmup)
         block_bytes = msize * np.dtype(dtype).itemsize
         records.append(BenchRecord(
             family=family, algorithm=algorithm, p=p, msize=msize,

@@ -35,8 +35,16 @@ def main(argv=None):
     ap.add_argument("--dtype", default="int32")
     ap.add_argument("--json", dest="json_path", default=None,
                     help="write records as JSON lines to this path")
+    ap.add_argument("--profile", dest="profile_dir", default=None,
+                    help="capture a jax.profiler trace of the sweep into "
+                         "this directory (open with TensorBoard/Perfetto) "
+                         "— per-collective tracing the reference's "
+                         "stopwatch could not provide (SURVEY.md §5.1)")
     args = ap.parse_args(argv)
 
+    import contextlib
+
+    import jax
     import jax.numpy as jnp
 
     from icikit.bench.harness import (
@@ -53,9 +61,12 @@ def main(argv=None):
              (REFERENCE_SWEEP_PERSONALIZED if args.family == "alltoall"
               else REFERENCE_SWEEP))
     algorithms = args.algorithms.split(",") if args.algorithms else None
-    records = sweep_family(mesh, args.family, algorithms, sizes=sizes,
-                           dtype=jnp.dtype(args.dtype), runs=args.runs,
-                           warmup=args.warmup)
+    profiled = (jax.profiler.trace(args.profile_dir)
+                if args.profile_dir else contextlib.nullcontext())
+    with profiled:
+        records = sweep_family(mesh, args.family, algorithms, sizes=sizes,
+                               dtype=jnp.dtype(args.dtype), runs=args.runs,
+                               warmup=args.warmup)
     print(format_table(records))
     if args.json_path:
         with open(args.json_path, "w") as f:

@@ -1,0 +1,135 @@
+"""Strong-scaling launcher — the reference's PBS batch script as code (C27).
+
+The reference strong-scaled one binary over np ∈ {2,...,128} by
+submitting ``mpirun -np $p`` once per process count and redirecting
+stdout to a per-np file (``Communication/Data/sub.sh:9-15``). Here every
+scale point is a subprocess running the bench CLI
+(``icikit.bench.run``) on a simulated CPU mesh of p host devices
+(``XLA_FLAGS=--xla_force_host_platform_device_count=p``) — the
+"multi-node without a cluster" capability the reference lacked
+(SURVEY.md §4.6) — or, with ``simulate=False``, on the first p local
+accelerator devices. Each point must be its own process because the
+host-platform device count is fixed at backend initialization.
+
+Records stream back as JSON dicts (the reference's per-np stdout files,
+made machine-readable); ``icikit.bench.report`` renders them into the
+comparison tables of the reference's PDF reports.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+from pathlib import Path
+
+# Simulated meshes are host threads, so the sweep stays modest by
+# default (the reference went to 128 ranks on 7 real nodes).
+DEFAULT_PS = (2, 4, 8)
+
+_REPO_ROOT = str(Path(__file__).resolve().parents[2])
+
+
+def _point_env(p: int, simulate: bool) -> dict:
+    env = dict(os.environ)
+    keep = [x for x in env.get("PYTHONPATH", "").split(os.pathsep) if x]
+    if simulate:
+        # Entries with an interpreter-startup site hook can pin a
+        # hardware platform before our per-subprocess overrides apply;
+        # drop those, keep the rest.
+        keep = [x for x in keep
+                if not os.path.exists(os.path.join(x, "sitecustomize.py"))]
+        env["JAX_PLATFORMS"] = "cpu"
+        flags = [f for f in env.get("XLA_FLAGS", "").split()
+                 if "xla_force_host_platform_device_count" not in f]
+        env["XLA_FLAGS"] = " ".join(
+            flags + [f"--xla_force_host_platform_device_count={p}"])
+    env["PYTHONPATH"] = os.pathsep.join([_REPO_ROOT] + keep)
+    return env
+
+
+def run_scale_point(family: str, p: int, *, algorithms=None, sizes=None,
+                    runs: int = 5, dtype: str = "int32",
+                    simulate: bool = True,
+                    timeout_s: float = 600.0) -> list[dict]:
+    """Run one scale point (one subprocess) and return its records."""
+    with tempfile.NamedTemporaryFile(mode="r", suffix=".jsonl",
+                                     delete=False) as tf:
+        json_path = tf.name
+    try:
+        cmd = [sys.executable, "-m", "icikit.bench.run",
+               "--family", family, "--devices", str(p),
+               "--runs", str(runs), "--dtype", dtype,
+               "--json", json_path]
+        if algorithms:
+            cmd += ["--algorithms", ",".join(algorithms)]
+        if sizes:
+            cmd += ["--sizes", ",".join(str(s) for s in sizes)]
+        proc = subprocess.run(
+            cmd, env=_point_env(p, simulate), capture_output=True,
+            text=True, timeout=timeout_s, cwd=_REPO_ROOT)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"scale point p={p} failed (rc={proc.returncode}):\n"
+                f"{proc.stdout[-2000:]}\n{proc.stderr[-2000:]}")
+        with open(json_path) as f:
+            return [json.loads(line) for line in f if line.strip()]
+    finally:
+        os.unlink(json_path)
+
+
+def run_scaling_sweep(family: str, ps=DEFAULT_PS, **kw) -> list[dict]:
+    """Strong-scaling study: the same workload at every device count,
+    concatenated into one record list (each record carries its p)."""
+    records = []
+    for p in ps:
+        records.extend(run_scale_point(family, p, **kw))
+    return records
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--family", default="allgather")
+    ap.add_argument("--ps", default=None,
+                    help="comma-separated device counts (default: 2,4,8)")
+    ap.add_argument("--algorithms", default=None)
+    ap.add_argument("--sizes", default=None)
+    ap.add_argument("--runs", type=int, default=5)
+    ap.add_argument("--dtype", default="int32")
+    ap.add_argument("--real-devices", action="store_true",
+                    help="use local accelerator devices instead of the "
+                         "simulated CPU mesh")
+    ap.add_argument("--json", dest="json_path", default=None)
+    ap.add_argument("--report", dest="report_path", default=None,
+                    help="also render a markdown report to this path")
+    args = ap.parse_args(argv)
+
+    ps = (tuple(int(x) for x in args.ps.split(","))
+          if args.ps else DEFAULT_PS)
+    records = run_scaling_sweep(
+        args.family, ps,
+        algorithms=args.algorithms.split(",") if args.algorithms else None,
+        sizes=(tuple(int(s) for s in args.sizes.split(","))
+               if args.sizes else None),
+        runs=args.runs, dtype=args.dtype,
+        simulate=not args.real_devices)
+
+    from icikit.bench.report import render_report
+    text = render_report(records,
+                         title=f"Strong scaling: {args.family}")
+    print(text)
+    if args.json_path:
+        with open(args.json_path, "w") as f:
+            for r in records:
+                f.write(json.dumps(r) + "\n")
+    if args.report_path:
+        with open(args.report_path, "w") as f:
+            f.write(text)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -12,9 +12,10 @@ here:
 - ``ring``                — C4, ``main.cc:190-223``: p-1 shift-by-one
   steps forwarding the block just received.
 - ``recursive_doubling``  — C3, ``main.cc:63-188``: ⌈log2 p⌉ XOR-partner
-  rounds with message volume doubling each round. The reference's "twin"
-  trick for non-power-of-2 p is replaced by an explicit power-of-2
-  constraint (SURVEY.md §7 "hard parts": decide per algorithm).
+  rounds with message volume doubling each round; power-of-2 p only.
+- ``recursive_doubling_twins`` — C3's non-power-of-2 path: the
+  reference's virtual "twin" ranks (``main.cc:71-75,136-185``) as four
+  partial ``ppermute`` schedules per round.
 - ``xla``                 — the vendor baseline (``jax.lax.all_gather``
   over ICI), playing the role Intel MPI played in the reference study.
 
@@ -85,9 +86,9 @@ def _recursive_doubling(block: jax.Array, axis: str, p: int) -> jax.Array:
     if not is_pow2(p):
         raise UnsupportedMeshError(
             "recursive_doubling requires a power-of-2 device count "
-            f"(got {p}); the reference's virtual-twin workaround "
-            "(Communication/src/main.cc:71-75) is intentionally not "
-            "reproduced — use 'ring' or 'naive' for other sizes")
+            f"(got {p}); use 'recursive_doubling_twins' (the reference's "
+            "virtual-twin workaround, Communication/src/main.cc:71-75), "
+            "'ring', or 'naive' for other sizes")
     r = lax.axis_index(axis)
     out = _own_block_first(block, p, r)
     for i in range(ilog2(p)):
@@ -99,6 +100,69 @@ def _recursive_doubling(block: jax.Array, axis: str, p: int) -> jax.Array:
     return out
 
 
+@register_algorithm("allgather", "recursive_doubling_twins")
+def _recursive_doubling_twins(block: jax.Array, axis: str, p: int) -> jax.Array:
+    """Recursive doubling for *any* p via virtual twin ranks (C3's
+    non-power-of-2 handling, ``Communication/src/main.cc:71-75,136-185``).
+
+    The reference rounds the rank count up to p2 = 2^ceil(log2 p) and has
+    each real rank also execute the send/recv schedule of a "twin"
+    virtual rank with id >= p. Here device d simulates virtual id d and,
+    when d < p2-p, virtual id d+p. Each device carries two accumulation
+    buffers (own id / twin id); every round is four partial ``ppermute``
+    schedules routing each virtual id's aligned group chunk to the device
+    that owns its XOR partner. Virtual blocks >= p hold zeros and are
+    dropped at the end — replacing the reference's block-clamping
+    (``:98-113``) with static shapes, the TPU-friendly equivalent.
+    """
+    if is_pow2(p):
+        return _recursive_doubling(block, axis, p)
+    p2 = 1 << p.bit_length()
+    n_twins = p2 - p  # devices 0..n_twins-1 also host twin ids p..p2-1
+    r = lax.axis_index(axis)
+    tail = block.shape[1:]
+    out_own = lax.dynamic_update_slice_in_dim(
+        jnp.zeros((p2,) + tail, block.dtype), block, r, 0)
+    out_twin = jnp.zeros((p2,) + tail, block.dtype)
+
+    for i in range(ilog2(p2)):
+        step = 1 << i
+        # Static routing tables for this round: virtual id v exchanges
+        # its 2^i-aligned group with v ^ 2^i; the owner of id v is
+        # v if v < p else v - p, and the buffer kind follows suit.
+        perms = {("own", "own"): [], ("own", "twin"): [],
+                 ("twin", "own"): [], ("twin", "twin"): []}
+        for src_dev in range(p):
+            u = src_dev ^ step
+            perms[("own", "own" if u < p else "twin")].append(
+                (src_dev, u if u < p else u - p))
+        for src_dev in range(n_twins):
+            u = (src_dev + p) ^ step
+            perms[("twin", "own" if u < p else "twin")].append(
+                (src_dev, u if u < p else u - p))
+
+        base_own = (r >> i) << i
+        base_twin = ((r + p) >> i) << i
+        chunk_own = lax.dynamic_slice_in_dim(out_own, base_own, step, 0)
+        chunk_twin = lax.dynamic_slice_in_dim(out_twin, base_twin, step, 0)
+        chunks = {"own": chunk_own, "twin": chunk_twin}
+        # Each virtual id has exactly one partner per round (XOR is an
+        # involution on [0, p2)), so each buffer receives exactly one
+        # non-zero chunk; summing the two partial permutes merges them.
+        recv_own = sum(
+            lax.ppermute(chunks[src], axis, perms[(src, "own")])
+            for src in ("own", "twin") if perms[(src, "own")])
+        recv_twin = sum(
+            lax.ppermute(chunks[src], axis, perms[(src, "twin")])
+            for src in ("own", "twin") if perms[(src, "twin")])
+        out_own = lax.dynamic_update_slice_in_dim(
+            out_own, recv_own, base_own ^ step, 0)
+        if n_twins and not isinstance(recv_twin, int):
+            out_twin = lax.dynamic_update_slice_in_dim(
+                out_twin, recv_twin, base_twin ^ step, 0)
+    return out_own[:p]
+
+
 @register_algorithm("allgather", "xla")
 def _xla(block: jax.Array, axis: str, p: int) -> jax.Array:
     """Vendor baseline: XLA's native all_gather over ICI."""
@@ -106,7 +170,8 @@ def _xla(block: jax.Array, axis: str, p: int) -> jax.Array:
     return lax.all_gather(block, axis, axis=0, tiled=True)
 
 
-ALLGATHER_ALGORITHMS = ("naive", "ring", "recursive_doubling", "xla")
+ALLGATHER_ALGORITHMS = ("naive", "ring", "recursive_doubling",
+                        "recursive_doubling_twins", "xla")
 
 register_family("allgather", "sharded",
                 lambda impl, axis, p: lambda b: impl(b, axis, p)[None])

@@ -26,6 +26,12 @@ jax.config.update("jax_num_cpu_devices", 8)
 from icikit.utils.mesh import make_mesh  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running test (subprocess scale points, "
+        "big fixtures)")
+
+
 @pytest.fixture(scope="session")
 def mesh8():
     return make_mesh(8)

@@ -45,14 +45,29 @@ def test_allgather_repeated_runs_stable(mesh8, algorithm):
             np.testing.assert_array_equal(out[d], _pattern(p, m, it))
 
 
-@pytest.mark.parametrize("algorithm", ["naive", "ring", "xla"])
+@pytest.mark.parametrize("algorithm",
+                         ["naive", "ring", "xla", "recursive_doubling_twins"])
 def test_allgather_non_power_of_two(algorithm):
-    """ring/naive support any p (the reference's recursive doubling needed
-    the twin trick for this; we constrain instead)."""
+    """ring/naive support any p; recursive_doubling_twins reproduces the
+    reference's virtual-twin workaround (main.cc:71-75)."""
     p, m = 6, 8
     mesh = make_mesh(p)
     x = shard_along(jnp.asarray(_pattern(p, m)), mesh)
     out = np.asarray(all_gather_blocks(x, mesh, algorithm=algorithm))
+    for d in range(p):
+        np.testing.assert_array_equal(out[d], _pattern(p, m))
+
+
+@pytest.mark.parametrize("p", [2, 3, 5, 6, 7, 8])
+def test_recursive_doubling_twins_all_sizes(p):
+    """The twin schedule must agree with the oracle at every device
+    count, power-of-2 (where it defers to the plain schedule) or not."""
+    m = 4
+    mesh = make_mesh(p)
+    x = shard_along(jnp.asarray(_pattern(p, m)), mesh)
+    out = np.asarray(all_gather_blocks(
+        x, mesh, algorithm="recursive_doubling_twins"))
+    assert out.shape == (p, p, m)
     for d in range(p):
         np.testing.assert_array_equal(out[d], _pattern(p, m))
 

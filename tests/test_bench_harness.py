@@ -47,3 +47,16 @@ def test_graft_entry_single_chip():
 def test_graft_dryrun_multichip():
     import __graft_entry__
     __graft_entry__.dryrun_multichip(8)
+
+
+def test_profile_flag_writes_trace(tmp_path):
+    """--profile captures a jax.profiler trace directory (SURVEY §5.1
+    upgrade: per-collective tracing the reference lacked)."""
+    from icikit.bench.run import main
+    trace_dir = tmp_path / "trace"
+    rc = main(["--family", "broadcast", "--algorithms", "xla",
+               "--sizes", "8", "--runs", "1", "--devices", "2",
+               "--profile", str(trace_dir)])
+    assert rc == 0
+    files = list(trace_dir.rglob("*"))
+    assert any(f.is_file() for f in files), "no trace files written"

@@ -1,0 +1,60 @@
+"""Tests for the strong-scaling launcher (C27 analog) and the markdown
+report renderer (C29 analog)."""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from icikit.bench.report import render_report
+from icikit.bench.scaling import run_scaling_sweep
+
+
+def _rec(family="allgather", algorithm="ring", p=2, msize=16,
+         best_s=1e-5, verified=True):
+    return {"family": family, "algorithm": algorithm, "p": p,
+            "msize": msize, "dtype": "int32", "bytes_per_block": msize * 4,
+            "runs": 3, "mean_s": best_s * 1.1, "best_s": best_s,
+            "busbw_gbps": 1.0, "verified": verified}
+
+
+def test_report_tables_and_ranking():
+    records = []
+    for p in (2, 4):
+        for m in (16, 256):
+            records.append(_rec(algorithm="ring", p=p, msize=m,
+                                best_s=1e-5))
+            records.append(_rec(algorithm="xla", p=p, msize=m,
+                                best_s=2e-5))
+    text = render_report(records, title="T")
+    assert "# T" in text
+    assert "best time (µs) vs message size, p=2" in text
+    assert "vs device count, msize=16" in text  # p varies -> scaling view
+    assert "**ring** fastest in 4/4 configurations" in text
+    assert "faster (median)" in text
+
+
+def test_report_marks_unverified():
+    text = render_report([_rec(verified=False)])
+    assert "unverified" in text
+    assert "✗" in text
+
+
+def test_report_single_p_skips_scaling_view():
+    text = render_report([_rec(p=4)])
+    assert "vs device count" not in text
+
+
+@pytest.mark.slow
+def test_scaling_sweep_subprocess_smoke():
+    """One real scale point through the subprocess path: p=2 simulated
+    CPU mesh, tiny sizes. This is the sub.sh analog end-to-end."""
+    records = run_scaling_sweep(
+        "allgather", ps=(2,), algorithms=["ring"], sizes=(4,), runs=1,
+        timeout_s=300.0)
+    assert len(records) == 1
+    r = records[0]
+    assert r["p"] == 2 and r["algorithm"] == "ring" and r["verified"]
+    # records are json-serializable end-to-end
+    json.dumps(records)

@@ -46,6 +46,12 @@ def main(argv=None):
     ap.add_argument("--watchdog", type=int, default=0,
                     help="arm a whole-run watchdog alarm of N seconds "
                          "(reference chopsigs_, utilities.cc:49-58)")
+    ap.add_argument("--checkpoint", default=None,
+                    help="chunk-level checkpoint file for the dynamic "
+                         "scheduler: completed chunks stream here and a "
+                         "restarted run resumes, solving only what is "
+                         "missing (upgrade over the reference's "
+                         "accidental crash-survival, SURVEY.md §5.4)")
     ap.add_argument("--json", dest="json_path", default=None)
     args = ap.parse_args(argv)
 
@@ -74,7 +80,8 @@ def main(argv=None):
         reports.append(solve_static(batch, max_steps=args.max_steps))
     if args.strategy in ("dynamic", "both", "all"):
         reports.append(solve_dynamic(batch, chunk_size=args.chunk_size,
-                                     max_steps=args.max_steps))
+                                     max_steps=args.max_steps,
+                                     checkpoint_path=args.checkpoint))
     if args.strategy in ("host", "all"):
         reports.append(solve_host(batch, chunk_size=args.chunk_size,
                                   max_steps=args.max_steps))

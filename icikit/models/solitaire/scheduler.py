@@ -28,9 +28,13 @@ and can never count as solutions, since a win needs exactly one peg).
 
 from __future__ import annotations
 
+import hashlib
+import json
+import os
 import threading
 import time
 from dataclasses import dataclass, field
+from pathlib import Path
 
 import numpy as np
 
@@ -85,6 +89,72 @@ def _pad(batch: BoardBatch, to: int) -> BoardBatch:
         playable=np.concatenate([batch.playable, np.zeros(pad, np.uint32)]))
 
 
+class ChunkCheckpoint:
+    """Resumable per-chunk result store for the dynamic scheduler.
+
+    The reference survived crashes only by accident — the server streamed
+    client solutions to the output file as they arrived
+    (``Dynamic-Load-Balancing/src/main.cc:104-106``; SURVEY.md §5.4),
+    but a restart re-solved everything. This makes resume deliberate:
+    each completed chunk is appended as one JSON line (with flush) so a
+    killed run loses at most the chunks in flight; a restart loads the
+    file and only solves what is missing. A dataset/config fingerprint
+    in the header refuses to resume onto different work.
+    """
+
+    _FIELDS = ("solved", "n_moves", "moves", "steps", "status")
+    _DTYPES = (bool, np.int32, np.int32, np.int32, np.int32)
+
+    def __init__(self, path, fingerprint: str):
+        self.path = Path(path)
+        self.fingerprint = fingerprint
+        self._lock = threading.Lock()
+        self.loaded: dict[int, tuple] = {}
+        if self.path.exists() and self.path.stat().st_size > 0:
+            with open(self.path) as f:
+                header = json.loads(f.readline())
+                if header.get("fingerprint") != fingerprint:
+                    raise ValueError(
+                        f"checkpoint {path} was written for a different "
+                        "dataset/configuration; refusing to resume")
+                for line in f:
+                    if not line.strip():
+                        continue  # torn tail line from a crash mid-write
+                    try:
+                        rec = json.loads(line)
+                    except json.JSONDecodeError:
+                        continue
+                    self.loaded[rec["chunk"]] = tuple(
+                        np.asarray(rec[k], dtype=d)
+                        for k, d in zip(self._FIELDS, self._DTYPES))
+        else:
+            self.path.parent.mkdir(parents=True, exist_ok=True)
+            with open(self.path, "w") as f:
+                f.write(json.dumps({"fingerprint": fingerprint}) + "\n")
+
+    def add(self, chunk: int, arrays: tuple) -> None:
+        rec = {"chunk": chunk}
+        for k, a in zip(self._FIELDS, arrays):
+            rec[k] = np.asarray(a).tolist()
+        line = json.dumps(rec) + "\n"
+        with self._lock:
+            with open(self.path, "a") as f:
+                f.write(line)
+                f.flush()
+                os.fsync(f.fileno())
+
+
+def checkpoint_fingerprint(batch: BoardBatch, chunk_size: int,
+                           max_steps: int) -> str:
+    """Content hash binding a checkpoint to its dataset and solve
+    configuration."""
+    h = hashlib.sha256()
+    h.update(np.ascontiguousarray(batch.pegs).tobytes())
+    h.update(np.ascontiguousarray(batch.playable).tobytes())
+    h.update(f"{chunk_size}:{max_steps}".encode())
+    return h.hexdigest()
+
+
 def solve_static(batch: BoardBatch, devices=None,
                  max_steps: int = 2_000_000_000) -> SolveReport:
     """Equal up-front split: device d gets the d-th contiguous slice.
@@ -131,13 +201,17 @@ def solve_static(batch: BoardBatch, devices=None,
 
 def solve_dynamic(batch: BoardBatch, devices=None,
                   chunk_size: int = DEFAULT_CHUNK,
-                  max_steps: int = 2_000_000_000) -> SolveReport:
+                  max_steps: int = 2_000_000_000,
+                  checkpoint_path=None) -> SolveReport:
     """Pull-model dynamic schedule: a shared cursor over fixed-size
     chunks; one host thread per device requests, solves, and reports
     until the queue drains (reference client loop, ``main.cc:146-191``,
     with the Iprobe/tag protocol collapsed into thread-safe control
     flow — there is no message to probe for when master and workers
-    share an address space)."""
+    share an address space).
+
+    ``checkpoint_path``: persist each completed chunk and skip chunks
+    already recorded there on restart (see ``ChunkCheckpoint``)."""
     if devices is None:
         devices = jax.devices()
     n = len(batch)
@@ -145,9 +219,20 @@ def solve_dynamic(batch: BoardBatch, devices=None,
     padded = _pad(batch, n_chunks * chunk_size)
     p = max(1, min(len(devices), max(n_chunks, 1)))
 
+    ckpt = None
+    results: list = [None] * n_chunks
+    pending = list(range(n_chunks))
+    if checkpoint_path is not None:
+        ckpt = ChunkCheckpoint(
+            checkpoint_path,
+            checkpoint_fingerprint(batch, chunk_size, max_steps))
+        for i, arrays in ckpt.loaded.items():
+            if i < n_chunks:
+                results[i] = arrays
+        pending = [i for i in pending if results[i] is None]
+
     cursor_lock = threading.Lock()
     cursor = [0]
-    results: list = [None] * n_chunks
     per_games = [0] * p
     per_steps = [0] * p
     errors: list = []
@@ -162,14 +247,17 @@ def solve_dynamic(batch: BoardBatch, devices=None,
         dev = devices[w]
         try:
             while True:
-                i = next_chunk()
-                if i >= n_chunks:
+                j = next_chunk()
+                if j >= len(pending):
                     return  # terminate tag (main.cc:93-97)
+                i = pending[j]
                 sl = slice(i * chunk_size, (i + 1) * chunk_size)
                 pg = jax.device_put(padded.pegs[sl], dev)
                 pl = jax.device_put(padded.playable[sl], dev)
                 out = jax.block_until_ready(solve_batch(pg, pl, max_steps))
                 results[i] = tuple(np.asarray(o) for o in out)
+                if ckpt is not None:
+                    ckpt.add(i, results[i])
                 real = min(chunk_size, max(0, n - i * chunk_size))
                 per_games[w] += real
                 per_steps[w] += int(results[i][3][:real].sum())

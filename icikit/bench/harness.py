@@ -24,6 +24,7 @@ from icikit.parallel.allgather import all_gather_blocks
 from icikit.parallel.allreduce import all_reduce
 from icikit.parallel.alltoall import all_to_all_blocks
 from icikit.parallel.collops import broadcast, gather_blocks, scatter_blocks
+from icikit.parallel.reducescatter import reduce_scatter
 from icikit.utils.mesh import DEFAULT_AXIS, mesh_axis_size, replicate, shard_along
 from icikit.utils.timing import timeit
 
@@ -62,6 +63,10 @@ def _bus_bytes(family: str, p: int, block_bytes: int) -> float:
         return (p - 1) * block_bytes
     if family == "allreduce":
         return 2 * block_bytes * (p - 1) / p
+    if family == "reducescatter":
+        # block_bytes records the output chunk; input is p chunks, of
+        # which (p-1) chunk-sized partials cross the wire per device.
+        return (p - 1) * block_bytes
     if family == "broadcast":
         return block_bytes
     raise ValueError(family)
@@ -86,6 +91,10 @@ def _setup(family: str, mesh, axis: str, msize: int, dtype):
     elif family == "scatter":
         data = _pattern(p, msize, dtype)
         x = replicate(jnp.asarray(data), mesh)
+    elif family == "reducescatter":
+        # each device contributes p chunks of msize; receives one chunk
+        data = _pattern(p, p * msize, dtype)
+        x = shard_along(jnp.asarray(data), mesh, axis)
     else:
         raise ValueError(family)
 
@@ -96,6 +105,7 @@ def _setup(family: str, mesh, axis: str, msize: int, dtype):
         "broadcast": broadcast,
         "scatter": scatter_blocks,
         "gather": gather_blocks,
+        "reducescatter": reduce_scatter,
     }
     run = lambda alg: fns[family](x, mesh, axis, algorithm=alg)
 
@@ -114,6 +124,8 @@ def _setup(family: str, mesh, axis: str, msize: int, dtype):
             return np.array_equal(o, data)
         if family == "gather":
             return np.array_equal(o[0], data)
+        if family == "reducescatter":
+            return np.array_equal(o, data.sum(axis=0).reshape(p, msize))
         return False
 
     return run, verify

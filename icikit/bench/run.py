@@ -22,7 +22,8 @@ def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--family", default="allgather",
                     choices=["allgather", "alltoall", "allreduce",
-                             "broadcast", "scatter", "gather"])
+                             "reducescatter", "broadcast", "scatter",
+                             "gather"])
     ap.add_argument("--algorithms", default=None,
                     help="comma-separated variant names (default: all)")
     ap.add_argument("--sizes", default=None,

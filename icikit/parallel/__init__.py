@@ -29,3 +29,7 @@ from icikit.parallel.collops import (  # noqa: F401
     gather_blocks,
     scatter_blocks,
 )
+from icikit.parallel.reducescatter import (  # noqa: F401
+    REDUCESCATTER_ALGORITHMS,
+    reduce_scatter,
+)

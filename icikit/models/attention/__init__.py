@@ -1,0 +1,24 @@
+"""L3'c — sequence/context-parallel attention.
+
+The long-context capability built on the framework's collective layer.
+The reference's ring all-to-all (``Communication/src/main.cc:190-223``)
+is structurally the ring-attention communication pattern (neighbor
+``ppermute`` of constant-size blocks, p-1 steps), and its all-to-all
+personalized family (``:234-388``) is the Ulysses-style head↔sequence
+redistribution primitive (SURVEY.md §5.7). This package turns those
+patterns into working long-sequence attention:
+
+- ``dense_attention`` — the single-device oracle.
+- ``ring_attention``  — sequence-parallel flash-style attention: K/V
+  blocks rotate around the ICI ring while each device streams its query
+  block through an online-softmax accumulator. Memory per device is
+  O(S/p); the sequence length scales with the ring.
+- ``ulysses_attention`` — all-to-all sequence parallelism: re-shard
+  sequence↔heads with any algorithm from the ``alltoall`` family (the
+  hand-rolled hypercube/e-cube/wraparound schedules or XLA's native
+  collective), attend locally over the full sequence, re-shard back.
+"""
+
+from icikit.models.attention.dense import dense_attention  # noqa: F401
+from icikit.models.attention.ring import ring_attention  # noqa: F401
+from icikit.models.attention.ulysses import ulysses_attention  # noqa: F401

@@ -1,0 +1,97 @@
+"""Ulysses-style sequence parallelism: all-to-all head↔sequence re-shard.
+
+The redistribution is the reference's all-to-all personalized transpose
+(``Communication/src/main.cc:234-388``) with head-groups as the blocks:
+inbound, device r trades its p head-groups for every device's group r,
+ending with the *full* sequence for heads ``[r·h/p, (r+1)·h/p)``; it
+attends locally (any single-device kernel works — here the dense
+oracle), then the inverse all-to-all restores sequence sharding. Any
+registered ``alltoall`` schedule can carry the re-shard, so the harness
+can compare hypercube/e-cube/wraparound against XLA's fused collective
+on the actual workload the primitive exists for.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache, partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from icikit.models.attention.dense import dense_attention
+from icikit.parallel.shmap import shard_map
+from icikit.utils.mesh import DEFAULT_AXIS
+from icikit.utils.registry import get_algorithm
+from jax.sharding import PartitionSpec as P
+
+
+def _seq_to_heads(x: jax.Array, axis: str, p: int, algorithm: str):
+    """(b, s, h, d) seq-sharded -> (b, p·s, h/p, d) head-sharded."""
+    if algorithm == "xla":
+        return lax.all_to_all(x, axis, split_axis=2, concat_axis=1,
+                              tiled=True)
+    impl = get_algorithm("alltoall", algorithm)
+    b, s, h, d = x.shape
+    blocks = jnp.moveaxis(x.reshape(b, s, p, h // p, d), 2, 0)
+    out = impl(blocks, axis, p)         # slot j = device j's seq chunk
+    return jnp.moveaxis(out, 0, 1).reshape(b, p * s, h // p, d)
+
+
+def _heads_to_seq(x: jax.Array, axis: str, p: int, algorithm: str):
+    """(b, p·s, h/p, d) head-sharded -> (b, s, h, d) seq-sharded."""
+    if algorithm == "xla":
+        return lax.all_to_all(x, axis, split_axis=1, concat_axis=2,
+                              tiled=True)
+    impl = get_algorithm("alltoall", algorithm)
+    b, big_s, hg, d = x.shape
+    blocks = jnp.moveaxis(x.reshape(b, p, big_s // p, hg, d), 1, 0)
+    out = impl(blocks, axis, p)         # slot j = device j's head group
+    return jnp.moveaxis(out, 0, 2).reshape(b, big_s // p, p * hg, d)
+
+
+def ulysses_attention_shard(q: jax.Array, k: jax.Array, v: jax.Array,
+                            axis: str, p: int, causal: bool,
+                            scale: float | None,
+                            algorithm: str) -> jax.Array:
+    qh = _seq_to_heads(q, axis, p, algorithm)
+    kh = _seq_to_heads(k, axis, p, algorithm)
+    vh = _seq_to_heads(v, axis, p, algorithm)
+    ctx = dense_attention(qh, kh, vh, causal=causal, scale=scale)
+    return _heads_to_seq(ctx, axis, p, algorithm)
+
+
+@lru_cache(maxsize=None)
+def _build(mesh, axis, causal, scale, algorithm):
+    p = mesh.shape[axis]
+    spec = P(None, axis)
+    fn = partial(ulysses_attention_shard, axis=axis, p=p, causal=causal,
+                 scale=scale, algorithm=algorithm)
+    return jax.jit(shard_map(fn, mesh=mesh, in_specs=spec, out_specs=spec))
+
+
+def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh,
+                      axis: str = DEFAULT_AXIS, causal: bool = False,
+                      scale: float | None = None,
+                      algorithm: str = "xla") -> jax.Array:
+    """Sequence-parallel attention via all-to-all head redistribution.
+
+    Args:
+      q, k, v: global arrays ``(batch, S, heads, head_dim)`` sharded
+        along the sequence dim; ``heads`` must divide evenly by p.
+      algorithm: any ``alltoall`` family variant ("xla", "wraparound",
+        "naive", "ecube", "hypercube").
+
+    Returns:
+      ``(batch, S, heads, head_dim)``, sequence-sharded, numerically
+      equal to ``dense_attention(q, k, v, causal)``.
+    """
+    p = mesh.shape[axis]
+    if q.shape[2] % p:
+        raise ValueError(
+            f"head count {q.shape[2]} must divide evenly over {p} devices")
+    if q.shape[1] % p:
+        raise ValueError(
+            f"sequence length {q.shape[1]} must divide evenly over "
+            f"{p} devices")
+    return _build(mesh, axis, bool(causal), scale, algorithm)(q, k, v)

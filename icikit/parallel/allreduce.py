@@ -20,7 +20,6 @@ from jax import lax
 from icikit.parallel.shmap import (
     build_collective,
     register_family,
-    shift_perm,
     xor_perm,
 )
 from icikit.utils.mesh import DEFAULT_AXIS, UnsupportedMeshError, ilog2, is_pow2
@@ -56,36 +55,21 @@ def _ring(x: jax.Array, axis: str, p: int, op: str) -> jax.Array:
     """Ring reduce-scatter followed by ring allgather.
 
     Bandwidth-optimal: 2·m·(p-1)/p per device — the schedule ICI
-    all-reduces actually use, built by hand from ``ppermute``. Inputs
+    all-reduces actually use, composed from the registered schedules
+    (``reducescatter``/``ring`` then ``allgather``/``ring``). Inputs
     whose leading dim is not divisible by p are zero-padded (safe for
     sum/max/min: padded lanes only ever combine with other padded lanes
     and are sliced off).
     """
+    from icikit.parallel.allgather import _ring as _allgather_ring
+    from icikit.parallel.reducescatter import _ring as _reduce_scatter_ring
     m = x.shape[0]
     pad = (-m) % p
     if pad:
         x = jnp.concatenate([x, jnp.zeros((pad,) + x.shape[1:], x.dtype)])
-    combine = _OPS[op][0]
-    csz = (m + pad) // p
-    acc = x.reshape((p, csz) + x.shape[1:])
-    r = lax.axis_index(axis)
-    # Reduce-scatter: after p-1 steps device r owns the full reduction of
-    # chunk (r+1) mod p.
-    for s in range(p - 1):
-        i_send = jnp.mod(r - s, p)
-        i_recv = jnp.mod(r - s - 1, p)
-        blk = lax.dynamic_slice_in_dim(acc, i_send, 1, 0)
-        recv = lax.ppermute(blk, axis, shift_perm(p, 1))
-        mine = lax.dynamic_slice_in_dim(acc, i_recv, 1, 0)
-        acc = lax.dynamic_update_slice_in_dim(acc, combine(mine, recv), i_recv, 0)
-    # All-gather of the completed chunks around the same ring.
-    for s in range(p - 1):
-        i_send = jnp.mod(r + 1 - s, p)
-        i_recv = jnp.mod(r - s, p)
-        blk = lax.dynamic_slice_in_dim(acc, i_send, 1, 0)
-        recv = lax.ppermute(blk, axis, shift_perm(p, 1))
-        acc = lax.dynamic_update_slice_in_dim(acc, recv, i_recv, 0)
-    out = acc.reshape((p * csz,) + x.shape[1:])
+    chunk = _reduce_scatter_ring(x, axis, p, op)       # device r owns chunk r
+    gathered = _allgather_ring(chunk[None], axis, p)   # (p, m'/p, ...) in order
+    out = gathered.reshape((m + pad,) + x.shape[1:])
     return out[:m] if pad else out
 
 

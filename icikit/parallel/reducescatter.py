@@ -50,9 +50,10 @@ def _ring(x: jax.Array, axis: str, p: int, op: str) -> jax.Array:
     """p-1 neighbor steps, one m/p chunk per step — bandwidth-optimal
     (tw·m·(p-1)/p), the schedule of the first half of a ring allreduce.
 
-    Step s: device r sends its partial of chunk (r-s) mod p to r+1 and
-    combines the incoming partial into chunk (r-s-1) mod p; after p-1
-    steps device r holds the full reduction of chunk r.
+    Step s (0-based): device r sends its partial of chunk (r-1-s) mod p
+    to r+1 and folds the incoming partial (its neighbor's view of chunk
+    (r-2-s) mod p) into its own copy; after p-1 steps device r holds the
+    full reduction of chunk r.
     """
     combine = _OPS[op][0]
     acc = _chunked(x, p)
@@ -155,4 +156,9 @@ def reduce_scatter(x: jax.Array, mesh, axis: str = DEFAULT_AXIS,
       Array of shape ``(p, m/p, ...)`` sharded along dim 0: ``out[d]`` is
       chunk d of the elementwise reduction over all contributions.
     """
+    p = mesh.shape[axis]
+    if x.ndim < 2 or x.shape[1] % p:
+        raise ValueError(
+            f"reduce_scatter needs m divisible by p "
+            f"(shape {x.shape}, p={p})")
     return build_collective("reducescatter", algorithm, mesh, axis, (op,))(x)

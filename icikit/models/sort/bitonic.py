@@ -63,7 +63,8 @@ def _build(mesh, axis):
     p = mesh.shape[axis]
     return jax.jit(shard_map(
         lambda b: bitonic_sort_shard(b[0], axis, p)[None],
-        mesh=mesh, in_specs=P(axis), out_specs=P(axis)))
+        mesh=mesh, in_specs=P(axis), out_specs=P(axis),
+        check_vma=False))
 
 
 def bitonic_sort_blocks(x2d: jax.Array, mesh, axis: str = DEFAULT_AXIS):

@@ -121,7 +121,8 @@ def _build(mesh, axis, cap):
         return out[None], overflow[None]
 
     return jax.jit(shard_map(per_shard, mesh=mesh, in_specs=P(axis),
-                             out_specs=(P(axis), P(axis))))
+                             out_specs=(P(axis), P(axis)),
+                             check_vma=False))
 
 
 def hypercube_quicksort_blocks(x2d: jax.Array, mesh,

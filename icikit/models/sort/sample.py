@@ -108,7 +108,8 @@ def _build(mesh, axis, cap, splitter):
         return out[None], overflow[None]
 
     return jax.jit(shard_map(per_shard, mesh=mesh, in_specs=P(axis),
-                             out_specs=(P(axis), P(axis))))
+                             out_specs=(P(axis), P(axis)),
+                             check_vma=False))
 
 
 def sample_sort_blocks(x2d: jax.Array, mesh, axis: str = DEFAULT_AXIS,

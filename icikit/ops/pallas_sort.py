@@ -169,13 +169,17 @@ def _cross_call(x, span: int, tile: int, lo_bit: int, hi_bit: int, *,
     """Cross-tile stages of one round whose Q-axis bit sits in
     [lo_bit, hi_bit], in one pass.
 
-    View the array as (n/span, A, G, B, tile) with Q = span/tile =
+    View the array as (n/span, A, G, B·tile) with Q = span/tile =
     A*G*B, G = 2^(hi-lo+1) covering the target bits, B = 2^lo_bit the
     bits below. A stage of stride 2^j (j-log2(tile) in [lo,hi]) is a
     min/max along the matching bit of the G axis. Everything else is
     independent, so (n/span, A, B, columns) fold into the grid; the
-    VMEM block is G * cb elements. The round's direction bit
-    (log2(span)) is the span-index parity.
+    VMEM block is (G, cb) — the B/column position selects a cb-wide
+    slice of the fused trailing axis (cb divides tile, so a block never
+    straddles a B boundary; keeping G as a full middle axis also
+    satisfies Mosaic's block-shape divisibility rule, which a
+    (..., 1, cb) block over a B-sized axis would not). The round's
+    direction bit (log2(span)) is the span-index parity.
     """
     n = x.shape[0]
     q = span // tile
@@ -192,7 +196,7 @@ def _cross_call(x, span: int, tile: int, lo_bit: int, hi_bit: int, *,
             asc = True
         else:
             asc = ((pl.program_id(0) // fold) & 1) == 0
-        v = x_ref[0, 0, :, 0, :]  # (G, cb)
+        v = x_ref[0, 0, :, :]  # (G, cb)
         for d in dists:
             y = v.reshape(g // (2 * d), 2, d, cb)
             p, r = y[:, 0], y[:, 1]
@@ -200,25 +204,25 @@ def _cross_call(x, span: int, tile: int, lo_bit: int, hi_bit: int, *,
             first = jnp.where(asc, lo, hi)
             second = jnp.where(asc, hi, lo)
             v = jnp.stack([first, second], axis=1).reshape(g, cb)
-        o_ref[0, 0, :, 0, :] = v
+        o_ref[0, 0, :, :] = v
 
     def idx(f, c):
         blk = f // fold
         a = (f // b_lo) % a_hi
         bb = f % b_lo
-        return (blk, a, 0, bb, c)
+        return (blk, a, 0, bb * (tile // cb) + c)
 
-    x5 = x.reshape(nb, a_hi, g, b_lo, tile)
+    x4 = x.reshape(nb, a_hi, g, b_lo * tile)
     out = pl.pallas_call(
         kernel,
         grid=(nb * fold, tile // cb),
-        in_specs=[pl.BlockSpec((1, 1, g, 1, cb), idx,
+        in_specs=[pl.BlockSpec((1, 1, g, cb), idx,
                                memory_space=pltpu.VMEM)],
-        out_specs=pl.BlockSpec((1, 1, g, 1, cb), idx,
+        out_specs=pl.BlockSpec((1, 1, g, cb), idx,
                                memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct(x5.shape, x5.dtype),
+        out_shape=jax.ShapeDtypeStruct(x4.shape, x4.dtype),
         interpret=interpret,
-    )(x5)
+    )(x4)
     return out.reshape(n)
 
 

@@ -21,7 +21,24 @@ except ImportError:  # pragma: no cover - older jax
 
 from icikit.utils.registry import get_algorithm
 
-shard_map = _shard_map
+
+def shard_map(f, *, check_vma: bool = True, **kw):
+    """``jax.shard_map``, with an opt-out for varying-manual-axes
+    checking. Bodies containing ``pallas_call``s must pass
+    ``check_vma=False``: Pallas output avals carry no vma information,
+    which newer jax rejects under the (default-on) check. Pure
+    ppermute/psum schedules keep the check — it is exactly the
+    replication-consistency validation this library wants."""
+    if check_vma:
+        return _shard_map(f, **kw)
+    try:
+        return _shard_map(f, check_vma=False, **kw)
+    except TypeError:
+        pass
+    try:  # pre-0.6 jax spells the flag check_rep
+        return _shard_map(f, check_rep=False, **kw)
+    except TypeError:
+        return _shard_map(f, **kw)
 
 # family -> (input_kind, adapter); adapter(impl, axis, p, *extra) returns the
 # per-shard function. input_kind "sharded" = block-sharded along the axis,

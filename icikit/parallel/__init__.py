@@ -29,6 +29,12 @@ from icikit.parallel.collops import (  # noqa: F401
     gather_blocks,
     scatter_blocks,
 )
+from icikit.parallel.multihost import (  # noqa: F401
+    hierarchical_all_reduce,
+    init_distributed,
+    make_hybrid_mesh,
+    process_info,
+)
 from icikit.parallel.reducescatter import (  # noqa: F401
     REDUCESCATTER_ALGORITHMS,
     reduce_scatter,

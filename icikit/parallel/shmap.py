@@ -50,6 +50,15 @@ def register_family(family: str, input_kind: str, adapter: Callable) -> None:
     _FAMILIES[family] = (input_kind, adapter)
 
 
+def wrap_program(per_shard, mesh, in_specs, out_specs, *,
+                 check_vma: bool = True):
+    """The single jit + shard_map wrapping every collective program uses
+    (1-axis families below, composite multi-axis programs elsewhere).
+    Callers own their caching — ``per_shard`` closures aren't hashable."""
+    return jax.jit(shard_map(per_shard, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=check_vma))
+
+
 @lru_cache(maxsize=None)
 def build_collective(family: str, algorithm: str, mesh, axis: str,
                      extra: tuple = ()):
@@ -59,8 +68,7 @@ def build_collective(family: str, algorithm: str, mesh, axis: str,
     p = mesh.shape[axis]
     per_shard = adapter(impl, axis, p, *extra)
     in_specs = P(axis) if input_kind == "sharded" else P()
-    return jax.jit(shard_map(per_shard, mesh=mesh, in_specs=in_specs,
-                             out_specs=P(axis)))
+    return wrap_program(per_shard, mesh, in_specs, P(axis))
 
 
 def xor_perm(p: int, mask: int):

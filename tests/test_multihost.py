@@ -1,0 +1,98 @@
+"""Hybrid-mesh (ICI x DCN) and hierarchical-collective oracle tests.
+
+The 8 simulated CPU devices stand in for (dcn_size x ici_size) hybrid
+topologies, exercising the multi-host schedules without a pod —
+SURVEY.md §4.6's "multi-node without a cluster" capability applied to
+the two-tier fabric.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from icikit.parallel.multihost import (
+    hierarchical_all_reduce,
+    init_distributed,
+    make_hybrid_mesh,
+    process_info,
+)
+from icikit.utils.mesh import shard_along
+
+
+def _hybrid_data(mesh, m, seed=0):
+    p = mesh.devices.size
+    rng = np.random.default_rng(seed)
+    data = rng.integers(-100, 100, size=(p, m)).astype(np.int32)
+    x = shard_along(jnp.asarray(data), mesh, axis_name=("dcn", "p"))
+    return data, x
+
+
+def test_make_hybrid_mesh_shapes():
+    mesh = make_hybrid_mesh(dcn_size=2)
+    assert mesh.shape == {"dcn": 2, "p": 4}
+    mesh = make_hybrid_mesh(dcn_size=4, ici_size=2)
+    assert mesh.shape == {"dcn": 4, "p": 2}
+    mesh = make_hybrid_mesh()  # single process: dcn collapses to 1
+    assert mesh.shape["dcn"] == 1
+
+
+def test_make_hybrid_mesh_validates():
+    with pytest.raises(ValueError):
+        make_hybrid_mesh(dcn_size=3)  # 8 % 3 != 0
+    with pytest.raises(ValueError):
+        make_hybrid_mesh(dcn_size=4, ici_size=4)  # 16 > 8 devices
+
+
+@pytest.mark.parametrize("dcn,ici", [(2, 4), (4, 2), (2, 2), (1, 8)])
+@pytest.mark.parametrize("ici_algorithm", ["ring", "recursive_doubling",
+                                           "xla"])
+def test_hierarchical_allreduce_sum(dcn, ici, ici_algorithm):
+    mesh = make_hybrid_mesh(dcn_size=dcn, ici_size=ici)
+    m = 4 * ici  # divisible by p_ici
+    data, x = _hybrid_data(mesh, m)
+    out = np.asarray(hierarchical_all_reduce(
+        x, mesh, ici_algorithm=ici_algorithm))
+    expected = data.sum(axis=0)
+    for d in range(dcn * ici):
+        np.testing.assert_array_equal(out[d], expected)
+
+
+@pytest.mark.parametrize("dcn_algorithm", ["ring", "recursive_doubling",
+                                           "xla"])
+def test_hierarchical_allreduce_dcn_algorithms(dcn_algorithm):
+    mesh = make_hybrid_mesh(dcn_size=2, ici_size=4)
+    data, x = _hybrid_data(mesh, 16, seed=1)
+    out = np.asarray(hierarchical_all_reduce(
+        x, mesh, dcn_algorithm=dcn_algorithm))
+    expected = data.sum(axis=0)
+    for d in range(8):
+        np.testing.assert_array_equal(out[d], expected)
+
+
+@pytest.mark.parametrize("op,npop", [("max", np.max), ("min", np.min)])
+def test_hierarchical_allreduce_minmax(op, npop):
+    mesh = make_hybrid_mesh(dcn_size=2, ici_size=4)
+    data, x = _hybrid_data(mesh, 8, seed=2)
+    out = np.asarray(hierarchical_all_reduce(x, mesh, op=op))
+    expected = npop(data, axis=0)
+    for d in range(8):
+        np.testing.assert_array_equal(out[d], expected)
+
+
+def test_hierarchical_allreduce_rejects_indivisible():
+    mesh = make_hybrid_mesh(dcn_size=2, ici_size=4)
+    data, x = _hybrid_data(mesh, 8)
+    with pytest.raises(ValueError):
+        hierarchical_all_reduce(x[:, :6], mesh)  # 6 % 4 != 0
+
+
+def test_init_distributed_noop_single_process(monkeypatch):
+    for v in ("COORDINATOR_ADDRESS", "JAX_COORDINATOR_ADDRESS",
+              "MEGASCALE_COORDINATOR_ADDRESS"):
+        monkeypatch.delenv(v, raising=False)
+    assert init_distributed() is False  # no cluster detectable: no-op
+
+
+def test_process_info_single_process():
+    idx, count, local = process_info()
+    assert idx == 0 and count == 1 and local >= 8

@@ -1,0 +1,29 @@
+"""Sharded-training-step transformer — the framework's flagship model.
+
+The reference contains no ML models; what it contains is the *comm
+fabric* models are built from (SURVEY.md §2 "parallelism-strategy
+inventory"): SPMD block decomposition (→ data parallelism), the ring
+pass-through schedule (→ sequence-parallel ring attention), all-to-all
+personalized (→ Ulysses re-shard), and hypercube reductions (→ tensor-
+parallel psums). This package closes the loop: a decoder transformer
+whose training step runs those strategies together on one 3-D mesh —
+
+- ``dp``: batch-sharded data parallelism with gradient psums,
+- ``tp``: Megatron-style tensor parallelism (column→row parallel
+  matmuls; one psum per attention/MLP block),
+- ``sp``: sequence parallelism carried by the library's own ring
+  attention (``icikit.models.attention.ring``).
+
+Everything is fully-manual SPMD inside one ``shard_map`` (the
+framework's idiom), bf16 matmuls on the MXU with fp32 master params,
+and ``lax.scan`` over stacked layer params so the program is compiled
+once regardless of depth.
+"""
+
+from icikit.models.transformer.model import (  # noqa: F401
+    TransformerConfig,
+    init_params,
+    loss_fn,
+    make_train_step,
+    param_specs,
+)

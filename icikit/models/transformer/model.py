@@ -1,0 +1,206 @@
+"""Decoder transformer with dp x tp x sp manual-SPMD training step.
+
+Layout (mesh axes ``dp``, ``tp``, ``sp``):
+
+- tokens/targets ``(B, S)``: batch over ``dp``, sequence over ``sp``;
+- attention weights: head dimension over ``tp`` (column-parallel QKV,
+  row-parallel output projection closed by one ``psum`` over ``tp``);
+- MLP weights: hidden dimension over ``tp`` (same column→row pattern);
+- embeddings / norms / output head: replicated (vocabularies here are
+  small; a vocab-parallel head would follow the same column→row rule);
+- attention over the sequence: the library's ring schedule
+  (``icikit.models.attention.ring.ring_attention_shard``) on the ``sp``
+  axis — the reference's ring all-to-all
+  (``Communication/src/main.cc:190-223``) carrying K/V blocks.
+
+Gradients: each leaf is complete on its ``tp`` shard by construction;
+replicated leaves additionally need a ``psum`` over ``tp`` (their use
+sites are tp-replicated, their cotangents are not). All leaves psum
+over ``dp`` and ``sp``. Matmuls run in bf16 (MXU-native), master
+params and the softmax/loss in fp32.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import lru_cache
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from icikit.models.attention.ring import ring_attention_shard
+from icikit.parallel.shmap import wrap_program
+
+DP_AXIS, TP_AXIS, SP_AXIS = "dp", "tp", "sp"
+
+
+@dataclass(frozen=True)
+class TransformerConfig:
+    vocab: int = 256
+    d_model: int = 128
+    n_heads: int = 4
+    d_head: int = 32
+    d_ff: int = 512
+    n_layers: int = 2
+    max_seq: int = 128
+    compute_dtype: str = "bfloat16"
+
+
+def make_model_mesh(n_devices: int | None = None, dp: int = 1, tp: int = 1,
+                    sp: int = 1, devices=None) -> Mesh:
+    """3-D (dp, tp, sp) mesh. tp innermost so tensor-parallel psums —
+    the highest-frequency collective (two per layer) — ride the
+    shortest ICI hops; sp next (p-1 ppermutes per attention); dp
+    outermost (one gradient psum per step, the natural DCN axis)."""
+    if devices is None:
+        devices = jax.devices()
+    n = dp * tp * sp
+    if n_devices is not None and n != n_devices:
+        raise ValueError(f"dp*tp*sp = {n} != n_devices = {n_devices}")
+    if n > len(devices):
+        raise ValueError(f"need {n} devices, have {len(devices)}")
+    arr = np.asarray(devices[:n]).reshape(dp, sp, tp).transpose(0, 2, 1)
+    return Mesh(arr, (DP_AXIS, TP_AXIS, SP_AXIS))
+
+
+def param_specs(cfg: TransformerConfig) -> dict:
+    """PartitionSpec per parameter leaf (layer-stacked on dim 0)."""
+    return {
+        "emb": P(),
+        "pos": P(),
+        "ln1": P(), "ln2": P(), "ln_f": P(),
+        "wqkv": P(None, None, None, TP_AXIS, None),  # (L, D, 3, H, Dh)
+        "wo": P(None, TP_AXIS, None, None),          # (L, H, Dh, D)
+        "w1": P(None, None, TP_AXIS),                # (L, D, F)
+        "w2": P(None, TP_AXIS, None),                # (L, F, D)
+        "w_out": P(),                                # (D, V)
+    }
+
+
+def init_params(key, cfg: TransformerConfig, mesh: Mesh) -> dict:
+    """fp32 master params, placed with their mesh shardings."""
+    L, D, H, Dh, F = (cfg.n_layers, cfg.d_model, cfg.n_heads, cfg.d_head,
+                      cfg.d_ff)
+    ks = jax.random.split(key, 7)
+
+    def norm(k, shape, fan_in):
+        return (jax.random.normal(k, shape, jnp.float32)
+                * (1.0 / np.sqrt(fan_in)))
+
+    params = {
+        "emb": norm(ks[0], (cfg.vocab, D), D),
+        "pos": norm(ks[1], (cfg.max_seq, D), D),
+        "ln1": jnp.ones((L, D), jnp.float32),
+        "ln2": jnp.ones((L, D), jnp.float32),
+        "ln_f": jnp.ones((D,), jnp.float32),
+        "wqkv": norm(ks[2], (L, D, 3, H, Dh), D),
+        "wo": norm(ks[3], (L, H, Dh, D), H * Dh),
+        "w1": norm(ks[4], (L, D, F), D),
+        "w2": norm(ks[5], (L, F, D), F),
+        "w_out": norm(ks[6], (D, cfg.vocab), D),
+    }
+    specs = param_specs(cfg)
+    return {k: jax.device_put(v, NamedSharding(mesh, specs[k]))
+            for k, v in params.items()}
+
+
+def _rms_norm(x, g):
+    x32 = x.astype(jnp.float32)
+    r = lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + 1e-6)
+    return (x32 * r) * g
+
+
+def _forward_local(params, tokens, cfg: TransformerConfig, p_sp: int):
+    """Per-shard forward: tokens (b_loc, s_loc) -> logits fp32.
+
+    Activations are replicated over tp (every psum over tp closes a
+    column->row parallel pair), batch-local over dp, sequence-local
+    over sp.
+    """
+    cdt = jnp.dtype(cfg.compute_dtype)
+    b, s = tokens.shape
+    r_sp = lax.axis_index(SP_AXIS)
+    pos = lax.dynamic_slice_in_dim(params["pos"], r_sp * s, s, 0)
+    x = params["emb"][tokens] + pos  # (b, s, D) fp32
+
+    def layer(x, lp):
+        h = _rms_norm(x, lp["ln1"]).astype(cdt)
+        qkv = jnp.einsum("bsd,dthe->bsthe", h, lp["wqkv"].astype(cdt))
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        attn = ring_attention_shard(q, k, v, SP_AXIS, p_sp, causal=True,
+                                    scale=None)
+        o = jnp.einsum("bshe,hed->bsd", attn.astype(cdt),
+                       lp["wo"].astype(cdt))
+        x = x + lax.psum(o.astype(jnp.float32), TP_AXIS)
+        h2 = _rms_norm(x, lp["ln2"]).astype(cdt)
+        u = jax.nn.gelu(jnp.einsum("bsd,df->bsf", h2, lp["w1"].astype(cdt)))
+        m = jnp.einsum("bsf,fd->bsd", u, lp["w2"].astype(cdt))
+        x = x + lax.psum(m.astype(jnp.float32), TP_AXIS)
+        return x, None
+
+    layer_params = {k: params[k] for k in
+                    ("ln1", "ln2", "wqkv", "wo", "w1", "w2")}
+    x, _ = lax.scan(layer, x, layer_params)
+    x = _rms_norm(x, params["ln_f"])
+    return jnp.einsum("bsd,dv->bsv", x.astype(cdt),
+                      params["w_out"].astype(cdt)).astype(jnp.float32)
+
+
+def _local_loss(params, tokens, targets, cfg, p_sp, denom):
+    logits = _forward_local(params, tokens, cfg, p_sp)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)
+    return nll.sum() / denom
+
+
+@lru_cache(maxsize=None)
+def _build_loss_and_grad(mesh, cfg: TransformerConfig, batch_shape):
+    p_sp = mesh.shape[SP_AXIS]
+    p_dp = mesh.shape[DP_AXIS]
+    denom = batch_shape[0] * batch_shape[1] * p_dp * p_sp  # global tokens
+    specs = param_specs(cfg)
+    data_spec = P(DP_AXIS, SP_AXIS)
+
+    def per_shard(params, tokens, targets):
+        loss, grads = jax.value_and_grad(_local_loss)(
+            params, tokens, targets, cfg, p_sp, denom)
+        # No explicit gradient psums: each param enters replicated over
+        # the axes its spec doesn't name, the auto-inserted pvary's
+        # transpose IS the cross-shard psum, so ``grads`` leaves are
+        # already fully reduced (and carry their params' replication).
+        return lax.psum(loss, (DP_AXIS, SP_AXIS)), grads
+
+    return wrap_program(
+        per_shard, mesh,
+        in_specs=(specs, data_spec, data_spec),
+        out_specs=(P(), specs))
+
+
+def loss_fn(params, tokens, targets, mesh, cfg: TransformerConfig):
+    """Global mean token cross-entropy and the full gradient pytree.
+
+    ``tokens``/``targets``: int32 ``(B, S)`` sharded ``P(dp, sp)``.
+    """
+    local = (tokens.shape[0] // mesh.shape[DP_AXIS],
+             tokens.shape[1] // mesh.shape[SP_AXIS])
+    return _build_loss_and_grad(mesh, cfg, local)(params, tokens, targets)
+
+
+def make_train_step(mesh, cfg: TransformerConfig, optimizer=None):
+    """Jitted full training step: (params, opt_state, tokens, targets)
+    -> (params, opt_state, loss). ``optimizer`` is any optax
+    GradientTransformation (default: adam(3e-4))."""
+    import optax
+    if optimizer is None:
+        optimizer = optax.adam(3e-4)
+
+    @jax.jit
+    def step(params, opt_state, tokens, targets):
+        loss, grads = loss_fn(params, tokens, targets, mesh, cfg)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    return optimizer, step

@@ -49,15 +49,18 @@ LANES = 128
 
 # Default tile geometry (elements, power of 2). T_GRID is the VMEM tile
 # for gridded passes; T_BIG is the largest single-tile kernel we allow —
-# rounds whose whole span fits run in one pass. Both are deliberately
-# modest: Mosaic compile time grows superlinearly with the number of
-# fused stages per kernel (measured: 91 stages 1.5 s, 120 stages 11 s,
-# 153 stages 269 s), so tiles are sized to keep every kernel under
-# ~100 stages. G_MAX bounds how many Q-axis bits one cross pass covers
-# (VMEM block is 2^g * cb elements).
-T_GRID = 1 << 13
-T_BIG = 1 << 16
-G_MAX = 10
+# rounds whose whole span fits run in one pass. Mosaic compile time
+# grows superlinearly with the number of fused stages per kernel
+# (measured: 91 stages 1.5 s, 120 stages 11 s, 153 stages 269 s), and
+# throughput grows with tile size (v5e, 2^27 int32 keys: t_grid 2^13 ->
+# 362 M keys/s, 2^14 -> 460 M, 2^15 -> 514 M, 2^16 -> 525 M but ~60 s
+# compile), so the defaults take the knee of that curve: 120-stage
+# phase-1 kernels (~11 s compile, amortized by the lru_cache). G_MAX
+# bounds how many Q-axis bits one cross pass covers (VMEM block is
+# 2^g * cb elements); 12 and t_big 2^18 overflow the v5e compiler.
+T_GRID = 1 << 15
+T_BIG = 1 << 17
+G_MAX = 11
 
 # Below this size the fixed overhead of a pallas_call loses to jnp.sort.
 MIN_PALLAS = 1 << 13
@@ -257,7 +260,14 @@ def _build_sort(n: int, dtype_name: str, t_grid: int, t_big: int,
 
     def run(x):
         x2d = x.reshape(n // LANES, LANES)
-        if n <= t_big:
+        # Single-tile full-sort only up to t_grid: the full network has
+        # log2n*(log2n+1)/2 stages, and past ~120 stages Mosaic compile
+        # time explodes (see the tile-geometry comment above). Larger n
+        # always takes the phased path, whose per-kernel stage counts
+        # stay at phase-1's _sort_stages(log2 t_grid) or a round's
+        # <= log2n. t_big only bounds the *span* a merge round may run
+        # as one cheap gridded kernel.
+        if n <= t_grid:
             return _net_call(x2d, n, _sort_stages(log2n),
                              interpret=interpret).reshape(n)
         # Phase 1: sort each t_grid tile (rounds 0..log2(t_grid)-1),

@@ -12,7 +12,12 @@ whose training step runs those strategies together on one 3-D mesh —
 - ``tp``: Megatron-style tensor parallelism (column→row parallel
   matmuls; one psum per attention/MLP block),
 - ``sp``: sequence parallelism carried by the library's own ring
-  attention (``icikit.models.attention.ring``).
+  attention (``icikit.models.attention.ring``),
+- ``ep``: expert parallelism — a Switch MoE whose token dispatch rides
+  the all-to-all family over the dp axis (``moe.py``),
+- ``pp``: GPipe-style pipeline parallelism — microbatches flowing
+  through layer-sharded stages on a ``ppermute`` chain whose autodiff
+  transpose is the backward pipeline (``pipeline.py``).
 
 Everything is fully-manual SPMD inside one ``shard_map`` (the
 framework's idiom), bf16 matmuls on the MXU with fp32 master params,
@@ -26,4 +31,12 @@ from icikit.models.transformer.model import (  # noqa: F401
     loss_fn,
     make_train_step,
     param_specs,
+)
+from icikit.models.transformer.moe import moe_ffn_shard  # noqa: F401
+from icikit.models.transformer.pipeline import (  # noqa: F401
+    init_pp_params,
+    make_pp_mesh,
+    make_pp_train_step,
+    pp_loss_fn,
+    pp_param_specs,
 )

@@ -32,6 +32,7 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from icikit.models.attention.ring import ring_attention_shard
+from icikit.models.transformer.moe import moe_ffn_shard
 from icikit.parallel.shmap import wrap_program
 
 DP_AXIS, TP_AXIS, SP_AXIS = "dp", "tp", "sp"
@@ -47,6 +48,13 @@ class TransformerConfig:
     n_layers: int = 2
     max_seq: int = 128
     compute_dtype: str = "bfloat16"
+    # Mixture-of-experts: n_experts > 0 replaces the dense FFN with a
+    # Switch MoE whose experts are sharded over the dp axis (expert
+    # parallelism; dispatch = the all-to-all family, see moe.py).
+    n_experts: int = 0
+    capacity_factor: float = 1.25
+    moe_aux_coef: float = 0.01
+    moe_algorithm: str = "xla"
 
 
 def make_model_mesh(n_devices: int | None = None, dp: int = 1, tp: int = 1,
@@ -68,16 +76,26 @@ def make_model_mesh(n_devices: int | None = None, dp: int = 1, tp: int = 1,
 
 def param_specs(cfg: TransformerConfig) -> dict:
     """PartitionSpec per parameter leaf (layer-stacked on dim 0)."""
-    return {
+    specs = {
         "emb": P(),
         "pos": P(),
         "ln1": P(), "ln2": P(), "ln_f": P(),
         "wqkv": P(None, None, None, TP_AXIS, None),  # (L, D, 3, H, Dh)
         "wo": P(None, TP_AXIS, None, None),          # (L, H, Dh, D)
-        "w1": P(None, None, TP_AXIS),                # (L, D, F)
-        "w2": P(None, TP_AXIS, None),                # (L, F, D)
         "w_out": P(),                                # (D, V)
     }
+    if cfg.n_experts:
+        specs.update({
+            "wr": P(),                                # (L, D, E)
+            "we1": P(None, DP_AXIS, None, None),      # (L, E, D, F)
+            "we2": P(None, DP_AXIS, None, None),      # (L, E, F, D)
+        })
+    else:
+        specs.update({
+            "w1": P(None, None, TP_AXIS),             # (L, D, F)
+            "w2": P(None, TP_AXIS, None),             # (L, F, D)
+        })
+    return specs
 
 
 def init_params(key, cfg: TransformerConfig, mesh: Mesh) -> dict:
@@ -98,10 +116,17 @@ def init_params(key, cfg: TransformerConfig, mesh: Mesh) -> dict:
         "ln_f": jnp.ones((D,), jnp.float32),
         "wqkv": norm(ks[2], (L, D, 3, H, Dh), D),
         "wo": norm(ks[3], (L, H, Dh, D), H * Dh),
-        "w1": norm(ks[4], (L, D, F), D),
-        "w2": norm(ks[5], (L, F, D), F),
         "w_out": norm(ks[6], (D, cfg.vocab), D),
     }
+    if cfg.n_experts:
+        E = cfg.n_experts
+        ke = jax.random.split(ks[4], 2)
+        params["wr"] = norm(ks[5], (L, D, E), D)
+        params["we1"] = norm(ke[0], (L, E, D, F), D)
+        params["we2"] = norm(ke[1], (L, E, F, D), F)
+    else:
+        params["w1"] = norm(ks[4], (L, D, F), D)
+        params["w2"] = norm(ks[5], (L, F, D), F)
     specs = param_specs(cfg)
     return {k: jax.device_put(v, NamedSharding(mesh, specs[k]))
             for k, v in params.items()}
@@ -113,8 +138,32 @@ def _rms_norm(x, g):
     return (x32 * r) * g
 
 
-def _forward_local(params, tokens, cfg: TransformerConfig, p_sp: int):
-    """Per-shard forward: tokens (b_loc, s_loc) -> logits fp32.
+def _attn_block(x, lp, cdt, attention, reduce_out):
+    """Pre-norm attention sublayer, shared by the sp and pp paths.
+
+    ``attention(q, k, v) -> (b, s, h, d)`` supplies the schedule (ring
+    over sp, dense within a pipeline stage); ``reduce_out`` closes the
+    column->row tensor-parallel pair (identity when not tp-sharded).
+    """
+    h = _rms_norm(x, lp["ln1"]).astype(cdt)
+    qkv = jnp.einsum("bsd,dthe->bsthe", h, lp["wqkv"].astype(cdt))
+    attn = attention(qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2])
+    o = jnp.einsum("bshe,hed->bsd", attn.astype(cdt), lp["wo"].astype(cdt))
+    return x + reduce_out(o.astype(jnp.float32))
+
+
+def _dense_ffn_block(x, lp, cdt, reduce_out):
+    """Pre-norm dense-MLP sublayer, shared by the sp and pp paths."""
+    h2 = _rms_norm(x, lp["ln2"]).astype(cdt)
+    u = jax.nn.gelu(jnp.einsum("bsd,df->bsf", h2, lp["w1"].astype(cdt)))
+    m = jnp.einsum("bsf,fd->bsd", u, lp["w2"].astype(cdt))
+    return x + reduce_out(m.astype(jnp.float32))
+
+
+def _forward_local(params, tokens, cfg: TransformerConfig, p_sp: int,
+                   p_dp: int):
+    """Per-shard forward: tokens (b_loc, s_loc) -> (logits fp32,
+    summed MoE aux loss).
 
     Activations are replicated over tp (every psum over tp closes a
     column->row parallel pair), batch-local over dp, sequence-local
@@ -126,34 +175,49 @@ def _forward_local(params, tokens, cfg: TransformerConfig, p_sp: int):
     pos = lax.dynamic_slice_in_dim(params["pos"], r_sp * s, s, 0)
     x = params["emb"][tokens] + pos  # (b, s, D) fp32
 
-    def layer(x, lp):
-        h = _rms_norm(x, lp["ln1"]).astype(cdt)
-        qkv = jnp.einsum("bsd,dthe->bsthe", h, lp["wqkv"].astype(cdt))
-        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
-        attn = ring_attention_shard(q, k, v, SP_AXIS, p_sp, causal=True,
+    def psum_tp(v):
+        return lax.psum(v, TP_AXIS)
+
+    def attention(q, k, v):
+        return ring_attention_shard(q, k, v, SP_AXIS, p_sp, causal=True,
                                     scale=None)
-        o = jnp.einsum("bshe,hed->bsd", attn.astype(cdt),
-                       lp["wo"].astype(cdt))
-        x = x + lax.psum(o.astype(jnp.float32), TP_AXIS)
-        h2 = _rms_norm(x, lp["ln2"]).astype(cdt)
-        u = jax.nn.gelu(jnp.einsum("bsd,df->bsf", h2, lp["w1"].astype(cdt)))
-        m = jnp.einsum("bsf,fd->bsd", u, lp["w2"].astype(cdt))
-        x = x + lax.psum(m.astype(jnp.float32), TP_AXIS)
-        return x, None
 
-    layer_params = {k: params[k] for k in
-                    ("ln1", "ln2", "wqkv", "wo", "w1", "w2")}
-    x, _ = lax.scan(layer, x, layer_params)
+    def layer(x, lp):
+        x = _attn_block(x, lp, cdt, attention, psum_tp)
+        if cfg.n_experts:
+            # Expert-parallel FFN over the dp axis; output is already
+            # tp-replicated (inputs and expert params are), no psum.
+            h2 = _rms_norm(x, lp["ln2"]).astype(cdt)
+            m, aux = moe_ffn_shard(
+                h2, lp["wr"].astype(cdt), lp["we1"].astype(cdt),
+                lp["we2"].astype(cdt), axis=DP_AXIS, p=p_dp,
+                n_experts=cfg.n_experts,
+                capacity_factor=cfg.capacity_factor,
+                algorithm=cfg.moe_algorithm)
+            x = x + m.astype(jnp.float32)
+        else:
+            x = _dense_ffn_block(x, lp, cdt, psum_tp)
+            aux = jnp.zeros((), jnp.float32)
+        return x, aux
+
+    layer_keys = (("ln1", "ln2", "wqkv", "wo", "wr", "we1", "we2")
+                  if cfg.n_experts else
+                  ("ln1", "ln2", "wqkv", "wo", "w1", "w2"))
+    layer_params = {k: params[k] for k in layer_keys}
+    x, auxes = lax.scan(layer, x, layer_params)
     x = _rms_norm(x, params["ln_f"])
-    return jnp.einsum("bsd,dv->bsv", x.astype(cdt),
-                      params["w_out"].astype(cdt)).astype(jnp.float32)
+    logits = jnp.einsum("bsd,dv->bsv", x.astype(cdt),
+                        params["w_out"].astype(cdt)).astype(jnp.float32)
+    return logits, auxes.sum()
 
 
-def _local_loss(params, tokens, targets, cfg, p_sp, denom):
-    logits = _forward_local(params, tokens, cfg, p_sp)
+def _local_loss(params, tokens, targets, cfg, p_sp, p_dp, denom):
+    logits, aux = _forward_local(params, tokens, cfg, p_sp, p_dp)
     logp = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)
-    return nll.sum() / denom
+    # aux is a per-shard mean-style penalty; dividing by the number of
+    # dp x sp shards makes the final psum over (dp, sp) an average.
+    return nll.sum() / denom + cfg.moe_aux_coef * aux / (p_dp * p_sp)
 
 
 @lru_cache(maxsize=None)
@@ -166,7 +230,7 @@ def _build_loss_and_grad(mesh, cfg: TransformerConfig, batch_shape):
 
     def per_shard(params, tokens, targets):
         loss, grads = jax.value_and_grad(_local_loss)(
-            params, tokens, targets, cfg, p_sp, denom)
+            params, tokens, targets, cfg, p_sp, p_dp, denom)
         # No explicit gradient psums: each param enters replicated over
         # the axes its spec doesn't name, the auto-inserted pvary's
         # transpose IS the cross-shard psum, so ``grads`` leaves are

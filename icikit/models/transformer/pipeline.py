@@ -1,0 +1,179 @@
+"""Pipeline parallelism: GPipe-style microbatch schedule on a pp axis.
+
+The reference's closest ancestor is the ring pass-through schedule
+(``Communication/src/main.cc:190-223``): a chain of devices each
+transforming what arrived and forwarding it right. Here the payload is
+a microbatch's activations, the transform is a stage's slice of the
+layer stack, and the reverse (backward) pipeline is not hand-written at
+all — it is the autodiff transpose of the forward ``ppermute`` chain,
+the same mechanism that turns the library's collectives into their
+duals.
+
+Layout: layer-stacked parameters shard over ``pp`` on their layer
+dimension (stage r owns layers [r·L/p, (r+1)·L/p)); embeddings and the
+head are replicated — every stage traces the embed/unembed code but a
+stage mask selects the real contribution, so their gradients flow only
+from the stages that actually use them. Tokens/targets arrive as
+(M, B, S) microbatches, batch-sharded over ``dp``. The schedule runs
+M + p − 1 unrolled steps; bubble fraction (p−1)/(M+p−1), the GPipe
+trade the caller tunes with ``n_microbatches``.
+
+Attention inside a stage is dense causal (sequence parallelism belongs
+to the sp path in ``model.py``; composing pp x sp is out of scope —
+mesh axes here are (dp, pp))."""
+
+from __future__ import annotations
+
+from functools import lru_cache
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from icikit.models.attention.dense import dense_attention
+from icikit.models.transformer.model import (
+    TransformerConfig,
+    _attn_block,
+    _dense_ffn_block,
+    _rms_norm,
+)
+from icikit.parallel.shmap import shard_map, wrap_program
+
+DP_AXIS, PP_AXIS = "dp", "pp"
+
+
+def make_pp_mesh(dp: int = 1, pp: int = 1, devices=None) -> Mesh:
+    if devices is None:
+        devices = jax.devices()
+    n = dp * pp
+    if n > len(devices):
+        raise ValueError(f"need {n} devices, have {len(devices)}")
+    return Mesh(np.asarray(devices[:n]).reshape(dp, pp), (DP_AXIS, PP_AXIS))
+
+
+def pp_param_specs(cfg: TransformerConfig) -> dict:
+    """Same parameter tree as ``model.param_specs`` but layer-stacked
+    leaves shard their layer dim over ``pp`` (dense FFN only)."""
+    if cfg.n_experts:
+        raise ValueError("pipeline path supports the dense FFN only")
+    return {
+        "emb": P(), "pos": P(), "ln_f": P(), "w_out": P(),
+        "ln1": P(PP_AXIS), "ln2": P(PP_AXIS),
+        "wqkv": P(PP_AXIS), "wo": P(PP_AXIS),
+        "w1": P(PP_AXIS), "w2": P(PP_AXIS),
+    }
+
+
+def init_pp_params(key, cfg: TransformerConfig, mesh: Mesh) -> dict:
+    """Same initializers (and values, for a given key) as
+    ``model.init_params``, placed with pp shardings."""
+    from icikit.models.transformer.model import (
+        init_params as _init,
+        make_model_mesh as _mm,
+    )
+    flat = _init(key, cfg, _mm(dp=1, tp=1, sp=1,
+                               devices=list(mesh.devices.ravel())))
+    specs = pp_param_specs(cfg)
+    return {k: jax.device_put(jax.device_get(v), NamedSharding(mesh, specs[k]))
+            for k, v in flat.items()}
+
+
+def _stage_layers(x, lp, cfg, cdt):
+    """Run this stage's L/p layers on one microbatch (b, s, D): the
+    shared layer body with dense causal attention and no tp reduction."""
+
+    def attention(q, k, v):
+        return dense_attention(q, k, v, causal=True)
+
+    def layer(x, p1):
+        x = _attn_block(x, p1, cdt, attention, lambda v: v)
+        x = _dense_ffn_block(x, p1, cdt, lambda v: v)
+        return x, None
+
+    x, _ = lax.scan(layer, x, lp)
+    return x
+
+
+@lru_cache(maxsize=None)
+def _build_pp_loss_and_grad(mesh, cfg: TransformerConfig, n_microbatches: int,
+                            local_shape):
+    p = mesh.shape[PP_AXIS]
+    p_dp = mesh.shape[DP_AXIS]
+    m = n_microbatches
+    if cfg.n_layers % p:
+        raise ValueError(f"n_layers={cfg.n_layers} not divisible by pp={p}")
+    cdt = jnp.dtype(cfg.compute_dtype)
+    specs = pp_param_specs(cfg)
+    data_spec = P(None, DP_AXIS)
+    denom = m * local_shape[0] * local_shape[1] * p_dp  # global tokens
+    fwd_perm = [(i, (i + 1) % p) for i in range(p)]
+
+    def local_loss(params, tokens, targets):
+        r = lax.axis_index(PP_AXIS)
+        b, s = tokens.shape[1], tokens.shape[2]
+        layer_keys = ("ln1", "ln2", "wqkv", "wo", "w1", "w2")
+        lp = {k: params[k] for k in layer_keys}
+        x = jnp.zeros((b, s, cfg.d_model), jnp.float32)
+        loss_sum = jnp.zeros((), jnp.float32)
+        for t in range(m + p - 1):
+            if t < m:  # inject microbatch t at stage 0
+                emb_x = (params["emb"][tokens[t]]
+                         + params["pos"][:s]).astype(jnp.float32)
+                x = jnp.where((r == 0)[None, None, None], emb_x, x)
+            x = _stage_layers(x, lp, cfg, cdt)
+            j = t - (p - 1)
+            if 0 <= j < m:  # microbatch j exits at the last stage
+                h = _rms_norm(x, params["ln_f"])
+                logits = jnp.einsum("bsd,dv->bsv", h.astype(cdt),
+                                    params["w_out"].astype(cdt)
+                                    ).astype(jnp.float32)
+                logp = jax.nn.log_softmax(logits, axis=-1)
+                nll = -jnp.take_along_axis(
+                    logp, targets[j][..., None], axis=-1).sum()
+                loss_sum = loss_sum + jnp.where(r == p - 1, nll, 0.0)
+            if t < m + p - 2:
+                x = lax.ppermute(x, PP_AXIS, fwd_perm)
+        return loss_sum / denom
+
+    def per_shard(params, tokens, targets):
+        loss, grads = jax.value_and_grad(local_loss)(params, tokens, targets)
+        return lax.psum(loss, (DP_AXIS, PP_AXIS)), grads
+
+    return wrap_program(per_shard, mesh, (specs, data_spec, data_spec),
+                        (P(), specs))
+
+
+def pp_loss_fn(params, tokens, targets, mesh, cfg: TransformerConfig,
+               n_microbatches: int):
+    """Global mean token cross-entropy + full gradient tree through the
+    microbatch pipeline.
+
+    ``tokens``/``targets``: int32 ``(M, B, S)`` — M microbatches,
+    batch-sharded over ``dp``, replicated over ``pp``.
+    """
+    if tokens.shape[0] != n_microbatches:
+        raise ValueError(
+            f"expected {n_microbatches} microbatches, got {tokens.shape[0]}")
+    local = (tokens.shape[1] // mesh.shape[DP_AXIS], tokens.shape[2])
+    return _build_pp_loss_and_grad(mesh, cfg, n_microbatches, local)(
+        params, tokens, targets)
+
+
+def make_pp_train_step(mesh, cfg: TransformerConfig, n_microbatches: int,
+                       optimizer=None):
+    """Jitted pipeline training step (params, opt_state, tokens,
+    targets) -> (params, opt_state, loss)."""
+    import optax
+    if optimizer is None:
+        optimizer = optax.adam(3e-4)
+
+    @jax.jit
+    def step(params, opt_state, tokens, targets):
+        loss, grads = pp_loss_fn(params, tokens, targets, mesh, cfg,
+                                 n_microbatches)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    return optimizer, step

@@ -1,0 +1,91 @@
+"""Pipeline-parallel tests: the microbatched pp schedule must be
+numerically identical to the plain single-device model on the same
+tokens (loss AND grads — the backward pipeline is the autodiff
+transpose of the forward ppermute chain, so this checks both)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from icikit.models.transformer import TransformerConfig, init_params, loss_fn
+from icikit.models.transformer.model import make_model_mesh
+from icikit.models.transformer.pipeline import (
+    init_pp_params,
+    make_pp_mesh,
+    make_pp_train_step,
+    pp_loss_fn,
+)
+
+CFG = TransformerConfig(vocab=61, d_model=32, n_heads=4, d_head=8,
+                        d_ff=64, n_layers=4, max_seq=16,
+                        compute_dtype="float32")
+
+
+def _microbatches(m=4, b=2, s=16, seed=0):
+    rng = np.random.default_rng(seed)
+    tok = rng.integers(0, CFG.vocab, (m, b, s)).astype(np.int32)
+    tgt = rng.integers(0, CFG.vocab, (m, b, s)).astype(np.int32)
+    return tok, tgt
+
+
+def _place_pp(mesh, tok, tgt):
+    sh = NamedSharding(mesh, P(None, "dp"))
+    return (jax.device_put(jnp.asarray(tok), sh),
+            jax.device_put(jnp.asarray(tgt), sh))
+
+
+@pytest.mark.parametrize("dp,pp,m", [(1, 4, 4), (2, 2, 4), (1, 2, 6),
+                                     (2, 4, 2)])
+def test_pp_matches_single_device(dp, pp, m):
+    tok, tgt = _microbatches(m=m)
+    ppmesh = make_pp_mesh(dp=dp, pp=pp)
+    pparams = init_pp_params(jax.random.key(0), CFG, ppmesh)
+    loss_pp, g_pp = pp_loss_fn(pparams, *_place_pp(ppmesh, tok, tgt),
+                               ppmesh, CFG, n_microbatches=m)
+
+    # reference: the plain model on the microbatches flattened into one
+    # batch (same tokens, same params by construction of init_pp_params)
+    mesh1 = make_model_mesh(dp=1, tp=1, sp=1)
+    params1 = init_params(jax.random.key(0), CFG, mesh1)
+    flat_tok = tok.reshape(-1, tok.shape[-1])
+    flat_tgt = tgt.reshape(-1, tgt.shape[-1])
+    sh = NamedSharding(mesh1, P("dp", "sp"))
+    loss1, g1 = loss_fn(params1, jax.device_put(jnp.asarray(flat_tok), sh),
+                        jax.device_put(jnp.asarray(flat_tgt), sh),
+                        mesh1, CFG)
+
+    np.testing.assert_allclose(float(loss_pp), float(loss1), rtol=1e-5)
+    for k in g1:
+        np.testing.assert_allclose(np.asarray(g_pp[k]), np.asarray(g1[k]),
+                                   rtol=3e-4, atol=3e-5, err_msg=k)
+
+
+def test_pp_train_step_learns():
+    import optax
+    mesh = make_pp_mesh(dp=2, pp=4)
+    params = init_pp_params(jax.random.key(1), CFG, mesh)
+    tok, tgt = _microbatches(m=4, seed=2)
+    tok_d, tgt_d = _place_pp(mesh, tok, tgt)
+    optimizer, step = make_pp_train_step(mesh, CFG, 4, optax.adam(1e-2))
+    st = optimizer.init(params)
+    first = None
+    for _ in range(30):
+        params, st, loss = step(params, st, tok_d, tgt_d)
+        if first is None:
+            first = float(loss)
+    assert float(loss) < first * 0.5, (first, float(loss))
+
+
+def test_pp_validation():
+    mesh = make_pp_mesh(dp=1, pp=4)
+    with pytest.raises(ValueError):
+        # 4 layers over pp=3 is impossible; mesh of 3 stages with 4
+        # microbatches declared but 2 provided is the cheaper check
+        pp_loss_fn({}, jnp.zeros((2, 2, 16), jnp.int32),
+                   jnp.zeros((2, 2, 16), jnp.int32), mesh, CFG,
+                   n_microbatches=4)
+    from icikit.models.transformer.pipeline import pp_param_specs
+    with pytest.raises(ValueError):
+        pp_param_specs(TransformerConfig(n_experts=4))

@@ -1,0 +1,29 @@
+# Top-level targets (the reference's per-sub-repo Makefile template,
+# */Makefile:29-62, collapsed into one ops entry point; the native
+# runtime keeps the wildcard-compile discipline in icikit/native/Makefile).
+
+PY ?= python
+
+.PHONY: test test-fast bench native clean sweep scaling
+
+test:
+	$(PY) -m pytest tests/ -q
+
+test-fast:
+	$(PY) -m pytest tests/ -q -m "not slow"
+
+bench:
+	$(PY) bench.py
+
+native:
+	$(MAKE) -C icikit/native
+
+sweep:
+	$(PY) -m icikit.bench.run --family allgather
+
+scaling:
+	$(PY) -m icikit.bench.scaling
+
+clean:
+	$(MAKE) -C icikit/native clean
+	find . -name __pycache__ -type d -exec rm -rf {} +

@@ -20,4 +20,5 @@ from icikit.utils.registry import (  # noqa: F401
     list_algorithms,
     register_algorithm,
 )
+from icikit.utils.checkpoint import TrainCheckpointer  # noqa: F401
 from icikit.utils.timing import Stopwatch, timeit  # noqa: F401

@@ -1,0 +1,143 @@
+"""Transformer training-throughput benchmark (tokens/s, approximate MFU).
+
+The reference's harnesses report max-over-ranks wall time per operation
+(``Communication/src/main.cc:443-449``); the model-training analog is
+tokens/s and model-FLOPs utilization of the fenced, warmed train step.
+FLOPs are counted as 6 x (matmul params) x tokens + attention's
+12 x b x s^2 x H x Dh per layer (fwd 2 + bwd 4 per MAC) — the standard
+PaLM-style accounting, approximate by design (norms/softmax/router
+excluded).
+
+CLI: ``python -m icikit.bench.train [--preset small|base] [--dp N ...]``
+— prints one JSON line per run, shaped like the harness records.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+PEAK_FLOPS = {
+    # bf16 dense peak per chip, published spec sheets.
+    "v6e": 918e12,
+    "v5e": 197e12,
+    "v5p": 459e12,
+    "v4": 275e12,
+    "cpu": 0.0,
+}
+
+PRESETS = {
+    "tiny": dict(vocab=256, d_model=128, n_heads=4, d_head=32, d_ff=512,
+                 n_layers=2, max_seq=128),
+    "small": dict(vocab=32768, d_model=512, n_heads=8, d_head=64,
+                  d_ff=2048, n_layers=8, max_seq=1024),
+    "base": dict(vocab=32768, d_model=1024, n_heads=16, d_head=64,
+                 d_ff=4096, n_layers=12, max_seq=1024),
+}
+
+
+def matmul_param_count(cfg) -> int:
+    per_layer = (cfg.d_model * 3 * cfg.n_heads * cfg.d_head   # wqkv
+                 + cfg.n_heads * cfg.d_head * cfg.d_model     # wo
+                 + 2 * cfg.d_model * cfg.d_ff)                # w1, w2
+    return (cfg.n_layers * per_layer
+            + cfg.d_model * cfg.vocab                         # head
+            + cfg.vocab * cfg.d_model)                        # embedding
+
+
+def step_flops(cfg, batch: int, seq: int) -> float:
+    """6*P*T matmul FLOPs + attention score/value FLOPs (fwd+bwd)."""
+    tokens = batch * seq
+    mm = 6.0 * matmul_param_count(cfg) * tokens
+    attn = 12.0 * batch * seq * seq * cfg.n_heads * cfg.d_head * cfg.n_layers
+    return mm + attn
+
+
+def detect_peak() -> float:
+    if jax.default_backend() != "tpu":
+        return 0.0
+    kind = jax.devices()[0].device_kind.lower().replace(" ", "")
+    aliases = {"v5lite": "v5e", "v5litepod": "v5e", "v6lite": "v6e"}
+    for raw, canon in aliases.items():
+        if raw in kind:
+            return PEAK_FLOPS[canon]
+    for key, val in PEAK_FLOPS.items():
+        if key in kind:
+            return val
+    return 0.0
+
+
+def run_bench(preset: str, dp: int, tp: int, sp: int, batch: int,
+              steps: int, warmup: int, moe_experts: int = 0) -> dict:
+    import optax
+
+    from icikit.models.transformer import (
+        TransformerConfig, init_params, make_train_step)
+    from icikit.models.transformer.model import make_model_mesh
+    from icikit.utils.timing import fence
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    cfg = TransformerConfig(**PRESETS[preset], n_experts=moe_experts)
+    mesh = make_model_mesh(dp=dp, tp=tp, sp=sp)
+    params = init_params(jax.random.key(0), cfg, mesh)
+    optimizer, step = make_train_step(mesh, cfg, optax.adam(1e-4))
+    opt_state = optimizer.init(params)
+
+    rng = np.random.default_rng(0)
+    sh = NamedSharding(mesh, P("dp", "sp"))
+    seq = cfg.max_seq
+    tok = jax.device_put(jnp.asarray(
+        rng.integers(0, cfg.vocab, (batch, seq)), jnp.int32), sh)
+    tgt = jax.device_put(jnp.asarray(
+        rng.integers(0, cfg.vocab, (batch, seq)), jnp.int32), sh)
+
+    import time
+    for _ in range(warmup):
+        params, opt_state, loss = step(params, opt_state, tok, tgt)
+    fence(params)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        params, opt_state, loss = step(params, opt_state, tok, tgt)
+    fence(loss)
+    dt = (time.perf_counter() - t0) / steps
+
+    n_dev = dp * tp * sp
+    tokens_s = batch * seq / dt
+    flops = step_flops(cfg, batch, seq)
+    peak = detect_peak() * n_dev
+    moe_tag = f"_e{moe_experts}" if moe_experts else ""
+    return {
+        "metric": f"train_{preset}_dp{dp}tp{tp}sp{sp}_b{batch}{moe_tag}",
+        "value": round(tokens_s, 1),
+        "unit": "tokens/s",
+        "step_ms": round(dt * 1e3, 2),
+        "model_tflops_per_s": round(flops / dt / 1e12, 2),
+        "mfu": round(flops / dt / peak, 4) if peak else None,
+        "loss": round(float(loss), 4),
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--preset", default="small", choices=sorted(PRESETS))
+    ap.add_argument("--dp", type=int, default=1)
+    ap.add_argument("--tp", type=int, default=1)
+    ap.add_argument("--sp", type=int, default=1)
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--steps", type=int, default=10)
+    ap.add_argument("--warmup", type=int, default=3)
+    ap.add_argument("--experts", type=int, default=0,
+                    help="n_experts > 0 benches the MoE variant")
+    args = ap.parse_args(argv)
+    rec = run_bench(args.preset, args.dp, args.tp, args.sp, args.batch,
+                    args.steps, args.warmup, args.experts)
+    print(json.dumps(rec))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

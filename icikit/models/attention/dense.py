@@ -28,13 +28,16 @@ def dense_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     """
     if scale is None:
         scale = q.shape[-1] ** -0.5
-    logits = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
-                        k.astype(jnp.float32)) * scale
+    # Inputs' dtype on the MXU, fp32 accumulation/softmax (bf16 inputs
+    # take the fast path; fp32 inputs match the always-upcast result).
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
     if causal:
         s_q, s_kv = q.shape[1], k.shape[1]
         q_pos = jnp.arange(s_q)[:, None] + (s_kv - s_q)
         k_pos = jnp.arange(s_kv)[None, :]
         logits = jnp.where(q_pos >= k_pos, logits, NEG_INF)
     w = jax.nn.softmax(logits, axis=-1)
-    out = jnp.einsum("bhqk,bkhd->bqhd", w, v.astype(jnp.float32))
+    out = jnp.einsum("bhqk,bkhd->bqhd", w.astype(v.dtype), v,
+                     preferred_element_type=jnp.float32)
     return out.astype(q.dtype)

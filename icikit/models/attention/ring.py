@@ -31,10 +31,16 @@ from jax.sharding import PartitionSpec as P
 
 
 def _tile_update(carry, q_scaled, k_blk, v_blk, mask):
-    """Fold one K/V tile into the (m, l, o) online-softmax accumulator."""
+    """Fold one K/V tile into the (m, l, o) online-softmax accumulator.
+
+    Matmuls run in the inputs' dtype with fp32 accumulation
+    (``preferred_element_type``): bf16 inputs take the MXU's fast path,
+    fp32 inputs are bit-identical to the previous always-upcast code.
+    The softmax statistics (m, l) and output accumulator stay fp32.
+    """
     m, l, o = carry
-    logits = jnp.einsum("bqhd,bkhd->bhqk", q_scaled,
-                        k_blk.astype(jnp.float32))
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q_scaled, k_blk,
+                        preferred_element_type=jnp.float32)
     if mask is not None:
         logits = jnp.where(mask, logits, NEG_INF)
     m_new = jnp.maximum(m, logits.max(axis=-1))
@@ -45,7 +51,8 @@ def _tile_update(carry, q_scaled, k_blk, v_blk, mask):
     w = jnp.exp(logits - m_ref[..., None])
     l_new = l * alpha + w.sum(axis=-1)
     o_new = o * alpha[..., None] + jnp.einsum(
-        "bhqk,bkhd->bhqd", w, v_blk.astype(jnp.float32))
+        "bhqk,bkhd->bhqd", w.astype(v_blk.dtype), v_blk,
+        preferred_element_type=jnp.float32)
     return m_new, l_new, o_new
 
 
@@ -57,7 +64,7 @@ def ring_attention_shard(q: jax.Array, k: jax.Array, v: jax.Array,
     if scale is None:
         scale = d ** -0.5
     r = lax.axis_index(axis)
-    q_scaled = q.astype(jnp.float32) * scale
+    q_scaled = (q.astype(jnp.float32) * scale).astype(q.dtype)
 
     m = jnp.full((b, h, s), NEG_INF, jnp.float32)
     l = jnp.zeros((b, h, s), jnp.float32)

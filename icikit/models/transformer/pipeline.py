@@ -92,7 +92,7 @@ def _stage_layers(x, lp, cfg, cdt):
         x = _dense_ffn_block(x, p1, cdt, lambda v: v)
         return x, None
 
-    x, _ = lax.scan(layer, x, lp)
+    x, _ = lax.scan(jax.checkpoint(layer) if cfg.remat else layer, x, lp)
     return x
 
 

@@ -4,8 +4,9 @@ The redistribution is the reference's all-to-all personalized transpose
 (``Communication/src/main.cc:234-388``) with head-groups as the blocks:
 inbound, device r trades its p head-groups for every device's group r,
 ending with the *full* sequence for heads ``[r·h/p, (r+1)·h/p)``; it
-attends locally (any single-device kernel works — here the dense
-oracle), then the inverse all-to-all restores sequence sharding. Any
+attends locally (any single-device kernel works — flash by default,
+the dense oracle on request), then the inverse all-to-all restores
+sequence sharding. Any
 registered ``alltoall`` schedule can carry the re-shard, so the harness
 can compare hypercube/e-cube/wraparound against XLA's fused collective
 on the actual workload the primitive exists for.
@@ -19,7 +20,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from icikit.models.attention.dense import dense_attention
+from icikit.ops.flash_attention import resolve_attention_impl
 from icikit.parallel.shmap import shard_map
 from icikit.utils.mesh import DEFAULT_AXIS
 from icikit.utils.registry import get_algorithm
@@ -53,27 +54,30 @@ def _heads_to_seq(x: jax.Array, axis: str, p: int, algorithm: str):
 def ulysses_attention_shard(q: jax.Array, k: jax.Array, v: jax.Array,
                             axis: str, p: int, causal: bool,
                             scale: float | None,
-                            algorithm: str) -> jax.Array:
+                            algorithm: str,
+                            local: str = "flash") -> jax.Array:
     qh = _seq_to_heads(q, axis, p, algorithm)
     kh = _seq_to_heads(k, axis, p, algorithm)
     vh = _seq_to_heads(v, axis, p, algorithm)
-    ctx = dense_attention(qh, kh, vh, causal=causal, scale=scale)
+    ctx = resolve_attention_impl(local)(qh, kh, vh, causal=causal,
+                                        scale=scale)
     return _heads_to_seq(ctx, axis, p, algorithm)
 
 
 @lru_cache(maxsize=None)
-def _build(mesh, axis, causal, scale, algorithm):
+def _build(mesh, axis, causal, scale, algorithm, local):
     p = mesh.shape[axis]
     spec = P(None, axis)
     fn = partial(ulysses_attention_shard, axis=axis, p=p, causal=causal,
-                 scale=scale, algorithm=algorithm)
+                 scale=scale, algorithm=algorithm, local=local)
     return jax.jit(shard_map(fn, mesh=mesh, in_specs=spec, out_specs=spec))
 
 
 def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh,
                       axis: str = DEFAULT_AXIS, causal: bool = False,
                       scale: float | None = None,
-                      algorithm: str = "xla") -> jax.Array:
+                      algorithm: str = "xla",
+                      local: str = "flash") -> jax.Array:
     """Sequence-parallel attention via all-to-all head redistribution.
 
     Args:
@@ -81,6 +85,8 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh,
         along the sequence dim; ``heads`` must divide evenly by p.
       algorithm: any ``alltoall`` family variant ("xla", "wraparound",
         "naive", "ecube", "hypercube").
+      local: single-device kernel for the head-sharded attention —
+        "flash" (fused Pallas) or "dense" (the XLA oracle).
 
     Returns:
       ``(batch, S, heads, head_dim)``, sequence-sharded, numerically
@@ -94,4 +100,4 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh,
         raise ValueError(
             f"sequence length {q.shape[1]} must divide evenly over "
             f"{p} devices")
-    return _build(mesh, axis, bool(causal), scale, algorithm)(q, k, v)
+    return _build(mesh, axis, bool(causal), scale, algorithm, local)(q, k, v)

@@ -33,6 +33,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from icikit.models.attention.ring import ring_attention_shard
 from icikit.models.transformer.moe import moe_ffn_shard
+from icikit.ops.flash_attention import resolve_attention_impl
 from icikit.parallel.shmap import wrap_program
 
 DP_AXIS, TP_AXIS, SP_AXIS = "dp", "tp", "sp"
@@ -60,6 +61,11 @@ class TransformerConfig:
     # one layer's internals, at ~1/3 extra FLOPs — the standard
     # HBM-for-MXU trade.
     remat: bool = True
+    # Local attention kernel: "flash" (fused Pallas, O(s) memory) or
+    # "dense" (the XLA oracle). Applies wherever a device attends over
+    # its full local sequence (sp == 1, pipeline stages); the ring
+    # schedule owns the sp > 1 path.
+    attention_impl: str = "flash"
 
 
 def make_model_mesh(n_devices: int | None = None, dp: int = 1, tp: int = 1,
@@ -184,6 +190,9 @@ def _forward_local(params, tokens, cfg: TransformerConfig, p_sp: int,
         return lax.psum(v, TP_AXIS)
 
     def attention(q, k, v):
+        if p_sp == 1:  # full sequence is local: use the fused kernel
+            return resolve_attention_impl(cfg.attention_impl)(
+                q, k, v, causal=True)
         return ring_attention_shard(q, k, v, SP_AXIS, p_sp, causal=True,
                                     scale=None)
 

@@ -18,9 +18,9 @@ from the stages that actually use them. Tokens/targets arrive as
 M + p − 1 unrolled steps; bubble fraction (p−1)/(M+p−1), the GPipe
 trade the caller tunes with ``n_microbatches``.
 
-Attention inside a stage is dense causal (sequence parallelism belongs
-to the sp path in ``model.py``; composing pp x sp is out of scope —
-mesh axes here are (dp, pp))."""
+Attention inside a stage is causal over the full local sequence via
+``cfg.attention_impl`` (flash by default; sequence parallelism belongs
+to the sp path in ``model.py`` — mesh axes here are (dp, pp))."""
 
 from __future__ import annotations
 
@@ -32,7 +32,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from icikit.models.attention.dense import dense_attention
+from icikit.ops.flash_attention import resolve_attention_impl
 from icikit.models.transformer.model import (
     TransformerConfig,
     _attn_block,
@@ -82,10 +82,12 @@ def init_pp_params(key, cfg: TransformerConfig, mesh: Mesh) -> dict:
 
 def _stage_layers(x, lp, cfg, cdt):
     """Run this stage's L/p layers on one microbatch (b, s, D): the
-    shared layer body with dense causal attention and no tp reduction."""
+    shared layer body with causal ``cfg.attention_impl`` attention and
+    no tp reduction."""
 
     def attention(q, k, v):
-        return dense_attention(q, k, v, causal=True)
+        return resolve_attention_impl(cfg.attention_impl)(
+            q, k, v, causal=True)
 
     def layer(x, p1):
         x = _attn_block(x, p1, cdt, attention, lambda v: v)

@@ -1,0 +1,43 @@
+"""Single-device multi-head attention — the oracle the sequence-parallel
+schedules are verified against (the role the reference's closed-form
+payload expectations play for its collectives, ``main.cc:436-441``)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = float(jnp.finfo(jnp.float32).min)
+
+
+def dense_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                    causal: bool = False,
+                    scale: float | None = None) -> jax.Array:
+    """Scaled dot-product attention, softmax in float32.
+
+    Args:
+      q: queries ``(batch, s_q, heads, head_dim)``.
+      k, v: keys/values ``(batch, s_kv, heads, head_dim)``.
+      causal: mask position i from attending to positions > i (query and
+        key positions aligned at the sequence end, standard decoder
+        convention; here ``s_q == s_kv`` is assumed by the callers).
+      scale: logit scale, default ``head_dim ** -0.5``.
+
+    Returns:
+      ``(batch, s_q, heads, head_dim)`` in q's dtype.
+    """
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    # Inputs' dtype on the MXU, fp32 accumulation/softmax (bf16 inputs
+    # take the fast path; fp32 inputs match the always-upcast result).
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    if causal:
+        s_q, s_kv = q.shape[1], k.shape[1]
+        q_pos = jnp.arange(s_q)[:, None] + (s_kv - s_q)
+        k_pos = jnp.arange(s_kv)[None, :]
+        logits = jnp.where(q_pos >= k_pos, logits, NEG_INF)
+    w = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", w.astype(v.dtype), v,
+                     preferred_element_type=jnp.float32)
+    return out.astype(q.dtype)

@@ -1,0 +1,344 @@
+"""Pallas TPU flash attention: fused O(s)-memory attention, fwd + bwd.
+
+Why this exists: the dense attention oracle
+(``icikit/models/attention/dense.py``) materializes the (b, h, s, s)
+logits in HBM — two full score-matrix round trips per forward (write +
+softmax read) and four more in the backward. At s = 4096, bf16, that is
+the whole HBM budget of the layer. This kernel streams K/V blocks
+through VMEM against a resident Q block, carrying the online-softmax
+(m, l, acc) state in VMEM scratch across the K grid dimension, so HBM
+traffic is O(s·d) per head — the same blockwise construction the ring
+schedule (``icikit/models/attention/ring.py``) uses *across* devices,
+here executed *within* a chip (SURVEY.md §5.7: the reference's ring
+all-to-all ``Communication/src/main.cc:190-223`` is the cross-device
+ancestor of exactly this tiling).
+
+The backward follows the standard two-pass flash recipe: residuals are
+(out, lse) only; dK/dV accumulate over the Q grid, dQ over the K grid,
+each recomputing the probability tile from q, k and the saved lse.
+
+Numerics: matmuls run in the inputs' dtype on the MXU with fp32
+accumulation; softmax statistics and all accumulators are fp32. Falls
+back to the dense oracle for shapes the tiling cannot cover (sequence
+not a multiple of 8, cross-attention with causal=True). On non-TPU
+backends the kernels run in Pallas interpreter mode, so CPU-mesh tests
+exercise the same code path.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache, partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu  # noqa: F401  (TPU lowering)
+
+from icikit.ops.attention import NEG_INF, dense_attention
+
+_BLOCKS = (1024, 512, 256, 128, 64, 32, 16, 8)
+
+
+def _out_struct(shape, dtype, *operands):
+    """ShapeDtypeStruct carrying the union of the operands' varying
+    mesh axes, so pallas_call composes with shard_map's (default-on)
+    replication checking instead of forcing check_vma=False."""
+    vma = frozenset()
+    for x in operands:
+        vma = vma | getattr(jax.typeof(x), "vma", frozenset())
+    try:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    except TypeError:  # older jax: no vma argument, no check either
+        return jax.ShapeDtypeStruct(shape, dtype)
+
+
+def _pick_block(s: int) -> int | None:
+    """K-side block: any power-of-two divisor >= 8."""
+    for b in _BLOCKS:
+        if b <= s and s % b == 0:
+            return b
+    return None
+
+
+def _pick_q_block(s: int) -> int | None:
+    """Q-side block. The (b, h, 1, s) softmax-stats residual makes the
+    q block the lane dimension of its BlockSpec, so Mosaic requires a
+    multiple of 128 — or a single block covering the whole sequence."""
+    for b in (512, 256, 128):
+        if s % b == 0:
+            return b
+    return s if s % 8 == 0 and s <= 1024 else None
+
+
+def _causal_mask(s, iq, ik, bq, bk):
+    qpos = iq * bq + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+    kpos = ik * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    return jnp.where(qpos >= kpos, s, NEG_INF)
+
+
+# ---------------------------------------------------------------- forward
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s, acc,
+                *, scale, causal, nk, bq, bk):
+    iq, ik = pl.program_id(2), pl.program_id(3)
+
+    @pl.when(ik == 0)
+    def _():
+        m_s[:] = jnp.full_like(m_s, -jnp.inf)
+        l_s[:] = jnp.zeros_like(l_s)
+        acc[:] = jnp.zeros_like(acc)
+
+    run = (ik * bk <= iq * bq + bq - 1) if causal else (ik >= 0)
+
+    @pl.when(run)
+    def _():
+        q, k, v = q_ref[0, 0], k_ref[0, 0], v_ref[0, 0]
+        s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+        if causal:
+            s = _causal_mask(s, iq, ik, bq, bk)
+        m_prev = m_s[:]                              # (bq, 128), lane-dup
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        w = jnp.exp(s - m_new[:, :1])
+        l_s[:] = l_s[:] * alpha + jnp.sum(w, axis=1, keepdims=True)
+        acc[:] = acc[:] * alpha[:, :1] + lax.dot_general(
+            w.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_s[:] = m_new
+
+    @pl.when(ik == nk - 1)
+    def _():
+        o_ref[0, 0] = (acc[:] / l_s[:, :1]).astype(o_ref.dtype)
+        lse_ref[0, 0, 0] = m_s[:, 0] + jnp.log(l_s[:, 0])
+
+
+def _fwd_call(qt, kt, vt, causal, scale, bq, bk, interpret):
+    b, h, sq, d = qt.shape
+    sk = kt.shape[2]
+    nq, nk = sq // bq, sk // bk
+    kernel = partial(_fwd_kernel, scale=scale, causal=causal, nk=nk,
+                     bq=bq, bk=bk)
+    return pl.pallas_call(
+        kernel,
+        grid=(b, h, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, 1, bq, d), lambda ib, ih, iq, ik: (ib, ih, iq, 0)),
+            pl.BlockSpec((1, 1, bk, d), lambda ib, ih, iq, ik: (ib, ih, ik, 0)),
+            pl.BlockSpec((1, 1, bk, d), lambda ib, ih, iq, ik: (ib, ih, ik, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, bq, d), lambda ib, ih, iq, ik: (ib, ih, iq, 0)),
+            pl.BlockSpec((1, 1, 1, bq), lambda ib, ih, iq, ik: (ib, ih, 0, iq)),
+        ],
+        out_shape=[
+            _out_struct((b, h, sq, d), qt.dtype, qt, kt, vt),
+            _out_struct((b, h, 1, sq), jnp.float32, qt, kt, vt),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, 128), jnp.float32),   # running max (lane-dup)
+            pltpu.VMEM((bq, 128), jnp.float32),   # running normalizer
+            pltpu.VMEM((bq, d), jnp.float32),     # output accumulator
+        ],
+        interpret=interpret,
+    )(qt, kt, vt)
+
+
+# --------------------------------------------------------------- backward
+
+def _p_tile(q, k, lse, iq, ik, bq, bk, scale, causal):
+    """Recompute the probability tile exp(s·scale − lse) in fp32."""
+    s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                        preferred_element_type=jnp.float32) * scale
+    if causal:
+        s = _causal_mask(s, iq, ik, bq, bk)
+    return jnp.exp(s - lse[:, None])
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, dq_ref,
+                   dq_acc, *, scale, causal, nk, bq, bk):
+    iq, ik = pl.program_id(2), pl.program_id(3)
+
+    @pl.when(ik == 0)
+    def _():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    run = (ik * bk <= iq * bq + bq - 1) if causal else (ik >= 0)
+
+    @pl.when(run)
+    def _():
+        q, k, v, do = q_ref[0, 0], k_ref[0, 0], v_ref[0, 0], do_ref[0, 0]
+        p = _p_tile(q, k, lse_ref[0, 0, 0], iq, ik, bq, bk, scale, causal)
+        dp = lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+        ds = p * (dp - dl_ref[0, 0, 0][:, None]) * scale
+        dq_acc[:] += lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(ik == nk - 1)
+    def _():
+        dq_ref[0, 0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref,
+                    dk_ref, dv_ref, dk_acc, dv_acc,
+                    *, scale, causal, nq, bq, bk):
+    ik, iq = pl.program_id(2), pl.program_id(3)
+
+    @pl.when(iq == 0)
+    def _():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    run = (iq * bq + bq - 1 >= ik * bk) if causal else (iq >= 0)
+
+    @pl.when(run)
+    def _():
+        q, k, v, do = q_ref[0, 0], k_ref[0, 0], v_ref[0, 0], do_ref[0, 0]
+        p = _p_tile(q, k, lse_ref[0, 0, 0], iq, ik, bq, bk, scale, causal)
+        dv_acc[:] += lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+        ds = p * (dp - dl_ref[0, 0, 0][:, None]) * scale
+        dk_acc[:] += lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(iq == nq - 1)
+    def _():
+        dk_ref[0, 0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _bwd_call(qt, kt, vt, do, lse, delta, causal, scale, bq, bk, interpret):
+    b, h, sq, d = qt.shape
+    sk = kt.shape[2]
+    nq, nk = sq // bq, sk // bk
+
+    q_at = lambda ib, ih, iq, ik: (ib, ih, iq, 0)       # noqa: E731
+    k_at = lambda ib, ih, iq, ik: (ib, ih, ik, 0)       # noqa: E731
+    r_at = lambda ib, ih, iq, ik: (ib, ih, 0, iq)       # noqa: E731
+    dq = pl.pallas_call(
+        partial(_bwd_dq_kernel, scale=scale, causal=causal, nk=nk,
+                bq=bq, bk=bk),
+        grid=(b, h, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, 1, bq, d), q_at),
+            pl.BlockSpec((1, 1, bk, d), k_at),
+            pl.BlockSpec((1, 1, bk, d), k_at),
+            pl.BlockSpec((1, 1, bq, d), q_at),
+            pl.BlockSpec((1, 1, 1, bq), r_at),
+            pl.BlockSpec((1, 1, 1, bq), r_at),
+        ],
+        out_specs=pl.BlockSpec((1, 1, bq, d), q_at),
+        out_shape=_out_struct((b, h, sq, d), qt.dtype, qt, kt, vt, do, lse,
+                              delta),
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        interpret=interpret,
+    )(qt, kt, vt, do, lse, delta)
+
+    qk_at = lambda ib, ih, ik, iq: (ib, ih, iq, 0)      # noqa: E731
+    kk_at = lambda ib, ih, ik, iq: (ib, ih, ik, 0)      # noqa: E731
+    rk_at = lambda ib, ih, ik, iq: (ib, ih, 0, iq)      # noqa: E731
+    dk, dv = pl.pallas_call(
+        partial(_bwd_dkv_kernel, scale=scale, causal=causal, nq=nq,
+                bq=bq, bk=bk),
+        grid=(b, h, nk, nq),
+        in_specs=[
+            pl.BlockSpec((1, 1, bq, d), qk_at),
+            pl.BlockSpec((1, 1, bk, d), kk_at),
+            pl.BlockSpec((1, 1, bk, d), kk_at),
+            pl.BlockSpec((1, 1, bq, d), qk_at),
+            pl.BlockSpec((1, 1, 1, bq), rk_at),
+            pl.BlockSpec((1, 1, 1, bq), rk_at),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, bk, d), kk_at),
+            pl.BlockSpec((1, 1, bk, d), kk_at),
+        ],
+        out_shape=[
+            _out_struct((b, h, sk, d), kt.dtype, qt, kt, vt, do, lse, delta),
+            _out_struct((b, h, sk, d), vt.dtype, qt, kt, vt, do, lse, delta),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bk, d), jnp.float32),
+            pltpu.VMEM((bk, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qt, kt, vt, do, lse, delta)
+    return dq, dk, dv
+
+
+# ------------------------------------------------------------- custom_vjp
+
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(qt, kt, vt, causal, scale, bq, bk, interpret):
+    out, _ = _fwd_call(qt, kt, vt, causal, scale, bq, bk, interpret)
+    return out
+
+
+def _flash_fwd(qt, kt, vt, causal, scale, bq, bk, interpret):
+    out, lse = _fwd_call(qt, kt, vt, causal, scale, bq, bk, interpret)
+    return out, (qt, kt, vt, out, lse)
+
+
+def _flash_bwd(causal, scale, bq, bk, interpret, res, g):
+    qt, kt, vt, out, lse = res
+    # delta_i = sum_d dO_i·O_i — the rowwise dot that closes the softmax
+    # jacobian; cheap (one O(s·d) pass), so computed outside the kernels.
+    delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1)[:, :, None, :]
+    dq, dk, dv = _bwd_call(qt, kt, vt, g, lse, delta, causal, scale,
+                           bq, bk, interpret)
+    return dq, dk, dv
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+# ----------------------------------------------------------------- public
+
+def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                    causal: bool = False,
+                    scale: float | None = None) -> jax.Array:
+    """Fused flash attention; drop-in for ``dense_attention``.
+
+    Args:
+      q: ``(b, s_q, h, d)``; k, v: ``(b, s_kv, h, d)``.
+      causal: lower-triangular masking (requires ``s_q == s_kv``).
+      scale: logit scale, default ``d ** -0.5``.
+
+    Returns:
+      ``(b, s_q, h, d)`` in ``q.dtype``, numerically equal to the dense
+      oracle up to fp32-accumulation reassociation. Shapes the tiling
+      cannot cover fall back to the oracle.
+    """
+    sq, sk = q.shape[1], k.shape[1]
+    bq, bk = _pick_q_block(sq), _pick_block(sk)
+    if bq is None or bk is None or (causal and sq != sk):
+        return dense_attention(q, k, v, causal=causal, scale=scale)
+    backend = jax.default_backend()
+    if backend not in ("tpu", "cpu"):
+        # No Mosaic lowering (e.g. GPU): the compiled dense oracle beats
+        # the Pallas interpreter by orders of magnitude.
+        return dense_attention(q, k, v, causal=causal, scale=scale)
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    interpret = backend == "cpu"  # CPU meshes exercise the same kernels
+    qt, kt, vt = (x.transpose(0, 2, 1, 3) for x in (q, k, v))
+    out = _flash(qt, kt, vt, bool(causal), float(scale), bq, bk, interpret)
+    return out.transpose(0, 2, 1, 3)
+
+
+def resolve_attention_impl(name: str):
+    """Map a config string to the local attention kernel (the single
+    selection point for the sp=1, pipeline, and Ulysses paths)."""
+    impls = {"flash": flash_attention, "dense": dense_attention}
+    if name not in impls:
+        raise ValueError(f"unknown attention impl {name!r} "
+                         f"(known: {', '.join(sorted(impls))})")
+    return impls[name]

@@ -1,0 +1,77 @@
+"""Flash attention vs the dense oracle: forward and gradients, causal
+and full, fp32 and bf16, plus the fallback shapes (SURVEY.md §5.7 —
+the within-chip analog of the ring schedule's online softmax)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from icikit.models.attention.dense import dense_attention
+from icikit.ops.flash_attention import _pick_block, flash_attention
+
+
+def _mk(b, s, h, d, dtype, seed=0):
+    ks = jax.random.split(jax.random.key(seed), 3)
+    return tuple(jax.random.normal(k, (b, s, h, d), dtype) for k in ks)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("s", [64, 192, 384])  # 1 q block / 1 / 3 (nq > 1)
+def test_forward_matches_dense(causal, s):
+    q, k, v = _mk(2, s, 2, 32, jnp.float32)
+    got = flash_attention(q, k, v, causal=causal)
+    want = dense_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(got, want, atol=2e-6, rtol=2e-6)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("s", [96, 384])  # single q block / nq = 3
+def test_grads_match_dense(causal, s):
+    q, k, v = _mk(1, s, 2, 16, jnp.float32, seed=1)
+
+    def loss(fn, q, k, v):
+        out = fn(q, k, v, causal=causal)
+        return jnp.sum(out * jnp.cos(out))  # non-trivial cotangent
+
+    g_flash = jax.grad(lambda q, k, v: loss(
+        flash_attention, q, k, v), argnums=(0, 1, 2))(q, k, v)
+    g_dense = jax.grad(lambda q, k, v: loss(
+        dense_attention, q, k, v), argnums=(0, 1, 2))(q, k, v)
+    for gf, gd, name in zip(g_flash, g_dense, "qkv"):
+        np.testing.assert_allclose(gf, gd, atol=5e-5, rtol=5e-4,
+                                   err_msg=f"d{name}")
+
+
+def test_bf16_forward_close():
+    q, k, v = _mk(1, 128, 2, 32, jnp.bfloat16, seed=2)
+    got = flash_attention(q, k, v, causal=True).astype(jnp.float32)
+    want = dense_attention(q, k, v, causal=True).astype(jnp.float32)
+    np.testing.assert_allclose(got, want, atol=2e-2, rtol=2e-2)
+
+
+def test_cross_attention_noncausal():
+    ks = jax.random.split(jax.random.key(3), 3)
+    q = jax.random.normal(ks[0], (2, 64, 2, 16))
+    k = jax.random.normal(ks[1], (2, 128, 2, 16))
+    v = jax.random.normal(ks[2], (2, 128, 2, 16))
+    got = flash_attention(q, k, v)
+    want = dense_attention(q, k, v)
+    np.testing.assert_allclose(got, want, atol=2e-6, rtol=2e-6)
+
+
+def test_fallback_shapes():
+    # sequence not a multiple of 8 -> dense fallback, still exact
+    q, k, v = _mk(1, 13, 2, 16, jnp.float32, seed=4)
+    got = flash_attention(q, k, v, causal=True)
+    want = dense_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(got, want, atol=1e-6)
+    assert _pick_block(13) is None
+    assert _pick_block(192) == 64
+    assert _pick_block(1024) == 1024
+
+
+def test_unknown_impl_rejected():
+    from icikit.ops.flash_attention import resolve_attention_impl
+    with pytest.raises(ValueError, match="unknown attention impl"):
+        resolve_attention_impl("fash")

@@ -3,17 +3,20 @@
 The communication schedule is exactly the reference's ring all-to-all
 (``Communication/src/main.cc:190-223``): p-1 neighbor steps, each device
 forwarding the block it just received. Here the payload is the K/V block
-and, instead of storing all p blocks, each device folds every visiting
-block into a flash-style online-softmax accumulator (running max /
-normalizer / weighted sum), so per-device memory is O(S/p + S/p·d) and
-the score matrix never materializes beyond one (S/p)² tile. This is the
-standard blockwise ring attention construction (Liu et al., 2023) built
-from the same ``ppermute`` shift the collective library uses.
+and, instead of storing all p blocks, each device attends its resident
+queries against every visiting block with the fused flash kernel
+(``icikit.ops.flash_attention``) and merges the partial results by
+their log-sum-exp weights — the standard blockwise ring attention
+construction (Liu et al., 2023) built from the same ``ppermute`` shift
+the collective library uses. Per-device memory is O(S/p·d); the score
+matrix never materializes beyond the kernel's VMEM tiles.
 
-Causal masking is applied per (query-block, key-block) pair from the
-blocks' *global* positions; blocks strictly in the future contribute
-nothing and their tile reduces to a no-op (the accumulator update is
-exact, not approximate).
+Causal masking per visiting block is one of three modes decided by the
+blocks' global positions: *skip* (block strictly in the future — no
+compute at all via ``lax.switch``), *diagonal* (own block — standard
+causal), *full* (block strictly in the past — unmasked). The merge is
+exact, not approximate: fully-skipped blocks carry lse = −inf and zero
+weight.
 """
 
 from __future__ import annotations
@@ -24,36 +27,58 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from icikit.models.attention.dense import NEG_INF
+from icikit.ops.flash_attention import flash_attention_with_lse
 from icikit.parallel.shmap import shard_map, shift_perm
 from icikit.utils.mesh import DEFAULT_AXIS
 from jax.sharding import PartitionSpec as P
 
 
-def _tile_update(carry, q_scaled, k_blk, v_blk, mask):
-    """Fold one K/V tile into the (m, l, o) online-softmax accumulator.
+def _attend_block(q, k_blk, v_blk, mode, scale):
+    """Attend q against one visiting K/V block.
 
-    Matmuls run in the inputs' dtype with fp32 accumulation
-    (``preferred_element_type``): bf16 inputs take the MXU's fast path,
-    fp32 inputs are bit-identical to the previous always-upcast code.
-    The softmax statistics (m, l) and output accumulator stay fp32.
+    ``mode``: 0 = skip (fully masked), 1 = diagonal causal, 2 = fully
+    visible. Returns ``(o (b, s, h, d) fp32, lse (b, h, s) fp32)``;
+    skipped blocks contribute lse = −inf so the merge ignores them.
     """
-    m, l, o = carry
-    logits = jnp.einsum("bqhd,bkhd->bhqk", q_scaled, k_blk,
-                        preferred_element_type=jnp.float32)
-    if mask is not None:
-        logits = jnp.where(mask, logits, NEG_INF)
-    m_new = jnp.maximum(m, logits.max(axis=-1))
-    # Fully-masked rows keep m == NEG_INF; exp(logits - NEG_INF) would
-    # overflow, so renormalize against a finite reference instead.
-    m_ref = jnp.maximum(m_new, -1e30)
-    alpha = jnp.exp(m - m_ref)
-    w = jnp.exp(logits - m_ref[..., None])
-    l_new = l * alpha + w.sum(axis=-1)
-    o_new = o * alpha[..., None] + jnp.einsum(
-        "bhqk,bkhd->bhqd", w.astype(v_blk.dtype), v_blk,
-        preferred_element_type=jnp.float32)
-    return m_new, l_new, o_new
+    def _skip(q, k, v):
+        # Outputs built *from* the operands (not fresh constants) so all
+        # switch branches agree on which mesh axes they vary over.
+        zkv = (k[(0,) * k.ndim] * 0 + v[(0,) * v.ndim] * 0
+               ).astype(jnp.float32)
+        o = q.astype(jnp.float32) * 0.0 + zkv
+        lse = (jnp.moveaxis(q[..., 0].astype(jnp.float32) * 0.0, 1, 2)
+               + zkv - jnp.inf)
+        return o, lse
+
+    def _diag(q, k, v):
+        o, lse = flash_attention_with_lse(q, k, v, causal=True, scale=scale)
+        return o.astype(jnp.float32), lse
+
+    def _full(q, k, v):
+        o, lse = flash_attention_with_lse(q, k, v, causal=False, scale=scale)
+        return o.astype(jnp.float32), lse
+
+    return lax.switch(mode, (_skip, _diag, _full), q, k_blk, v_blk)
+
+
+def _merge(o, lse, o_t, lse_t):
+    """Fold a normalized partial result into the running one by lse
+    weights. Exact: both operands are softmax-normalized over their own
+    key sets; the output is normalized over the union. −inf lse (empty
+    key sets) carry zero weight; −1e30 is the finite reference that
+    keeps exp() well-defined when both sides are empty."""
+    m = jnp.maximum(jnp.maximum(lse, lse_t), -1e30)
+    w = jnp.exp(lse - m)
+    w_t = jnp.exp(lse_t - m)
+    tot = w + w_t
+    tot_safe = jnp.where(tot == 0.0, 1.0, tot)
+
+    def bshd(x):  # (b, h, s) weight -> (b, s, h, 1) broadcast
+        return jnp.moveaxis(x, 1, 2)[..., None]
+
+    o_new = o * bshd(w / tot_safe) + o_t * bshd(w_t / tot_safe)
+    lse_new = jnp.where(tot == 0.0, -jnp.inf, m + jnp.log(tot_safe))
+    return o_new, lse_new
 
 
 def ring_attention_shard(q: jax.Array, k: jax.Array, v: jax.Array,
@@ -64,27 +89,23 @@ def ring_attention_shard(q: jax.Array, k: jax.Array, v: jax.Array,
     if scale is None:
         scale = d ** -0.5
     r = lax.axis_index(axis)
-    q_scaled = (q.astype(jnp.float32) * scale).astype(q.dtype)
 
-    m = jnp.full((b, h, s), NEG_INF, jnp.float32)
-    l = jnp.zeros((b, h, s), jnp.float32)
-    o = jnp.zeros((b, h, s, d), jnp.float32)
+    o = jnp.zeros((b, s, h, d), jnp.float32)
+    lse = jnp.full((b, h, s), -jnp.inf, jnp.float32)
     k_cur, v_cur = k, v
     for t in range(p):
         src = jnp.mod(r - t, p)  # origin device of the visiting block
-        mask = None
         if causal:
-            q_pos = r * s + jnp.arange(s)[:, None]
-            k_pos = src * s + jnp.arange(s)[None, :]
-            mask = q_pos >= k_pos
-        m, l, o = _tile_update((m, l, o), q_scaled, k_cur, v_cur, mask)
+            mode = jnp.where(src == r, 1, jnp.where(src < r, 2, 0))
+        else:
+            mode = jnp.full((), 2, jnp.int32)
+        o_t, lse_t = _attend_block(q, k_cur, v_cur, mode, scale)
+        o, lse = _merge(o, lse, o_t, lse_t)
         if t < p - 1:
             # the reference's forward-what-you-received ring discipline
             k_cur = lax.ppermute(k_cur, axis, shift_perm(p, 1))
             v_cur = lax.ppermute(v_cur, axis, shift_perm(p, 1))
-    l_safe = jnp.where(l == 0.0, 1.0, l)
-    out = (o / l_safe[..., None]).astype(q.dtype)
-    return jnp.einsum("bhqd->bqhd", out)
+    return o.astype(q.dtype)
 
 
 @lru_cache(maxsize=None)

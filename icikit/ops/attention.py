@@ -10,6 +10,26 @@ import jax.numpy as jnp
 NEG_INF = float(jnp.finfo(jnp.float32).min)
 
 
+def masked_logits(q: jax.Array, k: jax.Array, causal: bool,
+                  scale: float | None, fill: float = NEG_INF) -> jax.Array:
+    """fp32 ``(b, h, s_q, s_kv)`` attention logits with the causal mask
+    applied (end-aligned convention); shared by the dense softmax path
+    and the explicit-logsumexp path (``fill=-inf`` there, so empty rows
+    read as lse = -inf rather than a finite floor)."""
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    # Inputs' dtype on the MXU, fp32 accumulation/softmax (bf16 inputs
+    # take the fast path; fp32 inputs match the always-upcast result).
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    if causal:
+        s_q, s_kv = q.shape[1], k.shape[1]
+        q_pos = jnp.arange(s_q)[:, None] + (s_kv - s_q)
+        k_pos = jnp.arange(s_kv)[None, :]
+        logits = jnp.where(q_pos >= k_pos, logits, fill)
+    return logits
+
+
 def dense_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                     causal: bool = False,
                     scale: float | None = None) -> jax.Array:
@@ -26,17 +46,7 @@ def dense_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     Returns:
       ``(batch, s_q, heads, head_dim)`` in q's dtype.
     """
-    if scale is None:
-        scale = q.shape[-1] ** -0.5
-    # Inputs' dtype on the MXU, fp32 accumulation/softmax (bf16 inputs
-    # take the fast path; fp32 inputs match the always-upcast result).
-    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
-                        preferred_element_type=jnp.float32) * scale
-    if causal:
-        s_q, s_kv = q.shape[1], k.shape[1]
-        q_pos = jnp.arange(s_q)[:, None] + (s_kv - s_q)
-        k_pos = jnp.arange(s_kv)[None, :]
-        logits = jnp.where(q_pos >= k_pos, logits, NEG_INF)
+    logits = masked_logits(q, k, causal, scale)
     w = jax.nn.softmax(logits, axis=-1)
     out = jnp.einsum("bhqk,bkhd->bqhd", w.astype(v.dtype), v,
                      preferred_element_type=jnp.float32)

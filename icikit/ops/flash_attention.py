@@ -35,7 +35,7 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu  # noqa: F401  (TPU lowering)
 
-from icikit.ops.attention import NEG_INF, dense_attention
+from icikit.ops.attention import NEG_INF, dense_attention, masked_logits
 
 _BLOCKS = (1024, 512, 256, 128, 64, 32, 16, 8)
 
@@ -277,23 +277,28 @@ def _bwd_call(qt, kt, vt, do, lse, delta, causal, scale, bq, bk, interpret):
 
 @partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def _flash(qt, kt, vt, causal, scale, bq, bk, interpret):
-    out, _ = _fwd_call(qt, kt, vt, causal, scale, bq, bk, interpret)
-    return out
+    return _fwd_call(qt, kt, vt, causal, scale, bq, bk, interpret)
 
 
 def _flash_fwd(qt, kt, vt, causal, scale, bq, bk, interpret):
     out, lse = _fwd_call(qt, kt, vt, causal, scale, bq, bk, interpret)
-    return out, (qt, kt, vt, out, lse)
+    return (out, lse), (qt, kt, vt, out, lse)
 
 
 def _flash_bwd(causal, scale, bq, bk, interpret, res, g):
+    g_out, g_lse = g
     qt, kt, vt, out, lse = res
     # delta_i = sum_d dO_i·O_i — the rowwise dot that closes the softmax
     # jacobian; cheap (one O(s·d) pass), so computed outside the kernels.
-    delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
+    # The lse cotangent folds into the same tile formula: d lse_i/d s_ij
+    # = p_ij, so ds = p ∘ (dp − delta + g_lse) — passing (delta − g_lse)
+    # through the kernels' delta operand needs no kernel changes (dV is
+    # lse-independent).
+    delta = jnp.sum(g_out.astype(jnp.float32) * out.astype(jnp.float32),
                     axis=-1)[:, :, None, :]
-    dq, dk, dv = _bwd_call(qt, kt, vt, g, lse, delta, causal, scale,
-                           bq, bk, interpret)
+    dq, dk, dv = _bwd_call(qt, kt, vt, g_out, lse,
+                           delta - g_lse.astype(jnp.float32),
+                           causal, scale, bq, bk, interpret)
     return dq, dk, dv
 
 
@@ -301,6 +306,54 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
 # ----------------------------------------------------------------- public
+
+def _dense_with_lse(q, k, v, causal, scale):
+    """Oracle fallback returning (out, lse) — materializes the logits.
+    Masks with true -inf so fully-masked rows (causal with s_q > s_kv)
+    honor the blockwise-merge contract: lse = -inf, zero output."""
+    logits = masked_logits(q, k, causal, scale, fill=-jnp.inf)
+    lse = jax.nn.logsumexp(logits, axis=-1)
+    w = jnp.where(jnp.isneginf(lse)[..., None], 0.0,
+                  jnp.exp(logits - lse[..., None]))
+    out = jnp.einsum("bhqk,bkhd->bqhd", w.astype(v.dtype), v,
+                     preferred_element_type=jnp.float32)
+    return out.astype(q.dtype), lse
+
+
+def _flash_supported(sq, sk, causal):
+    bq, bk = _pick_q_block(sq), _pick_block(sk)
+    if bq is None or bk is None or (causal and sq != sk):
+        return None
+    backend = jax.default_backend()
+    if backend not in ("tpu", "cpu"):
+        # No Mosaic lowering (e.g. GPU): the compiled dense oracle beats
+        # the Pallas interpreter by orders of magnitude.
+        return None
+    return bq, bk, backend == "cpu"  # CPU meshes run the same kernels
+
+
+def flash_attention_with_lse(q: jax.Array, k: jax.Array, v: jax.Array,
+                             causal: bool = False,
+                             scale: float | None = None):
+    """Flash attention returning the per-row log-sum-exp as well.
+
+    Returns ``(out (b, s_q, h, d), lse (b, h, s_q))``. The lse is what
+    blockwise consumers (the ring schedule) need to merge partial
+    attention results exactly; its cotangent is handled by the custom
+    backward. Unsupported shapes/backends fall back to the dense oracle
+    with an explicit logsumexp.
+    """
+    sup = _flash_supported(q.shape[1], k.shape[1], causal)
+    if sup is None:
+        return _dense_with_lse(q, k, v, causal, scale)
+    bq, bk, interpret = sup
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    qt, kt, vt = (x.transpose(0, 2, 1, 3) for x in (q, k, v))
+    out, lse = _flash(qt, kt, vt, bool(causal), float(scale), bq, bk,
+                      interpret)
+    return out.transpose(0, 2, 1, 3), lse[:, :, 0, :]
+
 
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                     causal: bool = False,
@@ -317,21 +370,9 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
       oracle up to fp32-accumulation reassociation. Shapes the tiling
       cannot cover fall back to the oracle.
     """
-    sq, sk = q.shape[1], k.shape[1]
-    bq, bk = _pick_q_block(sq), _pick_block(sk)
-    if bq is None or bk is None or (causal and sq != sk):
+    if _flash_supported(q.shape[1], k.shape[1], causal) is None:
         return dense_attention(q, k, v, causal=causal, scale=scale)
-    backend = jax.default_backend()
-    if backend not in ("tpu", "cpu"):
-        # No Mosaic lowering (e.g. GPU): the compiled dense oracle beats
-        # the Pallas interpreter by orders of magnitude.
-        return dense_attention(q, k, v, causal=causal, scale=scale)
-    if scale is None:
-        scale = q.shape[-1] ** -0.5
-    interpret = backend == "cpu"  # CPU meshes exercise the same kernels
-    qt, kt, vt = (x.transpose(0, 2, 1, 3) for x in (q, k, v))
-    out = _flash(qt, kt, vt, bool(causal), float(scale), bq, bk, interpret)
-    return out.transpose(0, 2, 1, 3)
+    return flash_attention_with_lse(q, k, v, causal=causal, scale=scale)[0]
 
 
 def resolve_attention_impl(name: str):

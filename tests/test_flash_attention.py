@@ -71,6 +71,21 @@ def test_fallback_shapes():
     assert _pick_block(1024) == 1024
 
 
+def test_with_lse_empty_rows_contract():
+    # causal with s_q > s_kv (dense fallback): rows whose key set is
+    # empty must carry lse = -inf and zero output for exact blockwise
+    # merging (the ring schedule's contract).
+    from icikit.ops.flash_attention import flash_attention_with_lse
+    ks = jax.random.split(jax.random.key(5), 3)
+    q = jax.random.normal(ks[0], (1, 8, 2, 16))
+    k = jax.random.normal(ks[1], (1, 4, 2, 16))
+    v = jax.random.normal(ks[2], (1, 4, 2, 16))
+    out, lse = flash_attention_with_lse(q, k, v, causal=True)
+    assert np.all(np.isneginf(np.asarray(lse)[:, :, :4]))  # q_pos < 0
+    np.testing.assert_array_equal(np.asarray(out)[:, :4], 0.0)
+    assert np.all(np.isfinite(np.asarray(lse)[:, :, 4:]))
+
+
 def test_unknown_impl_rejected():
     from icikit.ops.flash_attention import resolve_attention_impl
     with pytest.raises(ValueError, match="unknown attention impl"):

@@ -1,0 +1,207 @@
+"""Attention kernel benchmark: dense vs flash (and the SP schedules).
+
+The model-side analog of the collective sweep (``icikit/bench/harness.py``
+= ``Communication/src/main.cc:390-502``): sweep sequence lengths, verify
+every variant against the dense oracle, report fenced timings and
+achieved TFLOP/s. On a single chip the subjects are the local kernels
+(dense, flash); on a multi-device mesh the sequence-parallel schedules
+(ring, ulysses) join the comparison — the same hand-rolled-vs-vendor
+science, applied to the attention family.
+
+CLI::
+
+    python -m icikit.bench.attention --seqs 1024,4096 --mode fwdbwd
+
+FLOPs accounting: forward = 4·b·s²·h·d (two matmuls), halved when
+causal; backward adds 2.5× forward (five matmuls incl. the probability
+recompute). Approximate by design — softmax/mask ops excluded.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import sys
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from icikit.utils.timing import timeit_chained
+
+
+@dataclass
+class AttnRecord:
+    impl: str
+    mode: str             # "fwd" | "fwdbwd"
+    batch: int
+    seq: int
+    heads: int
+    d_head: int
+    dtype: str
+    causal: bool
+    p: int                # devices (1 = local kernel)
+    runs: int
+    mean_s: float
+    best_s: float
+    tflops: float         # achieved, best-run
+    max_err: float        # vs the dense oracle (forward output)
+    verified: bool
+
+    def to_json(self) -> str:
+        return json.dumps(dataclasses.asdict(self))
+
+
+def attention_flops(batch, seq, heads, d_head, causal, mode) -> float:
+    fwd = 4.0 * batch * seq * seq * heads * d_head * (0.5 if causal else 1.0)
+    return fwd * (3.5 if mode == "fwdbwd" else 1.0)
+
+
+def _impl_fns(mesh):
+    """name -> callable(q, k, v, causal) for the subjects on this mesh."""
+    from icikit.ops.attention import dense_attention
+    from icikit.ops.flash_attention import flash_attention
+
+    fns = {
+        "dense": lambda q, k, v, causal: dense_attention(q, k, v,
+                                                         causal=causal),
+        "flash": lambda q, k, v, causal: flash_attention(q, k, v,
+                                                         causal=causal),
+    }
+    if mesh is not None and np.prod(list(mesh.shape.values())) > 1:
+        from icikit.models.attention.ring import ring_attention
+        from icikit.models.attention.ulysses import ulysses_attention
+        fns["ring"] = lambda q, k, v, causal: ring_attention(
+            q, k, v, mesh, causal=causal)
+        fns["ulysses"] = lambda q, k, v, causal: ulysses_attention(
+            q, k, v, mesh, causal=causal)
+    return fns
+
+
+def sweep_attention(seqs, impls=None, batch=4, heads=8, d_head=64,
+                    dtype="bfloat16", causal=True, mode="fwdbwd",
+                    runs=10, warmup=2, mesh=None, tol=3e-2):
+    """Benchmark + verify each impl over a sequence-length sweep."""
+    from icikit.ops.attention import dense_attention
+
+    fns = _impl_fns(mesh)
+    impls = list(impls or fns)
+    p = int(np.prod(list(mesh.shape.values()))) if mesh is not None else 1
+    dt = jnp.dtype(dtype)
+    records = []
+    for seq in seqs:
+        ks = jax.random.split(jax.random.key(seq), 3)
+        q, k, v = (jax.random.normal(kk, (batch, seq, heads, d_head), dt)
+                   for kk in ks)
+        if mode == "fwd":
+            want = np.asarray(dense_attention(q, k, v, causal=causal),
+                              jnp.float32)
+        else:
+            want = jax.jit(jax.grad(
+                lambda q, k, v:
+                dense_attention(q, k, v, causal=causal
+                                ).astype(jnp.float32).sum(),
+                argnums=(0, 1, 2)))(q, k, v)
+        for name in impls:
+            fn = fns[name]
+            if mode == "fwd":
+                run = jax.jit(lambda q, k, v, f=fn: f(q, k, v, causal))
+                first = lambda out: out
+            else:
+                run = jax.jit(jax.grad(
+                    lambda q, k, v, f=fn:
+                    f(q, k, v, causal).astype(jnp.float32).sum(),
+                    argnums=(0, 1, 2)))
+                first = lambda out: out[0]
+            def rel_err(a, b):
+                # magnitude-normalized: bf16 subjects differ from the
+                # oracle by ~1 ulp at the value's own scale
+                a = np.asarray(a, jnp.float32)
+                b = np.asarray(b, jnp.float32)
+                return float(np.abs(a - b).max() / max(1.0,
+                                                       np.abs(b).max()))
+
+            if mode == "fwd":
+                err = rel_err(fn(q, k, v, causal), want)
+            else:
+                # verify the timed subject: gradients vs the dense oracle
+                err = max(rel_err(a, b) for a, b in zip(run(q, k, v), want))
+
+            def chain(a, out, first=first):
+                # next q depends on this run's output: no caching layer
+                # can elide executions (see timeit_chained)
+                return (a[0] + 0.01 * first(out).astype(a[0].dtype),
+                        a[1], a[2])
+
+            with jax.profiler.TraceAnnotation(f"attention/{name}/s{seq}"):
+                res = timeit_chained(run, (q, k, v), chain, runs=runs,
+                                     warmup=warmup)
+            fl = attention_flops(batch, seq, heads, d_head, causal, mode)
+            records.append(AttnRecord(
+                impl=name, mode=mode, batch=batch, seq=seq, heads=heads,
+                d_head=d_head, dtype=dt.name, causal=causal, p=p,
+                runs=res.runs, mean_s=res.mean_s, best_s=res.best_s,
+                tflops=fl / res.best_s / 1e12, max_err=err,
+                verified=err <= tol))
+    return records
+
+
+def format_table(records) -> str:
+    if not records:
+        return "(no records)"
+    hdr = (f"{'impl':<9} {'mode':<7} {'seq':>6} {'p':>3} "
+           f"{'mean_ms':>9} {'best_ms':>9} {'TFLOP/s':>9} "
+           f"{'max_err':>9} {'ok':>3}")
+    lines = [hdr, "-" * len(hdr)]
+    for r in records:
+        lines.append(
+            f"{r.impl:<9} {r.mode:<7} {r.seq:>6} {r.p:>3} "
+            f"{r.mean_s * 1e3:>9.3f} {r.best_s * 1e3:>9.3f} "
+            f"{r.tflops:>9.2f} {r.max_err:>9.2e} "
+            f"{'✓' if r.verified else '✗':>3}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--seqs", default="512,1024,2048,4096")
+    ap.add_argument("--impls", default=None,
+                    help="comma-separated (default: all on this mesh)")
+    ap.add_argument("--batch", type=int, default=4)
+    ap.add_argument("--heads", type=int, default=8)
+    ap.add_argument("--dhead", type=int, default=64)
+    ap.add_argument("--dtype", default="bfloat16")
+    ap.add_argument("--mode", default="fwdbwd", choices=["fwd", "fwdbwd"])
+    ap.add_argument("--no-causal", dest="causal", action="store_false")
+    ap.add_argument("--runs", type=int, default=10)
+    ap.add_argument("--warmup", type=int, default=2)
+    ap.add_argument("--devices", type=int, default=None,
+                    help="use a p-device mesh (adds ring/ulysses)")
+    ap.add_argument("--json", dest="json_path", default=None)
+    args = ap.parse_args(argv)
+
+    mesh = None
+    if args.devices and args.devices > 1:
+        from icikit.utils.mesh import make_mesh
+        mesh = make_mesh(args.devices)
+    records = sweep_attention(
+        tuple(int(s) for s in args.seqs.split(",")),
+        args.impls.split(",") if args.impls else None,
+        batch=args.batch, heads=args.heads, d_head=args.dhead,
+        dtype=args.dtype, causal=args.causal, mode=args.mode,
+        runs=args.runs, warmup=args.warmup, mesh=mesh)
+    print(format_table(records))
+    if args.json_path:
+        with open(args.json_path, "w") as f:
+            for r in records:
+                f.write(r.to_json() + "\n")
+    if not all(r.verified for r in records):
+        print("VERIFICATION FAILURES present", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

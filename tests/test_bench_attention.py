@@ -1,0 +1,40 @@
+"""Attention-benchmark harness: record production, verification against
+the dense oracle, table formatting — on the simulated 8-device mesh the
+sequence-parallel schedules join the sweep (SURVEY.md §4.6)."""
+
+import numpy as np
+
+from icikit.bench.attention import (
+    attention_flops,
+    format_table,
+    sweep_attention,
+)
+
+
+def test_local_sweep_fwd():
+    recs = sweep_attention((64,), impls=("dense", "flash"), batch=1,
+                           heads=2, d_head=16, dtype="float32",
+                           mode="fwd", runs=2, warmup=1)
+    assert [r.impl for r in recs] == ["dense", "flash"]
+    assert all(r.verified for r in recs)
+    assert all(r.best_s > 0 and np.isfinite(r.tflops) for r in recs)
+    table = format_table(recs)
+    assert "flash" in table and "✓" in table
+
+
+def test_mesh_sweep_includes_schedules(mesh8):
+    recs = sweep_attention((64,), batch=1, heads=8, d_head=16,
+                           dtype="float32", mode="fwd", runs=1, warmup=1,
+                           mesh=mesh8)
+    impls = {r.impl for r in recs}
+    assert {"dense", "flash", "ring", "ulysses"} <= impls
+    assert all(r.verified for r in recs), [
+        (r.impl, r.max_err) for r in recs]
+    assert all(r.p == 8 for r in recs)
+
+
+def test_flops_accounting():
+    fwd = attention_flops(2, 128, 4, 32, causal=False, mode="fwd")
+    assert fwd == 4.0 * 2 * 128 * 128 * 4 * 32
+    assert attention_flops(2, 128, 4, 32, True, "fwd") == fwd / 2
+    assert attention_flops(2, 128, 4, 32, False, "fwdbwd") == fwd * 3.5

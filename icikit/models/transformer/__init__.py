@@ -32,7 +32,10 @@ from icikit.models.transformer.model import (  # noqa: F401
     make_train_step,
     param_specs,
 )
-from icikit.models.transformer.decode import greedy_generate  # noqa: F401
+from icikit.models.transformer.decode import (  # noqa: F401
+    greedy_generate,
+    sample_generate,
+)
 from icikit.models.transformer.moe import moe_ffn_shard  # noqa: F401
 from icikit.models.transformer.pipeline import (  # noqa: F401
     init_pp_params,

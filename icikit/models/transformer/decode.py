@@ -1,13 +1,17 @@
 """Autoregressive decoding with a KV cache (tensor-parallel capable).
 
 Training owns the big collective machinery; decoding is the other half
-of a complete model surface. Greedy decode with per-layer K/V caches:
-prefill runs the prompt once and saves keys/values, each decode step
-attends one query position against the cache — O(T) per token instead
-of O(T²) re-forward. Runs on the same (dp, tp, sp) mesh as training
-with sp = 1: batch shards over dp, heads (and the cache) shard over tp,
-the two per-layer psums close the Megatron pairs exactly as in
-``model._forward_local``.
+of a complete model surface. Prefill runs the prompt once and saves
+per-layer K/V; each decode step attends one query position against the
+cache — O(T) per token instead of O(T²) re-forward. Runs on the same
+(dp, tp, sp) mesh as training with sp = 1: batch shards over dp, heads
+(and the cache) shard over tp, the two per-layer psums close the
+Megatron pairs exactly as in ``model._forward_local``.
+
+Token selection is pluggable: greedy argmax (``greedy_generate``) or
+temperature / top-k / nucleus sampling (``sample_generate``, keyed by a
+JAX PRNG key folded with the dp shard index and step, so shards and
+steps draw independently and runs are reproducible).
 """
 
 from __future__ import annotations
@@ -29,6 +33,7 @@ from icikit.models.transformer.model import (
     _rms_norm,
     param_specs,
 )
+from icikit.ops.rope import apply_rope
 from icikit.parallel.shmap import wrap_program
 
 
@@ -46,8 +51,46 @@ def _masked_attention(q, ks, vs, cur, scale):
     return out.astype(q.dtype)
 
 
+def _top_k_mask(lg, k):
+    thr = lax.top_k(lg, k)[0][:, -1:]
+    return jnp.where(lg < thr, -jnp.inf, lg)
+
+
+def _top_p_mask(lg, p):
+    """Nucleus filter: keep the smallest prefix of the sorted
+    distribution with cumulative probability >= p (p = 1 keeps all)."""
+    srt = jnp.sort(lg, axis=-1)[:, ::-1]
+    probs = jax.nn.softmax(srt, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    keep = cum - probs < p          # first token always kept
+    thr = jnp.min(jnp.where(keep, srt, jnp.inf), axis=-1, keepdims=True)
+    return jnp.where(lg < thr, -jnp.inf, lg)
+
+
+def _make_selector(sampling):
+    """sampling: ("greedy",) or ("sample", top_k) — only top_k must be
+    static (``lax.top_k``); temperature and top_p arrive as traced
+    scalars so sweeping them reuses one compiled program. Returns
+    select(logits (b, V) fp32, key, knobs (2,) fp32) -> (b,) int32."""
+    if sampling[0] == "greedy":
+        return lambda logits, key, knobs: jnp.argmax(logits, axis=-1)
+    _, top_k = sampling
+
+    def select(logits, key, knobs):
+        temperature, top_p = knobs[0], knobs[1]
+        lg = logits / jnp.maximum(temperature, 1e-6)
+        if top_k:
+            lg = _top_k_mask(lg, top_k)
+        lg = _top_p_mask(lg, top_p)
+        return jax.random.categorical(key, lg, axis=-1)
+
+    return select
+
+
 @lru_cache(maxsize=None)
-def _build_generate(mesh, cfg: TransformerConfig, s_prompt: int, n_new: int):
+def _build_generate(mesh, cfg: TransformerConfig, s_prompt: int, n_new: int,
+                    sampling: tuple = ("greedy",)):
+    select = _make_selector(sampling)
     if cfg.n_experts:
         raise ValueError("decode supports the dense FFN only")
     if n_new < 1:
@@ -82,15 +125,26 @@ def _build_generate(mesh, cfg: TransformerConfig, s_prompt: int, n_new: int):
         return jnp.einsum("bd,dv->bv", h.astype(cdt),
                           params["w_out"].astype(cdt)).astype(jnp.float32)
 
-    def per_shard(params, prompt):
+    def per_shard(params, prompt, key_data, knobs):
         b = prompt.shape[0]
         lp = {k: params[k] for k in layer_keys}
+        # per-shard stream: dp shards hold different batch rows and must
+        # draw independently; tp/sp shards must agree (they replicate).
+        key = jax.random.fold_in(jax.random.wrap_key_data(key_data),
+                                 lax.axis_index(DP_AXIS))
 
         # --- prefill: full causal forward, caching padded K/V.
-        x = params["emb"][prompt] + params["pos"][:s_prompt]
+        x = params["emb"][prompt]
+        if cfg.pos_encoding == "learned":
+            x = x + params["pos"][:s_prompt]
 
         def prefill_layer(x, lp1):
             q, k, v = qkv_proj(x, lp1)
+            if cfg.pos_encoding == "rope":
+                # the cache stores rotated keys, as every step's are
+                pos = jnp.arange(s_prompt)
+                q = apply_rope(q, pos, cfg.rope_theta)
+                k = apply_rope(k, pos, cfg.rope_theta)
             # Attend over the prompt's own K/V only; the total-length
             # zero padding exists solely for the scan-carry cache shape.
             logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
@@ -111,17 +165,24 @@ def _build_generate(mesh, cfg: TransformerConfig, s_prompt: int, n_new: int):
             return x, (ks, vs)
 
         x, (kcache, vcache) = lax.scan(prefill_layer, x, lp)
-        tok0 = jnp.argmax(logits_last(params, x[:, -1]), axis=-1)
+        tok0 = select(logits_last(params, x[:, -1]),
+                      jax.random.fold_in(key, 0), knobs)
 
         # --- decode loop: one position at a time against the cache.
         def step(carry, i):
             token, kcache, vcache = carry
             cur = s_prompt + i
-            x = params["emb"][token][:, None] + params["pos"][cur][None, None]
+            x = params["emb"][token][:, None]
+            if cfg.pos_encoding == "learned":
+                x = x + params["pos"][cur][None, None]
 
             def dec_layer(x, layer_in):
                 lp1, ks, vs = layer_in
                 q, k, v = qkv_proj(x, lp1)
+                if cfg.pos_encoding == "rope":
+                    pos = cur[None]
+                    q = apply_rope(q, pos, cfg.rope_theta)
+                    k = apply_rope(k, pos, cfg.rope_theta)
                 ks = lax.dynamic_update_slice_in_dim(ks, k, cur, 1)
                 vs = lax.dynamic_update_slice_in_dim(vs, v, cur, 1)
                 attn = _masked_attention(q, ks, vs, cur, scale)
@@ -131,7 +192,8 @@ def _build_generate(mesh, cfg: TransformerConfig, s_prompt: int, n_new: int):
 
             x, (kcache, vcache) = lax.scan(dec_layer, x,
                                            (lp, kcache, vcache))
-            nxt = jnp.argmax(logits_last(params, x[:, 0]), axis=-1)
+            nxt = select(logits_last(params, x[:, 0]),
+                         jax.random.fold_in(key, i + 1), knobs)
             return (nxt, kcache, vcache), token
 
         # n_new - 1 steps: each emits its incoming token and computes the
@@ -144,7 +206,8 @@ def _build_generate(mesh, cfg: TransformerConfig, s_prompt: int, n_new: int):
                                axis=1)
 
     return wrap_program(per_shard, mesh,
-                        (param_specs(cfg), P(DP_AXIS, None)),
+                        (param_specs(cfg), P(DP_AXIS, None), P(None),
+                         P(None)),
                         P(DP_AXIS, None))
 
 
@@ -152,4 +215,29 @@ def greedy_generate(params, prompt, mesh, cfg: TransformerConfig,
                     n_new: int) -> jax.Array:
     """Greedy continuation: int32 ``prompt`` (B, S) sharded over dp ->
     (B, S + n_new) tokens (prompt followed by the argmax decode)."""
-    return _build_generate(mesh, cfg, prompt.shape[1], n_new)(params, prompt)
+    key_data = jax.random.key_data(jax.random.key(0))  # unused by greedy
+    knobs = jnp.ones((2,), jnp.float32)                 # unused by greedy
+    return _build_generate(mesh, cfg, prompt.shape[1], n_new)(
+        params, prompt, key_data, knobs)
+
+
+def sample_generate(params, prompt, mesh, cfg: TransformerConfig,
+                    n_new: int, key, temperature: float = 1.0,
+                    top_k: int = 0, top_p: float = 1.0) -> jax.Array:
+    """Sampled continuation with temperature / top-k / nucleus filters.
+
+    ``key``: a ``jax.random`` PRNG key; the same key reproduces the same
+    continuation. ``top_k=0`` and ``top_p=1.0`` disable the respective
+    filters (``top_k=1`` reduces to greedy).
+    """
+    if temperature < 0:
+        raise ValueError(f"temperature must be >= 0, got {temperature}")
+    if not 0.0 < top_p <= 1.0:
+        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+    if not 0 <= top_k <= cfg.vocab:
+        raise ValueError(f"top_k must be in [0, vocab={cfg.vocab}], "
+                         f"got {top_k}")
+    knobs = jnp.asarray([temperature, top_p], jnp.float32)
+    return _build_generate(mesh, cfg, prompt.shape[1], n_new,
+                           ("sample", int(top_k)))(
+        params, prompt, jax.random.key_data(key), knobs)

@@ -34,6 +34,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from icikit.models.attention.ring import ring_attention_shard
 from icikit.models.transformer.moe import moe_ffn_shard
 from icikit.ops.flash_attention import resolve_attention_impl
+from icikit.ops.rope import apply_rope
 from icikit.parallel.shmap import wrap_program
 
 DP_AXIS, TP_AXIS, SP_AXIS = "dp", "tp", "sp"
@@ -66,6 +67,11 @@ class TransformerConfig:
     # its full local sequence (sp == 1, pipeline stages); the ring
     # schedule owns the sp > 1 path.
     attention_impl: str = "flash"
+    # Positional encoding: "learned" (trained absolute table, the
+    # default) or "rope" (rotary on Q/K — relative positions, so every
+    # schedule applies it locally with global indices; no "pos" param).
+    pos_encoding: str = "learned"
+    rope_theta: float = 10000.0
 
 
 def make_model_mesh(n_devices: int | None = None, dp: int = 1, tp: int = 1,
@@ -85,16 +91,27 @@ def make_model_mesh(n_devices: int | None = None, dp: int = 1, tp: int = 1,
     return Mesh(arr, (DP_AXIS, TP_AXIS, SP_AXIS))
 
 
+def _check_cfg(cfg: TransformerConfig) -> None:
+    if cfg.pos_encoding not in ("learned", "rope"):
+        raise ValueError(f"unknown pos_encoding {cfg.pos_encoding!r} "
+                         "(known: learned, rope)")
+    if cfg.pos_encoding == "rope" and cfg.d_head % 2:
+        raise ValueError("RoPE requires an even d_head, got "
+                         f"{cfg.d_head}")
+
+
 def param_specs(cfg: TransformerConfig) -> dict:
     """PartitionSpec per parameter leaf (layer-stacked on dim 0)."""
+    _check_cfg(cfg)
     specs = {
         "emb": P(),
-        "pos": P(),
         "ln1": P(), "ln2": P(), "ln_f": P(),
         "wqkv": P(None, None, None, TP_AXIS, None),  # (L, D, 3, H, Dh)
         "wo": P(None, TP_AXIS, None, None),          # (L, H, Dh, D)
         "w_out": P(),                                # (D, V)
     }
+    if cfg.pos_encoding == "learned":
+        specs["pos"] = P()
     if cfg.n_experts:
         specs.update({
             "wr": P(),                                # (L, D, E)
@@ -121,7 +138,6 @@ def init_params(key, cfg: TransformerConfig, mesh: Mesh) -> dict:
 
     params = {
         "emb": norm(ks[0], (cfg.vocab, D), D),
-        "pos": norm(ks[1], (cfg.max_seq, D), D),
         "ln1": jnp.ones((L, D), jnp.float32),
         "ln2": jnp.ones((L, D), jnp.float32),
         "ln_f": jnp.ones((D,), jnp.float32),
@@ -129,6 +145,8 @@ def init_params(key, cfg: TransformerConfig, mesh: Mesh) -> dict:
         "wo": norm(ks[3], (L, H, Dh, D), H * Dh),
         "w_out": norm(ks[6], (D, cfg.vocab), D),
     }
+    if cfg.pos_encoding == "learned":
+        params["pos"] = norm(ks[1], (cfg.max_seq, D), D)
     if cfg.n_experts:
         E = cfg.n_experts
         ke = jax.random.split(ks[4], 2)
@@ -183,13 +201,18 @@ def _forward_local(params, tokens, cfg: TransformerConfig, p_sp: int,
     cdt = jnp.dtype(cfg.compute_dtype)
     b, s = tokens.shape
     r_sp = lax.axis_index(SP_AXIS)
-    pos = lax.dynamic_slice_in_dim(params["pos"], r_sp * s, s, 0)
-    x = params["emb"][tokens] + pos  # (b, s, D) fp32
+    positions = r_sp * s + jnp.arange(s)  # this shard's global positions
+    x = params["emb"][tokens]  # (b, s, D) fp32
+    if cfg.pos_encoding == "learned":
+        x = x + lax.dynamic_slice_in_dim(params["pos"], r_sp * s, s, 0)
 
     def psum_tp(v):
         return lax.psum(v, TP_AXIS)
 
     def attention(q, k, v):
+        if cfg.pos_encoding == "rope":
+            q = apply_rope(q, positions, cfg.rope_theta)
+            k = apply_rope(k, positions, cfg.rope_theta)
         if p_sp == 1:  # full sequence is local: use the fused kernel
             return resolve_attention_impl(cfg.attention_impl)(
                 q, k, v, causal=True)

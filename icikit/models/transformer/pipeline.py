@@ -33,9 +33,11 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from icikit.ops.flash_attention import resolve_attention_impl
+from icikit.ops.rope import apply_rope
 from icikit.models.transformer.model import (
     TransformerConfig,
     _attn_block,
+    _check_cfg,
     _dense_ffn_block,
     _rms_norm,
 )
@@ -56,14 +58,18 @@ def make_pp_mesh(dp: int = 1, pp: int = 1, devices=None) -> Mesh:
 def pp_param_specs(cfg: TransformerConfig) -> dict:
     """Same parameter tree as ``model.param_specs`` but layer-stacked
     leaves shard their layer dim over ``pp`` (dense FFN only)."""
+    _check_cfg(cfg)
     if cfg.n_experts:
         raise ValueError("pipeline path supports the dense FFN only")
-    return {
-        "emb": P(), "pos": P(), "ln_f": P(), "w_out": P(),
+    specs = {
+        "emb": P(), "ln_f": P(), "w_out": P(),
         "ln1": P(PP_AXIS), "ln2": P(PP_AXIS),
         "wqkv": P(PP_AXIS), "wo": P(PP_AXIS),
         "w1": P(PP_AXIS), "w2": P(PP_AXIS),
     }
+    if cfg.pos_encoding == "learned":
+        specs["pos"] = P()
+    return specs
 
 
 def init_pp_params(key, cfg: TransformerConfig, mesh: Mesh) -> dict:
@@ -86,6 +92,10 @@ def _stage_layers(x, lp, cfg, cdt):
     no tp reduction."""
 
     def attention(q, k, v):
+        if cfg.pos_encoding == "rope":
+            s = q.shape[1]
+            q = apply_rope(q, jnp.arange(s), cfg.rope_theta)
+            k = apply_rope(k, jnp.arange(s), cfg.rope_theta)
         return resolve_attention_impl(cfg.attention_impl)(
             q, k, v, causal=True)
 
@@ -121,8 +131,9 @@ def _build_pp_loss_and_grad(mesh, cfg: TransformerConfig, n_microbatches: int,
         loss_sum = jnp.zeros((), jnp.float32)
         for t in range(m + p - 1):
             if t < m:  # inject microbatch t at stage 0
-                emb_x = (params["emb"][tokens[t]]
-                         + params["pos"][:s]).astype(jnp.float32)
+                emb_x = params["emb"][tokens[t]].astype(jnp.float32)
+                if cfg.pos_encoding == "learned":
+                    emb_x = emb_x + params["pos"][:s]
                 x = jnp.where((r == 0)[None, None, None], emb_x, x)
             x = _stage_layers(x, lp, cfg, cdt)
             j = t - (p - 1)

@@ -1,0 +1,41 @@
+"""Rotary position embeddings (RoPE, Su et al. 2021).
+
+Split-half (NeoX) convention: the head dimension is viewed as d/2
+complex pairs ``(x[..., :d/2], x[..., d/2:])`` and pair ``j`` at
+position ``m`` is rotated by angle ``m · theta^(-2j/d)``. Rotation acts
+on Q and K after projection, so attention logits depend only on
+*relative* positions — which is what lets every parallel schedule
+(ring over sp, pipeline stages, the decode cache) apply it locally with
+its own global position indices and still agree globally.
+
+Pure VPU elementwise work; XLA fuses it into the surrounding projection
+matmuls, so no Pallas kernel is warranted.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def rope_angles(positions: jax.Array, d: int,
+                theta: float = 10000.0) -> jax.Array:
+    """Angles ``(len(positions), d/2)`` in fp32."""
+    if d % 2:
+        raise ValueError(f"head dim must be even for RoPE, got {d}")
+    inv = theta ** (-jnp.arange(0, d, 2, dtype=jnp.float32) / d)
+    return positions.astype(jnp.float32)[:, None] * inv[None, :]
+
+
+def apply_rope(x: jax.Array, positions: jax.Array,
+               theta: float = 10000.0) -> jax.Array:
+    """Rotate ``x (b, s, h, d)`` by its positions ``(s,)``; same dtype."""
+    d = x.shape[-1]
+    ang = rope_angles(positions, d, theta)
+    cos = jnp.cos(ang)[None, :, None, :]
+    sin = jnp.sin(ang)[None, :, None, :]
+    x1 = x[..., :d // 2].astype(jnp.float32)
+    x2 = x[..., d // 2:].astype(jnp.float32)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos],
+                          axis=-1)
+    return out.astype(x.dtype)

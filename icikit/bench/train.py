@@ -41,7 +41,9 @@ PRESETS = {
 
 
 def matmul_param_count(cfg) -> int:
-    per_layer = (cfg.d_model * 3 * cfg.n_heads * cfg.d_head   # wqkv
+    kv_heads = cfg.n_kv_heads or cfg.n_heads
+    per_layer = (cfg.d_model * cfg.n_heads * cfg.d_head       # q proj
+                 + 2 * cfg.d_model * kv_heads * cfg.d_head    # k, v proj
                  + cfg.n_heads * cfg.d_head * cfg.d_model     # wo
                  + 2 * cfg.d_model * cfg.d_ff)                # w1, w2
     return (cfg.n_layers * per_layer
@@ -72,7 +74,8 @@ def detect_peak() -> float:
 
 
 def run_bench(preset: str, dp: int, tp: int, sp: int, batch: int,
-              steps: int, warmup: int, moe_experts: int = 0) -> dict:
+              steps: int, warmup: int, moe_experts: int = 0,
+              kv_heads: int = 0) -> dict:
     import optax
 
     from icikit.models.transformer import (
@@ -81,7 +84,8 @@ def run_bench(preset: str, dp: int, tp: int, sp: int, batch: int,
     from icikit.utils.timing import fence
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    cfg = TransformerConfig(**PRESETS[preset], n_experts=moe_experts)
+    cfg = TransformerConfig(**PRESETS[preset], n_experts=moe_experts,
+                            n_kv_heads=kv_heads)
     mesh = make_model_mesh(dp=dp, tp=tp, sp=sp)
     params = init_params(jax.random.key(0), cfg, mesh)
     optimizer, step = make_train_step(mesh, cfg, optax.adam(1e-4))
@@ -110,8 +114,10 @@ def run_bench(preset: str, dp: int, tp: int, sp: int, batch: int,
     flops = step_flops(cfg, batch, seq)
     peak = detect_peak() * n_dev
     moe_tag = f"_e{moe_experts}" if moe_experts else ""
+    kv_tag = f"_kv{kv_heads}" if kv_heads else ""
     return {
-        "metric": f"train_{preset}_dp{dp}tp{tp}sp{sp}_b{batch}{moe_tag}",
+        "metric":
+            f"train_{preset}_dp{dp}tp{tp}sp{sp}_b{batch}{moe_tag}{kv_tag}",
         "value": round(tokens_s, 1),
         "unit": "tokens/s",
         "step_ms": round(dt * 1e3, 2),
@@ -132,9 +138,11 @@ def main(argv=None) -> int:
     ap.add_argument("--warmup", type=int, default=3)
     ap.add_argument("--experts", type=int, default=0,
                     help="n_experts > 0 benches the MoE variant")
+    ap.add_argument("--kv-heads", type=int, default=0,
+                    help="n_kv_heads > 0 benches the GQA variant")
     args = ap.parse_args(argv)
     rec = run_bench(args.preset, args.dp, args.tp, args.sp, args.batch,
-                    args.steps, args.warmup, args.experts)
+                    args.steps, args.warmup, args.experts, args.kv_heads)
     print(json.dumps(rec))
     return 0
 

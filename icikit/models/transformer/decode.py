@@ -29,26 +29,36 @@ from icikit.models.transformer.model import (
     SP_AXIS,
     TP_AXIS,
     TransformerConfig,
+    _attn_param_keys,
+    _check_mesh_cfg,
     _dense_ffn_block,
+    _n_rep,
+    _project_qkv,
     _rms_norm,
     param_specs,
+    repeat_kv,
 )
 from icikit.ops.rope import apply_rope
 from icikit.parallel.shmap import wrap_program
 
 
-def _masked_attention(q, ks, vs, cur, scale):
-    """q (b, 1, h, dh) against cache ks/vs (b, T, h, dh), attending
-    positions <= cur. fp32 softmax, matmul dtype follows inputs."""
-    logits = jnp.einsum("bqhd,bkhd->bhqk", q, ks,
+def _masked_attention(q, ks, vs, cur, scale, n_rep):
+    """q (b, 1, h, dh) against the *un-repeated* cache ks/vs
+    (b, T, h/n_rep, dh), attending positions <= cur. GQA groups are
+    served by a grouped einsum — the cache is never materialized at
+    n_heads width, which is the point of the shrunken cache. fp32
+    softmax, matmul dtype follows inputs."""
+    b, one, h, dh = q.shape
+    qg = q.reshape(b, one, h // n_rep, n_rep, dh)
+    logits = jnp.einsum("bqgrd,bkgd->bgrqk", qg, ks,
                         preferred_element_type=jnp.float32) * scale
     t = ks.shape[1]
-    mask = (jnp.arange(t) <= cur)[None, None, None, :]
+    mask = (jnp.arange(t) <= cur)[None, None, None, None, :]
     logits = jnp.where(mask, logits, NEG_INF)
     w = jax.nn.softmax(logits, axis=-1)
-    out = jnp.einsum("bhqk,bkhd->bqhd", w.astype(vs.dtype), vs,
+    out = jnp.einsum("bgrqk,bkgd->bqgrd", w.astype(vs.dtype), vs,
                      preferred_element_type=jnp.float32)
-    return out.astype(q.dtype)
+    return out.reshape(b, one, h, dh).astype(q.dtype)
 
 
 def _top_k_mask(lg, k):
@@ -104,12 +114,14 @@ def _build_generate(mesh, cfg: TransformerConfig, s_prompt: int, n_new: int,
         raise ValueError(f"prompt + new tokens = {total} exceeds "
                          f"max_seq = {cfg.max_seq}")
     scale = cfg.d_head ** -0.5
-    layer_keys = ("ln1", "ln2", "wqkv", "wo", "w1", "w2")
+    _check_mesh_cfg(cfg, mesh)
+    n_rep = _n_rep(cfg)
+    layer_keys = ("ln1", "ln2", *_attn_param_keys(cfg),
+                  "wo", "w1", "w2")
 
     def qkv_proj(x, lp):
         h = _rms_norm(x, lp["ln1"]).astype(cdt)
-        qkv = jnp.einsum("bsd,dthe->bsthe", h, lp["wqkv"].astype(cdt))
-        return qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        return _project_qkv(h, lp, cdt)
 
     def close_attn(x, attn, lp):
         o = jnp.einsum("bshe,hed->bsd", attn.astype(cdt),
@@ -147,13 +159,16 @@ def _build_generate(mesh, cfg: TransformerConfig, s_prompt: int, n_new: int,
                 k = apply_rope(k, pos, cfg.rope_theta)
             # Attend over the prompt's own K/V only; the total-length
             # zero padding exists solely for the scan-carry cache shape.
-            logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+            # GQA: the cache keeps the n_kv_heads projections; repeat
+            # serves the query-head groups at attention time only.
+            logits = jnp.einsum("bqhd,bkhd->bhqk", q, repeat_kv(k, n_rep),
                                 preferred_element_type=jnp.float32) * scale
             qpos = jnp.arange(s_prompt)[:, None]
             kpos = jnp.arange(s_prompt)[None, :]
             logits = jnp.where((kpos <= qpos)[None, None], logits, NEG_INF)
             w = jax.nn.softmax(logits, axis=-1)
-            attn = jnp.einsum("bhqk,bkhd->bqhd", w.astype(v.dtype), v,
+            attn = jnp.einsum("bhqk,bkhd->bqhd", w.astype(v.dtype),
+                              repeat_kv(v, n_rep),
                               preferred_element_type=jnp.float32
                               ).astype(q.dtype)
             x = close_attn(x, attn, lp1)
@@ -185,7 +200,7 @@ def _build_generate(mesh, cfg: TransformerConfig, s_prompt: int, n_new: int,
                     k = apply_rope(k, pos, cfg.rope_theta)
                 ks = lax.dynamic_update_slice_in_dim(ks, k, cur, 1)
                 vs = lax.dynamic_update_slice_in_dim(vs, v, cur, 1)
-                attn = _masked_attention(q, ks, vs, cur, scale)
+                attn = _masked_attention(q, ks, vs, cur, scale, n_rep)
                 x = close_attn(x, attn, lp1)
                 x = ffn(x, lp1)
                 return x, (ks, vs)

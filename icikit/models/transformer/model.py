@@ -72,6 +72,11 @@ class TransformerConfig:
     # schedule applies it locally with global indices; no "pos" param).
     pos_encoding: str = "learned"
     rope_theta: float = 10000.0
+    # Grouped-query attention: n_kv_heads > 0 projects K/V to that many
+    # heads (must divide n_heads); each K/V head serves an
+    # n_heads/n_kv_heads group of query heads. Shrinks the decode cache
+    # and K/V projection by the same factor. 0 = MHA (one K/V per Q).
+    n_kv_heads: int = 0
 
 
 def make_model_mesh(n_devices: int | None = None, dp: int = 1, tp: int = 1,
@@ -98,6 +103,34 @@ def _check_cfg(cfg: TransformerConfig) -> None:
     if cfg.pos_encoding == "rope" and cfg.d_head % 2:
         raise ValueError("RoPE requires an even d_head, got "
                          f"{cfg.d_head}")
+    if cfg.n_kv_heads and cfg.n_heads % cfg.n_kv_heads:
+        raise ValueError(f"n_kv_heads={cfg.n_kv_heads} must divide "
+                         f"n_heads={cfg.n_heads}")
+
+
+def _is_gqa(cfg: TransformerConfig) -> bool:
+    return bool(cfg.n_kv_heads) and cfg.n_kv_heads != cfg.n_heads
+
+
+def _n_rep(cfg: TransformerConfig) -> int:
+    """Query heads served per K/V head."""
+    return cfg.n_heads // cfg.n_kv_heads if _is_gqa(cfg) else 1
+
+
+def _attn_param_keys(cfg: TransformerConfig) -> tuple:
+    return ("wq", "wkv") if _is_gqa(cfg) else ("wqkv",)
+
+
+def _check_mesh_cfg(cfg: TransformerConfig, mesh) -> None:
+    """Mesh-dependent validation, surfaced before shard_map would fail
+    with an opaque uneven-sharding error."""
+    tp = mesh.shape.get(TP_AXIS, 1)
+    if cfg.n_heads % tp:
+        raise ValueError(f"n_heads={cfg.n_heads} must divide over tp={tp}")
+    kv = cfg.n_kv_heads or cfg.n_heads
+    if kv % tp:
+        raise ValueError(f"n_kv_heads={kv} must divide over tp={tp} "
+                         "(each tp shard needs whole K/V head groups)")
 
 
 def param_specs(cfg: TransformerConfig) -> dict:
@@ -106,10 +139,14 @@ def param_specs(cfg: TransformerConfig) -> dict:
     specs = {
         "emb": P(),
         "ln1": P(), "ln2": P(), "ln_f": P(),
-        "wqkv": P(None, None, None, TP_AXIS, None),  # (L, D, 3, H, Dh)
         "wo": P(None, TP_AXIS, None, None),          # (L, H, Dh, D)
         "w_out": P(),                                # (D, V)
     }
+    if _is_gqa(cfg):
+        specs["wq"] = P(None, None, TP_AXIS, None)   # (L, D, H, Dh)
+        specs["wkv"] = P(None, None, None, TP_AXIS, None)  # (L,D,2,Hkv,Dh)
+    else:
+        specs["wqkv"] = P(None, None, None, TP_AXIS, None)  # (L,D,3,H,Dh)
     if cfg.pos_encoding == "learned":
         specs["pos"] = P()
     if cfg.n_experts:
@@ -141,10 +178,15 @@ def init_params(key, cfg: TransformerConfig, mesh: Mesh) -> dict:
         "ln1": jnp.ones((L, D), jnp.float32),
         "ln2": jnp.ones((L, D), jnp.float32),
         "ln_f": jnp.ones((D,), jnp.float32),
-        "wqkv": norm(ks[2], (L, D, 3, H, Dh), D),
         "wo": norm(ks[3], (L, H, Dh, D), H * Dh),
         "w_out": norm(ks[6], (D, cfg.vocab), D),
     }
+    if _is_gqa(cfg):
+        kq, kkv = jax.random.split(ks[2])
+        params["wq"] = norm(kq, (L, D, H, Dh), D)
+        params["wkv"] = norm(kkv, (L, D, 2, cfg.n_kv_heads, Dh), D)
+    else:
+        params["wqkv"] = norm(ks[2], (L, D, 3, H, Dh), D)
     if cfg.pos_encoding == "learned":
         params["pos"] = norm(ks[1], (cfg.max_seq, D), D)
     if cfg.n_experts:
@@ -167,16 +209,35 @@ def _rms_norm(x, g):
     return (x32 * r) * g
 
 
+def _project_qkv(h, lp, cdt):
+    """(b, s, D) -> q (b, s, H', Dh), k/v (b, s, Hkv', Dh) — per-shard
+    head counts when tp-sharded. GQA K/V heads are repeated up to the
+    query head count at attention time, not here (the decode path
+    caches them un-repeated)."""
+    if "wq" in lp:
+        q = jnp.einsum("bsd,dhe->bshe", h, lp["wq"].astype(cdt))
+        kv = jnp.einsum("bsd,dthe->bsthe", h, lp["wkv"].astype(cdt))
+        return q, kv[:, :, 0], kv[:, :, 1]
+    qkv = jnp.einsum("bsd,dthe->bsthe", h, lp["wqkv"].astype(cdt))
+    return qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+
+
+def repeat_kv(k, n_rep: int):
+    """Repeat K/V heads to serve their query-head groups (GQA)."""
+    return k if n_rep == 1 else jnp.repeat(k, n_rep, axis=2)
+
+
 def _attn_block(x, lp, cdt, attention, reduce_out):
     """Pre-norm attention sublayer, shared by the sp and pp paths.
 
     ``attention(q, k, v) -> (b, s, h, d)`` supplies the schedule (ring
-    over sp, dense within a pipeline stage); ``reduce_out`` closes the
-    column->row tensor-parallel pair (identity when not tp-sharded).
+    over sp, dense within a pipeline stage) and owns GQA head
+    repetition; ``reduce_out`` closes the column->row tensor-parallel
+    pair (identity when not tp-sharded).
     """
     h = _rms_norm(x, lp["ln1"]).astype(cdt)
-    qkv = jnp.einsum("bsd,dthe->bsthe", h, lp["wqkv"].astype(cdt))
-    attn = attention(qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2])
+    q, k, v = _project_qkv(h, lp, cdt)
+    attn = attention(q, k, v)
     o = jnp.einsum("bshe,hed->bsd", attn.astype(cdt), lp["wo"].astype(cdt))
     return x + reduce_out(o.astype(jnp.float32))
 
@@ -209,10 +270,13 @@ def _forward_local(params, tokens, cfg: TransformerConfig, p_sp: int,
     def psum_tp(v):
         return lax.psum(v, TP_AXIS)
 
+    n_rep = _n_rep(cfg)
+
     def attention(q, k, v):
         if cfg.pos_encoding == "rope":
             q = apply_rope(q, positions, cfg.rope_theta)
             k = apply_rope(k, positions, cfg.rope_theta)
+        k, v = repeat_kv(k, n_rep), repeat_kv(v, n_rep)
         if p_sp == 1:  # full sequence is local: use the fused kernel
             return resolve_attention_impl(cfg.attention_impl)(
                 q, k, v, causal=True)
@@ -237,9 +301,10 @@ def _forward_local(params, tokens, cfg: TransformerConfig, p_sp: int,
             aux = jnp.zeros((), jnp.float32)
         return x, aux
 
-    layer_keys = (("ln1", "ln2", "wqkv", "wo", "wr", "we1", "we2")
+    attn_keys = _attn_param_keys(cfg)
+    layer_keys = (("ln1", "ln2", *attn_keys, "wo", "wr", "we1", "we2")
                   if cfg.n_experts else
-                  ("ln1", "ln2", "wqkv", "wo", "w1", "w2"))
+                  ("ln1", "ln2", *attn_keys, "wo", "w1", "w2"))
     layer_params = {k: params[k] for k in layer_keys}
     x, auxes = lax.scan(jax.checkpoint(layer) if cfg.remat else layer,
                         x, layer_params)
@@ -260,6 +325,7 @@ def _local_loss(params, tokens, targets, cfg, p_sp, p_dp, denom):
 
 @lru_cache(maxsize=None)
 def _build_loss_and_grad(mesh, cfg: TransformerConfig, batch_shape):
+    _check_mesh_cfg(cfg, mesh)
     p_sp = mesh.shape[SP_AXIS]
     p_dp = mesh.shape[DP_AXIS]
     denom = batch_shape[0] * batch_shape[1] * p_dp * p_sp  # global tokens

@@ -37,9 +37,12 @@ from icikit.ops.rope import apply_rope
 from icikit.models.transformer.model import (
     TransformerConfig,
     _attn_block,
+    _attn_param_keys,
     _check_cfg,
     _dense_ffn_block,
+    _n_rep,
     _rms_norm,
+    repeat_kv,
 )
 from icikit.parallel.shmap import shard_map, wrap_program
 
@@ -64,9 +67,11 @@ def pp_param_specs(cfg: TransformerConfig) -> dict:
     specs = {
         "emb": P(), "ln_f": P(), "w_out": P(),
         "ln1": P(PP_AXIS), "ln2": P(PP_AXIS),
-        "wqkv": P(PP_AXIS), "wo": P(PP_AXIS),
+        "wo": P(PP_AXIS),
         "w1": P(PP_AXIS), "w2": P(PP_AXIS),
     }
+    for k in _attn_param_keys(cfg):
+        specs[k] = P(PP_AXIS)
     if cfg.pos_encoding == "learned":
         specs["pos"] = P()
     return specs
@@ -91,11 +96,14 @@ def _stage_layers(x, lp, cfg, cdt):
     shared layer body with causal ``cfg.attention_impl`` attention and
     no tp reduction."""
 
+    n_rep = _n_rep(cfg)
+
     def attention(q, k, v):
         if cfg.pos_encoding == "rope":
             s = q.shape[1]
             q = apply_rope(q, jnp.arange(s), cfg.rope_theta)
             k = apply_rope(k, jnp.arange(s), cfg.rope_theta)
+        k, v = repeat_kv(k, n_rep), repeat_kv(v, n_rep)
         return resolve_attention_impl(cfg.attention_impl)(
             q, k, v, causal=True)
 
@@ -125,7 +133,8 @@ def _build_pp_loss_and_grad(mesh, cfg: TransformerConfig, n_microbatches: int,
     def local_loss(params, tokens, targets):
         r = lax.axis_index(PP_AXIS)
         b, s = tokens.shape[1], tokens.shape[2]
-        layer_keys = ("ln1", "ln2", "wqkv", "wo", "w1", "w2")
+        layer_keys = ("ln1", "ln2", *_attn_param_keys(cfg),
+                      "wo", "w1", "w2")
         lp = {k: params[k] for k in layer_keys}
         x = jnp.zeros((b, s, cfg.d_model), jnp.float32)
         loss_sum = jnp.zeros((), jnp.float32)

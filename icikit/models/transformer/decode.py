@@ -134,8 +134,21 @@ def _build_generate(mesh, cfg: TransformerConfig, s_prompt: int, n_new: int,
 
     def logits_last(params, x_last):
         h = _rms_norm(x_last, params["ln_f"])
-        return jnp.einsum("bd,dv->bv", h.astype(cdt),
-                          params["w_out"].astype(cdt)).astype(jnp.float32)
+        lg = jnp.einsum("bd,dv->bv", h.astype(cdt),
+                        params["w_out"].astype(cdt)).astype(jnp.float32)
+        if cfg.vocab_parallel:
+            # Reassemble the full row by scattering the local shard
+            # into zeros and psum'ing. This costs ~2x an all_gather's
+            # traffic (ring allreduce vs gather on a (b, V) row — tiny
+            # per step), but psum output is statically tp-invariant:
+            # shard_map's replication check rejects the all_gather form
+            # (its output carries a varying-over-tp tag in this jax).
+            r = lax.axis_index(TP_AXIS)
+            v_loc = lg.shape[1]
+            full = jnp.zeros((lg.shape[0], cfg.vocab), jnp.float32)
+            full = lax.dynamic_update_slice(full, lg, (0, r * v_loc))
+            lg = lax.psum(full, TP_AXIS)
+        return lg
 
     def per_shard(params, prompt, key_data, knobs):
         b = prompt.shape[0]

@@ -6,8 +6,9 @@ Layout (mesh axes ``dp``, ``tp``, ``sp``):
 - attention weights: head dimension over ``tp`` (column-parallel QKV,
   row-parallel output projection closed by one ``psum`` over ``tp``);
 - MLP weights: hidden dimension over ``tp`` (same column→row pattern);
-- embeddings / norms / output head: replicated (vocabularies here are
-  small; a vocab-parallel head would follow the same column→row rule);
+- embeddings / norms: replicated; the output head is replicated by
+  default or vocab-sharded over ``tp`` with distributed cross-entropy
+  (``vocab_parallel=True`` — the Megatron head);
 - attention over the sequence: the library's ring schedule
   (``icikit.models.attention.ring.ring_attention_shard``) on the ``sp``
   axis — the reference's ring all-to-all
@@ -77,6 +78,11 @@ class TransformerConfig:
     # n_heads/n_kv_heads group of query heads. Shrinks the decode cache
     # and K/V projection by the same factor. 0 = MHA (one K/V per Q).
     n_kv_heads: int = 0
+    # Vocab-parallel head (Megatron): shard w_out's vocab dim over tp
+    # and compute cross-entropy distributedly (pmax/psum-logsumexp +
+    # owner-shard target gather) — each tp shard holds V/tp logits
+    # instead of all V. Requires vocab % tp == 0.
+    vocab_parallel: bool = False
 
 
 def make_model_mesh(n_devices: int | None = None, dp: int = 1, tp: int = 1,
@@ -131,6 +137,9 @@ def _check_mesh_cfg(cfg: TransformerConfig, mesh) -> None:
     if kv % tp:
         raise ValueError(f"n_kv_heads={kv} must divide over tp={tp} "
                          "(each tp shard needs whole K/V head groups)")
+    if cfg.vocab_parallel and cfg.vocab % tp:
+        raise ValueError(f"vocab_parallel requires vocab={cfg.vocab} "
+                         f"divisible by tp={tp}")
 
 
 def param_specs(cfg: TransformerConfig) -> dict:
@@ -140,7 +149,8 @@ def param_specs(cfg: TransformerConfig) -> dict:
         "emb": P(),
         "ln1": P(), "ln2": P(), "ln_f": P(),
         "wo": P(None, TP_AXIS, None, None),          # (L, H, Dh, D)
-        "w_out": P(),                                # (D, V)
+        "w_out": (P(None, TP_AXIS) if cfg.vocab_parallel
+                  else P()),                         # (D, V)
     }
     if _is_gqa(cfg):
         specs["wq"] = P(None, None, TP_AXIS, None)   # (L, D, H, Dh)
@@ -165,6 +175,7 @@ def param_specs(cfg: TransformerConfig) -> dict:
 
 def init_params(key, cfg: TransformerConfig, mesh: Mesh) -> dict:
     """fp32 master params, placed with their mesh shardings."""
+    _check_mesh_cfg(cfg, mesh)
     L, D, H, Dh, F = (cfg.n_layers, cfg.d_model, cfg.n_heads, cfg.d_head,
                       cfg.d_ff)
     ks = jax.random.split(key, 7)
@@ -314,13 +325,45 @@ def _forward_local(params, tokens, cfg: TransformerConfig, p_sp: int,
     return logits, auxes.sum()
 
 
-def _local_loss(params, tokens, targets, cfg, p_sp, p_dp, denom):
+def _vocab_parallel_nll(logits, targets):
+    """Token NLL from *vocab-sharded* logits (b, s, V/tp): the Megatron
+    head. Max and log-sum-exp reduce over tp; the shard owning each
+    target id contributes its logit via a masked psum. All three
+    collectives ride the innermost (fastest) mesh axis."""
+    v_loc = logits.shape[-1]
+    r = lax.axis_index(TP_AXIS)
+    # the max shift is stability-only (its gradient cancels exactly);
+    # pmax has no VJP rule even under stop_gradient, so reduce via the
+    # differentiable all_gather and a local max
+    m = lax.stop_gradient(jnp.max(
+        lax.all_gather(logits.max(axis=-1), TP_AXIS, axis=0), axis=0))
+    z = lax.psum(jnp.exp(logits - m[..., None]).sum(-1), TP_AXIS)
+    loc = targets - r * v_loc
+    own = (loc >= 0) & (loc < v_loc)
+    safe = jnp.clip(loc, 0, v_loc - 1)
+    tl = jnp.take_along_axis(logits, safe[..., None], axis=-1)[..., 0]
+    tgt_logit = lax.psum(jnp.where(own, tl, 0.0), TP_AXIS)
+    return m + jnp.log(z) - tgt_logit                          # (b, s)
+
+
+def _local_loss(params, tokens, targets, cfg, p_sp, p_dp, p_tp, denom):
     logits, aux = _forward_local(params, tokens, cfg, p_sp, p_dp)
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)
+    if cfg.vocab_parallel:
+        nll = _vocab_parallel_nll(logits, targets)
+    else:
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(logp, targets[..., None],
+                                   axis=-1)[..., 0]
     # aux is a per-shard mean-style penalty; dividing by the number of
     # dp x sp shards makes the final psum over (dp, sp) an average.
-    return nll.sum() / denom + cfg.moe_aux_coef * aux / (p_dp * p_sp)
+    loss = nll.sum() / denom + cfg.moe_aux_coef * aux / (p_dp * p_sp)
+    if cfg.vocab_parallel:
+        # every tp shard computed the identical value (the head math
+        # closes with psums), but the gathered-max path leaves a
+        # varying-over-tp tag; one scalar psum makes the replication
+        # explicit for shard_map's check (exact for power-of-2 tp).
+        loss = lax.psum(loss, TP_AXIS) / p_tp
+    return loss
 
 
 @lru_cache(maxsize=None)
@@ -334,7 +377,8 @@ def _build_loss_and_grad(mesh, cfg: TransformerConfig, batch_shape):
 
     def per_shard(params, tokens, targets):
         loss, grads = jax.value_and_grad(_local_loss)(
-            params, tokens, targets, cfg, p_sp, p_dp, denom)
+            params, tokens, targets, cfg, p_sp, p_dp,
+            mesh.shape[TP_AXIS], denom)
         # No explicit gradient psums: each param enters replicated over
         # the axes its spec doesn't name, the auto-inserted pvary's
         # transpose IS the cross-shard psum, so ``grads`` leaves are

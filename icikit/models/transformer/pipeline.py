@@ -64,6 +64,9 @@ def pp_param_specs(cfg: TransformerConfig) -> dict:
     _check_cfg(cfg)
     if cfg.n_experts:
         raise ValueError("pipeline path supports the dense FFN only")
+    if cfg.vocab_parallel:
+        raise ValueError("vocab_parallel shards over tp, which the "
+                         "(dp, pp) pipeline mesh does not have")
     specs = {
         "emb": P(), "ln_f": P(), "w_out": P(),
         "ln1": P(PP_AXIS), "ln2": P(PP_AXIS),

@@ -29,15 +29,16 @@ from icikit.models.transformer.model import (
     SP_AXIS,
     TP_AXIS,
     TransformerConfig,
-    _attn_param_keys,
     _check_mesh_cfg,
     _dense_ffn_block,
+    _layer_keys,
     _n_rep,
     _project_qkv,
     _rms_norm,
     param_specs,
     repeat_kv,
 )
+from icikit.models.transformer.moe import moe_ffn_shard
 from icikit.ops.rope import apply_rope
 from icikit.parallel.shmap import wrap_program
 
@@ -101,8 +102,6 @@ def _make_selector(sampling):
 def _build_generate(mesh, cfg: TransformerConfig, s_prompt: int, n_new: int,
                     sampling: tuple = ("greedy",)):
     select = _make_selector(sampling)
-    if cfg.n_experts:
-        raise ValueError("decode supports the dense FFN only")
     if n_new < 1:
         raise ValueError(f"n_new must be >= 1, got {n_new}")
     if mesh.shape[SP_AXIS] != 1:
@@ -116,8 +115,8 @@ def _build_generate(mesh, cfg: TransformerConfig, s_prompt: int, n_new: int,
     scale = cfg.d_head ** -0.5
     _check_mesh_cfg(cfg, mesh)
     n_rep = _n_rep(cfg)
-    layer_keys = ("ln1", "ln2", *_attn_param_keys(cfg),
-                  "wo", "w1", "w2")
+    p_dp = mesh.shape[DP_AXIS]
+    layer_keys = _layer_keys(cfg)
 
     def qkv_proj(x, lp):
         h = _rms_norm(x, lp["ln1"]).astype(cdt)
@@ -129,6 +128,20 @@ def _build_generate(mesh, cfg: TransformerConfig, s_prompt: int, n_new: int,
         return x + lax.psum(o.astype(jnp.float32), TP_AXIS)
 
     def ffn(x, lp):
+        if cfg.n_experts:
+            # Dropless dispatch at decode (capacity = all local tokens):
+            # the training-time capacity drop is a pool-level property
+            # that an incremental decode cannot reproduce, and dropping
+            # tokens at inference only hurts; experts still shard over
+            # dp, carried by the configured all-to-all schedule.
+            h2 = _rms_norm(x, lp["ln2"]).astype(cdt)
+            m, _ = moe_ffn_shard(
+                h2, lp["wr"].astype(cdt), lp["we1"].astype(cdt),
+                lp["we2"].astype(cdt), axis=DP_AXIS, p=p_dp,
+                n_experts=cfg.n_experts,
+                capacity_factor=float(cfg.n_experts),
+                algorithm=cfg.moe_algorithm)
+            return x + m.astype(jnp.float32)
         return _dense_ffn_block(x, lp, cdt,
                                 lambda v: lax.psum(v, TP_AXIS))
 

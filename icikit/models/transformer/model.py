@@ -33,6 +33,7 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from icikit.models.attention.ring import ring_attention_shard
+from icikit.models.attention.ulysses import ulysses_attention_shard
 from icikit.models.transformer.moe import moe_ffn_shard
 from icikit.ops.flash_attention import resolve_attention_impl
 from icikit.ops.rope import apply_rope
@@ -83,6 +84,13 @@ class TransformerConfig:
     # owner-shard target gather) — each tp shard holds V/tp logits
     # instead of all V. Requires vocab % tp == 0.
     vocab_parallel: bool = False
+    # Sequence-parallel schedule for sp > 1: "ring" (neighbor ppermute
+    # K/V rotation, any sequence length) or "ulysses" (all-to-all
+    # head<->sequence re-shard; needs n_heads/tp divisible by sp).
+    # sp_algorithm picks the alltoall variant carrying a ulysses
+    # re-shard ("xla" or any registered hand-rolled schedule).
+    sequence_schedule: str = "ring"
+    sp_algorithm: str = "xla"
 
 
 def make_model_mesh(n_devices: int | None = None, dp: int = 1, tp: int = 1,
@@ -103,6 +111,10 @@ def make_model_mesh(n_devices: int | None = None, dp: int = 1, tp: int = 1,
 
 
 def _check_cfg(cfg: TransformerConfig) -> None:
+    if cfg.sequence_schedule not in ("ring", "ulysses"):
+        raise ValueError(
+            f"unknown sequence_schedule {cfg.sequence_schedule!r} "
+            "(known: ring, ulysses)")
     if cfg.pos_encoding not in ("learned", "rope"):
         raise ValueError(f"unknown pos_encoding {cfg.pos_encoding!r} "
                          "(known: learned, rope)")
@@ -127,6 +139,13 @@ def _attn_param_keys(cfg: TransformerConfig) -> tuple:
     return ("wq", "wkv") if _is_gqa(cfg) else ("wqkv",)
 
 
+def _layer_keys(cfg: TransformerConfig) -> tuple:
+    """Per-layer parameter names — single source for the scan bodies in
+    the training forward and the decode cache path."""
+    ffn = (("wr", "we1", "we2") if cfg.n_experts else ("w1", "w2"))
+    return ("ln1", "ln2", *_attn_param_keys(cfg), "wo", *ffn)
+
+
 def _check_mesh_cfg(cfg: TransformerConfig, mesh) -> None:
     """Mesh-dependent validation, surfaced before shard_map would fail
     with an opaque uneven-sharding error."""
@@ -140,6 +159,12 @@ def _check_mesh_cfg(cfg: TransformerConfig, mesh) -> None:
     if cfg.vocab_parallel and cfg.vocab % tp:
         raise ValueError(f"vocab_parallel requires vocab={cfg.vocab} "
                          f"divisible by tp={tp}")
+    sp = mesh.shape.get(SP_AXIS, 1)
+    if (cfg.sequence_schedule == "ulysses" and sp > 1
+            and (cfg.n_heads // tp) % sp):
+        raise ValueError(
+            f"ulysses needs per-tp-shard heads ({cfg.n_heads}/{tp}) "
+            f"divisible by sp={sp}")
 
 
 def param_specs(cfg: TransformerConfig) -> dict:
@@ -291,6 +316,14 @@ def _forward_local(params, tokens, cfg: TransformerConfig, p_sp: int,
         if p_sp == 1:  # full sequence is local: use the fused kernel
             return resolve_attention_impl(cfg.attention_impl)(
                 q, k, v, causal=True)
+        if cfg.sequence_schedule == "ulysses":
+            # note: GQA K/V are repeated to full width before the
+            # re-shard (layout shared with the ring path); un-repeated
+            # re-sharding would cut the K/V a2a volume by n_rep at the
+            # cost of a second head-count path through ulysses
+            return ulysses_attention_shard(
+                q, k, v, SP_AXIS, p_sp, causal=True, scale=None,
+                algorithm=cfg.sp_algorithm, local=cfg.attention_impl)
         return ring_attention_shard(q, k, v, SP_AXIS, p_sp, causal=True,
                                     scale=None)
 
@@ -312,11 +345,7 @@ def _forward_local(params, tokens, cfg: TransformerConfig, p_sp: int,
             aux = jnp.zeros((), jnp.float32)
         return x, aux
 
-    attn_keys = _attn_param_keys(cfg)
-    layer_keys = (("ln1", "ln2", *attn_keys, "wo", "wr", "we1", "we2")
-                  if cfg.n_experts else
-                  ("ln1", "ln2", *attn_keys, "wo", "w1", "w2"))
-    layer_params = {k: params[k] for k in layer_keys}
+    layer_params = {k: params[k] for k in _layer_keys(cfg)}
     x, auxes = lax.scan(jax.checkpoint(layer) if cfg.remat else layer,
                         x, layer_params)
     x = _rms_norm(x, params["ln_f"])

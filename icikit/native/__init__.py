@@ -11,7 +11,10 @@ Native pieces (reference counterparts in parentheses):
 - ``monotonic_s`` — monotonic clock (``get_timer``'s ``MPI_Wtime``);
 - ``parse_boards`` — reference-format dataset parser (``main.cc:49-66``);
 - ``solve``/``solve_batch`` — host DFS solver + threaded work-queue
-  batch driver (``game.cc:121-138`` + the ``Server``/``Client`` farm).
+  batch driver (``game.cc:121-138`` + the ``Server``/``Client`` farm);
+- ``markov_fill`` — the trainer's data loader: threaded synthetic-corpus
+  generation, bit-identical to the numpy fallback (the reference's
+  p-invariant input generation, ``psort.cc:575-614``).
 """
 
 from __future__ import annotations
@@ -51,28 +54,59 @@ def _try_load():
         except OSError as e:
             _build_error = f"native load failed: {e}"
             return None
-        lib.ik_install_traps.restype = ctypes.c_int
-        lib.ik_watchdog.argtypes = [ctypes.c_uint]
-        lib.ik_trap_count.restype = ctypes.c_int
-        lib.ik_watchdog_soft.argtypes = [ctypes.c_int]
-        lib.ik_monotonic_s.restype = ctypes.c_double
-        lib.ik_monotonic_ns.restype = ctypes.c_int64
-        lib.ik_parse_boards.restype = ctypes.c_int64
-        lib.ik_parse_boards.argtypes = [
-            ctypes.c_char_p, ctypes.c_size_t,
-            ctypes.POINTER(ctypes.c_uint32), ctypes.POINTER(ctypes.c_uint32),
-            ctypes.c_int64]
-        lib.ik_solve.restype = ctypes.c_int
-        lib.ik_solve.argtypes = [
-            ctypes.c_uint32, ctypes.c_uint32, ctypes.c_int64,
-            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
-            ctypes.POINTER(ctypes.c_int64)]
-        lib.ik_solve_batch.restype = ctypes.c_int
-        lib.ik_solve_batch.argtypes = [
-            ctypes.POINTER(ctypes.c_uint32), ctypes.POINTER(ctypes.c_uint32),
-            ctypes.c_int64, ctypes.c_int64, ctypes.c_int, ctypes.c_int,
-            ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(ctypes.c_int32),
-            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int64)]
+        if not hasattr(lib, "ik_markov_fill"):
+            # stale prebuilt library from before the newest entry
+            # points existed: rebuild once and reload
+            try:
+                subprocess.run(["make", "-C", _HERE, "-s", "clean"],
+                               check=True, capture_output=True,
+                               text=True, timeout=60)
+                subprocess.run(["make", "-C", _HERE, "-s"], check=True,
+                               capture_output=True, text=True,
+                               timeout=120)
+                lib = ctypes.CDLL(_LIB_PATH)
+            except (subprocess.SubprocessError, OSError) as e:
+                out = getattr(e, "stderr", "") or str(e)
+                _build_error = ("native library stale and rebuild "
+                                f"failed: {str(out).strip()[:500]}")
+                return None
+        try:
+            lib.ik_install_traps.restype = ctypes.c_int
+            lib.ik_watchdog.argtypes = [ctypes.c_uint]
+            lib.ik_trap_count.restype = ctypes.c_int
+            lib.ik_watchdog_soft.argtypes = [ctypes.c_int]
+            lib.ik_monotonic_s.restype = ctypes.c_double
+            lib.ik_monotonic_ns.restype = ctypes.c_int64
+            lib.ik_parse_boards.restype = ctypes.c_int64
+            lib.ik_parse_boards.argtypes = [
+                ctypes.c_char_p, ctypes.c_size_t,
+                ctypes.POINTER(ctypes.c_uint32),
+                ctypes.POINTER(ctypes.c_uint32),
+                ctypes.c_int64]
+            lib.ik_solve.restype = ctypes.c_int
+            lib.ik_solve.argtypes = [
+                ctypes.c_uint32, ctypes.c_uint32, ctypes.c_int64,
+                ctypes.POINTER(ctypes.c_int32),
+                ctypes.POINTER(ctypes.c_int32),
+                ctypes.POINTER(ctypes.c_int64)]
+            lib.ik_solve_batch.restype = ctypes.c_int
+            lib.ik_solve_batch.argtypes = [
+                ctypes.POINTER(ctypes.c_uint32),
+                ctypes.POINTER(ctypes.c_uint32),
+                ctypes.c_int64, ctypes.c_int64, ctypes.c_int, ctypes.c_int,
+                ctypes.POINTER(ctypes.c_uint8),
+                ctypes.POINTER(ctypes.c_int32),
+                ctypes.POINTER(ctypes.c_int32),
+                ctypes.POINTER(ctypes.c_int64)]
+            lib.ik_markov_fill.restype = ctypes.c_int
+            lib.ik_markov_fill.argtypes = [
+                ctypes.c_int32, ctypes.c_int32, ctypes.c_uint64,
+                ctypes.c_uint64, ctypes.c_int64, ctypes.c_int64,
+                ctypes.c_int, ctypes.POINTER(ctypes.c_int32)]
+        except AttributeError as e:
+            # a future stale-library case the hasattr probe missed
+            _build_error = f"native library missing symbol: {e}"
+            return None
         _lib = lib
         return _lib
 
@@ -217,3 +251,59 @@ def solve_batch(pegs: np.ndarray, playable: np.ndarray,
             moves.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
             steps.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
     return solved.astype(bool), n_moves, moves, steps
+
+
+def markov_fill(vocab: int, branch: int, table_seed: int, stream_seed: int,
+                batch: int, seq: int, n_threads: int = 0):
+    """Fill an int32 (batch, seq+1) Markov-corpus array. Native when
+    available; the numpy fallback computes the identical splitmix64
+    arithmetic, so the corpus is a pure function of the seeds either
+    way (the trainer may resume on a host without a toolchain)."""
+    out = np.empty((batch, seq + 1), np.int32)
+    lib = _try_load()
+    if lib is not None:
+        rc = lib.ik_markov_fill(
+            vocab, branch, table_seed & (2**64 - 1),
+            stream_seed & (2**64 - 1), batch, seq, n_threads,
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+        if rc != 0:
+            raise ValueError(f"ik_markov_fill failed (code {rc})")
+        return out
+    return _markov_fill_py(vocab, branch, table_seed, stream_seed,
+                           batch, seq, out)
+
+
+def _mix64(x: np.ndarray) -> np.ndarray:
+    x = (x + np.uint64(0x9E3779B97F4A7C15))
+    x = (x ^ (x >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
+    x = (x ^ (x >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
+    return x ^ (x >> np.uint64(31))
+
+
+def _markov_fill_py(vocab, branch, table_seed, stream_seed, batch, seq,
+                    out):
+    with np.errstate(over="ignore"):
+        ts = np.uint64(table_seed & (2**64 - 1))
+        ss = np.uint64(stream_seed & (2**64 - 1))
+        rows = np.arange(batch, dtype=np.uint64)
+        # hash the (small-integer) stream seed so adjacent seeds do not
+        # produce shifted-identical draw streams (base + t collisions)
+        base = _mix64(ss) ^ _mix64(rows)              # (batch,)
+        out[:, 0] = (_mix64(base ^ np.uint64(0x243F6A8885A308D3))
+                     % np.uint64(vocab)).astype(np.int32)
+        out[:, 1] = (_mix64(base ^ np.uint64(0x13198A2E03707344))
+                     % np.uint64(vocab)).astype(np.int32)
+        w = np.arange(branch, 0, -1, dtype=np.float64)
+        cum = (w / w.sum()).cumsum()
+        t_idx = np.arange(seq + 1, dtype=np.uint64)
+        u = ((_mix64(base[:, None] + t_idx[None, :]) >> np.uint64(11))
+             * (1.0 / 9007199254740992.0))            # (batch, seq+1)
+        picks = np.minimum(np.searchsorted(cum, u, side="right"),
+                           branch - 1).astype(np.uint64)
+        for t in range(2, seq + 1):
+            a = out[:, t - 2].astype(np.uint64)
+            b = out[:, t - 1].astype(np.uint64)
+            h = _mix64(ts ^ _mix64(a * np.uint64(vocab) + b)
+                       ^ picks[:, t] * np.uint64(0xD6E8FEB86659FD93))
+            out[:, t] = (h % np.uint64(vocab)).astype(np.int32)
+    return out

@@ -62,6 +62,18 @@ int ik_solve_batch(const uint32_t* pegs, const uint32_t* playable,
                    int chunk_size, uint8_t* solved, int32_t* n_moves,
                    int32_t* moves /* n_boards*25 */, int64_t* steps);
 
+/* markov.cc — synthetic-corpus generator (the trainer's data loader).
+ * Fills out[batch][seq+1] with an order-2 Markov chain over [0, vocab):
+ * successor table and all draws derive from splitmix64 finalizers of
+ * (table_seed, stream_seed, indices), so the stream is a pure function
+ * of the seeds — the Python fallback implements the identical
+ * arithmetic and produces bit-equal corpora. n_threads 0 = hardware
+ * concurrency; rows parallelize freely (draws are per-(row, pos)).
+ * Returns 0, or -1 on bad arguments. */
+int ik_markov_fill(int32_t vocab, int32_t branch, uint64_t table_seed,
+                   uint64_t stream_seed, int64_t batch, int64_t seq,
+                   int n_threads, int32_t* out);
+
 #ifdef __cplusplus
 }
 #endif

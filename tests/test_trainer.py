@@ -22,17 +22,35 @@ def _run(capsys, *extra):
 def test_markov_sampler_deterministic():
     import numpy as np
     s = make_markov_sampler(64, seed=0)
-    a = s(np.random.default_rng(1), 2, 16)
-    b = s(np.random.default_rng(1), 2, 16)
+    a = s(1, 2, 16)
+    b = s(1, 2, 16)
     np.testing.assert_array_equal(a, b)
+    assert not np.array_equal(a, s(2, 2, 16))
     assert a.shape == (2, 17)
     assert ((a >= 0) & (a < 64)).all()
 
 
+def test_markov_native_matches_python_fallback():
+    import numpy as np
+    from icikit import native
+    if not native.available():
+        import pytest
+        pytest.skip(native.build_error() or "no native runtime")
+    a = native.markov_fill(61, 4, 5, 9, 6, 24)
+    b = native._markov_fill_py(61, 4, 5, 9, 6, 24,
+                               np.empty((6, 25), np.int32))
+    np.testing.assert_array_equal(a, b)
+
+
 def test_loss_drops_and_sample_emitted(capsys):
-    recs = _run(capsys, "--dp", "2", "--tp", "2", "--lr", "1e-2")
+    # vocab 16: the 256-context transition table is small enough to
+    # learn from 30 x 128 tokens; the run is seed-deterministic
+    recs = _run(capsys, "--dp", "2", "--tp", "2", "--lr", "1e-2",
+                "--vocab", "16", "--steps", "30", "--log-every", "10")
     losses = [r["loss"] for r in recs if "loss" in r]
-    assert len(losses) >= 2 and losses[-1] < losses[0]
+    assert len(losses) >= 3
+    assert losses[-1] < losses[0] - 0.05          # decreasing trend
+    assert losses[-1] < 2.77                      # below uniform ln(16)
     sample = [r for r in recs if r.get("event") == "sample"]
     assert sample and len(sample[0]["tokens"]) == 8 + 4
 

@@ -1,9 +1,14 @@
 """Headline benchmark — prints ONE JSON line.
 
-Metric: distributed-sort throughput in keys/s, benchmarked on all local
-devices (one TPU chip under the driver). Baseline: the north-star target
-from BASELINE.md — bitonic sort of 2^28 int32 keys in < 1 s on v4-8,
-i.e. 268.4M keys/s; ``vs_baseline`` > 1.0 beats it.
+Metric: distributed-sort throughput at the north-star size from
+BASELINE.md — bitonic sort of 2^28 int32 keys, whose stated goal
+(< 1 s, i.e. 268.4 M keys/s) was set for a v4-8; the driver runs this
+on one chip, so ``vs_baseline`` > 1.0 beats the four-chip target on a
+quarter of the hardware (verified headroom: ~0.41 s/sort on one v5e).
+Falls back to 2^27 if the full size does not fit a smaller device's
+HBM. Timing uses the elision-proof chained protocol (each run's input
+is a scrambled function of the previous run's output, two-point windows
+cancel constant costs — see ``icikit.utils.timing.timeit_chained``).
 """
 
 from __future__ import annotations
@@ -16,36 +21,50 @@ def main():
     import jax
     import jax.numpy as jnp
 
-    from icikit.utils.mesh import make_mesh, mesh_axis_size
-    from icikit.utils.timing import timeit
+    from icikit.utils.mesh import is_pow2, make_mesh, mesh_axis_size
+    from icikit.utils.timing import timeit_chained
 
-    n = 1 << 27  # 134M keys: largest size that stays comfortable in HBM
     mesh = make_mesh()
     p = mesh_axis_size(mesh)
 
-    key = jax.random.key(0)
-    keys = jax.random.randint(key, (n,), jnp.iinfo(jnp.int32).min,
-                              jnp.iinfo(jnp.int32).max, dtype=jnp.int32)
-
     from icikit.models.sort import sort as dist_sort
-    from icikit.utils.mesh import is_pow2
 
     # bitonic needs power-of-2 p; fall back like sweep_family does
     alg = "bitonic" if is_pow2(p) else "sample"
 
     def run(x):
         return dist_sort(x, mesh, algorithm=alg)
-    kind = f"{alg}_sort"
 
-    keys = jax.block_until_ready(keys)
-    res = timeit(run, keys, runs=5, warmup=2)
-    keys_per_s = n / res.best_s
+    def chain(args, out):
+        # bijective odd-multiplier scramble: content and order change
+        # every run, so no caching layer can elide an execution
+        return (out * jnp.int32(-1640531527),)
+
+    def attempt(n):
+        keys = jax.random.randint(jax.random.key(0), (n,),
+                                  jnp.iinfo(jnp.int32).min,
+                                  jnp.iinfo(jnp.int32).max,
+                                  dtype=jnp.int32)
+        keys = jax.block_until_ready(keys)
+        return timeit_chained(run, (keys,), chain, runs=4, warmup=1)
+
+    n = 1 << 28  # the north-star size: 2^28 keys in < 1 s
+    try:
+        res = attempt(n)
+    except Exception as e:  # smaller-HBM device: halve once
+        if "RESOURCE_EXHAUSTED" not in str(e) and "memory" not in str(e):
+            raise
+        n = 1 << 27
+        res = attempt(n)
+    keys_per_s = n / res.mean_s
     baseline = (1 << 28) / 1.0  # 2^28 keys in 1 s
     print(json.dumps({
-        "metric": f"{kind}_throughput_p{p}_n2e27_int32",
+        "metric": f"{alg}_sort_throughput_p{p}_n2e{n.bit_length() - 1}"
+                  "_int32",
         "value": round(keys_per_s, 1),
         "unit": "keys/s",
         "vs_baseline": round(keys_per_s / baseline, 4),
+        "seconds_per_sort": round(res.mean_s, 4),
     }))
     return 0
 

@@ -39,6 +39,7 @@ from icikit.models.transformer.model import (
     repeat_kv,
 )
 from icikit.models.transformer.moe import moe_ffn_shard
+from icikit.ops.flash_attention import resolve_attention_impl
 from icikit.ops.rope import apply_rope
 from icikit.parallel.shmap import wrap_program
 
@@ -187,16 +188,11 @@ def _build_generate(mesh, cfg: TransformerConfig, s_prompt: int, n_new: int,
             # zero padding exists solely for the scan-carry cache shape.
             # GQA: the cache keeps the n_kv_heads projections; repeat
             # serves the query-head groups at attention time only.
-            logits = jnp.einsum("bqhd,bkhd->bhqk", q, repeat_kv(k, n_rep),
-                                preferred_element_type=jnp.float32) * scale
-            qpos = jnp.arange(s_prompt)[:, None]
-            kpos = jnp.arange(s_prompt)[None, :]
-            logits = jnp.where((kpos <= qpos)[None, None], logits, NEG_INF)
-            w = jax.nn.softmax(logits, axis=-1)
-            attn = jnp.einsum("bhqk,bkhd->bqhd", w.astype(v.dtype),
-                              repeat_kv(v, n_rep),
-                              preferred_element_type=jnp.float32
-                              ).astype(q.dtype)
+            # cfg.attention_impl routes long prompts through the fused
+            # kernel (tiny/odd prompt lengths fall back to the oracle).
+            attn = resolve_attention_impl(cfg.attention_impl)(
+                q, repeat_kv(k, n_rep), repeat_kv(v, n_rep),
+                causal=True, scale=scale)
             x = close_attn(x, attn, lp1)
             x = ffn(x, lp1)
             ks = jnp.zeros((b, total) + k.shape[2:], k.dtype)

@@ -25,6 +25,7 @@ from icikit.parallel.allreduce import all_reduce
 from icikit.parallel.alltoall import all_to_all_blocks
 from icikit.parallel.collops import broadcast, gather_blocks, scatter_blocks
 from icikit.parallel.reducescatter import reduce_scatter
+from icikit.parallel.scan import scan_reduce
 from icikit.utils.mesh import DEFAULT_AXIS, mesh_axis_size, replicate, shard_along
 from icikit.utils.timing import timeit
 
@@ -69,6 +70,9 @@ def _bus_bytes(family: str, p: int, block_bytes: int) -> float:
         return (p - 1) * block_bytes
     if family == "broadcast":
         return block_bytes
+    if family == "scan":
+        # minimal per-device movement: one running-prefix block in/out
+        return block_bytes
     raise ValueError(family)
 
 
@@ -82,7 +86,7 @@ def _pattern(p: int, msize: int, dtype) -> np.ndarray:
 def _setup(family: str, mesh, axis: str, msize: int, dtype):
     """Build (input, run_fn_factory, verify_fn) for one family."""
     p = mesh_axis_size(mesh, axis)
-    if family in ("allgather", "broadcast", "gather", "allreduce"):
+    if family in ("allgather", "broadcast", "gather", "allreduce", "scan"):
         data = _pattern(p, msize, dtype)
         x = shard_along(jnp.asarray(data), mesh, axis)
     elif family == "alltoall":
@@ -106,6 +110,7 @@ def _setup(family: str, mesh, axis: str, msize: int, dtype):
         "scatter": scatter_blocks,
         "gather": gather_blocks,
         "reducescatter": reduce_scatter,
+        "scan": scan_reduce,
     }
     run = lambda alg: fns[family](x, mesh, axis, algorithm=alg)
 
@@ -126,6 +131,8 @@ def _setup(family: str, mesh, axis: str, msize: int, dtype):
             return np.array_equal(o[0], data)
         if family == "reducescatter":
             return np.array_equal(o, data.sum(axis=0).reshape(p, msize))
+        if family == "scan":
+            return np.array_equal(o, np.cumsum(data, axis=0))
         return False
 
     return run, verify

@@ -23,7 +23,7 @@ def main(argv=None):
     ap.add_argument("--family", default="allgather",
                     choices=["allgather", "alltoall", "allreduce",
                              "reducescatter", "broadcast", "scatter",
-                             "gather"])
+                             "gather", "scan"])
     ap.add_argument("--algorithms", default=None,
                     help="comma-separated variant names (default: all)")
     ap.add_argument("--sizes", default=None,
@@ -31,6 +31,11 @@ def main(argv=None):
                          "(default: the reference sweep 2^0..2^16 step 2^4)")
     ap.add_argument("--devices", type=int, default=None,
                     help="mesh size (default: all local devices)")
+    ap.add_argument("--simulate", action="store_true",
+                    help="run on simulated CPU devices (--devices of "
+                         "them, default 8) even if a real accelerator "
+                         "is present — SURVEY.md §4.6 without relying "
+                         "on env vars a site hook may override")
     ap.add_argument("--runs", type=int, default=10)
     ap.add_argument("--warmup", type=int, default=2)
     ap.add_argument("--dtype", default="int32")
@@ -46,6 +51,20 @@ def main(argv=None):
     import contextlib
 
     import jax
+
+    # A site hook may pin JAX_PLATFORMS to a TPU plugin, overriding the
+    # env overrides in the module docstring — --simulate forces the
+    # simulated-CPU mesh from inside the process (same dance as
+    # __graft_entry__.dryrun_multichip).
+    if args.simulate:
+        try:
+            jax.config.update("jax_platforms", "cpu")
+            jax.config.update("jax_num_cpu_devices", args.devices or 8)
+        except (RuntimeError, AttributeError) as e:
+            # RuntimeError: backend already initialized; AttributeError:
+            # jax predating the jax_num_cpu_devices option
+            print(f"--simulate ignored ({e})", file=sys.stderr)
+
     import jax.numpy as jnp
 
     from icikit.bench.harness import (

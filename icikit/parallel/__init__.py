@@ -39,3 +39,7 @@ from icikit.parallel.reducescatter import (  # noqa: F401
     REDUCESCATTER_ALGORITHMS,
     reduce_scatter,
 )
+from icikit.parallel.scan import (  # noqa: F401
+    SCAN_ALGORITHMS,
+    scan_reduce,
+)

@@ -83,3 +83,11 @@ def shift_perm(p: int, shift: int):
     """Rotation permutation ``j -> (j + shift) % p`` — the ring/wraparound
     partner rule (``Communication/src/main.cc:198-221``, ``:379-385``)."""
     return [(j, (j + shift) % p) for j in range(p)]
+
+
+def partial_shift_perm(p: int, step: int):
+    """Right shift *without* wraparound: ``j -> j + step`` for
+    ``j < p - step`` — the targeted-``MPI_Send`` analog used where a
+    wrapped value must not arrive (prefix scans: the top of the axis
+    must never fold into the bottom's prefix)."""
+    return [(j, j + step) for j in range(p - step)]

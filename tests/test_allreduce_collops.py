@@ -120,6 +120,6 @@ def test_registry_lists_xla_everywhere():
     (one binary runs and compares all variants — SURVEY.md §5.6)."""
     from icikit.utils.registry import get_algorithm, list_algorithms
     for family in ("allgather", "alltoall", "allreduce", "broadcast",
-                   "scatter", "gather"):
+                   "scatter", "gather", "scan"):
         assert "xla" in list_algorithms(family)
         assert get_algorithm(family, "xla") is not None

@@ -1,0 +1,93 @@
+"""Oracle tests for the scan (prefix-reduction) family — numpy
+cumulative reductions as the closed-form expectation, the pattern-oracle
+discipline of the reference's drivers (SURVEY.md §4.1)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from icikit.parallel import SCAN_ALGORITHMS, scan_reduce
+from icikit.utils.mesh import make_mesh, shard_along
+
+_NP_CUM = {"sum": np.cumsum,
+           "max": np.maximum.accumulate,
+           "min": np.minimum.accumulate}
+
+
+def _data(p, m, seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.integers(-100, 100, size=(p, m)).astype(np.int32)
+
+
+@pytest.mark.parametrize("algorithm", SCAN_ALGORITHMS)
+@pytest.mark.parametrize("op", ["sum", "max", "min"])
+def test_inclusive_scan(mesh8, algorithm, op):
+    p, m = 8, 16
+    data = _data(p, m, seed=1)
+    x = shard_along(jnp.asarray(data), mesh8)
+    out = np.asarray(scan_reduce(x, mesh8, algorithm=algorithm, op=op))
+    np.testing.assert_array_equal(out, _NP_CUM[op](data, axis=0))
+
+
+@pytest.mark.parametrize("algorithm", SCAN_ALGORITHMS)
+def test_exclusive_scan(mesh8, algorithm):
+    p, m = 8, 16
+    data = _data(p, m, seed=2)
+    x = shard_along(jnp.asarray(data), mesh8)
+    out = np.asarray(scan_reduce(x, mesh8, algorithm=algorithm,
+                                 inclusive=False))
+    expected = np.concatenate(
+        [np.zeros((1, m), np.int32), np.cumsum(data, axis=0)[:-1]])
+    np.testing.assert_array_equal(out, expected)
+
+
+@pytest.mark.parametrize("algorithm", SCAN_ALGORITHMS)
+@pytest.mark.parametrize("op", ["max", "min"])
+def test_exclusive_scan_minmax_identity(mesh8, algorithm, op):
+    """Device 0 of an exclusive max/min scan holds the op identity."""
+    p, m = 8, 4
+    data = _data(p, m, seed=3)
+    x = shard_along(jnp.asarray(data), mesh8)
+    out = np.asarray(scan_reduce(x, mesh8, algorithm=algorithm, op=op,
+                                 inclusive=False))
+    ident = (np.iinfo(np.int32).min if op == "max"
+             else np.iinfo(np.int32).max)
+    np.testing.assert_array_equal(out[0], np.full(m, ident, np.int32))
+    np.testing.assert_array_equal(out[1:], _NP_CUM[op](data, axis=0)[:-1])
+
+
+@pytest.mark.parametrize("algorithm", SCAN_ALGORITHMS)
+def test_scan_non_pow2(algorithm):
+    """Every scan schedule supports any p (partial perms, not XOR)."""
+    p, m = 6, 8
+    mesh = make_mesh(p)
+    data = _data(p, m, seed=4)
+    x = shard_along(jnp.asarray(data), mesh)
+    out = np.asarray(scan_reduce(x, mesh, algorithm=algorithm))
+    np.testing.assert_array_equal(out, np.cumsum(data, axis=0))
+
+
+@pytest.mark.parametrize("algorithm", SCAN_ALGORITHMS)
+def test_scan_float(mesh8, algorithm):
+    p, m = 8, 8
+    rng = np.random.default_rng(5)
+    data = rng.standard_normal((p, m)).astype(np.float32)
+    x = shard_along(jnp.asarray(data), mesh8)
+    out = np.asarray(scan_reduce(x, mesh8, algorithm=algorithm))
+    np.testing.assert_allclose(out, np.cumsum(data, axis=0), rtol=1e-5,
+                               atol=1e-5)
+
+
+def test_scan_p1(mesh1):
+    data = _data(1, 8, seed=6)
+    x = shard_along(jnp.asarray(data), mesh1)
+    np.testing.assert_array_equal(
+        np.asarray(scan_reduce(x, mesh1, algorithm="hillis_steele")), data)
+    out_ex = np.asarray(scan_reduce(x, mesh1, algorithm="linear",
+                                    inclusive=False))
+    np.testing.assert_array_equal(out_ex, np.zeros_like(data))
+
+
+def test_scan_in_registry():
+    from icikit.utils.registry import list_algorithms
+    assert set(SCAN_ALGORITHMS) == set(list_algorithms("scan"))

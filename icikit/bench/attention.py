@@ -5,7 +5,7 @@ The model-side analog of the collective sweep (``icikit/bench/harness.py``
 every variant against the dense oracle, report fenced timings and
 achieved TFLOP/s. On a single chip the subjects are the local kernels
 (dense, flash); on a multi-device mesh the sequence-parallel schedules
-(ring, ulysses) join the comparison — the same hand-rolled-vs-vendor
+(ring, ulysses, zigzag) join the comparison — the same hand-rolled-vs-vendor
 science, applied to the attention family.
 
 CLI::
@@ -73,9 +73,12 @@ def _impl_fns(mesh):
     if mesh is not None and np.prod(list(mesh.shape.values())) > 1:
         from icikit.models.attention.ring import ring_attention
         from icikit.models.attention.ulysses import ulysses_attention
+        from icikit.models.attention.zigzag import zigzag_attention
         fns["ring"] = lambda q, k, v, causal: ring_attention(
             q, k, v, mesh, causal=causal)
         fns["ulysses"] = lambda q, k, v, causal: ulysses_attention(
+            q, k, v, mesh, causal=causal)
+        fns["zigzag"] = lambda q, k, v, causal: zigzag_attention(
             q, k, v, mesh, causal=causal)
     return fns
 
@@ -178,7 +181,7 @@ def main(argv=None) -> int:
     ap.add_argument("--runs", type=int, default=10)
     ap.add_argument("--warmup", type=int, default=2)
     ap.add_argument("--devices", type=int, default=None,
-                    help="use a p-device mesh (adds ring/ulysses)")
+                    help="use a p-device mesh (adds ring/ulysses/zigzag)")
     ap.add_argument("--json", dest="json_path", default=None)
     args = ap.parse_args(argv)
 

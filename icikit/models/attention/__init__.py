@@ -17,8 +17,12 @@ patterns into working long-sequence attention:
   sequence↔heads with any algorithm from the ``alltoall`` family (the
   hand-rolled hypercube/e-cube/wraparound schedules or XLA's native
   collective), attend locally over the full sequence, re-shard back.
+- ``zigzag_attention`` — the ring schedule on a zigzag chunk layout:
+  every device holds one early + one late sequence chunk, equalizing
+  causal work across the ring (~2× on the causal critical path).
 """
 
 from icikit.models.attention.dense import dense_attention  # noqa: F401
 from icikit.models.attention.ring import ring_attention  # noqa: F401
 from icikit.models.attention.ulysses import ulysses_attention  # noqa: F401
+from icikit.models.attention.zigzag import zigzag_attention  # noqa: F401

@@ -1,0 +1,166 @@
+"""Zigzag ring attention — causal-load-balanced sequence parallelism.
+
+The plain ring schedule (``icikit.models.attention.ring``) is exact but
+causally imbalanced: with blocks laid out in sequence order, device 0's
+queries see one live K/V block while device p−1's see all p — and since
+the ring's ``ppermute`` steps are lock-step, every step costs the
+straggler's full-block attention. Total critical path ≈ p full-block
+attends.
+
+The zigzag layout fixes the imbalance by giving every device an equal
+share of causal work: split the sequence into 2p chunks and assign
+device r the pair (r, 2p−1−r) — one early chunk, one late chunk. Every
+device's live chunk-pair count is then (r+1) + (2p−1−r+1) = 2p+2 −
+constant in r — so each lock-step ring round does ~half the straggler
+work of the sequence-ordered layout (~2× on the causal critical path;
+the standard zigzag/striped context-parallel construction, e.g.
+llama3's zigzag variant of Liu et al.'s ring attention).
+
+The communication is the reference's ring discipline
+(``Communication/src/main.cc:190-223``) carrying chunk *pairs*; the
+layout redistribution in/out of zigzag order is two partial
+``ppermute``s each way — the targeted-``MPI_Send`` analog, same
+vocabulary as the scatter/gather schedules. Inputs and outputs are
+ordinary sequence-ordered shards, so this is a drop-in alternative to
+``ring_attention``: the permutation never escapes the shard_map body.
+
+Masking stays chunk-granular — each visiting (q-chunk, kv-chunk) pair
+is skip / diagonal-causal / full by global chunk id, the same three
+modes the plain ring uses per block, so the fused flash kernel needs no
+new mask shapes and the result is exact, not approximate.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache, partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from icikit.models.attention.ring import _attend_block, _merge
+from icikit.parallel.shmap import shard_map, shift_perm
+from icikit.utils.mesh import DEFAULT_AXIS
+
+
+def _chunk_dev(c: int, p: int) -> int:
+    """Owner of global chunk c (of 2p) in zigzag layout: device
+    min(c, 2p-1-c)."""
+    return c if c < p else 2 * p - 1 - c
+
+
+def _to_zigzag(x, axis: str, p: int):
+    """Sequence-ordered shard -> (early chunk r, late chunk 2p-1-r).
+
+    Device r's local halves are global chunks 2r (lower) and 2r+1
+    (upper). Two bijective partial routes deliver them: route A carries
+    every lower half, route B every upper half. Chunk c lands *early*
+    iff c < p, i.e. iff its zigzag owner has parity c%2 — so even
+    devices take their early chunk from A, odd devices from B.
+    """
+    if p == 1:
+        return x
+    half = x.shape[1] // 2
+    lo, hi = x[:, :half], x[:, half:]
+    perm_a = [(r, _chunk_dev(2 * r, p)) for r in range(p)]
+    perm_b = [(r, _chunk_dev(2 * r + 1, p)) for r in range(p)]
+    recv_a = lax.ppermute(lo, axis, perm_a)
+    recv_b = lax.ppermute(hi, axis, perm_b)
+    even = (lax.axis_index(axis) % 2) == 0
+    early = jnp.where(even, recv_a, recv_b)
+    late = jnp.where(even, recv_b, recv_a)
+    return jnp.concatenate([early, late], axis=1)
+
+
+def _from_zigzag(x, axis: str, p: int):
+    """Inverse of ``_to_zigzag``: the same two routes reversed, each
+    device sending back the chunk the route delivered to it."""
+    if p == 1:
+        return x
+    half = x.shape[1] // 2
+    early, late = x[:, :half], x[:, half:]
+    inv_a = [(_chunk_dev(2 * r, p), r) for r in range(p)]
+    inv_b = [(_chunk_dev(2 * r + 1, p), r) for r in range(p)]
+    even = (lax.axis_index(axis) % 2) == 0
+    send_a = jnp.where(even, early, late)
+    send_b = jnp.where(even, late, early)
+    lo = lax.ppermute(send_a, axis, inv_a)
+    hi = lax.ppermute(send_b, axis, inv_b)
+    return jnp.concatenate([lo, hi], axis=1)
+
+
+def zigzag_attention_shard(q: jax.Array, k: jax.Array, v: jax.Array,
+                           axis: str, p: int, causal: bool,
+                           scale: float | None) -> jax.Array:
+    """Per-shard zigzag ring attention over local blocks ``(b, s, h, d)``
+    in *sequence order* (the zigzag layout is internal)."""
+    b, s, h, d = q.shape
+    if scale is None:
+        scale = d ** -0.5
+    if p == 1 or s < 2 or not causal:
+        # no imbalance to fix (non-causal work is already uniform);
+        # the plain ring does the same math in 1 full-chunk call/step
+        from icikit.models.attention.ring import ring_attention_shard
+        return ring_attention_shard(q, k, v, axis, p, causal, scale)
+    half = s // 2
+    qz = _to_zigzag(q, axis, p)
+    kz = _to_zigzag(k, axis, p)
+    vz = _to_zigzag(v, axis, p)
+    r = lax.axis_index(axis)
+    gq = (r, 2 * p - 1 - r)  # global chunk ids of the two local q chunks
+
+    o = [jnp.zeros((b, half, h, d), jnp.float32) for _ in range(2)]
+    lse = [jnp.full((b, h, half), -jnp.inf, jnp.float32) for _ in range(2)]
+    k_cur, v_cur = kz, vz
+    for t in range(p):
+        src = jnp.mod(r - t, p)
+        gk = (src, 2 * p - 1 - src)  # chunk ids of the visiting pair
+        for qi in range(2):
+            for ki in range(2):
+                if causal:
+                    mode = jnp.where(
+                        gk[ki] == gq[qi], 1,
+                        jnp.where(gk[ki] < gq[qi], 2, 0))
+                else:
+                    mode = jnp.full((), 2, jnp.int32)
+                kc = lax.slice_in_dim(k_cur, ki * half, (ki + 1) * half,
+                                      axis=1)
+                vc = lax.slice_in_dim(v_cur, ki * half, (ki + 1) * half,
+                                      axis=1)
+                qc = lax.slice_in_dim(qz, qi * half, (qi + 1) * half,
+                                      axis=1)
+                o_t, lse_t = _attend_block(qc, kc, vc, mode, scale)
+                o[qi], lse[qi] = _merge(o[qi], lse[qi], o_t, lse_t)
+        if t < p - 1:
+            k_cur = lax.ppermute(k_cur, axis, shift_perm(p, 1))
+            v_cur = lax.ppermute(v_cur, axis, shift_perm(p, 1))
+    out = jnp.concatenate(o, axis=1)
+    return _from_zigzag(out, axis, p).astype(q.dtype)
+
+
+@lru_cache(maxsize=None)
+def _build(mesh, axis, causal, scale):
+    p = mesh.shape[axis]
+    spec = P(None, axis)
+    fn = partial(zigzag_attention_shard, axis=axis, p=p, causal=causal,
+                 scale=scale)
+    return jax.jit(shard_map(fn, mesh=mesh, in_specs=spec, out_specs=spec))
+
+
+def zigzag_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh,
+                     axis: str = DEFAULT_AXIS, causal: bool = False,
+                     scale: float | None = None) -> jax.Array:
+    """Causal-load-balanced sequence-parallel attention.
+
+    Drop-in alternative to ``ring_attention`` — same contract
+    (``(batch, S, heads, head_dim)`` sequence-sharded in natural order,
+    exact vs the dense oracle), ~2× faster causal critical path on p
+    devices. S must divide evenly by 2p (two chunks per device).
+    """
+    p = mesh.shape[axis]
+    if q.shape[1] % (2 * p):
+        raise ValueError(
+            f"sequence length {q.shape[1]} must divide evenly into "
+            f"2*{p} zigzag chunks")
+    return _build(mesh, axis, bool(causal), scale)(q, k, v)

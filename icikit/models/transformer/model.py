@@ -34,6 +34,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from icikit.models.attention.ring import ring_attention_shard
 from icikit.models.attention.ulysses import ulysses_attention_shard
+from icikit.models.attention.zigzag import zigzag_attention_shard
 from icikit.models.transformer.moe import moe_ffn_shard
 from icikit.ops.flash_attention import resolve_attention_impl
 from icikit.ops.rope import apply_rope
@@ -111,10 +112,10 @@ def make_model_mesh(n_devices: int | None = None, dp: int = 1, tp: int = 1,
 
 
 def _check_cfg(cfg: TransformerConfig) -> None:
-    if cfg.sequence_schedule not in ("ring", "ulysses"):
+    if cfg.sequence_schedule not in ("ring", "ulysses", "zigzag"):
         raise ValueError(
             f"unknown sequence_schedule {cfg.sequence_schedule!r} "
-            "(known: ring, ulysses)")
+            "(known: ring, ulysses, zigzag)")
     if cfg.pos_encoding not in ("learned", "rope"):
         raise ValueError(f"unknown pos_encoding {cfg.pos_encoding!r} "
                          "(known: learned, rope)")
@@ -165,6 +166,11 @@ def _check_mesh_cfg(cfg: TransformerConfig, mesh) -> None:
         raise ValueError(
             f"ulysses needs per-tp-shard heads ({cfg.n_heads}/{tp}) "
             f"divisible by sp={sp}")
+    if (cfg.sequence_schedule == "zigzag" and sp > 1
+            and cfg.max_seq % (2 * sp)):
+        raise ValueError(
+            f"zigzag needs max_seq={cfg.max_seq} divisible by "
+            f"2*sp={2 * sp} (two chunks per device)")
 
 
 def param_specs(cfg: TransformerConfig) -> dict:
@@ -324,6 +330,9 @@ def _forward_local(params, tokens, cfg: TransformerConfig, p_sp: int,
             return ulysses_attention_shard(
                 q, k, v, SP_AXIS, p_sp, causal=True, scale=None,
                 algorithm=cfg.sp_algorithm, local=cfg.attention_impl)
+        if cfg.sequence_schedule == "zigzag":
+            return zigzag_attention_shard(q, k, v, SP_AXIS, p_sp,
+                                          causal=True, scale=None)
         return ring_attention_shard(q, k, v, SP_AXIS, p_sp, causal=True,
                                     scale=None)
 

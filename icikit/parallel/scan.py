@@ -30,8 +30,6 @@ gets the identity), matching ``MPI_Exscan``'s contract.
 
 from __future__ import annotations
 
-import math
-
 import jax
 import jax.numpy as jnp
 from jax import lax
@@ -66,7 +64,7 @@ def _hillis_steele(x: jax.Array, axis: str, p: int, op: str) -> jax.Array:
     *top* of the array into the bottom's prefix)."""
     combine = _COMBINE[op]
     r = lax.axis_index(axis)
-    for i in range(max(0, math.ceil(math.log2(p))) if p > 1 else 0):
+    for i in range((p - 1).bit_length()):
         step = 1 << i
         recv = lax.ppermute(x, axis, partial_shift_perm(p, step))
         x = jnp.where(r >= step, combine(x, recv), x)

@@ -10,7 +10,7 @@ attends.
 The zigzag layout fixes the imbalance by giving every device an equal
 share of causal work: split the sequence into 2p chunks and assign
 device r the pair (r, 2p−1−r) — one early chunk, one late chunk. Every
-device's live chunk-pair count is then (r+1) + (2p−1−r+1) = 2p+2 −
+device's live chunk-pair count is then (r+1) + (2p−r) = 2p+1 —
 constant in r — so each lock-step ring round does ~half the straggler
 work of the sequence-ordered layout (~2× on the causal critical path;
 the standard zigzag/striped context-parallel construction, e.g.
@@ -118,12 +118,11 @@ def zigzag_attention_shard(q: jax.Array, k: jax.Array, v: jax.Array,
         gk = (src, 2 * p - 1 - src)  # chunk ids of the visiting pair
         for qi in range(2):
             for ki in range(2):
-                if causal:
-                    mode = jnp.where(
-                        gk[ki] == gq[qi], 1,
-                        jnp.where(gk[ki] < gq[qi], 2, 0))
-                else:
-                    mode = jnp.full((), 2, jnp.int32)
+                # causal is always True here — non-causal calls took the
+                # ring fallback above (uniform work, nothing to balance)
+                mode = jnp.where(
+                    gk[ki] == gq[qi], 1,
+                    jnp.where(gk[ki] < gq[qi], 2, 0))
                 kc = lax.slice_in_dim(k_cur, ki * half, (ki + 1) * half,
                                       axis=1)
                 vc = lax.slice_in_dim(v_cur, ki * half, (ki + 1) * half,
@@ -159,8 +158,14 @@ def zigzag_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh,
     devices. S must divide evenly by 2p (two chunks per device).
     """
     p = mesh.shape[axis]
-    if q.shape[1] % (2 * p):
+    if causal and p > 1:
+        if q.shape[1] % (2 * p):
+            raise ValueError(
+                f"sequence length {q.shape[1]} must divide evenly into "
+                f"2*{p} zigzag chunks")
+    elif q.shape[1] % p:
+        # fallback paths delegate to the ring: p-divisibility suffices
         raise ValueError(
-            f"sequence length {q.shape[1]} must divide evenly into "
-            f"2*{p} zigzag chunks")
+            f"sequence length {q.shape[1]} must divide evenly over "
+            f"{p} devices")
     return _build(mesh, axis, bool(causal), scale)(q, k, v)

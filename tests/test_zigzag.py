@@ -31,9 +31,9 @@ def test_zigzag_matches_dense(mesh8, causal):
 
 
 def test_zigzag_layout_roundtrip(mesh8):
-    """_to_zigzag/_from_zigzag are inverse — checked through the public
-    API by the identity attention (k=v=q, causal=False) being
-    position-stable, and directly on the helpers."""
+    """_to_zigzag/_from_zigzag are inverse, and the forward layout puts
+    chunks (r, 2p-1-r) on device r — checked directly on the helpers
+    (the public path is covered by test_zigzag_matches_dense)."""
     from functools import partial
 
     from jax.sharding import PartitionSpec as P

@@ -100,9 +100,15 @@ def test_zigzag_p1_degenerate(mesh1):
 
 
 def test_zigzag_shape_validation(mesh8):
-    q, k, v = _qkv(s=24)  # 24 not divisible by 2*8
+    q, k, v = _qkv(s=24)  # 24 divides by p=8 but not 2p=16
     with pytest.raises(ValueError, match="zigzag"):
-        zigzag_attention(q, k, v, mesh8)
+        zigzag_attention(q, k, v, mesh8, causal=True)
+    # non-causal delegates to the ring: p-divisibility suffices
+    out = zigzag_attention(q, k, v, mesh8, causal=False)
+    assert out.shape == q.shape
+    q, k, v = _qkv(s=20)  # 20 does not divide by p=8 either
+    with pytest.raises(ValueError, match="sequence length"):
+        zigzag_attention(q, k, v, mesh8, causal=False)
 
 
 def test_model_zigzag_schedule_matches_ring():

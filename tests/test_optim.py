@@ -1,0 +1,156 @@
+"""Optimizer-construction tests: schedules, clipping, accumulation.
+
+The key oracle: ``accum_steps=k`` over k equal microbatches produces
+the same parameters as one step on the concatenated batch (the loss is
+a per-token mean, so the mean-of-microbatch-grads equals the big-batch
+grad)."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from icikit.models.transformer import (
+    TransformerConfig,
+    init_params,
+    make_train_step,
+)
+from icikit.models.transformer.model import make_model_mesh
+from icikit.models.transformer.optim import make_optimizer, make_schedule
+
+
+def _cfg():
+    return TransformerConfig(vocab=32, d_model=16, n_heads=2, d_head=8,
+                             d_ff=32, n_layers=1, max_seq=8,
+                             compute_dtype="float32")
+
+
+def _tokens(b, s, seed):
+    rng = np.random.default_rng(seed)
+    return jnp.asarray(rng.integers(0, 32, (b, s)), jnp.int32)
+
+
+def test_schedule_shapes():
+    s = make_schedule(1.0, "warmup_cosine", warmup_steps=10,
+                      total_steps=100)
+    assert float(s(0)) == 0.0
+    assert float(s(10)) == pytest.approx(1.0)
+    assert float(s(100)) == pytest.approx(0.0, abs=1e-6)
+    s = make_schedule(2.0, "warmup_linear", warmup_steps=4,
+                      total_steps=8, min_lr_ratio=0.5)
+    assert float(s(4)) == pytest.approx(2.0)
+    assert float(s(8)) == pytest.approx(1.0)
+    const = make_schedule(3e-4, "constant")
+    assert const == 3e-4
+
+
+def test_schedule_validation():
+    with pytest.raises(ValueError, match="unknown schedule"):
+        make_schedule(1.0, "exponential")
+    with pytest.raises(ValueError, match="total_steps"):
+        make_schedule(1.0, "warmup_cosine", warmup_steps=10,
+                      total_steps=10)
+
+
+def test_grad_clip_bounds_update():
+    """With an absurdly small clip norm the global update norm is
+    bounded by clip * lr (Adam normalizes per-coordinate, so check the
+    clip actually engaged by comparing against the unclipped run)."""
+    cfg = _cfg()
+    mesh = make_model_mesh(dp=1, tp=1, sp=1)
+    tok, tgt = _tokens(2, 8, 0), _tokens(2, 8, 1)
+
+    def run(tx):
+        params = init_params(jax.random.key(0), cfg, mesh)
+        _, step = make_train_step(mesh, cfg, tx)
+        opt_state = tx.init(params)
+        new_params, _, _ = step(params, opt_state, tok, tgt)
+        return jax.tree.map(lambda a, b: np.abs(np.asarray(a - b)).max(),
+                            new_params, params)
+
+    moved_clipped = run(make_optimizer(1e-2, grad_clip=1e-6))
+    moved_free = run(make_optimizer(1e-2))
+    total_c = max(jax.tree.leaves(moved_clipped))
+    total_f = max(jax.tree.leaves(moved_free))
+    assert total_c < total_f  # the clip engaged
+
+
+def test_accumulation_matches_big_batch():
+    cfg = _cfg()
+    mesh = make_model_mesh(dp=1, tp=1, sp=1)
+    b1 = (_tokens(2, 8, 2), _tokens(2, 8, 3))
+    b2 = (_tokens(2, 8, 4), _tokens(2, 8, 5))
+    big = (jnp.concatenate([b1[0], b2[0]]), jnp.concatenate([b1[1], b2[1]]))
+
+    params0 = init_params(jax.random.key(1), cfg, mesh)
+
+    tx_acc = make_optimizer(1e-2, accum_steps=2)
+    _, step_acc = make_train_step(mesh, cfg, tx_acc)
+    st = tx_acc.init(params0)
+    p_mid, st, _ = step_acc(params0, st, *b1)
+    # microbatch 1 must not move the parameters
+    same = jax.tree.map(lambda a, b: np.array_equal(np.asarray(a),
+                                                    np.asarray(b)),
+                        p_mid, params0)
+    assert all(jax.tree.leaves(same))
+    p_acc, st, _ = step_acc(p_mid, st, *b2)
+
+    tx_big = make_optimizer(1e-2)
+    _, step_big = make_train_step(mesh, cfg, tx_big)
+    p_big, _, _ = step_big(params0, tx_big.init(params0), *big)
+
+    for a, b in zip(jax.tree.leaves(p_acc), jax.tree.leaves(p_big)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-5, atol=2e-6)
+
+
+def test_weight_decay_shrinks_params():
+    """AdamW decay pulls an untouched-gradient direction toward zero:
+    compare total parameter norm after identical steps with/without."""
+    cfg = _cfg()
+    mesh = make_model_mesh(dp=1, tp=1, sp=1)
+    tok, tgt = _tokens(2, 8, 6), _tokens(2, 8, 7)
+
+    def norm_after(tx):
+        params = init_params(jax.random.key(2), cfg, mesh)
+        _, step = make_train_step(mesh, cfg, tx)
+        p, _, _ = step(params, tx.init(params), tok, tgt)
+        return float(sum(np.square(np.asarray(x)).sum()
+                         for x in jax.tree.leaves(p)))
+
+    assert (norm_after(make_optimizer(1e-3, weight_decay=0.5))
+            < norm_after(make_optimizer(1e-3)))
+
+
+_CLI_BASE = ["--batch", "2", "--seq", "16", "--vocab", "64",
+             "--d-model", "16", "--n-heads", "2", "--d-head", "8",
+             "--d-ff", "32", "--n-layers", "1", "--log-every", "2",
+             "--sample-tokens", "0"]
+
+
+def test_trainer_cli_flags(tmp_path):
+    """The CLI accepts the new knobs end-to-end, checkpoints, and
+    resumes with the same optimizer structure."""
+    from icikit.models.transformer.train import train
+    flags = ["--lr-schedule", "warmup_cosine", "--warmup-steps", "1",
+             "--grad-clip", "1.0", "--accum-steps", "2",
+             "--weight-decay", "0.01",
+             "--ckpt-dir", str(tmp_path / "run")]
+    assert train(["--steps", "4", *_CLI_BASE, *flags]) == 0
+    assert train(["--steps", "8", *_CLI_BASE, *flags]) == 0  # resume
+
+
+def test_trainer_resume_rejects_structural_flag_change(tmp_path):
+    """Changing a structure-affecting optimizer flag across a resume
+    fails fast with the cause instead of an Orbax tree mismatch."""
+    from icikit.models.transformer.train import train
+    ckpt = ["--ckpt-dir", str(tmp_path / "run")]
+    assert train(["--steps", "2", *_CLI_BASE, *ckpt]) == 0
+    rc = train(["--steps", "4", *_CLI_BASE, *ckpt,
+                "--accum-steps", "2"])
+    assert rc == 2
+    # non-structural change only warns
+    rc = train(["--steps", "4", *_CLI_BASE, *ckpt, "--lr", "1e-3"])
+    assert rc == 0

@@ -1,0 +1,166 @@
+"""Distributed-sorting benchmark: the reference's sorting study as a CLI.
+
+Reproduces ``Parallel-Sorting``'s driver science
+(``psort.cc:525-663``; ``project3.pdf`` §4: four algorithms side by
+side over problem sizes) on a TPU mesh: p-invariant input generation
+(uniform or the skewed ``ODD_DIST``), every registered sort variant,
+the distributed inversion-count verifier after each, and elision-proof
+chained timing. One process compares all variants — the reference
+rebuilt its binary per call-site choice (``psort.cc:647``).
+
+CLI::
+
+    python -m icikit.bench.sort --sizes 1048576,16777216 --simulate
+    python -m icikit.bench.sort --sizes 268435456 --algorithms bitonic
+
+FLOP-free metric: keys/s (the study's axis), plus effective HBM GB/s
+at 2 passes/merge-round for context on the single-chip kernel.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import sys
+from dataclasses import dataclass
+
+
+@dataclass
+class SortRecord:
+    algorithm: str
+    p: int
+    n: int
+    dtype: str
+    distribution: str     # "uniform" | "odd_dist"
+    runs: int
+    mean_s: float
+    best_s: float
+    keys_per_s: float
+    errors: int           # distributed inversion count (0 = sorted)
+
+    def to_json(self) -> str:
+        return json.dumps(dataclasses.asdict(self))
+
+
+def sweep_sorts(mesh, sizes, algorithms=None, dtype="int32",
+                odd_dist=False, runs=4, warmup=1, seed=0):
+    """Benchmark + verify each sort over a size sweep."""
+    import jax
+    import jax.numpy as jnp
+
+    from icikit.models.sort import SORT_ALGORITHMS, check_sort, sort
+    from icikit.utils.mesh import UnsupportedMeshError, mesh_axis_size
+    from icikit.utils.prandom import uniform_global
+    from icikit.utils.timing import timeit_chained
+
+    p = mesh_axis_size(mesh)
+    algorithms = list(algorithms or SORT_ALGORITHMS)
+    dt = jnp.dtype(dtype)
+    records = []
+    for n in sizes:
+        u = uniform_global(jax.random.key(seed), n, odd_dist=odd_dist)
+        if jnp.issubdtype(dt, jnp.integer):
+            info = jnp.iinfo(dt)
+            keys = (u * (float(info.max) - float(info.min))
+                    + float(info.min)).astype(dt)
+        else:
+            keys = u.astype(dt)
+        keys = jax.block_until_ready(keys)
+        for alg in algorithms:
+            def run(x, alg=alg):
+                return sort(x, mesh, algorithm=alg)
+
+            def chain(args, out):
+                # bijective odd-multiplier scramble: content and order
+                # change every run, so no cache can elide an execution
+                if jnp.issubdtype(dt, jnp.integer):
+                    return (out * dt.type(-1640531527),)
+                return ((out * 25.173 + 0.217) % 1.0,)
+
+            try:
+                sorted_out = run(keys)
+            except UnsupportedMeshError:
+                continue  # e.g. bitonic on a non-pow2 mesh
+            pad = (-n) % p
+            errors = check_sort(
+                jnp.concatenate(
+                    [sorted_out,
+                     jnp.full((pad,), sorted_out[-1], dt)]
+                ).reshape(p, (n + pad) // p), mesh) if p > 1 else int(
+                    jnp.sum(sorted_out[1:] < sorted_out[:-1]))
+            with jax.profiler.TraceAnnotation(f"sort/{alg}/n{n}"):
+                res = timeit_chained(run, (keys,), chain, runs=runs,
+                                     warmup=warmup)
+            records.append(SortRecord(
+                algorithm=alg, p=p, n=n, dtype=dt.name,
+                distribution="odd_dist" if odd_dist else "uniform",
+                runs=res.runs, mean_s=res.mean_s, best_s=res.best_s,
+                keys_per_s=n / res.best_s, errors=int(errors)))
+    return records
+
+
+def format_table(records) -> str:
+    if not records:
+        return "(no records)"
+    hdr = (f"{'algorithm':<15} {'p':>3} {'n':>12} {'dist':>9} "
+           f"{'mean_ms':>10} {'best_ms':>10} {'Mkeys/s':>9} {'errs':>5}")
+    lines = [hdr, "-" * len(hdr)]
+    for r in records:
+        lines.append(
+            f"{r.algorithm:<15} {r.p:>3} {r.n:>12} {r.distribution:>9} "
+            f"{r.mean_s * 1e3:>10.2f} {r.best_s * 1e3:>10.2f} "
+            f"{r.keys_per_s / 1e6:>9.1f} {r.errors:>5}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--sizes", default="1048576,4194304",
+                    help="comma-separated key counts (reference study: "
+                         "50M doubles; north star: 2^28 int32)")
+    ap.add_argument("--algorithms", default=None,
+                    help="comma-separated (default: all four)")
+    ap.add_argument("--dtype", default="int32")
+    ap.add_argument("--odd-dist", action="store_true",
+                    help="the reference's skewed ODD_DIST input "
+                         "(psort.cc:598-609) — stresses splitters")
+    ap.add_argument("--runs", type=int, default=4)
+    ap.add_argument("--warmup", type=int, default=1)
+    ap.add_argument("--devices", type=int, default=None)
+    ap.add_argument("--simulate", action="store_true",
+                    help="simulated CPU mesh (--devices of them, "
+                         "default 8) even if an accelerator is present")
+    ap.add_argument("--json", dest="json_path", default=None)
+    args = ap.parse_args(argv)
+
+    import jax
+
+    if args.simulate:
+        try:
+            jax.config.update("jax_platforms", "cpu")
+            jax.config.update("jax_num_cpu_devices", args.devices or 8)
+        except (RuntimeError, AttributeError) as e:
+            print(f"--simulate ignored ({e})", file=sys.stderr)
+
+    from icikit.utils.mesh import make_mesh
+
+    mesh = make_mesh(args.devices)
+    records = sweep_sorts(
+        mesh, tuple(int(s) for s in args.sizes.split(",")),
+        args.algorithms.split(",") if args.algorithms else None,
+        dtype=args.dtype, odd_dist=args.odd_dist, runs=args.runs,
+        warmup=args.warmup)
+    print(format_table(records))
+    if args.json_path:
+        with open(args.json_path, "w") as f:
+            for r in records:
+                f.write(r.to_json() + "\n")
+    if any(r.errors for r in records):
+        print("SORT VERIFICATION FAILURES present", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

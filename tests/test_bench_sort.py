@@ -1,0 +1,34 @@
+"""The sorting-study benchmark harness: every algorithm verified and
+timed over the sweep (the reference driver's sort/check_sort/report
+loop, psort.cc:525-663, as a testable API)."""
+
+import pytest
+
+from icikit.bench.sort import format_table, sweep_sorts
+
+
+@pytest.mark.parametrize("odd_dist", [False, True])
+def test_sweep_sorts_all_algorithms(mesh8, odd_dist):
+    records = sweep_sorts(mesh8, sizes=(4096,), runs=2, warmup=1,
+                          odd_dist=odd_dist)
+    assert {r.algorithm for r in records} == {
+        "bitonic", "sample", "sample_bitonic", "quicksort"}
+    for r in records:
+        assert r.errors == 0, f"{r.algorithm} produced inversions"
+        assert r.keys_per_s > 0
+        assert r.p == 8
+    table = format_table(records)
+    assert "bitonic" in table and "Mkeys/s" in table
+
+
+def test_sweep_sorts_float_and_non_pow2_skip():
+    from icikit.utils.mesh import make_mesh
+    mesh = make_mesh(6)
+    records = sweep_sorts(mesh, sizes=(4096,), runs=2, warmup=1,
+                          dtype="float32")
+    # bitonic requires power-of-2 p and is skipped on 6 devices
+    algs = {r.algorithm for r in records}
+    assert "bitonic" not in algs
+    assert "sample" in algs
+    assert all(r.errors == 0 for r in records)
+    assert all(r.dtype == "float32" for r in records)

@@ -15,17 +15,17 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+# The ragged-exchange primitive moved to the collective layer
+# (icikit.parallel.alltoallv) where it is public, algorithm-selectable
+# API; these re-exports keep the sorts' internal import surface.
+from icikit.parallel.alltoallv import (  # noqa: F401
+    pack_segments,
+    unpack_rows,
+)
+from icikit.parallel.alltoallv import exchange_counts as _exchange_counts
+from icikit.parallel.alltoallv import ragged_all_to_all as _ragged_a2a
+from icikit.utils.dtypes import sentinel_for  # noqa: F401
 from icikit.utils.mesh import DEFAULT_AXIS, mesh_axis_size, shard_along
-
-
-def sentinel_for(dtype) -> jax.Array:
-    """Largest representable value — pads buffers so padding sorts last
-    (replacing the reference's degenerate ``INT_MAX`` sentinel for
-    double data, ``psort.cc:234`` — a recorded defect)."""
-    dtype = jnp.dtype(dtype)
-    if jnp.issubdtype(dtype, jnp.floating):
-        return jnp.array(jnp.inf, dtype)
-    return jnp.array(jnp.iinfo(dtype).max, dtype)
 
 
 def ceil_to(n: int, mult: int) -> int:
@@ -63,56 +63,22 @@ def take_sorted(out2d: jax.Array, n: int) -> jax.Array:
 
 
 # ---------------------------------------------------------------------------
-# capacity-padded ragged exchange (per-shard; call inside shard_map)
+# capacity-padded ragged exchange (per-shard; call inside shard_map) —
+# thin aliases over icikit.parallel.alltoallv with the XLA carrier the
+# sorts default to.
 # ---------------------------------------------------------------------------
 
 
-def pack_segments(a: jax.Array, starts: jax.Array, counts: jax.Array,
-                  cap: int) -> jax.Array:
-    """Pack p contiguous segments of local array ``a`` into (p, cap) rows
-    padded with sentinels. ``starts``/``counts``: (p,) int32, traced.
-
-    Because locally sorted data makes destination buckets contiguous
-    (the reference histograms into contiguous buckets, psort.cc:241-250),
-    packing is one vectorized gather — no per-bucket loop.
-    """
-    idx = starts[:, None] + jnp.arange(cap)[None, :]
-    valid = jnp.arange(cap)[None, :] < counts[:, None]
-    gathered = a[jnp.clip(idx, 0, a.shape[0] - 1)]
-    return jnp.where(valid, gathered, sentinel_for(a.dtype))
-
-
-def unpack_rows(rows: jax.Array, counts: jax.Array):
-    """Flatten (p, cap) rows with per-row valid ``counts`` into a flat
-    (p*cap,) array whose invalid lanes are sentinels, plus total count."""
-    cap = rows.shape[1]
-    valid = jnp.arange(cap)[None, :] < counts[:, None]
-    flat = jnp.where(valid, rows, sentinel_for(rows.dtype)).reshape(-1)
-    return flat, counts.sum()
-
-
 def exchange_counts(counts: jax.Array, axis: str) -> jax.Array:
-    """Given my per-destination ``counts`` (p,), return per-source counts
-    destined to me (p,) — the ``MPI_Alltoall`` of counts at
-    ``psort.cc:263``, as a tiny ``all_to_all``."""
-    return lax.all_to_all(counts[:, None], axis, split_axis=0,
-                          concat_axis=0, tiled=True)[:, 0]
+    """Per-source counts destined to me — ``psort.cc:263``."""
+    return _exchange_counts(counts, axis, counts.shape[0])
 
 
 def ragged_all_to_all(a: jax.Array, starts: jax.Array, counts: jax.Array,
                       cap: int, axis: str):
     """Send contiguous segment d of ``a`` to device d; receive segments.
-
-    Returns (rows (p, cap) sentinel-padded, recv_counts (p,), overflow
-    flag). ``overflow`` is 1 if any segment anywhere exceeded ``cap``
-    (content would be truncated) — callers surface it on the host.
-    """
-    overflow = lax.psum((counts > cap).any().astype(jnp.int32), axis)
-    packed = pack_segments(a, starts, counts, cap)
-    rows = lax.all_to_all(packed, axis, split_axis=0, concat_axis=0,
-                          tiled=True)
-    recv_counts = jnp.minimum(exchange_counts(counts, axis), cap)
-    return rows, recv_counts, overflow
+    See ``icikit.parallel.alltoallv.ragged_all_to_all``."""
+    return _ragged_a2a(a, starts, counts, cap, axis)
 
 
 def rebalance_sorted(flat: jax.Array, count: jax.Array, n_loc: int,

@@ -36,7 +36,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
-from icikit.models.sort.common import rebalance_sorted, sentinel_for
+from icikit.models.sort.common import rebalance_sorted
+from icikit.utils.dtypes import sentinel_for
 from icikit.ops.pallas_sort import local_sort
 from icikit.parallel.shmap import shard_map, xor_perm
 from icikit.utils.mesh import DEFAULT_AXIS, UnsupportedMeshError, ilog2, is_pow2

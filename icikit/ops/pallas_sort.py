@@ -351,7 +351,7 @@ def local_sort(x: jax.Array, backend: str = "auto", *,
     interpret = backend == "interpret"
     np2 = n if _is_pow2(n) else 1 << n.bit_length()
     if np2 != n:
-        from icikit.models.sort.common import sentinel_for
+        from icikit.utils.dtypes import sentinel_for
         x = jnp.concatenate(
             [x, jnp.full((np2 - n,), sentinel_for(x.dtype), x.dtype)])
     out = _build_sort(np2, jnp.dtype(x.dtype).name, t_grid, t_big,

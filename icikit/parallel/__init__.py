@@ -20,6 +20,10 @@ from icikit.parallel.alltoall import (  # noqa: F401
     ALLTOALL_ALGORITHMS,
     all_to_all_blocks,
 )
+from icikit.parallel.alltoallv import (  # noqa: F401
+    all_to_all_v,
+    ragged_all_to_all,
+)
 from icikit.parallel.allreduce import (  # noqa: F401
     ALLREDUCE_ALGORITHMS,
     all_reduce,

@@ -1,0 +1,132 @@
+"""Variable-count all-to-all (``MPI_Alltoallv``) — capacity-padded.
+
+The reference's ragged exchanges (``MPI_Alltoallv`` in the sample
+sorts, ``Parallel-Sorting/src/psort.cc:277,361``; variable
+``MPI_Send/Recv`` + ``MPI_Get_count`` in quicksort, ``:440-482``) have
+no direct XLA analog: TPU programs need static shapes. This module is
+the public form of the framework's answer (SURVEY.md §7 "hard parts"):
+fixed-capacity ``(p, cap)`` rows + explicit count vectors, overflow
+*detected* and surfaced instead of silently truncated — and the padded
+rows ride any registered ``alltoall`` schedule (hypercube, e-cube,
+wraparound, naive, or the XLA native collective), so the
+hand-rolled-vs-vendor study extends to the ragged case.
+
+Layout follows MPI: each device's send buffer holds p contiguous
+segments ordered by destination (displacements = exclusive cumsum of
+counts, ``MPI_Alltoallv``'s default usage); the receive side lands as
+``(p, cap)`` sentinel-padded rows ordered by source, with the true
+lengths in ``recv_counts``.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from icikit.parallel.shmap import wrap_program
+from icikit.utils.dtypes import sentinel_for
+from icikit.utils.mesh import DEFAULT_AXIS
+from icikit.utils.registry import get_algorithm
+
+
+def pack_segments(a: jax.Array, starts: jax.Array, counts: jax.Array,
+                  cap: int) -> jax.Array:
+    """Pack p contiguous segments of local array ``a`` into (p, cap) rows
+    padded with sentinels. ``starts``/``counts``: (p,) int32, traced.
+
+    Contiguous-by-destination layout makes packing one vectorized
+    gather — no per-bucket loop (the reference histograms into
+    contiguous buckets, ``psort.cc:241-250``).
+    """
+    idx = starts[:, None] + jnp.arange(cap)[None, :]
+    valid = jnp.arange(cap)[None, :] < counts[:, None]
+    gathered = a[jnp.clip(idx, 0, a.shape[0] - 1)]
+    return jnp.where(valid, gathered, sentinel_for(a.dtype))
+
+
+def unpack_rows(rows: jax.Array, counts: jax.Array):
+    """Flatten (p, cap) rows with per-row valid ``counts`` into a flat
+    (p*cap,) array whose invalid lanes are sentinels, plus total count."""
+    cap = rows.shape[1]
+    valid = jnp.arange(cap)[None, :] < counts[:, None]
+    flat = jnp.where(valid, rows, sentinel_for(rows.dtype)).reshape(-1)
+    return flat, counts.sum()
+
+
+def exchange_counts(counts: jax.Array, axis: str, p: int,
+                    algorithm: str = "xla") -> jax.Array:
+    """Given my per-destination ``counts`` (p,), return per-source counts
+    destined to me (p,) — the ``MPI_Alltoall`` of counts at
+    ``psort.cc:263``, carried by any registered alltoall schedule."""
+    carrier = get_algorithm("alltoall", algorithm)
+    return carrier(counts[:, None], axis, p)[:, 0]
+
+
+def ragged_all_to_all(a: jax.Array, starts: jax.Array, counts: jax.Array,
+                      cap: int, axis: str, p: int | None = None,
+                      algorithm: str = "xla"):
+    """Per-shard (inside shard_map): send contiguous segment d of ``a``
+    to device d; receive one segment per source.
+
+    Returns (rows (p, cap) sentinel-padded by source, recv_counts (p,),
+    overflow flag). ``overflow`` is 1 if any segment anywhere exceeded
+    ``cap`` (content would be truncated) — callers surface it on the
+    host rather than silently losing data.
+    """
+    if p is None:
+        p = counts.shape[0]
+    overflow = lax.psum((counts > cap).any().astype(jnp.int32), axis)
+    packed = pack_segments(a, starts, counts, cap)
+    carrier = get_algorithm("alltoall", algorithm)
+    rows = carrier(packed, axis, p)
+    recv_counts = jnp.minimum(
+        exchange_counts(counts, axis, p, algorithm), cap)
+    return rows, recv_counts, overflow
+
+
+@lru_cache(maxsize=None)
+def _build(mesh, axis, cap, algorithm):
+    p = mesh.shape[axis]
+
+    def per_shard(b, c):
+        a, counts = b[0], c[0]
+        starts = jnp.concatenate(
+            [jnp.zeros((1,), counts.dtype), jnp.cumsum(counts)[:-1]])
+        rows, recv, overflow = ragged_all_to_all(
+            a, starts, counts, cap, axis, p, algorithm)
+        return rows[None], recv[None], overflow[None]
+
+    return wrap_program(per_shard, mesh, (P(axis), P(axis)),
+                        (P(axis), P(axis), P(axis)))
+
+
+def all_to_all_v(x: jax.Array, send_counts: jax.Array, mesh,
+                 axis: str = DEFAULT_AXIS, capacity: int | None = None,
+                 algorithm: str = "xla"):
+    """Variable-count distributed exchange (``MPI_Alltoallv``).
+
+    Args:
+      x: global ``(p, L)`` sharded on dim 0. Device d's row holds p
+        contiguous segments ordered by destination: segment j (its
+        block for device j) spans
+        ``[cumsum(counts)[j-1], cumsum(counts)[j])``.
+      send_counts: global ``(p, p)`` int32 sharded on dim 0;
+        ``send_counts[d, j]`` = elements device d sends to device j.
+      capacity: static per-pair row capacity (default ``L``, always
+        safe). Smaller capacities cut wire volume; overflow is
+        reported, not truncated silently.
+      algorithm: any registered ``alltoall`` schedule.
+
+    Returns:
+      ``(rows, recv_counts, overflow)``: ``rows`` global ``(p, p,
+      capacity)`` — row ``[d, s]`` holds the segment source s sent to
+      device d, sentinel-padded past ``recv_counts[d, s]``; ``overflow``
+      ``(p,)`` replicated flag — nonzero means some segment exceeded
+      ``capacity`` and was truncated (re-run with a larger one).
+    """
+    cap = int(capacity if capacity is not None else x.shape[1])
+    return _build(mesh, axis, cap, algorithm)(x, send_counts)

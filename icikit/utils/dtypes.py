@@ -1,0 +1,17 @@
+"""Dtype helpers shared across layers."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def sentinel_for(dtype) -> jax.Array:
+    """Largest representable value — pads capacity buffers so padding
+    sorts last (replacing the reference's degenerate ``INT_MAX``
+    sentinel for double data, ``Parallel-Sorting/src/psort.cc:234`` — a
+    recorded defect)."""
+    dtype = jnp.dtype(dtype)
+    if jnp.issubdtype(dtype, jnp.floating):
+        return jnp.array(jnp.inf, dtype)
+    return jnp.array(jnp.iinfo(dtype).max, dtype)

@@ -4,7 +4,7 @@
 
 PY ?= python
 
-.PHONY: test test-fast bench native clean sweep scaling
+.PHONY: test test-fast bench native clean sweep scaling northstar
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -23,6 +23,9 @@ sweep:
 
 scaling:
 	$(PY) -m icikit.bench.scaling
+
+northstar:
+	$(PY) -m icikit.bench.northstar --out NORTHSTAR.md --json northstar.jsonl
 
 clean:
 	$(MAKE) -C icikit/native clean

@@ -24,6 +24,7 @@ import jax
 
 from icikit.models.sort.bitonic import bitonic_sort_blocks
 from icikit.models.sort.common import prepare_blocks, take_sorted
+from icikit.models.sort.kv import argsort_dist, sort_kv  # noqa: F401
 from icikit.models.sort.quicksort import hypercube_quicksort_blocks
 from icikit.models.sort.sample import sample_sort_blocks
 from icikit.models.sort.verify import check_sort, check_sort_shard  # noqa: F401

@@ -37,13 +37,14 @@ def next_pow2(n: int) -> int:
 
 
 def prepare_blocks(x: jax.Array, mesh, axis: str = DEFAULT_AXIS,
-                   pow2_local: bool = False):
-    """Pad flat ``x`` with sentinels to p equal blocks and shard.
+                   pow2_local: bool = False, fill=None):
+    """Pad flat ``x`` to p equal blocks and shard.
 
     The reference spreads the remainder over low ranks
-    (``psort.cc:556-562``); sentinel-padding to equal blocks keeps
-    shapes static and the padding sorts harmlessly to the global tail.
-    Returns (sharded (p, n_loc) array, n_loc).
+    (``psort.cc:556-562``); padding to equal blocks keeps shapes static.
+    ``fill`` defaults to the dtype sentinel, which sorts harmlessly to
+    the global tail (payload arrays pass e.g. 0 instead). Returns
+    (sharded (p, n_loc) array, n_loc).
     """
     p = mesh_axis_size(mesh, axis)
     n = x.shape[0]
@@ -52,8 +53,10 @@ def prepare_blocks(x: jax.Array, mesh, axis: str = DEFAULT_AXIS,
         n_loc = next_pow2(n_loc)
     total = n_loc * p
     if total != n:
-        fill = jnp.full((total - n,), sentinel_for(x.dtype), x.dtype)
-        x = jnp.concatenate([x, fill])
+        if fill is None:
+            fill = sentinel_for(x.dtype)
+        pad = jnp.full((total - n,), fill, x.dtype)
+        x = jnp.concatenate([x, pad])
     return shard_along(x.reshape(p, n_loc), mesh, axis), n_loc
 
 
@@ -82,7 +85,7 @@ def ragged_all_to_all(a: jax.Array, starts: jax.Array, counts: jax.Array,
 
 
 def rebalance_sorted(flat: jax.Array, count: jax.Array, n_loc: int,
-                     axis: str, p: int) -> jax.Array:
+                     axis: str, p: int, values: jax.Array | None = None):
     """Redistribute globally-sorted-but-ragged data to exactly ``n_loc``
     per device, preserving order.
 
@@ -90,7 +93,9 @@ def rebalance_sorted(flat: jax.Array, count: jax.Array, n_loc: int,
     elements (sentinel tail). Globally the valid runs concatenated in
     rank order are sorted. Output: (n_loc,) — device k ends with global
     positions [k*n_loc, (k+1)*n_loc), padded with sentinels past the
-    global total.
+    global total. When ``values`` is given (same shape as ``flat``,
+    payload lanes paired with the keys), the same routing is applied to
+    it and ``(keys, values)`` is returned — the KV form.
 
     This is the regular-shape answer to the reference's "local sizes
     change" property (``psort.cc:274``): one extra capacity-padded
@@ -123,4 +128,10 @@ def rebalance_sorted(flat: jax.Array, count: jax.Array, n_loc: int,
     col = jnp.clip(t - piece_off[s_of_t], 0, n_loc - 1)
     vals = rows[s_of_t, col]
     in_range = t < piece_end[-1]  # pieces tile [0, total-valid-here)
-    return jnp.where(in_range, vals, sentinel_for(flat.dtype))
+    keys_out = jnp.where(in_range, vals, sentinel_for(flat.dtype))
+    if values is None:
+        return keys_out
+    vrows, _, _ = ragged_all_to_all(values, starts, counts, n_loc, axis)
+    v = vrows[s_of_t, col]
+    values_out = jnp.where(in_range, v, jnp.zeros_like(v))
+    return keys_out, values_out

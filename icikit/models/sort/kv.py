@@ -1,0 +1,124 @@
+"""Distributed key-value sort and argsort.
+
+The reference sorts bare keys (``Parallel-Sorting/src/psort.cc`` works
+on ``double`` arrays only); an MPI practitioner sorting records pairs
+every key with a payload. This module is that capability, built on the
+sample-sort pipeline (C15/C16 — local sort, splitters, bucket route,
+final local sort): the bucket routing is *key-derived* but applied to
+key and value alike via the capacity-padded ragged exchange, and every
+local sort is a stable multi-operand ``lax.sort`` so values follow
+their keys exactly.
+
+Stability is end-to-end: equal keys keep their global input order —
+buckets split only *between* distinct key values (``searchsorted``
+side="left" sends every instance of a splitter value to one bucket),
+received rows concatenate in source-rank order, and the local sorts are
+stable. ``argsort_dist`` exploits this: sorting (keys, global indices)
+yields the permutation ``jnp.argsort(keys, stable=True)`` would.
+
+Validity through the padded exchange is an explicit flag sorted as the
+*primary* key (invalid lanes last), not a sentinel key value — so keys
+equal to the dtype's maximum stay correctly paired with their values
+(the sentinel trick the key-only sorts use would scramble them).
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from icikit.models.sort.common import (
+    prepare_blocks,
+    ragged_all_to_all,
+    rebalance_sorted,
+)
+from icikit.models.sort.sample import bucket_route, run_with_capacity_retry
+from icikit.parallel.alltoallv import ragged_payload
+from icikit.parallel.shmap import shard_map
+from icikit.utils.dtypes import sentinel_for
+from icikit.utils.mesh import DEFAULT_AXIS
+
+
+def _sort_kv_local(k, v, valid=None):
+    """Stable local KV sort; ``valid`` lanes (when given) sort first via
+    an is-invalid primary key."""
+    if valid is None:
+        return lax.sort((k, v), dimension=0, num_keys=1, is_stable=True)
+    inval = (~valid).astype(jnp.int32)
+    _, k_s, v_s = lax.sort((inval, k, v), dimension=0, num_keys=2,
+                           is_stable=True)
+    return k_s, v_s
+
+
+def sample_sort_kv_shard(k: jax.Array, v: jax.Array, axis: str, p: int,
+                         cap: int, splitter: str):
+    """Per-shard KV sample sort. Returns (keys, values, overflow)."""
+    n_loc = k.shape[0]
+    k, v = _sort_kv_local(k, v)
+    if p == 1:
+        return k, v, jnp.zeros((), jnp.int32)
+
+    starts, counts = bucket_route(k, axis, p, splitter)
+    krows, recv_counts, overflow = ragged_all_to_all(k, starts, counts,
+                                                     cap, axis)
+    # values leg: same routing, no redundant metadata collectives
+    vrows = ragged_payload(v, starts, counts, cap, axis, p)
+    valid = (jnp.arange(cap)[None, :] < recv_counts[:, None]).reshape(-1)
+    k_flat, v_flat = krows.reshape(-1), vrows.reshape(-1)
+    k_flat, v_flat = _sort_kv_local(k_flat, v_flat, valid)
+    k_out, v_out = rebalance_sorted(
+        jnp.where(valid.sum() > jnp.arange(k_flat.shape[0]),
+                  k_flat, sentinel_for(k_flat.dtype)),
+        valid.sum(), n_loc, axis, p, values=v_flat)
+    return k_out, v_out, overflow
+
+
+@lru_cache(maxsize=None)
+def _build(mesh, axis, cap, splitter):
+    p = mesh.shape[axis]
+
+    def per_shard(bk, bv):
+        k, v, overflow = sample_sort_kv_shard(bk[0], bv[0], axis, p, cap,
+                                              splitter)
+        return k[None], v[None], overflow[None]
+
+    return jax.jit(shard_map(per_shard, mesh=mesh,
+                             in_specs=(P(axis), P(axis)),
+                             out_specs=(P(axis), P(axis), P(axis)),
+                             check_vma=False))
+
+
+def sort_kv(keys: jax.Array, values: jax.Array, mesh,
+            axis: str = DEFAULT_AXIS, splitter: str = "allgather",
+            cap_factor: float = 4.0):
+    """Sort flat ``keys`` ascending across the mesh, carrying ``values``.
+
+    Stable: equal keys keep their input order (so values are
+    deterministic). Returns ``(sorted_keys, permuted_values)`` of the
+    input length. ``values`` must be flat with ``values.shape ==
+    keys.shape``.
+    """
+    if keys.shape != values.shape:
+        raise ValueError(f"keys {keys.shape} and values {values.shape} "
+                         "must have identical shapes")
+    n = keys.shape[0]
+    k2d, n_loc = prepare_blocks(keys, mesh, axis)
+    v2d, _ = prepare_blocks(values, mesh, axis, fill=0)
+    p = k2d.shape[0]
+    k, v, _ = run_with_capacity_retry(
+        lambda cap: _build(mesh, axis, cap, splitter), n_loc, p,
+        cap_factor, k2d, v2d)
+    return k.reshape(-1)[:n], v.reshape(-1)[:n]
+
+
+def argsort_dist(keys: jax.Array, mesh, axis: str = DEFAULT_AXIS,
+                 **kw) -> jax.Array:
+    """Distributed stable argsort: the permutation that sorts ``keys``
+    (``jnp.argsort(keys, stable=True)``, computed across the mesh)."""
+    idx = jnp.arange(keys.shape[0], dtype=jnp.int32)
+    _, perm = sort_kv(keys, idx, mesh, axis, **kw)
+    return perm

@@ -64,6 +64,30 @@ def _splitters_bitonic(samples: jax.Array, axis: str,
     return meds[:-1]
 
 
+def bucket_route(a: jax.Array, axis: str, p: int, splitter: str):
+    """Splitter selection + bucket bounds for a locally *sorted* block:
+    returns (starts, counts) of the p contiguous destination buckets.
+
+    Single source of the routing contract for both the key-only and the
+    key-value sample sorts: p-1 evenly spaced local samples, splitters
+    by the chosen scheme, then bucket bounds by binary search instead of
+    the reference's linear scan (``psort.cc:241-250``). ``side="left"``
+    sends every instance of a splitter-valued key to one bucket — the
+    property the KV sort's stability contract rests on.
+    """
+    n_loc = a.shape[0]
+    samp_idx = (jnp.arange(1, p) * n_loc) // p
+    samples = a[samp_idx]
+    if splitter == "bitonic":
+        splitters = _splitters_bitonic(samples, axis, p)
+    else:
+        splitters = _splitters_allgather(samples, axis, p)
+    bounds = jnp.searchsorted(a, splitters, side="left").astype(jnp.int32)
+    starts = jnp.concatenate([jnp.zeros(1, jnp.int32), bounds])
+    ends = jnp.concatenate([bounds, jnp.array([n_loc], jnp.int32)])
+    return starts, ends - starts
+
+
 def sample_sort_shard(a: jax.Array, axis: str, p: int, cap: int,
                       splitter: str):
     """Per-shard sample sort. Returns (sorted (n_loc,) block, overflow).
@@ -77,20 +101,7 @@ def sample_sort_shard(a: jax.Array, axis: str, p: int, cap: int,
     if p == 1:
         return a, jnp.zeros((), jnp.int32)
 
-    samp_idx = (jnp.arange(1, p) * n_loc) // p
-    samples = a[samp_idx]
-    if splitter == "bitonic":
-        splitters = _splitters_bitonic(samples, axis, p)
-    else:
-        splitters = _splitters_allgather(samples, axis, p)
-
-    # Buckets are contiguous in the sorted local array: histogram by
-    # binary search instead of the reference's linear scan (:241-250).
-    bounds = jnp.searchsorted(a, splitters, side="left").astype(jnp.int32)
-    starts = jnp.concatenate([jnp.zeros(1, jnp.int32), bounds])
-    ends = jnp.concatenate([bounds, jnp.array([n_loc], jnp.int32)])
-    counts = ends - starts
-
+    starts, counts = bucket_route(a, axis, p, splitter)
     rows, recv_counts, overflow = ragged_all_to_all(a, starts, counts,
                                                     cap, axis)
     flat, valid = unpack_rows(rows, recv_counts)
@@ -112,19 +123,27 @@ def _build(mesh, axis, cap, splitter):
                              check_vma=False))
 
 
+def run_with_capacity_retry(build, n_loc: int, p: int, cap_factor: float,
+                            *operands):
+    """Run a capacity-parameterized program with the standard escalation:
+    start at ``cap_factor * n_loc / p`` (balanced buckets need ~n_loc/p),
+    retry once at the safe capacity n_loc if any bucket overflowed — the
+    price of static shapes, made explicit instead of the reference's
+    unchecked over-allocation. ``build(cap)`` returns a callable whose
+    result tuple ends with the overflow flag."""
+    cap = max(1, min(n_loc, int(cap_factor * n_loc / max(p, 1))))
+    out = build(cap)(*operands)
+    if int(jax.device_get(out[-1].sum())) > 0 and cap < n_loc:
+        out = build(n_loc)(*operands)
+    return out
+
+
 def sample_sort_blocks(x2d: jax.Array, mesh, axis: str = DEFAULT_AXIS,
                        splitter: str = "allgather",
                        cap_factor: float = 4.0):
-    """Sort block-sharded (p, n_loc) data globally ascending.
-
-    Starts with bucket capacity ``cap_factor * n_loc / p`` (balanced
-    buckets need ~n_loc/p) and retries once with the safe capacity
-    n_loc if any bucket overflowed — the price of static shapes, made
-    explicit instead of the reference's unchecked over-allocation.
-    """
+    """Sort block-sharded (p, n_loc) data globally ascending."""
     p, n_loc = x2d.shape
-    cap = max(1, min(n_loc, int(cap_factor * n_loc / max(p, 1))))
-    out, overflow = _build(mesh, axis, cap, splitter)(x2d)
-    if int(jax.device_get(overflow.sum())) > 0 and cap < n_loc:
-        out, overflow = _build(mesh, axis, n_loc, splitter)(x2d)
+    out, _ = run_with_capacity_retry(
+        lambda cap: _build(mesh, axis, cap, splitter), n_loc, p,
+        cap_factor, x2d)
     return out

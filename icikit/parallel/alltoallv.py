@@ -66,6 +66,19 @@ def exchange_counts(counts: jax.Array, axis: str, p: int,
     return carrier(counts[:, None], axis, p)[:, 0]
 
 
+def ragged_payload(a: jax.Array, starts: jax.Array, counts: jax.Array,
+                   cap: int, axis: str, p: int | None = None,
+                   algorithm: str = "xla") -> jax.Array:
+    """The data leg of a ragged exchange alone: pack + carry, no count
+    exchange or overflow psum. For a second operand routed with starts/
+    counts that ``ragged_all_to_all`` already exchanged (the KV sorts'
+    values leg) — skips the two redundant metadata collectives."""
+    if p is None:
+        p = counts.shape[0]
+    packed = pack_segments(a, starts, counts, cap)
+    return get_algorithm("alltoall", algorithm)(packed, axis, p)
+
+
 def ragged_all_to_all(a: jax.Array, starts: jax.Array, counts: jax.Array,
                       cap: int, axis: str, p: int | None = None,
                       algorithm: str = "xla"):
@@ -80,9 +93,7 @@ def ragged_all_to_all(a: jax.Array, starts: jax.Array, counts: jax.Array,
     if p is None:
         p = counts.shape[0]
     overflow = lax.psum((counts > cap).any().astype(jnp.int32), axis)
-    packed = pack_segments(a, starts, counts, cap)
-    carrier = get_algorithm("alltoall", algorithm)
-    rows = carrier(packed, axis, p)
+    rows = ragged_payload(a, starts, counts, cap, axis, p, algorithm)
     recv_counts = jnp.minimum(
         exchange_counts(counts, axis, p, algorithm), cap)
     return rows, recv_counts, overflow

@@ -1,0 +1,21 @@
+"""North-star runner smoke: all targets execute, verify, and render."""
+
+import pytest
+
+from icikit.bench.northstar import render_markdown, run_northstar
+
+
+@pytest.mark.slow
+def test_northstar_quick(mesh4):
+    coll, sorts, dlb, checks = run_northstar(mesh4, quick=True, runs=2)
+    assert checks["collectives_verified"]
+    assert checks["sorts_verified"]
+    assert checks["dlb_schedulers_agree"]
+    assert {r.algorithm for r in sorts} == {
+        "bitonic", "sample", "sample_bitonic", "quicksort"}
+    assert {d["strategy"] for d in dlb} == {"static", "dynamic"}
+    md = render_markdown(coll, sorts, dlb, checks,
+                         {"platform": "cpu", "p": 4,
+                          "date": "test", "wall_s": 0.0})
+    assert "Target checks" in md and "PASS" in md
+    assert "allreduce" in md and "bitonic" in md

@@ -1,0 +1,103 @@
+"""Distributed key-value sort / argsort vs the numpy stable oracle."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from icikit.models.sort import argsort_dist, sort_kv
+from icikit.utils.mesh import make_mesh
+
+
+def _case(n, seed=0, dup_heavy=False, dtype=np.int32):
+    rng = np.random.default_rng(seed)
+    hi = 8 if dup_heavy else 10_000
+    keys = rng.integers(-hi, hi, n).astype(dtype)
+    vals = rng.integers(0, 1 << 30, n).astype(np.int32)
+    return keys, vals
+
+
+def _oracle(keys, vals):
+    perm = np.argsort(keys, kind="stable")
+    return keys[perm], vals[perm]
+
+
+@pytest.mark.parametrize("splitter", ["allgather", "bitonic"])
+@pytest.mark.parametrize("n", [256, 1000])  # 1000: padding path
+def test_sort_kv_matches_stable_oracle(mesh8, splitter, n):
+    keys, vals = _case(n, seed=1)
+    ek, ev = _oracle(keys, vals)
+    k, v = sort_kv(jnp.asarray(keys), jnp.asarray(vals), mesh8,
+                   splitter=splitter)
+    np.testing.assert_array_equal(np.asarray(k), ek)
+    np.testing.assert_array_equal(np.asarray(v), ev)
+
+
+def test_sort_kv_duplicate_keys_stable(mesh8):
+    """Heavy duplicates: stability decides the value order — must match
+    numpy's stable argsort exactly."""
+    keys, vals = _case(512, seed=2, dup_heavy=True)
+    ek, ev = _oracle(keys, vals)
+    k, v = sort_kv(jnp.asarray(keys), jnp.asarray(vals), mesh8)
+    np.testing.assert_array_equal(np.asarray(k), ek)
+    np.testing.assert_array_equal(np.asarray(v), ev)
+
+
+def test_sort_kv_max_keys_keep_values(mesh8):
+    """Keys at the dtype max (the sentinel value) stay paired — the
+    validity-flag design, not the sentinel trick."""
+    keys = np.full(64, np.iinfo(np.int32).max, np.int32)
+    keys[::3] = 7
+    vals = np.arange(64, dtype=np.int32)
+    ek, ev = _oracle(keys, vals)
+    k, v = sort_kv(jnp.asarray(keys), jnp.asarray(vals), mesh8)
+    np.testing.assert_array_equal(np.asarray(k), ek)
+    np.testing.assert_array_equal(np.asarray(v), ev)
+
+
+def test_sort_kv_float_keys(mesh8):
+    rng = np.random.default_rng(3)
+    keys = rng.standard_normal(300).astype(np.float32)
+    vals = np.arange(300, dtype=np.int32)
+    ek, ev = _oracle(keys, vals)
+    k, v = sort_kv(jnp.asarray(keys), jnp.asarray(vals), mesh8)
+    np.testing.assert_array_equal(np.asarray(k), ek)
+    np.testing.assert_array_equal(np.asarray(v), ev)
+
+
+def test_sort_kv_skewed_overflow_retry(mesh8):
+    """All keys equal: every element routes to one bucket, far past the
+    initial capacity — the safe-capacity retry must engage and the
+    result stays exact."""
+    keys = np.zeros(512, np.int32)
+    vals = np.arange(512, dtype=np.int32)
+    k, v = sort_kv(jnp.asarray(keys), jnp.asarray(vals), mesh8)
+    np.testing.assert_array_equal(np.asarray(k), keys)
+    np.testing.assert_array_equal(np.asarray(v), vals)
+
+
+def test_argsort_dist(mesh8):
+    keys, _ = _case(400, seed=4, dup_heavy=True)
+    perm = np.asarray(argsort_dist(jnp.asarray(keys), mesh8))
+    np.testing.assert_array_equal(perm, np.argsort(keys, kind="stable"))
+
+
+def test_sort_kv_shape_mismatch(mesh8):
+    with pytest.raises(ValueError, match="identical shapes"):
+        sort_kv(jnp.zeros(8), jnp.zeros(9), mesh8)
+
+
+def test_sort_kv_p1(mesh1):
+    keys, vals = _case(128, seed=5)
+    ek, ev = _oracle(keys, vals)
+    k, v = sort_kv(jnp.asarray(keys), jnp.asarray(vals), mesh1)
+    np.testing.assert_array_equal(np.asarray(k), ek)
+    np.testing.assert_array_equal(np.asarray(v), ev)
+
+
+def test_sort_kv_non_pow2_mesh():
+    mesh = make_mesh(6)
+    keys, vals = _case(300, seed=6)
+    ek, ev = _oracle(keys, vals)
+    k, v = sort_kv(jnp.asarray(keys), jnp.asarray(vals), mesh)
+    np.testing.assert_array_equal(np.asarray(k), ek)
+    np.testing.assert_array_equal(np.asarray(v), ev)

@@ -34,7 +34,11 @@ from icikit.parallel.collops import (  # noqa: F401
     scatter_blocks,
 )
 from icikit.parallel.multihost import (  # noqa: F401
+    hier_chunk_index,
+    hierarchical_all_gather,
     hierarchical_all_reduce,
+    hierarchical_all_to_all,
+    hierarchical_reduce_scatter,
     init_distributed,
     make_hybrid_mesh,
     process_info,

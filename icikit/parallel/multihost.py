@@ -20,6 +20,17 @@ crosses DCN. This module is that layer:
   DCN, allgather on ICI: per-device DCN traffic drops from m to
   m/p_ici. Inner steps are the registered schedules, so the
   hand-rolled-vs-vendor study (report.pdf §2.4) extends across tiers.
+- ``hierarchical_all_gather`` — allgather across DCN *first* (original
+  m-sized blocks), then the ×p_ici expansion rides ICI: DCN sees
+  (p_dcn−1)·m per device instead of p_ici·(p_dcn−1)·m.
+- ``hierarchical_reduce_scatter`` — reduce-scatter on ICI then on DCN;
+  only an m/p_ici chunk ever crosses DCN. Output chunks land in
+  (ici, dcn)-major order — ``hier_chunk_index`` gives the permutation.
+- ``hierarchical_all_to_all`` — two-step factorized transpose: ICI
+  exchange keyed by destination chip, DCN exchange keyed by
+  destination slice. Total DCN volume is irreducible for a transpose,
+  but messages aggregate ×p_ici (only same-chip-position pairs talk
+  across DCN — p²/p_ici flows instead of p²).
 """
 
 from __future__ import annotations
@@ -201,4 +212,155 @@ def hierarchical_all_reduce(x: jax.Array, mesh: Mesh,
         ici_algorithm, ici_algorithm)
     fn = _build_hierarchical_all_reduce(
         mesh, dcn_axis, ici_axis, op, rs_name, ag_name, dcn_algorithm)
+    return fn(x)
+
+
+@lru_cache(maxsize=None)
+def _build_hierarchical_all_gather(mesh, dcn_axis, ici_axis, dcn_name,
+                                   ici_name):
+    ag_dcn = get_algorithm("allgather", dcn_name)
+    ag_ici = get_algorithm("allgather", ici_name)
+    p_dcn, p_ici = mesh.shape[dcn_axis], mesh.shape[ici_axis]
+
+    def per_shard(b):  # (1, m) — device (s, j)'s block
+        slice_stack = ag_dcn(b, dcn_axis, p_dcn)        # (p_dcn, m): every
+        # slice's block at my chip position j — only m-sized blocks
+        # crossed DCN. The ×p_ici expansion happens on ICI:
+        full = ag_ici(slice_stack[None], ici_axis, p_ici)
+        # (p_ici, p_dcn, m) indexed [j', s', m] -> global row-major (s', j')
+        m = full.shape[-1]
+        return full.transpose(1, 0, 2).reshape(1, p_dcn * p_ici, m)
+
+    spec = P((dcn_axis, ici_axis))
+    return wrap_program(per_shard, mesh, spec, spec)
+
+
+def hierarchical_all_gather(x: jax.Array, mesh: Mesh,
+                            dcn_axis: str = DCN_AXIS,
+                            ici_axis: str = DEFAULT_AXIS,
+                            ici_algorithm: str = "ring",
+                            dcn_algorithm: str = "ring") -> jax.Array:
+    """Two-tier allgather: DCN first (original blocks), ICI second.
+
+    Args:
+      x: global ``(p_dcn * p_ici, m)`` block-sharded over both axes
+        (device (s, j) contributes row ``s * p_ici + j``).
+
+    Returns:
+      ``(p, p, m)`` sharded like the input's leading dim: every device's
+      row holds all p blocks in global order — the flat
+      ``all_gather_blocks`` contract, with DCN traffic cut ×p_ici.
+    """
+    if x.ndim != 2:
+        raise ValueError(
+            f"hierarchical_all_gather needs (p, m) input; got {x.shape}")
+    fn = _build_hierarchical_all_gather(mesh, dcn_axis, ici_axis,
+                                        dcn_algorithm, ici_algorithm)
+    return fn(x)
+
+
+def hier_chunk_index(mesh: Mesh, dcn_axis: str = DCN_AXIS,
+                     ici_axis: str = DEFAULT_AXIS) -> np.ndarray:
+    """Global chunk id held by each device row after
+    ``hierarchical_reduce_scatter``: device (s, j) = row s*p_ici + j
+    ends with chunk j*p_dcn + s ((ici, dcn)-major)."""
+    p_dcn, p_ici = mesh.shape[dcn_axis], mesh.shape[ici_axis]
+    s, j = np.divmod(np.arange(p_dcn * p_ici), p_ici)
+    return j * p_dcn + s
+
+
+@lru_cache(maxsize=None)
+def _build_hierarchical_reduce_scatter(mesh, dcn_axis, ici_axis, op,
+                                       ici_name, dcn_name):
+    rs_ici = get_algorithm("reducescatter", ici_name)
+    rs_dcn = get_algorithm("reducescatter", dcn_name)
+    p_dcn, p_ici = mesh.shape[dcn_axis], mesh.shape[ici_axis]
+
+    def per_shard(b):  # (1, m) -> (1, m/p) — my fully-reduced chunk
+        chunk = rs_ici(b[0], ici_axis, p_ici, op)   # (m/p_ici,) slice-local
+        piece = rs_dcn(chunk, dcn_axis, p_dcn, op)  # only this crosses DCN
+        return piece[None]
+
+    spec = P((dcn_axis, ici_axis))
+    return wrap_program(per_shard, mesh, spec, spec)
+
+
+def hierarchical_reduce_scatter(x: jax.Array, mesh: Mesh,
+                                dcn_axis: str = DCN_AXIS,
+                                ici_axis: str = DEFAULT_AXIS,
+                                op: str = "sum",
+                                ici_algorithm: str = "ring",
+                                dcn_algorithm: str = "ring") -> jax.Array:
+    """Two-tier reduce-scatter: ICI reduces m to m/p_ici, then only
+    that chunk crosses DCN.
+
+    Args:
+      x: global ``(p, m)`` block-sharded over both axes; ``m`` must be
+        divisible by ``p_dcn * p_ici``.
+
+    Returns:
+      ``(p, m/p)``: each device holds one fully-reduced global chunk,
+      in (ici, dcn)-major order — ``hier_chunk_index(mesh)`` maps
+      device row to chunk id (an allgather with the inverse layout, or
+      ``hierarchical_all_reduce``'s final ICI gather, undoes it).
+    """
+    p_ici = mesh.shape[ici_axis]
+    p_dcn = mesh.shape[dcn_axis]
+    if x.ndim != 2 or x.shape[1] % (p_ici * p_dcn):
+        raise ValueError(
+            f"hierarchical_reduce_scatter needs (p, m) with m divisible "
+            f"by p={p_ici * p_dcn}; got {x.shape}")
+    fn = _build_hierarchical_reduce_scatter(
+        mesh, dcn_axis, ici_axis, op, ici_algorithm, dcn_algorithm)
+    return fn(x)
+
+
+@lru_cache(maxsize=None)
+def _build_hierarchical_all_to_all(mesh, dcn_axis, ici_axis, ici_name,
+                                   dcn_name):
+    a2a_ici = get_algorithm("alltoall", ici_name)
+    a2a_dcn = get_algorithm("alltoall", dcn_name)
+    p_dcn, p_ici = mesh.shape[dcn_axis], mesh.shape[ici_axis]
+
+    def per_shard(b):  # (1, p, m): my blocks by destination row-major
+        m = b.shape[-1]
+        buf = b[0].reshape(p_dcn, p_ici, m)        # [dest_s, dest_j, m]
+        # Step 1 (ICI): exchange keyed by destination chip position,
+        # carrying p_dcn-block bundles.
+        t = a2a_ici(buf.transpose(1, 0, 2), ici_axis, p_ici)
+        # t: [src_j, dest_s, m] — everything my slice holds for chip
+        # position j of any slice.
+        # Step 2 (DCN): exchange keyed by destination slice — the only
+        # DCN hop, aggregated ×p_ici.
+        u = a2a_dcn(t.transpose(1, 0, 2), dcn_axis, p_dcn)
+        # u: [src_s, src_j, m] -> (p, m) source-major, the flat contract
+        return u.reshape(1, p_dcn * p_ici, m)
+
+    spec = P((dcn_axis, ici_axis))
+    return wrap_program(per_shard, mesh, spec, spec)
+
+
+def hierarchical_all_to_all(x: jax.Array, mesh: Mesh,
+                            dcn_axis: str = DCN_AXIS,
+                            ici_axis: str = DEFAULT_AXIS,
+                            ici_algorithm: str = "xla",
+                            dcn_algorithm: str = "xla") -> jax.Array:
+    """Two-tier distributed transpose (factorized all-to-all).
+
+    Args:
+      x: global ``(p, p, m)`` sharded on dim 0 — device d's row holds
+        its p destination blocks in global (dcn, ici) row-major order.
+
+    Returns:
+      ``(p, p, m)`` equal to ``swapaxes(x, 0, 1)`` — the flat
+      ``all_to_all_blocks`` contract, with cross-DCN messages
+      aggregated ×p_ici.
+    """
+    p = mesh.shape[dcn_axis] * mesh.shape[ici_axis]
+    if x.ndim != 3 or x.shape[1] != p:
+        raise ValueError(
+            f"hierarchical_all_to_all needs (p, p, m) input with "
+            f"p={p} destination blocks per device; got {x.shape}")
+    fn = _build_hierarchical_all_to_all(mesh, dcn_axis, ici_axis,
+                                        ici_algorithm, dcn_algorithm)
     return fn(x)

@@ -96,3 +96,69 @@ def test_init_distributed_noop_single_process(monkeypatch):
 def test_process_info_single_process():
     idx, count, local = process_info()
     assert idx == 0 and count == 1 and local >= 8
+
+
+# --------------------------------------------------------------- new tiers
+
+
+@pytest.mark.parametrize("dcn,ici", [(2, 4), (4, 2), (1, 8)])
+@pytest.mark.parametrize("algorithm", ["ring", "xla"])
+def test_hierarchical_all_gather(dcn, ici, algorithm):
+    from icikit.parallel.multihost import hierarchical_all_gather
+    mesh = make_hybrid_mesh(dcn_size=dcn, ici_size=ici)
+    data, x = _hybrid_data(mesh, 8, seed=3)
+    out = np.asarray(hierarchical_all_gather(
+        x, mesh, dcn_algorithm=algorithm, ici_algorithm=algorithm))
+    assert out.shape == (dcn * ici, dcn * ici, 8)
+    for d in range(dcn * ici):
+        np.testing.assert_array_equal(out[d], data)
+
+
+@pytest.mark.parametrize("dcn,ici", [(2, 4), (4, 2)])
+@pytest.mark.parametrize("op,npop", [("sum", np.sum), ("max", np.max)])
+def test_hierarchical_reduce_scatter(dcn, ici, op, npop):
+    from icikit.parallel.multihost import (
+        hier_chunk_index,
+        hierarchical_reduce_scatter,
+    )
+    mesh = make_hybrid_mesh(dcn_size=dcn, ici_size=ici)
+    p = dcn * ici
+    m = 2 * p
+    data, x = _hybrid_data(mesh, m, seed=4)
+    out = np.asarray(hierarchical_reduce_scatter(x, mesh, op=op))
+    total = npop(data, axis=0).reshape(p, m // p)
+    chunk_of = hier_chunk_index(mesh)
+    for d in range(p):
+        np.testing.assert_array_equal(out[d], total[chunk_of[d]])
+
+
+def test_hierarchical_reduce_scatter_validates():
+    from icikit.parallel.multihost import hierarchical_reduce_scatter
+    mesh = make_hybrid_mesh(dcn_size=2, ici_size=4)
+    data, x = _hybrid_data(mesh, 8, seed=5)
+    with pytest.raises(ValueError):
+        hierarchical_reduce_scatter(x[:, :6], mesh)  # 6 % 8 != 0
+
+
+@pytest.mark.parametrize("dcn,ici", [(2, 4), (4, 2), (2, 2)])
+def test_hierarchical_all_to_all(dcn, ici):
+    from icikit.parallel.multihost import hierarchical_all_to_all
+    mesh = make_hybrid_mesh(dcn_size=dcn, ici_size=ici)
+    p = dcn * ici
+    rng = np.random.default_rng(6)
+    data = rng.integers(-100, 100, size=(p, p, 4)).astype(np.int32)
+    x = shard_along(jnp.asarray(data), mesh, axis_name=("dcn", "p"))
+    out = np.asarray(hierarchical_all_to_all(x, mesh))
+    np.testing.assert_array_equal(out, data.swapaxes(0, 1))
+
+
+def test_hierarchical_all_to_all_handrolled_carriers():
+    from icikit.parallel.multihost import hierarchical_all_to_all
+    mesh = make_hybrid_mesh(dcn_size=2, ici_size=4)
+    p = 8
+    rng = np.random.default_rng(7)
+    data = rng.integers(-100, 100, size=(p, p, 4)).astype(np.int32)
+    x = shard_along(jnp.asarray(data), mesh, axis_name=("dcn", "p"))
+    out = np.asarray(hierarchical_all_to_all(
+        x, mesh, ici_algorithm="hypercube", dcn_algorithm="wraparound"))
+    np.testing.assert_array_equal(out, data.swapaxes(0, 1))

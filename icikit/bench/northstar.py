@@ -125,6 +125,13 @@ def render_markdown(coll, sorts, dlb, checks, meta) -> str:
                      f"{d['n_solutions']} | {d['wall_s']:.3f} | "
                      f"{d['imbalance']:.2f} |")
     lines.append("")
+    if meta["p"] == 1:
+        lines.append(
+            "> **Note:** with a single device every collective is the "
+            "identity program, so this section only demonstrates "
+            "verified degenerate execution — bandwidth comparisons "
+            "need a mesh (run with `--simulate --devices 8`, or on "
+            "multi-chip hardware).\n")
     lines.append(render_report(
         [dataclasses.asdict(r) for r in coll],
         title="Collective families (best µs; busbw in JSON records)"))

@@ -19,7 +19,15 @@ import sys
 from collections import defaultdict
 
 
+# Below this, a measurement is noise: an identity program (p=1), a
+# cached replay on a tunneled device, or a two-point subtraction that
+# collapsed. Excluded from rankings; rendered as "<1".
+MIN_MEASURABLE_S = 1e-6
+
+
 def _fmt_time(s: float) -> str:
+    if s < MIN_MEASURABLE_S:
+        return "<1"
     return f"{s * 1e6:,.1f}"
 
 
@@ -75,6 +83,11 @@ def _ranking(records, family) -> str:
     for r in recs:
         by_config[(r["p"], r["msize"])].append(r)
     for cfg, rs in sorted(by_config.items()):
+        if any(r["best_s"] < MIN_MEASURABLE_S for r in rs):
+            # one unmeasurable entry poisons the whole comparison at
+            # this config: dropping just that record would crown a
+            # slower survivor as the winner
+            continue
         best = min(rs, key=lambda r: r["best_s"])
         wins[best["algorithm"]] += 1
         xla = next((r for r in rs if r["algorithm"] == "xla"), None)
@@ -128,7 +141,10 @@ def main(argv=None):
         with open(args.out, "w") as f:
             f.write(text)
     else:
-        print(text)
+        try:
+            print(text)
+        except BrokenPipeError:  # e.g. `| head` closed the pipe
+            return 0
     return 0
 
 

@@ -27,7 +27,7 @@ def test_mesh_sweep_includes_schedules(mesh8):
                            dtype="float32", mode="fwd", runs=1, warmup=1,
                            mesh=mesh8)
     impls = {r.impl for r in recs}
-    assert {"dense", "flash", "ring", "ulysses"} <= impls
+    assert {"dense", "flash", "ring", "ulysses", "zigzag"} <= impls
     assert all(r.verified for r in recs), [
         (r.impl, r.max_err) for r in recs]
     assert all(r.p == 8 for r in recs)

@@ -73,9 +73,38 @@ def detect_peak() -> float:
     return 0.0
 
 
+def measure_peak(n: int = 8192, iters: int = 50) -> float:
+    """Achievable bf16 matmul FLOP/s on this device, measured.
+
+    Nameplate peaks (PEAK_FLOPS) assume full clocks and exclusive
+    chips; tunneled or shared allocations can deliver a fraction of
+    that (measured: ~85 of 197 TFLOP/s on one tunneled v5e), making
+    nameplate MFU uninterpretable. One in-jit chain of large bf16
+    matmuls gives the ceiling the train step is actually racing.
+    """
+    from jax import lax
+
+    from icikit.utils.timing import timeit_chained
+
+    k = jax.random.key(0)
+    a = jax.random.normal(k, (n, n), jnp.bfloat16)
+    # unit-spectral-ish scaling: std((x@b)_ij) = sqrt(n)*std(x)*std(b),
+    # so std(b) = 1/sqrt(n) keeps the chain bounded — an unscaled chain
+    # overflows bf16 to all-NaN within ~10 iterations, making every run
+    # value-identical (exactly the cacheable pattern this measurement
+    # must avoid on tunneled backends)
+    b = jax.random.normal(k, (n, n), jnp.bfloat16) * (n ** -0.5)
+    f = jax.jit(lambda a: lax.fori_loop(
+        0, iters, lambda i, x: (x @ b).astype(jnp.bfloat16), a))
+    res = timeit_chained(f, (a,), lambda args, out: (out,), runs=2,
+                         warmup=1)
+    return 2.0 * n ** 3 * iters / res.mean_s
+
+
 def run_bench(preset: str, dp: int, tp: int, sp: int, batch: int,
               steps: int, warmup: int, moe_experts: int = 0,
-              kv_heads: int = 0) -> dict:
+              kv_heads: int = 0, remat: bool = True,
+              calibrate_peak: bool = False) -> dict:
     import optax
 
     from icikit.models.transformer import (
@@ -85,7 +114,7 @@ def run_bench(preset: str, dp: int, tp: int, sp: int, batch: int,
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     cfg = TransformerConfig(**PRESETS[preset], n_experts=moe_experts,
-                            n_kv_heads=kv_heads)
+                            n_kv_heads=kv_heads, remat=remat)
     mesh = make_model_mesh(dp=dp, tp=tp, sp=sp)
     params = init_params(jax.random.key(0), cfg, mesh)
     optimizer, step = make_train_step(mesh, cfg, optax.adam(1e-4))
@@ -115,9 +144,11 @@ def run_bench(preset: str, dp: int, tp: int, sp: int, batch: int,
     peak = detect_peak() * n_dev
     moe_tag = f"_e{moe_experts}" if moe_experts else ""
     kv_tag = f"_kv{kv_heads}" if kv_heads else ""
-    return {
+    remat_tag = "" if remat else "_noremat"
+    rec = {
         "metric":
-            f"train_{preset}_dp{dp}tp{tp}sp{sp}_b{batch}{moe_tag}{kv_tag}",
+            f"train_{preset}_dp{dp}tp{tp}sp{sp}_b{batch}{moe_tag}"
+            f"{kv_tag}{remat_tag}",
         "value": round(tokens_s, 1),
         "unit": "tokens/s",
         "step_ms": round(dt * 1e3, 2),
@@ -125,6 +156,13 @@ def run_bench(preset: str, dp: int, tp: int, sp: int, batch: int,
         "mfu": round(flops / dt / peak, 4) if peak else None,
         "loss": round(float(loss), 4),
     }
+    if calibrate_peak:
+        # backend-agnostic: on GPU/CPU (no nameplate entry, mfu=None)
+        # the measured ceiling is the only meaningful denominator
+        measured = measure_peak() * n_dev
+        rec["measured_peak_tflops"] = round(measured / 1e12, 2)
+        rec["mfu_vs_measured"] = round(flops / dt / measured, 4)
+    return rec
 
 
 def main(argv=None) -> int:
@@ -140,9 +178,18 @@ def main(argv=None) -> int:
                     help="n_experts > 0 benches the MoE variant")
     ap.add_argument("--kv-heads", type=int, default=0,
                     help="n_kv_heads > 0 benches the GQA variant")
+    ap.add_argument("--no-remat", dest="remat", action="store_false",
+                    help="skip per-layer rematerialization: ~1/3 fewer "
+                         "backward FLOPs when activations fit HBM")
+    ap.add_argument("--calibrate-peak", action="store_true",
+                    help="also measure this device's achievable bf16 "
+                         "matmul ceiling and report mfu_vs_measured "
+                         "(nameplate MFU misleads on shared/tunneled "
+                         "allocations)")
     args = ap.parse_args(argv)
     rec = run_bench(args.preset, args.dp, args.tp, args.sp, args.batch,
-                    args.steps, args.warmup, args.experts, args.kv_heads)
+                    args.steps, args.warmup, args.experts, args.kv_heads,
+                    remat=args.remat, calibrate_peak=args.calibrate_peak)
     print(json.dumps(rec))
     return 0
 

@@ -1,0 +1,26 @@
+"""Decode-throughput bench harness smoke (tiny preset, CPU mesh)."""
+
+from icikit.bench.decode import decode_bytes_per_token, run_bench
+
+
+def test_decode_bench_tiny():
+    rec = run_bench("tiny", dp=1, tp=1, batch=2, prompt_len=8, n_new=4,
+                    runs=1)
+    assert rec["unit"] == "tokens/s" and rec["value"] > 0
+    assert rec["per_token_ms"] > 0
+    assert rec["metric"].startswith("decode_tiny_")
+
+
+def test_decode_bench_sampling_and_gqa():
+    rec = run_bench("tiny", dp=1, tp=1, batch=2, prompt_len=8, n_new=4,
+                    sampling="sample", runs=1, kv_heads=2)
+    assert rec["value"] > 0
+
+
+def test_decode_bytes_accounting():
+    from icikit.bench.train import PRESETS
+    from icikit.models.transformer import TransformerConfig
+    cfg = TransformerConfig(**PRESETS["tiny"])
+    b1 = decode_bytes_per_token(cfg, batch=1, cache_len=16)
+    b2 = decode_bytes_per_token(cfg, batch=1, cache_len=32)
+    assert b2 > b1  # longer cache reads more

@@ -1,0 +1,45 @@
+"""The reference's Parallel-Sorting program as library API: generate a
+p-invariant input, sort it four ways across the mesh, verify each with
+the distributed inversion counter, and sort key-value pairs.
+
+Run: ``PYTHONPATH=. python examples/distributed_sort.py``
+"""
+
+import jax
+
+try:
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 8)
+except RuntimeError:
+    pass
+
+import jax.numpy as jnp
+import numpy as np
+
+from icikit.models.sort import SORT_ALGORITHMS, check_sort, sort, sort_kv
+from icikit.utils.mesh import make_mesh
+from icikit.utils.prandom import uniform_global
+
+mesh = make_mesh()
+p = len(jax.devices())
+
+n = 1 << 16
+keys = (uniform_global(jax.random.key(0), n, odd_dist=True)
+        * 1e9).astype(jnp.int32)
+
+for alg in SORT_ALGORITHMS:
+    out = sort(keys, mesh, algorithm=alg)
+    errors = int(jnp.sum(out[1:] < out[:-1]))
+    print(f"{alg:>15}: sorted {n} keys, {errors} inversions")
+    assert errors == 0
+
+# the reference's distributed verifier, on block-sharded data
+blocks = sort(keys, mesh).reshape(p, n // p)
+print("check_sort errors:", check_sort(blocks, mesh))
+
+# key-value sorting (beyond the reference: payloads follow their keys)
+vals = jnp.arange(n, dtype=jnp.int32)
+sk, sv = sort_kv(keys, vals, mesh)
+assert np.array_equal(np.asarray(sv),
+                      np.argsort(np.asarray(keys), kind="stable"))
+print("sort_kv: values follow keys (stable) ✓")

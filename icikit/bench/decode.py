@@ -85,6 +85,10 @@ def run_bench(preset: str, dp: int, tp: int, batch: int, prompt_len: int,
     # extra decode steps (the short program's slightly shorter cache is
     # a second-order effect). Falls back to the contaminated mean with
     # an explicit flag when scheduling noise swamps the subtraction.
+    if n_new < 2:
+        raise ValueError("n_new must be >= 2 (the prefill-isolating "
+                         "two-length differencing needs two distinct "
+                         "decode lengths)")
     n_short = max(1, n_new // 2)
     p0 = jax.device_put(
         jnp.asarray(rng.integers(0, cfg.vocab, (batch, prompt_len)),
@@ -115,9 +119,10 @@ def run_bench(preset: str, dp: int, tp: int, batch: int, prompt_len: int,
 
 
 def main(argv=None) -> int:
+    from icikit.bench.train import PRESETS
+
     ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("--preset", default="small",
-                    choices=["tiny", "small", "base"])
+    ap.add_argument("--preset", default="small", choices=sorted(PRESETS))
     ap.add_argument("--dp", type=int, default=1)
     ap.add_argument("--tp", type=int, default=1)
     ap.add_argument("--batch", type=int, default=8)

@@ -118,6 +118,12 @@ def render_markdown(coll, sorts, dlb, checks, meta) -> str:
                      f"{r.best_s * 1e3:.2f} | "
                      f"{r.keys_per_s / 1e6:.1f} | {r.errors} |")
     lines.append("\n## Dynamic load balancing\n")
+    if meta["p"] == 1:
+        lines.append(
+            "> **Note:** with a single worker there is no imbalance to "
+            "balance — the dynamic rows measure pure chunked-dispatch "
+            "overhead. The static-vs-dynamic study needs workers "
+            "(`tests/test_solitaire.py` runs it on the 8-device mesh).\n")
     lines.append("| grade | strategy | solutions | wall_s | imbalance |")
     lines.append("|---|---|---|---|---|")
     for d in dlb:

@@ -44,7 +44,7 @@ from icikit.models.transformer.model import (
     _rms_norm,
     repeat_kv,
 )
-from icikit.parallel.shmap import shard_map, wrap_program
+from icikit.parallel.shmap import wrap_program
 
 DP_AXIS, PP_AXIS = "dp", "pp"
 

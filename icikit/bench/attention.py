@@ -83,12 +83,61 @@ def _impl_fns(mesh):
     return fns
 
 
+# Above this many total score-matrix elements the dense oracle's
+# (b, h, s, s) fp32 logits (2 GB at this bound) stop fitting HBM
+# alongside the subjects and their gradients; the oracle switches to
+# cross-tiling agreement (see _oracle). The default sweep's largest
+# point (s=4096, b=4, h=8 = 2^29 scores) stays on the dense oracle.
+_DENSE_ORACLE_MAX_SCORES = 1 << 29
+
+
+def _alternate_tiling(s: int, causal: bool):
+    """A valid flash tiling *different from* the automatic choice, for
+    cross-tiling verification. Raises rather than silently verifying a
+    computation against itself."""
+    from icikit.ops.flash_attention import (
+        _flash_supported, _pick_block, _pick_q_block)
+    if _flash_supported(s, s, causal) is None:
+        raise ValueError(f"no flash tiling exists for s={s}")
+    bq, bk = _pick_q_block(s), _pick_block(s)
+    bq2 = next((c for c in (256, 128, 512)
+                if c != bq and c % 128 == 0 and s % c == 0), None)
+    bk2 = next((c for c in (512, 256, 128, 64)
+                if c != bk and s % c == 0), None)
+    if bq2 is None and bk2 is None:
+        raise ValueError(
+            f"s={s} admits only one flash tiling (bq={bq}, bk={bk}); "
+            "no independent cross-tiling oracle is possible")
+    return bq2 or bq, bk2 or bk
+
+
+def _oracle(q, k, v, causal, mode):
+    """Reference values for verification. Within the memory budget:
+    the dense oracle. Beyond it (long-context sweeps): the same flash
+    computation under a *different tiling* — independent VMEM tile
+    boundaries and accumulation order agreeing is a strong oracle, and
+    the only O(s)-memory one available at 64k+."""
+    b, s, h, _ = q.shape
+    if b * h * s * s <= _DENSE_ORACLE_MAX_SCORES:
+        from icikit.ops.attention import dense_attention
+        ref = lambda q, k, v: dense_attention(q, k, v, causal=causal)
+    else:
+        from icikit.ops.flash_attention import flash_attention_with_lse
+        bq2, bk2 = _alternate_tiling(s, causal)
+        ref = lambda q, k, v: flash_attention_with_lse(
+            q, k, v, causal=causal, block_q=bq2, block_k=bk2)[0]
+
+    if mode == "fwd":
+        return np.asarray(jax.jit(ref)(q, k, v), jnp.float32)
+    return jax.jit(jax.grad(
+        lambda q, k, v: ref(q, k, v).astype(jnp.float32).sum(),
+        argnums=(0, 1, 2)))(q, k, v)
+
+
 def sweep_attention(seqs, impls=None, batch=4, heads=8, d_head=64,
                     dtype="bfloat16", causal=True, mode="fwdbwd",
                     runs=10, warmup=2, mesh=None, tol=3e-2):
     """Benchmark + verify each impl over a sequence-length sweep."""
-    from icikit.ops.attention import dense_attention
-
     fns = _impl_fns(mesh)
     impls = list(impls or fns)
     p = int(np.prod(list(mesh.shape.values()))) if mesh is not None else 1
@@ -98,15 +147,7 @@ def sweep_attention(seqs, impls=None, batch=4, heads=8, d_head=64,
         ks = jax.random.split(jax.random.key(seq), 3)
         q, k, v = (jax.random.normal(kk, (batch, seq, heads, d_head), dt)
                    for kk in ks)
-        if mode == "fwd":
-            want = np.asarray(dense_attention(q, k, v, causal=causal),
-                              jnp.float32)
-        else:
-            want = jax.jit(jax.grad(
-                lambda q, k, v:
-                dense_attention(q, k, v, causal=causal
-                                ).astype(jnp.float32).sum(),
-                argnums=(0, 1, 2)))(q, k, v)
+        want = _oracle(q, k, v, causal, mode)
         for name in impls:
             fn = fns[name]
             if mode == "fwd":
@@ -129,7 +170,8 @@ def sweep_attention(seqs, impls=None, batch=4, heads=8, d_head=64,
             if mode == "fwd":
                 err = rel_err(fn(q, k, v, causal), want)
             else:
-                # verify the timed subject: gradients vs the dense oracle
+                # verify the timed subject's gradients vs the oracle
+                # (dense within budget, cross-tiled flash beyond it)
                 err = max(rel_err(a, b) for a, b in zip(run(q, k, v), want))
 
             def chain(a, out, first=first):

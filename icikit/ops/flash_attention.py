@@ -340,7 +340,9 @@ def _flash_supported(sq, sk, causal):
 
 def flash_attention_with_lse(q: jax.Array, k: jax.Array, v: jax.Array,
                              causal: bool = False,
-                             scale: float | None = None):
+                             scale: float | None = None,
+                             block_q: int | None = None,
+                             block_k: int | None = None):
     """Flash attention returning the per-row log-sum-exp as well.
 
     Returns ``(out (b, s_q, h, d), lse (b, h, s_q))``. The lse is what
@@ -348,11 +350,32 @@ def flash_attention_with_lse(q: jax.Array, k: jax.Array, v: jax.Array,
     attention results exactly; its cotangent is handled by the custom
     backward. Unsupported shapes/backends fall back to the dense oracle
     with an explicit logsumexp.
+
+    ``block_q``/``block_k`` override the automatic tile choice (e.g.
+    the benchmark's cross-tiling oracle). ``block_q`` must be the whole
+    sequence or a multiple of 128 dividing it (Mosaic lane constraint
+    on the lse residual); ``block_k`` a divisor of the K length.
     """
     sup = _flash_supported(q.shape[1], k.shape[1], causal)
     if sup is None:
+        if block_q or block_k:
+            raise ValueError(
+                f"shape (s_q={q.shape[1]}, s_kv={k.shape[1]}, "
+                f"causal={causal}) has no flash tiling to override")
         return _dense_with_lse(q, k, v, causal, scale)
     bq, bk, interpret = sup
+    if block_q is not None:
+        sq = q.shape[1]
+        if not (block_q == sq or (block_q % 128 == 0 and sq % block_q == 0)):
+            raise ValueError(
+                f"block_q={block_q} must be the whole sequence or a "
+                f"multiple of 128 dividing s_q={sq}")
+        bq = block_q
+    if block_k is not None:
+        if k.shape[1] % block_k or block_k < 8:
+            raise ValueError(f"block_k={block_k} must divide "
+                             f"s_kv={k.shape[1]} (and be >= 8)")
+        bk = block_k
     if scale is None:
         scale = q.shape[-1] ** -0.5
     qt, kt, vt = (x.transpose(0, 2, 1, 3) for x in (q, k, v))

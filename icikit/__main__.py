@@ -1,0 +1,52 @@
+"""``python -m icikit`` — discovery surface.
+
+Prints the registered algorithm families (the runtime answer to the
+reference's compile-time ``#define`` selection, SURVEY.md §5.6), the
+visible devices, and the CLI entry points. The reference required
+reading three Makefiles and the source to learn what could run; here
+one command lists every selectable variant.
+"""
+
+from __future__ import annotations
+
+import sys
+
+
+def main(argv=None) -> int:
+    # Importing the family modules populates the registry.
+    import icikit.models.sort  # noqa: F401
+    import icikit.parallel  # noqa: F401
+    from icikit import __version__
+    from icikit.utils.registry import list_algorithms
+
+    print(f"icikit {__version__} — TPU-native parallel-computing "
+          "framework\n")
+    print("Algorithm families (runtime-selectable; 'xla' = the native "
+          "ICI collective playing the vendor-MPI role):")
+    for family in list_algorithms():
+        algs = ", ".join(sorted(list_algorithms(family)))
+        print(f"  {family:<14} {algs}")
+    try:
+        import jax
+        devs = jax.devices()
+        print(f"\nDevices: {len(devs)} x {devs[0].platform} "
+              f"({devs[0].device_kind})")
+    except Exception as e:  # no backend in this environment
+        print(f"\nDevices: unavailable ({e})")
+    print("""
+CLI entry points:
+  python -m icikit.bench.run        collective sweep (--family, --simulate)
+  python -m icikit.bench.sort       the four-sort study
+  python -m icikit.bench.attention  dense/flash/ring/ulysses/zigzag
+  python -m icikit.bench.train      training tokens/s + MFU
+  python -m icikit.bench.decode     inference tokens/s
+  python -m icikit.bench.scaling    strong scaling over device counts
+  python -m icikit.bench.northstar  every BASELINE.md target
+  python -m icikit.bench.report     render JSONL records to markdown
+  python -m icikit.models.transformer.train   end-to-end LM trainer
+  python -m icikit.models.solitaire.run       dynamic-load-balancing study""")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -47,7 +47,9 @@ class AttnRecord:
     mean_s: float
     best_s: float
     tflops: float         # achieved, best-run
-    max_err: float        # vs the dense oracle (forward output)
+    max_err: float        # vs the oracle (dense within the memory
+                          # budget, cross-tiled flash beyond it;
+                          # fwd: outputs, fwdbwd: worst gradient)
     verified: bool
 
     def to_json(self) -> str:

@@ -372,9 +372,9 @@ def flash_attention_with_lse(q: jax.Array, k: jax.Array, v: jax.Array,
                 f"multiple of 128 dividing s_q={sq}")
         bq = block_q
     if block_k is not None:
-        if k.shape[1] % block_k or block_k < 8:
-            raise ValueError(f"block_k={block_k} must divide "
-                             f"s_kv={k.shape[1]} (and be >= 8)")
+        if block_k < 8 or block_k % 8 or k.shape[1] % block_k:
+            raise ValueError(f"block_k={block_k} must be a multiple of "
+                             f"8 dividing s_kv={k.shape[1]}")
         bk = block_k
     if scale is None:
         scale = q.shape[-1] ** -0.5

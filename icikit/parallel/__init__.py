@@ -43,6 +43,15 @@ from icikit.parallel.multihost import (  # noqa: F401
     make_hybrid_mesh,
     process_info,
 )
+from icikit.parallel.pt2pt import (  # noqa: F401
+    send_to,
+    sendrecv_shift,
+    sendrecv_xor,
+)
+from icikit.parallel.reduceloc import (  # noqa: F401
+    allreduce_loc,
+    top_k_dist,
+)
 from icikit.parallel.reducescatter import (  # noqa: F401
     REDUCESCATTER_ALGORITHMS,
     reduce_scatter,

@@ -1,0 +1,58 @@
+"""Point-to-point primitives — the ``MPI_Send``/``MPI_Recv``/
+``MPI_Sendrecv`` surface as permutation collectives.
+
+Every hand-rolled schedule in the reference is built from point-to-
+point calls with deadlock-avoidance orderings (lower-rank-sends-first
+``Communication/src/main.cc:115-132``, even/odd ``:206-216``); under
+``shard_map`` the analog is a (possibly partial) ``ppermute``, which is
+deadlock-free by construction and needs no ordering discipline. These
+helpers are the public form, usable inside any ``shard_map`` body —
+the same vocabulary the collective families build on
+(``parallel/shmap.py``).
+
+No tags and no wildcard receive: XLA programs are static, so the
+"message arrived, which was it?" dynamism of ``MPI_Iprobe``/
+``MPI_ANY_SOURCE`` (the DLB server's drain loop,
+``Dynamic-Load-Balancing/src/main.cc:84-112``) maps to host-side
+orchestration instead (``icikit.models.solitaire.scheduler``).
+"""
+
+from __future__ import annotations
+
+import jax
+from jax import lax
+
+from icikit.parallel.shmap import partial_shift_perm, shift_perm, xor_perm
+from icikit.utils.mesh import UnsupportedMeshError, is_pow2
+
+__all__ = ["send_to", "sendrecv_shift", "sendrecv_xor", "shift_perm",
+           "xor_perm", "partial_shift_perm"]
+
+
+def send_to(x: jax.Array, axis: str, pairs) -> jax.Array:
+    """Targeted sends: deliver this device's ``x`` along explicit
+    (src, dst) ``pairs`` (each src and dst at most once — MPI's
+    matched-envelope rule, enforced by ``ppermute``). Devices not
+    receiving get zeros — combine with ``jnp.where`` on
+    ``lax.axis_index``."""
+    return lax.ppermute(x, axis, list(pairs))
+
+
+def sendrecv_shift(x: jax.Array, axis: str, p: int,
+                   shift: int = 1) -> jax.Array:
+    """``MPI_Sendrecv`` on the ring: send to ``(r + shift) mod p``,
+    receive from ``(r - shift) mod p`` — the reference's wrap-around
+    rotation step (``main.cc:379-385``)."""
+    return lax.ppermute(x, axis, shift_perm(p, shift))
+
+
+def sendrecv_xor(x: jax.Array, axis: str, p: int, mask: int) -> jax.Array:
+    """``MPI_Sendrecv`` with the hypercube partner ``r ^ mask`` — the
+    reference's compare-split / e-cube exchange step
+    (``psort.cc:121``, ``main.cc:250``). ``p`` must be a power of 2."""
+    if not is_pow2(p):
+        raise UnsupportedMeshError(
+            f"sendrecv_xor needs a power-of-2 device count, got {p}")
+    if not 0 < mask < p:
+        raise ValueError(f"mask must be in [1, {p}), got {mask}")
+    return lax.ppermute(x, axis, xor_perm(p, mask))

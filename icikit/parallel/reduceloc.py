@@ -1,0 +1,136 @@
+"""Located reductions and distributed top-k.
+
+``MPI_MAXLOC``/``MPI_MINLOC`` are the members of MPI's built-in
+reduction-op set (the vendor layer the reference relies on for its
+timing reduces, ``Communication/src/main.cc:445``) that return *where*
+the extremum lives as well as its value — the primitive behind
+"which rank was slowest" analyses like the reference's max-over-ranks
+timing protocol. ``top_k_dist`` generalizes from 1 to k: the k global
+extrema and their owners, via local-top-k → allgather(candidates) →
+final top-k, so the wire carries k·p candidates instead of the data.
+
+Both return *global element indices* (device · block + offset), which
+is what consumers (straggler analysis, distributed sampling, MoE
+routing diagnostics) actually need.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from icikit.parallel.shmap import wrap_program
+from icikit.utils.mesh import DEFAULT_AXIS
+
+
+def _locate(x, axis: str, mode: str):
+    """Per-shard (n,) block -> replicated (value, global_index) of the
+    global extremum; ties resolve to the lowest global index (the
+    MPI_MAXLOC tie rule)."""
+    n = x.shape[0]
+    r = lax.axis_index(axis)
+    local_idx = jnp.argmax(x) if mode == "max" else jnp.argmin(x)
+    local_val = x[local_idx]
+    gidx = r * n + local_idx.astype(jnp.int32)
+    best = lax.pmax(local_val, axis) if mode == "max" else \
+        lax.pmin(local_val, axis)
+    # lowest global index among devices holding the extremum
+    cand = jnp.where(local_val == best, gidx, jnp.iinfo(jnp.int32).max)
+    return best, lax.pmin(cand, axis)
+
+
+@lru_cache(maxsize=None)
+def _build_locate(mesh, axis, mode):
+    def per_shard(b):
+        v, i = _locate(b[0], axis, mode)
+        return v[None], i[None]
+
+    return wrap_program(per_shard, mesh, P(axis), (P(axis), P(axis)))
+
+
+def allreduce_loc(x: jax.Array, mesh, axis: str = DEFAULT_AXIS,
+                  op: str = "maxloc"):
+    """``MPI_Allreduce`` with ``MPI_MAXLOC``/``MPI_MINLOC`` semantics.
+
+    Args:
+      x: global ``(p, n)`` sharded on dim 0.
+      op: "maxloc" or "minloc".
+
+    Returns:
+      ``(value, global_index)`` — per-device replicated scalars; the
+      index is into the flattened global array, ties to the lowest
+      index.
+    """
+    if op not in ("maxloc", "minloc"):
+        raise ValueError(f"op must be 'maxloc' or 'minloc', got {op!r}")
+    _check_blocks(x, mesh, axis)
+    v, i = _build_locate(mesh, axis, op[:3])(x)
+    return v[0], i[0]
+
+
+def _check_blocks(x, mesh, axis):
+    p = mesh.shape[axis]
+    if x.ndim != 2 or x.shape[0] != p:
+        raise ValueError(
+            f"expected one (n,) block per device: (p={p}, n) input, "
+            f"got {x.shape} (a larger leading dim would silently drop "
+            "rows inside the shard)")
+
+
+@lru_cache(maxsize=None)
+def _build_top_k(mesh, axis, k, largest):
+    p = mesh.shape[axis]
+
+    def best(vals, kk):
+        """k best (direction-aware) without negation — negating
+        overflows at the signed minimum and is wrong for unsigned."""
+        if largest:
+            return lax.top_k(vals, kk)
+        order = jnp.argsort(vals)[:kk]
+        return vals[order], order
+
+    def per_shard(b):
+        x = b[0]
+        n = x.shape[0]
+        r = lax.axis_index(axis)
+        lv, li = best(x, min(k, n))
+        gi = r * n + li.astype(jnp.int32)
+        # candidate pool: every device's local top-k
+        vals = lax.all_gather(lv, axis, axis=0, tiled=True)   # (p*k',)
+        idxs = lax.all_gather(gi, axis, axis=0, tiled=True)
+        fv, fi = best(vals, k)
+        return fv[None], idxs[fi][None]
+
+    return wrap_program(per_shard, mesh, P(axis), (P(axis), P(axis)))
+
+
+def top_k_dist(x: jax.Array, mesh, k: int, axis: str = DEFAULT_AXIS,
+               largest: bool = True):
+    """The k global extrema of block-sharded data and their indices.
+
+    Args:
+      x: global ``(p, n)`` sharded on dim 0, with ``n >= k`` per block
+        (each device must be able to contribute k candidates for the
+        global answer to be exact).
+
+    Returns:
+      ``(values (k,), global_indices (k,))`` replicated on every
+      device, sorted best-first. Wire cost: one allgather of k
+      candidates per device — the data never moves.
+    """
+    _check_blocks(x, mesh, axis)
+    p, n = x.shape[0], x.shape[1]
+    if k < 1:
+        raise ValueError(f"k must be >= 1, got {k}")
+    if k > n:
+        raise ValueError(
+            f"k={k} exceeds the per-device block ({n}): a device "
+            f"cannot contribute enough candidates for exactness; "
+            f"reshape to larger blocks or reduce k")
+    del p
+    v, i = _build_top_k(mesh, axis, int(k), bool(largest))(x)
+    return v[0], i[0]

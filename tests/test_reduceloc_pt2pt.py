@@ -1,0 +1,140 @@
+"""Oracle tests: located reductions, distributed top-k, point-to-point."""
+
+from functools import partial
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from icikit.parallel import (
+    allreduce_loc,
+    send_to,
+    sendrecv_shift,
+    sendrecv_xor,
+    top_k_dist,
+)
+from icikit.parallel.shmap import shard_map
+from icikit.utils.mesh import shard_along
+
+
+def _data(p, n, seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.integers(-10_000, 10_000, (p, n)).astype(np.int32)
+
+
+@pytest.mark.parametrize("op,npfn", [("maxloc", np.argmax),
+                                     ("minloc", np.argmin)])
+def test_allreduce_loc(mesh8, op, npfn):
+    data = _data(8, 32, seed=1)
+    x = shard_along(jnp.asarray(data), mesh8)
+    v, i = allreduce_loc(x, mesh8, op=op)
+    flat = data.reshape(-1)
+    assert int(i) == npfn(flat)
+    assert int(v) == flat[npfn(flat)]
+
+
+def test_allreduce_loc_tie_lowest_index(mesh8):
+    data = np.zeros((8, 4), np.int32)
+    data[2, 1] = 7
+    data[5, 3] = 7  # duplicate max, higher global index
+    x = shard_along(jnp.asarray(data), mesh8)
+    v, i = allreduce_loc(x, mesh8, op="maxloc")
+    assert int(v) == 7 and int(i) == 2 * 4 + 1
+
+
+def test_allreduce_loc_validates(mesh8):
+    x = shard_along(jnp.zeros((8, 4), jnp.int32), mesh8)
+    with pytest.raises(ValueError, match="maxloc"):
+        allreduce_loc(x, mesh8, op="sum")
+
+
+@pytest.mark.parametrize("largest", [True, False])
+@pytest.mark.parametrize("k", [1, 3, 8])
+def test_top_k_dist(mesh8, k, largest):
+    data = _data(8, 16, seed=2)
+    x = shard_along(jnp.asarray(data), mesh8)
+    v, i = top_k_dist(x, mesh8, k, largest=largest)
+    flat = data.reshape(-1)
+    order = np.argsort(-flat if largest else flat, kind="stable")[:k]
+    np.testing.assert_array_equal(np.asarray(v), flat[order])
+    # indices must point at the returned values (ties may permute ids)
+    np.testing.assert_array_equal(flat[np.asarray(i)], np.asarray(v))
+
+
+def test_top_k_dist_validates(mesh8):
+    x = shard_along(jnp.zeros((8, 4), jnp.int32), mesh8)
+    with pytest.raises(ValueError, match="exceeds the per-device"):
+        top_k_dist(x, mesh8, k=5)
+    with pytest.raises(ValueError, match="k must be"):
+        top_k_dist(x, mesh8, k=0)
+
+
+def test_pt2pt_primitives(mesh8):
+    p = 8
+    data = _data(p, 4, seed=3)
+    x = shard_along(jnp.asarray(data), mesh8)
+
+    def body(fn, b):
+        return fn(b[0])[None]
+
+    def run(per_block):
+        return np.asarray(shard_map(
+            partial(body, per_block), mesh=mesh8, in_specs=P("p"),
+            out_specs=P("p"))(x))
+
+    out = run(lambda blk: sendrecv_shift(blk, "p", p, 2))
+    np.testing.assert_array_equal(out, np.roll(data, 2, axis=0))
+
+    out = run(lambda blk: sendrecv_xor(blk, "p", p, 3))
+    np.testing.assert_array_equal(out, data[np.arange(p) ^ 3])
+
+    # targeted send 0 -> 5: receiver sees the payload, idle devices zeros
+    out = run(lambda blk: send_to(blk, "p", [(0, 5)]))
+    np.testing.assert_array_equal(out[5], data[0])
+    assert (out[np.arange(p) != 5] == 0).all()
+
+
+def test_reduceloc_float(mesh8):
+    rng = np.random.default_rng(4)
+    data = rng.standard_normal((8, 16)).astype(np.float32)
+    x = shard_along(jnp.asarray(data), mesh8)
+    v, i = allreduce_loc(x, mesh8, op="minloc")
+    flat = data.reshape(-1)
+    assert int(i) == np.argmin(flat)
+    np.testing.assert_allclose(float(v), flat.min())
+
+
+def test_top_k_min_direction_int_min(mesh8):
+    """The signed minimum must survive bottom-k (a negation-based
+    implementation overflows it away)."""
+    data = np.full((8, 4), 5, np.int32)
+    data[3, 2] = np.iinfo(np.int32).min
+    x = shard_along(jnp.asarray(data), mesh8)
+    v, i = top_k_dist(x, mesh8, 1, largest=False)
+    assert int(v[0]) == np.iinfo(np.int32).min
+    assert int(i[0]) == 3 * 4 + 2
+
+
+def test_block_shape_validation(mesh8):
+    x = shard_along(jnp.zeros((16, 4), jnp.int32), mesh8)
+    with pytest.raises(ValueError, match="one .* block per device"):
+        allreduce_loc(x, mesh8)
+    with pytest.raises(ValueError, match="one .* block per device"):
+        top_k_dist(x, mesh8, 1)
+
+
+def test_sendrecv_xor_validates(mesh8):
+    from icikit.utils.mesh import UnsupportedMeshError, make_mesh
+    mesh6 = make_mesh(6)
+    data = _data(6, 4, seed=5)
+    x = shard_along(jnp.asarray(data), mesh6)
+
+    def run():
+        return shard_map(
+            lambda b: sendrecv_xor(b[0], "p", 6, 2)[None],
+            mesh=mesh6, in_specs=P("p"), out_specs=P("p"))(x)
+
+    with pytest.raises(UnsupportedMeshError, match="power-of-2"):
+        run()

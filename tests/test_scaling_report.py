@@ -58,3 +58,18 @@ def test_scaling_sweep_subprocess_smoke():
     assert r["p"] == 2 and r["algorithm"] == "ring" and r["verified"]
     # records are json-serializable end-to-end
     json.dumps(records)
+
+
+@pytest.mark.slow
+def test_sort_scaling_subprocess_smoke():
+    """The sorting study through the strong-scaling launcher — the
+    reference's project3.pdf scaling figure, one scale point."""
+    from icikit.bench.scaling import _render_sort_scaling
+    records = run_scaling_sweep(
+        None, ps=(2,), algorithms=["sample"], sizes=(2048,), runs=1,
+        timeout_s=300.0, bench="sort")
+    assert len(records) == 1
+    r = records[0]
+    assert r["p"] == 2 and r["algorithm"] == "sample" and r["errors"] == 0
+    text = _render_sort_scaling(records)
+    assert "Mkeys/s vs p" in text and "sample" in text

@@ -70,7 +70,8 @@ class TimeitResult:
 
 
 def timeit_chained(fn, args: tuple, chain, runs: int = 10,
-                   warmup: int = 2) -> TimeitResult:
+                   warmup: int = 2,
+                   target_window_s: float | None = None) -> TimeitResult:
     """Elision-proof timing for constant-shaped kernels.
 
     Remote-tunneled backends can serve repeated structurally-identical
@@ -110,10 +111,18 @@ def timeit_chained(fn, args: tuple, chain, runs: int = 10,
         state["cur"] = chain(state["cur"], fn(*state["cur"]))
     force(state["cur"])
     # Two-point needs each window well above dispatch/transfer noise
-    # (~100 ms on a tunneled device): scale runs until t(runs) >= 0.25 s.
+    # (~100 ms on a tunneled device): scale runs until t(runs) >=
+    # target. On CPU meshes the dispatch noise is microseconds AND deep
+    # queues of chained multi-device executions can skew the per-device
+    # threads past XLA:CPU's 40 s collective-rendezvous hard limit —
+    # so the default target (and with it the queue depth) stays small
+    # there.
+    if target_window_s is None:
+        target_window_s = (0.02 if jax.default_backend() == "cpu"
+                           else 0.25)
     n, probe = runs, measure(runs)
-    while probe < 0.25 and n < 4096:
-        n = n * max(2, int(0.3 / max(probe, 1e-3)))
+    while probe < target_window_s and n < 4096:
+        n = n * max(2, int(1.2 * target_window_s / max(probe, 1e-3)))
         probe = measure(n)
     t2 = measure(2 * n)
     per = (t2 - probe) / n

@@ -118,8 +118,18 @@ def timeit_chained(fn, args: tuple, chain, runs: int = 10,
     # so the default target (and with it the queue depth) stays small
     # there.
     if target_window_s is None:
-        target_window_s = (0.02 if jax.default_backend() == "cpu"
-                           else 0.25)
+        # key off the backend the timed program actually runs on (the
+        # operands' devices), not the process default — a CPU mesh in a
+        # TPU-default process still needs the small-window guard
+        platform = jax.default_backend()
+        for leaf in jax.tree_util.tree_leaves(args):
+            devs = getattr(leaf, "devices", None)
+            if callable(devs):
+                ds = devs()
+                if ds:
+                    platform = next(iter(ds)).platform
+                    break
+        target_window_s = 0.02 if platform == "cpu" else 0.25
     n, probe = runs, measure(runs)
     while probe < target_window_s and n < 4096:
         n = n * max(2, int(1.2 * target_window_s / max(probe, 1e-3)))

@@ -39,7 +39,15 @@ def _attend_block(q, k_blk, v_blk, mode, scale):
     ``mode``: 0 = skip (fully masked), 1 = diagonal causal, 2 = fully
     visible. Returns ``(o (b, s, h, d) fp32, lse (b, h, s) fp32)``;
     skipped blocks contribute lse = −inf so the merge ignores them.
+
+    GQA: ``k_blk``/``v_blk`` may carry fewer heads than ``q`` (h_kv
+    dividing h) — they are repeated here, *after* the ring transfer, so
+    the rotating messages stay at K/V width (wire volume ÷ h/h_kv).
     """
+    n_rep = q.shape[2] // k_blk.shape[2]
+    if n_rep > 1:
+        k_blk = jnp.repeat(k_blk, n_rep, axis=2)
+        v_blk = jnp.repeat(v_blk, n_rep, axis=2)
     def _skip(q, k, v):
         # Outputs built *from* the operands (not fresh constants) so all
         # switch branches agree on which mesh axes they vary over.
@@ -84,8 +92,15 @@ def _merge(o, lse, o_t, lse_t):
 def ring_attention_shard(q: jax.Array, k: jax.Array, v: jax.Array,
                          axis: str, p: int, causal: bool,
                          scale: float | None) -> jax.Array:
-    """Per-shard ring attention over local blocks ``(b, s, h, d)``."""
+    """Per-shard ring attention over local blocks ``(b, s, h, d)``.
+
+    GQA: ``k``/``v`` may carry h_kv < h heads (h_kv dividing h); the
+    un-repeated blocks rotate, shrinking ring traffic by h/h_kv."""
     b, s, h, d = q.shape
+    if h % k.shape[2]:
+        raise ValueError(
+            f"query heads ({h}) must be a multiple of K/V heads "
+            f"({k.shape[2]})")
     if scale is None:
         scale = d ** -0.5
     r = lax.axis_index(axis)

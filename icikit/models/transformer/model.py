@@ -318,18 +318,23 @@ def _forward_local(params, tokens, cfg: TransformerConfig, p_sp: int,
         if cfg.pos_encoding == "rope":
             q = apply_rope(q, positions, cfg.rope_theta)
             k = apply_rope(k, positions, cfg.rope_theta)
-        k, v = repeat_kv(k, n_rep), repeat_kv(v, n_rep)
         if p_sp == 1:  # full sequence is local: use the fused kernel
+            k, v = repeat_kv(k, n_rep), repeat_kv(v, n_rep)
             return resolve_attention_impl(cfg.attention_impl)(
                 q, k, v, causal=True)
         if cfg.sequence_schedule == "ulysses":
             # note: GQA K/V are repeated to full width before the
-            # re-shard (layout shared with the ring path); un-repeated
-            # re-sharding would cut the K/V a2a volume by n_rep at the
-            # cost of a second head-count path through ulysses
+            # re-shard (the head re-shard needs q and K/V head counts
+            # to split identically over sp); un-repeated re-sharding
+            # would cut the a2a volume by n_rep at the cost of a
+            # second head-count path through ulysses
+            k, v = repeat_kv(k, n_rep), repeat_kv(v, n_rep)
             return ulysses_attention_shard(
                 q, k, v, SP_AXIS, p_sp, causal=True, scale=None,
                 algorithm=cfg.sp_algorithm, local=cfg.attention_impl)
+        # ring/zigzag rotate the *un-repeated* K/V blocks: GQA shrinks
+        # the per-step ring message by n_rep; heads repeat per visiting
+        # block inside the kernel call (_attend_block).
         if cfg.sequence_schedule == "zigzag":
             return zigzag_attention_shard(q, k, v, SP_AXIS, p_sp,
                                           causal=True, scale=None)

@@ -107,3 +107,28 @@ def test_p1_degenerate(mesh1):
                                          algorithm="hypercube"))
     np.testing.assert_allclose(out_r, expected, rtol=2e-5, atol=2e-5)
     np.testing.assert_allclose(out_u, expected, rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("schedule", ["ring", "zigzag"])
+def test_gqa_kv_heads_rotate_unrepeated(mesh8, schedule):
+    """GQA: ring/zigzag accept h_kv < h and match the dense oracle on
+    repeated K/V — the rotating messages stay at K/V width."""
+    from icikit.models.attention import zigzag_attention
+    b, s, h, hkv, d = 2, 32, 8, 2, 8
+    rng = np.random.default_rng(7)
+    q = jnp.asarray(rng.standard_normal((b, s, h, d)).astype(np.float32))
+    k = jnp.asarray(rng.standard_normal((b, s, hkv, d)).astype(np.float32))
+    v = jnp.asarray(rng.standard_normal((b, s, hkv, d)).astype(np.float32))
+    kr = jnp.repeat(k, h // hkv, axis=2)
+    vr = jnp.repeat(v, h // hkv, axis=2)
+    expected = np.asarray(dense_attention(q, kr, vr, causal=True))
+    fn = ring_attention if schedule == "ring" else zigzag_attention
+    qs, ks, vs = (shard_along(a, mesh8, dim=1) for a in (q, k, v))
+    out = np.asarray(fn(qs, ks, vs, mesh8, causal=True))
+    np.testing.assert_allclose(out, expected, rtol=2e-5, atol=2e-5)
+
+
+def test_gqa_head_divisibility_validated(mesh8):
+    q, k, v = _qkv(h=4)
+    with pytest.raises(ValueError, match="multiple of K/V heads"):
+        ring_attention(q, k[:, :, :3], v[:, :, :3], mesh8)

@@ -44,6 +44,10 @@ def _attend_block(q, k_blk, v_blk, mode, scale):
     dividing h) — they are repeated here, *after* the ring transfer, so
     the rotating messages stay at K/V width (wire volume ÷ h/h_kv).
     """
+    if q.shape[2] % k_blk.shape[2]:
+        raise ValueError(
+            f"query heads ({q.shape[2]}) must be a multiple of K/V "
+            f"heads ({k_blk.shape[2]})")
     n_rep = q.shape[2] // k_blk.shape[2]
     if n_rep > 1:
         k_blk = jnp.repeat(k_blk, n_rep, axis=2)

@@ -136,3 +136,9 @@ def test_model_zigzag_schedule_matches_ring():
     loss_zz, _ = loss_fn(params, tok, tgt, mesh, zz_cfg)
     np.testing.assert_allclose(float(loss_zz), float(loss_ring),
                                rtol=1e-5)
+
+
+def test_zigzag_gqa_head_divisibility_validated(mesh8):
+    q, k, v = _qkv(s=32, h=4)
+    with pytest.raises(ValueError, match="multiple of K/V heads"):
+        zigzag_attention(q, k[:, :, :3], v[:, :, :3], mesh8, causal=True)

@@ -56,9 +56,28 @@ def ulysses_attention_shard(q: jax.Array, k: jax.Array, v: jax.Array,
                             scale: float | None,
                             algorithm: str,
                             local: str = "flash") -> jax.Array:
+    h, h_kv = q.shape[2], k.shape[2]
+    if h % h_kv:
+        raise ValueError(
+            f"query heads ({h}) must be a multiple of K/V heads ({h_kv})")
+    n_rep = h // h_kv
+    if n_rep > 1 and h_kv % p:
+        # K/V head groups can't split over p devices: repeat up front
+        # (correct for any h_kv, at full-width a2a volume)
+        k = jnp.repeat(k, n_rep, axis=2)
+        v = jnp.repeat(v, n_rep, axis=2)
+        n_rep = 1
     qh = _seq_to_heads(q, axis, p, algorithm)
     kh = _seq_to_heads(k, axis, p, algorithm)
     vh = _seq_to_heads(v, axis, p, algorithm)
+    if n_rep > 1:
+        # GQA at K/V width through the wire: device r's q-head group
+        # [r·h/p, (r+1)·h/p) is served exactly by its kv-head group
+        # [r·h_kv/p, ...) (h_kv % p == 0 guarantees the alignment), so
+        # the a2a carried 1/n_rep of the K/V bytes and the repeat is
+        # local
+        kh = jnp.repeat(kh, n_rep, axis=2)
+        vh = jnp.repeat(vh, n_rep, axis=2)
     ctx = resolve_attention_impl(local)(qh, kh, vh, causal=causal,
                                         scale=scale)
     return _heads_to_seq(ctx, axis, p, algorithm)

@@ -323,12 +323,9 @@ def _forward_local(params, tokens, cfg: TransformerConfig, p_sp: int,
             return resolve_attention_impl(cfg.attention_impl)(
                 q, k, v, causal=True)
         if cfg.sequence_schedule == "ulysses":
-            # note: GQA K/V are repeated to full width before the
-            # re-shard (the head re-shard needs q and K/V head counts
-            # to split identically over sp); un-repeated re-sharding
-            # would cut the a2a volume by n_rep at the cost of a
-            # second head-count path through ulysses
-            k, v = repeat_kv(k, n_rep), repeat_kv(v, n_rep)
+            # GQA K/V re-shard at their own width when the kv-head
+            # groups split over sp (a2a volume ÷ n_rep, repeat is
+            # local); the shard fn pre-repeats otherwise
             return ulysses_attention_shard(
                 q, k, v, SP_AXIS, p_sp, causal=True, scale=None,
                 algorithm=cfg.sp_algorithm, local=cfg.attention_impl)

@@ -25,10 +25,12 @@ the reference's algorithm family: ``parallel_bitonic_sort``
 of across ranks, with direction masks playing the role of the
 reference's ``ibit``/``jbit`` rank tests (``:184-195``).
 
-Only int32/uint32/float32 take the Pallas path (TPU-native widths);
-other dtypes and small arrays fall back to ``jnp.sort``. NaN ordering
-in the float32 Pallas path follows min/max semantics, not ``jnp.sort``'s
-NaN-last contract — callers with NaNs should use the XLA backend.
+int32/uint32/float32 take the Pallas path natively (TPU widths);
+bf16/f16 ride the f32 kernel by exact monotone widening; other dtypes
+and small arrays fall back to ``jnp.sort``. NaN ordering in the
+float Pallas paths (f32 native and the half-precision widening)
+follows min/max semantics, not ``jnp.sort``'s NaN-last contract —
+callers with NaNs should pass ``backend='xla'``.
 """
 
 from __future__ import annotations
@@ -339,15 +341,25 @@ def local_sort(x: jax.Array, backend: str = "auto", *,
     or 'xla' (``jnp.sort``).
     """
     n = x.shape[0]
-    backend = _resolve_backend(backend, x.dtype, n)
+    # Half-precision floats ride the fp32 kernel when the Pallas path
+    # is taken (bf16/f16 embed exactly in f32, monotonically — widen-
+    # sort-narrow is exact, with the same NaN caveat as native f32);
+    # the XLA path keeps jnp.sort's native bf16 handling (NaN-last).
+    in_dtype = jnp.dtype(x.dtype)
+    half = in_dtype in (jnp.bfloat16, jnp.float16)
+    kernel_dtype = jnp.float32 if half else in_dtype
+    backend = _resolve_backend(backend, kernel_dtype, n)
     if backend == "xla" or n < 2:
         return jnp.sort(x)
     if backend not in ("pallas", "interpret"):
         raise ValueError(f"unknown backend {backend!r}")
-    if not pallas_supported(x.dtype, n):
+    if not pallas_supported(kernel_dtype, n):
         raise ValueError(
-            f"pallas sort supports int32/uint32/float32 and n >= "
-            f"{MIN_PALLAS}; got {x.dtype} n={n} (use backend='xla')")
+            f"pallas sort supports int32/uint32/float32 (bf16/f16 via "
+            f"the f32 kernel) and n >= {MIN_PALLAS}; got {in_dtype} "
+            f"n={n} (use backend='xla')")
+    if half:
+        x = x.astype(jnp.float32)
     interpret = backend == "interpret"
     np2 = n if _is_pow2(n) else 1 << n.bit_length()
     if np2 != n:
@@ -356,7 +368,8 @@ def local_sort(x: jax.Array, backend: str = "auto", *,
             [x, jnp.full((np2 - n,), sentinel_for(x.dtype), x.dtype)])
     out = _build_sort(np2, jnp.dtype(x.dtype).name, t_grid, t_big,
                       g_max or G_MAX, interpret)(x)
-    return out[:n] if np2 != n else out
+    out = out[:n] if np2 != n else out
+    return out.astype(in_dtype) if half else out
 
 
 def merge_bitonic(v: jax.Array, backend: str = "auto", *,

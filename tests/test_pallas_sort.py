@@ -114,3 +114,15 @@ def test_merge_xla_fallback_matches():
     v = _bitonic(1 << 10)
     out = np.asarray(ps.merge_bitonic(jnp.asarray(v), backend="xla"))
     assert np.array_equal(out, np.sort(v))
+
+
+def test_local_sort_bf16_widen_narrow():
+    """bf16 keys sort exactly through the fp32 path (bf16 embeds in
+    f32; the mapping is monotone)."""
+    rng = np.random.default_rng(11)
+    x = jnp.asarray(rng.standard_normal(1 << 14).astype(np.float32)
+                    ).astype(jnp.bfloat16)
+    out = ps.local_sort(x, backend="interpret")
+    assert out.dtype == jnp.bfloat16
+    want = np.sort(np.asarray(x, np.float32))
+    np.testing.assert_array_equal(np.asarray(out, np.float32), want)

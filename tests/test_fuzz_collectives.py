@@ -1,0 +1,39 @@
+"""Differential fuzz over the collective families.
+
+Random (family, algorithm, p, msize, dtype) configurations verified
+against the harness's closed-form oracles — deterministic seeds, so a
+failure reproduces. Complements the per-family suites by hitting shape
+and mesh-size combinations nobody hand-picked (the reference only ever
+ran power-of-2 process counts and one dtype)."""
+
+import numpy as np
+import pytest
+
+from icikit.bench.harness import _setup
+from icikit.utils.mesh import UnsupportedMeshError, make_mesh
+from icikit.utils.registry import list_algorithms
+
+FAMILIES = ("allgather", "alltoall", "allreduce", "reducescatter",
+            "broadcast", "scatter", "gather", "scan")
+
+
+@pytest.mark.parametrize("seed", range(24))
+def test_random_config_verifies(seed):
+    rng = np.random.default_rng(seed)
+    family = FAMILIES[rng.integers(len(FAMILIES))]
+    p = int(rng.choice([2, 3, 4, 5, 6, 8]))
+    msize = int(rng.choice([1, 3, 8, 17, 64, 200]))
+    dtype = np.dtype([np.int32, np.float32][rng.integers(2)])
+    algs = list_algorithms(family)
+    algorithm = algs[rng.integers(len(algs))]
+    mesh = make_mesh(p)
+    run, verify = _setup(family, mesh, "p", msize, dtype)
+    try:
+        out = run(algorithm)
+    except UnsupportedMeshError:
+        assert p & (p - 1), (
+            f"{family}/{algorithm} rejected a power-of-2 mesh p={p}")
+        return
+    assert verify(out), (
+        f"oracle mismatch: {family}/{algorithm} p={p} msize={msize} "
+        f"{dtype}")

@@ -21,6 +21,7 @@ from icikit.parallel.alltoall import (  # noqa: F401
     all_to_all_blocks,
 )
 from icikit.parallel.alltoallv import (  # noqa: F401
+    all_gather_v,
     all_to_all_v,
     ragged_all_to_all,
 )

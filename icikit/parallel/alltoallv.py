@@ -115,6 +115,48 @@ def _build(mesh, axis, cap, algorithm):
                         (P(axis), P(axis), P(axis)))
 
 
+@lru_cache(maxsize=None)
+def _build_agv(mesh, axis, algorithm):
+    p = mesh.shape[axis]
+    impl = get_algorithm("allgather", algorithm)
+
+    def per_shard(b, c):
+        rows = impl(b, axis, p)        # (p, cap)
+        counts = impl(c, axis, p)[:, 0]  # counts ride the same schedule
+        return rows[None], counts[None]
+
+    return wrap_program(per_shard, mesh, (P(axis), P(axis)),
+                        (P(axis), P(axis)))
+
+
+def all_gather_v(x: jax.Array, counts: jax.Array, mesh,
+                 axis: str = DEFAULT_AXIS, algorithm: str = "xla"):
+    """Variable-count allgather (``MPI_Allgatherv``), capacity-padded.
+
+    Args:
+      x: global ``(p, cap)`` sharded on dim 0 — device d's block, whose
+        first ``counts[d]`` elements are valid (the rest is padding;
+        ``cap`` is the static capacity, the max any device contributes).
+      counts: global ``(p,)`` int32 sharded on dim 0 (device d holds
+        its own count).
+      algorithm: any registered ``allgather`` schedule.
+
+    Returns:
+      ``(rows, all_counts)``: ``rows`` global ``(p, p, cap)`` — every
+      device's row stacks all p blocks in rank order with their
+      padding; ``all_counts`` ``(p, p)`` — every device's copy of the
+      count vector. ``unpack_rows(rows[d], all_counts[d])`` flattens to
+      the concatenated valid runs (sentinel-marked lanes).
+    """
+    p = mesh.shape[axis]
+    if x.ndim != 2 or x.shape[0] != p:
+        raise ValueError(f"expected one (cap,) block per device: "
+                         f"(p={p}, cap) input, got {x.shape}")
+    if counts.shape != (p,):
+        raise ValueError(f"counts must be ({p},), got {counts.shape}")
+    return _build_agv(mesh, axis, algorithm)(x, counts[:, None])
+
+
 def all_to_all_v(x: jax.Array, send_counts: jax.Array, mesh,
                  axis: str = DEFAULT_AXIS, capacity: int | None = None,
                  algorithm: str = "xla"):

@@ -93,3 +93,40 @@ def test_alltoallv_non_pow2():
         for s in range(p):
             c = counts[s, d]
             np.testing.assert_array_equal(got[d, s, :c], exp[d, s, :c])
+
+
+@pytest.mark.parametrize("algorithm", ["xla", "ring", "recursive_doubling"])
+def test_allgatherv_matches_oracle(mesh8, algorithm):
+    from icikit.parallel import all_gather_v
+    from icikit.parallel.alltoallv import unpack_rows
+    p, cap = 8, 10
+    rng = np.random.default_rng(10)
+    counts = rng.integers(0, cap + 1, p).astype(np.int32)
+    data = np.zeros((p, cap), np.int32)
+    for d in range(p):
+        data[d, :counts[d]] = rng.integers(0, 1000, counts[d])
+    rows, all_counts = all_gather_v(
+        shard_along(jnp.asarray(data), mesh8),
+        shard_along(jnp.asarray(counts), mesh8), mesh8,
+        algorithm=algorithm)
+    rows, all_counts = np.asarray(rows), np.asarray(all_counts)
+    expected = np.concatenate([data[d, :counts[d]] for d in range(p)])
+    for d in range(p):
+        np.testing.assert_array_equal(all_counts[d], counts)
+        flat, total = unpack_rows(jnp.asarray(rows[d]),
+                                  jnp.asarray(counts))
+        flat = np.asarray(flat)
+        got = np.concatenate(
+            [flat[s * cap:s * cap + counts[s]] for s in range(p)])
+        np.testing.assert_array_equal(got, expected)
+        assert int(total) == counts.sum()
+
+
+def test_allgatherv_validates(mesh8):
+    from icikit.parallel import all_gather_v
+    x = shard_along(jnp.zeros((8, 4), jnp.int32), mesh8)
+    with pytest.raises(ValueError, match="counts must be"):
+        all_gather_v(x, jnp.zeros((4,), jnp.int32), mesh8)
+    with pytest.raises(ValueError, match="one .* block per device"):
+        all_gather_v(jnp.zeros((16, 4), jnp.int32),
+                     jnp.zeros((8,), jnp.int32), mesh8)

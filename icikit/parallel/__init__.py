@@ -45,6 +45,8 @@ from icikit.parallel.multihost import (  # noqa: F401
     process_info,
 )
 from icikit.parallel.pt2pt import (  # noqa: F401
+    barrier,
+    halo_exchange,
     send_to,
     sendrecv_shift,
     sendrecv_xor,

@@ -25,8 +25,8 @@ from jax import lax
 from icikit.parallel.shmap import partial_shift_perm, shift_perm, xor_perm
 from icikit.utils.mesh import UnsupportedMeshError, is_pow2
 
-__all__ = ["send_to", "sendrecv_shift", "sendrecv_xor", "shift_perm",
-           "xor_perm", "partial_shift_perm"]
+__all__ = ["send_to", "sendrecv_shift", "sendrecv_xor", "halo_exchange",
+           "barrier", "shift_perm", "xor_perm", "partial_shift_perm"]
 
 
 def send_to(x: jax.Array, axis: str, pairs) -> jax.Array:
@@ -56,3 +56,41 @@ def sendrecv_xor(x: jax.Array, axis: str, p: int, mask: int) -> jax.Array:
     if not 0 < mask < p:
         raise ValueError(f"mask must be in [1, {p}), got {mask}")
     return lax.ppermute(x, axis, xor_perm(p, mask))
+
+
+def halo_exchange(x: jax.Array, axis: str, p: int, width: int,
+                  periodic: bool = True):
+    """Neighbor halo exchange — the stencil-decomposition workhorse
+    (``MPI_Neighbor_alltoall`` on a 1-D Cartesian topology).
+
+    Per-shard: ``x`` is this device's block with the exchanged
+    dimension leading. Returns ``(left_halo, right_halo)``, each
+    ``(width, ...)``: the last ``width`` rows of the left neighbor and
+    the first ``width`` of the right. Non-periodic boundaries receive
+    zeros (mask on ``lax.axis_index`` to substitute boundary
+    conditions).
+    """
+    if not 0 < width <= x.shape[0]:
+        raise ValueError(
+            f"halo width must be in [1, block={x.shape[0]}], got {width}")
+    if periodic:
+        right_perm, left_perm = shift_perm(p, 1), shift_perm(p, -1)
+    else:
+        right_perm = partial_shift_perm(p, 1)
+        left_perm = [(j, j - 1) for j in range(1, p)]
+    left_halo = lax.ppermute(x[-width:], axis, right_perm)
+    right_halo = lax.ppermute(x[:width], axis, left_perm)
+    return left_halo, right_halo
+
+
+def barrier(axis: str) -> jax.Array:
+    """``MPI_Barrier``: a zero-payload synchronization point. XLA
+    programs order collectives by data dependence, so the returned
+    scalar must be *consumed* (e.g. added to a value whose timing the
+    barrier should gate) — a free-floating barrier would be dead-code
+    eliminated, which is also why the reference's timing protocol
+    (Barrier → Wtime, ``psort.cc:617``) maps to fencing on results
+    instead (``icikit.utils.timing``)."""
+    import jax.numpy as jnp
+
+    return lax.psum(jnp.zeros((), jnp.int32), axis)

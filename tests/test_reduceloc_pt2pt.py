@@ -138,3 +138,46 @@ def test_sendrecv_xor_validates(mesh8):
 
     with pytest.raises(UnsupportedMeshError, match="power-of-2"):
         run()
+
+
+@pytest.mark.parametrize("periodic", [True, False])
+def test_halo_exchange(mesh8, periodic):
+    from icikit.parallel import halo_exchange
+    p, n, w = 8, 6, 2
+    data = _data(p, n, seed=6)
+    x = shard_along(jnp.asarray(data), mesh8)
+
+    def body(b):
+        lh, rh = halo_exchange(b[0], "p", p, w, periodic=periodic)
+        return lh[None], rh[None]
+
+    lh, rh = shard_map(body, mesh=mesh8, in_specs=P("p"),
+                       out_specs=(P("p"), P("p")))(x)
+    lh, rh = np.asarray(lh), np.asarray(rh)
+    for d in range(p):
+        want_l = data[(d - 1) % p, -w:]
+        want_r = data[(d + 1) % p, :w]
+        if not periodic and d == 0:
+            want_l = np.zeros((w, ), np.int32)
+        if not periodic and d == p - 1:
+            want_r = np.zeros((w, ), np.int32)
+        np.testing.assert_array_equal(lh[d], want_l)
+        np.testing.assert_array_equal(rh[d], want_r)
+
+
+def test_halo_width_validated(mesh8):
+    from icikit.parallel import halo_exchange
+    data = _data(8, 4, seed=7)
+    x = shard_along(jnp.asarray(data), mesh8)
+    with pytest.raises(ValueError, match="halo width"):
+        shard_map(lambda b: halo_exchange(b[0], "p", 8, 5)[0][None],
+                  mesh=mesh8, in_specs=P("p"), out_specs=P("p"))(x)
+
+
+def test_barrier_is_consumable(mesh8):
+    from icikit.parallel import barrier
+    data = _data(8, 4, seed=8)
+    x = shard_along(jnp.asarray(data), mesh8)
+    out = shard_map(lambda b: (b[0] + barrier("p"))[None], mesh=mesh8,
+                    in_specs=P("p"), out_specs=P("p"))(x)
+    np.testing.assert_array_equal(np.asarray(out), data)

@@ -26,7 +26,8 @@ def test_forward_matches_dense(causal, s):
 
 
 @pytest.mark.parametrize("causal", [False, True])
-@pytest.mark.parametrize("s", [96, 384])  # single q block / nq = 3
+#             fused single-block bwd / tiled bq=96 bk=32 / nq=3 tiled
+@pytest.mark.parametrize("s", [128, 96, 384])
 def test_grads_match_dense(causal, s):
     q, k, v = _mk(1, s, 2, 16, jnp.float32, seed=1)
 

@@ -221,25 +221,31 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref,
 
 
 def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref,
-                      dq_ref, dk_ref, dv_ref, *, scale, causal, bq, bk):
+                      dq_ref, dk_ref, dv_ref, *, scale, causal,
+                      bq, bk):
     """Single-block backward: when the whole sequence fits one (bq, bk)
     tile (the common case at s <= 1024), dq/dk/dv share one recompute
     of the probability tile — 5 matmuls and one operand read instead
     of the two-kernel path's 7 and two."""
-    q, k, v, do = q_ref[0, 0], k_ref[0, 0], v_ref[0, 0], do_ref[0, 0]
-    p = _p_tile(q, k, lse_ref[0, 0, 0], 0, 0, bq, bk, scale, causal)
-    dv_ref[0, 0] = lax.dot_general(
-        p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32).astype(dv_ref.dtype)
-    dp = lax.dot_general(do, v, (((1,), (1,)), ((), ())),
-                         preferred_element_type=jnp.float32)
-    ds = p * (dp - dl_ref[0, 0, 0][:, None]) * scale
-    dq_ref[0, 0] = lax.dot_general(
-        ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32).astype(dq_ref.dtype)
-    dk_ref[0, 0] = lax.dot_general(
-        ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32).astype(dk_ref.dtype)
+    @pl.when(pl.program_id(3) == 0)  # always true; the stores sit
+    def _():                         # under a cond like the tiled
+        q, k, v, do = (q_ref[0, 0], k_ref[0, 0], v_ref[0, 0],
+                       do_ref[0, 0])  # kernels', which the interpret-
+        # mode vma discharge requires (bare stores trip its
+        # dynamic_slice check under shard_map)
+        p = _p_tile(q, k, lse_ref[0, 0, 0], 0, 0, bq, bk, scale, causal)
+        dv_ref[0, 0] = lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32).astype(dv_ref.dtype)
+        dp = lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+        ds = p * (dp - dl_ref[0, 0, 0][:, None]) * scale
+        dq_ref[0, 0] = lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32).astype(dq_ref.dtype)
+        dk_ref[0, 0] = lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32).astype(dk_ref.dtype)
 
 
 def _bwd_call(qt, kt, vt, do, lse, delta, causal, scale, bq, bk, interpret):
@@ -248,12 +254,14 @@ def _bwd_call(qt, kt, vt, do, lse, delta, causal, scale, bq, bk, interpret):
     nq, nk = sq // bq, sk // bk
 
     if nq == 1 and nk == 1:
-        at = lambda ib, ih: (ib, ih, 0, 0)           # noqa: E731
+        # index via the (size-1) grid vars, not literal zeros: the
+        # interpreter's vma discharge accepts program-id-derived starts
+        at = lambda ib, ih, iq, ik: (ib, ih, iq, ik)  # noqa: E731
         rt = at  # residuals share the whole-block index map
         return pl.pallas_call(
             partial(_bwd_fused_kernel, scale=scale, causal=causal,
                     bq=bq, bk=bk),
-            grid=(b, h),
+            grid=(b, h, 1, 1),
             in_specs=[
                 pl.BlockSpec((1, 1, bq, d), at),
                 pl.BlockSpec((1, 1, bk, d), at),

@@ -87,6 +87,41 @@ def test_with_lse_empty_rows_contract():
     assert np.all(np.isfinite(np.asarray(lse)[:, :, 4:]))
 
 
+@pytest.mark.parametrize("causal", [False, True])
+def test_tiled_fused_bwd_square_blocks(causal):
+    # bq == bk multi-block: the fused one-pass backward's whole-sequence
+    # dq scratch accumulates via dynamic-slice stores and flushes once,
+    # during the final K row (icikit/ops/flash_attention.py
+    # _bwd_fused_tiled_kernel). Pin its grads against the dense oracle.
+    from icikit.ops.flash_attention import flash_attention_with_lse
+    q, k, v = _mk(1, 512, 2, 32, jnp.float32, seed=6)
+
+    def loss(q, k, v):
+        out, _ = flash_attention_with_lse(q, k, v, causal=causal,
+                                          block_q=128, block_k=128)
+        return jnp.sum(out * jnp.cos(out))
+
+    def loss_dense(q, k, v):
+        out = dense_attention(q, k, v, causal=causal)
+        return jnp.sum(out * jnp.cos(out))
+
+    g = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for gf, gdd, name in zip(g, gd, "qkv"):
+        np.testing.assert_allclose(gf, gdd, atol=5e-5, rtol=5e-4,
+                                   err_msg=f"d{name}")
+
+
+def test_bwd_path_selection():
+    # the fused tiled path owns every multi-block shape whose fp32 dq
+    # accumulator fits the VMEM budget; beyond it the two-kernel
+    # fallback takes over
+    from icikit.ops.flash_attention import _DQ_SCRATCH_BYTES_MAX
+    assert 16384 * 64 * 4 <= _DQ_SCRATCH_BYTES_MAX      # 16k stays fused
+    assert 131072 * 64 * 4 <= _DQ_SCRATCH_BYTES_MAX     # 128k stays fused
+    assert 1048576 * 64 * 4 > _DQ_SCRATCH_BYTES_MAX     # 1M falls back
+
+
 def test_unknown_impl_rejected():
     from icikit.ops.flash_attention import resolve_attention_impl
     with pytest.raises(ValueError, match="unknown attention impl"):

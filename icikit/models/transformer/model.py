@@ -282,6 +282,20 @@ def repeat_kv(k, n_rep: int):
     return k if n_rep == 1 else jnp.repeat(k, n_rep, axis=2)
 
 
+def _attn_pre(x, lp, cdt):
+    """First half of the attention sublayer: pre-norm + QKV projection."""
+    h = _rms_norm(x, lp["ln1"]).astype(cdt)
+    return _project_qkv(h, lp, cdt)
+
+
+def _attn_post(x, attn, lp, cdt, reduce_out):
+    """Second half: output projection, tp reduction, residual add.
+    Reduces in the residual dtype: the bf16-stream train path gets a
+    bf16 psum, the fp32-stream decode path keeps its fp32 reduction."""
+    o = jnp.einsum("bshe,hed->bsd", attn.astype(cdt), lp["wo"].astype(cdt))
+    return x + reduce_out(o.astype(x.dtype))
+
+
 def _attn_block(x, lp, cdt, attention, reduce_out):
     """Pre-norm attention sublayer, shared by the sp and pp paths.
 
@@ -290,13 +304,9 @@ def _attn_block(x, lp, cdt, attention, reduce_out):
     repetition; ``reduce_out`` closes the column->row tensor-parallel
     pair (identity when not tp-sharded).
     """
-    h = _rms_norm(x, lp["ln1"]).astype(cdt)
-    q, k, v = _project_qkv(h, lp, cdt)
+    q, k, v = _attn_pre(x, lp, cdt)
     attn = attention(q, k, v)
-    o = jnp.einsum("bshe,hed->bsd", attn.astype(cdt), lp["wo"].astype(cdt))
-    # reduce in the residual dtype: the bf16-stream train path gets a
-    # bf16 psum, the fp32-stream decode path keeps its fp32 reduction
-    return x + reduce_out(o.astype(x.dtype))
+    return _attn_post(x, attn, lp, cdt, reduce_out)
 
 
 def _dense_ffn_block(x, lp, cdt, reduce_out):
@@ -320,11 +330,14 @@ def _maybe_remat(layer, cfg: TransformerConfig):
         "dots_attn": cp.save_from_both_policies(
             cp.dots_saveable,
             cp.save_only_these_names("flash_out", "flash_lse")),
+        # except_attn restructures the scan body itself (see
+        # _forward_local); callers that can only wrap a whole layer
+        # (the pipeline path) degrade to the same saved set via dots
+        "except_attn": cp.dots_saveable,
     }
     if cfg.remat_policy not in policies:
-        raise ValueError(
-            f"unknown remat_policy {cfg.remat_policy!r} (known: "
-            f"{', '.join(sorted([*policies, 'except_attn']))})")
+        raise ValueError(f"unknown remat_policy {cfg.remat_policy!r} "
+                         f"(known: {', '.join(sorted(policies))})")
     pol = policies[cfg.remat_policy]
     return jax.checkpoint(layer, policy=pol) if pol else jax.checkpoint(layer)
 
@@ -412,13 +425,10 @@ def _forward_local(params, tokens, cfg: TransformerConfig, p_sp: int,
         dots = jax.checkpoint_policies.dots_saveable
 
         def pre(x, lp):
-            h = _rms_norm(x, lp["ln1"]).astype(cdt)
-            return _project_qkv(h, lp, cdt)
+            return _attn_pre(x, lp, cdt)
 
         def post(x, attn, lp):
-            o = jnp.einsum("bshe,hed->bsd", attn.astype(cdt),
-                           lp["wo"].astype(cdt))
-            return ffn(x + psum_tp(o.astype(x.dtype)), lp)
+            return ffn(_attn_post(x, attn, lp, cdt, psum_tp), lp)
 
         def scan_body(x, lp):
             q, k, v = jax.checkpoint(pre, policy=dots)(x, lp)

@@ -115,7 +115,12 @@ def _stage_layers(x, lp, cfg, cdt):
         x = _dense_ffn_block(x, p1, cdt, lambda v: v)
         return x, None
 
-    x, _ = lax.scan(jax.checkpoint(layer) if cfg.remat else layer, x, lp)
+    # _maybe_remat honors (and validates) cfg.remat_policy; the
+    # except_attn layer restructuring is a model.py-scan concern, so
+    # here it degrades to the dots policy (same saved set, whole-layer
+    # region).
+    from icikit.models.transformer.model import _maybe_remat
+    x, _ = lax.scan(_maybe_remat(layer, cfg), x, lp)
     return x
 
 

@@ -24,6 +24,7 @@ from icikit.parallel.allgather import all_gather_blocks
 from icikit.parallel.allreduce import all_reduce
 from icikit.parallel.alltoall import all_to_all_blocks
 from icikit.parallel.collops import broadcast, gather_blocks, scatter_blocks
+from icikit.parallel.reduce import reduce_to_root
 from icikit.parallel.reducescatter import reduce_scatter
 from icikit.parallel.scan import scan_reduce
 from icikit.utils.mesh import DEFAULT_AXIS, mesh_axis_size, replicate, shard_along
@@ -73,6 +74,9 @@ def _bus_bytes(family: str, p: int, block_bytes: int) -> float:
     if family == "scan":
         # minimal per-device movement: one running-prefix block in/out
         return block_bytes
+    if family == "reduce":
+        # each device sends its partial up the tree once
+        return block_bytes
     raise ValueError(family)
 
 
@@ -86,7 +90,8 @@ def _pattern(p: int, msize: int, dtype) -> np.ndarray:
 def _setup(family: str, mesh, axis: str, msize: int, dtype):
     """Build (input, run_fn_factory, verify_fn) for one family."""
     p = mesh_axis_size(mesh, axis)
-    if family in ("allgather", "broadcast", "gather", "allreduce", "scan"):
+    if family in ("allgather", "broadcast", "gather", "allreduce", "scan",
+                  "reduce"):
         data = _pattern(p, msize, dtype)
         x = shard_along(jnp.asarray(data), mesh, axis)
     elif family == "alltoall":
@@ -111,6 +116,7 @@ def _setup(family: str, mesh, axis: str, msize: int, dtype):
         "gather": gather_blocks,
         "reducescatter": reduce_scatter,
         "scan": scan_reduce,
+        "reduce": reduce_to_root,
     }
     run = lambda alg: fns[family](x, mesh, axis, algorithm=alg)
 
@@ -133,6 +139,11 @@ def _setup(family: str, mesh, axis: str, msize: int, dtype):
             return np.array_equal(o, data.sum(axis=0).reshape(p, msize))
         if family == "scan":
             return np.array_equal(o, np.cumsum(data, axis=0))
+        if family == "reduce":
+            # root holds the reduction (main.cc:445's MPI_Reduce), the
+            # rest are zeroed by contract
+            return (np.array_equal(o[0], data.sum(axis=0))
+                    and not np.any(o[1:]))
         return False
 
     return run, verify

@@ -51,6 +51,10 @@ from icikit.parallel.pt2pt import (  # noqa: F401
     sendrecv_shift,
     sendrecv_xor,
 )
+from icikit.parallel.reduce import (  # noqa: F401
+    REDUCE_ALGORITHMS,
+    reduce_to_root,
+)
 from icikit.parallel.reduceloc import (  # noqa: F401
     allreduce_loc,
     top_k_dist,

@@ -1,0 +1,93 @@
+"""Reduce-to-root algorithm family.
+
+The one vendor collective the reference leans on that had no named
+icikit family: ``MPI_Reduce(MPI_MAX -> rank 0)`` closes every timing
+loop (``Communication/src/main.cc:445``, ``Parallel-Sorting/src/
+psort.cc:652``) — the max-over-ranks protocol the harnesses report.
+Here it becomes a first-class family like the others: a hand-rolled
+binomial-tree ``ppermute`` schedule (the classic MPI_Reduce internal)
+and the XLA vendor baseline (psum/pmax/pmin + root mask; XLA exposes no
+rooted reduction, so the all-reduce-then-mask is the honest native
+formulation).
+
+Contract: device ``root`` ends with the full reduction; every other
+device ends with zeros. Trees run in relative-rank space
+``rr = (r - root) mod p`` so any root works (cf. collops.py).
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from icikit.parallel.shmap import build_collective, register_family
+from icikit.utils.mesh import DEFAULT_AXIS
+from icikit.utils.registry import register_algorithm
+
+_OPS = {
+    "sum": (jnp.add, lambda ax: lambda x: lax.psum(x, ax)),
+    "max": (jnp.maximum, lambda ax: lambda x: lax.pmax(x, ax)),
+    "min": (jnp.minimum, lambda ax: lambda x: lax.pmin(x, ax)),
+}
+
+
+@register_algorithm("reduce", "binomial")
+def _binomial(x: jax.Array, axis: str, p: int, op: str, root: int):
+    """⌈log2 p⌉ halving rounds: in round i, relative ranks with
+    ``rr % 2^(i+1) == 2^i`` send their partial to ``rr - 2^i``, which
+    combines. Mirror image of the binomial broadcast; works for any p
+    (a rank simply has no partner in rounds past its subtree)."""
+    combine = _OPS[op][0]
+    r = lax.axis_index(axis)
+    rr = jnp.mod(r - root, p)
+    cur = x
+    for i in range(max(0, math.ceil(math.log2(p))) if p > 1 else 0):
+        step = 1 << i
+        # senders: rr % 2*step == step; receivers: rr % 2*step == 0
+        perm = [((root + j) % p, (root + j - step) % p)
+                for j in range(step, p, 2 * step)]
+        if not perm:
+            break
+        recv = lax.ppermute(cur, axis, perm)
+        # a receiver combines only if its sender exists (rr+step < p);
+        # everything else keeps its value (senders' partials are dead
+        # after their sending round)
+        is_recv = (jnp.mod(rr, 2 * step) == 0) & (rr + step < p)
+        cur = jnp.where(is_recv, combine(cur, recv), cur)
+    return jnp.where(r == root, cur, jnp.zeros_like(cur))
+
+
+@register_algorithm("reduce", "xla")
+def _xla(x: jax.Array, axis: str, p: int, op: str, root: int):
+    """Vendor baseline: native all-reduce, then the root mask."""
+    del p
+    r = lax.axis_index(axis)
+    full = _OPS[op][1](axis)(x)
+    return jnp.where(r == root, full, jnp.zeros_like(full))
+
+
+REDUCE_ALGORITHMS = ("binomial", "xla")
+
+register_family(
+    "reduce", "sharded",
+    lambda impl, axis, p, op, root:
+        lambda b: impl(b[0], axis, p, op, root)[None])
+
+
+def reduce_to_root(x: jax.Array, mesh, axis: str = DEFAULT_AXIS,
+                   algorithm: str = "binomial", op: str = "sum",
+                   root: int = 0) -> jax.Array:
+    """Rooted reduction (``MPI_Reduce``, ``main.cc:445``).
+
+    Args:
+      x: global array of shape ``(p, ...)`` sharded along dim 0; device
+        d contributes ``x[d]``.
+
+    Returns:
+      Same shape/sharding; ``out[root]`` holds the elementwise ``op``
+      reduction of every contribution, all other rows are zero.
+    """
+    return build_collective("reduce", algorithm, mesh, axis, (op, root))(x)

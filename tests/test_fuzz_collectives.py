@@ -14,7 +14,7 @@ from icikit.utils.mesh import UnsupportedMeshError, make_mesh
 from icikit.utils.registry import list_algorithms
 
 FAMILIES = ("allgather", "alltoall", "allreduce", "reducescatter",
-            "broadcast", "scatter", "gather", "scan")
+            "broadcast", "scatter", "gather", "scan", "reduce")
 
 
 @pytest.mark.parametrize("seed", range(24))

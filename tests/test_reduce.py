@@ -1,0 +1,65 @@
+"""Reduce-to-root family: the MPI_Reduce analog (main.cc:445,
+psort.cc:652). Binomial tree vs the XLA baseline, all ops, any root,
+non-power-of-2 meshes."""
+
+import numpy as np
+import pytest
+
+from icikit.parallel import REDUCE_ALGORITHMS, reduce_to_root
+from icikit.utils.mesh import make_mesh, shard_along
+
+import jax.numpy as jnp
+
+
+def _data(p, m=5, dtype=np.float32, seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.integers(-50, 50, size=(p, m)).astype(dtype)
+
+
+_NP_OPS = {"sum": np.sum, "max": np.max, "min": np.min}
+
+
+@pytest.mark.parametrize("algorithm", REDUCE_ALGORITHMS)
+@pytest.mark.parametrize("p", [2, 3, 5, 8])
+@pytest.mark.parametrize("op", ["sum", "max", "min"])
+def test_reduce_matches_numpy(algorithm, p, op):
+    mesh = make_mesh(p)
+    data = _data(p)
+    x = shard_along(jnp.asarray(data), mesh)
+    out = np.asarray(reduce_to_root(x, mesh, algorithm=algorithm, op=op))
+    np.testing.assert_array_equal(out[0], _NP_OPS[op](data, axis=0))
+    assert not np.any(out[1:]), "non-root rows must be zero"
+
+
+@pytest.mark.parametrize("algorithm", REDUCE_ALGORITHMS)
+@pytest.mark.parametrize("root", [1, 3, 6])
+def test_reduce_nonzero_root(algorithm, root):
+    p = 7
+    mesh = make_mesh(p)
+    data = _data(p, seed=root)
+    x = shard_along(jnp.asarray(data), mesh)
+    out = np.asarray(reduce_to_root(x, mesh, algorithm=algorithm,
+                                    op="max", root=root))
+    np.testing.assert_array_equal(out[root], data.max(axis=0))
+    mask = np.ones(p, bool)
+    mask[root] = False
+    assert not np.any(out[mask])
+
+
+def test_reduce_timing_protocol_shape():
+    # the reference's timing close: every rank contributes its wall
+    # time, rank 0 reports the max (main.cc:443-449)
+    p = 8
+    mesh = make_mesh(p)
+    times = np.abs(_data(p, m=1)).astype(np.float32)
+    x = shard_along(jnp.asarray(times), mesh)
+    out = np.asarray(reduce_to_root(x, mesh, op="max"))
+    assert out[0, 0] == times.max()
+
+
+def test_reduce_p1_identity():
+    mesh = make_mesh(1)
+    data = _data(1)
+    x = shard_along(jnp.asarray(data), mesh)
+    out = np.asarray(reduce_to_root(x, mesh, algorithm="binomial"))
+    np.testing.assert_array_equal(out, data)

@@ -131,17 +131,40 @@ def render_markdown(coll, sorts, dlb, checks, meta) -> str:
                      f"{d['n_solutions']} | {d['wall_s']:.3f} | "
                      f"{d['imbalance']:.2f} |")
     lines.append("")
-    if meta["p"] == 1:
-        lines.append(
-            "> **Note:** with a single device every collective is the "
-            "identity program, so this section only demonstrates "
-            "verified degenerate execution — bandwidth comparisons "
-            "need a mesh (run with `--simulate --devices 8`, or on "
-            "multi-chip hardware).\n")
+    # render_report suppresses p=1 tables itself (identity programs);
+    # the records stay in the JSON output either way
     lines.append(render_report(
-        [dataclasses.asdict(r) for r in coll],
-        title="Collective families (best µs; busbw in JSON records)"))
+        [r if isinstance(r, dict) else dataclasses.asdict(r)
+         for r in coll],
+        title="Collective families (best µs; busbw in JSON records)",
+        heading_level=2))
     return "\n".join(lines)
+
+
+def regen_from_jsonl(json_path: str) -> str:
+    """Rebuild the markdown report from recorded results — no hardware
+    re-run (the renderer changes more often than the measurements)."""
+    import types
+    coll, sorts, dlb, meta_rec = [], [], [], {}
+    with open(json_path) as f:
+        for line in f:
+            r = json.loads(line)
+            kind = r.pop("kind", None)
+            if kind == "collective":
+                coll.append(r)
+            elif kind == "sort":
+                sorts.append(types.SimpleNamespace(**r))
+            elif kind == "dlb":
+                dlb.append(r)
+            elif kind == "checks":
+                meta_rec = r
+    if not meta_rec:
+        raise ValueError(
+            f"{json_path} has no checks/meta record — not a northstar "
+            "records file (write one with `--json`)")
+    meta = {k: meta_rec.pop(k, None)
+            for k in ("platform", "p", "date", "wall_s")}
+    return render_markdown(coll, sorts, dlb, meta_rec, meta)
 
 
 def main(argv=None) -> int:
@@ -153,7 +176,20 @@ def main(argv=None) -> int:
     ap.add_argument("--simulate", action="store_true")
     ap.add_argument("--out", default=None, help="markdown report path")
     ap.add_argument("--json", dest="json_path", default=None)
+    ap.add_argument("--regen", default=None, metavar="JSONL",
+                    help="re-render the markdown from recorded results "
+                         "instead of running benchmarks")
     args = ap.parse_args(argv)
+
+    if args.regen:
+        md = regen_from_jsonl(args.regen)
+        if args.out:
+            with open(args.out, "w") as f:
+                f.write(md)
+            print(f"wrote {args.out}")
+        else:
+            print(md)
+        return 0
 
     import jax
 

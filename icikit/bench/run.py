@@ -23,7 +23,7 @@ def main(argv=None):
     ap.add_argument("--family", default="allgather",
                     choices=["allgather", "alltoall", "allreduce",
                              "reducescatter", "broadcast", "scatter",
-                             "gather", "scan"])
+                             "gather", "scan", "reduce"])
     ap.add_argument("--algorithms", default=None,
                     help="comma-separated variant names (default: all)")
     ap.add_argument("--sizes", default=None,

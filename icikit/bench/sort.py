@@ -51,7 +51,7 @@ def sweep_sorts(mesh, sizes, algorithms=None, dtype="int32",
 
     from icikit.models.sort import SORT_ALGORITHMS, check_sort, sort
     from icikit.utils.mesh import UnsupportedMeshError, mesh_axis_size
-    from icikit.utils.prandom import uniform_global
+    from icikit.utils.prandom import odd_dist_warp, uniform_global
     from icikit.utils.timing import timeit_chained
 
     p = mesh_axis_size(mesh)
@@ -73,10 +73,24 @@ def sweep_sorts(mesh, sizes, algorithms=None, dtype="int32",
 
             def chain(args, out):
                 # bijective odd-multiplier scramble: content and order
-                # change every run, so no cache can elide an execution
+                # change every run, so no cache can elide an execution.
+                # The scramble alone would feed near-uniform data to
+                # every timed run regardless of --odd-dist (ADVICE r1):
+                # map back to (0,1) and re-apply the skew so the timed
+                # windows measure the recorded distribution.
                 if jnp.issubdtype(dt, jnp.integer):
-                    return (out * dt.type(-1640531527),)
-                return ((out * 25.173 + 0.217) % 1.0,)
+                    mixed = out * dt.type(-1640531527)
+                    if not odd_dist:
+                        return (mixed,)
+                    info = jnp.iinfo(dt)
+                    span = float(info.max) - float(info.min)
+                    u01 = (mixed.astype(jnp.float32)
+                           - float(info.min)) / span
+                    warped = odd_dist_warp(u01)
+                    return ((warped * span + float(info.min)).astype(dt),)
+                mixed = (out * 25.173 + 0.217) % 1.0
+                return ((odd_dist_warp(mixed) if odd_dist
+                         else mixed).astype(dt),)
 
             try:
                 sorted_out = run(keys)

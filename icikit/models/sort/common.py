@@ -24,6 +24,7 @@ from icikit.parallel.alltoallv import (  # noqa: F401
 )
 from icikit.parallel.alltoallv import exchange_counts as _exchange_counts
 from icikit.parallel.alltoallv import ragged_all_to_all as _ragged_a2a
+from icikit.parallel.alltoallv import ragged_payload as _ragged_payload
 from icikit.utils.dtypes import sentinel_for  # noqa: F401
 from icikit.utils.mesh import DEFAULT_AXIS, mesh_axis_size, shard_along
 
@@ -131,7 +132,9 @@ def rebalance_sorted(flat: jax.Array, count: jax.Array, n_loc: int,
     keys_out = jnp.where(in_range, vals, sentinel_for(flat.dtype))
     if values is None:
         return keys_out
-    vrows, _, _ = ragged_all_to_all(values, starts, counts, n_loc, axis)
+    # data leg only: the keys leg above already exchanged counts and
+    # checked overflow for exactly these starts/counts (ADVICE r1)
+    vrows = _ragged_payload(values, starts, counts, n_loc, axis)
     v = vrows[s_of_t, col]
     values_out = jnp.where(in_range, v, jnp.zeros_like(v))
     return keys_out, values_out

@@ -86,12 +86,19 @@ def _build_top_k(mesh, axis, k, largest):
     p = mesh.shape[axis]
 
     def best(vals, kk):
-        """k best (direction-aware) without negation — negating
-        overflows at the signed minimum and is wrong for unsigned."""
+        """k best (direction-aware) via lax.top_k both ways (ADVICE r1:
+        the argsort path was O(n log n) where only k are needed).
+        smallest-k uses an order-reversing monotone transform that
+        cannot overflow: bitwise NOT for integers (INT_MIN -> INT_MAX;
+        plain negation overflows there and is wrong for unsigned) and
+        negation for floats (safe across +-inf; NaN placement for
+        smallest-k floats is unspecified, as for lax.top_k itself).
+        Ties keep the lower index first either way (top_k is stable)."""
         if largest:
             return lax.top_k(vals, kk)
-        order = jnp.argsort(vals)[:kk]
-        return vals[order], order
+        inv = ~vals if jnp.issubdtype(vals.dtype, jnp.integer) else -vals
+        _, idx = lax.top_k(inv, kk)
+        return vals[idx], idx
 
     def per_shard(b):
         x = b[0]

@@ -36,6 +36,59 @@ from icikit.models.solitaire.game import (
 )
 from icikit.models.solitaire.scheduler import write_solutions
 
+# The reference's shipped fixtures and their golden solution counts —
+# the "found N solutions" oracle (main.cc:135), computed by the native
+# solver (which preserves the reference's (i,j,dir) move-enumeration
+# order) and pinned here. SURVEY.md §4.4 / VERDICT r1 missing #1.
+_REF_DATA = "/root/reference/Dynamic-Load-Balancing/Data"
+GOLDEN_COUNTS = {
+    "easy_sample.dat": (1000, 32),
+    "hard_sample.dat": (1000, 115),
+    "big_set/easy_sample.dat.gz": (20000, 1116),
+    "big_set/medium_sample.dat.gz": (20000, 1742),
+    "big_set/hard_sample.dat.gz": (20000, 27),
+}
+
+
+def _ref_fixture(name):
+    import os
+    path = os.path.join(_REF_DATA, name)
+    if not os.path.exists(path):
+        pytest.skip(f"reference fixture {name} not present")
+    return load_dataset(path)
+
+
+# big_set/hard (34 s of DFS) is pinned in GOLDEN_COUNTS and FIXTURES.md
+# but kept out of the suite; the other four run on every test pass.
+@pytest.mark.parametrize("name", ["easy_sample.dat", "hard_sample.dat",
+                                  "big_set/easy_sample.dat.gz",
+                                  "big_set/medium_sample.dat.gz"])
+def test_reference_fixture_golden_counts(name):
+    """Native solver over the reference's shipped fixtures reproduces
+    the committed golden counts (the reference's only real test
+    fixtures)."""
+    from icikit.models.solitaire.scheduler import solve_host
+    batch = _ref_fixture(name)
+    n_games, golden = GOLDEN_COUNTS[name]
+    assert len(batch) == n_games  # count header honored (Data/*.dat:1)
+    rep = solve_host(batch)
+    assert int(rep.solved.sum()) == golden
+
+
+def test_reference_fixture_jax_agrees_with_native():
+    """The JAX while_loop solver and both schedulers agree with the
+    native DFS per-board on a slice of the reference's easy fixture
+    (deep-search boards are the host backend's job — see FIXTURES.md;
+    grade-mixed JAX-vs-native agreement is pinned separately on
+    generated datasets below)."""
+    from icikit.models.solitaire.scheduler import solve_host
+    batch = _ref_fixture("easy_sample.dat")[:96]
+    host = solve_host(batch)
+    static = solve_static(batch)
+    np.testing.assert_array_equal(static.solved, host.solved)
+    dynamic = solve_dynamic(batch)
+    np.testing.assert_array_equal(dynamic.solved, host.solved)
+
 
 # ---------------------------------------------------------------------------
 # Board encoding

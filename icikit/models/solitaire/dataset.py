@@ -158,6 +158,23 @@ def generate_dataset(n_games: int, grade: str = "easy",
     return BoardBatch(pegs=pegs_out, playable=playable_out)
 
 
+def generate_skewed_dataset(n_games: int, seed: int = 0,
+                            hard_fraction: float = 0.125) -> BoardBatch:
+    """A deterministic dataset with adversarially *placed* cost skew:
+    the last ``hard_fraction`` of the boards are hard (deep DFS), the
+    rest easy. A static contiguous split hands every hard board to the
+    final worker — the exact variable-cost scenario the reference's
+    dynamic farm exists for (``Dynamic-Load-Balancing/README.md:5``);
+    the imbalance study (tests/test_solitaire.py, bench.northstar)
+    measures how much of that skew each scheduler absorbs."""
+    n_hard = max(1, int(n_games * hard_fraction))
+    easy = generate_dataset(n_games - n_hard, "easy", seed=seed)
+    hard = generate_dataset(n_hard, "hard", seed=seed + 1)
+    return BoardBatch(
+        pegs=np.concatenate([easy.pegs, hard.pegs]),
+        playable=np.concatenate([easy.playable, hard.playable]))
+
+
 def dataset_dir() -> str:
     """Repo-local Data/ directory (reference ``Dynamic-Load-Balancing/Data``)."""
     here = os.path.dirname(os.path.abspath(__file__))

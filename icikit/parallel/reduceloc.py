@@ -96,7 +96,12 @@ def _build_top_k(mesh, axis, k, largest):
         Ties keep the lower index first either way (top_k is stable)."""
         if largest:
             return lax.top_k(vals, kk)
-        inv = ~vals if jnp.issubdtype(vals.dtype, jnp.integer) else -vals
+        if vals.dtype == jnp.bool_:
+            inv = jnp.logical_not(vals).astype(jnp.int32)
+        elif jnp.issubdtype(vals.dtype, jnp.integer):
+            inv = ~vals
+        else:
+            inv = -vals
         _, idx = lax.top_k(inv, kk)
         return vals[idx], idx
 

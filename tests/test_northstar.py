@@ -13,7 +13,12 @@ def test_northstar_quick(mesh4):
     assert checks["dlb_schedulers_agree"]
     assert {r.algorithm for r in sorts} == {
         "bitonic", "sample", "sample_bitonic", "quicksort"}
-    assert {d["strategy"] for d in dlb} == {"static", "dynamic"}
+    assert {d["strategy"] for d in dlb} == {
+        "static", "dynamic", "modeled-static", "modeled-dynamic"}
+    # the skewed study: dynamic must spread the cost skew static
+    # concentrates (per-worker DFS steps; machine-independent)
+    assert checks["dlb_dynamic_balances_skew"]
+    assert checks["dlb_dynamic_critical_path_win"]
     md = render_markdown(coll, sorts, dlb, checks,
                          {"platform": "cpu", "p": 4,
                           "date": "test", "wall_s": 0.0})

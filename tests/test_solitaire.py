@@ -90,6 +90,72 @@ def test_reference_fixture_jax_agrees_with_native():
     np.testing.assert_array_equal(dynamic.solved, host.solved)
 
 
+def test_dynamic_beats_static_imbalance_on_skewed_data():
+    """The point of the reference sub-repo (Dynamic-Load-Balancing/
+    README.md:5): under variable DFS cost, the pull model spreads the
+    expensive boards while a static contiguous split concentrates them.
+
+    Schedule quality is evaluated deterministically: exact per-board
+    DFS costs (node counts from a real solve) replayed through
+    simulate_schedule's virtual clock — on a host with fewer cores
+    than workers, live-thread telemetry measures the OS scheduler, not
+    the algorithm. The live dynamic run still pins result agreement."""
+    from icikit.models.solitaire.dataset import generate_skewed_dataset
+    from icikit.models.solitaire.scheduler import simulate_schedule
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device simulated mesh")
+    ds = generate_skewed_dataset(256, seed=3, hard_fraction=0.25)
+    static = solve_static(ds, max_steps=200_000)
+    dynamic = solve_dynamic(ds, chunk_size=4, max_steps=200_000)
+    # same work, same answers, full coverage
+    np.testing.assert_array_equal(static.solved, dynamic.solved)
+    assert sum(dynamic.per_worker_games) == len(ds)
+
+    def imb(per):
+        per = np.asarray(per, np.float64)
+        return per.max() / per.mean()
+
+    st = simulate_schedule(static.steps, p=8, strategy="static")
+    dy = simulate_schedule(static.steps, p=8, strategy="dynamic",
+                           chunk_size=4)
+    # every hard board sits in the last static slice: imbalance -> p
+    assert imb(st) > 3.0, st
+    # the pull model spreads the 16 hard chunks over all 8 workers
+    # (floor set by the costliest single chunk, ~1.7 here)
+    assert imb(dy) < imb(st) / 2, (st, dy)
+    assert imb(dy) < 2.0, dy
+    # modeled critical path (= ideal wall time) shrinks accordingly
+    assert max(dy) < max(st) / 2, (st, dy)
+
+
+def test_dynamic_guided_pull_single_device_dispatch_count():
+    """Guided pulls amortize dispatches: a 1-worker drain of a c-chunk
+    queue takes O(log c) pulls, not c (ROADMAP r1 item 6 — the p=1
+    overhead that made dynamic 6.8x slower than static in the r1
+    northstar)."""
+    from icikit.models.solitaire import scheduler as sched
+    ds = generate_dataset(256, "easy", seed=5)  # 32 chunks of 8
+    pulls = []
+    orig = sched.solve_batch
+
+    def counting(pg, pl, max_steps=2_000_000_000):
+        pulls.append(int(pg.shape[0]))
+        return orig(pg, pl, max_steps)
+
+    sched.solve_batch, _saved = counting, orig
+    try:
+        rep = sched.solve_dynamic(ds, devices=jax.devices()[:1])
+    finally:
+        sched.solve_batch = _saved
+    assert rep.n_solutions == solve_static(ds).n_solutions
+    assert len(pulls) == 32   # every chunk still solved chunk-shaped
+    assert all(c == 8 for c in pulls)  # one compiled shape throughout
+    # 32 chunks, one worker: guided pulls of 16, 8, 4, 2, 1, 1 = 6
+    # host barriers instead of 32
+    assert rep.n_pulls <= 8, rep.n_pulls
+    assert rep.per_worker_games == [256]
+
+
 # ---------------------------------------------------------------------------
 # Board encoding
 

@@ -141,7 +141,9 @@ def run_northstar(mesh, quick: bool = False, runs: int = 4):
             dlb.append({
                 "grade": "skewed", "strategy": label,
                 "n_games": len(skewed), "n_solutions": rep.n_solutions,
-                "wall_s": rep.wall_s, "imbalance": rep.imbalance,
+                # the native pool reports only aggregate telemetry: no
+                # per-worker split exists to compute an imbalance from
+                "wall_s": rep.wall_s, "imbalance": None,
             })
         if n_cores >= n_threads:
             # a wall-time comparison only carries signal when every
@@ -218,9 +220,11 @@ def render_markdown(coll, sorts, dlb, checks, meta) -> str:
     lines.append("| grade | strategy | solutions | wall_s | imbalance |")
     lines.append("|---|---|---|---|---|")
     for d in dlb:
+        imb = ("n/a" if d["imbalance"] is None
+               else f"{d['imbalance']:.2f}")
         lines.append(f"| {d['grade']} | {d['strategy']} | "
                      f"{d['n_solutions']} | {d['wall_s']:.3f} | "
-                     f"{d['imbalance']:.2f} |")
+                     f"{imb} |")
     lines.append("")
     # render_report suppresses p=1 tables itself (identity programs);
     # the records stay in the JSON output either way

@@ -26,9 +26,9 @@ import sys
 import tempfile
 from pathlib import Path
 
-# Simulated meshes are host threads, so the sweep stays modest by
-# default (the reference went to 128 ranks on 7 real nodes).
-DEFAULT_PS = (2, 4, 8)
+# Simulated meshes are host threads; 32 is the practical ceiling on a
+# small host (the reference went to 128 ranks on 7 real nodes).
+DEFAULT_PS = (2, 4, 8, 16, 32)
 
 _REPO_ROOT = str(Path(__file__).resolve().parents[2])
 

@@ -117,12 +117,14 @@ def sweep_sorts(mesh, sizes, algorithms=None, dtype="int32",
 def format_table(records) -> str:
     if not records:
         return "(no records)"
-    hdr = (f"{'algorithm':<15} {'p':>3} {'n':>12} {'dist':>9} "
-           f"{'mean_ms':>10} {'best_ms':>10} {'Mkeys/s':>9} {'errs':>5}")
+    hdr = (f"{'algorithm':<15} {'p':>3} {'n':>12} {'dtype':>9} "
+           f"{'dist':>9} {'mean_ms':>10} {'best_ms':>10} "
+           f"{'Mkeys/s':>9} {'errs':>5}")
     lines = [hdr, "-" * len(hdr)]
     for r in records:
         lines.append(
-            f"{r.algorithm:<15} {r.p:>3} {r.n:>12} {r.distribution:>9} "
+            f"{r.algorithm:<15} {r.p:>3} {r.n:>12} {r.dtype:>9} "
+            f"{r.distribution:>9} "
             f"{r.mean_s * 1e3:>10.2f} {r.best_s * 1e3:>10.2f} "
             f"{r.keys_per_s / 1e6:>9.1f} {r.errors:>5}")
     return "\n".join(lines)
@@ -139,6 +141,13 @@ def main(argv=None) -> int:
     ap.add_argument("--odd-dist", action="store_true",
                     help="the reference's skewed ODD_DIST input "
                          "(psort.cc:598-609) — stresses splitters")
+    ap.add_argument("--reference-float", action="store_true",
+                    help="the reference's headline float study "
+                         "(project3.pdf p.5 SS4: 50,000,000 doubles) at "
+                         "its scale: n=50M, float32 and bfloat16, "
+                         "uniform and odd_dist. TPU has no f64 "
+                         "(FLOATSORT.md documents the deviation); "
+                         "overrides --sizes/--dtype/--odd-dist")
     ap.add_argument("--runs", type=int, default=4)
     ap.add_argument("--warmup", type=int, default=1)
     ap.add_argument("--devices", type=int, default=None)
@@ -160,11 +169,20 @@ def main(argv=None) -> int:
     from icikit.utils.mesh import make_mesh
 
     mesh = make_mesh(args.devices)
-    records = sweep_sorts(
-        mesh, tuple(int(s) for s in args.sizes.split(",")),
-        args.algorithms.split(",") if args.algorithms else None,
-        dtype=args.dtype, odd_dist=args.odd_dist, runs=args.runs,
-        warmup=args.warmup)
+    if args.reference_float:
+        configs = [((50_000_000,), dtype, odd)
+                   for dtype in ("float32", "bfloat16")
+                   for odd in (False, True)]
+    else:
+        configs = [(tuple(int(s) for s in args.sizes.split(",")),
+                    args.dtype, args.odd_dist)]
+    records = []
+    for sizes, dtype, odd in configs:
+        records += sweep_sorts(
+            mesh, sizes,
+            args.algorithms.split(",") if args.algorithms else None,
+            dtype=dtype, odd_dist=odd, runs=args.runs,
+            warmup=args.warmup)
     print(format_table(records))
     if args.json_path:
         with open(args.json_path, "w") as f:

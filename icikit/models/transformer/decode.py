@@ -206,36 +206,46 @@ def _build_generate(mesh, cfg: TransformerConfig, s_prompt: int, n_new: int,
                       jax.random.fold_in(key, 0), knobs)
 
         # --- decode loop: one position at a time against the cache.
+        # Per-layer cache buffers ride the *carry* as a tuple and the
+        # layer loop is unrolled, so every step writes exactly one new
+        # column in place and reads each cache exactly once. The two
+        # obvious formulations both lose: caches through scan xs/ys
+        # re-stack a fresh full cache per step (profiled: ~35% of the
+        # b=32 step, a 16.8 MB copy per token), and a scan with
+        # dynamically-indexed stacked caches materializes a per-layer
+        # slice copy on the read.
+        n_layers = kcache.shape[0]
+        kc = tuple(kcache[li] for li in range(n_layers))
+        vc = tuple(vcache[li] for li in range(n_layers))
+
         def step(carry, i):
-            token, kcache, vcache = carry
+            token, kc, vc = carry
             cur = s_prompt + i
             x = params["emb"][token][:, None]
             if cfg.pos_encoding == "learned":
                 x = x + params["pos"][cur][None, None]
-
-            def dec_layer(x, layer_in):
-                lp1, ks, vs = layer_in
+            kc2, vc2 = [], []
+            for li in range(n_layers):
+                lp1 = {kk: lp[kk][li] for kk in layer_keys}
                 q, k, v = qkv_proj(x, lp1)
                 if cfg.pos_encoding == "rope":
                     pos = cur[None]
                     q = apply_rope(q, pos, cfg.rope_theta)
                     k = apply_rope(k, pos, cfg.rope_theta)
-                ks = lax.dynamic_update_slice_in_dim(ks, k, cur, 1)
-                vs = lax.dynamic_update_slice_in_dim(vs, v, cur, 1)
+                ks = lax.dynamic_update_slice_in_dim(kc[li], k, cur, 1)
+                vs = lax.dynamic_update_slice_in_dim(vc[li], v, cur, 1)
                 attn = _masked_attention(q, ks, vs, cur, scale, n_rep)
                 x = close_attn(x, attn, lp1)
                 x = ffn(x, lp1)
-                return x, (ks, vs)
-
-            x, (kcache, vcache) = lax.scan(dec_layer, x,
-                                           (lp, kcache, vcache))
+                kc2.append(ks)
+                vc2.append(vs)
             nxt = select(logits_last(params, x[:, 0]),
                          jax.random.fold_in(key, i + 1), knobs)
-            return (nxt, kcache, vcache), token
+            return (nxt, tuple(kc2), tuple(vc2)), token
 
         # n_new - 1 steps: each emits its incoming token and computes the
         # next; the final token needs no further forward pass.
-        (last, _, _), toks = lax.scan(step, (tok0, kcache, vcache),
+        (last, _, _), toks = lax.scan(step, (tok0, kc, vc),
                                       jnp.arange(n_new - 1))
         generated = jnp.concatenate(
             [jnp.moveaxis(toks, 0, 1), last[:, None]], axis=1)

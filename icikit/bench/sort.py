@@ -88,7 +88,11 @@ def sweep_sorts(mesh, sizes, algorithms=None, dtype="int32",
                            - float(info.min)) / span
                     warped = odd_dist_warp(u01)
                     return ((warped * span + float(info.min)).astype(dt),)
-                mixed = (out * 25.173 + 0.217) % 1.0
+                # scramble in f32: bf16's 8-bit mantissa would
+                # collapse the orbit to a handful of distinct values
+                # within a few steps (measured: 50k keys -> 17 values
+                # in 3 steps), degenerating the timed distribution
+                mixed = (out.astype(jnp.float32) * 25.173 + 0.217) % 1.0
                 return ((odd_dist_warp(mixed) if odd_dist
                          else mixed).astype(dt),)
 

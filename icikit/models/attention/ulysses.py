@@ -61,23 +61,39 @@ def ulysses_attention_shard(q: jax.Array, k: jax.Array, v: jax.Array,
         raise ValueError(
             f"query heads ({h}) must be a multiple of K/V heads ({h_kv})")
     n_rep = h // h_kv
-    if n_rep > 1 and h_kv % p:
-        # K/V head groups can't split over p devices: repeat up front
-        # (correct for any h_kv, at full-width a2a volume)
-        k = jnp.repeat(k, n_rep, axis=2)
-        v = jnp.repeat(v, n_rep, axis=2)
-        n_rep = 1
     qh = _seq_to_heads(q, axis, p, algorithm)
-    kh = _seq_to_heads(k, axis, p, algorithm)
-    vh = _seq_to_heads(v, axis, p, algorithm)
-    if n_rep > 1:
+    if n_rep == 1 or h_kv % p == 0:
         # GQA at K/V width through the wire: device r's q-head group
         # [r·h/p, (r+1)·h/p) is served exactly by its kv-head group
         # [r·h_kv/p, ...) (h_kv % p == 0 guarantees the alignment), so
-        # the a2a carried 1/n_rep of the K/V bytes and the repeat is
+        # the a2a carries 1/n_rep of the K/V bytes and the repeat is
         # local
-        kh = jnp.repeat(kh, n_rep, axis=2)
-        vh = jnp.repeat(vh, n_rep, axis=2)
+        kh = _seq_to_heads(k, axis, p, algorithm)
+        vh = _seq_to_heads(v, axis, p, algorithm)
+        if n_rep > 1:
+            kh = jnp.repeat(kh, n_rep, axis=2)
+            vh = jnp.repeat(vh, n_rep, axis=2)
+    elif p % h_kv == 0:
+        # K/V head *groups* split with per-device replication factors:
+        # replicate each kv head p/h_kv times pre-wire (width exactly
+        # p), so after the a2a device r holds the one kv head
+        # ``r // (p/h_kv)`` — which serves all of its h/p query heads,
+        # because kv-group boundaries align with device boundaries
+        # (n_rep is a multiple of h/p when p % h_kv == 0). Wire volume
+        # is p heads instead of the full-repeat fallback's h: a
+        # (h/p)× saving. The remaining repeat to q-width is local.
+        f = p // h_kv
+        kh = _seq_to_heads(jnp.repeat(k, f, axis=2), axis, p, algorithm)
+        vh = _seq_to_heads(jnp.repeat(v, f, axis=2), axis, p, algorithm)
+        kh = jnp.repeat(kh, h // p, axis=2)
+        vh = jnp.repeat(vh, h // p, axis=2)
+    else:
+        # irreducible layout (p and h_kv share no useful factor):
+        # repeat to full query width before the wire
+        kh = _seq_to_heads(jnp.repeat(k, n_rep, axis=2), axis, p,
+                           algorithm)
+        vh = _seq_to_heads(jnp.repeat(v, n_rep, axis=2), axis, p,
+                           algorithm)
     ctx = resolve_attention_impl(local)(qh, kh, vh, causal=causal,
                                         scale=scale)
     return _heads_to_seq(ctx, axis, p, algorithm)

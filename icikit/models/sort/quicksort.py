@@ -136,6 +136,13 @@ def hypercube_quicksort_blocks(x2d: jax.Array, mesh,
     reference over-allocated to n total, ``psort.cc:385``) and doubles
     on detected overflow up to ``max_cap_factor``; beyond that a
     RuntimeError reports irreducible skew.
+
+    The default ``cap_factor = 2.0`` is measured (r2 overflow study,
+    p in {4, 8}, n in {2^20, 2^22}): median-of-medians pivots keep the
+    per-round split so even that 1.25 · n_loc already suffices under
+    both uniform and odd_dist — 2.0 doubles that margin, so the
+    doubling retry (which re-traces a fresh program per capacity)
+    never fires on realistic inputs.
     """
     p, n_loc = x2d.shape
     f = cap_factor

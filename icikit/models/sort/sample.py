@@ -130,7 +130,19 @@ def run_with_capacity_retry(build, n_loc: int, p: int, cap_factor: float,
     retry once at the safe capacity n_loc if any bucket overflowed — the
     price of static shapes, made explicit instead of the reference's
     unchecked over-allocation. ``build(cap)`` returns a callable whose
-    result tuple ends with the overflow flag."""
+    result tuple ends with the overflow flag.
+
+    The default ``cap_factor = 4.0`` is measured, not guessed (r2
+    overflow study, p in {4, 8}, n in {2^20, 2^22}, uniform and
+    odd_dist): the minimal non-overflowing factor was 1.25 for
+    allgather splitters on uniform data, 2.0-3.0 under odd_dist, and
+    3.0 worst-case for the bitonic splitter (its p global splitters
+    come from per-rank medians — coarser than the p·(p−1) sample set,
+    so buckets run more uneven). 4.0 clears every measured
+    configuration with margin: the retry recompile never fires in the
+    common case, and relative bucket fluctuation shrinks as n grows,
+    so the margin widens at scale (``tests/test_sort.py`` pins the
+    no-overflow property at the default)."""
     cap = max(1, min(n_loc, int(cap_factor * n_loc / max(p, 1))))
     out = build(cap)(*operands)
     if int(jax.device_get(out[-1].sum())) > 0 and cap < n_loc:

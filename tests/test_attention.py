@@ -157,12 +157,50 @@ def test_gqa_ulysses_matches_dense(hkv, algorithm):
     np.testing.assert_allclose(out, expected, rtol=2e-5, atol=2e-5)
 
 
-def test_gqa_ulysses_prerepeat_fallback(mesh8):
-    """h_kv=2 does not divide p=8: the shard fn pre-repeats and the
-    result still matches the oracle."""
+def test_gqa_ulysses_group_split(mesh8):
+    """h_kv=2 does not divide p=8 but p % h_kv == 0: kv-head groups
+    split with per-device replication (each kv head replicated p/h_kv
+    times pre-wire — width p, not the full-repeat fallback's h) and
+    the result matches the oracle."""
     from icikit.models.attention import ulysses_attention
     b, s, h, hkv, d = 2, 32, 8, 2, 8
     rng = np.random.default_rng(9)
+    q = jnp.asarray(rng.standard_normal((b, s, h, d)).astype(np.float32))
+    k = jnp.asarray(rng.standard_normal((b, s, hkv, d)).astype(np.float32))
+    v = jnp.asarray(rng.standard_normal((b, s, hkv, d)).astype(np.float32))
+    expected = np.asarray(dense_attention(
+        q, jnp.repeat(k, 4, 2), jnp.repeat(v, 4, 2), causal=True))
+    qs, ks, vs = (shard_along(a, mesh8, dim=1) for a in (q, k, v))
+    out = np.asarray(ulysses_attention(qs, ks, vs, mesh8, causal=True))
+    np.testing.assert_allclose(out, expected, rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("algorithm", ["xla", "wraparound"])
+def test_gqa_ulysses_group_split_multihead(algorithm):
+    """Group split with h/p > 1 local query heads per resident kv head
+    (p=4, h=16, h_kv=2: f=2 replicas pre-wire, 4 q heads served
+    locally), under both carrier kinds."""
+    from icikit.models.attention import ulysses_attention
+    mesh = make_mesh(4)
+    b, s, h, hkv, d = 2, 32, 16, 2, 8
+    rng = np.random.default_rng(10)
+    q = jnp.asarray(rng.standard_normal((b, s, h, d)).astype(np.float32))
+    k = jnp.asarray(rng.standard_normal((b, s, hkv, d)).astype(np.float32))
+    v = jnp.asarray(rng.standard_normal((b, s, hkv, d)).astype(np.float32))
+    expected = np.asarray(dense_attention(
+        q, jnp.repeat(k, 8, 2), jnp.repeat(v, 8, 2), causal=True))
+    qs, ks, vs = (shard_along(a, mesh, dim=1) for a in (q, k, v))
+    out = np.asarray(ulysses_attention(qs, ks, vs, mesh, causal=True,
+                                       algorithm=algorithm))
+    np.testing.assert_allclose(out, expected, rtol=2e-5, atol=2e-5)
+
+
+def test_gqa_ulysses_irreducible_fallback(mesh8):
+    """p=8 and h_kv=6 share no useful factor (neither divides the
+    other): the full-width pre-repeat fallback still matches."""
+    from icikit.models.attention import ulysses_attention
+    b, s, h, hkv, d = 1, 32, 24, 6, 8
+    rng = np.random.default_rng(11)
     q = jnp.asarray(rng.standard_normal((b, s, h, d)).astype(np.float32))
     k = jnp.asarray(rng.standard_normal((b, s, hkv, d)).astype(np.float32))
     v = jnp.asarray(rng.standard_normal((b, s, hkv, d)).astype(np.float32))

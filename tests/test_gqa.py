@@ -124,3 +124,34 @@ def test_gqa_decode_matches_reforward():
         nxt = jnp.argmax(logits, axis=-1).astype(toks.dtype)
         toks = jnp.concatenate([toks, nxt[:, None]], axis=1)
     np.testing.assert_array_equal(got, np.asarray(toks))
+
+
+def test_gqa_ulysses_sp_not_dividing_kv_heads():
+    """sequence_schedule=ulysses with sp=4 and n_kv_heads=2 (sp does
+    not divide h_kv): the kv-head-group split with per-device
+    replication (icikit/models/attention/ulysses.py) must reproduce
+    the 1-device loss/grads."""
+    import numpy as np
+    cfg = TransformerConfig(vocab=61, d_model=32, n_heads=8, d_head=8,
+                            d_ff=64, n_layers=2, max_seq=32,
+                            compute_dtype="float32", n_kv_heads=2,
+                            sequence_schedule="ulysses")
+    rng = np.random.default_rng(4)
+    tok = rng.integers(0, cfg.vocab, (4, 32)).astype(np.int32)
+    tgt = rng.integers(0, cfg.vocab, (4, 32)).astype(np.int32)
+
+    def run(dp, tp, sp):
+        mesh = make_model_mesh(dp=dp, tp=tp, sp=sp)
+        params = init_params(jax.random.key(0), cfg, mesh)
+        sh = NamedSharding(mesh, P("dp", "sp"))
+        loss, grads = loss_fn(
+            params, jax.device_put(jnp.asarray(tok), sh),
+            jax.device_put(jnp.asarray(tgt), sh), mesh, cfg)
+        return float(loss), grads
+
+    l1, g1 = run(1, 1, 1)
+    l4, g4 = run(1, 1, 4)
+    np.testing.assert_allclose(l1, l4, rtol=1e-5)
+    for k in g1:
+        np.testing.assert_allclose(np.asarray(g1[k]), np.asarray(g4[k]),
+                                   rtol=2e-4, atol=2e-5, err_msg=k)

@@ -139,3 +139,28 @@ def test_bitonic_non_pow2_mesh_raises():
     mesh = make_mesh(6)
     with pytest.raises(UnsupportedMeshError):
         sort(jnp.zeros(64, jnp.int32), mesh, algorithm="bitonic")
+
+
+def test_default_capacities_hold_without_retry():
+    """The measured defaults (capacity_study.json: sample cap_factor 4.0,
+    quicksort 2.0) must clear an odd_dist workload on the first build —
+    the retry path re-traces a whole new program, so the common case
+    must never take it."""
+    import jax
+    from icikit.models.sort import quicksort as Q
+    from icikit.models.sort import sample as S
+    from icikit.utils.mesh import make_mesh, shard_along
+    from icikit.utils.prandom import uniform_global
+
+    p, n = 8, 1 << 16
+    mesh = make_mesh(p)
+    u = uniform_global(jax.random.key(0), n, odd_dist=True)
+    keys = (u * 2e9 - 1e9).astype(jnp.int32)
+    x2d = shard_along(keys.reshape(p, n // p), mesh)
+    n_loc = n // p
+    for splitter in ("allgather", "bitonic"):
+        cap = max(1, min(n_loc, int(4.0 * n_loc / p)))   # the default
+        _, ovf = S._build(mesh, "p", cap, splitter)(x2d)
+        assert int(jax.device_get(ovf.sum())) == 0, splitter
+    _, ovf = Q._build(mesh, "p", int(2.0 * n_loc))(x2d)  # the default
+    assert int(jax.device_get(ovf.sum())) == 0

@@ -56,9 +56,18 @@ def main(argv=None):
     args = ap.parse_args(argv)
 
     if args.watchdog:
-        from icikit.utils.guard import chopsigs
+        from icikit.utils.guard import chopsigs, disarm
         chopsigs(args.watchdog)
+        try:
+            return _guarded_main(args)
+        finally:
+            # success or failure, the caller's process must not keep
+            # the hard-exit trap handler or a ticking alarm
+            disarm()
+    return _guarded_main(args)
 
+
+def _guarded_main(args):
     from icikit.models.solitaire.dataset import generate_dataset, load_dataset
     from icikit.models.solitaire.scheduler import (
         solve_dynamic,

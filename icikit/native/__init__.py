@@ -72,6 +72,7 @@ def _try_load():
                 return None
         try:
             lib.ik_install_traps.restype = ctypes.c_int
+            lib.ik_restore_traps.restype = ctypes.c_int
             lib.ik_watchdog.argtypes = [ctypes.c_uint]
             lib.ik_trap_count.restype = ctypes.c_int
             lib.ik_watchdog_soft.argtypes = [ctypes.c_int]
@@ -129,6 +130,20 @@ def install_traps() -> bool:
     if lib is not None:
         return lib.ik_install_traps() == 0
     return False
+
+
+def restore_traps() -> bool:
+    """Restore default signal dispositions (undo install_traps): a
+    disarmed process must behave like an untouched one — the trap
+    handler hard-exits with code 2, which turns benign teardown-time
+    signals into truncated-output deaths."""
+    lib = _try_load()
+    if lib is not None:
+        return lib.ik_restore_traps() == 0
+    # no native traps were ever installed on this path; the Python
+    # SIGALRM fallback is owned (saved + restored) by guard.chopsigs/
+    # guard.disarm — nothing to undo here
+    return True
 
 
 def watchdog(seconds: int) -> None:

@@ -44,14 +44,40 @@ static void trap_handler(int sig) {
   _exit(2);
 }
 
+static const int kTrapSigs[] = {SIGBUS, SIGSEGV, SIGILL,
+                                SIGSYS, SIGFPE, SIGALRM};
+#define IK_NTRAPS (sizeof(kTrapSigs) / sizeof(kTrapSigs[0]))
+static struct sigaction g_saved[IK_NTRAPS];
+static int g_saved_valid = 0;
+
 int ik_install_traps(void) {
   struct sigaction sa;
   sa.sa_handler = trap_handler;
   sigemptyset(&sa.sa_mask);
   sa.sa_flags = 0;
-  const int sigs[] = {SIGBUS, SIGSEGV, SIGILL, SIGSYS, SIGFPE, SIGALRM};
-  for (size_t i = 0; i < sizeof(sigs) / sizeof(sigs[0]); ++i)
-    if (sigaction(sigs[i], &sa, NULL) != 0) return -1;
+  for (size_t i = 0; i < IK_NTRAPS; ++i)
+    if (sigaction(kTrapSigs[i], &sa,
+                  g_saved_valid ? NULL : &g_saved[i]) != 0)
+      return -1;
+  g_saved_valid = 1;
+  return 0;
+}
+
+/* Undo ik_install_traps: put back the dispositions that were active
+ * before the FIRST install (repeat installs don't clobber the saved
+ * set), so a host process keeps its own handlers — e.g. pytest's
+ * faulthandler — instead of being forced to SIG_DFL. A disarmed
+ * process must behave like an untouched one: the trap handler
+ * hard-exits, which turns benign teardown-time signals into a
+ * truncated-output death (observed: the full suite "failing" with
+ * exit 2 after every test passed). */
+int ik_restore_traps(void) {
+  if (!g_saved_valid) return 0;
+  for (size_t i = 0; i < IK_NTRAPS; ++i)
+    if (sigaction(kTrapSigs[i], &g_saved[i], NULL) != 0) return -1;
+  /* a new install/restore pair must re-snapshot, or it would reinstate
+   * this (now stale) set over handlers installed in between */
+  g_saved_valid = 0;
   return 0;
 }
 

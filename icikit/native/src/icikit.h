@@ -21,6 +21,9 @@ extern "C" {
 /* guard.cc — signal traps + runaway-job watchdog (reference chopsigs_,
  * utilities.cc:49-58). Returns 0 on success. */
 int ik_install_traps(void);
+
+/* Restore default signal dispositions (undo ik_install_traps). */
+int ik_restore_traps(void);
 /* Arm (or re-arm) the watchdog alarm; 0 disarms (reference alarm(sleep_time),
  * utilities.cc:57). */
 void ik_watchdog(unsigned seconds);

@@ -16,11 +16,17 @@ from __future__ import annotations
 DEFAULT_TIMEOUT_S = 1200
 DEBUG_TIMEOUT_S = 120
 
+# The Python-fallback SIGALRM handler that chopsigs displaced (restored
+# by disarm); a sentinel distinguishes "fallback never installed".
+_NO_SAVED = object()
+_saved_py_alarm = _NO_SAVED
+
 
 def chopsigs(timeout_s: int = DEFAULT_TIMEOUT_S) -> bool:
     """Install fatal-signal traps and arm the watchdog. Returns True if
     the native trap path is active (False means only the alarm is armed,
     via Python's signal module)."""
+    global _saved_py_alarm
     from icikit import native
 
     ok = native.install_traps()
@@ -32,13 +38,29 @@ def chopsigs(timeout_s: int = DEFAULT_TIMEOUT_S) -> bool:
             raise TimeoutError(
                 f"icikit watchdog: run exceeded {timeout_s} s")
 
-        signal.signal(signal.SIGALRM, _alarm)
+        prev = signal.signal(signal.SIGALRM, _alarm)
+        if _saved_py_alarm is _NO_SAVED:  # keep the pre-first snapshot
+            _saved_py_alarm = prev
     native.watchdog(timeout_s)
     return ok
 
 
 def disarm() -> None:
-    """Cancel the watchdog (for interactive use after a guarded run)."""
+    """Cancel the watchdog and restore the signal dispositions that were
+    active before ``chopsigs``.
+
+    Restoring matters as much as cancelling: the trap handler
+    hard-exits (the reference's MPI_Abort discipline), and a process
+    that finished its guarded run must stop treating teardown-time
+    signals — which a default process never notices — as fatal.
+    """
+    global _saved_py_alarm
     from icikit import native
 
     native.watchdog(0)
+    native.restore_traps()
+    if _saved_py_alarm is not _NO_SAVED:
+        import signal
+
+        signal.signal(signal.SIGALRM, _saved_py_alarm)
+        _saved_py_alarm = _NO_SAVED

@@ -51,3 +51,47 @@ def mesh1():
 def _check_devices():
     assert jax.device_count() >= 8, (
         "expected >= 8 simulated CPU devices; XLA_FLAGS not applied?")
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _clear_jax_caches_between_modules():
+    """Drop compiled-program caches at each module boundary: with ~580
+    tests in one process the accumulated executables/tracing caches
+    drove the XLA:CPU compiler into a segfault near the end of the
+    suite (reproducibly, in a test that passes standalone). Costs some
+    recompilation; buys a bounded memory profile."""
+    yield
+    import gc
+
+    jax.clear_caches()
+    gc.collect()
+
+
+_EXIT_STATUS = [0]
+
+
+@pytest.hookimpl(trylast=True)
+def pytest_sessionfinish(session, exitstatus):
+    _EXIT_STATUS[0] = int(exitstatus)
+
+
+@pytest.hookimpl(trylast=True)
+def pytest_unconfigure(config):
+    """Skip interpreter teardown: with ~580 tests in one process the
+    XLA:CPU runtime segfaults on shutdown (exit 139 — and, before
+    guard.disarm() restored signal dispositions, the trap handler's
+    exit 2 with truncated output — after every test passed). By
+    unconfigure the terminal summary has printed; trylast lets other
+    plugins' unconfigure finalizers (log files, coverage) complete
+    first, then exit with pytest's own status before the faulty
+    destructors run. Escape hatch: ICIKIT_NO_EARLY_EXIT=1 restores
+    normal interpreter shutdown."""
+    if os.environ.get("ICIKIT_NO_EARLY_EXIT"):
+        return
+    import logging
+    import sys
+
+    logging.shutdown()
+    sys.stdout.flush()
+    sys.stderr.flush()
+    os._exit(_EXIT_STATUS[0])

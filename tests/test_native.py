@@ -140,6 +140,26 @@ def test_watchdog_hard_kills_runaway():
     assert "watchdog" in r.stderr or "ERROR" in r.stderr
 
 
+def test_disarm_restores_default_dispositions():
+    # After a guarded run disarms, the process must stop treating
+    # signals as icikit-fatal: a raised SIGALRM should produce the
+    # *default* death (killed by signal 14), not the trap handler's
+    # _exit(2) + diagnostic. Leaving the handler installed turned
+    # teardown-time signals into truncated-output suite deaths.
+    code = textwrap.dedent("""
+        import os, signal
+        from icikit.utils.guard import chopsigs, disarm
+        chopsigs(600)
+        disarm()
+        os.kill(os.getpid(), signal.SIGALRM)
+        print("SHOULD-NOT-PRINT")
+    """)
+    r = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                       text=True, timeout=120, cwd="/root/repo")
+    assert r.returncode == -14, r  # default SIGALRM death
+    assert "icikit terminated" not in r.stderr, r.stderr
+
+
 def test_load_dataset_uses_native_path(tmp_path):
     ds = generate_dataset(16, "easy", seed=61)
     path = tmp_path / "g.dat"

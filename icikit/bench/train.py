@@ -152,7 +152,10 @@ def run_bench(preset: str, dp: int, tp: int, sp: int, batch: int,
         return jax.lax.fori_loop(0, steps, body,
                                  (params, opt_state, loss))
 
-    multi_j = jax.jit(multi)
+    # donate the carried state: without it the loop holds two full
+    # copies of params+opt_state, which is the difference between b=16
+    # fitting and ResourceExhausted at the base preset
+    multi_j = jax.jit(multi, donate_argnums=(0, 1))
     params, opt_state, loss = multi_j(params, opt_state)  # compile+warm
     fence(loss)  # loss reported from this run; timing continues from it
     res = timeit_chained(multi_j, (params, opt_state),

@@ -122,7 +122,10 @@ def timeit_chained(fn, args: tuple, chain, runs: int = 10,
         # operands' devices), not the process default — a CPU mesh in a
         # TPU-default process still needs the small-window guard
         platform = jax.default_backend()
-        for leaf in jax.tree_util.tree_leaves(args):
+        # sniff from the live chained state, not the original args: a
+        # donating fn has already consumed (deleted) the args buffers
+        # by the time the warmup above ran
+        for leaf in jax.tree_util.tree_leaves(state["cur"]):
             devs = getattr(leaf, "devices", None)
             if callable(devs):
                 ds = devs()

@@ -40,6 +40,13 @@ from icikit.ops.attention import NEG_INF, dense_attention, masked_logits
 
 _BLOCKS = (1024, 512, 256, 128, 64, 32, 16, 8)
 
+# Base-2 softmax constants: the kernels fold log2(e) into the logit
+# scale so the per-element transcendental is exp2, and convert the
+# emitted lse back to nats. The forward statistics and the backward
+# probability recompute must share the same fold — single-source it.
+_LOG2E = 1.4426950408889634
+_LN2 = 0.6931471805599453
+
 
 def _out_struct(shape, dtype, *operands):
     """ShapeDtypeStruct carrying the union of the operands' varying
@@ -146,16 +153,22 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s, acc,
     @pl.when(run)
     def _():
         q, k, v = q_ref[0, 0], k_ref[0, 0], v_ref[0, 0]
+        # base-2 softmax: fold log2(e) into the logit scale (free — the
+        # scale multiply exists anyway) so the transcendental is exp2,
+        # skipping exp's internal x*log2(e) pass on every tile element.
+        # All statistics live in base-2 space; the emitted lse converts
+        # back to nats at the end.
         s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                            preferred_element_type=jnp.float32) * scale
+                            preferred_element_type=jnp.float32
+                            ) * (scale * _LOG2E)
         if bias_s:
             s = s + _mask_bias(bias_s[0], iq, ik, bq)
         elif causal:
             s = _causal_mask(s, iq, ik, bq, bk)
         m_prev = m_s[:]                              # (bq, 128), lane-dup
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
-        alpha = jnp.exp(m_prev - m_new)
-        w = jnp.exp(s - m_new[:, :1])
+        alpha = jnp.exp2(m_prev - m_new)
+        w = jnp.exp2(s - m_new[:, :1])
         l_s[:] = l_s[:] * alpha + jnp.sum(w, axis=1, keepdims=True)
         acc[:] = acc[:] * alpha[:, :1] + lax.dot_general(
             w.astype(v.dtype), v, (((1,), (0,)), ((), ())),
@@ -165,7 +178,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s, acc,
     @pl.when(ik == nk - 1)
     def _():
         o_ref[0, 0] = (acc[:] / l_s[:, :1]).astype(o_ref.dtype)
-        lse_ref[0, 0, 0] = m_s[:, 0] + jnp.log(l_s[:, 0])
+        # ln sum(e^z) = m2*ln2 + ln(l) with m2 = max in base-2 space
+        lse_ref[0, 0, 0] = (m_s[:, 0] * _LN2
+                            + jnp.log(l_s[:, 0]))
 
 
 def _fwd_call(qt, kt, vt, causal, scale, bq, bk, interpret):
@@ -221,12 +236,16 @@ def _fwd_call(qt, kt, vt, causal, scale, bq, bk, interpret):
 # --------------------------------------------------------------- backward
 
 def _p_tile(q, k, lse, iq, ik, bq, bk, scale, causal):
-    """Recompute the probability tile exp(s·scale − lse) in fp32."""
+    """Recompute the probability tile exp(s·scale − lse) in fp32 —
+    in base-2 space (cf. the forward): the log2(e) factor folds into
+    the existing scale multiply and a per-row lse conversion, so the
+    per-element transcendental is a bare exp2."""
     s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                        preferred_element_type=jnp.float32) * scale
+                        preferred_element_type=jnp.float32
+                        ) * (scale * _LOG2E)
     if causal:
         s = _causal_mask(s, iq, ik, bq, bk)
-    return jnp.exp(s - lse[:, None])
+    return jnp.exp2(s - (lse * _LOG2E)[:, None])
 
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, dq_ref,

@@ -120,11 +120,13 @@ def run_bench(preset: str, dp: int, tp: int, batch: int, prompt_len: int,
     ctr = [0]
 
     def chain(a, out):
-        # greedy decode can reach a fixed point (a collapsed repeated
-        # token regenerating itself), which would make later runs
-        # value-identical — the replay-cacheable pattern chaining
-        # exists to prevent. One host-side counter token per run keeps
-        # every prompt distinct regardless.
+        # ``out`` is (B, prompt_len + n_new) — prompt followed by the
+        # continuation — so the tail slice is a valid (B, prompt_len)
+        # refresh for any n_new >= 1. Greedy decode can reach a fixed
+        # point (a collapsed repeated token regenerating itself), which
+        # would make later runs value-identical — the replay-cacheable
+        # pattern chaining exists to prevent. One host-side counter
+        # token per run keeps every prompt distinct regardless.
         ctr[0] += 1
         return (out[:, -prompt_len:].at[0, 0].set(ctr[0] % cfg.vocab),)
 

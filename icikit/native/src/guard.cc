@@ -55,11 +55,26 @@ int ik_install_traps(void) {
   sa.sa_handler = trap_handler;
   sigemptyset(&sa.sa_mask);
   sa.sa_flags = 0;
+  /* Snapshot every old disposition before installing any: a partial
+   * install that failed midway must not leave g_saved half-filled, or
+   * a later successful install would snapshot the trap handler itself
+   * and ik_restore_traps would "restore" it instead of the original. */
+  struct sigaction old[IK_NTRAPS];
+  if (!g_saved_valid)
+    for (size_t i = 0; i < IK_NTRAPS; ++i)
+      if (sigaction(kTrapSigs[i], NULL, &old[i]) != 0) return -1;
   for (size_t i = 0; i < IK_NTRAPS; ++i)
-    if (sigaction(kTrapSigs[i], &sa,
-                  g_saved_valid ? NULL : &g_saved[i]) != 0)
+    if (sigaction(kTrapSigs[i], &sa, NULL) != 0) {
+      /* roll back the prefix already replaced */
+      if (!g_saved_valid)
+        for (size_t j = 0; j < i; ++j)
+          sigaction(kTrapSigs[j], &old[j], NULL);
       return -1;
-  g_saved_valid = 1;
+    }
+  if (!g_saved_valid) {
+    for (size_t i = 0; i < IK_NTRAPS; ++i) g_saved[i] = old[i];
+    g_saved_valid = 1;
+  }
   return 0;
 }
 

@@ -202,6 +202,12 @@ def _fwd_call(qt, kt, vt, causal, scale, bq, bk, interpret):
             ib, ih, jnp.minimum(ik, _last_valid_k(iq, bq, bk)), 0)
     else:
         k_at = lambda ib, ih, iq, ik: (ib, ih, ik, 0)  # noqa: E731
+    # NOTE: the bias scratch is initialized only at the single global
+    # first grid step (_init_mask_bias) and read by every later (b, h,
+    # iq, ik) step. That is safe because this grid uses the default
+    # 'arbitrary' (serial) dimension semantics; if any grid dimension is
+    # ever marked parallel / megacore-partitioned (v4/v5p), the init
+    # must move to per-(b, h) first steps ((iq == 0) & (ik == 0)).
     return pl.pallas_call(
         kernel,
         grid=(b, h, nq, nk),

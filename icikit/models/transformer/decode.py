@@ -148,7 +148,7 @@ def _build_generate(mesh, cfg: TransformerConfig, s_prompt: int, n_new: int,
 
     def logits_last(params, x_last):
         h = _rms_norm(x_last, params["ln_f"])
-        lg = jnp.einsum("bd,dv->bv", h.astype(cdt),
+        lg = jnp.einsum("bd,vd->bv", h.astype(cdt),
                         params["w_out"].astype(cdt)).astype(jnp.float32)
         if cfg.vocab_parallel:
             # Reassemble the full row by scattering the local shard

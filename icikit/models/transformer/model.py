@@ -105,6 +105,18 @@ class TransformerConfig:
     # re-shard ("xla" or any registered hand-rolled schedule).
     sequence_schedule: str = "ring"
     sp_algorithm: str = "xla"
+    # Unroll factor for the layer scan (lax.scan unroll=): >1 trades
+    # compile time and code size for fewer loop-carried dynamic slices
+    # of the stacked layer params. Measured on v5e (base preset):
+    # unroll=2 REGRESSES 117 -> 97 TF/s (VMEM pressure breaks the
+    # scheduler's overlap) — keep 1 unless re-measured.
+    scan_unroll: int = 1
+    # Fused softmax-xent head (ops/xent.py): stream vocab chunks of the
+    # logits through VMEM instead of materializing the (T, V) fp32
+    # logits in HBM. Auto-falls back to the unfused log_softmax path
+    # when the tiling doesn't cover the shape (or vocab_parallel=True,
+    # whose distributed head is its own fused path).
+    fused_head: bool = True
 
 
 def make_model_mesh(n_devices: int | None = None, dp: int = 1, tp: int = 1,
@@ -193,8 +205,13 @@ def param_specs(cfg: TransformerConfig) -> dict:
         "emb": P(),
         "ln1": P(), "ln2": P(), "ln_f": P(),
         "wo": P(None, TP_AXIS, None, None),          # (L, H, Dh, D)
-        "w_out": (P(None, TP_AXIS) if cfg.vocab_parallel
-                  else P()),                         # (D, V)
+        # w_out is stored (V, D) — same physical layout as the
+        # embedding — so the optimizer update and the fused-xent dw
+        # stream it at roofline (the (D, V) orientation's transposed
+        # dw made adam on the head run ~4x its roofline; round-3
+        # profile). Vocab-parallel shards the leading vocab dim.
+        "w_out": (P(TP_AXIS, None) if cfg.vocab_parallel
+                  else P()),                         # (V, D)
     }
     if _is_gqa(cfg):
         specs["wq"] = P(None, None, TP_AXIS, None)   # (L, D, H, Dh)
@@ -234,7 +251,7 @@ def init_params(key, cfg: TransformerConfig, mesh: Mesh) -> dict:
         "ln2": jnp.ones((L, D), jnp.float32),
         "ln_f": jnp.ones((D,), jnp.float32),
         "wo": norm(ks[3], (L, H, Dh, D), H * Dh),
-        "w_out": norm(ks[6], (D, cfg.vocab), D),
+        "w_out": norm(ks[6], (cfg.vocab, D), D),
     }
     if _is_gqa(cfg):
         kq, kkv = jax.random.split(ks[2])
@@ -343,9 +360,12 @@ def _maybe_remat(layer, cfg: TransformerConfig):
 
 
 def _forward_local(params, tokens, cfg: TransformerConfig, p_sp: int,
-                   p_dp: int):
+                   p_dp: int, head: str = "logits"):
     """Per-shard forward: tokens (b_loc, s_loc) -> (logits fp32,
-    summed MoE aux loss).
+    summed MoE aux loss); with ``head="hidden"`` returns the final
+    normed hidden state (b, s, D) in compute dtype instead — the
+    fused-xent loss path consumes that directly and never materializes
+    logits.
 
     Activations are replicated over tp (every psum over tp closes a
     column->row parallel pair), batch-local over dp, sequence-local
@@ -438,9 +458,12 @@ def _forward_local(params, tokens, cfg: TransformerConfig, p_sp: int,
         scan_body = _maybe_remat(layer, cfg)
 
     layer_params = {k: params[k] for k in _layer_keys(cfg)}
-    x, auxes = lax.scan(scan_body, x, layer_params)
-    x = _rms_norm(x, params["ln_f"])
-    logits = jnp.einsum("bsd,dv->bsv", x.astype(cdt),
+    x, auxes = lax.scan(scan_body, x, layer_params,
+                        unroll=cfg.scan_unroll)
+    x = _rms_norm(x, params["ln_f"]).astype(cdt)
+    if head == "hidden":
+        return x, auxes.sum()
+    logits = jnp.einsum("bsd,vd->bsv", x,
                         params["w_out"].astype(cdt)).astype(jnp.float32)
     return logits, auxes.sum()
 
@@ -466,14 +489,35 @@ def _vocab_parallel_nll(logits, targets):
     return m + jnp.log(z) - tgt_logit                          # (b, s)
 
 
+def _use_fused_head(cfg, b: int, s: int) -> bool:
+    if not cfg.fused_head or cfg.vocab_parallel:
+        return False
+    from icikit.ops.xent import xent_supported
+    return xent_supported(b * s, cfg.d_model, cfg.vocab,
+                          jnp.dtype(cfg.compute_dtype))
+
+
 def _local_loss(params, tokens, targets, cfg, p_sp, p_dp, p_tp, denom):
-    logits, aux = _forward_local(params, tokens, cfg, p_sp, p_dp)
-    if cfg.vocab_parallel:
-        nll = _vocab_parallel_nll(logits, targets)
+    b, s = tokens.shape
+    if _use_fused_head(cfg, b, s):
+        from icikit.ops.xent import fused_xent
+        h, aux = _forward_local(params, tokens, cfg, p_sp, p_dp,
+                                head="hidden")
+        cdt = h.dtype
+        # explicit replication-lift: the custom-vjp kernel returns a
+        # dp/sp-varying dw, so the usual auto-pvary (whose transpose is
+        # the cross-shard gradient psum) must be placed by hand
+        w = lax.pvary(params["w_out"].astype(cdt), (DP_AXIS, SP_AXIS))
+        nll = fused_xent(h.reshape(b * s, cfg.d_model), w,
+                         targets.reshape(b * s)).reshape(b, s)
     else:
-        logp = jax.nn.log_softmax(logits, axis=-1)
-        nll = -jnp.take_along_axis(logp, targets[..., None],
-                                   axis=-1)[..., 0]
+        logits, aux = _forward_local(params, tokens, cfg, p_sp, p_dp)
+        if cfg.vocab_parallel:
+            nll = _vocab_parallel_nll(logits, targets)
+        else:
+            logp = jax.nn.log_softmax(logits, axis=-1)
+            nll = -jnp.take_along_axis(logp, targets[..., None],
+                                       axis=-1)[..., 0]
     # aux is a per-shard mean-style penalty; dividing by the number of
     # dp x sp shards makes the final psum over (dp, sp) an average.
     loss = nll.sum() / denom + cfg.moe_aux_coef * aux / (p_dp * p_sp)

@@ -156,7 +156,7 @@ def _build_pp_loss_and_grad(mesh, cfg: TransformerConfig, n_microbatches: int,
             j = t - (p - 1)
             if 0 <= j < m:  # microbatch j exits at the last stage
                 h = _rms_norm(x, params["ln_f"])
-                logits = jnp.einsum("bsd,dv->bsv", h.astype(cdt),
+                logits = jnp.einsum("bsd,vd->bsv", h.astype(cdt),
                                     params["w_out"].astype(cdt)
                                     ).astype(jnp.float32)
                 logp = jax.nn.log_softmax(logits, axis=-1)

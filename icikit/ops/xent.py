@@ -1,0 +1,261 @@
+"""Pallas TPU fused softmax cross-entropy head (vocab-chunked).
+
+Why this exists: the unfused head computes ``logits = x @ w`` (b·s, V),
+casts them to fp32 (1 GB at the base preset), runs ``log_softmax`` over
+them (two more full reads plus an fp32 write), gathers the target
+column, and in the backward materializes fp32 ``dlogits`` of the same
+size — ~5 GB of HBM traffic that exists only because the (T, V) logits
+matrix is materialized between the head matmul and the loss. This
+kernel streams vocab chunks of the logits through VMEM against a
+resident x block, carrying online max / sum-exp statistics in scratch
+(the flash-attention construction applied to the classifier head), so
+per-token ``lse`` and the target logit come out of one pass and the
+full logits never touch HBM.
+
+The backward recomputes each logits chunk from (x, w, lse) and writes
+the single matrix the gradient matmuls actually need — ``g = (softmax −
+onehot) · dnll`` — in bf16; ``dx = g @ w`` and ``dw = gᵀ @ x`` are then
+plain MXU matmuls. The head weight is taken **(V, D)** — embedding
+orientation — so both cotangents come out in their params' natural
+layouts (the (D, V) orientation produced a transposed-layout ``dw``
+that made the optimizer update on the head run ~4× its roofline;
+round-3 profile notes in ROADMAP.md).
+
+Numerics: the matmuls accumulate fp32 on the MXU; softmax statistics
+are fp32 in base-2 space (log2(e) folds into one VPU multiply per tile,
+the per-element transcendental is a bare ``exp2`` — same recipe as
+``flash_attention``). Reference lineage: the reference has no ML head;
+this is the TPU-first replacement for the L4-driver pattern of
+"compute, verify, reduce" applied to the training loss
+(``Parallel-Sorting/src/psort.cc:497-520`` is the analogous fused
+check-while-reducing pass).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_LOG2E = 1.4426950408889634
+_LN2 = 0.6931471805599453
+
+# Default tile geometry. bt rows of x stay resident while bv-wide vocab
+# chunks stream; (bt, bv) = (1024, 2048) puts the fp32 score tile at
+# 8 MB and the streamed w tile at 4 MB bf16 — comfortably double-
+# buffered under a 64 MB scoped-VMEM budget.
+BLOCK_T = 1024
+BLOCK_V = 2048
+
+
+def _out_struct(shape, dtype, *operands):
+    """ShapeDtypeStruct carrying the union of the operands' varying
+    mesh axes (composes with shard_map's replication checking)."""
+    vma = frozenset()
+    for x in operands:
+        vma = vma | getattr(jax.typeof(x), "vma", frozenset())
+    try:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    except TypeError:  # older jax: no vma argument, no check either
+        return jax.ShapeDtypeStruct(shape, dtype)
+
+
+def _fwd_kernel(x_ref, w_ref, t_ref, lse_ref, tgt_ref, m_s, l_s, t_s,
+                *, nv, bv):
+    iv = pl.program_id(1)
+
+    @pl.when(iv == 0)
+    def _():
+        m_s[:] = jnp.full_like(m_s, -jnp.inf)
+        l_s[:] = jnp.zeros_like(l_s)
+        t_s[:] = jnp.zeros_like(t_s)
+
+    # the always-true guard keeps the interpret-mode vma discharge
+    # happy under shard_map (bare stores trip its dynamic_slice
+    # varying-manual-axes check; real-TPU lowering is unaffected)
+    @pl.when(iv >= 0)
+    def _():
+        x, w = x_ref[:], w_ref[:]
+        s = lax.dot_general(x, w, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32)  # (bt, bv)
+        # target-logit extraction in natural units, pre base-2 scale
+        tgt = t_ref[0, 0, :][:, None]                        # (bt, 1)
+        cols = iv * bv + lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        t_s[:] += jnp.sum(jnp.where(cols == tgt, s, 0.0), axis=1,
+                          keepdims=True)
+        sb = s * _LOG2E                                      # base-2
+        m_prev = m_s[:]
+        m_new = jnp.maximum(m_prev, jnp.max(sb, axis=1, keepdims=True))
+        l_s[:] = l_s[:] * jnp.exp2(m_prev - m_new) + jnp.sum(
+            jnp.exp2(sb - m_new), axis=1, keepdims=True)
+        m_s[:] = m_new
+
+    @pl.when(iv == nv - 1)
+    def _():
+        lse = (m_s[:] + jnp.log2(l_s[:])) * _LN2             # nats
+        lse_ref[0, 0, :] = lse[:, 0]
+        tgt_ref[0, 0, :] = t_s[:][:, 0]
+
+
+def _bwd_kernel(x_ref, w_ref, t_ref, lse_ref, dnll_ref, g_ref, *, bv):
+    iv = pl.program_id(1)
+
+    @pl.when(iv >= 0)  # always true; see the forward kernel's note
+    def _():
+        x, w = x_ref[:], w_ref[:]
+        s = lax.dot_general(x, w, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32)  # (bt, bv)
+        lse_b2 = (lse_ref[0, 0, :] * _LOG2E)[:, None]        # (bt, 1)
+        p = jnp.exp2(s * _LOG2E - lse_b2)                    # softmax
+        tgt = t_ref[0, 0, :][:, None]
+        cols = iv * bv + lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        onehot = (cols == tgt).astype(jnp.float32)
+        g = (p - onehot) * dnll_ref[0, 0, :][:, None]
+        g_ref[:] = g.astype(g_ref.dtype)
+
+
+def _tiles(t, v, block_t, block_v):
+    bt = min(block_t, t)
+    bv = min(block_v, v)
+    if t % bt or v % bv:
+        return None
+    return bt, bv
+
+
+def _fwd_call(x, w, targets, bt, bv, interpret):
+    t, d = x.shape
+    v = w.shape[0]
+    nt, nv = t // bt, v // bv
+    # row-vector operands ride as (nt, 1, bt): Mosaic requires the
+    # last two block dims to divide (8, 128) or equal the array dims —
+    # a size-1 middle dim satisfies the sublane rule exactly.
+    t2 = targets.reshape(nt, 1, bt)
+    lse2, tgt2 = pl.pallas_call(
+        partial(_fwd_kernel, nv=nv, bv=bv),
+        grid=(nt, nv),
+        in_specs=[
+            pl.BlockSpec((bt, d), lambda it, iv: (it, 0)),
+            pl.BlockSpec((bv, d), lambda it, iv: (iv, 0)),
+            pl.BlockSpec((1, 1, bt), lambda it, iv: (it, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, bt), lambda it, iv: (it, 0, 0)),
+            pl.BlockSpec((1, 1, bt), lambda it, iv: (it, 0, 0)),
+        ],
+        out_shape=[
+            _out_struct((nt, 1, bt), jnp.float32, x, w, targets),
+            _out_struct((nt, 1, bt), jnp.float32, x, w, targets),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bt, 1), jnp.float32),   # running max (base-2)
+            pltpu.VMEM((bt, 1), jnp.float32),   # running sum-exp
+            pltpu.VMEM((bt, 1), jnp.float32),   # target logit (nats)
+        ],
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=64 * 1024 * 1024),
+        interpret=interpret,
+    )(x, w, t2)
+    return lse2.reshape(t), tgt2.reshape(t)
+
+
+def _g_call(x, w, targets, lse, dnll, bt, bv, interpret):
+    t, d = x.shape
+    v = w.shape[0]
+    nt, nv = t // bt, v // bv
+    return pl.pallas_call(
+        partial(_bwd_kernel, bv=bv),
+        grid=(nt, nv),
+        in_specs=[
+            pl.BlockSpec((bt, d), lambda it, iv: (it, 0)),
+            pl.BlockSpec((bv, d), lambda it, iv: (iv, 0)),
+            pl.BlockSpec((1, 1, bt), lambda it, iv: (it, 0, 0)),
+            pl.BlockSpec((1, 1, bt), lambda it, iv: (it, 0, 0)),
+            pl.BlockSpec((1, 1, bt), lambda it, iv: (it, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((bt, bv), lambda it, iv: (it, iv)),
+        out_shape=_out_struct((t, v), x.dtype, x, w, targets, lse, dnll),
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=64 * 1024 * 1024),
+        interpret=interpret,
+    )(x, w, targets.reshape(nt, 1, bt), lse.reshape(nt, 1, bt),
+      dnll.reshape(nt, 1, bt))
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _xent(x, w, targets, bt, bv, interpret):
+    lse, tgt = _fwd_call(x, w, targets, bt, bv, interpret)
+    return lse - tgt
+
+
+def _xent_fwd(x, w, targets, bt, bv, interpret):
+    lse, tgt = _fwd_call(x, w, targets, bt, bv, interpret)
+    return lse - tgt, (x, w, targets, lse)
+
+
+def _xent_bwd(bt, bv, interpret, res, dnll):
+    x, w, targets, lse = res
+    g = _g_call(x, w, targets, lse, dnll.astype(jnp.float32), bt, bv,
+                interpret)
+    # dx: (T, V) @ (V, D) — contract vocab; dw: (T, V)ᵀ @ (T, D) —
+    # contract tokens; both land in their params' natural layouts.
+    dx = lax.dot_general(g, w, (((1,), (0,)), ((), ())),
+                         preferred_element_type=jnp.float32)
+    dw = lax.dot_general(g, x, (((0,), (0,)), ((), ())),
+                         preferred_element_type=jnp.float32)
+    return dx.astype(x.dtype), dw.astype(w.dtype), None
+
+
+_xent.defvjp(_xent_fwd, _xent_bwd)
+
+
+def xent_supported(t: int, d: int, v: int, dtype,
+                   block_t: int = BLOCK_T, block_v: int = BLOCK_V):
+    """Whether the fused head covers this shape/backend (else callers
+    should take the unfused log_softmax path)."""
+    if jnp.dtype(dtype) not in (jnp.bfloat16, jnp.float32):
+        return False
+    if jax.default_backend() not in ("tpu", "cpu"):
+        return False
+    if d % 128 or _tiles(t, v, block_t, block_v) is None:
+        return False
+    return True
+
+
+def fused_xent(x: jax.Array, w: jax.Array, targets: jax.Array,
+               block_t: int = BLOCK_T, block_v: int = BLOCK_V,
+               interpret: bool | None = None) -> jax.Array:
+    """Per-token cross-entropy ``-log softmax(x @ w)[target]``.
+
+    Args:
+      x: ``(T, D)`` activations (bf16 or f32).
+      w: ``(V, D)`` head weights (embedding orientation), same dtype.
+      targets: ``(T,)`` int32 class ids in ``[0, V)``.
+
+    Returns:
+      ``(T,)`` fp32 NLL per token, numerically equal to the unfused
+      ``-take_along_axis(log_softmax(x @ w), targets)`` up to fp32
+      reassociation. Differentiable in ``x`` and ``w``; the ``w``
+      cotangent accumulates in fp32 and is cast to ``w.dtype`` once.
+
+    Raises ``ValueError`` for shapes the tiling cannot cover — callers
+    gate on :func:`xent_supported`.
+    """
+    t, d = x.shape
+    v = w.shape[0]
+    if w.shape[1] != d or targets.shape != (t,):
+        raise ValueError(f"shape mismatch: x {x.shape}, w {w.shape}, "
+                         f"targets {targets.shape}")
+    tiles = _tiles(t, v, block_t, block_v)
+    if tiles is None or d % 128:
+        raise ValueError(
+            f"fused xent needs T divisible by min(block_t={block_t}, T), "
+            f"V divisible by min(block_v={block_v}, V) and D % 128 == 0; "
+            f"got T={t} D={d} V={v} (use the unfused path)")
+    bt, bv = tiles
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    return _xent(x, w, targets.astype(jnp.int32), bt, bv, bool(interpret))

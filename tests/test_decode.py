@@ -38,7 +38,7 @@ def _oracle_continue(params, prompt, n_new):
             u = jax.nn.gelu(jnp.einsum("bsd,df->bsf", h2, p["w1"][li]))
             x = x + jnp.einsum("bsf,fd->bsd", u, p["w2"][li])
         x = _rms_norm(x, p["ln_f"])
-        logits = jnp.einsum("bd,dv->bv", x[:, -1], p["w_out"])
+        logits = jnp.einsum("bd,vd->bv", x[:, -1], p["w_out"])
         nxt = jnp.argmax(logits, axis=-1).astype(toks.dtype)
         toks = jnp.concatenate([toks, nxt[:, None]], axis=1)
     return np.asarray(toks)

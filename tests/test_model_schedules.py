@@ -87,7 +87,7 @@ def _moe_oracle_continue(params, prompt, cfg, n_new):
                 y, expert[..., None, None], axis=2)[:, :, 0]
             x = x + sel * gate[..., None]
         x = _rms_norm(x, p["ln_f"])
-        logits = jnp.einsum("bd,dv->bv", x[:, -1], p["w_out"])
+        logits = jnp.einsum("bd,vd->bv", x[:, -1], p["w_out"])
         nxt = jnp.argmax(logits, axis=-1).astype(toks.dtype)
         toks = jnp.concatenate([toks, nxt[:, None]], axis=1)
     return np.asarray(toks)

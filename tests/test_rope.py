@@ -110,7 +110,7 @@ def test_rope_decode_matches_reforward():
             u = jax.nn.gelu(jnp.einsum("bsd,df->bsf", h2, p["w1"][li]))
             x = x + jnp.einsum("bsf,fd->bsd", u, p["w2"][li])
         x = _rms_norm(x, p["ln_f"])
-        logits = jnp.einsum("bd,dv->bv", x[:, -1], p["w_out"])
+        logits = jnp.einsum("bd,vd->bv", x[:, -1], p["w_out"])
         nxt = jnp.argmax(logits, axis=-1).astype(toks.dtype)
         toks = jnp.concatenate([toks, nxt[:, None]], axis=1)
     np.testing.assert_array_equal(got, np.asarray(toks))

@@ -79,7 +79,7 @@ def test_loss_matches_dense_oracle():
                                    jnp.asarray(p["w1"][li])))
         x = x + jnp.einsum("bsf,fd->bsd", u, jnp.asarray(p["w2"][li]))
     x = _rms_norm(x, jnp.asarray(p["ln_f"]))
-    logits = jnp.einsum("bsd,dv->bsv", x, jnp.asarray(p["w_out"]))
+    logits = jnp.einsum("bsd,vd->bsv", x, jnp.asarray(p["w_out"]))
     logp = jax.nn.log_softmax(logits, axis=-1)
     want = float(-jnp.take_along_axis(
         logp, jnp.asarray(tgt)[..., None], axis=-1).mean())

@@ -54,7 +54,7 @@ def test_w_out_actually_sharded():
     mesh = make_model_mesh(dp=1, tp=4, sp=1)
     params = init_params(jax.random.key(0), cfg, mesh)
     spec = params["w_out"].sharding.spec
-    assert spec == P(None, "tp")
+    assert spec == P("tp", None)
 
 
 def test_decode_matches_replicated():

@@ -44,21 +44,9 @@ _BLOCKS = (1024, 512, 256, 128, 64, 32, 16, 8)
 # scale so the per-element transcendental is exp2, and convert the
 # emitted lse back to nats. The forward statistics and the backward
 # probability recompute must share the same fold — single-source it.
-_LOG2E = 1.4426950408889634
-_LN2 = 0.6931471805599453
-
-
-def _out_struct(shape, dtype, *operands):
-    """ShapeDtypeStruct carrying the union of the operands' varying
-    mesh axes, so pallas_call composes with shard_map's (default-on)
-    replication checking instead of forcing check_vma=False."""
-    vma = frozenset()
-    for x in operands:
-        vma = vma | getattr(jax.typeof(x), "vma", frozenset())
-    try:
-        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
-    except TypeError:  # older jax: no vma argument, no check either
-        return jax.ShapeDtypeStruct(shape, dtype)
+from icikit.ops.pallas_common import LN2 as _LN2
+from icikit.ops.pallas_common import LOG2E as _LOG2E
+from icikit.ops.pallas_common import out_struct as _out_struct
 
 
 def _pick_block(s: int) -> int | None:

@@ -1,0 +1,21 @@
+"""Shared plumbing for the Pallas kernels in this package."""
+
+from __future__ import annotations
+
+import jax
+
+LOG2E = 1.4426950408889634
+LN2 = 0.6931471805599453
+
+
+def out_struct(shape, dtype, *operands):
+    """ShapeDtypeStruct carrying the union of the operands' varying
+    mesh axes, so pallas_call composes with shard_map's (default-on)
+    replication checking instead of forcing check_vma=False."""
+    vma = frozenset()
+    for x in operands:
+        vma = vma | getattr(jax.typeof(x), "vma", frozenset())
+    try:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    except TypeError:  # older jax: no vma argument, no check either
+        return jax.ShapeDtypeStruct(shape, dtype)

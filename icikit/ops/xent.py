@@ -41,8 +41,9 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-_LOG2E = 1.4426950408889634
-_LN2 = 0.6931471805599453
+from icikit.ops.pallas_common import LN2 as _LN2
+from icikit.ops.pallas_common import LOG2E as _LOG2E
+from icikit.ops.pallas_common import out_struct as _out_struct
 
 # Default tile geometry. bt rows of x stay resident while bv-wide vocab
 # chunks stream; (bt, bv) = (1024, 2048) puts the fp32 score tile at
@@ -50,18 +51,6 @@ _LN2 = 0.6931471805599453
 # buffered under a 64 MB scoped-VMEM budget.
 BLOCK_T = 1024
 BLOCK_V = 2048
-
-
-def _out_struct(shape, dtype, *operands):
-    """ShapeDtypeStruct carrying the union of the operands' varying
-    mesh axes (composes with shard_map's replication checking)."""
-    vma = frozenset()
-    for x in operands:
-        vma = vma | getattr(jax.typeof(x), "vma", frozenset())
-    try:
-        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
-    except TypeError:  # older jax: no vma argument, no check either
-        return jax.ShapeDtypeStruct(shape, dtype)
 
 
 def _fwd_kernel(x_ref, w_ref, t_ref, lse_ref, tgt_ref, m_s, l_s, t_s,

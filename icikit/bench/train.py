@@ -33,9 +33,15 @@ PEAK_FLOPS = {
 PRESETS = {
     "tiny": dict(vocab=256, d_model=128, n_heads=4, d_head=32, d_ff=512,
                  n_layers=2, max_seq=128),
-    "small": dict(vocab=32768, d_model=512, n_heads=8, d_head=64,
+    # d_head = 128 everywhere: the MXU is a 128x128 systolic array, so
+    # QK^T (contraction = d_head) and PV (output width = d_head) both
+    # run at half rate at d_head = 64 — measured on v5e, d_head 64 -> 128
+    # at fixed d_model/params/FLOPs cut the attention kernel time ~2x.
+    # The TPU-native head size is 128; the reference has no ML models,
+    # so the preset owes nothing to a torch ancestor.
+    "small": dict(vocab=32768, d_model=512, n_heads=4, d_head=128,
                   d_ff=2048, n_layers=8, max_seq=1024),
-    "base": dict(vocab=32768, d_model=1024, n_heads=16, d_head=64,
+    "base": dict(vocab=32768, d_model=1024, n_heads=8, d_head=128,
                  d_ff=4096, n_layers=12, max_seq=1024),
 }
 

@@ -88,30 +88,37 @@ def _first_valid_q(ik, bq, bk):
     return (ik * bk) // bq
 
 
+def _tri_bias(bq, bk):
+    """The diagonal tile's additive causal mask: 0 where q >= k,
+    NEG_INF above — the single source for every kernel's bias init."""
+    qpos = lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+    kpos = lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    return jnp.where(qpos >= kpos, 0.0, NEG_INF)
+
+
 def _init_mask_bias(bias_s, iq, ik, bq, bk):
-    """Fill the (2·bq, bk) additive-mask scratch at the first grid step:
-    rows [0, bq) hold the diagonal tile's mask (0 where q >= k,
-    NEG_INF above the diagonal), rows [bq, 2·bq) hold zeros for
-    interior tiles. With square tiles (bq == bk) every
-    diagonal-crossing tile shares one relative pattern, so the per-tile
-    iota/compare/select collapses to one dynamic-slice add — worth ~10%
-    of the causal forward at long sequence on v5e."""
+    """Fill the (3·bq, bk) additive-mask scratch at the first grid step:
+    rows [0, bq) hold all-NEG_INF (tiles strictly above the diagonal —
+    reachable only as the upper half of a coarse K block that straddles
+    it), rows [bq, 2·bq) the diagonal tile's mask (0 where q >= k),
+    rows [2·bq, 3·bq) zeros for interior tiles. With square tiles
+    (bq == bk) every diagonal-crossing tile shares one relative
+    pattern, so the per-tile iota/compare/select collapses to one
+    dynamic-slice read folded into the scale fma."""
     first = ((pl.program_id(0) == 0) & (pl.program_id(1) == 0)
              & (iq == 0) & (ik == 0))
 
     @pl.when(first)
     def _():
-        qpos = lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-        kpos = lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-        bias_s[pl.ds(0, bq), :] = jnp.where(qpos >= kpos, 0.0, NEG_INF)
-        bias_s[pl.ds(bq, bq), :] = jnp.zeros((bq, bk), jnp.float32)
+        bias_s[pl.ds(0, bq), :] = jnp.full((bq, bk), NEG_INF, jnp.float32)
+        bias_s[pl.ds(bq, bq), :] = _tri_bias(bq, bk)
+        bias_s[pl.ds(2 * bq, bq), :] = jnp.zeros((bq, bk), jnp.float32)
 
 
 def _mask_bias(bias_s, iq, ik, bq):
-    """The additive mask for tile (iq, ik): diagonal pattern when
-    iq == ik, zeros when strictly interior (iq > ik; tiles above the
-    diagonal never execute)."""
-    idx = jnp.clip(iq - ik, 0, 1)
+    """The additive mask for tile (iq, ik): full mask above the
+    diagonal (iq < ik), diagonal pattern at iq == ik, zeros interior."""
+    idx = jnp.clip(iq - ik + 1, 0, 2)
     return bias_s[pl.ds(idx * bq, bq), :]
 
 
@@ -124,7 +131,16 @@ def _causal_mask(s, iq, ik, bq, bk):
 # ---------------------------------------------------------------- forward
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s, acc,
-                *bias_s, scale, causal, nk, bq, bk):
+                *bias_s, scale, causal, nk, bq, bk, ks):
+    """Streaming forward. Each grid step covers ``ks`` K sub-blocks of
+    width ``bk`` (one coarse DMA block of ks·bk rows), and each
+    sub-block lane j owns an INDEPENDENT (m, l, acc) accumulator bank
+    (rows [j·bq, (j+1)·bq) of the scratches), merged once at the final
+    store. Independent banks make the whole per-sub-block chain (dot →
+    mask → softmax → accumulate) data-independent across j, so Mosaic's
+    scheduler can run lane j+1's MXU dots underneath lane j's VPU
+    softmax — the ks = 1 structure serializes the two units, and a
+    shared accumulator would re-serialize them at every update."""
     iq, ik = pl.program_id(2), pl.program_id(3)
 
     if bias_s:  # square tiles: precompute the mask once as an additive
@@ -136,49 +152,144 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s, acc,
         l_s[:] = jnp.zeros_like(l_s)
         acc[:] = jnp.zeros_like(acc)
 
-    run = (ik * bk <= iq * bq + bq - 1) if causal else (ik >= 0)
+    if causal:  # any sub-block of the coarse block visible?
+        run = ik * (ks * bk) <= iq * bq + bq - 1
+    else:
+        run = ik >= 0
 
     @pl.when(run)
     def _():
-        q, k, v = q_ref[0, 0], k_ref[0, 0], v_ref[0, 0]
+        q = q_ref[0, 0]
         # base-2 softmax: fold log2(e) into the logit scale (free — the
         # scale multiply exists anyway) so the transcendental is exp2,
         # skipping exp's internal x*log2(e) pass on every tile element.
         # All statistics live in base-2 space; the emitted lse converts
         # back to nats at the end.
-        s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                            preferred_element_type=jnp.float32
-                            ) * (scale * _LOG2E)
-        if bias_s:
-            s = s + _mask_bias(bias_s[0], iq, ik, bq)
-        elif causal:
-            s = _causal_mask(s, iq, ik, bq, bk)
-        m_prev = m_s[:]                              # (bq, 128), lane-dup
-        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
-        alpha = jnp.exp2(m_prev - m_new)
-        w = jnp.exp2(s - m_new[:, :1])
-        l_s[:] = l_s[:] * alpha + jnp.sum(w, axis=1, keepdims=True)
-        acc[:] = acc[:] * alpha[:, :1] + lax.dot_general(
-            w.astype(v.dtype), v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        m_s[:] = m_new
+        for j in range(ks):
+            k = k_ref[0, 0, j * bk:(j + 1) * bk]
+            v = v_ref[0, 0, j * bk:(j + 1) * bk]
+            ikj = ik * ks + j  # sub-block column index
+            raw = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                  preferred_element_type=jnp.float32)
+            if bias_s:
+                # one fma: the scale multiply and the mask add fuse, so
+                # interior tiles (bias slice = zeros) pay nothing extra
+                s = (raw * (scale * _LOG2E)
+                     + _mask_bias(bias_s[0], iq, ikj, bq))
+            elif causal:
+                s = _causal_mask(raw * (scale * _LOG2E), iq, ikj, bq, bk)
+            else:
+                s = raw * (scale * _LOG2E)
+            rows = pl.ds(j * bq, bq)                 # bank j
+            m_prev = m_s[rows]                       # (bq, 128), lane-dup
+            m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+            alpha = jnp.exp2(m_prev - m_new)
+            w = jnp.exp2(s - m_new[:, :1])
+            l_s[rows] = l_s[rows] * alpha + jnp.sum(w, axis=1,
+                                                    keepdims=True)
+            acc[rows] = acc[rows] * alpha[:, :1] + lax.dot_general(
+                w.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            m_s[rows] = m_new
 
     @pl.when(ik == nk - 1)
     def _():
-        o_ref[0, 0] = (acc[:] / l_s[:, :1]).astype(o_ref.dtype)
+        # merge the ks banks: m* = max_j m_j, rescale each (l, acc)
+        m_star = m_s[pl.ds(0, bq)]
+        for j in range(1, ks):
+            m_star = jnp.maximum(m_star, m_s[pl.ds(j * bq, bq)])
+        l_tot = jnp.zeros((bq, 1), jnp.float32)
+        o_tot = jnp.zeros((bq, acc.shape[1]), jnp.float32)
+        for j in range(ks):
+            rows = pl.ds(j * bq, bq)
+            beta = jnp.exp2(m_s[rows] - m_star)
+            l_tot = l_tot + l_s[rows][:, :1] * beta[:, :1]
+            o_tot = o_tot + acc[rows] * beta[:, :1]
+        o_ref[0, 0] = (o_tot / l_tot).astype(o_ref.dtype)
         # ln sum(e^z) = m2*ln2 + ln(l) with m2 = max in base-2 space
-        lse_ref[0, 0, 0] = (m_s[:, 0] * _LN2
-                            + jnp.log(l_s[:, 0]))
+        lse_ref[0, 0, 0] = (m_star[:, 0] * _LN2
+                            + jnp.log(l_tot[:, 0]))
 
 
-def _fwd_call(qt, kt, vt, causal, scale, bq, bk, interpret):
+def _fwd_single_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *bias_s,
+                       scale, causal, bq, bk):
+    """One K block covers the whole row (nk == 1, the s <= 1024 train
+    case): no online-softmax carry — direct rowwise max/sum with no
+    (m, l, acc) scratch, no -inf init pass and no alpha rescale. The
+    causal mask is a VMEM bias tile computed once per launch and folded
+    into the scale multiply as a single fma."""
+    if bias_s:
+        first = (pl.program_id(0) == 0) & (pl.program_id(1) == 0)
+
+        @pl.when(first)
+        def _():
+            bias_s[0][:] = _tri_bias(bq, bk)
+
+    @pl.when(pl.program_id(1) >= 0)  # always true; see _bwd_fused_kernel
+    def _():
+        q, k, v = q_ref[0, 0], k_ref[0, 0], v_ref[0, 0]
+        raw = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                              preferred_element_type=jnp.float32)
+        if bias_s:
+            s = raw * (scale * _LOG2E) + bias_s[0][:]
+        elif causal:
+            s = _causal_mask(raw * (scale * _LOG2E), 0, 0, bq, bk)
+        else:
+            s = raw * (scale * _LOG2E)
+        m = jnp.max(s, axis=1, keepdims=True)
+        w = jnp.exp2(s - m)
+        l = jnp.sum(w, axis=1, keepdims=True)
+        acc = lax.dot_general(w.astype(v.dtype), v,
+                              (((1,), (0,)), ((), ())),
+                              preferred_element_type=jnp.float32)
+        o_ref[0, 0] = (acc / l).astype(o_ref.dtype)
+        lse_ref[0, 0, 0] = m[:, 0] * _LN2 + jnp.log(l[:, 0])
+
+
+def _fwd_single_call(qt, kt, vt, causal, scale, bq, bk, interpret):
+    b, h, sq, d = qt.shape
+    at = lambda ib, ih: (ib, ih, 0, 0)  # noqa: E731
+    bias_scratch = ([pltpu.VMEM((bq, bk), jnp.float32)] if causal else [])
+    return pl.pallas_call(
+        partial(_fwd_single_kernel, scale=scale, causal=causal,
+                bq=bq, bk=bk),
+        grid=(b, h),
+        in_specs=[
+            pl.BlockSpec((1, 1, bq, d), at),
+            pl.BlockSpec((1, 1, bk, d), at),
+            pl.BlockSpec((1, 1, bk, d), at),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, bq, d), at),
+            pl.BlockSpec((1, 1, 1, bq), at),
+        ],
+        out_shape=[
+            _out_struct((b, h, sq, d), qt.dtype, qt, kt, vt),
+            _out_struct((b, h, 1, sq), jnp.float32, qt, kt, vt),
+        ],
+        scratch_shapes=bias_scratch,
+        # the (bq, bk) f32 score/bias tiles exceed the default 16 MB
+        # scoped budget at bq = bk = 1024
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=64 * 1024 * 1024),
+        interpret=interpret,
+    )(qt, kt, vt)
+
+
+def _fwd_call(qt, kt, vt, causal, scale, bq, bk, interpret, ksplit=1):
     b, h, sq, d = qt.shape
     sk = kt.shape[2]
-    nq, nk = sq // bq, sk // bk
+    if sq // bq == 1 and sk // bk == 1:
+        return _fwd_single_call(qt, kt, vt, causal, scale, bq, bk,
+                                interpret)
+    if sk % (bk * ksplit):
+        ksplit = 1
+    cbk = bk * ksplit  # coarse (DMA) K block: ksplit sub-blocks
+    nq, nk = sq // bq, sk // cbk
     kernel = partial(_fwd_kernel, scale=scale, causal=causal, nk=nk,
-                     bq=bq, bk=bk)
-    use_bias = causal and bq == bk and nk > 1
-    bias_scratch = ([pltpu.VMEM((2 * bq, bk), jnp.float32)]
+                     bq=bq, bk=bk, ks=ksplit)
+    use_bias = causal and bq == bk and nk * ksplit > 1
+    bias_scratch = ([pltpu.VMEM((3 * bq, bk), jnp.float32)]
                     if use_bias else [])
     if causal:
         # Clamp the K/V fetch index to the causal bound: grid steps
@@ -187,7 +298,7 @@ def _fwd_call(qt, kt, vt, causal, scale, bq, bk, interpret):
         # skipped half of the grid stops costing HBM fetch slots
         # (+15-20% fwd at s=16k, bq=512 on v5e; neutral at bq=1024).
         k_at = lambda ib, ih, iq, ik: (  # noqa: E731
-            ib, ih, jnp.minimum(ik, _last_valid_k(iq, bq, bk)), 0)
+            ib, ih, jnp.minimum(ik, _last_valid_k(iq, bq, cbk)), 0)
     else:
         k_at = lambda ib, ih, iq, ik: (ib, ih, ik, 0)  # noqa: E731
     # NOTE: the bias scratch is initialized only at the single global
@@ -201,8 +312,8 @@ def _fwd_call(qt, kt, vt, causal, scale, bq, bk, interpret):
         grid=(b, h, nq, nk),
         in_specs=[
             pl.BlockSpec((1, 1, bq, d), lambda ib, ih, iq, ik: (ib, ih, iq, 0)),
-            pl.BlockSpec((1, 1, bk, d), k_at),
-            pl.BlockSpec((1, 1, bk, d), k_at),
+            pl.BlockSpec((1, 1, cbk, d), k_at),
+            pl.BlockSpec((1, 1, cbk, d), k_at),
         ],
         out_specs=[
             pl.BlockSpec((1, 1, bq, d), lambda ib, ih, iq, ik: (ib, ih, iq, 0)),
@@ -213,9 +324,10 @@ def _fwd_call(qt, kt, vt, causal, scale, bq, bk, interpret):
             _out_struct((b, h, 1, sq), jnp.float32, qt, kt, vt),
         ],
         scratch_shapes=[
-            pltpu.VMEM((bq, 128), jnp.float32),   # running max (lane-dup)
-            pltpu.VMEM((bq, 128), jnp.float32),   # running normalizer
-            pltpu.VMEM((bq, d), jnp.float32),     # output accumulator
+            # ks independent accumulator banks, rows [j*bq, (j+1)*bq)
+            pltpu.VMEM((ksplit * bq, 128), jnp.float32),  # running max
+            pltpu.VMEM((ksplit * bq, 128), jnp.float32),  # normalizer
+            pltpu.VMEM((ksplit * bq, d), jnp.float32),    # out accum
             *bias_scratch,                        # additive causal mask
         ],
         # the (2·bq, bk) bias tile overflows Mosaic's default 16 MB
@@ -229,16 +341,21 @@ def _fwd_call(qt, kt, vt, causal, scale, bq, bk, interpret):
 
 # --------------------------------------------------------------- backward
 
-def _p_tile(q, k, lse, iq, ik, bq, bk, scale, causal):
+def _p_tile(q, k, lse, iq, ik, bq, bk, scale, causal, bias=None):
     """Recompute the probability tile exp(s·scale − lse) in fp32 —
     in base-2 space (cf. the forward): the log2(e) factor folds into
     the existing scale multiply and a per-row lse conversion, so the
-    per-element transcendental is a bare exp2."""
-    s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                        preferred_element_type=jnp.float32
-                        ) * (scale * _LOG2E)
-    if causal:
-        s = _causal_mask(s, iq, ik, bq, bk)
+    per-element transcendental is a bare exp2. With ``bias`` (the
+    precomputed additive causal mask) the mask folds into the scale
+    multiply as one fma instead of the per-tile iota/compare/select."""
+    raw = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                          preferred_element_type=jnp.float32)
+    if bias is not None:
+        s = raw * (scale * _LOG2E) + bias
+    elif causal:
+        s = _causal_mask(raw * (scale * _LOG2E), iq, ik, bq, bk)
+    else:
+        s = raw * (scale * _LOG2E)
     return jnp.exp2(s - (lse * _LOG2E)[:, None])
 
 
@@ -301,19 +418,27 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref,
 
 
 def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref,
-                      dq_ref, dk_ref, dv_ref, *, scale, causal,
+                      dq_ref, dk_ref, dv_ref, *bias_s, scale, causal,
                       bq, bk):
     """Single-block backward: when the whole sequence fits one (bq, bk)
     tile (the common case at s <= 1024), dq/dk/dv share one recompute
     of the probability tile — 5 matmuls and one operand read instead
     of the two-kernel path's 7 and two."""
+    if bias_s:
+        first = (pl.program_id(0) == 0) & (pl.program_id(1) == 0)
+
+        @pl.when(first)
+        def _():
+            bias_s[0][:] = _tri_bias(bq, bk)
+
     @pl.when(pl.program_id(3) == 0)  # always true; the stores sit
     def _():                         # under a cond like the tiled
         q, k, v, do = (q_ref[0, 0], k_ref[0, 0], v_ref[0, 0],
                        do_ref[0, 0])  # kernels', which the interpret-
         # mode vma discharge requires (bare stores trip its
         # dynamic_slice check under shard_map)
-        p = _p_tile(q, k, lse_ref[0, 0, 0], 0, 0, bq, bk, scale, causal)
+        p = _p_tile(q, k, lse_ref[0, 0, 0], 0, 0, bq, bk, scale, causal,
+                    bias_s[0][:] if bias_s else None)
         dv_ref[0, 0] = lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32).astype(dv_ref.dtype)
@@ -331,7 +456,7 @@ def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref,
 def _bwd_fused_tiled_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref,
                             dq_ref, dk_ref, dv_ref,
                             dq_full, dk_acc, dv_acc,
-                            *, scale, causal, nq, nk, bq, bk):
+                            *bias_s, scale, causal, nq, nk, bq, bk):
     """Fused multi-block backward: one pass over the (ik outer, iq
     inner) grid computes dq, dk and dv from a single recompute of each
     probability tile — 5 matmuls and one operand stream where the
@@ -342,6 +467,9 @@ def _bwd_fused_tiled_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref,
     parks on block 0 until then, so no intermediate write-backs
     occur)."""
     ik, iq = pl.program_id(2), pl.program_id(3)
+
+    if bias_s:  # square tiles: one (diag, interior) additive-mask pair
+        _init_mask_bias(bias_s[0], iq, ik, bq, bk)
 
     @pl.when((ik == 0) & (iq == 0))
     def _():
@@ -357,7 +485,8 @@ def _bwd_fused_tiled_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref,
     @pl.when(run)
     def _():
         q, k, v, do = q_ref[0, 0], k_ref[0, 0], v_ref[0, 0], do_ref[0, 0]
-        p = _p_tile(q, k, lse_ref[0, 0, 0], iq, ik, bq, bk, scale, causal)
+        p = _p_tile(q, k, lse_ref[0, 0, 0], iq, ik, bq, bk, scale, causal,
+                    _mask_bias(bias_s[0], iq, ik, bq) if bias_s else None)
         dv_acc[:] += lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -409,6 +538,9 @@ def _bwd_fused_tiled_call(qt, kt, vt, do, lse, delta, causal, scale,
     # that (constant index map = no write-back), then walk the Q blocks.
     dq_at = lambda ib, ih, ik, iq: (                    # noqa: E731
         ib, ih, jnp.where(ik == nk - 1, iq, 0), 0)
+    use_bias = causal and bq == bk
+    bias_scratch = ([pltpu.VMEM((3 * bq, bk), jnp.float32)]
+                    if use_bias else [])
     return pl.pallas_call(
         partial(_bwd_fused_tiled_kernel, scale=scale, causal=causal,
                 nq=nq, nk=nk, bq=bq, bk=bk),
@@ -435,6 +567,7 @@ def _bwd_fused_tiled_call(qt, kt, vt, do, lse, delta, causal, scale,
             pltpu.VMEM((sq, d), jnp.float32),   # dq accumulator
             pltpu.VMEM((bk, d), jnp.float32),   # dk accumulator
             pltpu.VMEM((bk, d), jnp.float32),   # dv accumulator
+            *bias_scratch,                      # additive causal mask
         ],
         # The whole-sequence dq accumulator deliberately exceeds
         # Mosaic's default 16 MB scoped-VMEM budget; v5e has 128 MB.
@@ -454,6 +587,8 @@ def _bwd_call(qt, kt, vt, do, lse, delta, causal, scale, bq, bk, interpret):
         # interpreter's vma discharge accepts program-id-derived starts
         at = lambda ib, ih, iq, ik: (ib, ih, iq, ik)  # noqa: E731
         rt = at  # residuals share the whole-block index map
+        bias_scratch = ([pltpu.VMEM((bq, bk), jnp.float32)]
+                        if causal else [])
         return pl.pallas_call(
             partial(_bwd_fused_kernel, scale=scale, causal=causal,
                     bq=bq, bk=bk),
@@ -479,6 +614,11 @@ def _bwd_call(qt, kt, vt, do, lse, delta, causal, scale, bq, bk, interpret):
                 _out_struct((b, h, sk, d), vt.dtype, qt, kt, vt, do,
                             lse, delta),
             ],
+            scratch_shapes=bias_scratch,
+            # the (bq, bk) f32 bias tile exceeds the 16 MB default
+            # scoped budget at bq = bk = 1024
+            **({"compiler_params": pltpu.CompilerParams(
+                vmem_limit_bytes=64 * 1024 * 1024)} if causal else {}),
             interpret=interpret,
         )(qt, kt, vt, do, lse, delta)
 
@@ -550,17 +690,17 @@ def _bwd_call(qt, kt, vt, do, lse, delta, causal, scale, bq, bk, interpret):
 
 # ------------------------------------------------------------- custom_vjp
 
-@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash(qt, kt, vt, causal, scale, bq, bk, interpret):
-    return _fwd_call(qt, kt, vt, causal, scale, bq, bk, interpret)
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash(qt, kt, vt, causal, scale, bq, bk, ks, interpret):
+    return _fwd_call(qt, kt, vt, causal, scale, bq, bk, interpret, ks)
 
 
-def _flash_fwd(qt, kt, vt, causal, scale, bq, bk, interpret):
-    out, lse = _fwd_call(qt, kt, vt, causal, scale, bq, bk, interpret)
+def _flash_fwd(qt, kt, vt, causal, scale, bq, bk, ks, interpret):
+    out, lse = _fwd_call(qt, kt, vt, causal, scale, bq, bk, interpret, ks)
     return (out, lse), (qt, kt, vt, out, lse)
 
 
-def _flash_bwd(causal, scale, bq, bk, interpret, res, g):
+def _flash_bwd(causal, scale, bq, bk, ks, interpret, res, g):
     g_out, g_lse = g
     qt, kt, vt, out, lse = res
     # delta_i = sum_d dO_i·O_i — the rowwise dot that closes the softmax
@@ -648,8 +788,13 @@ def flash_attention_with_lse(q: jax.Array, k: jax.Array, v: jax.Array,
     if scale is None:
         scale = q.shape[-1] ** -0.5
     qt, kt, vt = (x.transpose(0, 2, 1, 3) for x in (q, k, v))
+    # Two K sub-blocks per grid step on the long-sequence path: the
+    # sub-blocks' score matmuls are independent, so the scheduler can
+    # overlap sub-block j+1's MXU dot with sub-block j's VPU softmax
+    # (ks = 1 serializes the units). Needs >= 4 K blocks to matter.
+    ks = 2 if (bq == bk and k.shape[1] // bk >= 4) else 1
     out, lse = _flash(qt, kt, vt, bool(causal), float(scale), bq, bk,
-                      interpret)
+                      ks, interpret)
     # Names for rematerialization policies: a checkpointed layer whose
     # policy saves these skips re-running the forward kernel in the
     # backward pass (TransformerConfig.remat_policy = "dots_attn").

@@ -139,11 +139,30 @@ def make_hybrid_mesh(dcn_size: int | None = None,
         raise ValueError(
             f"requested {dcn_size}x{ici_size} mesh but only {n} devices")
     if nproc > 1:
-        from jax.experimental import mesh_utils
-        arr = mesh_utils.create_hybrid_device_mesh(
-            mesh_shape=(1, ici_size),
-            dcn_mesh_shape=(dcn_size, 1),
-            devices=devices)
+        slice_ids = {getattr(d, "slice_index", None) for d in devices}
+        if (dcn_size * ici_size == n and None not in slice_ids
+                and len(slice_ids) == dcn_size):
+            from jax.experimental import mesh_utils
+            arr = mesh_utils.create_hybrid_device_mesh(
+                mesh_shape=(1, ici_size),
+                dcn_mesh_shape=(dcn_size, 1),
+                devices=devices)
+            return Mesh(arr, axis_names)
+        # Backends without slice topology metadata (multi-process CPU —
+        # the 2-OS-process bring-up test) or subset requests: the
+        # process boundary IS the DCN boundary, so take ici_size
+        # devices from each of dcn_size processes.
+        by_proc: dict[int, list] = {}
+        for d in sorted(devices, key=lambda d: (d.process_index, d.id)):
+            by_proc.setdefault(d.process_index, []).append(d)
+        groups = list(by_proc.values())
+        if len(groups) < dcn_size or any(
+                len(g) < ici_size for g in groups[:dcn_size]):
+            raise ValueError(
+                f"cannot carve a ({dcn_size}, {ici_size}) hybrid mesh "
+                f"from {len(groups)} processes with "
+                f"{[len(g) for g in groups]} devices each")
+        arr = np.asarray([g[:ici_size] for g in groups[:dcn_size]])
         return Mesh(arr, axis_names)
     arr = np.asarray(devices[:dcn_size * ici_size]).reshape(
         dcn_size, ici_size)

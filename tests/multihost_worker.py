@@ -1,0 +1,91 @@
+"""Worker for the real 2-process ``jax.distributed`` bring-up test.
+
+Each OS process simulates 4 CPU devices; together they form the 8-device
+(dcn=2, ici=4) hybrid mesh. This is the ``mpirun`` analog executed for
+real — the reference launches p ranks via PBS/mpirun
+(``Communication/Data/sub.sh:9-15``, ``MPI_Init`` at
+``Communication/src/main.cc:396``); here the coordinator handshake,
+cross-process mesh construction and cross-process collectives all
+actually run, not simulate.
+
+Usage: python multihost_worker.py <coordinator_port> <process_id>
+Prints "WORKER_OK" on success (the parent test asserts on it).
+"""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+from jax.sharding import NamedSharding, PartitionSpec as P  # noqa: E402
+
+
+def main() -> int:
+    port, pid = int(sys.argv[1]), int(sys.argv[2])
+
+    from icikit.parallel.multihost import (
+        hierarchical_all_gather,
+        hierarchical_all_reduce,
+        init_distributed,
+        make_hybrid_mesh,
+        process_info,
+    )
+
+    # the MPI_Init analog — explicit coordinator, 2 processes
+    assert init_distributed(coordinator_address=f"localhost:{port}",
+                            num_processes=2, process_id=pid)
+    assert init_distributed() is True  # idempotent second call
+    rank, nproc, local = process_info()
+    assert (rank, nproc, local) == (pid, 2, 4), (rank, nproc, local)
+    assert jax.device_count() == 8
+
+    # hybrid mesh across the two processes: outer axis = DCN
+    mesh = make_hybrid_mesh()
+    assert mesh.shape == {"dcn": 2, "p": 4}
+    # outer axis must actually span the processes
+    procs = [[d.process_index for d in row] for row in mesh.devices]
+    assert sorted({p for row in procs for p in row}) == [0, 1]
+    assert all(len(set(row)) == 1 for row in procs), procs
+
+    p, m = 8, 16
+    rng = np.random.default_rng(7)
+    data = rng.integers(-100, 100, size=(p, m)).astype(np.int32)
+    sharding = NamedSharding(mesh, P(("dcn", "p")))
+    x = jax.make_array_from_callback(
+        (p, m), sharding, lambda idx: data[idx])
+
+    for alg in ("xla", "ring"):
+        out = hierarchical_all_reduce(x, mesh, ici_algorithm=alg,
+                                      dcn_algorithm=alg)
+        want = data.sum(axis=0)
+        for shard in out.addressable_shards:
+            got = np.asarray(shard.data)
+            assert (got == want[None].repeat(got.shape[0], 0)).all(), alg
+
+    out = hierarchical_all_gather(x, mesh)
+    for shard in out.addressable_shards:
+        got = np.asarray(shard.data)  # (rows, p, m): all blocks per row
+        assert (got == data[None]).all()
+
+    # plain cross-process psum through the flat mesh path as well
+    from icikit.parallel.shmap import shard_map
+
+    def f(b):
+        return jax.lax.psum(b, ("dcn", "p"))
+
+    out = jax.jit(shard_map(f, mesh=mesh, in_specs=P(("dcn", "p")),
+                            out_specs=P()))(x.astype(jnp.float32))
+    np.testing.assert_allclose(
+        np.asarray(out.addressable_shards[0].data),
+        data.astype(np.float32).sum(axis=0)[None])
+
+    print("WORKER_OK", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

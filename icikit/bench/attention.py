@@ -241,7 +241,10 @@ def main(argv=None) -> int:
         runs=args.runs, warmup=args.warmup, mesh=mesh)
     print(format_table(records))
     if args.json_path:
-        with open(args.json_path, "w") as f:
+        # append: LONGCONTEXT.md's protocol is best-over-every-recorded
+        # invocation, so the record file accumulates across runs (an
+        # overwrite here once destroyed two rounds of records)
+        with open(args.json_path, "a") as f:
             for r in records:
                 f.write(r.to_json() + "\n")
     if not all(r.verified for r in records):

@@ -1,0 +1,110 @@
+"""Capacity overflow study: measure the minimal non-overflowing
+``cap_factor`` per (algorithm, p, n, distribution).
+
+The reference over-allocates every rank's working buffer to the full
+``n`` (``Parallel-Sorting/src/psort.cc:385``) — overflow is impossible
+and so is the question. icikit's capacity-padded exchanges make the
+trade explicit: a factor too small triggers a retry-recompile, a factor
+too large wastes HBM. The shipped defaults (sample 4.0, quicksort 2.0)
+must therefore be *measured* over the envelope the sorts actually run
+at — this module produces that record (``capacity_study.json``).
+
+For each configuration the study builds the real per-shard program at
+``cap = factor · n_loc / p`` (sample family) or ``factor · n_loc``
+(quicksort) and reads the program's own overflow flag — the exact
+signal the retry path keys on, not a reimplementation of the
+bucketing.
+
+CLI (simulated mesh; capacities are count properties, not timings)::
+
+    python -m icikit.bench.capacity --ns 20,22,24 --ps 4,8 \
+        --out capacity_study.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _keys(n: int, dist: str):
+    import jax
+    import jax.numpy as jnp
+
+    from icikit.utils.prandom import uniform_global
+    u = uniform_global(jax.random.key(7), n, odd_dist=(dist == "odd"))
+    return (u * 2e9 - 1e9).astype(jnp.int32)
+
+
+def _overflowed(alg: str, mesh, x2d, n_loc: int, p: int,
+                factor: float) -> bool:
+    import jax
+
+    from icikit.models.sort import quicksort as Q
+    from icikit.models.sort import sample as S
+    if alg == "quicksort":
+        cap = max(1, int(factor * n_loc))
+        out = Q._build(mesh, "p", cap)(x2d)
+        return int(jax.device_get(out[-1].sum())) > 0
+    splitter = "bitonic" if alg == "sample[bitonic]" else "allgather"
+    cap = max(1, min(n_loc, int(factor * n_loc / p)))
+    out = S._build(mesh, "p", cap, splitter)(x2d)
+    return int(jax.device_get(out[-1].sum())) > 0
+
+
+FACTORS = (1.25, 1.5, 2.0, 3.0, 4.0, 6.0)
+ALGS = ("sample[allgather]", "sample[bitonic]", "quicksort")
+
+
+def run_study(ns, ps, dists=("uniform", "odd"), factors=FACTORS,
+              algs=ALGS, log=print):
+    from icikit.utils.mesh import make_mesh, shard_along
+    records = []
+    for p in ps:
+        mesh = make_mesh(p)
+        for n_log in ns:
+            n = 1 << n_log
+            n_loc = n // p
+            for dist in dists:
+                keys = _keys(n, dist)
+                x2d = shard_along(keys.reshape(p, n_loc), mesh)
+                for alg in algs:
+                    found = None
+                    for f in factors:
+                        if not _overflowed(alg, mesh, x2d, n_loc, p, f):
+                            found = f
+                            break
+                    records.append({"alg": alg, "p": p, "n": n_log,
+                                    "dist": dist, "min_factor": found})
+                    log(f"p={p} n=2^{n_log} {dist:8s} {alg:18s} "
+                        f"min_factor={found}")
+    return records
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--ns", default="20,22,24",
+                    help="log2 global sizes, comma-separated")
+    ap.add_argument("--ps", default="4,8")
+    ap.add_argument("--out", default="capacity_study.json")
+    ap.add_argument("--simulate", action="store_true",
+                    help="force a simulated CPU mesh (set before jax "
+                         "initializes)")
+    args, _ = ap.parse_known_args(argv)
+    if args.simulate:
+        import os
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        os.environ.setdefault(
+            "XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+    ns = [int(x) for x in args.ns.split(",")]
+    ps = [int(x) for x in args.ps.split(",")]
+    records = run_study(ns, ps)
+    with open(args.out, "w") as f:
+        json.dump(records, f)
+    print(f"wrote {len(records)} records to {args.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

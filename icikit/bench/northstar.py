@@ -22,6 +22,7 @@ CLI::
 
 from __future__ import annotations
 
+import os
 import argparse
 import json
 import sys
@@ -193,6 +194,8 @@ def render_markdown(coll, sorts, dlb, checks, meta) -> str:
     for name, ok in checks.items():
         lines.append(f"- {'PASS' if ok else 'FAIL'} — {name}")
     lines.append("\n## Sorting (keys/s)\n")
+    if os.path.exists("docs/figs/sort_throughput.png"):
+        lines.append("![throughput vs n](docs/figs/sort_throughput.png)\n")
     lines.append("| algorithm | n | best_ms | Mkeys/s | errors |")
     lines.append("|---|---|---|---|---|")
     for r in sorts:

@@ -587,17 +587,29 @@ def make_train_step(mesh, cfg: TransformerConfig, optimizer=None):
     def narrow(p):
         if cfg.grad_dtype == "float32":
             return p
-        # norm scales stay fp32: _rms_norm multiplies them into fp32
-        # statistics, so narrowing them would change the forward
-        # numerics, not just the cotangent dtype (their gradients are
-        # (L, D)-small — no traffic to save)
-        return {k: v if k.startswith("ln")
+        # norm scales, the embedding table and the positional table
+        # stay fp32: they feed fp32 arithmetic directly (_rms_norm
+        # statistics; the gather + positional add happen before the one
+        # cast into the compute stream), so narrowing them would change
+        # the forward numerics, not just the cotangent dtype. The
+        # weight matmuls already cast per use, so narrowing those
+        # leaves only changes the gradient leaves' dtype — the stacked
+        # per-layer gradient writes and optimizer gradient reads halve.
+        keep = ("ln", "emb", "pos")
+        return {k: v if k.startswith(keep)
                 or not jnp.issubdtype(v.dtype, jnp.floating)
                 else v.astype(cdt) for k, v in p.items()}
 
     @jax.jit
     def step(params, opt_state, tokens, targets):
         loss, grads = loss_fn(narrow(params), tokens, targets, mesh, cfg)
+        # moments accumulate from fp32 inputs: adam squares its
+        # gradient input, and a bf16 g**2 carries ~2^-8 relative error
+        # into nu every step — the HBM saving lives in the stacked
+        # grad writes/reads above, not in this cast
+        grads = jax.tree.map(
+            lambda g: g.astype(jnp.float32)
+            if jnp.issubdtype(g.dtype, jnp.floating) else g, grads)
         updates, opt_state = optimizer.update(grads, opt_state, params)
         return optax.apply_updates(params, updates), opt_state, loss
 

@@ -330,7 +330,7 @@ def _fwd_call(qt, kt, vt, causal, scale, bq, bk, interpret, ksplit=1):
             pltpu.VMEM((ksplit * bq, d), jnp.float32),    # out accum
             *bias_scratch,                        # additive causal mask
         ],
-        # the (2·bq, bk) bias tile overflows Mosaic's default 16 MB
+        # the (3·bq, bk) bias tile overflows Mosaic's default 16 MB
         # scoped-VMEM budget at bq = bk = 1024 (v5e has 128 MB); other
         # configurations keep the default guardrail
         **({"compiler_params": pltpu.CompilerParams(

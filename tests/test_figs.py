@@ -1,0 +1,76 @@
+"""Figure rendering (``icikit.bench.figs``): the committed PNGs must be
+regenerable from the committed jsonl records with no hardware."""
+
+from __future__ import annotations
+
+import json
+import os
+
+
+def _write(path, rows):
+    with open(path, "w") as f:
+        for r in rows:
+            f.write(json.dumps(r) + "\n")
+
+
+def test_render_all_from_records(tmp_path):
+    import matplotlib
+    matplotlib.use("Agg")
+    from icikit.bench.figs import render_all
+    sc = tmp_path / "scaling.jsonl"
+    ns = tmp_path / "northstar.jsonl"
+    lc = tmp_path / "longcontext.jsonl"
+    _write(sc, [{"family": "allgather", "algorithm": a, "p": p,
+                 "msize": m, "best_s": 1e-4 * m * p / 64}
+                for a in ("ring", "xla") for p in (2, 8)
+                for m in (1, 65536)]
+           + [{"family": "alltoall", "algorithm": "hypercube", "p": 8,
+               "msize": 16, "best_s": 1e-4},
+              {"family": "allreduce", "algorithm": "ring", "p": 4,
+               "msize": 65536, "best_s": 2e-3}])
+    _write(ns, [{"kind": "sort", "algorithm": "bitonic", "p": 1,
+                 "n": 1 << 20, "distribution": "uniform",
+                 "keys_per_s": 1e8},
+                {"kind": "sort", "algorithm": "sample", "p": 1,
+                 "n": 1 << 20, "distribution": "uniform",
+                 "keys_per_s": 5e7}])
+    _write(lc, [{"impl": "flash", "mode": "fwd", "seq": 32768,
+                 "d_head": 64, "tflops": 66.0, "verified": True},
+                {"impl": "flash", "mode": "fwd", "seq": 32768,
+                 "d_head": 64, "tflops": 999.0, "verified": True},
+                {"impl": "flash", "mode": "fwdbwd", "seq": 32768,
+                 "d_head": 128, "tflops": 170.0, "verified": True}])
+    out = render_all(outdir=str(tmp_path / "figs"), scaling=str(sc),
+                     northstar=str(ns), longcontext=str(lc))
+    names = {os.path.basename(p) for p in out}
+    assert "scaling_allgather_msize_p8.png" in names
+    assert "sort_throughput.png" in names
+    assert "longcontext_tflops.png" in names
+    for p in out:
+        assert os.path.getsize(p) > 10_000  # real rendered images
+
+
+def test_missing_records_are_skipped(tmp_path):
+    import matplotlib
+    matplotlib.use("Agg")
+    from icikit.bench.figs import render_all
+    out = render_all(outdir=str(tmp_path / "figs"),
+                     scaling=str(tmp_path / "none.jsonl"),
+                     northstar=str(tmp_path / "none.jsonl"),
+                     longcontext=str(tmp_path / "none.jsonl"))
+    assert out == []
+
+
+def test_artifact_filter_excludes_impossible_readings(tmp_path):
+    """Readings above the measured matmul ceiling are timing artifacts
+    and must not enter the best-of curves."""
+    from icikit.bench.figs import _TFLOPS_CEILING, fig_longcontext
+    import matplotlib
+    matplotlib.use("Agg")
+    rows = [{"impl": "flash", "mode": "fwd", "seq": 16384, "d_head": 128,
+             "tflops": 731.0, "verified": True},
+            {"impl": "flash", "mode": "fwd", "seq": 16384, "d_head": 128,
+             "tflops": 150.0, "verified": True}]
+    assert rows[0]["tflops"] > _TFLOPS_CEILING
+    path = fig_longcontext(rows, str(tmp_path))
+    assert path and os.path.getsize(path) > 10_000

@@ -84,10 +84,20 @@ def measure_hbm_bw(gib: float = 2.0, iters: int = 30) -> float:
         return x, acc
 
     f = jax.jit(lambda x, a: lax.fori_loop(0, iters, body, (x, a)))
-    res = timeit_chained(f, (x, jnp.float32(0)),
-                         lambda a, out: (out[0], out[1]),
-                         runs=2, warmup=1)
-    return float(n) * 2 * iters / res.best_s
+    # HBM nameplate (v5e: 819 GB/s) is a hard physical ceiling on any
+    # read probe; the tunneled chip's corrupted timing windows
+    # occasionally return a probe "measurement" far above it (observed:
+    # 1.85 TB/s), which would silently deflate every pct_roofline row.
+    # Re-measure once on implausibility, then clamp.
+    nameplate = 819e9
+    for _ in range(2):
+        res = timeit_chained(f, (x, jnp.float32(0)),
+                             lambda a, out: (out[0], out[1]),
+                             runs=2, warmup=1)
+        bw = float(n) * 2 * iters / res.best_s
+        if bw <= 1.02 * nameplate:
+            return bw
+    return min(bw, nameplate)
 
 
 def run_bench(preset: str, dp: int, tp: int, batch: int, prompt_len: int,
@@ -153,8 +163,9 @@ def run_bench(preset: str, dp: int, tp: int, batch: int, prompt_len: int,
     per_token_s = res.best_s / n_new
     bw = decode_bytes_per_token(
         cfg, batch, prompt_len + n_new) / per_token_s
+    kv_tag = f"_kv{kv_heads}" if kv_heads else ""
     return {
-        "metric": f"decode_{preset}_dp{dp}tp{tp}_b{batch}"
+        "metric": f"decode_{preset}_dp{dp}tp{tp}_b{batch}{kv_tag}"
                   f"_p{prompt_len}_n{n_new}_{sampling}",
         "value": round(batch / per_token_s, 1),
         "unit": "tokens/s",
@@ -234,7 +245,10 @@ def main(argv=None) -> int:
     for rec in recs:
         print(json.dumps(rec))
     if args.json_path:
-        with open(args.json_path, "w") as f:
+        # append: record files accumulate across invocations (the
+        # studies' best-of protocol depends on it; "w" here once
+        # destroyed committed records)
+        with open(args.json_path, "a") as f:
             for rec in recs:
                 f.write(json.dumps(rec) + "\n")
     return 0

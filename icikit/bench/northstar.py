@@ -198,10 +198,34 @@ def render_markdown(coll, sorts, dlb, checks, meta) -> str:
         lines.append("![throughput vs n](docs/figs/sort_throughput.png)\n")
     lines.append("| algorithm | n | best_ms | Mkeys/s | errors |")
     lines.append("|---|---|---|---|---|")
+    # records accumulate across invocations: render the best verified
+    # run per (algorithm, n), worst error count (the study protocol)
+    best: dict = {}
     for r in sorts:
+        cur = best.get((r.algorithm, r.n))
+        if cur is None or r.keys_per_s > cur.keys_per_s:
+            best[(r.algorithm, r.n)] = r
+    for (alg, n) in sorted(best, key=lambda k: (k[1], k[0])):
+        r = best[(alg, n)]
+        errs = max(x.errors for x in sorts
+                   if (x.algorithm, x.n) == (alg, n))
         lines.append(f"| {r.algorithm} | 2^{r.n.bit_length() - 1} | "
                      f"{r.best_s * 1e3:.2f} | "
-                     f"{r.keys_per_s / 1e6:.1f} | {r.errors} |")
+                     f"{r.keys_per_s / 1e6:.1f} | {errs} |")
+    if meta["p"] == 1:
+        lines.append(
+            "\n> **p=1 reading.** At one device every distributed sort "
+            "short-circuits to the same Pallas local sort — the "
+            "algorithm columns differ only in wrapper overhead plus "
+            "tunnel timing variance (identical device programs have "
+            "measured 2-4x apart minutes apart). The round-2 gaps "
+            "(sample 162 / quicksort 107 vs bitonic 324 at 2^24) were "
+            "a *blocking host-side overflow read* in the capacity-"
+            "retry wrappers stalling the dispatch pipeline mid-"
+            "measurement; round 3 skips that sync whenever a retry "
+            "is impossible. Algorithmic comparisons need p > 1 "
+            "(project3.pdf §4's trends are about scaling, not one "
+            "rank).\n")
     lines.append("\n## Dynamic load balancing\n")
     if meta["p"] == 1:
         lines.append(
@@ -318,7 +342,10 @@ def main(argv=None) -> int:
     else:
         print(md)
     if args.json_path:
-        with open(args.json_path, "w") as f:
+        # append: record files accumulate across invocations (the
+        # studies' best-of protocol depends on it; "w" here once
+        # destroyed committed records)
+        with open(args.json_path, "a") as f:
             for r in coll:
                 f.write(json.dumps(
                     {"kind": "collective", **dataclasses.asdict(r)}) + "\n")

@@ -173,7 +173,10 @@ def main(argv=None):
                              title=f"Strong scaling: {args.family}")
     print(text)
     if args.json_path:
-        with open(args.json_path, "w") as f:
+        # append: record files accumulate across invocations (the
+        # studies' best-of protocol depends on it; "w" here once
+        # destroyed committed records)
+        with open(args.json_path, "a") as f:
             for r in records:
                 f.write(json.dumps(r) + "\n")
     if args.report_path:

@@ -189,7 +189,10 @@ def main(argv=None) -> int:
             warmup=args.warmup)
     print(format_table(records))
     if args.json_path:
-        with open(args.json_path, "w") as f:
+        # append: record files accumulate across invocations (the
+        # studies' best-of protocol depends on it; "w" here once
+        # destroyed committed records)
+        with open(args.json_path, "a") as f:
             for r in records:
                 f.write(r.to_json() + "\n")
     if any(r.errors for r in records):

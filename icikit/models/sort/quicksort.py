@@ -145,6 +145,13 @@ def hypercube_quicksort_blocks(x2d: jax.Array, mesh,
     never fires on realistic inputs.
     """
     p, n_loc = x2d.shape
+    if p == 1:
+        # degenerate case: the shard short-circuits to a local sort and
+        # overflow is impossible — skip the blocking host-side overflow
+        # read (it stalls the dispatch pipeline; see
+        # sample.run_with_capacity_retry)
+        out, _ = _build(mesh, axis, n_loc)(x2d)
+        return out
     f = cap_factor
     while True:
         cap = int(f * n_loc)

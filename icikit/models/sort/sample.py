@@ -145,7 +145,12 @@ def run_with_capacity_retry(build, n_loc: int, p: int, cap_factor: float,
     no-overflow property at the default)."""
     cap = max(1, min(n_loc, int(cap_factor * n_loc / max(p, 1))))
     out = build(cap)(*operands)
-    if int(jax.device_get(out[-1].sum())) > 0 and cap < n_loc:
+    # Order matters: when cap == n_loc the retry can never fire, and
+    # the overflow read is a *blocking host round-trip* in the middle
+    # of otherwise-pipelined dispatches — on a tunneled chip that sync
+    # alone measured ~2x on the p=1 sort rows (NORTHSTAR r2: sample
+    # 162 vs bitonic 324 Mkeys/s for identical device work).
+    if cap < n_loc and int(jax.device_get(out[-1].sum())) > 0:
         out = build(n_loc)(*operands)
     return out
 

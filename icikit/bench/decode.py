@@ -55,7 +55,32 @@ def decode_bytes_per_token(cfg, batch: int, cache_len: float,
     return param_bytes + 2.0 * cache
 
 
-def measure_hbm_bw(gib: float = 2.0, iters: int = 30) -> float:
+# HBM nameplate read bandwidth by TPU generation (bytes/s), keyed by
+# icikit.bench.train.tpu_generation()'s canonical names — the single
+# device-kind matcher; do NOT re-implement substring matching here.
+# A hard physical ceiling for the probe's plausibility clamp. Unknown
+# generations get no clamp (None) — clamping with the wrong
+# generation's number would silently corrupt every pct_roofline row
+# (a v4 probe clamped at v5e's 819 GB/s reads as >100% forever).
+HBM_NAMEPLATE_BY_GEN = {
+    "v5e": 819e9,
+    "v6e": 1638e9,   # Trillium
+    "v5p": 2765e9,
+    "v4": 1228e9,
+}
+
+
+def hbm_nameplate_bytes() -> float | None:
+    """Nameplate HBM bandwidth for the attached device, or None if the
+    TPU generation is unrecognized (in which case the probe is trusted
+    unclamped)."""
+    from icikit.bench.train import tpu_generation
+
+    return HBM_NAMEPLATE_BY_GEN.get(tpu_generation())
+
+
+def measure_hbm_bw(gib: float = 2.0, iters: int = 30,
+                   nameplate: float | None = None) -> float:
     """Achievable HBM *read* bandwidth (bytes/s), measured.
 
     Decode traffic is read-dominated (parameters + cache in, one token
@@ -84,18 +109,21 @@ def measure_hbm_bw(gib: float = 2.0, iters: int = 30) -> float:
         return x, acc
 
     f = jax.jit(lambda x, a: lax.fori_loop(0, iters, body, (x, a)))
-    # HBM nameplate (v5e: 819 GB/s) is a hard physical ceiling on any
-    # read probe; the tunneled chip's corrupted timing windows
+    # The device's nameplate bandwidth is a hard physical ceiling on
+    # any read probe; the tunneled chip's corrupted timing windows
     # occasionally return a probe "measurement" far above it (observed:
-    # 1.85 TB/s), which would silently deflate every pct_roofline row.
-    # Re-measure once on implausibility, then clamp.
-    nameplate = 819e9
+    # 1.85 TB/s on an 819 GB/s v5e), which would silently deflate every
+    # pct_roofline row. Re-measure once on implausibility, then clamp.
+    # The ceiling is per-generation (hbm_nameplate_bytes); an unknown
+    # device kind disables the clamp rather than borrowing v5e's.
+    if nameplate is None:
+        nameplate = hbm_nameplate_bytes()
     for _ in range(2):
         res = timeit_chained(f, (x, jnp.float32(0)),
                              lambda a, out: (out[0], out[1]),
                              runs=2, warmup=1)
         bw = float(n) * 2 * iters / res.best_s
-        if bw <= 1.02 * nameplate:
+        if nameplate is None or bw <= 1.02 * nameplate:
             return bw
     return min(bw, nameplate)
 
@@ -173,6 +201,13 @@ def run_bench(preset: str, dp: int, tp: int, batch: int, prompt_len: int,
         "read_gbps": round(bw / 1e9, 1),
         "batch": batch,
         "includes_prefill": True,
+        # Bytes-model provenance: the record files append across rounds
+        # while the accounting has changed (r3 introduced the
+        # VMEM-resident subtraction), so every record stamps the model
+        # it was computed under — rows from different byte models must
+        # never be compared by the best-of protocol.
+        "bytes_model": "r3-vmem-resident",
+        "vmem_resident_bytes": VMEM_RESIDENT_BYTES,
     }
 
 

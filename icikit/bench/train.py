@@ -65,18 +65,32 @@ def step_flops(cfg, batch: int, seq: int) -> float:
     return mm + attn
 
 
-def detect_peak() -> float:
+def tpu_generation() -> str | None:
+    """Canonical TPU generation key for the attached device ("v5e",
+    "v6e", "v5p", "v4", ...), or None off-TPU / unrecognized. The
+    single device-kind matcher — every per-generation table (FLOP peak
+    here, HBM nameplate in bench.decode) must key through this, not
+    re-implement substring matching: device_kind spellings vary ("TPU
+    v5 lite", "TPU v5e", "TPU v5 litepod"), and a divergent matcher
+    that lets "TPU v5e" fall through to a bare "v5" entry silently
+    borrows the wrong generation's ceiling."""
     if jax.default_backend() != "tpu":
-        return 0.0
+        return None
     kind = jax.devices()[0].device_kind.lower().replace(" ", "")
     aliases = {"v5lite": "v5e", "v5litepod": "v5e", "v6lite": "v6e"}
     for raw, canon in aliases.items():
         if raw in kind:
-            return PEAK_FLOPS[canon]
-    for key, val in PEAK_FLOPS.items():
+            return canon
+    # longest-match first so "v5e"/"v5p" win over a hypothetical "v5"
+    for key in sorted(PEAK_FLOPS, key=len, reverse=True):
         if key in kind:
-            return val
-    return 0.0
+            return key
+    return None
+
+
+def detect_peak() -> float:
+    gen = tpu_generation()
+    return PEAK_FLOPS.get(gen, 0.0) if gen else 0.0
 
 
 def measure_peak(n: int = 8192, iters: int = 50) -> float:

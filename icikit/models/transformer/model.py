@@ -514,7 +514,8 @@ def _local_loss(params, tokens, targets, cfg, p_sp, p_dp, p_tp, denom):
         # explicit replication-lift: the custom-vjp kernel returns a
         # dp/sp-varying dw, so the usual auto-pvary (whose transpose is
         # the cross-shard gradient psum) must be placed by hand
-        w = lax.pvary(params["w_out"].astype(cdt), (DP_AXIS, SP_AXIS))
+        w = lax.pcast(params["w_out"].astype(cdt), (DP_AXIS, SP_AXIS),
+                      to="varying")
         nll = fused_xent(h.reshape(b * s, cfg.d_model), w,
                          targets.reshape(b * s)).reshape(b, s)
     else:
@@ -584,19 +585,32 @@ def make_train_step(mesh, cfg: TransformerConfig, optimizer=None):
                          "(known: compute, float32)")
     cdt = jnp.dtype(cfg.compute_dtype)
 
+    # norm scales, the embedding table and the positional table stay
+    # fp32: they feed fp32 arithmetic directly (_rms_norm statistics;
+    # the gather + positional add happen before the one cast into the
+    # compute stream), so narrowing them would change the forward
+    # numerics, not just the cotangent dtype. The weight matmuls cast
+    # per use (including the MoE router "wr", line ~431), so narrowing
+    # those leaves only changes the gradient leaves' dtype — the
+    # stacked per-layer gradient writes and optimizer gradient reads
+    # halve. Both lists are EXPLICIT param names, not prefixes: a new
+    # param added to init_params without a verdict here must fail
+    # loudly, never get silently narrowed.
+    KEEP_FP32 = {"ln1", "ln2", "ln_f", "emb", "pos"}
+    NARROW_OK = {"wo", "w_out", "wq", "wkv", "wqkv",
+                 "wr", "we1", "we2", "w1", "w2"}
+
     def narrow(p):
         if cfg.grad_dtype == "float32":
             return p
-        # norm scales, the embedding table and the positional table
-        # stay fp32: they feed fp32 arithmetic directly (_rms_norm
-        # statistics; the gather + positional add happen before the one
-        # cast into the compute stream), so narrowing them would change
-        # the forward numerics, not just the cotangent dtype. The
-        # weight matmuls already cast per use, so narrowing those
-        # leaves only changes the gradient leaves' dtype — the stacked
-        # per-layer gradient writes and optimizer gradient reads halve.
-        keep = ("ln", "emb", "pos")
-        return {k: v if k.startswith(keep)
+        unknown = set(p) - KEEP_FP32 - NARROW_OK
+        if unknown:
+            raise ValueError(
+                f"params {sorted(unknown)} have no grad_dtype verdict; "
+                "add them to KEEP_FP32 (feeds fp32 arithmetic directly) "
+                "or NARROW_OK (cast-per-use matmul weight) in "
+                "make_train_step")
+        return {k: v if k in KEEP_FP32
                 or not jnp.issubdtype(v.dtype, jnp.floating)
                 else v.astype(cdt) for k, v in p.items()}
 

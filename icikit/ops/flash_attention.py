@@ -27,6 +27,7 @@ exercise the same code path.
 
 from __future__ import annotations
 
+import math
 from functools import lru_cache, partial
 
 import jax
@@ -88,9 +89,19 @@ def _first_valid_q(ik, bq, bk):
     return (ik * bk) // bq
 
 
+# Finiteness invariant: NEG_INF must be a finite float32 (it is
+# float32.min, not -inf). The banked-ksplit forward executes
+# fully-masked sub-blocks and relies on exp2(NEG_INF - m*)
+# underflowing to exactly 0 in the bank merge; with a true -inf mask
+# a fully-masked bank would compute exp2(-inf - -inf) = NaN and
+# poison the merge. Do not switch the masking to -jnp.inf.
+assert math.isfinite(NEG_INF), "bank merge requires a finite mask value"
+
+
 def _tri_bias(bq, bk):
     """The diagonal tile's additive causal mask: 0 where q >= k,
-    NEG_INF above — the single source for every kernel's bias init."""
+    NEG_INF above — the single source for every kernel's bias init.
+    NEG_INF is finite by invariant (see assertion above)."""
     qpos = lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
     kpos = lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
     return jnp.where(qpos >= kpos, 0.0, NEG_INF)

@@ -1,0 +1,109 @@
+"""Standalone optimizer-tail bench: one-pass Adam vs its HBM floor.
+
+The train step's optimizer tail is pure memory streaming (26 B/element
+with bf16 gradients: read p/m/v fp32 + g bf16, write p/m/v). This
+bench measures the two one-pass formulations from ``icikit.ops.adam``
+on a synthetic parameter tree shaped like the base preset, against the
+floor implied by the measured HBM bandwidth (``measure_hbm_bw``):
+
+- ``pallas``: the single-kernel path — measured 89% of achievable
+  bandwidth standalone (this artifact pins that claim).
+- ``xla``: the elementwise formulation XLA fuses itself — measured
+  95%, and it is layout-agnostic, which is why the step uses it.
+
+Context (ROADMAP/README): inside the *full* train step the Pallas
+path loses — it pins default layouts and XLA inserts conversion
+copies (+15 ms/step measured at the base preset) — so the step uses
+the XLA form. This bench pins the standalone claim; the step-level
+A/B lives in ``icikit.bench.train --optimizer {fused,optax}``.
+
+CLI::
+
+    python -m icikit.bench.adam --params-m 211 --runs 4
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+
+
+def run_bench(params_m: float = 211.0, runs: int = 4,
+              grad_dtype: str = "bfloat16") -> list[dict]:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from icikit.bench.decode import measure_hbm_bw
+    from icikit.ops.adam import adam_apply
+    from icikit.utils.timing import timeit_chained
+
+    n = int(params_m * 1e6)
+    rows = n // 128
+    gdt = jnp.dtype(grad_dtype)
+    key = jax.random.key(0)
+    bytes_per = 3 * 4 + 3 * 4 + gdt.itemsize  # r p/m/v + w p/m/v + r g
+    traffic = n * bytes_per
+    bw_ceiling = measure_hbm_bw()
+
+    records = []
+    for mode in ("pallas", "xla"):
+        # fresh tree per mode: the step donates p/m/v, so the previous
+        # mode's run deleted its buffers
+        p = {"w": jax.random.normal(key, (rows, 128), jnp.float32)}
+        m = {"w": jnp.zeros((rows, 128), jnp.float32)}
+        v = {"w": jnp.zeros((rows, 128), jnp.float32)}
+        g = {"w": jax.random.normal(key, (rows, 128), jnp.float32
+                                    ).astype(gdt)}
+        def step(p, m, v, g, t, mode=mode):
+            return adam_apply(p, m, v, g, 1e-3, t, use_pallas=(
+                mode == "pallas")) + (t + 1,)
+
+        # NO donation: donating p/m/v aliases the pallas_call's inputs
+        # to its outputs, and the in-place hazard serializes Mosaic's
+        # block DMA pipeline — measured 266-451 GB/s depending on
+        # block shape, vs 664 at-floor with fresh outputs (the XLA
+        # formulation streams at floor either way; its fusion loop
+        # handles aliasing). The full train step donates its carry, so
+        # this is one more reason the step uses the XLA form.
+        f = jax.jit(step)
+        t0 = jnp.zeros((), jnp.int32)
+        res = timeit_chained(
+            f, (p, m, v, g, t0),
+            lambda args, out: (out[0], out[1], out[2], args[3], out[3]),
+            runs=runs, warmup=1)
+        gbps = traffic / res.best_s / 1e9
+        records.append({
+            "metric": f"adam_onepass_{mode}_{params_m:g}M_{gdt.name}",
+            "value": round(gbps, 1),
+            "unit": "GB/s",
+            "ms": round(res.best_s * 1e3, 3),
+            "bytes_per_element": bytes_per,
+            "hbm_bw_gbps": round(bw_ceiling / 1e9, 1),
+            "pct_hbm": round(100 * gbps / (bw_ceiling / 1e9), 1),
+        })
+    return records
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--params-m", type=float, default=211.0,
+                    help="tree size in millions of parameters "
+                         "(default: the base preset's 211M)")
+    ap.add_argument("--runs", type=int, default=4)
+    ap.add_argument("--grad-dtype", default="bfloat16")
+    ap.add_argument("--json", dest="json_path", default=None)
+    args = ap.parse_args(argv)
+    recs = run_bench(args.params_m, args.runs, args.grad_dtype)
+    for rec in recs:
+        print(json.dumps(rec))
+    if args.json_path:
+        # append: record files accumulate across invocations
+        with open(args.json_path, "a") as f:
+            for rec in recs:
+                f.write(json.dumps(rec) + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -26,6 +26,7 @@ once regardless of depth.
 """
 
 from icikit.models.transformer.model import (  # noqa: F401
+    FusedAdam,
     TransformerConfig,
     init_params,
     loss_fn,

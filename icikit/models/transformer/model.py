@@ -38,7 +38,7 @@ from icikit.models.attention.zigzag import zigzag_attention_shard
 from icikit.models.transformer.moe import moe_ffn_shard
 from icikit.ops.flash_attention import resolve_attention_impl
 from icikit.ops.rope import apply_rope
-from icikit.parallel.shmap import wrap_program
+from icikit.parallel.shmap import shard_map, wrap_program
 
 DP_AXIS, TP_AXIS, SP_AXIS = "dp", "tp", "sp"
 
@@ -573,10 +573,40 @@ def loss_fn(params, tokens, targets, mesh, cfg: TransformerConfig):
     return _build_loss_and_grad(mesh, cfg, local)(params, tokens, targets)
 
 
+class FusedAdam:
+    """Adam via the one-pass Pallas kernel (``icikit.ops.adam``).
+
+    Drop-in for ``optax.adam`` in ``make_train_step`` only (it is not
+    a GradientTransformation — the update writes p' directly, so there
+    is no separable "updates" tree to hand back). The gradient is
+    consumed in its stored dtype and upcast in-register. ``lr`` may be
+    a float or a ``step -> lr`` schedule callable.
+
+    ``use_pallas`` defaults off: the measured verdict (see
+    ``icikit.ops.adam.adam_apply``) is that XLA already runs every
+    per-leaf Adam fusion at the HBM floor and fuses the update into
+    the dw matmul for unstacked leaves, while the Pallas kernel's
+    layout pinning costs +15 ms/step in conversions at the base
+    preset. Step time with the default therefore matches optax; what
+    this class buys is the one-pass formulation (no update tree) and
+    the kernel as an opt-in for standalone optimizer studies.
+    """
+
+    def __init__(self, lr=3e-4, b1: float = 0.9, b2: float = 0.999,
+                 eps: float = 1e-8, use_pallas: bool = False):
+        self.lr, self.b1, self.b2, self.eps = lr, b1, b2, eps
+        self.use_pallas = use_pallas
+
+    def init(self, params):
+        zeros = lambda: {k: jnp.zeros_like(v) for k, v in params.items()}
+        return (zeros(), zeros(), jnp.zeros((), jnp.int32))
+
+
 def make_train_step(mesh, cfg: TransformerConfig, optimizer=None):
     """Jitted full training step: (params, opt_state, tokens, targets)
     -> (params, opt_state, loss). ``optimizer`` is any optax
-    GradientTransformation (default: adam(3e-4))."""
+    GradientTransformation (default: adam(3e-4)), or a ``FusedAdam``
+    for the one-pass fused-kernel optimizer tail."""
     import optax
     if optimizer is None:
         optimizer = optax.adam(3e-4)
@@ -613,6 +643,36 @@ def make_train_step(mesh, cfg: TransformerConfig, optimizer=None):
         return {k: v if k in KEEP_FP32
                 or not jnp.issubdtype(v.dtype, jnp.floating)
                 else v.astype(cdt) for k, v in p.items()}
+
+    if isinstance(optimizer, FusedAdam):
+        from icikit.ops.adam import adam_apply
+
+        specs = param_specs(cfg)
+        opt = optimizer
+
+        @jax.jit
+        def fused_step(params, opt_state, tokens, targets):
+            loss, grads = loss_fn(narrow(params), tokens, targets,
+                                  mesh, cfg)
+            m, v, t = opt_state
+            t = t + 1
+            lr = opt.lr(t) if callable(opt.lr) else opt.lr
+            # elementwise update on local shards: every leaf's spec is
+            # its param spec (grads/moments share it), scalars ride
+            # replicated
+            pspecs = {k: specs[k] for k in params}
+            apply = shard_map(
+                lambda p, mm, vv, g, lr_, t_: adam_apply(
+                    p, mm, vv, g, lr_, t_, opt.b1, opt.b2, opt.eps,
+                    use_pallas=opt.use_pallas),
+                mesh=mesh,
+                in_specs=(pspecs, pspecs, pspecs, pspecs, P(), P()),
+                out_specs=(pspecs, pspecs, pspecs))
+            new_p, new_m, new_v = apply(params, m, v, grads,
+                                        jnp.asarray(lr, jnp.float32), t)
+            return new_p, (new_m, new_v, t), loss
+
+        return optimizer, fused_step
 
     @jax.jit
     def step(params, opt_state, tokens, targets):

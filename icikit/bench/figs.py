@@ -163,6 +163,46 @@ def fig_sort_throughput(records, outdir):
     return path
 
 
+def fig_sort_scaling(records, outdir):
+    """keys/s vs p for the four sorts — the reference's headline
+    sorting figure (project3.pdf §4) on the simulated host-thread
+    mesh. Line style distinguishes input size (solid = largest)."""
+    import matplotlib.pyplot as plt
+    rows = [r for r in records
+            if r.get("distribution") == "uniform" and r.get("p", 0) > 1
+            and r.get("errors", 0) == 0]  # verified runs only
+    if not rows:
+        return None
+    sizes = sorted({r["n"] for r in rows})[-2:]  # two largest n
+    styles = {n: s for n, s in zip(sizes, ("--", "-"))}
+    by_key = defaultdict(dict)
+    for r in rows:
+        if r["n"] not in styles:
+            continue
+        cur = by_key[(r["algorithm"], r["n"])].get(r["p"], 0)
+        if r["keys_per_s"] > cur:
+            by_key[(r["algorithm"], r["n"])][r["p"]] = r["keys_per_s"]
+    fig, ax = plt.subplots(figsize=(6.4, 4.0), facecolor=SURFACE)
+    for (alg, n) in sorted(by_key):
+        pts = sorted(by_key[(alg, n)].items())
+        c = PALETTE[SORT_SLOTS.get(alg, 6)]
+        label = f"{alg} (n=2^{n.bit_length() - 1})"
+        ax.plot([p for p, _ in pts], [k / 1e6 for _, k in pts],
+                color=c, linewidth=2, linestyle=styles[n], marker="o",
+                markersize=5, label=label, zorder=3)
+    ax.set_xscale("log", base=2)
+    ax.set_xticks(sorted({p for v in by_key.values() for p in v}))
+    ax.get_xaxis().set_major_formatter("{x:.0f}")
+    _style(ax, "Distributed sorts: throughput vs device count "
+               "(int32, uniform, simulated CPU mesh)",
+           "devices (p)", "throughput (M keys/s)")
+    _legend(ax)
+    path = os.path.join(outdir, "sort_scaling_p.png")
+    fig.savefig(path, dpi=160, bbox_inches="tight", facecolor=SURFACE)
+    plt.close(fig)
+    return path
+
+
 # Measured bf16 matmul ceiling (bench.train measure_peak, this chip):
 # readings above it are tunnel timing artifacts, not kernels.
 _TFLOPS_CEILING = 184.4
@@ -212,19 +252,22 @@ def fig_longcontext(records, outdir):
 
 def render_all(outdir="docs/figs", scaling="scaling.jsonl",
                northstar="northstar.jsonl",
-               longcontext="longcontext.jsonl"):
+               longcontext="longcontext.jsonl",
+               sort_scaling="sort_scaling.jsonl"):
     import matplotlib
     matplotlib.use("Agg")
     os.makedirs(outdir, exist_ok=True)
     sc = _load(scaling)
     ns = _load(northstar)
     lc = _load(longcontext)
+    ss = _load(sort_scaling)
     out = []
     out.append(fig_scaling_msize(sc, outdir, "allgather", p=8))
     out.append(fig_scaling_msize(sc, outdir, "alltoall", p=8))
     out.append(fig_scaling_p(sc, outdir, "allgather", msize=65536))
     out.append(fig_scaling_p(sc, outdir, "allreduce", msize=65536))
     out.append(fig_sort_throughput(ns, outdir))
+    out.append(fig_sort_scaling(ss, outdir))
     out.append(fig_longcontext(lc, outdir))
     return [p for p in out if p]
 

@@ -109,14 +109,26 @@ def run_scaling_sweep(family: str, ps=DEFAULT_PS, **kw) -> list[dict]:
 
 
 def _render_sort_scaling(records: list[dict]) -> str:
-    """keys/s vs p, algorithms as columns — project3.pdf's Fig. shape."""
+    """keys/s vs p, algorithms as columns — project3.pdf's Fig. shape.
+    Multiple records per (algorithm, p, n) (appended across rounds)
+    collapse to the best verified reading."""
     algs = sorted({r["algorithm"] for r in records})
-    out = ["# Strong scaling: distributed sorts\n"]
+    out = ["## Measured: Mkeys/s vs p (best verified reading per cell)\n"]
     for n in sorted({r["n"] for r in records}):
         rows = []
         for p in sorted({r["p"] for r in records if r["n"] == n}):
-            cell = {r["algorithm"]: r for r in records
-                    if r["n"] == n and r["p"] == p}
+            cell = {}
+            for r in records:
+                if r["n"] != n or r["p"] != p:
+                    continue
+                best = cell.get(r["algorithm"])
+                # verified records always displace errored ones; among
+                # equals (both verified / both errored), best wins
+                if (best is None
+                        or (r["errors"] == 0 and best["errors"] > 0)
+                        or (min(r["errors"], 1) == min(best["errors"], 1)
+                            and r["keys_per_s"] > best["keys_per_s"])):
+                    cell[r["algorithm"]] = r
             row = [str(p)]
             for a in algs:
                 r = cell.get(a)
@@ -124,12 +136,48 @@ def _render_sort_scaling(records: list[dict]) -> str:
                            + ("" if r["errors"] == 0 else " ✗")
                            if r else "—")
             rows.append(row)
-        out.append(f"### n = {n} (Mkeys/s vs p)\n")
+        out.append(f"### n = 2^{n.bit_length() - 1} (Mkeys/s vs p)\n")
         out.append("| p | " + " | ".join(algs) + " |")
         out.append("|" + "|".join("---" for _ in range(len(algs) + 1)) + "|")
         out += ["| " + " | ".join(r) + " |" for r in rows]
         out.append("")
     return "\n".join(out)
+
+
+_GEN_BEGIN = "<!-- generated: sort-scaling data (do not edit) -->"
+_GEN_END = "<!-- /generated -->"
+
+
+def write_sort_scaling_md(jsonl_path: str = "sort_scaling.jsonl",
+                          out_path: str = "SORTSCALING.md") -> None:
+    """Refresh SORTSCALING.md's generated block (measured tables +
+    figure link + analytic schedule counts) from the committed
+    records, preserving the hand-written analysis around it."""
+    from icikit.bench.schedule_stats import render_sort_markdown
+
+    with open(jsonl_path) as f:
+        records = [json.loads(ln) for ln in f if ln.strip()]
+    gen = "\n".join([
+        _GEN_BEGIN,
+        "",
+        _render_sort_scaling(records),
+        "![sort scaling](docs/figs/sort_scaling_p.png)",
+        "",
+        render_sort_markdown(ps=(2, 4, 8, 16, 32), n=1 << 20),
+        _GEN_END,
+    ])
+    try:
+        text = open(out_path).read()
+    except FileNotFoundError:
+        text = "# Strong scaling: the four distributed sorts\n\n"
+    if _GEN_BEGIN in text and _GEN_END in text:
+        head = text[:text.index(_GEN_BEGIN)]
+        tail = text[text.index(_GEN_END) + len(_GEN_END):]
+        text = head + gen + tail
+    else:
+        text = text.rstrip() + "\n\n" + gen + "\n"
+    with open(out_path, "w") as f:
+        f.write(text)
 
 
 def main(argv=None):
@@ -152,7 +200,17 @@ def main(argv=None):
     ap.add_argument("--json", dest="json_path", default=None)
     ap.add_argument("--report", dest="report_path", default=None,
                     help="also render a markdown report to this path")
+    ap.add_argument("--sort-report", dest="sort_report",
+                    action="store_true",
+                    help="refresh SORTSCALING.md's generated tables "
+                         "from sort_scaling.jsonl and exit (no new "
+                         "measurements)")
     args = ap.parse_args(argv)
+
+    if args.sort_report:
+        write_sort_scaling_md(args.json_path or "sort_scaling.jsonl")
+        print("updated SORTSCALING.md")
+        return 0
 
     ps = (tuple(int(x) for x in args.ps.split(","))
           if args.ps else DEFAULT_PS)

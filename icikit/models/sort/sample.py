@@ -155,9 +155,15 @@ def run_with_capacity_retry(build, n_loc: int, p: int, cap_factor: float,
     return out
 
 
+# Measured shipped default (r2 overflow study — see
+# run_with_capacity_retry's docstring); the analytic schedule counts
+# (bench.schedule_stats.analyze_sort) trace at this same value.
+DEFAULT_CAP_FACTOR = 4.0
+
+
 def sample_sort_blocks(x2d: jax.Array, mesh, axis: str = DEFAULT_AXIS,
                        splitter: str = "allgather",
-                       cap_factor: float = 4.0):
+                       cap_factor: float = DEFAULT_CAP_FACTOR):
     """Sort block-sharded (p, n_loc) data globally ascending."""
     p, n_loc = x2d.shape
     out, _ = run_with_capacity_retry(

@@ -57,7 +57,8 @@ def test_missing_records_are_skipped(tmp_path):
     out = render_all(outdir=str(tmp_path / "figs"),
                      scaling=str(tmp_path / "none.jsonl"),
                      northstar=str(tmp_path / "none.jsonl"),
-                     longcontext=str(tmp_path / "none.jsonl"))
+                     longcontext=str(tmp_path / "none.jsonl"),
+                     sort_scaling=str(tmp_path / "none.jsonl"))
     assert out == []
 
 

@@ -78,3 +78,36 @@ def test_nonpow2_marked_na():
     row = [ln for ln in md.splitlines()
            if ln.startswith("| recursive_doubling |")][0]
     assert "n/a" in row
+
+
+def test_sort_schedule_forms():
+    """The traced sort schedules reproduce their textbook forms:
+    bitonic has d(d+1)/2 full-block rounds; sample sort's depth is
+    p-independent; the hybrid adds the splitter bitonic's depth;
+    quicksort's calls grow linearly in d (pivot + exchange stages)."""
+    from icikit.bench.schedule_stats import analyze_sort
+
+    n = 1 << 14
+    for p in (2, 4, 8):
+        d = p.bit_length() - 1
+        bi = analyze_sort("bitonic", p, n)
+        assert bi.rounds == d * (d + 1) // 2
+        assert bi.calls == bi.rounds  # full-block ppermute per round
+        # full block crosses each round: bytes = rounds * n/p * 4
+        assert bi.bytes_per_dev == bi.rounds * (n // p) * 4
+    depths = [analyze_sort("sample", p, n).rounds for p in (2, 4, 8)]
+    assert len(set(depths)) == 1  # constant communication depth
+    for p in (4, 8):
+        d = p.bit_length() - 1
+        hy = analyze_sort("sample_bitonic", p, n)
+        assert hy.rounds == depths[0] + d * (d + 1) // 2
+        qs = analyze_sort("quicksort", p, n)
+        assert qs.rounds >= 2 * d  # >= pivot + exchange per round
+
+
+def test_sort_render_markdown():
+    from icikit.bench.schedule_stats import render_sort_markdown
+
+    text = render_sort_markdown(ps=(2, 4), n=1 << 12)
+    assert "| bitonic |" in text and "| quicksort |" in text
+    assert "rounds/calls/MB-dev" in text

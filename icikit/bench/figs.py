@@ -140,11 +140,22 @@ def fig_sort_throughput(records, outdir):
             and r.get("p") == 1 and r.get("distribution") == "uniform"]
     if not rows:
         return None
+    # Same cell rule as the NORTHSTAR table: the most recent
+    # median-of-windows record wins; best-of only among legacy rows
+    # (a best-of across sessions kept corrupted-fast windows — the
+    # r3 1427-Mkeys/s artifact).
     by_alg = defaultdict(dict)
+    chosen = {}
     for r in rows:
-        cur = by_alg[r["algorithm"]].get(r["n"], 0)
-        if r["keys_per_s"] > cur:
-            by_alg[r["algorithm"]][r["n"]] = r["keys_per_s"]
+        key = (r["algorithm"], r["n"])
+        cur = chosen.get(key)
+        r_med = r.get("protocol") == "median-of-windows"
+        cur_med = (cur is not None
+                   and cur.get("protocol") == "median-of-windows")
+        if cur is None or r_med or not cur_med:  # later record wins
+            chosen[key] = r
+    for (alg, n), r in chosen.items():
+        by_alg[alg][n] = r["keys_per_s"]
     fig, ax = plt.subplots(figsize=(6.4, 4.0), facecolor=SURFACE)
     for alg in sorted(by_alg):
         pts = sorted(by_alg[alg].items())

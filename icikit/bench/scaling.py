@@ -68,9 +68,13 @@ def run_scale_point(family: str, p: int, *, algorithms=None, sizes=None,
         json_path = tf.name
     try:
         if bench == "sort":
+            # --windows 1: the scaling sweep is a relative-trend study
+            # on the CPU mesh (no corrupted-fast pathology to guard;
+            # 3x subprocess cost buys nothing)
             cmd = [sys.executable, "-m", "icikit.bench.sort",
                    "--devices", str(p), "--runs", str(runs),
-                   "--dtype", dtype, "--json", json_path]
+                   "--dtype", dtype, "--windows", "1",
+                   "--json", json_path]
         else:
             cmd = [sys.executable, "-m", "icikit.bench.run",
                    "--family", family, "--devices", str(p),

@@ -69,6 +69,34 @@ class TimeitResult:
         return min(self.per_run_s)
 
 
+def _make_chain_measure(fn, args, chain):
+    """Shared chain machinery: returns (state, measure) where
+    ``measure(n)`` times n chained runs, always continuing the chain
+    from where the last window left off — a window that restarted from
+    ``args`` would replay a value-identical prefix, the very pattern a
+    caching backend elides."""
+    import jax.numpy as jnp
+
+    def force(a):
+        leaf = jax.tree_util.tree_leaves(a)[0]
+        idx = (0,) * getattr(leaf, "ndim", 0)
+        return float(jnp.asarray(leaf[idx], jnp.float32))
+
+    state = {"cur": args, "force": force}
+
+    def measure(n):
+        cur = state["cur"]
+        watch = Stopwatch()
+        for _ in range(n):
+            cur = chain(cur, fn(*cur))
+        force(cur)
+        t = watch()
+        state["cur"] = cur
+        return t
+
+    return state, measure
+
+
 def timeit_chained(fn, args: tuple, chain, runs: int = 10,
                    warmup: int = 2,
                    target_window_s: float | None = None) -> TimeitResult:
@@ -84,32 +112,11 @@ def timeit_chained(fn, args: tuple, chain, runs: int = 10,
     (final transfer, dispatch ramp) cancel via two-point measurement:
     per-run = (t(2·runs) − t(runs)) / runs.
     """
-    import jax.numpy as jnp
-
-    def force(a):
-        leaf = jax.tree_util.tree_leaves(a)[0]
-        idx = (0,) * getattr(leaf, "ndim", 0)
-        return float(jnp.asarray(leaf[idx], jnp.float32))
-
-    state = {"cur": args}
-
-    def measure(n):
-        # Continue the chain from where the last window left off — a
-        # window that restarted from ``args`` would replay a
-        # value-identical prefix, the very pattern a caching backend
-        # elides.
-        cur = state["cur"]
-        watch = Stopwatch()
-        for _ in range(n):
-            cur = chain(cur, fn(*cur))
-        force(cur)
-        t = watch()
-        state["cur"] = cur
-        return t
+    state, measure = _make_chain_measure(fn, args, chain)
 
     for _ in range(max(warmup, 1)):
         state["cur"] = chain(state["cur"], fn(*state["cur"]))
-    force(state["cur"])
+    state["force"](state["cur"])
     # Two-point needs each window well above dispatch/transfer noise
     # (~100 ms on a tunneled device): scale runs until t(runs) >=
     # target. On CPU meshes the dispatch noise is microseconds AND deep
@@ -118,21 +125,40 @@ def timeit_chained(fn, args: tuple, chain, runs: int = 10,
     # so the default target (and with it the queue depth) stays small
     # there.
     if target_window_s is None:
-        # key off the backend the timed program actually runs on (the
-        # operands' devices), not the process default — a CPU mesh in a
-        # TPU-default process still needs the small-window guard
-        platform = jax.default_backend()
-        # sniff from the live chained state, not the original args: a
-        # donating fn has already consumed (deleted) the args buffers
-        # by the time the warmup above ran
-        for leaf in jax.tree_util.tree_leaves(state["cur"]):
-            devs = getattr(leaf, "devices", None)
-            if callable(devs):
-                ds = devs()
-                if ds:
-                    platform = next(iter(ds)).platform
-                    break
-        target_window_s = 0.02 if platform == "cpu" else 0.25
+        target_window_s = _resolve_target_window(state)
+    per, window, total = _two_point_window(measure, runs,
+                                           target_window_s)
+    return TimeitResult(mean_s=per, total_s=total, runs=window,
+                        per_run_s=[per] * window)
+
+
+def _resolve_target_window(state) -> float:
+    # key off the backend the timed program actually runs on (the
+    # operands' devices), not the process default — a CPU mesh in a
+    # TPU-default process still needs the small-window guard. Sniff
+    # from the live chained state, not the original args: a donating
+    # fn has already consumed (deleted) the args buffers by the time
+    # the warmup ran.
+    platform = jax.default_backend()
+    for leaf in jax.tree_util.tree_leaves(state["cur"]):
+        devs = getattr(leaf, "devices", None)
+        if callable(devs):
+            ds = devs()
+            if ds:
+                platform = next(iter(ds)).platform
+                break
+    # Two-point needs each window well above dispatch/transfer noise
+    # (~100 ms on a tunneled device). On CPU meshes the dispatch noise
+    # is microseconds AND deep queues of chained multi-device
+    # executions can skew the per-device threads past XLA:CPU's 40 s
+    # collective-rendezvous hard limit — so the target (and with it
+    # the queue depth) stays small there.
+    return 0.02 if platform == "cpu" else 0.25
+
+
+def _two_point_window(measure, runs, target_window_s):
+    """One two-point measurement: per-run seconds, window size, total
+    wall seconds spent."""
     n, probe = runs, measure(runs)
     while probe < target_window_s and n < 4096:
         n = n * max(2, int(1.2 * target_window_s / max(probe, 1e-3)))
@@ -149,8 +175,82 @@ def timeit_chained(fn, args: tuple, chain, runs: int = 10,
             # last window's plain mean — an upper bound that includes
             # the constant costs, but a sane number instead of ~0
             per = t2 / (4 * n)
-    return TimeitResult(mean_s=per, total_s=probe + t2, runs=window,
-                        per_run_s=[per] * window)
+    return per, window, probe + t2
+
+
+@dataclass
+class WindowsResult:
+    """Median-of-windows measurement with spread — the headline
+    protocol (every table cell quotes ``median [min, max]``; best-of
+    lives only in the record files)."""
+    median_s: float
+    min_s: float
+    max_s: float
+    windows: int           # windows kept
+    discarded: int         # implausibly-fast windows dropped
+    per_window_s: list
+    # True when EVERY window fell below floor_s: the stats above are
+    # then the implausible readings themselves (reported rather than
+    # fabricated from the floor) and must be rendered as suspect.
+    suspect: bool = False
+
+    @property
+    def best_s(self) -> float:
+        return self.min_s
+
+
+def timeit_windows(fn, args: tuple, chain, windows: int = 5,
+                   runs: int = 4, warmup: int = 1,
+                   target_window_s: float | None = None,
+                   floor_s: float | None = None) -> WindowsResult:
+    """Noise-robust headline timing: ``windows`` independent two-point
+    measurements over ONE continuing chain, reported as median with
+    [min, max] spread.
+
+    The tunneled chip's failure modes are asymmetric (memory
+    ``axon-tpu-timing-traps``): noise episodes depress readings up to
+    30% for minutes, and corrupted windows return physically
+    impossible *fast* readings. A single best-of over rounds keeps the
+    corrupted fasts ("best recorded" 1427 Mkeys/s vs a 740 same-day
+    median, NORTHSTAR r3); a single reading eats the slow episodes.
+    Median over ≥3 windows is robust to both tails; ``floor_s`` (a
+    physical lower bound on per-run time, e.g. from HBM bandwidth ×
+    minimum passes) additionally discards impossible windows before
+    the median — each discard is re-measured, up to 2x ``windows``
+    attempts total.
+    """
+    if windows < 1:
+        raise ValueError(f"windows must be >= 1, got {windows}")
+    state, measure = _make_chain_measure(fn, args, chain)
+    for _ in range(max(warmup, 1)):
+        state["cur"] = chain(state["cur"], fn(*state["cur"]))
+    state["force"](state["cur"])
+    if target_window_s is None:
+        target_window_s = _resolve_target_window(state)
+    pers, dropped = [], []
+    for _ in range(2 * max(windows, 1)):
+        if len(pers) >= windows:
+            break
+        per, _, _ = _two_point_window(measure, runs, target_window_s)
+        if floor_s is not None and per < floor_s:
+            dropped.append(per)
+            continue
+        pers.append(per)
+    suspect = False
+    if not pers:
+        # every window fell below the physical floor: report the
+        # actual (implausible) readings flagged as suspect — never a
+        # number fabricated from the floor, and never a zero that
+        # would crash a throughput division downstream
+        pers, dropped, suspect = dropped, [], True
+    pers_sorted = sorted(pers)
+    mid = len(pers_sorted) // 2
+    median = (pers_sorted[mid] if len(pers_sorted) % 2
+              else 0.5 * (pers_sorted[mid - 1] + pers_sorted[mid]))
+    return WindowsResult(median_s=median, min_s=min(pers),
+                         max_s=max(pers), windows=len(pers),
+                         discarded=len(dropped), per_window_s=pers,
+                         suspect=suspect)
 
 
 def timeit(fn, *args, runs: int = 10, warmup: int = 2,

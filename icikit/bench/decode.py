@@ -130,7 +130,7 @@ def measure_hbm_bw(gib: float = 2.0, iters: int = 30,
 
 def run_bench(preset: str, dp: int, tp: int, batch: int, prompt_len: int,
               n_new: int, sampling: str = "greedy", runs: int = 3,
-              kv_heads: int = 0) -> dict:
+              kv_heads: int = 0, windows: int = 3) -> dict:
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -157,16 +157,14 @@ def run_bench(preset: str, dp: int, tp: int, batch: int, prompt_len: int,
                                jax.random.key(1), temperature=0.8,
                                top_k=40)
 
-    # Elision-proof chained timing: each run's prompt is the previous
-    # run's generated tail, so every generation is value-distinct and
-    # the two-point windows cancel dispatch/fence constants (the
+    # Elision-proof chaining: each run's prompt is the previous run's
+    # generated tail, so every generation is value-distinct (the
     # earlier two-length differencing protocol was profiled losing to
     # tunnel noise: ~200 ms fixed costs swamped the tens-of-ms decode
-    # signal, flipping readings by 3x run to run). per_token includes
-    # the amortized prefill of prompt_len tokens — one forward pass
-    # against n_new sequential steps, <2% at the default shapes.
-    from icikit.utils.timing import timeit_chained
-
+    # signal). per_token includes the amortized prefill of prompt_len
+    # tokens — one forward pass against n_new sequential steps, <2% at
+    # the default shapes. Timing itself is the median-of-windows
+    # protocol below.
     if n_new < 2:
         raise ValueError("n_new must be >= 2")
     p0 = jax.device_put(
@@ -186,9 +184,21 @@ def run_bench(preset: str, dp: int, tp: int, batch: int, prompt_len: int,
         ctr[0] += 1
         return (out[:, -prompt_len:].at[0, 0].set(ctr[0] % cfg.vocab),)
 
-    res = timeit_chained(lambda prompt: gen(prompt, n_new), (p0,),
-                         chain, runs=runs, warmup=1)
-    per_token_s = res.best_s / n_new
+    # Median-of-windows headline protocol (r4): the tunneled chip's
+    # session noise corrupted decode's old best-plausible rows in BOTH
+    # directions — r3's "b=8 cliff" (0.518 ms/tok vs b=16's 0.283) was
+    # a depressed-session artifact that does not reproduce (r4: 0.18-
+    # 0.25 ms across repeats). Floor: one generate call cannot read
+    # its parameter+cache bytes faster than nameplate HBM allows.
+    from icikit.utils.timing import timeit_windows
+    nameplate = hbm_nameplate_bytes()
+    floor_s = (n_new * decode_bytes_per_token(
+        cfg, batch, prompt_len + n_new) / nameplate
+        if nameplate else None)
+    res = timeit_windows(lambda prompt: gen(prompt, n_new), (p0,),
+                         chain, windows=windows, runs=runs, warmup=1,
+                         floor_s=floor_s)
+    per_token_s = res.median_s / n_new
     bw = decode_bytes_per_token(
         cfg, batch, prompt_len + n_new) / per_token_s
     kv_tag = f"_kv{kv_heads}" if kv_heads else ""
@@ -208,6 +218,14 @@ def run_bench(preset: str, dp: int, tp: int, batch: int, prompt_len: int,
         # never be compared by the best-of protocol.
         "bytes_model": "r3-vmem-resident",
         "vmem_resident_bytes": VMEM_RESIDENT_BYTES,
+        # headline protocol provenance (median of >= windows with
+        # per-token-ms spread; suspect = every window below the floor)
+        "protocol": "median-of-windows",
+        "windows": res.windows,
+        "discarded": res.discarded,
+        "suspect": res.suspect,
+        "per_token_ms_spread": [round(res.min_s / n_new * 1e3, 3),
+                                round(res.max_s / n_new * 1e3, 3)],
     }
 
 
@@ -222,27 +240,34 @@ def run_sweep(preset: str, batches, prompt_len: int, n_new: int,
     the measured *streaming-read* bandwidth (measure_hbm_bw) each
     configuration achieves.
     """
-    bw_ceiling = measure_hbm_bw()
+    # The roofline denominator is itself a measurement on a noisy
+    # tunnel: a single depressed probe inflates every pct_roofline row
+    # above 100% (observed: b=8 at "110%" of a probe that read ~12%
+    # low). Take the best of three clamped probes — the max is the
+    # best estimate of achievable read bandwidth (probes only err low
+    # once the nameplate clamp removes the corrupted-fast tail).
+    bw_ceiling = max(measure_hbm_bw() for _ in range(3))
     records = []
     for b in batches:
+        # corrupted-fast windows are discarded inside run_bench (the
+        # median-of-windows floor subsumes the old whole-run retry);
+        # the measured-roofline fraction can still exceed 100% slightly
+        # when the session's probe itself ran depressed — the nameplate
+        # floor bounds what a *kernel* can do, not what a noisy probe
+        # reports.
         rec = run_bench(preset, dp, tp, b, prompt_len, n_new,
                         sampling=sampling, runs=runs, kv_heads=kv_heads)
-        # Physical-plausibility retry: the tunneled chip occasionally
-        # returns a corrupted (too-fast) chained window — an implied
-        # read bandwidth above the measured ceiling cannot be a real
-        # kernel. Re-measure once; if still impossible, keep the slower
-        # reading and mark the record.
-        if rec["read_gbps"] > 1.05 * bw_ceiling / 1e9:
-            rec2 = run_bench(preset, dp, tp, b, prompt_len, n_new,
-                             sampling=sampling, runs=runs,
-                             kv_heads=kv_heads)
-            if rec2["read_gbps"] < rec["read_gbps"]:
-                rec = rec2
-            if rec["read_gbps"] > 1.05 * bw_ceiling / 1e9:
-                rec["suspect_timing"] = True
         rec["roofline_gbps"] = round(bw_ceiling / 1e9, 1)
         rec["pct_roofline"] = round(
             100.0 * rec["read_gbps"] / (bw_ceiling / 1e9), 1)
+        # vs nameplate too: in a depressed tunnel session the probe
+        # itself reads low and good configs show >100% of "roofline";
+        # the nameplate fraction is the conservative physical claim
+        # (a kernel cannot beat the spec sheet).
+        nameplate = hbm_nameplate_bytes()
+        if nameplate:
+            rec["pct_nameplate"] = round(
+                100.0 * rec["read_gbps"] / (nameplate / 1e9), 1)
         records.append(rec)
     return records
 

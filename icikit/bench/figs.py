@@ -140,20 +140,15 @@ def fig_sort_throughput(records, outdir):
             and r.get("p") == 1 and r.get("distribution") == "uniform"]
     if not rows:
         return None
-    # Same cell rule as the NORTHSTAR table: the most recent
-    # median-of-windows record wins; best-of only among legacy rows
-    # (a best-of across sessions kept corrupted-fast windows — the
-    # r3 1427-Mkeys/s artifact).
+    # The shared headline cell rule (report.select_headline): latest
+    # record wins, medians never displaced by legacy rows — one
+    # implementation with the NORTHSTAR table so figure and table
+    # cannot disagree.
+    from icikit.bench.report import select_headline
     by_alg = defaultdict(dict)
-    chosen = {}
-    for r in rows:
-        key = (r["algorithm"], r["n"])
-        cur = chosen.get(key)
-        r_med = r.get("protocol") == "median-of-windows"
-        cur_med = (cur is not None
-                   and cur.get("protocol") == "median-of-windows")
-        if cur is None or r_med or not cur_med:  # later record wins
-            chosen[key] = r
+    chosen = select_headline(
+        rows, key_of=lambda r: (r["algorithm"], r["n"]),
+        proto_of=lambda r: r.get("protocol", "chained-best"))
     for (alg, n), r in chosen.items():
         by_alg[alg][n] = r["keys_per_s"]
     fig, ax = plt.subplots(figsize=(6.4, 4.0), facecolor=SURFACE)

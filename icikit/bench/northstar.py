@@ -210,18 +210,12 @@ def render_markdown(coll, sorts, dlb, checks, meta) -> str:
     # "best recorded" and made the table contradict the driver-captured
     # number (r3: 1427 vs 987 vs 740 for the same program). Cells that
     # only have pre-r4 chained-best records render those, explicitly
-    # labeled; best-of readings stay in the jsonl.
-    shown: dict = {}
-    for r in sorts:  # file order == append order; later wins
-        key = (r.algorithm, r.n)
-        cur = shown.get(key)
-        r_med = getattr(r, "protocol", "chained-best") \
-            == "median-of-windows"
-        cur_med = (cur is not None
-                   and getattr(cur, "protocol", "chained-best")
-                   == "median-of-windows")
-        if cur is None or r_med or not cur_med:
-            shown[key] = r
+    # labeled; best-of readings stay in the jsonl. The cell rule is
+    # shared with the sort-throughput figure (report.select_headline).
+    from icikit.bench.report import select_headline
+    shown = select_headline(
+        sorts, key_of=lambda r: (r.algorithm, r.n),
+        proto_of=lambda r: getattr(r, "protocol", "chained-best"))
     for (alg, n) in sorted(shown, key=lambda k: (k[1], k[0])):
         r = shown[(alg, n)]
         errs = max(x.errors for x in sorts

@@ -259,3 +259,28 @@ def main(argv=None):
 
 if __name__ == "__main__":
     sys.exit(main())
+
+
+def select_headline(rows, key_of, proto_of):
+    """The shared headline cell rule for every table AND figure
+    rendered from accumulated records: latest record wins per cell,
+    except a median-of-windows record is never displaced by a
+    non-median (legacy best-of/chained) one. One implementation so a
+    table and the figure beside it can never disagree — best-of across
+    sessions is banned from headlines (it kept corrupted-fast tunnel
+    windows, NORTHSTAR r3).
+
+    ``key_of(row) -> hashable cell key``; ``proto_of(row) -> str``
+    (the record's protocol tag, "median-of-windows" or legacy).
+    Returns {cell key: chosen row} preserving the input's append
+    order semantics.
+    """
+    chosen = {}
+    for r in rows:
+        k = key_of(r)
+        cur = chosen.get(k)
+        r_med = proto_of(r) == "median-of-windows"
+        cur_med = cur is not None and proto_of(cur) == "median-of-windows"
+        if cur is None or r_med or not cur_med:
+            chosen[k] = r
+    return chosen

@@ -231,7 +231,10 @@ def timeit_windows(fn, args: tuple, chain, windows: int = 5,
     for _ in range(2 * max(windows, 1)):
         if len(pers) >= windows:
             break
-        per, _, _ = _two_point_window(measure, runs, target_window_s)
+        per, win, _ = _two_point_window(measure, runs, target_window_s)
+        # carry the converged window size forward: later windows skip
+        # the sub-target growth probes the first one already paid for
+        runs = max(runs, win // 2)
         if floor_s is not None and per < floor_s:
             dropped.append(per)
             continue

@@ -1,0 +1,263 @@
+"""MoE measured study: expert capacity grid + dispatch throughput.
+
+Brings expert parallelism to the same measured standard as the dense
+path and the sorts. Two experiments:
+
+1. **Capacity grid** (the router's version of the sort capacity study,
+   ``icikit.bench.capacity``): the Switch dispatch packs tokens into
+   fixed ``(expert, capacity)`` buffers — the same static-shape
+   discipline the sample sort built for the reference's
+   ``MPI_Alltoallv`` (``psort.cc:277``, over-allocation at
+   ``psort.cc:385``) — and *drops* overflow (standard Switch
+   behavior, the residual passes dropped tokens through). The grid
+   measures the dropped-token fraction vs ``capacity_factor`` for
+   uniform (random init) and skewed routing, over expert counts: the
+   data behind choosing ``capacity_factor`` the way FIXTURES/
+   capacity_study chose the sort cap factors.
+
+2. **Dispatch throughput** (simulated mesh): tokens/s of the full MoE
+   FFN (route -> pack -> all-to-all -> expert compute -> inverse
+   all-to-all -> combine) vs expert count and dispatch algorithm —
+   every registered ``alltoall`` schedule can carry it, extending the
+   reference's hand-rolled-vs-vendor study to MoE routing. Simulated
+   host-thread numbers are *relative* (SCALING.md's caveat applies).
+
+CLI::
+
+    python -m icikit.bench.moe --capacity-grid --json moe_capacity.jsonl
+    python -m icikit.bench.moe --dispatch --devices 8
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _route(n_tokens: int, d_model: int, n_experts: int,
+           skew: float, seed: int):
+    """One routing pass -> (one-hot assignment, imbalance). ``skew``
+    adds a linear per-expert logit bias (0 = the random-init
+    near-uniform regime; 2-4 = a badly load-imbalanced router, the
+    stress case capacity planning must survive — the MoE analog of
+    the sorts' ODD_DIST input)."""
+    import jax
+    import jax.numpy as jnp
+
+    k1, k2 = jax.random.split(jax.random.key(seed))
+    x = jax.random.normal(k1, (n_tokens, d_model), jnp.float32)
+    wr = jax.random.normal(k2, (d_model, n_experts), jnp.float32)
+    wr = wr * (d_model ** -0.5)
+    logits = x @ wr + skew * jnp.linspace(0.0, 1.0, n_experts)
+    expert = jnp.argmax(logits, axis=-1)
+    oh = jax.nn.one_hot(expert, n_experts, dtype=jnp.float32)
+    imb = float(oh.sum(axis=0).max() / (n_tokens / n_experts))
+    return oh, imb
+
+
+def routing_drop_stats(n_tokens: int, d_model: int, n_experts: int,
+                       capacity_factor: float, skew: float = 0.0,
+                       seed: int = 0, _routed=None) -> dict:
+    """Fraction of tokens the Switch dispatch drops at this capacity.
+
+    Drop semantics come from the SHIPPED dispatch helpers
+    (``moe.switch_cap`` / ``moe.switch_slots``) — the grid measures
+    the path the model runs, not a re-implementation. Pure routing
+    math (no mesh, no comm): drop behavior depends only on the router
+    output and the capacity rule ``cap = cf * T / E``.
+    """
+    import jax.numpy as jnp
+
+    from icikit.models.transformer.moe import switch_cap, switch_slots
+
+    oh, imb = _routed if _routed is not None else _route(
+        n_tokens, d_model, n_experts, skew, seed)
+    cap = switch_cap(capacity_factor, n_tokens, n_experts)
+    _, keep = switch_slots(oh, cap)
+    dropped = float(1.0 - jnp.mean(keep))
+    return {
+        "kind": "moe_capacity",
+        "n_tokens": n_tokens,
+        "n_experts": n_experts,
+        "capacity_factor": capacity_factor,
+        "skew": skew,
+        "cap_slots": cap,
+        "drop_frac": round(dropped, 4),
+        "imbalance": round(imb, 3),
+    }
+
+
+def capacity_grid(n_tokens: int = 8192, d_model: int = 256,
+                  experts=(4, 8, 16), cfs=(0.5, 0.75, 1.0, 1.25, 1.5,
+                                           2.0),
+                  skews=(0.0, 2.0, 4.0)) -> list[dict]:
+    # one routing pass per (E, skew); the cf sweep reuses it (the
+    # assignment does not depend on capacity)
+    out = []
+    for e in experts:
+        for skew in skews:
+            routed = _route(n_tokens, d_model, e, skew, 0)
+            out += [routing_drop_stats(n_tokens, d_model, e, cf, skew,
+                                       _routed=routed) for cf in cfs]
+    return out
+
+
+def dispatch_bench(p: int = 8, experts=(8, 16),
+                   algorithms=("xla", "wraparound", "hypercube"),
+                   b: int = 8, s: int = 128, d_model: int = 256,
+                   d_ff: int = 512, capacity_factor: float = 1.25,
+                   runs: int = 3) -> list[dict]:
+    """Full MoE FFN tokens/s on the mesh, per (E, dispatch algorithm).
+
+    Uses the same shard_map entry the transformer uses
+    (``moe_ffn_shard`` over the dp axis), so the numbers measure the
+    shipped dispatch path, not a mock.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from icikit.models.transformer.moe import moe_ffn_shard
+    from icikit.parallel.shmap import shard_map
+    from icikit.utils.mesh import make_mesh
+    from icikit.utils.timing import timeit_chained
+
+    mesh = make_mesh(p)
+    axis = mesh.axis_names[0]
+    fabric = jax.devices()[0].platform
+    records = []
+    key = jax.random.key(0)
+    for e in experts:
+        if e % p:
+            print(f"skipping E={e}: does not divide p={p}",
+                  file=sys.stderr)
+            continue
+        e_loc = e // p
+        wr = jax.random.normal(key, (d_model, e), jnp.float32) * 0.06
+        we1 = jax.random.normal(key, (e_loc, d_model, d_ff),
+                                jnp.float32) * 0.06
+        we2 = jax.random.normal(key, (e_loc, d_ff, d_model),
+                                jnp.float32) * 0.04
+        x = jax.random.normal(key, (p * b, s, d_model), jnp.float32)
+        for alg in algorithms:
+            def per_shard(xb, alg=alg, e=e):
+                out, aux = moe_ffn_shard(
+                    xb, wr, we1, we2, axis=axis, p=p, n_experts=e,
+                    capacity_factor=capacity_factor, algorithm=alg)
+                return out + aux  # keep aux live
+
+            f = jax.jit(shard_map(
+                per_shard, mesh=mesh, in_specs=P(axis),
+                out_specs=P(axis), check_vma=False))
+
+            def chain(args, out):
+                return (out * 0.99,)
+
+            res = timeit_chained(f, (x,), chain, runs=runs, warmup=1)
+            tokens = p * b * s
+            records.append({
+                "kind": "moe_dispatch", "fabric": fabric,
+                "p": p, "n_experts": e, "algorithm": alg,
+                "tokens": tokens,
+                "capacity_factor": capacity_factor,
+                "mean_s": res.mean_s,
+                "tokens_per_s": round(tokens / res.mean_s, 1),
+            })
+    return records
+
+
+def render_markdown(cap_records, disp_records) -> str:
+    lines = ["# MoE measured study: capacity and dispatch\n"]
+    if cap_records:
+        lines.append(
+            "## Expert capacity grid (dropped-token fraction)\n")
+        lines.append(
+            "> `cap = capacity_factor * T / E` slots per expert "
+            "(GShard rule); overflow tokens are dropped (Switch "
+            "semantics — the residual carries them through unchanged). "
+            "`skew` adds a linear per-expert logit bias: 0 = random-"
+            "init router, 2-4 = badly imbalanced routing, the MoE "
+            "analog of the sorts' ODD_DIST stress input. `imb` = "
+            "busiest expert's load over uniform.\n")
+        for e in sorted({r["n_experts"] for r in cap_records}):
+            skews = sorted({r["skew"] for r in cap_records})
+            lines.append(f"### E = {e}\n")
+            lines.append("| cf | " + " | ".join(
+                f"skew={s:g} drop (imb)" for s in skews) + " |")
+            lines.append("|---|" + "---|" * len(skews))
+            cfs = sorted({r["capacity_factor"] for r in cap_records
+                          if r["n_experts"] == e})
+            for cf in cfs:
+                row = [f"{cf:g}"]
+                for s in skews:
+                    rec = next((r for r in cap_records
+                                if r["n_experts"] == e
+                                and r["capacity_factor"] == cf
+                                and r["skew"] == s), None)
+                    row.append(f"{rec['drop_frac']:.1%} "
+                               f"({rec['imbalance']:.2f})"
+                               if rec else "—")
+                lines.append("| " + " | ".join(row) + " |")
+            lines.append("")
+    if disp_records:
+        lines.append("## Dispatch throughput (simulated host-thread "
+                     "mesh — relative numbers)\n")
+        algs = sorted({r["algorithm"] for r in disp_records})
+        lines.append("| E | " + " | ".join(
+            f"{a} tokens/s" for a in algs) + " |")
+        lines.append("|---|" + "---|" * len(algs))
+        for e in sorted({r["n_experts"] for r in disp_records}):
+            row = [str(e)]
+            for a in algs:
+                rec = next((r for r in disp_records
+                            if r["n_experts"] == e
+                            and r["algorithm"] == a), None)
+                row.append(f"{rec['tokens_per_s']:,.0f}" if rec else "—")
+            lines.append("| " + " | ".join(row) + " |")
+        lines.append("")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--capacity-grid", action="store_true")
+    ap.add_argument("--dispatch", action="store_true")
+    ap.add_argument("--devices", type=int, default=8)
+    ap.add_argument("--simulate", action="store_true",
+                    help="simulated CPU mesh for --dispatch")
+    ap.add_argument("--runs", type=int, default=3)
+    ap.add_argument("--json", dest="json_path", default=None)
+    ap.add_argument("--out", default=None,
+                    help="render/refresh MOE.md-style markdown here")
+    args = ap.parse_args(argv)
+
+    if args.simulate:
+        import jax
+        try:
+            jax.config.update("jax_platforms", "cpu")
+            jax.config.update("jax_num_cpu_devices", args.devices)
+        except (RuntimeError, AttributeError) as e:
+            print(f"simulate ignored ({e})", file=sys.stderr)
+
+    cap_records, disp_records = [], []
+    if args.capacity_grid:
+        cap_records = capacity_grid()
+    if args.dispatch:
+        disp_records = dispatch_bench(p=args.devices, runs=args.runs)
+    for r in cap_records + disp_records:
+        print(json.dumps(r))
+    if args.json_path:
+        # append: record files accumulate across invocations
+        with open(args.json_path, "a") as f:
+            for r in cap_records + disp_records:
+                f.write(json.dumps(r) + "\n")
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(render_markdown(cap_records, disp_records))
+        print(f"wrote {args.out}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -25,6 +25,23 @@ import jax.numpy as jnp
 from icikit.utils.registry import get_algorithm
 
 
+def switch_cap(capacity_factor: float, t: int, n_experts: int) -> int:
+    """GShard capacity rule: per-expert slot budget for t tokens."""
+    return max(1, int(capacity_factor * t / n_experts))
+
+
+def switch_slots(oh, cap: int):
+    """Slot each token within its expert's capacity from the one-hot
+    assignment ``oh (t, E)``; returns (slot (t,), keep (t,)) with
+    overflow (slot >= cap) marked dropped and slot clamped. The single
+    copy of the dispatch's drop semantics — the capacity study
+    (bench.moe) measures through this same function."""
+    pos = jnp.cumsum(oh, axis=0) - oh          # tokens before me, same e
+    slot = jnp.sum(pos * oh, axis=1).astype(jnp.int32)
+    keep = slot < cap
+    return jnp.minimum(slot, cap - 1), keep
+
+
 def moe_ffn_shard(x, wr, we1, we2, *, axis: str, p: int, n_experts: int,
                   capacity_factor: float, algorithm: str = "xla"):
     """Per-shard MoE FFN.
@@ -52,7 +69,7 @@ def moe_ffn_shard(x, wr, we1, we2, *, axis: str, p: int, n_experts: int,
     b, s, d_model = x.shape
     e_loc = n_experts // p
     t = b * s
-    cap = max(1, int(capacity_factor * t / n_experts))
+    cap = switch_cap(capacity_factor, t, n_experts)
     xt = x.reshape(t, d_model)
 
     # --- route: top-1 expert per token, fp32 softmax.
@@ -70,10 +87,7 @@ def moe_ffn_shard(x, wr, we1, we2, *, axis: str, p: int, n_experts: int,
 
     # --- dispatch slots: position of each token within its expert's
     # capacity; overflow (slot >= cap) is dropped.
-    pos = jnp.cumsum(oh, axis=0) - oh              # tokens before me, same e
-    slot = jnp.sum(pos * oh, axis=1).astype(jnp.int32)   # (t,)
-    keep = (slot < cap)
-    slot = jnp.minimum(slot, cap - 1)
+    slot, keep = switch_slots(oh, cap)
 
     # --- pack (E, cap, D) send buffer; block j goes to rank j.
     buf = jnp.zeros((n_experts, cap, d_model), x.dtype)

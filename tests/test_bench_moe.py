@@ -1,0 +1,42 @@
+"""MoE study bench: capacity-grid semantics and the dispatch path."""
+
+import jax
+import pytest
+
+from icikit.bench.moe import (capacity_grid, dispatch_bench,
+                              render_markdown, routing_drop_stats)
+
+
+def test_drop_monotone_in_capacity():
+    """More capacity never drops more tokens; sub-unit capacity must
+    drop at least the arithmetic deficit (T tokens, cf*T slots)."""
+    rows = [routing_drop_stats(2048, 64, 8, cf, skew=0.0)
+            for cf in (0.5, 1.0, 1.5)]
+    drops = [r["drop_frac"] for r in rows]
+    assert drops[0] >= drops[1] >= drops[2]
+    assert drops[0] >= 0.5 - 1e-6  # cf=0.5 holds half the tokens
+    assert drops[2] <= 0.02        # uniform routing fits at cf=1.5
+
+
+def test_skew_increases_drop_and_imbalance():
+    base = routing_drop_stats(2048, 64, 8, 1.25, skew=0.0)
+    skewed = routing_drop_stats(2048, 64, 8, 1.25, skew=4.0)
+    assert skewed["drop_frac"] > base["drop_frac"]
+    assert skewed["imbalance"] > base["imbalance"] > 0.9
+
+
+def test_capacity_grid_shape():
+    recs = capacity_grid(n_tokens=512, d_model=32, experts=(4,),
+                         cfs=(1.0, 2.0), skews=(0.0,))
+    assert len(recs) == 2
+    assert all(r["kind"] == "moe_capacity" for r in recs)
+
+
+def test_dispatch_bench_runs_on_mesh():
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device simulated mesh")
+    recs = dispatch_bench(p=8, experts=(8,), algorithms=("xla",),
+                          b=2, s=16, d_model=32, d_ff=64, runs=2)
+    assert recs and recs[0]["tokens_per_s"] > 0
+    text = render_markdown([], recs)
+    assert "Dispatch throughput" in text

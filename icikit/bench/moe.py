@@ -25,7 +25,7 @@ path and the sorts. Two experiments:
 CLI::
 
     python -m icikit.bench.moe --capacity-grid --json moe_capacity.jsonl
-    python -m icikit.bench.moe --dispatch --devices 8
+    python -m icikit.bench.moe --dispatch --simulate --devices 8
 """
 
 from __future__ import annotations
@@ -201,8 +201,10 @@ def render_markdown(cap_records, disp_records) -> str:
                 lines.append("| " + " | ".join(row) + " |")
             lines.append("")
     if disp_records:
-        lines.append("## Dispatch throughput (simulated host-thread "
-                     "mesh — relative numbers)\n")
+        fabric = disp_records[0].get("fabric", "cpu")
+        fab_note = ("simulated host-thread mesh — relative numbers"
+                    if fabric == "cpu" else f"real {fabric} devices")
+        lines.append(f"## Dispatch throughput ({fab_note})\n")
         algs = sorted({r["algorithm"] for r in disp_records})
         lines.append("| E | " + " | ".join(
             f"{a} tokens/s" for a in algs) + " |")
@@ -244,6 +246,12 @@ def main(argv=None) -> int:
     if args.capacity_grid:
         cap_records = capacity_grid()
     if args.dispatch:
+        import jax
+        if len(jax.devices()) < args.devices:
+            print(f"need {args.devices} devices for --dispatch (have "
+                  f"{len(jax.devices())}); add --simulate for the "
+                  "host-thread mesh", file=sys.stderr)
+            return 1
         disp_records = dispatch_bench(p=args.devices, runs=args.runs)
     for r in cap_records + disp_records:
         print(json.dumps(r))

@@ -1,0 +1,299 @@
+"""Pipeline-parallel bubble study: measured vs the GPipe model.
+
+The pp schedule (``models/transformer/pipeline.py``) runs ``m + p - 1``
+unrolled stage sweeps for ``m`` microbatches over ``p`` stages — the
+ring pass-through ancestry (``Communication/src/main.cc:190-223``) with
+activations as payload. In this SPMD formulation the bubble is not
+idle time but *masked wasted compute*: every device executes every
+sweep, and ``jnp.where`` masks select the valid contributions. Useful
+fraction = m/(m+p-1); bubble fraction = (p-1)/(m+p-1) — the GPipe
+trade tuned with ``n_microbatches``.
+
+Two halves, like every study in this repo:
+
+- **Analytic** (machine-checked, no hardware): the per-shard program
+  is traced to a jaxpr over an AbstractMesh and its structure counted —
+  exactly ``m + p - 2`` forward ``ppermute``s, stage compute
+  proportional to ``m + p - 1`` sweeps. This pins the schedule's
+  shape the way ``schedule_stats`` pins the collectives'.
+- **Measured** (simulated host-thread mesh): per-token fwd+bwd step
+  time vs ``m`` at fixed microbatch size. The model predicts
+  ``t_tok(m) = T_sweep * (m+p-1) / m + c``; the study fits ``T_sweep``
+  and reports each point's measured efficiency against the ideal
+  ``m/(m+p-1)`` curve.
+
+CLI::
+
+    python -m icikit.bench.pipeline --pp 4 --ms 1,2,4,8,16 \\
+        --json pipeline_study.jsonl --out PIPELINE.md
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def analytic_pp_counts(cfg, p: int, m: int, b: int = 2,
+                       s: int = 16) -> dict:
+    """Trace the pipeline loss program and count its structure."""
+    import jax
+    from jax.sharding import AbstractMesh
+
+    from icikit.models.transformer.pipeline import (
+        DP_AXIS, PP_AXIS, _build_pp_loss_and_grad)
+
+    mesh = AbstractMesh((1, p), (DP_AXIS, PP_AXIS))
+    # _build_pp_loss_and_grad wraps in jit+shard_map; tracing the
+    # wrapped callable over abstract operands counts the real program
+    fn = _build_pp_loss_and_grad(mesh, cfg, m, (b, s))
+    import jax.numpy as jnp
+
+    # build abstract params directly from the shape table (eval_shape
+    # of init on a concrete mesh is heavy)
+    shapes = _pp_param_shapes(cfg)
+    params = {k: jax.ShapeDtypeStruct(v, jnp.float32)
+              for k, v in shapes.items()}
+    toks = jax.ShapeDtypeStruct((m, b, s), jnp.int32)
+    jaxpr = jax.make_jaxpr(fn)(params, toks, toks)
+
+    counts = {"ppermute": 0}
+
+    def walk(jx):
+        for eqn in jx.eqns:
+            if eqn.primitive.name == "ppermute":
+                counts["ppermute"] += 1
+            for v in eqn.params.values():
+                if hasattr(v, "jaxpr"):
+                    walk(v.jaxpr if hasattr(v.jaxpr, "eqns") else v)
+                elif hasattr(v, "eqns"):
+                    walk(v)
+
+    walk(jaxpr.jaxpr)
+    # the traced program is value_and_grad: the backward pipeline is
+    # the autodiff TRANSPOSE of the forward ppermute chain, so the
+    # trace must contain exactly 2x(m+p-2) ppermutes — counting them
+    # machine-checks both the forward schedule length and the
+    # transpose property the module docstring claims
+    return {"kind": "pp_analytic", "p": p, "m": m,
+            "ppermutes": counts["ppermute"],
+            "expected_ppermutes": 2 * (m + p - 2),
+            "sweeps": m + p - 1,
+            "ideal_efficiency": round(m / (m + p - 1), 4)}
+
+
+def _pp_param_shapes(cfg) -> dict:
+    L, D, H, Dh, F = (cfg.n_layers, cfg.d_model, cfg.n_heads,
+                      cfg.d_head, cfg.d_ff)
+    shapes = {
+        "emb": (cfg.vocab, D), "ln_f": (D,),
+        "ln1": (L, D), "ln2": (L, D),
+        "wo": (L, H, Dh, D), "w_out": (cfg.vocab, D),
+        "w1": (L, D, F), "w2": (L, F, D),
+    }
+    if cfg.n_kv_heads and cfg.n_kv_heads != cfg.n_heads:
+        shapes["wq"] = (L, D, H, Dh)
+        shapes["wkv"] = (L, D, 2, cfg.n_kv_heads, Dh)
+    else:
+        shapes["wqkv"] = (L, D, 3, H, Dh)
+    if cfg.pos_encoding == "learned":
+        shapes["pos"] = (cfg.max_seq, D)
+    return shapes
+
+
+def bubble_sweep(pp: int = 4, ms=(1, 2, 4, 8, 16), b_micro: int = 2,
+                 s: int = 64, runs: int = 3) -> list[dict]:
+    """Per-token pipeline step time vs microbatch count on the mesh.
+
+    Fixed microbatch size: total tokens grow with m, so per-token time
+    isolates the bubble (a bubble-free pipeline would be flat in m).
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from icikit.models.transformer import TransformerConfig
+    from icikit.models.transformer.pipeline import (
+        init_pp_params, make_pp_mesh, pp_loss_fn)
+    from icikit.utils.timing import timeit_chained
+
+    cfg = TransformerConfig(vocab=512, d_model=128, n_heads=4,
+                            d_head=32, d_ff=256, n_layers=pp * 2,
+                            max_seq=s, compute_dtype="float32")
+    mesh = make_pp_mesh(dp=1, pp=pp)
+    params = init_pp_params(jax.random.key(0), cfg, mesh)
+    rng = np.random.default_rng(0)
+    records = []
+    for m in ms:
+        sh = NamedSharding(mesh, P(None, "dp"))
+        tok = jax.device_put(jnp.asarray(
+            rng.integers(0, cfg.vocab, (m, b_micro, s)), jnp.int32), sh)
+
+        def f(params, tok=tok, m=m):
+            loss, grads = pp_loss_fn(params, tok, tok, mesh, cfg, m)
+            return loss, grads
+
+        jf = jax.jit(f)
+
+        def chain(args, out):
+            # nudge params by a gradient leaf so runs are value-distinct
+            p2 = dict(args[0])
+            p2["ln_f"] = p2["ln_f"] + 1e-6 * out[1]["ln_f"]
+            return (p2,)
+
+        res = timeit_chained(jf, (params,), chain, runs=runs, warmup=1)
+        tokens = m * b_micro * s
+        records.append({
+            "kind": "pp_bubble", "p": pp, "m": m,
+            "b_micro": b_micro, "s": s, "tokens": tokens,
+            "step_s": res.mean_s,
+            "per_token_us": round(res.mean_s / tokens * 1e6, 2),
+            "ideal_efficiency": round(m / (m + pp - 1), 4),
+        })
+    return records
+
+
+def fit_and_render(analytic, measured) -> str:
+    lines = ["# Pipeline parallelism: bubble fraction vs microbatches\n"]
+    lines.append(
+        "The GPipe schedule runs m + p − 1 stage sweeps for m "
+        "microbatches over p stages; in the SPMD formulation the "
+        "bubble is *masked wasted compute*, so per-token time should "
+        "follow T·(m+p−1)/m + c exactly. Ideal efficiency = "
+        "m/(m+p−1), bubble = (p−1)/(m+p−1). Measured on the simulated "
+        "host-thread mesh (relative numbers; SCALING.md's caveat).\n")
+    if analytic:
+        lines.append("## Analytic schedule structure (traced)\n")
+        lines.append(
+            "> ppermute count is for the traced fwd+bwd program: the "
+            "backward pipeline is the autodiff transpose of the "
+            "forward chain, so the trace must hold exactly 2(m+p−2) "
+            "— the count checks the schedule length AND the transpose "
+            "property.\n")
+        lines.append("| p | m | ppermutes (traced = 2(m+p−2)) | "
+                     "sweeps | ideal efficiency |")
+        lines.append("|---|---|---|---|---|")
+        for r in analytic:
+            ok = "✓" if r["ppermutes"] == r["expected_ppermutes"] \
+                else "✗ MISMATCH"
+            lines.append(
+                f"| {r['p']} | {r['m']} | {r['ppermutes']} = "
+                f"{r['expected_ppermutes']} {ok} | {r['sweeps']} | "
+                f"{r['ideal_efficiency']:.3f} |")
+        lines.append("")
+    for p in sorted({r["p"] for r in measured}):
+        rows = sorted((r for r in measured if r["p"] == p),
+                      key=lambda r: r["m"])
+        # least-squares fit of t_tok = T*(m+p-1)/m + c over ALL points
+        # (two parameters, no anchoring — an anchored fit would make
+        # its anchor row match the ideal by construction)
+        xs = [(r["m"] + p - 1) / r["m"] for r in rows]
+        ys = [r["per_token_us"] for r in rows]
+        n = len(rows)
+        if n >= 2:
+            sx, sy = sum(xs), sum(ys)
+            sxx = sum(x * x for x in xs)
+            sxy = sum(x * y for x, y in zip(xs, ys))
+            t_sweep = (n * sxy - sx * sy) / (n * sxx - sx * sx)
+            c = (sy - t_sweep * sx) / n
+        else:
+            t_sweep, c = ys[0] / xs[0], 0.0
+        lines.append("## Measured per-token time vs m "
+                     f"(pp={p}, fwd+bwd): least-squares "
+                     f"t_tok = {t_sweep:.1f}·(m+p−1)/m + {c:.1f} µs\n")
+        lines.append("| m | per-token µs | model fit | residual | "
+                     "ideal m/(m+p−1) |")
+        lines.append("|---|---|---|---|---|")
+        for r, x in zip(rows, xs):
+            model = t_sweep * x + c
+            resid = (r["per_token_us"] - model) / model
+            lines.append(
+                f"| {r['m']} | {r['per_token_us']:.1f} | {model:.1f} | "
+                f"{resid:+.1%} | {r['ideal_efficiency']:.3f} |")
+        lines.append("")
+        lines.append(
+            "Small residuals mean per-token time is linear in "
+            "(m+p−1)/m — the bubble model — with the fitted constant "
+            "c absorbing fixed per-step costs (head/embed masking "
+            "work runs every sweep). The bubble term T·(m+p−1)/m "
+            "shrinks toward T as m grows, which is the whole GPipe "
+            "trade.\n")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--pp", type=int, default=4)
+    ap.add_argument("--ms", default="1,2,4,8,16")
+    ap.add_argument("--runs", type=int, default=3)
+    ap.add_argument("--skip-measure", action="store_true",
+                    help="analytic table only (no mesh, no timing)")
+    ap.add_argument("--json", dest="json_path", default=None)
+    ap.add_argument("--out", default=None)
+    ap.add_argument("--regen", default=None, metavar="JSONL",
+                    help="re-render --out from accumulated records "
+                         "(best per (p, m) cell — the CPU fabric's "
+                         "run-to-run wobble is host-scheduler noise, "
+                         "and the fastest run is the least-disturbed "
+                         "one, same convention as the collective "
+                         "tables on this fabric) instead of measuring")
+    args = ap.parse_args(argv)
+
+    if args.regen:
+        recs = [json.loads(ln) for ln in open(args.regen)
+                if ln.strip()]
+        analytic = [r for r in recs if r["kind"] == "pp_analytic"]
+        # dedupe analytic by (p, m) (idempotent), best measured cell
+        seen = {}
+        for r in analytic:
+            seen[(r["p"], r["m"])] = r
+        analytic = [seen[k] for k in sorted(seen)]
+        best = {}
+        for r in recs:
+            if r["kind"] != "pp_bubble":
+                continue
+            k = (r["p"], r["m"])
+            if k not in best or r["per_token_us"] < best[k]["per_token_us"]:
+                best[k] = r
+        measured = [best[k] for k in sorted(best)]
+        out = args.out or "PIPELINE.md"
+        with open(out, "w") as f:
+            f.write(fit_and_render(analytic, measured))
+        print(f"wrote {out}", file=sys.stderr)
+        return 0
+
+    ms = tuple(int(x) for x in args.ms.split(","))
+
+    from icikit.models.transformer import TransformerConfig
+    tiny = TransformerConfig(vocab=64, d_model=32, n_heads=2, d_head=16,
+                             d_ff=64, n_layers=args.pp, max_seq=16,
+                             compute_dtype="float32")
+    analytic = [analytic_pp_counts(tiny, args.pp, m) for m in ms]
+    measured = []
+    if not args.skip_measure:
+        import jax
+        if len(jax.devices()) < args.pp:
+            print(f"need {args.pp} devices for the measured half "
+                  f"(have {len(jax.devices())}); run under "
+                  "JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_"
+                  f"platform_device_count={args.pp}", file=sys.stderr)
+            return 1
+        measured = bubble_sweep(args.pp, ms, runs=args.runs)
+    for r in analytic + measured:
+        print(json.dumps(r))
+    if args.json_path:
+        # append: record files accumulate across invocations
+        with open(args.json_path, "a") as f:
+            for r in analytic + measured:
+                f.write(json.dumps(r) + "\n")
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(fit_and_render(analytic, measured))
+        print(f"wrote {args.out}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -126,7 +126,8 @@ def run_bench(preset: str, dp: int, tp: int, sp: int, batch: int,
               kv_heads: int = 0, remat: bool = True,
               remat_policy: str = "nothing",
               calibrate_peak: bool = False,
-              optimizer: str = "fused", windows: int = 3) -> dict:
+              optimizer: str = "fused", windows: int = 3,
+              softmax_shift: float | None = None) -> dict:
     import optax
 
     from icikit.models.transformer import (
@@ -137,7 +138,8 @@ def run_bench(preset: str, dp: int, tp: int, sp: int, batch: int,
 
     cfg = TransformerConfig(**PRESETS[preset], n_experts=moe_experts,
                             n_kv_heads=kv_heads, remat=remat,
-                            remat_policy=remat_policy)
+                            remat_policy=remat_policy,
+                            softmax_shift=softmax_shift)
     mesh = make_model_mesh(dp=dp, tp=tp, sp=sp)
     params = init_params(jax.random.key(0), cfg, mesh)
     # fused = the one-pass FusedAdam formulation (XLA-lowered by
@@ -211,6 +213,8 @@ def run_bench(preset: str, dp: int, tp: int, sp: int, batch: int,
         remat_tag = f"_rp-{remat_policy}"
     if opt_name != "fused":
         remat_tag += f"_opt-{opt_name}"
+    if softmax_shift is not None:
+        remat_tag += f"_shift{softmax_shift:g}"
     rec = {
         "metric":
             f"train_{preset}_dp{dp}tp{tp}sp{sp}_b{batch}{moe_tag}"
@@ -272,6 +276,9 @@ def main(argv=None) -> int:
                          "+15 ms at base/b=8 from layout conversion "
                          "copies — kept for reproducing that A/B); "
                          "optax = stock optax.adam pipeline")
+    ap.add_argument("--softmax-shift", type=float, default=None,
+                    help="constant-shift softmax forward (removes the "
+                         "rowmax chain; traced overflow fallback)")
     ap.add_argument("--windows", type=int, default=3,
                     help="median-of-windows headline protocol; each "
                          "window is one chained --steps loop")
@@ -285,7 +292,8 @@ def main(argv=None) -> int:
                     args.steps, args.warmup, args.experts, args.kv_heads,
                     remat=args.remat, remat_policy=args.remat_policy,
                     calibrate_peak=args.calibrate_peak,
-                    optimizer=args.optimizer, windows=args.windows)
+                    optimizer=args.optimizer, windows=args.windows,
+                    softmax_shift=args.softmax_shift)
     print(json.dumps(rec))
     return 0
 

@@ -83,6 +83,12 @@ class TransformerConfig:
     # its full local sequence (sp == 1, pipeline stages); the ring
     # schedule owns the sp > 1 path.
     attention_impl: str = "flash"
+    # constant-shift softmax forward (ops/flash_attention): removes
+    # the rowmax chain — the measured exposed VPU cost of the tile
+    # loop — with a traced exact-fallback on overflow. None = exact
+    # online softmax; 16.0 is safe for unit-variance streams. Applies
+    # to the local (p_sp == 1) flash path only.
+    softmax_shift: float | None = None
     # Positional encoding: "learned" (trained absolute table, the
     # default) or "rope" (rotary on Q/K — relative positions, so every
     # schedule applies it locally with global indices; no "pos" param).
@@ -404,6 +410,13 @@ def _forward_local(params, tokens, cfg: TransformerConfig, p_sp: int,
             k = apply_rope(k, positions, cfg.rope_theta)
         if p_sp == 1:  # full sequence is local: use the fused kernel
             k, v = repeat_kv(k, n_rep), repeat_kv(v, n_rep)
+            if (cfg.attention_impl == "flash"
+                    and cfg.softmax_shift is not None):
+                # single selection point; flash_attention accepts the
+                # shift and handles the unsupported-shape fallback
+                return resolve_attention_impl("flash")(
+                    q, k, v, causal=True,
+                    softmax_shift=cfg.softmax_shift)
             return resolve_attention_impl(cfg.attention_impl)(
                 q, k, v, causal=True)
         if cfg.sequence_schedule == "ulysses":

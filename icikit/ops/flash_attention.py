@@ -107,7 +107,7 @@ def _tri_bias(bq, bk):
     return jnp.where(qpos >= kpos, 0.0, NEG_INF)
 
 
-def _init_mask_bias(bias_s, iq, ik, bq, bk):
+def _init_mask_bias(bias_s, iq, ik, bq, bk, base: float = 0.0):
     """Fill the (3·bq, bk) additive-mask scratch at the first grid step:
     rows [0, bq) hold all-NEG_INF (tiles strictly above the diagonal —
     reachable only as the upper half of a coarse K block that straddles
@@ -115,15 +115,19 @@ def _init_mask_bias(bias_s, iq, ik, bq, bk):
     rows [2·bq, 3·bq) zeros for interior tiles. With square tiles
     (bq == bk) every diagonal-crossing tile shares one relative
     pattern, so the per-tile iota/compare/select collapses to one
-    dynamic-slice read folded into the scale fma."""
+    dynamic-slice read folded into the scale fma.
+
+    ``base`` shifts the valid entries (the constant-shift kernel folds
+    its −shift here, so the shift costs zero runtime ops)."""
     first = ((pl.program_id(0) == 0) & (pl.program_id(1) == 0)
              & (iq == 0) & (ik == 0))
 
     @pl.when(first)
     def _():
         bias_s[pl.ds(0, bq), :] = jnp.full((bq, bk), NEG_INF, jnp.float32)
-        bias_s[pl.ds(bq, bq), :] = _tri_bias(bq, bk)
-        bias_s[pl.ds(2 * bq, bq), :] = jnp.zeros((bq, bk), jnp.float32)
+        bias_s[pl.ds(bq, bq), :] = _tri_bias(bq, bk) + base
+        bias_s[pl.ds(2 * bq, bq), :] = jnp.full((bq, bk), base,
+                                                jnp.float32)
 
 
 def _mask_bias(bias_s, iq, ik, bq):
@@ -222,8 +226,81 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s, acc,
                             + jnp.log(l_tot[:, 0]))
 
 
+def _fwd_const_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, l_s, acc,
+                      *bias_s, scale, causal, nk, bq, bk, ks,
+                      shift: float):
+    """Constant-shift streaming forward: ``w = exp2(s − shift)`` with a
+    FIXED shift instead of the online rowmax. The tile-floor ablations
+    (``bench/tile_floor.py``) showed the exposed per-tile cost of the
+    d=64 forward is the rowmax chain (~0.5 µs/tile), not the exp2
+    (~0) — removing the max dependency lets Mosaic overlap the rest.
+    The shift folds into the mask-bias scratch (square tiles) or the
+    scale fma, so it costs zero extra ops.
+
+    Numerical contract: safe while max_row |s·scale·log2e − shift|
+    stays within fp32 exp2 range (~±126). Overflow (scores ≫ shift)
+    makes ``l`` inf → lse inf; total underflow makes l = 0 → lse
+    −inf. Both are DETECTABLE from the returned lse (callers check
+    ``jnp.isfinite(lse)``) and the wrapper re-runs the online-softmax
+    kernel on detection — the same optimistic-with-fallback discipline
+    as the sorts' capacity retry. Opt-in via ``softmax_shift``; the
+    default path keeps exact online softmax."""
+    iq, ik = pl.program_id(2), pl.program_id(3)
+
+    if bias_s:  # shift pre-folded into the bias tiles (base=-shift)
+        _init_mask_bias(bias_s[0], iq, ik, bq, bk, base=-shift)
+
+    @pl.when(ik == 0)
+    def _():
+        l_s[:] = jnp.zeros_like(l_s)
+        acc[:] = jnp.zeros_like(acc)
+
+    if causal:
+        run = ik * (ks * bk) <= iq * bq + bq - 1
+    else:
+        run = ik >= 0
+
+    @pl.when(run)
+    def _():
+        q = q_ref[0, 0]
+        for j in range(ks):
+            k = k_ref[0, 0, j * bk:(j + 1) * bk]
+            v = v_ref[0, 0, j * bk:(j + 1) * bk]
+            ikj = ik * ks + j
+            raw = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                  preferred_element_type=jnp.float32)
+            if bias_s:
+                s = (raw * (scale * _LOG2E)
+                     + _mask_bias(bias_s[0], iq, ikj, bq))
+            elif causal:
+                s = _causal_mask(raw * (scale * _LOG2E) - shift,
+                                 iq, ikj, bq, bk)
+            else:
+                s = raw * (scale * _LOG2E) - shift
+            w = jnp.exp2(s)
+            rows = pl.ds(j * bq, bq)
+            l_s[rows] += jnp.sum(w, axis=1, keepdims=True)
+            acc[rows] += lax.dot_general(
+                w.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+
+    @pl.when(ik == nk - 1)
+    def _():
+        # bank merge is a plain sum — no max, no rescale
+        l_tot = l_s[pl.ds(0, bq)][:, :1]
+        o_tot = acc[pl.ds(0, bq)]
+        for j in range(1, ks):
+            rows = pl.ds(j * bq, bq)
+            l_tot = l_tot + l_s[rows][:, :1]
+            o_tot = o_tot + acc[rows]
+        o_ref[0, 0] = (o_tot / l_tot).astype(o_ref.dtype)
+        # lse = ln Σ e^z = shift·ln2 + ln(l): same form as the online
+        # kernel with the constant standing in for the rowmax
+        lse_ref[0, 0, 0] = shift * _LN2 + jnp.log(l_tot[:, 0])
+
+
 def _fwd_single_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *bias_s,
-                       scale, causal, bq, bk):
+                       scale, causal, bq, bk, shift=None):
     """One K block covers the whole row (nk == 1, the s <= 1024 train
     case): no online-softmax carry — direct rowwise max/sum with no
     (m, l, acc) scratch, no -inf init pass and no alpha rescale. The
@@ -247,7 +324,13 @@ def _fwd_single_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *bias_s,
             s = _causal_mask(raw * (scale * _LOG2E), 0, 0, bq, bk)
         else:
             s = raw * (scale * _LOG2E)
-        m = jnp.max(s, axis=1, keepdims=True)
+        if shift is None:
+            m = jnp.max(s, axis=1, keepdims=True)
+        else:
+            # constant-shift variant: the rowmax chain is the tile
+            # loop's exposed VPU cost (bench/tile_floor.py); a fixed
+            # shift removes it, overflow is detectable from lse
+            m = jnp.full((bq, 1), shift, jnp.float32)
         w = jnp.exp2(s - m)
         l = jnp.sum(w, axis=1, keepdims=True)
         acc = lax.dot_general(w.astype(v.dtype), v,
@@ -257,13 +340,14 @@ def _fwd_single_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *bias_s,
         lse_ref[0, 0, 0] = m[:, 0] * _LN2 + jnp.log(l[:, 0])
 
 
-def _fwd_single_call(qt, kt, vt, causal, scale, bq, bk, interpret):
+def _fwd_single_call(qt, kt, vt, causal, scale, bq, bk, interpret,
+                     shift=None):
     b, h, sq, d = qt.shape
     at = lambda ib, ih: (ib, ih, 0, 0)  # noqa: E731
     bias_scratch = ([pltpu.VMEM((bq, bk), jnp.float32)] if causal else [])
     return pl.pallas_call(
         partial(_fwd_single_kernel, scale=scale, causal=causal,
-                bq=bq, bk=bk),
+                bq=bq, bk=bk, shift=shift),
         grid=(b, h),
         in_specs=[
             pl.BlockSpec((1, 1, bq, d), at),
@@ -287,18 +371,33 @@ def _fwd_single_call(qt, kt, vt, causal, scale, bq, bk, interpret):
     )(qt, kt, vt)
 
 
-def _fwd_call(qt, kt, vt, causal, scale, bq, bk, interpret, ksplit=1):
+def _fwd_call(qt, kt, vt, causal, scale, bq, bk, interpret, ksplit=1,
+              shift=None):
     b, h, sq, d = qt.shape
     sk = kt.shape[2]
     if sq // bq == 1 and sk // bk == 1:
         return _fwd_single_call(qt, kt, vt, causal, scale, bq, bk,
-                                interpret)
+                                interpret, shift)
     if sk % (bk * ksplit):
         ksplit = 1
     cbk = bk * ksplit  # coarse (DMA) K block: ksplit sub-blocks
     nq, nk = sq // bq, sk // cbk
-    kernel = partial(_fwd_kernel, scale=scale, causal=causal, nk=nk,
-                     bq=bq, bk=bk, ks=ksplit)
+    if shift is None:
+        kernel = partial(_fwd_kernel, scale=scale, causal=causal,
+                         nk=nk, bq=bq, bk=bk, ks=ksplit)
+        stat_scratch = [
+            pltpu.VMEM((ksplit * bq, 128), jnp.float32),  # running max
+            pltpu.VMEM((ksplit * bq, 128), jnp.float32),  # normalizer
+            pltpu.VMEM((ksplit * bq, d), jnp.float32),    # out accum
+        ]
+    else:
+        kernel = partial(_fwd_const_kernel, scale=scale, causal=causal,
+                         nk=nk, bq=bq, bk=bk, ks=ksplit,
+                         shift=float(shift))
+        stat_scratch = [
+            pltpu.VMEM((ksplit * bq, 128), jnp.float32),  # normalizer
+            pltpu.VMEM((ksplit * bq, d), jnp.float32),    # out accum
+        ]
     use_bias = causal and bq == bk and nk * ksplit > 1
     bias_scratch = ([pltpu.VMEM((3 * bq, bk), jnp.float32)]
                     if use_bias else [])
@@ -336,9 +435,7 @@ def _fwd_call(qt, kt, vt, causal, scale, bq, bk, interpret, ksplit=1):
         ],
         scratch_shapes=[
             # ks independent accumulator banks, rows [j*bq, (j+1)*bq)
-            pltpu.VMEM((ksplit * bq, 128), jnp.float32),  # running max
-            pltpu.VMEM((ksplit * bq, 128), jnp.float32),  # normalizer
-            pltpu.VMEM((ksplit * bq, d), jnp.float32),    # out accum
+            *stat_scratch,
             *bias_scratch,                        # additive causal mask
         ],
         # the (3·bq, bk) bias tile overflows Mosaic's default 16 MB
@@ -701,17 +798,41 @@ def _bwd_call(qt, kt, vt, do, lse, delta, causal, scale, bq, bk, interpret):
 
 # ------------------------------------------------------------- custom_vjp
 
-@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
-def _flash(qt, kt, vt, causal, scale, bq, bk, ks, interpret):
-    return _fwd_call(qt, kt, vt, causal, scale, bq, bk, interpret, ks)
+def _fwd_with_fallback(qt, kt, vt, causal, scale, bq, bk, ks,
+                       interpret, shift):
+    """Constant-shift forward with the exact-fallback INSIDE the
+    custom_vjp boundary: overflow (non-finite lse) re-runs the online
+    kernel via a traced cond, so the residuals the backward sees are
+    always the final, correct (out, lse). A fallback outside the
+    custom_vjp would leave the shift-branch's backward always live
+    under grad, and on overflow its NaN/inf residuals poison the
+    gradients (delta = 0 x NaN) even though the forward fell back."""
+    out, lse = _fwd_call(qt, kt, vt, causal, scale, bq, bk, interpret,
+                         ks, shift)
+    if shift is None:
+        return out, lse
+    return lax.cond(
+        jnp.isfinite(lse).all(),
+        lambda: (out, lse),
+        lambda: _fwd_call(qt, kt, vt, causal, scale, bq, bk,
+                          interpret, ks, None))
 
 
-def _flash_fwd(qt, kt, vt, causal, scale, bq, bk, ks, interpret):
-    out, lse = _fwd_call(qt, kt, vt, causal, scale, bq, bk, interpret, ks)
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
+def _flash(qt, kt, vt, causal, scale, bq, bk, ks, interpret,
+           shift=None):
+    return _fwd_with_fallback(qt, kt, vt, causal, scale, bq, bk, ks,
+                              interpret, shift)
+
+
+def _flash_fwd(qt, kt, vt, causal, scale, bq, bk, ks, interpret,
+               shift=None):
+    out, lse = _fwd_with_fallback(qt, kt, vt, causal, scale, bq, bk,
+                                  ks, interpret, shift)
     return (out, lse), (qt, kt, vt, out, lse)
 
 
-def _flash_bwd(causal, scale, bq, bk, ks, interpret, res, g):
+def _flash_bwd(causal, scale, bq, bk, ks, interpret, shift, res, g):
     g_out, g_lse = g
     qt, kt, vt, out, lse = res
     # delta_i = sum_d dO_i·O_i — the rowwise dot that closes the softmax
@@ -762,7 +883,8 @@ def flash_attention_with_lse(q: jax.Array, k: jax.Array, v: jax.Array,
                              causal: bool = False,
                              scale: float | None = None,
                              block_q: int | None = None,
-                             block_k: int | None = None):
+                             block_k: int | None = None,
+                             softmax_shift: float | None = None):
     """Flash attention returning the per-row log-sum-exp as well.
 
     Returns ``(out (b, s_q, h, d), lse (b, h, s_q))``. The lse is what
@@ -770,6 +892,13 @@ def flash_attention_with_lse(q: jax.Array, k: jax.Array, v: jax.Array,
     attention results exactly; its cotangent is handled by the custom
     backward. Unsupported shapes/backends fall back to the dense oracle
     with an explicit logsumexp.
+
+    ``softmax_shift`` opts into the constant-shift forward: a fixed
+    base-2 shift replaces the online rowmax (the measured exposed cost
+    of the d=64 tile loop — see ``bench/tile_floor.py``), with a
+    traced exact-fallback on overflow (non-finite lse). Use only for
+    full causal/dense attention where a −inf lse cannot occur by
+    design; 16.0 is a good value for unit-variance inputs.
 
     ``block_q``/``block_k`` override the automatic tile choice (e.g.
     the benchmark's cross-tiling oracle). ``block_q`` must be the whole
@@ -804,8 +933,17 @@ def flash_attention_with_lse(q: jax.Array, k: jax.Array, v: jax.Array,
     # overlap sub-block j+1's MXU dot with sub-block j's VPU softmax
     # (ks = 1 serializes the units). Needs >= 4 K blocks to matter.
     ks = 2 if (bq == bk and k.shape[1] // bk >= 4) else 1
+    # The constant-shift path carries its exact-fallback INSIDE the
+    # custom_vjp (_fwd_with_fallback): overflow re-runs the online
+    # kernel via a traced cond, no host sync, and the backward always
+    # sees the final correct (out, lse). NOTE: shift is only valid
+    # where a -inf lse cannot occur by design (full causal/dense
+    # attention — every row sees the diagonal); ring/blockwise
+    # schedules with fully-masked rows must keep the online path.
     out, lse = _flash(qt, kt, vt, bool(causal), float(scale), bq, bk,
-                      ks, interpret)
+                      ks, interpret,
+                      None if softmax_shift is None
+                      else float(softmax_shift))
     # Names for rematerialization policies: a checkpointed layer whose
     # policy saves these skips re-running the forward kernel in the
     # backward pass (TransformerConfig.remat_policy = "dots_attn").
@@ -816,7 +954,8 @@ def flash_attention_with_lse(q: jax.Array, k: jax.Array, v: jax.Array,
 
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                     causal: bool = False,
-                    scale: float | None = None) -> jax.Array:
+                    scale: float | None = None,
+                    softmax_shift: float | None = None) -> jax.Array:
     """Fused flash attention; drop-in for ``dense_attention``.
 
     Args:
@@ -831,7 +970,8 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     """
     if _flash_supported(q.shape[1], k.shape[1], causal) is None:
         return dense_attention(q, k, v, causal=causal, scale=scale)
-    return flash_attention_with_lse(q, k, v, causal=causal, scale=scale)[0]
+    return flash_attention_with_lse(q, k, v, causal=causal, scale=scale,
+                                    softmax_shift=softmax_shift)[0]
 
 
 def resolve_attention_impl(name: str):

@@ -126,8 +126,8 @@ def timeit_chained(fn, args: tuple, chain, runs: int = 10,
     # there.
     if target_window_s is None:
         target_window_s = _resolve_target_window(state)
-    per, window, total = _two_point_window(measure, runs,
-                                           target_window_s)
+    per, window, total, _ = _two_point_window(measure, runs,
+                                              target_window_s)
     return TimeitResult(mean_s=per, total_s=total, runs=window,
                         per_run_s=[per] * window)
 
@@ -157,17 +157,22 @@ def _resolve_target_window(state) -> float:
 
 
 def _two_point_window(measure, runs, target_window_s):
-    """One two-point measurement: per-run seconds, window size, total
-    wall seconds spent."""
+    """One two-point measurement: (per-run seconds, window size, total
+    wall seconds, executed run count)."""
+    executed = 0
     n, probe = runs, measure(runs)
+    executed += runs
     while probe < target_window_s and n < 4096:
         n = n * max(2, int(1.2 * target_window_s / max(probe, 1e-3)))
         probe = measure(n)
+        executed += n
     t2 = measure(2 * n)
+    executed += 2 * n
     per = (t2 - probe) / n
     window = 2 * n
     if per <= 0:  # cross-measurement noise: retry once, larger window
         probe, t2 = measure(2 * n), measure(4 * n)
+        executed += 6 * n
         per = (t2 - probe) / (2 * n)
         window = 4 * n
         if per <= 0:
@@ -175,7 +180,7 @@ def _two_point_window(measure, runs, target_window_s):
             # last window's plain mean — an upper bound that includes
             # the constant costs, but a sane number instead of ~0
             per = t2 / (4 * n)
-    return per, window, probe + t2
+    return per, window, probe + t2, executed
 
 
 @dataclass
@@ -189,6 +194,7 @@ class WindowsResult:
     windows: int           # windows kept
     discarded: int         # implausibly-fast windows dropped
     per_window_s: list
+    total_runs: int = 0    # executions actually performed
     # True when EVERY window fell below floor_s: the stats above are
     # then the implausible readings themselves (reported rather than
     # fabricated from the floor) and must be rendered as suspect.
@@ -227,11 +233,13 @@ def timeit_windows(fn, args: tuple, chain, windows: int = 5,
     state["force"](state["cur"])
     if target_window_s is None:
         target_window_s = _resolve_target_window(state)
-    pers, dropped = [], []
+    pers, dropped, total_runs = [], [], 0
     for _ in range(2 * max(windows, 1)):
         if len(pers) >= windows:
             break
-        per, win, _ = _two_point_window(measure, runs, target_window_s)
+        per, win, _, execd = _two_point_window(measure, runs,
+                                               target_window_s)
+        total_runs += execd
         # carry the converged window size forward: later windows skip
         # the sub-target growth probes the first one already paid for
         runs = max(runs, win // 2)
@@ -253,7 +261,7 @@ def timeit_windows(fn, args: tuple, chain, windows: int = 5,
     return WindowsResult(median_s=median, min_s=min(pers),
                          max_s=max(pers), windows=len(pers),
                          discarded=len(dropped), per_window_s=pers,
-                         suspect=suspect)
+                         suspect=suspect, total_runs=total_runs)
 
 
 def timeit(fn, *args, runs: int = 10, warmup: int = 2,

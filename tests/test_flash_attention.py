@@ -126,3 +126,57 @@ def test_unknown_impl_rejected():
     from icikit.ops.flash_attention import resolve_attention_impl
     with pytest.raises(ValueError, match="unknown attention impl"):
         resolve_attention_impl("fash")
+
+
+def test_constant_shift_matches_online():
+    """The constant-shift forward (rowmax replaced by a fixed base-2
+    shift, the r4 long-context fwd optimization) matches the online-
+    softmax kernel in outputs, lse, and gradients; pathological
+    magnitudes trigger the traced fallback and still match."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from icikit.ops.flash_attention import flash_attention_with_lse
+
+    k1, k2, k3 = jax.random.split(jax.random.key(0), 3)
+    b, s, h, d = 1, 2048, 2, 64
+    q = jax.random.normal(k1, (b, s, h, d), jnp.bfloat16)
+    k = jax.random.normal(k2, (b, s, h, d), jnp.bfloat16)
+    v = jax.random.normal(k3, (b, s, h, d), jnp.bfloat16)
+    o1, l1 = flash_attention_with_lse(q, k, v, causal=True)
+    o2, l2 = flash_attention_with_lse(q, k, v, causal=True,
+                                      softmax_shift=16.0)
+    np.testing.assert_allclose(np.asarray(o1, np.float32),
+                               np.asarray(o2, np.float32), atol=2e-2)
+    np.testing.assert_allclose(np.asarray(l1), np.asarray(l2),
+                               rtol=1e-5, atol=1e-5)
+
+    def loss(fn):
+        return lambda q: fn(q)[0].astype(jnp.float32).sum()
+
+    g1 = jax.grad(loss(lambda q: flash_attention_with_lse(
+        q, k, v, causal=True)))(q)
+    g2 = jax.grad(loss(lambda q: flash_attention_with_lse(
+        q, k, v, causal=True, softmax_shift=16.0)))(q)
+    np.testing.assert_allclose(np.asarray(g1, np.float32),
+                               np.asarray(g2, np.float32), atol=3e-2)
+    # overflow: scores far past the shift's exp2 range must fall back
+    qb = (q.astype(jnp.float32) * 120).astype(jnp.bfloat16)
+    kb = (k.astype(jnp.float32) * 120).astype(jnp.bfloat16)
+    o3, l3 = flash_attention_with_lse(qb, kb, v, causal=True,
+                                      softmax_shift=16.0)
+    o4, l4 = flash_attention_with_lse(qb, kb, v, causal=True)
+    assert bool(jnp.isfinite(l3).all())
+    np.testing.assert_allclose(np.asarray(l3), np.asarray(l4),
+                               rtol=1e-4)
+    # gradients THROUGH the fallback: the cond lives inside the
+    # custom_vjp, so the backward sees the final correct residuals —
+    # a fallback outside it poisoned gradients with 0 x NaN
+    g3 = jax.grad(loss(lambda q: flash_attention_with_lse(
+        q, kb, v, causal=True, softmax_shift=16.0)))(qb)
+    g4 = jax.grad(loss(lambda q: flash_attention_with_lse(
+        q, kb, v, causal=True)))(qb)
+    assert bool(jnp.isfinite(g3.astype(jnp.float32)).all())
+    np.testing.assert_allclose(np.asarray(g3, np.float32),
+                               np.asarray(g4, np.float32), atol=3e-2)

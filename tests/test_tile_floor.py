@@ -1,0 +1,15 @@
+"""The d=64 per-tile floor decomposition bench runs and decomposes."""
+
+from icikit.bench.tile_floor import measure, render
+
+
+def test_tile_floor_variants_execute():
+    """All three variants execute (interpret mode on CPU) and produce
+    per-tile numbers; the render names each variant."""
+    recs = measure(seq=2048, d=64, h=1, bq=512, bk=512, windows=1)
+    assert {r["variant"] for r in recs} == {
+        "full", "mxu", "softmax_ks1", "no_exp2", "no_max",
+        "no_exp2_no_max"}
+    assert all(r["per_tile_us"] > 0 for r in recs)
+    text = render(recs)
+    assert "mxu-only" in text and "exp2" in text and "rowmax" in text

@@ -216,31 +216,45 @@ _TFLOPS_CEILING = 184.4
 
 def fig_longcontext(records, outdir):
     import matplotlib.pyplot as plt
-    series = {}  # (mode, d_head) -> {seq: tflops}
-    for r in records:
-        if not r.get("verified") or r.get("impl") != "flash":
-            continue
-        if r["tflops"] > _TFLOPS_CEILING:
-            continue  # physically impossible: timing artifact
-        key = (r["mode"], r.get("d_head", 64))
-        cur = series.setdefault(key, {}).get(r["seq"], 0)
-        if r["tflops"] > cur:
-            series[key][r["seq"]] = r["tflops"]
+    from icikit.bench.report import select_headline
+    rows = [r for r in records
+            if r.get("verified")
+            and r.get("impl") in ("flash", "flash_shift")
+            and r["tflops"] <= _TFLOPS_CEILING]
+    # shared headline cell rule (report.select_headline): the most
+    # recent record per (impl, mode, d_head, seq), medians never
+    # displaced by legacy rows
+    chosen = select_headline(
+        rows,
+        key_of=lambda r: (r["impl"], r["mode"], r.get("d_head", 64),
+                          r["seq"]),
+        proto_of=lambda r: r.get("protocol", "chained-best"))
+    series = {}  # (impl, mode, d_head) -> {seq: tflops}
+    for (impl, mode, dh, seq), r in chosen.items():
+        series.setdefault((impl, mode, dh), {})[seq] = r["tflops"]
     if not series:
         return None
+    # color follows the (mode, d_head) entity; the const-shift variant
+    # of an entity shares its color and dashes instead
     slots = {("fwd", 128): 0, ("fwdbwd", 128): 1,
              ("fwd", 64): 2, ("fwdbwd", 64): 3}
-    names = {("fwd", 128): "fwd, d_head=128",
-             ("fwdbwd", 128): "fwd+bwd, d_head=128",
-             ("fwd", 64): "fwd, d_head=64",
-             ("fwdbwd", 64): "fwd+bwd, d_head=64"}
+    names = {("fwd", 128): "fwd, d=128",
+             ("fwdbwd", 128): "fwd+bwd, d=128",
+             ("fwd", 64): "fwd, d=64",
+             ("fwdbwd", 64): "fwd+bwd, d=64"}
     fig, ax = plt.subplots(figsize=(6.4, 4.0), facecolor=SURFACE)
-    for key in sorted(series, key=lambda k: slots.get(k, 6)):
+    for key in sorted(series,
+                      key=lambda k: (slots.get(k[1:], 6), k[0])):
+        impl, mode, dh = key
         pts = sorted(series[key].items())
-        c = PALETTE[slots.get(key, 6)]
+        c = PALETTE[slots.get((mode, dh), 6)]
+        shift = impl == "flash_shift"
+        label = names.get((mode, dh), f"{mode}, d={dh}")
         ax.plot([s for s, _ in pts], [t for _, t in pts], color=c,
-                linewidth=2, marker="o", markersize=5,
-                label=names.get(key, str(key)), zorder=3)
+                linewidth=2, linestyle="--" if shift else "-",
+                marker="o", markersize=5,
+                label=label + (" (const-shift)" if shift else ""),
+                zorder=3)
     ax.set_xscale("log", base=2)
     ax.set_ylim(bottom=0)
     xs = sorted({s for v in series.values() for s in v})
@@ -248,7 +262,9 @@ def fig_longcontext(records, outdir):
     ax.set_xticklabels([f"{s//1024}k" for s in xs])
     _style(ax, "Causal flash attention: achieved TFLOP/s vs sequence "
                "(b=1, bf16, one v5e)",
-           "sequence length (tokens)", "TFLOP/s (best recorded)")
+           "sequence length (tokens)",
+           "TFLOP/s (median; latest legacy reading where no median "
+           "exists)")
     _legend(ax)
     path = os.path.join(outdir, "longcontext_tflops.png")
     fig.savefig(path, dpi=160, bbox_inches="tight", facecolor=SURFACE)

@@ -10,7 +10,7 @@ intermediate (the update tree) makes an extra HBM round trip. This
 kernel does the whole update in one pass per leaf: read p, m, v, g
 (g in its stored dtype, upcast in-register — bf16→fp32 is exact, so
 the numerics match optax's cast-then-update exactly), write p', m',
-v'. Nothing else touches HBM: 28 B/element for fp32 grads, 22 B for
+v'. Nothing else touches HBM: 28 B/element for fp32 grads, 26 B for
 bf16 — the floor.
 
 Semantics are ``optax.adam`` (scale_by_adam with eps_root=0)::

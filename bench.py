@@ -74,6 +74,7 @@ def main():
         "windows": res.windows,
         "discarded": res.discarded,
         "suspect": res.suspect,
+        "session_quality": res.session_quality(),
         "protocol": "median-of-windows",
     }))
     return 0

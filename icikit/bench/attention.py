@@ -58,6 +58,8 @@ class AttnRecord:
     windows: int = 1
     discarded: int = 0
     suspect: bool = False
+    # session-stability provenance (r5) — None on pre-r5 rows
+    session_quality: dict | None = None
 
     def to_json(self) -> str:
         return json.dumps(dataclasses.asdict(self))
@@ -215,7 +217,8 @@ def sweep_attention(seqs, impls=None, batch=4, heads=8, d_head=64,
                 verified=err <= tol,
                 protocol="median-of-windows", min_s=res.min_s,
                 max_s=res.max_s, windows=res.windows,
-                discarded=res.discarded, suspect=res.suspect))
+                discarded=res.discarded, suspect=res.suspect,
+                session_quality=res.session_quality()))
     return records
 
 

@@ -224,6 +224,7 @@ def run_bench(preset: str, dp: int, tp: int, batch: int, prompt_len: int,
         "windows": res.windows,
         "discarded": res.discarded,
         "suspect": res.suspect,
+        "session_quality": res.session_quality(),
         "per_token_ms_spread": [round(res.min_s / n_new * 1e3, 3),
                                 round(res.max_s / n_new * 1e3, 3)],
     }

@@ -227,6 +227,14 @@ def render_markdown(coll, sorts, dlb, checks, meta) -> str:
                 proto += f" ({r.discarded} discarded)"
             if getattr(r, "suspect", False):
                 proto += " SUSPECT"
+            # r5 session-stability stamp: escalation that never
+            # converged marks the row's session as depressed/unstable
+            q = getattr(r, "session_quality", None)
+            q = q if isinstance(q, dict) else {}
+            if q.get("degraded"):
+                proto += " DEGRADED-SESSION"
+            elif q.get("escalated"):
+                proto += " (escalated)"
         else:
             spread = "—"
             proto = "chained-best (pre-r4)"

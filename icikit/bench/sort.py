@@ -46,6 +46,10 @@ class SortRecord:
     windows: int = 1
     discarded: int = 0    # implausibly-fast windows dropped
     suspect: bool = False  # every window fell below the physical floor
+    # session-stability provenance (r5): spread ratio, escalation, and
+    # a degraded flag when the spread never converged under 15% —
+    # None on pre-r5 and chained-best rows
+    session_quality: dict | None = None
 
     def to_json(self) -> str:
         return json.dumps(dataclasses.asdict(self))
@@ -168,7 +172,8 @@ def sweep_sorts(mesh, sizes, algorithms=None, dtype="int32",
                         min_s=wres.min_s, max_s=wres.max_s,
                         windows=wres.windows,
                         discarded=wres.discarded,
-                        suspect=wres.suspect))
+                        suspect=wres.suspect,
+                        session_quality=wres.session_quality()))
                     continue
                 res = timeit_chained(run, (keys,), chain, runs=runs,
                                      warmup=warmup)
@@ -198,7 +203,10 @@ def format_table(records) -> str:
             f"{r.keys_per_s / 1e6:>9.1f} {r.errors:>5}"
             + (f"  ({r.discarded} discarded)" if r.discarded else "")
             + ("  SUSPECT (all windows below floor)"
-               if getattr(r, "suspect", False) else ""))
+               if getattr(r, "suspect", False) else "")
+            + ("  DEGRADED (spread never converged)"
+               if (getattr(r, "session_quality", None) or {}).get(
+                   "degraded") else ""))
     return "\n".join(lines)
 
 

@@ -143,6 +143,7 @@ def measure(seq: int, d: int = 64, h: int = 8, bq: int = 1024,
             "median_s": res.median_s,
             "spread_s": [res.min_s, res.max_s],
             "per_tile_us": round(per_tile_us, 3),
+            "session_quality": res.session_quality(),
         })
 
     # analytic fast-bounds for discarding corrupted windows: no d=64

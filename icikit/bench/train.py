@@ -230,6 +230,7 @@ def run_bench(preset: str, dp: int, tp: int, sp: int, batch: int,
         "protocol": "median-of-windows",
         "windows": wres.windows,
         "discarded": wres.discarded,
+        "session_quality": wres.session_quality(),
         "step_ms_spread": [round(wres.min_s / steps * 1e3, 2),
                            round(wres.max_s / steps * 1e3, 2)],
         # optimizer provenance: rows appended before r4 were measured

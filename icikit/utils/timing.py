@@ -199,16 +199,121 @@ class WindowsResult:
     # then the implausible readings themselves (reported rather than
     # fabricated from the floor) and must be rendered as suspect.
     suspect: bool = False
+    # Escalation provenance: when the initial windows spread wider than
+    # ``escalate_ratio`` of the median, extra windows were run (bounded
+    # by ``max_windows``). If the set STILL hasn't converged (judged on
+    # the outlier-trimmed spread, ``_spread_converged``), ``degraded``
+    # marks the session as unstable — the median is then "best
+    # available under a depressed/noisy tunnel session", not a
+    # converged steady-state number.
+    escalated: bool = False
+    degraded: bool = False
 
     @property
     def best_s(self) -> float:
         return self.min_s
 
+    @property
+    def spread_ratio(self) -> float:
+        """(max − min) / median — the session-stability figure the
+        escalation logic thresholds on."""
+        if self.median_s <= 0:
+            return float("inf")
+        return (self.max_s - self.min_s) / self.median_s
+
+    def session_quality(self) -> dict:
+        """Provenance blob for record files: how stable was the
+        session this number came from? Stamped into every headline
+        record so a depressed-tunnel median is visibly flagged
+        instead of silently standing in for steady state. Carries
+        only the escalation-specific fields — windows/discarded/
+        suspect already live as top-level record fields."""
+        return {
+            "spread_ratio": round(self.spread_ratio, 4),
+            "escalated": self.escalated,
+            "degraded": self.degraded,
+        }
+
+
+def _median(xs: list) -> float:
+    s = sorted(xs)
+    mid = len(s) // 2
+    return s[mid] if len(s) % 2 else 0.5 * (s[mid - 1] + s[mid])
+
+
+def _spread_converged(pers: list, ratio: float,
+                      trim: bool = False) -> bool:
+    """Has the window set converged to within ``ratio``·median?
+
+    With ``trim`` (set only once escalation has begun, never for the
+    initial trigger — a lone severe outlier in the first window set
+    must fire escalation, not be trimmed out of the judgment) and ≥5
+    kept windows, the single min and max are excluded: the outlier
+    from the noise episode that *triggered* escalation must not keep
+    a session flagged after the median has converged on the dominant
+    mode (the outlier stays in the recorded spread — only the
+    convergence judgment excludes it). A genuinely bimodal session
+    keeps outliers on both sides of the trim and stays unconverged.
+    """
+    xs = sorted(pers)
+    if trim and len(xs) >= 5:
+        xs = xs[1:-1]
+    return (xs[-1] - xs[0]) <= ratio * _median(xs)
+
+
+def _collect_windows(window_fn, windows: int, floor_s: float | None,
+                     escalate_ratio: float, max_windows: int):
+    """Pure collection + escalation logic, separated from the device
+    chain so it can be unit-tested against a synthetic noisy timer.
+
+    ``window_fn() -> (per_run_s, executed_runs)`` performs one
+    two-point window. Collects ``windows`` floor-respecting windows
+    (each floor discard is retried, up to 2× attempts per phase). If
+    the kept spread exceeds ``escalate_ratio``·median, keeps running
+    extra windows — ``windows`` more per escalation round, up to
+    ``max_windows`` kept — instead of shrugging: a wide spread means
+    the session is mid-noise-episode, and more samples either let the
+    median converge on the dominant mode (``_spread_converged``) or
+    prove the session is genuinely degraded (flagged, not silently
+    reported).
+    """
+    pers, dropped, total_runs = [], [], 0
+
+    def collect(k):
+        nonlocal total_runs
+        added = 0
+        for _ in range(2 * k):
+            if added >= k:
+                break
+            per, execd = window_fn()
+            total_runs += execd
+            if floor_s is not None and per < floor_s:
+                dropped.append(per)
+                continue
+            pers.append(per)
+            added += 1
+        return added
+
+    collect(windows)
+    escalated = False
+    while (len(pers) >= 2 and len(pers) < max_windows
+           and not _spread_converged(pers, escalate_ratio,
+                                     trim=escalated)):
+        escalated = True
+        if collect(min(windows, max_windows - len(pers))) == 0:
+            break  # every extra attempt hit the floor: stop, flag below
+    degraded = bool(pers and len(pers) >= 2
+                    and not _spread_converged(pers, escalate_ratio,
+                                              trim=escalated))
+    return pers, dropped, total_runs, escalated, degraded
+
 
 def timeit_windows(fn, args: tuple, chain, windows: int = 5,
                    runs: int = 4, warmup: int = 1,
                    target_window_s: float | None = None,
-                   floor_s: float | None = None) -> WindowsResult:
+                   floor_s: float | None = None,
+                   escalate_ratio: float = 0.15,
+                   max_windows: int | None = None) -> WindowsResult:
     """Noise-robust headline timing: ``windows`` independent two-point
     measurements over ONE continuing chain, reported as median with
     [min, max] spread.
@@ -224,29 +329,38 @@ def timeit_windows(fn, args: tuple, chain, windows: int = 5,
     minimum passes) additionally discards impossible windows before
     the median — each discard is re-measured, up to 2x ``windows``
     attempts total.
+
+    When the kept windows spread wider than ``escalate_ratio`` of
+    their median (a 50% spread caught BENCH_r04 reporting a
+    depressed-tail median with no flag), the protocol ESCALATES: it
+    keeps measuring — ``windows`` more per round, bounded by
+    ``max_windows`` (default 3× ``windows``) — so the median either
+    converges on the dominant session mode or the result is stamped
+    ``degraded`` for downstream records via ``session_quality()``.
     """
     if windows < 1:
         raise ValueError(f"windows must be >= 1, got {windows}")
+    if max_windows is None:
+        max_windows = 3 * windows
     state, measure = _make_chain_measure(fn, args, chain)
     for _ in range(max(warmup, 1)):
         state["cur"] = chain(state["cur"], fn(*state["cur"]))
     state["force"](state["cur"])
     if target_window_s is None:
         target_window_s = _resolve_target_window(state)
-    pers, dropped, total_runs = [], [], 0
-    for _ in range(2 * max(windows, 1)):
-        if len(pers) >= windows:
-            break
-        per, win, _, execd = _two_point_window(measure, runs,
+    run_state = {"runs": runs}
+
+    def window_fn():
+        per, win, _, execd = _two_point_window(measure,
+                                               run_state["runs"],
                                                target_window_s)
-        total_runs += execd
         # carry the converged window size forward: later windows skip
         # the sub-target growth probes the first one already paid for
-        runs = max(runs, win // 2)
-        if floor_s is not None and per < floor_s:
-            dropped.append(per)
-            continue
-        pers.append(per)
+        run_state["runs"] = max(run_state["runs"], win // 2)
+        return per, execd
+
+    pers, dropped, total_runs, escalated, degraded = _collect_windows(
+        window_fn, windows, floor_s, escalate_ratio, max_windows)
     suspect = False
     if not pers:
         # every window fell below the physical floor: report the
@@ -254,14 +368,11 @@ def timeit_windows(fn, args: tuple, chain, windows: int = 5,
         # number fabricated from the floor, and never a zero that
         # would crash a throughput division downstream
         pers, dropped, suspect = dropped, [], True
-    pers_sorted = sorted(pers)
-    mid = len(pers_sorted) // 2
-    median = (pers_sorted[mid] if len(pers_sorted) % 2
-              else 0.5 * (pers_sorted[mid - 1] + pers_sorted[mid]))
-    return WindowsResult(median_s=median, min_s=min(pers),
+    return WindowsResult(median_s=_median(pers), min_s=min(pers),
                          max_s=max(pers), windows=len(pers),
                          discarded=len(dropped), per_window_s=pers,
-                         suspect=suspect, total_runs=total_runs)
+                         suspect=suspect, total_runs=total_runs,
+                         escalated=escalated, degraded=degraded)
 
 
 def timeit(fn, *args, runs: int = 10, warmup: int = 2,

@@ -117,7 +117,14 @@ def _render_sort_scaling(records: list[dict]) -> str:
     Multiple records per (algorithm, p, n) (appended across rounds)
     collapse to the best verified reading."""
     algs = sorted({r["algorithm"] for r in records})
-    out = ["## Measured: Mkeys/s vs p (best verified reading per cell)\n"]
+    out = ["## Measured: Mkeys/s vs p — relative-trend study, "
+           "NON-HEADLINE\n",
+           "> Cells collapse appended records to the best verified\n"
+           "> reading (chained-best, `--windows 1`): this sweep runs\n"
+           "> p simulated devices on ONE serializing core, where the\n"
+           "> comparison is algorithm-vs-algorithm *trend*, not\n"
+           "> absolute throughput — headline absolute numbers live in\n"
+           "> NORTHSTAR.md under the median-of-windows protocol.\n"]
     for n in sorted({r["n"] for r in records}):
         rows = []
         for p in sorted({r["p"] for r in records if r["n"] == n}):

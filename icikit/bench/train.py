@@ -127,7 +127,8 @@ def run_bench(preset: str, dp: int, tp: int, sp: int, batch: int,
               remat_policy: str = "nothing",
               calibrate_peak: bool = False,
               optimizer: str = "fused", windows: int = 3,
-              softmax_shift: float | None = None) -> dict:
+              softmax_shift: float | None = None,
+              head: str = "recompute") -> dict:
     import optax
 
     from icikit.models.transformer import (
@@ -139,16 +140,39 @@ def run_bench(preset: str, dp: int, tp: int, sp: int, batch: int,
     cfg = TransformerConfig(**PRESETS[preset], n_experts=moe_experts,
                             n_kv_heads=kv_heads, remat=remat,
                             remat_policy=remat_policy,
-                            softmax_shift=softmax_shift)
+                            softmax_shift=softmax_shift,
+                            xent_save_exp=(head == "saved"))
+    if head == "saved":
+        # the saved-exp flag only takes effect on the fused-head path;
+        # silently measuring the recompute head under a _head-saved
+        # metric tag would fake the structural A/B's null result
+        from icikit.models.transformer.model import _use_fused_head
+        if not _use_fused_head(cfg, batch, cfg.max_seq):
+            raise ValueError(
+                "--head saved requires the fused xent head to be "
+                f"active, but the gate rejects this config (preset="
+                f"{preset}, batch={batch}: needs TPU/CPU backend, "
+                "tile-divisible T and V, d_model % 128 == 0, and not "
+                "vocab_parallel)")
     mesh = make_model_mesh(dp=dp, tp=tp, sp=sp)
     params = init_params(jax.random.key(0), cfg, mesh)
     # fused = the one-pass FusedAdam formulation (XLA-lowered by
     # default; use_pallas opts into the in-step Pallas kernel, the
     # measured -15ms loser — kept reachable so the ROADMAP number can
-    # be reproduced); "optax" is the stock pipeline for A/B
+    # be reproduced); "optax" is the stock pipeline for A/B;
+    # bf16nu/bf16mom store the second (resp. both) moment(s) bf16 —
+    # the r5 structural A/B on the optimizer tail's HBM stream
     opt_name = optimizer
-    tx = (FusedAdam(1e-4, use_pallas=(opt_name == "fused-pallas"))
-          if opt_name != "optax" else optax.adam(1e-4))
+    if opt_name == "optax":
+        tx = optax.adam(1e-4)
+    else:
+        mom = {}
+        if opt_name == "fused-bf16nu":
+            mom = dict(nu_dtype=jnp.bfloat16)
+        elif opt_name == "fused-bf16mom":
+            mom = dict(mu_dtype=jnp.bfloat16, nu_dtype=jnp.bfloat16)
+        tx = FusedAdam(1e-4, use_pallas=(opt_name == "fused-pallas"),
+                       **mom)
     optimizer, step = make_train_step(mesh, cfg, tx)
     opt_state = optimizer.init(params)
 
@@ -215,6 +239,8 @@ def run_bench(preset: str, dp: int, tp: int, sp: int, batch: int,
         remat_tag += f"_opt-{opt_name}"
     if softmax_shift is not None:
         remat_tag += f"_shift{softmax_shift:g}"
+    if head != "recompute":
+        remat_tag += f"_head-{head}"
     rec = {
         "metric":
             f"train_{preset}_dp{dp}tp{tp}sp{sp}_b{batch}{moe_tag}"
@@ -270,16 +296,26 @@ def main(argv=None) -> int:
                     help="skip per-layer rematerialization: ~1/3 fewer "
                          "backward FLOPs when activations fit HBM")
     ap.add_argument("--optimizer", default="fused",
-                    choices=["fused", "fused-pallas", "optax"],
+                    choices=["fused", "fused-pallas", "fused-bf16nu",
+                             "fused-bf16mom", "optax"],
                     help="fused = one-pass FusedAdam, XLA-lowered "
                          "(default; measured == optax); fused-pallas "
                          "= the Pallas kernel in-step (measured "
                          "+15 ms at base/b=8 from layout conversion "
                          "copies — kept for reproducing that A/B); "
-                         "optax = stock optax.adam pipeline")
+                         "fused-bf16nu / fused-bf16mom = bf16 second "
+                         "(resp. both) moments, the r5 optimizer-"
+                         "stream structural A/B; optax = stock "
+                         "optax.adam pipeline")
     ap.add_argument("--softmax-shift", type=float, default=None,
                     help="constant-shift softmax forward (removes the "
                          "rowmax chain; traced overflow fallback)")
+    ap.add_argument("--head", default="recompute",
+                    choices=["recompute", "saved"],
+                    help="fused-head backward: recompute the logits "
+                         "chunk (default) or rebuild softmax from "
+                         "saved bf16 exponentials (r5 structural A/B "
+                         "— skips the 4th head dot)")
     ap.add_argument("--windows", type=int, default=3,
                     help="median-of-windows headline protocol; each "
                          "window is one chained --steps loop")
@@ -294,7 +330,7 @@ def main(argv=None) -> int:
                     remat=args.remat, remat_policy=args.remat_policy,
                     calibrate_peak=args.calibrate_peak,
                     optimizer=args.optimizer, windows=args.windows,
-                    softmax_shift=args.softmax_shift)
+                    softmax_shift=args.softmax_shift, head=args.head)
     print(json.dumps(rec))
     return 0
 

@@ -104,6 +104,11 @@ class TransformerConfig:
     # owner-shard target gather) — each tp shard holds V/tp logits
     # instead of all V. Requires vocab % tp == 0.
     vocab_parallel: bool = False
+    # Fused-head backward mode (r5 structural A/B): save the forward's
+    # bf16 shifted-exponential chunks so the backward skips the logits
+    # recompute matmul (ops/xent.py save_exp). Costs a live (T, V)
+    # bf16 residual between forward and backward.
+    xent_save_exp: bool = False
     # Sequence-parallel schedule for sp > 1: "ring" (neighbor ppermute
     # K/V rotation, any sequence length) or "ulysses" (all-to-all
     # head<->sequence re-shard; needs n_heads/tp divisible by sp).
@@ -530,7 +535,8 @@ def _local_loss(params, tokens, targets, cfg, p_sp, p_dp, p_tp, denom):
         w = lax.pcast(params["w_out"].astype(cdt), (DP_AXIS, SP_AXIS),
                       to="varying")
         nll = fused_xent(h.reshape(b * s, cfg.d_model), w,
-                         targets.reshape(b * s)).reshape(b, s)
+                         targets.reshape(b * s),
+                         save_exp=cfg.xent_save_exp).reshape(b, s)
     else:
         logits, aux = _forward_local(params, tokens, cfg, p_sp, p_dp)
         if cfg.vocab_parallel:
@@ -603,16 +609,33 @@ class FusedAdam:
     preset. Step time with the default therefore matches optax; what
     this class buys is the one-pass formulation (no update tree) and
     the kernel as an opt-in for standalone optimizer studies.
+
+    ``mu_dtype``/``nu_dtype`` store the moments narrow (r5 structural
+    route: the optimizer tail is pure HBM traffic, so bf16 moments cut
+    its stream — nu alone −4 B/param, both −8 of 28). The update
+    arithmetic stays fp32 (moments upcast in-register, rounded once on
+    store). Convergence parity vs fp32 moments is pinned by
+    ``tests/test_trainer.py::test_bf16_moments_convergence_parity``.
     """
 
     def __init__(self, lr=3e-4, b1: float = 0.9, b2: float = 0.999,
-                 eps: float = 1e-8, use_pallas: bool = False):
+                 eps: float = 1e-8, use_pallas: bool = False,
+                 mu_dtype=None, nu_dtype=None):
         self.lr, self.b1, self.b2, self.eps = lr, b1, b2, eps
         self.use_pallas = use_pallas
+        self.mu_dtype, self.nu_dtype = mu_dtype, nu_dtype
 
     def init(self, params):
-        zeros = lambda: {k: jnp.zeros_like(v) for k, v in params.items()}
-        return (zeros(), zeros(), jnp.zeros((), jnp.int32))
+        def zeros(dtype):
+            # zeros_like preserves each param's mesh sharding (a bare
+            # jnp.zeros would materialize unsharded on device 0)
+            return {k: jnp.zeros_like(
+                v, dtype=(dtype if dtype is not None
+                          and jnp.issubdtype(v.dtype, jnp.floating)
+                          else None))
+                    for k, v in params.items()}
+        return (zeros(self.mu_dtype), zeros(self.nu_dtype),
+                jnp.zeros((), jnp.int32))
 
 
 def make_train_step(mesh, cfg: TransformerConfig, optimizer=None):

@@ -55,10 +55,10 @@ def _adam_kernel(sc_ref, p_ref, m_ref, v_ref, g_ref,
     c1 = sc_ref[1]  # 1/(1-b1^t)
     c2 = sc_ref[2]  # 1/(1-b2^t)
     g = g_ref[...].astype(jnp.float32)
-    m = m_ref[...] * b1 + g * (1.0 - b1)
-    v = v_ref[...] * b2 + (g * g) * (1.0 - b2)
-    mo_ref[...] = m
-    vo_ref[...] = v
+    m = m_ref[...].astype(jnp.float32) * b1 + g * (1.0 - b1)
+    v = v_ref[...].astype(jnp.float32) * b2 + (g * g) * (1.0 - b2)
+    mo_ref[...] = m.astype(mo_ref.dtype)
+    vo_ref[...] = v.astype(vo_ref.dtype)
     po_ref[...] = p_ref[...] - lr * (m * c1) / (
         jnp.sqrt(v * c2) + eps)
 
@@ -78,8 +78,8 @@ def _leaf_update_pallas(p, m, v, g, scalars, b1, b2, eps, interpret):
         out_specs=[spec, spec, spec],
         out_shape=[
             out_struct(shape2, jnp.float32, p, m, v, g),
-            out_struct(shape2, jnp.float32, p, m, v, g),
-            out_struct(shape2, jnp.float32, p, m, v, g),
+            out_struct(shape2, m.dtype, p, m, v, g),
+            out_struct(shape2, v.dtype, p, m, v, g),
         ],
         interpret=interpret,
     )(scalars, p.reshape(shape2), m.reshape(shape2),
@@ -91,12 +91,19 @@ def _leaf_update_pallas(p, m, v, g, scalars, b1, b2, eps, interpret):
 def _leaf_update_xla(p, m, v, g, scalars, b1, b2, eps):
     """Fallback for leaves the (rows, 128) view can't express and for
     backends without Mosaic — XLA fuses the elementwise chain; only
-    the update-tree round trip is saved (the math is identical)."""
+    the update-tree round trip is saved (the math is identical).
+
+    Moments may be stored narrow (r5 structural route: bf16 second
+    moments halve the nu stream): they are upcast in-register, the
+    update arithmetic is always fp32, and the new moment is rounded
+    once on the store — the only precision loss is the storage
+    rounding itself."""
     lr, c1, c2 = scalars[0], scalars[1], scalars[2]
     g = g.astype(jnp.float32)
-    m = m * b1 + g * (1.0 - b1)
-    v = v * b2 + (g * g) * (1.0 - b2)
-    return p - lr * (m * c1) / (jnp.sqrt(v * c2) + eps), m, v
+    m32 = m.astype(jnp.float32) * b1 + g * (1.0 - b1)
+    v32 = v.astype(jnp.float32) * b2 + (g * g) * (1.0 - b2)
+    p = p - lr * (m32 * c1) / (jnp.sqrt(v32 * c2) + eps)
+    return p, m32.astype(m.dtype), v32.astype(v.dtype)
 
 
 def _use_pallas(leaf) -> bool:

@@ -54,7 +54,15 @@ BLOCK_V = 2048
 
 
 def _fwd_kernel(x_ref, w_ref, t_ref, lse_ref, tgt_ref, m_s, l_s, t_s,
-                *, nv, bv):
+                *, nv, bv, e_ref=None, mrun_ref=None):
+    """``e_ref``/``mrun_ref`` non-None = the save-exp variant (r5
+    structural route): the shifted exponentials ``exp2(sb − m_i)``
+    this pass already computes for the online sum are written out
+    (bf16) together with each chunk's running max ``m_i``, so the
+    backward can rebuild the softmax by rescaling —
+    ``p = e · exp2(m_i − lse)`` — without re-running the logits
+    matmul (the "fourth 550-GFLOP dot" of ROADMAP's head
+    accounting)."""
     iv = pl.program_id(1)
 
     @pl.when(iv == 0)
@@ -79,15 +87,45 @@ def _fwd_kernel(x_ref, w_ref, t_ref, lse_ref, tgt_ref, m_s, l_s, t_s,
         sb = s * _LOG2E                                      # base-2
         m_prev = m_s[:]
         m_new = jnp.maximum(m_prev, jnp.max(sb, axis=1, keepdims=True))
+        e = jnp.exp2(sb - m_new)
         l_s[:] = l_s[:] * jnp.exp2(m_prev - m_new) + jnp.sum(
-            jnp.exp2(sb - m_new), axis=1, keepdims=True)
+            e, axis=1, keepdims=True)
         m_s[:] = m_new
+        if e_ref is not None:
+            e_ref[:] = e.astype(e_ref.dtype)
+            mrun_ref[0, 0, 0, :] = m_new[:, 0]
 
     @pl.when(iv == nv - 1)
     def _():
         lse = (m_s[:] + jnp.log2(l_s[:])) * _LN2             # nats
         lse_ref[0, 0, :] = lse[:, 0]
         tgt_ref[0, 0, :] = t_s[:][:, 0]
+
+
+def _fwd_kernel_save(x_ref, w_ref, t_ref, lse_ref, tgt_ref, e_ref,
+                     mrun_ref, m_s, l_s, t_s, *, nv, bv):
+    _fwd_kernel(x_ref, w_ref, t_ref, lse_ref, tgt_ref, m_s, l_s, t_s,
+                nv=nv, bv=bv, e_ref=e_ref, mrun_ref=mrun_ref)
+
+
+def _g_saved_kernel(e_ref, mrun_ref, t_ref, lse_ref, dnll_ref, g_ref,
+                    *, bv):
+    """Backward g from the saved exponentials: no logits matmul.
+    ``p = e · exp2(m_i − lse)`` — ``m_i`` is the running max the
+    forward used for this chunk, so the rescale is exact up to the
+    bf16 storage rounding of ``e``."""
+    iv = pl.program_id(1)
+
+    @pl.when(iv >= 0)  # always true; see the forward kernel's note
+    def _():
+        lse_b2 = (lse_ref[0, 0, :] * _LOG2E)[:, None]        # (bt, 1)
+        scale = jnp.exp2(mrun_ref[0, 0, 0, :][:, None] - lse_b2)
+        p = e_ref[:].astype(jnp.float32) * scale
+        tgt = t_ref[0, 0, :][:, None]
+        cols = iv * bv + lax.broadcasted_iota(jnp.int32, p.shape, 1)
+        onehot = (cols == tgt).astype(jnp.float32)
+        g = (p - onehot) * dnll_ref[0, 0, :][:, None]
+        g_ref[:] = g.astype(g_ref.dtype)
 
 
 def _bwd_kernel(x_ref, w_ref, t_ref, lse_ref, dnll_ref, g_ref, *, bv):
@@ -115,7 +153,7 @@ def _tiles(t, v, block_t, block_v):
     return bt, bv
 
 
-def _fwd_call(x, w, targets, bt, bv, interpret):
+def _fwd_call(x, w, targets, bt, bv, interpret, save=False):
     t, d = x.shape
     v = w.shape[0]
     nt, nv = t // bt, v // bv
@@ -123,22 +161,33 @@ def _fwd_call(x, w, targets, bt, bv, interpret):
     # last two block dims to divide (8, 128) or equal the array dims —
     # a size-1 middle dim satisfies the sublane rule exactly.
     t2 = targets.reshape(nt, 1, bt)
-    lse2, tgt2 = pl.pallas_call(
-        partial(_fwd_kernel, nv=nv, bv=bv),
+    row_spec = pl.BlockSpec((1, 1, bt), lambda it, iv: (it, 0, 0))
+    out_specs = [row_spec, row_spec]
+    out_shape = [
+        _out_struct((nt, 1, bt), jnp.float32, x, w, targets),
+        _out_struct((nt, 1, bt), jnp.float32, x, w, targets),
+    ]
+    kernel = partial(_fwd_kernel, nv=nv, bv=bv)
+    if save:
+        kernel = partial(_fwd_kernel_save, nv=nv, bv=bv)
+        out_specs += [
+            pl.BlockSpec((bt, bv), lambda it, iv: (it, iv)),
+            pl.BlockSpec((1, 1, 1, bt), lambda it, iv: (it, iv, 0, 0)),
+        ]
+        out_shape += [
+            _out_struct((t, v), x.dtype, x, w, targets),
+            _out_struct((nt, nv, 1, bt), jnp.float32, x, w, targets),
+        ]
+    outs = pl.pallas_call(
+        kernel,
         grid=(nt, nv),
         in_specs=[
             pl.BlockSpec((bt, d), lambda it, iv: (it, 0)),
             pl.BlockSpec((bv, d), lambda it, iv: (iv, 0)),
-            pl.BlockSpec((1, 1, bt), lambda it, iv: (it, 0, 0)),
+            row_spec,
         ],
-        out_specs=[
-            pl.BlockSpec((1, 1, bt), lambda it, iv: (it, 0, 0)),
-            pl.BlockSpec((1, 1, bt), lambda it, iv: (it, 0, 0)),
-        ],
-        out_shape=[
-            _out_struct((nt, 1, bt), jnp.float32, x, w, targets),
-            _out_struct((nt, 1, bt), jnp.float32, x, w, targets),
-        ],
+        out_specs=out_specs,
+        out_shape=out_shape,
         scratch_shapes=[
             pltpu.VMEM((bt, 1), jnp.float32),   # running max (base-2)
             pltpu.VMEM((bt, 1), jnp.float32),   # running sum-exp
@@ -148,6 +197,9 @@ def _fwd_call(x, w, targets, bt, bv, interpret):
             vmem_limit_bytes=64 * 1024 * 1024),
         interpret=interpret,
     )(x, w, t2)
+    lse2, tgt2 = outs[0], outs[1]
+    if save:
+        return lse2.reshape(t), tgt2.reshape(t), outs[2], outs[3]
     return lse2.reshape(t), tgt2.reshape(t)
 
 
@@ -174,21 +226,54 @@ def _g_call(x, w, targets, lse, dnll, bt, bv, interpret):
       dnll.reshape(nt, 1, bt))
 
 
-@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
-def _xent(x, w, targets, bt, bv, interpret):
-    lse, tgt = _fwd_call(x, w, targets, bt, bv, interpret)
+def _g_saved_call(e, mrun, targets, lse, dnll, bt, bv, interpret):
+    t, v = e.shape
+    nt, nv = t // bt, v // bv
+    row_spec = pl.BlockSpec((1, 1, bt), lambda it, iv: (it, 0, 0))
+    return pl.pallas_call(
+        partial(_g_saved_kernel, bv=bv),
+        grid=(nt, nv),
+        in_specs=[
+            pl.BlockSpec((bt, bv), lambda it, iv: (it, iv)),
+            pl.BlockSpec((1, 1, 1, bt), lambda it, iv: (it, iv, 0, 0)),
+            row_spec, row_spec, row_spec,
+        ],
+        out_specs=pl.BlockSpec((bt, bv), lambda it, iv: (it, iv)),
+        out_shape=_out_struct((t, v), e.dtype, e, mrun, targets, lse,
+                              dnll),
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=64 * 1024 * 1024),
+        interpret=interpret,
+    )(e, mrun, targets.reshape(nt, 1, bt), lse.reshape(nt, 1, bt),
+      dnll.reshape(nt, 1, bt))
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _xent(x, w, targets, bt, bv, interpret, save):
+    lse, tgt = _fwd_call(x, w, targets, bt, bv, interpret)[:2]
     return lse - tgt
 
 
-def _xent_fwd(x, w, targets, bt, bv, interpret):
+def _xent_fwd(x, w, targets, bt, bv, interpret, save):
+    if save:
+        lse, tgt, e, mrun = _fwd_call(x, w, targets, bt, bv, interpret,
+                                      save=True)
+        return lse - tgt, (x, w, targets, lse, e, mrun)
     lse, tgt = _fwd_call(x, w, targets, bt, bv, interpret)
-    return lse - tgt, (x, w, targets, lse)
+    return lse - tgt, (x, w, targets, lse, None, None)
 
 
-def _xent_bwd(bt, bv, interpret, res, dnll):
-    x, w, targets, lse = res
-    g = _g_call(x, w, targets, lse, dnll.astype(jnp.float32), bt, bv,
-                interpret)
+def _xent_bwd(bt, bv, interpret, save, res, dnll):
+    x, w, targets, lse, e, mrun = res
+    if save:
+        # recompute-free backward (r5): g is rebuilt from the saved
+        # shifted exponentials — the 2·T·V·D logits matmul is gone;
+        # the price is the forward's bf16 e write + this read
+        g = _g_saved_call(e, mrun, targets, lse,
+                          dnll.astype(jnp.float32), bt, bv, interpret)
+    else:
+        g = _g_call(x, w, targets, lse, dnll.astype(jnp.float32), bt,
+                    bv, interpret)
     # dx: (T, V) @ (V, D) — contract vocab; dw: (T, V)ᵀ @ (T, D) —
     # contract tokens; both land in their params' natural layouts.
     dx = lax.dot_general(g, w, (((1,), (0,)), ((), ())),
@@ -216,13 +301,21 @@ def xent_supported(t: int, d: int, v: int, dtype,
 
 def fused_xent(x: jax.Array, w: jax.Array, targets: jax.Array,
                block_t: int = BLOCK_T, block_v: int = BLOCK_V,
-               interpret: bool | None = None) -> jax.Array:
+               interpret: bool | None = None,
+               save_exp: bool = False) -> jax.Array:
     """Per-token cross-entropy ``-log softmax(x @ w)[target]``.
 
     Args:
       x: ``(T, D)`` activations (bf16 or f32).
       w: ``(V, D)`` head weights (embedding orientation), same dtype.
       targets: ``(T,)`` int32 class ids in ``[0, V)``.
+      save_exp: save the forward's bf16 shifted-exponential chunks
+        (+ per-chunk running maxes) as residuals so the backward
+        rebuilds softmax by rescaling instead of re-running the
+        logits matmul — trades one 2·T·V·D dot for T·V bf16 of HBM
+        write+read and holds the (T, V) residual live between
+        forward and backward (r5 structural A/B; gradients agree
+        with the recompute path to bf16 storage rounding).
 
     Returns:
       ``(T,)`` fp32 NLL per token, numerically equal to the unfused
@@ -247,4 +340,5 @@ def fused_xent(x: jax.Array, w: jax.Array, targets: jax.Array,
     bt, bv = tiles
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
-    return _xent(x, w, targets.astype(jnp.int32), bt, bv, bool(interpret))
+    return _xent(x, w, targets.astype(jnp.int32), bt, bv,
+                 bool(interpret), bool(save_exp))

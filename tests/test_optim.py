@@ -195,6 +195,37 @@ def test_fused_adam_matches_optax(use_pallas):
                                    rtol=2e-6, atol=1e-10, err_msg=k)
 
 
+def test_fused_adam_bf16_moments_state_dtypes_and_first_steps():
+    """bf16-moment FusedAdam (r5 optimizer-stream A/B): state dtypes
+    honor mu/nu_dtype, and early steps track the fp32-moment run
+    closely (the storage rounding is the only divergence source —
+    update arithmetic stays fp32)."""
+    cfg = _cfg()
+    mesh = make_model_mesh(dp=1, tp=1, sp=1)
+    params = init_params(jax.random.key(0), cfg, mesh)
+    tok, tgt = _tokens(2, 8, 1), _tokens(2, 8, 2)
+
+    from icikit.models.transformer import FusedAdam
+    opt_a, step_a = make_train_step(mesh, cfg, FusedAdam(1e-3))
+    opt_b, step_b = make_train_step(
+        mesh, cfg, FusedAdam(1e-3, mu_dtype=jnp.bfloat16,
+                             nu_dtype=jnp.bfloat16))
+    sa, sb = opt_a.init(params), opt_b.init(params)
+    for k, leaf in sb[0].items():
+        if jnp.issubdtype(params[k].dtype, jnp.floating):
+            assert leaf.dtype == jnp.bfloat16, k
+            assert sb[1][k].dtype == jnp.bfloat16, k
+    pa = pb = params
+    for _ in range(3):
+        pa, sa, loss_a = step_a(pa, sa, tok, tgt)
+        pb, sb, loss_b = step_b(pb, sb, tok, tgt)
+    np.testing.assert_allclose(float(loss_a), float(loss_b), rtol=1e-3)
+    for k in pa:
+        np.testing.assert_allclose(np.asarray(pa[k], np.float32),
+                                   np.asarray(pb[k], np.float32),
+                                   rtol=5e-3, atol=5e-4, err_msg=k)
+
+
 def test_fused_adam_kernel_leaf_matches_reference():
     """Direct kernel check on a lane-divisible leaf: one fused update
     equals the reference formula in fp64-ish (fp32) math, including

@@ -66,6 +66,52 @@ def test_checkpoint_resume(tmp_path, capsys):
     assert steps and steps[0] > 6 and steps[-1] == 9
 
 
+def test_bf16_moments_convergence_parity():
+    """The r5 bf16-moment FusedAdam must *converge* like fp32 moments,
+    not just match early steps: 300 steps on the learnable Markov
+    corpus, comparing the tail-averaged loss. This is the numerics pin
+    for the optimizer-stream structural route — storage rounding of
+    the EMAs must not stall or destabilize training (the known bf16-
+    EMA hazard: (1−b2)·g² increments below bf16 resolution get lost)."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    from icikit.models.transformer import (
+        FusedAdam, TransformerConfig, init_params, make_train_step)
+    from icikit.models.transformer.model import make_model_mesh
+
+    cfg = TransformerConfig(vocab=16, d_model=32, n_heads=4, d_head=8,
+                            d_ff=64, n_layers=1, max_seq=32,
+                            compute_dtype="float32")
+    mesh = make_model_mesh(dp=1, tp=1, sp=1)
+    sampler = make_markov_sampler(16, seed=0)
+
+    def run(tx, steps=300):
+        params = init_params(jax.random.key(0), cfg, mesh)
+        _, step = make_train_step(mesh, cfg, tx)
+        st = tx.init(params)
+        losses = []
+        for i in range(steps):
+            batch = jnp.asarray(sampler(i, 4, 32))
+            tok, tgt = batch[:, :-1], batch[:, 1:]
+            params, st, loss = step(params, st, tok, tgt)
+            losses.append(float(loss))
+        return np.asarray(losses)
+
+    l32 = run(FusedAdam(1e-2))
+    l16 = run(FusedAdam(1e-2, mu_dtype=jnp.bfloat16,
+                        nu_dtype=jnp.bfloat16))
+    tail32, tail16 = l32[-30:].mean(), l16[-30:].mean()
+    # both learn (below the uniform baseline ln(16) = 2.77, and below
+    # their own start)…
+    assert tail32 < 2.75 and tail16 < 2.75
+    assert tail32 < l32[0] and tail16 < l16[0]
+    # …and to the same loss within a tight margin (measured 2026-07-31:
+    # 2.6753 vs 2.6743 — the trajectories track almost step-for-step)
+    assert abs(tail16 - tail32) < 0.02 * tail32
+
+
 def test_watchdog_flag_smoke(capsys):
     # arm a generous watchdog; the run finishes inside it and disarms
     # on its own before returning

@@ -94,6 +94,67 @@ def test_grads_multi_chunk_bf16():
                                rtol=0.1, atol=0.05)
 
 
+def test_save_exp_fwd_identical_and_grads_match():
+    """The save-exp head (r5: backward rebuilds softmax from saved
+    bf16 exponentials instead of recomputing the logits chunk) must
+    leave the forward bit-identical and the gradients equal to the
+    recompute path up to the bf16 storage rounding of e. Multi-chunk
+    blocks exercise the per-chunk running-max rescale — chunks written
+    before the global max arrives are rescaled by exp2(m_i − lse)."""
+    x, w, tgt = _case(512, 128, 1024)
+    sel = jnp.asarray(RNG.standard_normal(512).astype(np.float32))
+
+    def loss(save):
+        def f(x, w):
+            return jnp.sum(fused_xent(x, w, tgt, block_t=256,
+                                      block_v=512, save_exp=save) * sel)
+        return f
+
+    np.testing.assert_array_equal(
+        np.asarray(fused_xent(x, w, tgt, block_t=256, block_v=512,
+                              save_exp=True)),
+        np.asarray(fused_xent(x, w, tgt, block_t=256, block_v=512)))
+    dx_s, dw_s = jax.grad(loss(True), argnums=(0, 1))(x, w)
+    dx_r, dw_r = jax.grad(loss(False), argnums=(0, 1))(x, w)
+    # fp32 x/w but e stored in x.dtype=fp32 here: rescale vs recompute
+    # differ only by fp32 reassociation
+    np.testing.assert_allclose(np.asarray(dx_s), np.asarray(dx_r),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(dw_s), np.asarray(dw_r),
+                               rtol=1e-4, atol=1e-5)
+    # and against the oracle
+    def oracle(x, w):
+        return jnp.sum(_oracle_nll(x, w, tgt) * sel)
+    dx_o, dw_o = jax.grad(oracle, argnums=(0, 1))(x, w)
+    np.testing.assert_allclose(np.asarray(dx_s), np.asarray(dx_o),
+                               rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(np.asarray(dw_s), np.asarray(dw_o),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_save_exp_grads_bf16_storage_rounding():
+    """bf16 x/w: e is stored bf16 (2^-8 relative), so saved-path
+    gradients agree with the recompute path to bf16 tolerance."""
+    x, w, tgt = _case(512, 128, 1024)
+    x, w = x.astype(jnp.bfloat16), w.astype(jnp.bfloat16)
+
+    def loss(save):
+        def f(x, w):
+            return jnp.mean(fused_xent(x, w, tgt, block_t=256,
+                                       block_v=512, save_exp=save))
+        return f
+
+    dx_s, dw_s = jax.grad(loss(True), argnums=(0, 1))(x, w)
+    dx_r, dw_r = jax.grad(loss(False), argnums=(0, 1))(x, w)
+    assert dx_s.dtype == jnp.bfloat16 and dw_s.dtype == jnp.bfloat16
+    np.testing.assert_allclose(np.asarray(dx_s, np.float32),
+                               np.asarray(dx_r, np.float32),
+                               rtol=0.05, atol=0.02)
+    np.testing.assert_allclose(np.asarray(dw_s, np.float32),
+                               np.asarray(dw_r, np.float32),
+                               rtol=0.05, atol=0.02)
+
+
 def test_supported_gate():
     assert xent_supported(1024, 128, 2048, jnp.bfloat16)
     assert xent_supported(256, 256, 512, jnp.float32)

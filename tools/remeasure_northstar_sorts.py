@@ -1,0 +1,34 @@
+"""Re-measure NORTHSTAR's 2^28 quicksort/sample/sample_bitonic rows
+under the median-of-windows(+escalation) headline protocol — the three
+rows VERDICT r4 flagged as pre-protocol residue. Appends kind:sort
+records to northstar.jsonl; re-render with
+`python -m icikit.bench.northstar --regen northstar.jsonl --out NORTHSTAR.md`.
+"""
+
+import dataclasses
+import json
+import sys
+
+from icikit.bench.sort import sweep_sorts
+from icikit.utils.mesh import make_mesh
+
+
+def main():
+    mesh = make_mesh()
+    algs = ("quicksort", "sample", "sample_bitonic")
+    recs = sweep_sorts(mesh, (1 << 28,), algorithms=algs, runs=4,
+                       warmup=1, windows=3)
+    with open("northstar.jsonl", "a") as f:
+        for r in recs:
+            f.write(json.dumps({**dataclasses.asdict(r),
+                                "kind": "sort"}) + "\n")
+    for r in recs:
+        print(r.algorithm, f"{r.keys_per_s / 1e6:.1f} Mkeys/s",
+              f"median {r.mean_s * 1e3:.1f} ms",
+              f"spread [{r.min_s * 1e3:.1f}, {r.max_s * 1e3:.1f}]",
+              r.session_quality, f"errors={r.errors}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -147,9 +147,12 @@ def run_northstar(mesh, quick: bool = False, runs: int = 4):
             dlb.append({
                 "grade": "skewed", "strategy": label,
                 "n_games": len(skewed), "n_solutions": rep.n_solutions,
-                # the native pool reports only aggregate telemetry: no
-                # per-worker split exists to compute an imbalance from
-                "wall_s": rep.wall_s, "imbalance": None,
+                # r5: the pool's board→worker map gives a real
+                # per-worker split; on a host with fewer cores than
+                # workers the DYNAMIC split still reflects OS
+                # scheduling (the virtual-clock rows remain the
+                # schedule-quality verdict), but static's is exact
+                "wall_s": rep.wall_s, "imbalance": rep.imbalance,
             })
         if n_cores >= n_threads:
             # a wall-time comparison only carries signal when every
@@ -272,7 +275,13 @@ def render_markdown(coll, sorts, dlb, checks, meta) -> str:
             "critical path in G-steps (steps × 1e-9), their imbalance "
             "max/mean steps. `host-*` rows run the native thread pool "
             "with static = one contiguous chunk per thread; wall-time "
-            "differences only appear when the host has real cores.\n")
+            "differences only appear when the host has real cores. "
+            "The modeled-vs-live consistency is an executable claim, "
+            "not narration: the pool's board→worker telemetry must "
+            "reproduce the modeled strategy ranking and per-worker "
+            "load split (static within 5%, dynamic ordering within "
+            "queue-racing margins) — `tests/test_solitaire.py::"
+            "test_host_pool_reproduces_modeled_schedule_ranking`.\n")
     lines.append("| grade | strategy | solutions | wall_s | imbalance |")
     lines.append("|---|---|---|---|---|")
     for d in dlb:

@@ -189,12 +189,20 @@ def fit_and_render(analytic, measured) -> str:
         xs = [(r["m"] + p - 1) / r["m"] for r in rows]
         ys = [r["per_token_us"] for r in rows]
         n = len(rows)
-        if n >= 2:
+        denom = (n * sum(x * x for x in xs) - sum(xs) ** 2
+                 if n >= 2 else 0.0)
+        if n >= 2 and abs(denom) > 1e-12:
             sx, sy = sum(xs), sum(ys)
-            sxx = sum(x * x for x in xs)
             sxy = sum(x * y for x, y in zip(xs, ys))
-            t_sweep = (n * sxy - sx * sy) / (n * sxx - sx * sx)
+            t_sweep = (n * sxy - sx * sy) / denom
             c = (sy - t_sweep * sx) / n
+        elif n >= 2:
+            # pp=1: (m+p−1)/m = 1 for every m — the bubble term is
+            # gone by construction and per-token time must be FLAT.
+            # Report the mean as the constant; the table's residuals
+            # then measure exactly the m-independence of the per-sweep
+            # cost T, which is the model's core assumption.
+            t_sweep, c = 0.0, sum(ys) / n
         else:
             t_sweep, c = ys[0] / xs[0], 0.0
         lines.append("## Measured per-token time vs m "

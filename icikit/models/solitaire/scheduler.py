@@ -371,21 +371,34 @@ def solve_host(batch: BoardBatch, n_threads: int = 0,
     kept as a first-class backend so the study can compare host-native
     vs TPU-vectorized execution the way the reference compared
     hand-rolled vs vendor collectives (SURVEY.md §5.8)."""
+    import os
+
     from icikit import native
 
+    if not native.available():
+        # the Python fallback solves serially: report ONE worker so
+        # the telemetry describes the run that actually happened (a
+        # fabricated n-thread split would publish imbalance =
+        # n_threads for both strategies)
+        n_threads = 1
+    elif n_threads <= 0:
+        n_threads = os.cpu_count() or 1
     t0 = time.perf_counter()
-    solved, n_moves, moves, steps = native.solve_batch(
+    solved, n_moves, moves, steps, workers = native.solve_batch(
         batch.pegs, batch.playable, max_steps=max_steps,
-        n_threads=n_threads, chunk_size=chunk_size)
+        n_threads=n_threads, chunk_size=chunk_size, return_workers=True)
     wall = time.perf_counter() - t0
     status = np.where(solved, 1, np.where(steps >= max_steps, 3, 2))
-    # The native pool does its own chunk accounting internally; per-worker
-    # telemetry is aggregate-only here.
+    # Per-worker telemetry from the pool's board→worker map (r5): which
+    # thread solved each board, so the live queue's load split is
+    # directly comparable to simulate_schedule's virtual-clock replay.
+    per_games = [int((workers == w).sum()) for w in range(n_threads)]
+    per_steps = [int(steps[workers == w].sum()) for w in range(n_threads)]
     return SolveReport(solved=solved, n_moves=n_moves, moves=moves,
                        steps=steps.astype(np.int64), status=status,
                        wall_s=wall, strategy="host", chunk_size=chunk_size,
-                       per_worker_games=[len(batch)],
-                       per_worker_steps=[int(steps.sum())])
+                       per_worker_games=per_games,
+                       per_worker_steps=per_steps)
 
 
 def write_solutions(path, batch: BoardBatch, report: SolveReport) -> int:

@@ -40,36 +40,35 @@ def _try_load():
     with _lock:
         if _lib is not None or _build_error is not None:
             return _lib
-        if not os.path.exists(_LIB_PATH):
-            try:
-                subprocess.run(
-                    ["make", "-C", _HERE, "-s"], check=True,
-                    capture_output=True, text=True, timeout=120)
-            except (subprocess.SubprocessError, OSError) as e:
+        # Always run make BEFORE the first dlopen: make's own mtime
+        # check makes this a no-op when the library is current, and it
+        # refreshes a stale prebuilt one from before newer sources.
+        # Rebuilding after a failed CDLL probe cannot work — glibc
+        # dlopen returns the already-mapped handle for the same path,
+        # so a post-load rebuild would never be picked up this process.
+        try:
+            subprocess.run(
+                ["make", "-C", _HERE, "-s"], check=True,
+                capture_output=True, text=True, timeout=120)
+        except (subprocess.SubprocessError, OSError) as e:
+            if not os.path.exists(_LIB_PATH):
                 out = getattr(e, "stderr", "") or str(e)
                 _build_error = f"native build failed: {out.strip()[:500]}"
                 return None
+            # no toolchain but a prebuilt library exists: try it (the
+            # symbol probe below rejects it if too old)
         try:
             lib = ctypes.CDLL(_LIB_PATH)
         except OSError as e:
             _build_error = f"native load failed: {e}"
             return None
-        if not hasattr(lib, "ik_markov_fill"):
-            # stale prebuilt library from before the newest entry
-            # points existed: rebuild once and reload
-            try:
-                subprocess.run(["make", "-C", _HERE, "-s", "clean"],
-                               check=True, capture_output=True,
-                               text=True, timeout=60)
-                subprocess.run(["make", "-C", _HERE, "-s"], check=True,
-                               capture_output=True, text=True,
-                               timeout=120)
-                lib = ctypes.CDLL(_LIB_PATH)
-            except (subprocess.SubprocessError, OSError) as e:
-                out = getattr(e, "stderr", "") or str(e)
-                _build_error = ("native library stale and rebuild "
-                                f"failed: {str(out).strip()[:500]}")
-                return None
+        if not (hasattr(lib, "ik_markov_fill")
+                and hasattr(lib, "ik_solve_batch_w")):
+            # stale prebuilt library and no working toolchain to
+            # refresh it (make above would have): honest fallback
+            _build_error = ("native library predates required entry "
+                            "points and could not be rebuilt")
+            return None
         try:
             lib.ik_install_traps.restype = ctypes.c_int
             lib.ik_restore_traps.restype = ctypes.c_int
@@ -90,15 +89,16 @@ def _try_load():
                 ctypes.POINTER(ctypes.c_int32),
                 ctypes.POINTER(ctypes.c_int32),
                 ctypes.POINTER(ctypes.c_int64)]
-            lib.ik_solve_batch.restype = ctypes.c_int
-            lib.ik_solve_batch.argtypes = [
+            lib.ik_solve_batch_w.restype = ctypes.c_int
+            lib.ik_solve_batch_w.argtypes = [
                 ctypes.POINTER(ctypes.c_uint32),
                 ctypes.POINTER(ctypes.c_uint32),
                 ctypes.c_int64, ctypes.c_int64, ctypes.c_int, ctypes.c_int,
                 ctypes.POINTER(ctypes.c_uint8),
                 ctypes.POINTER(ctypes.c_int32),
                 ctypes.POINTER(ctypes.c_int32),
-                ctypes.POINTER(ctypes.c_int64)]
+                ctypes.POINTER(ctypes.c_int64),
+                ctypes.POINTER(ctypes.c_int32)]  # board_worker (r5)
             lib.ik_markov_fill.restype = ctypes.c_int
             lib.ik_markov_fill.argtypes = [
                 ctypes.c_int32, ctypes.c_int32, ctypes.c_uint64,
@@ -231,13 +231,17 @@ def solve(pegs: int, playable: int,
 
 def solve_batch(pegs: np.ndarray, playable: np.ndarray,
                 max_steps: int = 2**62, n_threads: int = 0,
-                chunk_size: int = 8):
+                chunk_size: int = 8, return_workers: bool = False):
     """Native threaded work-queue batch solve. Returns (solved bool[B],
-    n_moves int32[B], moves int32[B,25], steps int64[B])."""
+    n_moves int32[B], moves int32[B,25], steps int64[B]); with
+    ``return_workers`` also int32[B] of the pool worker that solved
+    each board (0 = the server thread) — the DLB study's per-worker
+    telemetry. The Python fallback solves serially: worker 0."""
     pegs = np.ascontiguousarray(pegs, np.uint32)
     playable = np.ascontiguousarray(playable, np.uint32)
     n = len(pegs)
     lib = _try_load()
+    workers = np.zeros(n, np.int32)
     if lib is None:
         from icikit.models.solitaire.game import solve_one_py
         solved = np.zeros(n, bool)
@@ -251,20 +255,25 @@ def solve_batch(pegs: np.ndarray, playable: np.ndarray,
             n_moves[i] = len(ms)
             moves[i, :len(ms)] = ms
             steps[i] = st
+        if return_workers:
+            return solved, n_moves, moves, steps, workers
         return solved, n_moves, moves, steps
     solved = np.zeros(n, np.uint8)
     n_moves = np.zeros(n, np.int32)
     moves = np.full((n, MAX_DEPTH), -1, np.int32)
     steps = np.zeros(n, np.int64)
     if n:
-        lib.ik_solve_batch(
+        lib.ik_solve_batch_w(
             pegs.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
             playable.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
             n, max_steps, n_threads, chunk_size,
             solved.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
             n_moves.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
             moves.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
-            steps.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
+            steps.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            workers.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+    if return_workers:
+        return solved.astype(bool), n_moves, moves, steps, workers
     return solved.astype(bool), n_moves, moves, steps
 
 

@@ -100,11 +100,12 @@ extern "C" int ik_solve(uint32_t pegs, uint32_t playable, int64_t max_steps,
   }
 }
 
-extern "C" int ik_solve_batch(const uint32_t* pegs, const uint32_t* playable,
-                              int64_t n_boards, int64_t max_steps,
-                              int n_threads, int chunk_size, uint8_t* solved,
-                              int32_t* n_moves, int32_t* moves,
-                              int64_t* steps) {
+extern "C" int ik_solve_batch_w(const uint32_t* pegs,
+                                const uint32_t* playable, int64_t n_boards,
+                                int64_t max_steps, int n_threads,
+                                int chunk_size, uint8_t* solved,
+                                int32_t* n_moves, int32_t* moves,
+                                int64_t* steps, int32_t* board_worker) {
   if (n_boards <= 0) return 0;
   if (chunk_size <= 0) chunk_size = 8; /* reference chunk_size, main.cc:15 */
   if (n_threads <= 0) {
@@ -113,7 +114,10 @@ extern "C" int ik_solve_batch(const uint32_t* pegs, const uint32_t* playable,
   }
   std::atomic<int64_t> cursor(0);
 
-  auto client = [&]() {
+  /* board_worker (nullable): which pool worker solved each board —
+   * the per-worker telemetry the DLB study needs to compare the live
+   * queue against simulate_schedule's virtual-clock replay. */
+  auto client = [&](int wid) {
     for (;;) {
       int64_t start = cursor.fetch_add(chunk_size); /* work_need -> chunk */
       if (start >= n_boards) return;                /* terminate */
@@ -123,13 +127,25 @@ extern "C" int ik_solve_batch(const uint32_t* pegs, const uint32_t* playable,
         int st = ik_solve(pegs[b], playable[b], max_steps, &n_moves[b],
                           &moves[b * kMaxDepth], &steps[b]);
         solved[b] = st == 1 ? 1 : 0;
+        if (board_worker) board_worker[b] = wid;
       }
     }
   };
 
   std::vector<std::thread> pool;
-  for (int t = 1; t < n_threads; ++t) pool.emplace_back(client);
-  client(); /* the server solves too (main.cc:115-132) */
+  for (int t = 1; t < n_threads; ++t) pool.emplace_back(client, t);
+  client(0); /* the server solves too (main.cc:115-132) */
   for (auto& t : pool) t.join();
   return 0;
+}
+
+/* Pre-r5 entry kept for ABI stability (no worker telemetry). */
+extern "C" int ik_solve_batch(const uint32_t* pegs, const uint32_t* playable,
+                              int64_t n_boards, int64_t max_steps,
+                              int n_threads, int chunk_size, uint8_t* solved,
+                              int32_t* n_moves, int32_t* moves,
+                              int64_t* steps) {
+  return ik_solve_batch_w(pegs, playable, n_boards, max_steps, n_threads,
+                          chunk_size, solved, n_moves, moves, steps,
+                          nullptr);
 }

@@ -480,3 +480,72 @@ def test_checkpoint_survives_torn_tail(tmp_path):
         f.write('{"chunk": 1, "solved": [tru')  # torn write
     resumed = solve_dynamic(ds, chunk_size=8, checkpoint_path=str(ck))
     np.testing.assert_array_equal(resumed.solved, full.solved)
+
+
+@pytest.mark.slow
+def test_host_pool_reproduces_modeled_schedule_ranking():
+    """VERDICT r4 #8: the DLB schedule-quality claim, executable on the
+    live pool. simulate_schedule's virtual-clock replay says dynamic
+    chunking beats a static contiguous split on the skewed set; the
+    native thread pool (with r5's board->worker telemetry) must
+    reproduce that ranking, and the per-worker load splits must agree
+    with the virtual-clock model up to queue racing (the pool is a
+    pull queue even at one-chunk-per-worker sizing: a fast-starting
+    thread can take two chunks, so groupings — not totals — race;
+    measured 2026-07-31: static imbalance 4.363 live vs 4.358
+    modeled, dynamic 1.760 vs 1.773)."""
+    from icikit import native
+    from icikit.models.solitaire.dataset import generate_skewed_dataset
+    from icikit.models.solitaire.scheduler import (
+        simulate_schedule, solve_host)
+
+    if not native.available():
+        pytest.skip(native.build_error() or "no native runtime")
+
+    n_workers, chunk, max_steps = 8, 4, 500_000
+    skewed = generate_skewed_dataset(256, seed=3, hard_fraction=0.25)
+    host_static = solve_host(skewed, n_threads=n_workers,
+                             chunk_size=-(-len(skewed) // n_workers),
+                             max_steps=max_steps)
+    host_dynamic = solve_host(skewed, n_threads=n_workers,
+                              chunk_size=chunk, max_steps=max_steps)
+    assert host_static.n_solutions == host_dynamic.n_solutions
+
+    # the model replays the MEASURED per-board costs (identical for
+    # both runs: DFS node counts are deterministic)
+    np.testing.assert_array_equal(host_static.steps, host_dynamic.steps)
+    sim_st = simulate_schedule(host_static.steps, n_workers, "static")
+    sim_dy = simulate_schedule(host_static.steps, n_workers, "dynamic",
+                               chunk_size=chunk)
+
+    def imb(per):
+        per = np.asarray(per, np.float64)
+        return per.max() / per.mean()
+
+    # 1. the modeled ranking (the claim NORTHSTAR narrates)
+    assert imb(sim_dy) < imb(sim_st)
+
+    # 2. the live imbalances agree with the model: static's is pinned
+    #    by the indivisible hard chunks (tight), dynamic's races on a
+    #    timeshared host (loose but far from static's 4x+ skew)
+    assert abs(imb(host_static.per_worker_steps)
+               - imb(sim_st)) < 0.05 * imb(sim_st)
+    assert abs(imb(host_dynamic.per_worker_steps)
+               - imb(sim_dy)) < 0.25 * imb(sim_dy)
+
+    # 3. the live pool reproduces the ranking — dynamic spreads the
+    #    hard tail static concentrates — and the dynamic per-worker
+    #    load ORDERING tracks the model worker-for-worker (sorted)
+    assert imb(host_dynamic.per_worker_steps) < imb(
+        host_static.per_worker_steps)
+    np.testing.assert_allclose(
+        np.sort(np.asarray(host_dynamic.per_worker_steps, np.float64)),
+        np.sort(np.asarray(sim_dy, np.float64)), rtol=0.25)
+
+    # 4. chunk conservation: every dynamic chunk went to exactly one
+    #    worker (the queue hands out whole chunks)
+    _, _, _, _, workers = native.solve_batch(
+        skewed.pegs, skewed.playable, max_steps=max_steps,
+        n_threads=n_workers, chunk_size=chunk, return_workers=True)
+    for c0 in range(0, len(skewed), chunk):
+        assert len(set(workers[c0:c0 + chunk])) == 1

@@ -1,0 +1,34 @@
+"""Round-5 train-ceiling structural A/Bs (VERDICT r4 #1) at the base
+preset: recompute vs saved head x fp32 vs bf16 moments, b=8 and b=16,
+interleaved within one session so every variant sees the same tunnel
+mood. Appends records to train_ab_r5.jsonl.
+"""
+
+import json
+import sys
+
+from icikit.bench.train import run_bench
+
+
+def main():
+    batches = [int(b) for b in (sys.argv[1:] or ["8"])]
+    variants = [
+        dict(head="recompute", optimizer="fused"),        # baseline
+        dict(head="saved", optimizer="fused"),            # route (b)
+        dict(head="recompute", optimizer="fused-bf16nu"),  # route (a)
+        dict(head="recompute", optimizer="fused-bf16mom"),
+        dict(head="saved", optimizer="fused-bf16mom"),    # combined
+    ]
+    for batch in batches:
+        for v in variants:
+            rec = run_bench("base", 1, 1, 1, batch, steps=10, warmup=3,
+                            windows=3, **v)
+            rec["ab"] = v
+            print(json.dumps(rec), flush=True)
+            with open("train_ab_r5.jsonl", "a") as f:
+                f.write(json.dumps(rec) + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -100,11 +100,15 @@ def _pp_param_shapes(cfg) -> dict:
 
 
 def bubble_sweep(pp: int = 4, ms=(1, 2, 4, 8, 16), b_micro: int = 2,
-                 s: int = 64, runs: int = 3) -> list[dict]:
+                 s: int = 64, runs: int = 3,
+                 d_model: int = 128) -> list[dict]:
     """Per-token pipeline step time vs microbatch count on the mesh.
 
     Fixed microbatch size: total tokens grow with m, so per-token time
     isolates the bubble (a bubble-free pipeline would be flat in m).
+    ``d_model`` scales the per-sweep compute: the canonical CPU study
+    uses 128; a real-chip anchor needs a compute-dominant shape
+    (~512) or the fixed dispatch cost masquerades as bubble.
     """
     import jax
     import jax.numpy as jnp
@@ -116,8 +120,9 @@ def bubble_sweep(pp: int = 4, ms=(1, 2, 4, 8, 16), b_micro: int = 2,
         init_pp_params, make_pp_mesh, pp_loss_fn)
     from icikit.utils.timing import timeit_chained
 
-    cfg = TransformerConfig(vocab=512, d_model=128, n_heads=4,
-                            d_head=32, d_ff=256, n_layers=pp * 2,
+    cfg = TransformerConfig(vocab=512, d_model=d_model, n_heads=4,
+                            d_head=d_model // 4, d_ff=2 * d_model,
+                            n_layers=pp * 2,
                             max_seq=s, compute_dtype="float32")
     mesh = make_pp_mesh(dp=1, pp=pp)
     params = init_pp_params(jax.random.key(0), cfg, mesh)
@@ -148,6 +153,8 @@ def bubble_sweep(pp: int = 4, ms=(1, 2, 4, 8, 16), b_micro: int = 2,
             "step_s": res.mean_s,
             "per_token_us": round(res.mean_s / tokens * 1e6, 2),
             "ideal_efficiency": round(m / (m + pp - 1), 4),
+            "platform": jax.default_backend(),
+            "d_model": d_model,
         })
     return records
 
@@ -180,8 +187,29 @@ def fit_and_render(analytic, measured) -> str:
                 f"{r['expected_ppermutes']} {ok} | {r['sweeps']} | "
                 f"{r['ideal_efficiency']:.3f} |")
         lines.append("")
-    for p in sorted({r["p"] for r in measured}):
-        rows = sorted((r for r in measured if r["p"] == p),
+    def cfg_key(r):
+        # pre-r5 records predate the platform/d_model stamps: they are
+        # the canonical CPU-mesh study shape
+        return (r["p"], r.get("platform", "cpu"),
+                r.get("d_model", 128), r.get("b_micro", 2),
+                r.get("s", 64))
+
+    for key in sorted({cfg_key(r) for r in measured}):
+        p, platform, d_model, b_micro, s = key
+        if platform == "tpu" and (d_model, b_micro, s) == (128, 2, 64):
+            # exactly the canonical CPU-study shape measured on a real
+            # chip: ~1-2 ms fixed dispatch cost vs ~1 ms of compute,
+            # so its per-token column measures overhead amortization,
+            # not the bubble — excluded from the report (records stay
+            # in the jsonl); use a compute-dominant shape (--dmodel
+            # 512 --bmicro 4 --seq 512) for real-chip anchors
+            lines.append(
+                f"> (pp={p} tpu rows at the canonical CPU-study shape "
+                f"(d_model=128, b_micro=2, s=64) excluded: "
+                "dispatch-latency-bound on a real chip — real-chip "
+                "anchors use a compute-dominant shape.)\n")
+            continue
+        rows = sorted((r for r in measured if cfg_key(r) == key),
                       key=lambda r: r["m"])
         # least-squares fit of t_tok = T*(m+p-1)/m + c over ALL points
         # (two parameters, no anchoring — an anchored fit would make
@@ -206,7 +234,8 @@ def fit_and_render(analytic, measured) -> str:
         else:
             t_sweep, c = ys[0] / xs[0], 0.0
         lines.append("## Measured per-token time vs m "
-                     f"(pp={p}, fwd+bwd): least-squares "
+                     f"(pp={p}, fwd+bwd, {platform}, d_model={d_model}, "
+                     f"b_micro={b_micro}, s={s}): least-squares "
                      f"t_tok = {t_sweep:.1f}·(m+p−1)/m + {c:.1f} µs\n")
         lines.append("| m | per-token µs | model fit | residual | "
                      "ideal m/(m+p−1) |")
@@ -228,11 +257,41 @@ def fit_and_render(analytic, measured) -> str:
     return "\n".join(lines)
 
 
+_GEN_BEGIN = "<!-- generated: pipeline data (do not edit) -->"
+_GEN_END = "<!-- /generated -->"
+
+
+def write_report(analytic, measured, out_path: str) -> None:
+    """Write ``out_path`` replacing only the generated block, so
+    hand-written analysis around it (the round-5 closure narrative
+    with its session-specific numbers) survives regeneration — same
+    convention as SORTSCALING.md."""
+    gen = "\n".join([_GEN_BEGIN, "",
+                     fit_and_render(analytic, measured), _GEN_END])
+    try:
+        text = open(out_path).read()
+    except FileNotFoundError:
+        text = ""
+    if _GEN_BEGIN in text and _GEN_END in text:
+        head = text[:text.index(_GEN_BEGIN)]
+        tail = text[text.index(_GEN_END) + len(_GEN_END):]
+        text = head + gen + tail
+    else:
+        text = gen + "\n"
+    with open(out_path, "w") as f:
+        f.write(text)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--pp", type=int, default=4)
     ap.add_argument("--ms", default="1,2,4,8,16")
     ap.add_argument("--runs", type=int, default=3)
+    ap.add_argument("--dmodel", type=int, default=128,
+                    help="model width (128 = the canonical CPU study; "
+                         "~512 for a compute-dominant real-chip anchor)")
+    ap.add_argument("--bmicro", type=int, default=2)
+    ap.add_argument("--seq", type=int, default=64)
     ap.add_argument("--skip-measure", action="store_true",
                     help="analytic table only (no mesh, no timing)")
     ap.add_argument("--json", dest="json_path", default=None)
@@ -259,13 +318,17 @@ def main(argv=None) -> int:
         for r in recs:
             if r["kind"] != "pp_bubble":
                 continue
-            k = (r["p"], r["m"])
+            # cell key includes the measurement config (platform +
+            # shape): a TPU-anchor row must never displace — or be
+            # displaced by — a CPU-mesh row of the same (p, m)
+            k = (r["p"], r["m"], r.get("platform", "cpu"),
+                 r.get("d_model", 128), r.get("b_micro", 2),
+                 r.get("s", 64))
             if k not in best or r["per_token_us"] < best[k]["per_token_us"]:
                 best[k] = r
         measured = [best[k] for k in sorted(best)]
         out = args.out or "PIPELINE.md"
-        with open(out, "w") as f:
-            f.write(fit_and_render(analytic, measured))
+        write_report(analytic, measured, out)
         print(f"wrote {out}", file=sys.stderr)
         return 0
 
@@ -288,7 +351,9 @@ def main(argv=None) -> int:
                   f"platform_device_count={args.pp}", file=sys.stderr)
             mesh_too_small = True
         else:
-            measured = bubble_sweep(args.pp, ms, runs=args.runs)
+            measured = bubble_sweep(args.pp, ms, runs=args.runs,
+                                    b_micro=args.bmicro, s=args.seq,
+                                    d_model=args.dmodel)
     for r in analytic + measured:
         print(json.dumps(r))
     if args.json_path:
@@ -297,8 +362,7 @@ def main(argv=None) -> int:
             for r in analytic + measured:
                 f.write(json.dumps(r) + "\n")
     if args.out:
-        with open(args.out, "w") as f:
-            f.write(fit_and_render(analytic, measured))
+        write_report(analytic, measured, args.out)
         print(f"wrote {args.out}", file=sys.stderr)
     return 1 if mesh_too_small else 0
 

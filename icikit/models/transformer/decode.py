@@ -40,23 +40,33 @@ from icikit.models.transformer.model import (
 )
 from icikit.models.transformer.moe import moe_ffn_shard
 from icikit.ops.flash_attention import resolve_attention_impl
-from icikit.ops.rope import apply_rope
+from icikit.ops.rope import apply_rope, rope_sincos
 from icikit.parallel.shmap import wrap_program
 
 
-def _masked_attention(q, ks, vs, cur, scale, n_rep):
+def _masked_attention(q, ks, vs, mask, scale, n_rep):
     """q (b, 1, h, dh) against the *un-repeated* cache ks/vs
-    (b, T, h/n_rep, dh), attending positions <= cur. GQA groups are
+    (b, T, h/n_rep, dh) under a precomputed ``mask`` (T,) — computed
+    ONCE per decode step and closed over by every layer (r5: the
+    per-layer arange/compare chain was ~2 of the 218 serialized
+    sub-µs fusions per layer that dominate b=1). GQA groups are
     served by a grouped einsum — the cache is never materialized at
-    n_heads width, which is the point of the shrunken cache. fp32
-    softmax, matmul dtype follows inputs."""
+    n_heads width, which is the point of the shrunken cache; at
+    n_rep == 1 (MHA) the grouping reshapes are skipped entirely.
+    fp32 softmax, matmul dtype follows inputs."""
     b, one, h, dh = q.shape
+    if n_rep == 1:
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, ks,
+                            preferred_element_type=jnp.float32) * scale
+        logits = jnp.where(mask[None, None, None, :], logits, NEG_INF)
+        w = jax.nn.softmax(logits, axis=-1)
+        out = jnp.einsum("bhqk,bkhd->bqhd", w.astype(vs.dtype), vs,
+                         preferred_element_type=jnp.float32)
+        return out.astype(q.dtype)
     qg = q.reshape(b, one, h // n_rep, n_rep, dh)
     logits = jnp.einsum("bqgrd,bkgd->bgrqk", qg, ks,
                         preferred_element_type=jnp.float32) * scale
-    t = ks.shape[1]
-    mask = (jnp.arange(t) <= cur)[None, None, None, None, :]
-    logits = jnp.where(mask, logits, NEG_INF)
+    logits = jnp.where(mask[None, None, None, None, :], logits, NEG_INF)
     w = jax.nn.softmax(logits, axis=-1)
     out = jnp.einsum("bgrqk,bkgd->bqgrd", w.astype(vs.dtype), vs,
                      preferred_element_type=jnp.float32)
@@ -224,17 +234,26 @@ def _build_generate(mesh, cfg: TransformerConfig, s_prompt: int, n_new: int,
             x = params["emb"][token][:, None]
             if cfg.pos_encoding == "learned":
                 x = x + params["pos"][cur][None, None]
+            # step-invariant work hoisted out of the layer loop (r5):
+            # the causal mask and (for rope) the rotation angles depend
+            # only on `cur`, yet were re-emitted per layer — at b=1 the
+            # 218 serialized sub-µs fusions ARE the bottleneck (21% of
+            # the step, DECODE.md), so every per-layer op removed is
+            # ~0.65 µs/layer back
+            mask = jnp.arange(total) <= cur
+            sincos = (rope_sincos(cur[None], cfg.d_head, cfg.rope_theta)
+                      if cfg.pos_encoding == "rope" else None)
             kc2, vc2 = [], []
             for li in range(n_layers):
                 lp1 = {kk: lp[kk][li] for kk in layer_keys}
                 q, k, v = qkv_proj(x, lp1)
                 if cfg.pos_encoding == "rope":
                     pos = cur[None]
-                    q = apply_rope(q, pos, cfg.rope_theta)
-                    k = apply_rope(k, pos, cfg.rope_theta)
+                    q = apply_rope(q, pos, cfg.rope_theta, sincos)
+                    k = apply_rope(k, pos, cfg.rope_theta, sincos)
                 ks = lax.dynamic_update_slice_in_dim(kc[li], k, cur, 1)
                 vs = lax.dynamic_update_slice_in_dim(vc[li], v, cur, 1)
-                attn = _masked_attention(q, ks, vs, cur, scale, n_rep)
+                attn = _masked_attention(q, ks, vs, mask, scale, n_rep)
                 x = close_attn(x, attn, lp1)
                 x = ffn(x, lp1)
                 kc2.append(ks)

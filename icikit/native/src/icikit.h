@@ -65,6 +65,16 @@ int ik_solve_batch(const uint32_t* pegs, const uint32_t* playable,
                    int chunk_size, uint8_t* solved, int32_t* n_moves,
                    int32_t* moves /* n_boards*25 */, int64_t* steps);
 
+/* Primary entry (r5): ik_solve_batch plus per-board worker telemetry.
+ * board_worker (nullable, n_boards) receives the pool worker id that
+ * solved each board — 0 is the server thread, 1..n_threads-1 the pool
+ * threads. The legacy ik_solve_batch forwards here with nullptr. */
+int ik_solve_batch_w(const uint32_t* pegs, const uint32_t* playable,
+                     int64_t n_boards, int64_t max_steps, int n_threads,
+                     int chunk_size, uint8_t* solved, int32_t* n_moves,
+                     int32_t* moves /* n_boards*25 */, int64_t* steps,
+                     int32_t* board_worker);
+
 /* markov.cc — synthetic-corpus generator (the trainer's data loader).
  * Fills out[batch][seq+1] with an order-2 Markov chain over [0, vocab):
  * successor table and all draws derive from splitmix64 finalizers of

@@ -27,13 +27,25 @@ def rope_angles(positions: jax.Array, d: int,
     return positions.astype(jnp.float32)[:, None] * inv[None, :]
 
 
-def apply_rope(x: jax.Array, positions: jax.Array,
-               theta: float = 10000.0) -> jax.Array:
-    """Rotate ``x (b, s, h, d)`` by its positions ``(s,)``; same dtype."""
-    d = x.shape[-1]
+def rope_sincos(positions: jax.Array, d: int, theta: float = 10000.0):
+    """Precomputed ``(cos, sin)`` tables, each ``(s, d/2)`` fp32 — for
+    callers that apply the same positions to many tensors (the decode
+    loop applies one position across every layer; computing the angle
+    chain per layer was pure serialized-fusion overhead at b=1)."""
     ang = rope_angles(positions, d, theta)
-    cos = jnp.cos(ang)[None, :, None, :]
-    sin = jnp.sin(ang)[None, :, None, :]
+    return jnp.cos(ang), jnp.sin(ang)
+
+
+def apply_rope(x: jax.Array, positions: jax.Array,
+               theta: float = 10000.0, sincos=None) -> jax.Array:
+    """Rotate ``x (b, s, h, d)`` by its positions ``(s,)``; same dtype.
+    ``sincos``: optional precomputed ``rope_sincos`` tables (positions
+    is then ignored)."""
+    d = x.shape[-1]
+    if sincos is None:
+        sincos = rope_sincos(positions, d, theta)
+    cos = sincos[0][None, :, None, :]
+    sin = sincos[1][None, :, None, :]
     x1 = x[..., :d // 2].astype(jnp.float32)
     x2 = x[..., d // 2:].astype(jnp.float32)
     out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos],

@@ -525,11 +525,16 @@ def test_host_pool_reproduces_modeled_schedule_ranking():
     # 1. the modeled ranking (the claim NORTHSTAR narrates)
     assert imb(sim_dy) < imb(sim_st)
 
-    # 2. the live imbalances agree with the model: static's is pinned
-    #    by the indivisible hard chunks (tight), dynamic's races on a
-    #    timeshared host (loose but far from static's 4x+ skew)
+    # 2. the live imbalances agree with the model: static's max is
+    #    pinned by the dominant indivisible hard chunk — redistributing
+    #    every easy chunk moves max/mean by < 1%, and the only way to
+    #    blow the bound is one worker taking BOTH hard chunks, which
+    #    requires it to finish a seconds-long DFS before any of 7 peers
+    #    performs a microsecond queue pull. 10% margin covers the
+    #    easy-chunk shuffle with room. Dynamic races on a timeshared
+    #    host (loose margin, still far from static's 4x+ skew).
     assert abs(imb(host_static.per_worker_steps)
-               - imb(sim_st)) < 0.05 * imb(sim_st)
+               - imb(sim_st)) < 0.10 * imb(sim_st)
     assert abs(imb(host_dynamic.per_worker_steps)
                - imb(sim_dy)) < 0.25 * imb(sim_dy)
 

@@ -1,0 +1,66 @@
+"""b=1 decode scaffolding floor study (VERDICT r4 #5).
+
+DECODE.md's profile attributes ~142 µs/token of the base b=1 step to
+218 serialized sub-µs fusions. This script measures, by in-structure
+ablation (same dataflow, one op class stubbed at a time — the
+tile_floor discipline; an isolated microbench would let Mosaic/XLA
+reschedule everything), what each scaffolding class actually costs
+end-to-end, i.e. what a perfect fused replacement could reclaim:
+
+  shipped     — as measured by bench.decode
+  no-norm     — every _rms_norm is identity (removes 2 norm chains/layer)
+  no-softmax  — attention keeps both dots but drops mask+softmax
+  no-attn-vpu — both of the above
+
+Timing-only: the ablated programs compute wrong tokens by design.
+Run on the real chip: PYTHONPATH=/root/repo:/root/.axon_site.
+"""
+
+import json
+import sys
+
+import jax.numpy as jnp
+
+
+def main():
+    import icikit.models.transformer.decode as D
+    from icikit.bench.decode import run_bench
+
+    real_norm = D._rms_norm
+    real_attn = D._masked_attention
+
+    def no_norm(x, w):
+        return x
+
+    def no_vpu_attn(q, ks, vs, mask, scale, n_rep):
+        b, one, h, dh = q.shape
+        from icikit.models.transformer.model import repeat_kv
+        ks, vs = repeat_kv(ks, n_rep), repeat_kv(vs, n_rep)
+        w = jnp.einsum("bqhd,bkhd->bhqk", q, ks,
+                       preferred_element_type=jnp.float32) * scale
+        out = jnp.einsum("bhqk,bkhd->bqhd", w.astype(vs.dtype), vs,
+                         preferred_element_type=jnp.float32)
+        return out.astype(q.dtype)
+
+    variants = [
+        ("shipped", real_norm, real_attn),
+        ("no-norm", no_norm, real_attn),
+        ("no-softmax", real_norm, no_vpu_attn),
+        ("no-attn-vpu", no_norm, no_vpu_attn),
+    ]
+    for name, norm, attn in variants:
+        D._rms_norm = norm
+        D._masked_attention = attn
+        D._build_generate.cache_clear()
+        rec = run_bench("base", 1, 1, 1, 64, 256, runs=3, windows=3)
+        rec["ablation"] = name
+        print(json.dumps(rec), flush=True)
+        with open("decode_floor_r5.jsonl", "a") as f:
+            f.write(json.dumps(rec) + "\n")
+    D._rms_norm = real_norm
+    D._masked_attention = real_attn
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

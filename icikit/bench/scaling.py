@@ -164,17 +164,21 @@ def write_sort_scaling_md(jsonl_path: str = "sort_scaling.jsonl",
     """Refresh SORTSCALING.md's generated block (measured tables +
     figure link + analytic schedule counts) from the committed
     records, preserving the hand-written analysis around it."""
+    from icikit.bench.crossover import crossover_table
+    from icikit.bench.crossover import render_markdown as render_crossover
     from icikit.bench.schedule_stats import render_sort_markdown
 
     with open(jsonl_path) as f:
         records = [json.loads(ln) for ln in f if ln.strip()]
+    ps = tuple(sorted({r["p"] for r in records})) or (2, 4, 8, 16, 32)
     gen = "\n".join([
         _GEN_BEGIN,
         "",
         _render_sort_scaling(records),
         "![sort scaling](docs/figs/sort_scaling_p.png)",
         "",
-        render_sort_markdown(ps=(2, 4, 8, 16, 32), n=1 << 20),
+        render_sort_markdown(ps=ps, n=1 << 20),
+        render_crossover(crossover_table(1 << 20)),
         _GEN_END,
     ])
     try:

@@ -111,3 +111,24 @@ def test_sort_render_markdown():
     text = render_sort_markdown(ps=(2, 4), n=1 << 12)
     assert "| bitonic |" in text and "| quicksort |" in text
     assert "rounds/calls/MB-dev" in text
+
+
+def test_crossover_prediction_structure():
+    """The crossover predictor (r5): structure + the two model
+    properties that carry the science — bitonic wins the small-p
+    low-latency regime, and raising per-round latency can only move
+    the crossover EARLIER (the latency-depth mechanism)."""
+    from icikit.bench.crossover import crossover_table, render_markdown
+
+    tab = crossover_table(1 << 16, ps=(2, 4, 8, 16, 32, 64),
+                          alphas_us=(1.0, 50.0))
+    assert tab["algs"] == ["bitonic", "quicksort"]
+    t1 = tab["times"][1.0]
+    assert t1["bitonic"][0] < t1["quicksort"][0]  # small p: bitonic
+    crossings = [tab["crossover_p"][a] for a in (1.0, 50.0)]
+    # higher alpha crosses no later than lower alpha (None = never)
+    if crossings[0] is not None:
+        assert crossings[1] is not None
+        assert crossings[1] <= crossings[0]
+    md = render_markdown(tab)
+    assert "crossover" in md and "| 50 |" in md

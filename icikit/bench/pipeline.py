@@ -85,6 +85,65 @@ def analytic_pp_counts(cfg, p: int, m: int, b: int = 2,
             "ideal_efficiency": round(m / (m + p - 1), 4)}
 
 
+def analytic_1f1b_counts(cfg, p: int, m: int, b: int = 2,
+                         s: int = 16) -> dict:
+    """Trace the 1F1B program and machine-check its schedule shape:
+    the whole trace must hold exactly TWO ppermutes — both inside the
+    single scan body (one forward ring hop, one reversed cotangent
+    hop) — and the scan must run exactly T = m + 2p − 2 steps. This
+    is the 1F1B analog of the GPipe 2(m+p−2) unrolled-count check:
+    GPipe's schedule length lives in the ppermute count, 1F1B's in
+    the scan trip count."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import AbstractMesh
+
+    from icikit.models.transformer.pipeline import (
+        DP_AXIS, PP_AXIS, _build_pp_1f1b)
+
+    mesh = AbstractMesh((1, p), (DP_AXIS, PP_AXIS))
+    fn = _build_pp_1f1b(mesh, cfg, m, (b, s))
+    shapes = _pp_param_shapes(cfg)
+    params = {k: jax.ShapeDtypeStruct(v, jnp.float32)
+              for k, v in shapes.items()}
+    toks = jax.ShapeDtypeStruct((m, b, s), jnp.int32)
+    jaxpr = jax.make_jaxpr(fn)(params, toks, toks)
+
+    def count_ppermutes(jx):
+        """Total ppermutes in this jaxpr including nested jaxprs."""
+        total = 0
+        for eqn in jx.eqns:
+            if eqn.primitive.name == "ppermute":
+                total += 1
+            for v in eqn.params.values():
+                inner = getattr(v, "jaxpr", v)
+                if hasattr(inner, "eqns"):
+                    total += count_ppermutes(inner)
+        return total
+
+    scans = []  # (length, ppermutes inside that scan's body)
+
+    def walk(jx):
+        for eqn in jx.eqns:
+            if eqn.primitive.name == "scan":
+                body = eqn.params["jaxpr"].jaxpr
+                scans.append((eqn.params.get("length"),
+                              count_ppermutes(body)))
+            for v in eqn.params.values():
+                inner = getattr(v, "jaxpr", v)
+                if hasattr(inner, "eqns"):
+                    walk(inner)
+
+    walk(jaxpr.jaxpr)
+    return {"kind": "pp_1f1b_analytic", "p": p, "m": m,
+            # total over the WHOLE trace: both hops must live inside
+            # the schedule scan, so total == in-body count == 2
+            "ppermutes": count_ppermutes(jaxpr.jaxpr),
+            "expected_ppermutes": 2,
+            "scans": scans,  # (length, body ppermutes) per scan eqn
+            "expected_T": m + 2 * p - 2}
+
+
 def _pp_param_shapes(cfg) -> dict:
     """Parameter shapes from the single source of truth: eval_shape
     over the model's own init_params (no computation, no drift — a

@@ -124,6 +124,26 @@ def _stage_layers(x, lp, cfg, cdt):
     return x
 
 
+def _embed_microbatch(params, tok, s, cfg):
+    """Token embedding (+ learned positions) for one microbatch —
+    shared by the GPipe and 1F1B inject paths so the loss surface
+    cannot silently diverge between schedules."""
+    x = params["emb"][tok].astype(jnp.float32)
+    if cfg.pos_encoding == "learned":
+        x = x + params["pos"][:s]
+    return x
+
+
+def _exit_nll(params, x, tgt, cfg, cdt):
+    """Summed token NLL of the head on a stage output — shared by the
+    GPipe and 1F1B extract paths."""
+    h = _rms_norm(x, params["ln_f"])
+    logits = jnp.einsum("bsd,vd->bsv", h.astype(cdt),
+                        params["w_out"].astype(cdt)).astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    return -jnp.take_along_axis(logp, tgt[..., None], axis=-1).sum()
+
+
 @lru_cache(maxsize=None)
 def _build_pp_loss_and_grad(mesh, cfg: TransformerConfig, n_microbatches: int,
                             local_shape):
@@ -148,20 +168,12 @@ def _build_pp_loss_and_grad(mesh, cfg: TransformerConfig, n_microbatches: int,
         loss_sum = jnp.zeros((), jnp.float32)
         for t in range(m + p - 1):
             if t < m:  # inject microbatch t at stage 0
-                emb_x = params["emb"][tokens[t]].astype(jnp.float32)
-                if cfg.pos_encoding == "learned":
-                    emb_x = emb_x + params["pos"][:s]
+                emb_x = _embed_microbatch(params, tokens[t], s, cfg)
                 x = jnp.where((r == 0)[None, None, None], emb_x, x)
             x = _stage_layers(x, lp, cfg, cdt)
             j = t - (p - 1)
             if 0 <= j < m:  # microbatch j exits at the last stage
-                h = _rms_norm(x, params["ln_f"])
-                logits = jnp.einsum("bsd,vd->bsv", h.astype(cdt),
-                                    params["w_out"].astype(cdt)
-                                    ).astype(jnp.float32)
-                logp = jax.nn.log_softmax(logits, axis=-1)
-                nll = -jnp.take_along_axis(
-                    logp, targets[j][..., None], axis=-1).sum()
+                nll = _exit_nll(params, x, targets[j], cfg, cdt)
                 loss_sum = loss_sum + jnp.where(r == p - 1, nll, 0.0)
             if t < m + p - 2:
                 x = lax.ppermute(x, PP_AXIS, fwd_perm)
@@ -175,26 +187,177 @@ def _build_pp_loss_and_grad(mesh, cfg: TransformerConfig, n_microbatches: int,
                         (P(), specs))
 
 
+@lru_cache(maxsize=None)
+def _build_pp_1f1b(mesh, cfg: TransformerConfig, n_microbatches: int,
+                   local_shape):
+    """One-forward-one-backward (1F1B) pipeline schedule, hand-rolled.
+
+    GPipe above leaves the backward to autodiff: all m forwards run
+    before any backward, so autodiff holds every sweep's residuals —
+    O(m + p) live sweep-residual sets per device. 1F1B interleaves:
+    microbatch u's backward starts the moment its forward exits
+    (global step u + p − 1), so at any time a device holds at most
+    **2p − 1 saved sweep inputs** (a rolling buffer; stage r consumes
+    the residual it created 2(p−1−r) sweeps earlier — the uniform
+    SPMD program sizes the buffer for the worst stage). Residuals are
+    *recompute-style*: only each sweep's input activation (b, s, D)
+    is saved, and the backward re-runs the stage under ``jax.vjp`` —
+    the Megatron 1F1B-with-recompute formulation, which is also what
+    keeps the rolling buffer selectable by a traced slot index
+    (closures cannot be indexed; data can).
+
+    Schedule, as one ``lax.scan`` over T = m + 2p − 2 global steps:
+    step t runs forward sweep t (self-masking past t ≥ m+p−1) and —
+    once t ≥ p−1 — backward sweep u = t−(p−1). The forward activation
+    rides a forward ``ppermute`` ring, the cotangent rides the
+    reversed ring; stage 0 always overwrites its incoming activation
+    (inject or zeros), so its input cotangent is identically zero and
+    the reversed ring delivers exact zero seeds to stage p−1 — no
+    special-casing at the pipeline ends. Invalid sweeps contribute
+    zero loss and zero gradients because their cotangent seeds are
+    zero, not because of post-hoc masking.
+
+    Cost: 3 stage-computes per step (forward + recompute + backward)
+    over m+2p−2 steps vs GPipe-with-full-remat's 3(m+p−1) — a
+    (p−1)/(m+p−1) compute overhead bought for the O(m) → O(p)
+    activation-memory drop (machine-checked by compiled peak-memory
+    comparison in ``tests/test_pipeline.py``).
+    """
+    p = mesh.shape[PP_AXIS]
+    p_dp = mesh.shape[DP_AXIS]
+    m = n_microbatches
+    if cfg.n_layers % p:
+        raise ValueError(f"n_layers={cfg.n_layers} not divisible by pp={p}")
+    cdt = jnp.dtype(cfg.compute_dtype)
+    specs = pp_param_specs(cfg)
+    data_spec = P(None, DP_AXIS)
+    denom = m * local_shape[0] * local_shape[1] * p_dp
+    fwd_perm = [(i, (i + 1) % p) for i in range(p)]
+    rev_perm = [(i, (i - 1) % p) for i in range(p)]
+    S = 2 * p - 1  # rolling residual slots (worst-stage live span + 1)
+    T = m + 2 * p - 2  # global steps
+
+    def per_shard(params, tokens, targets):
+        r = lax.axis_index(PP_AXIS)
+        b, s = tokens.shape[1], tokens.shape[2]
+        layer_keys = ("ln1", "ln2", *_attn_param_keys(cfg),
+                      "wo", "w1", "w2")
+
+        def sweep(params, x, t):
+            """One masked pipeline sweep: inject (stage 0, t < m),
+            stage layers, extract loss (stage p−1, valid exit). ``t``
+            traced, so every sweep shares one jaxpr and the saved
+            inputs stack into an indexable buffer."""
+            lp = {k: params[k] for k in layer_keys}
+            tok = lax.dynamic_index_in_dim(
+                tokens, jnp.clip(t, 0, m - 1), 0, keepdims=False)
+            emb_x = _embed_microbatch(params, tok, s, cfg)
+            # stage 0 ALWAYS overwrites its input (inject or zeros):
+            # its input cotangent is then exactly zero, which the
+            # reversed ring delivers to stage p−1 as the seed
+            x = jnp.where((r == 0)[None, None, None],
+                          jnp.where(t < m, emb_x, jnp.zeros_like(emb_x)),
+                          x)
+            x = _stage_layers(x, lp, cfg, cdt)
+            j = t - (p - 1)
+            tgt = lax.dynamic_index_in_dim(
+                targets, jnp.clip(j, 0, m - 1), 0, keepdims=False)
+            nll = _exit_nll(params, x, tgt, cfg, cdt)
+            valid_exit = (r == p - 1) & (j >= 0) & (j < m)
+            return x, jnp.where(valid_exit, nll, 0.0)
+
+        def to_varying(v, axes=(DP_AXIS, PP_AXIS)):
+            # scan carries must keep a fixed type across iterations,
+            # and the hand-rolled vjp's cotangent seeds must carry the
+            # same varying-manual-axes tags as the sweep's outputs —
+            # so every carry starts explicitly varying over the mesh
+            # (pcast only the axes the leaf doesn't already vary over)
+            cur = getattr(jax.typeof(v), "vma", frozenset())
+            missing = tuple(a for a in axes if a not in cur)
+            return lax.pcast(v, missing, to="varying") if missing else v
+
+        # gradient accumulators keep each param's OWN vma tags: the
+        # per-sweep vjp returns cotangents psummed back to exactly
+        # those tags (invariant for replicated leaves, pp-varying for
+        # the stacks), which is also what the out_specs require
+        zero_grads = jax.tree.map(jnp.zeros_like, params)
+        x0 = to_varying(jnp.zeros((b, s, cfg.d_model), jnp.float32))
+        resbuf0 = jnp.zeros((S,) + x0.shape, x0.dtype) + x0[None]
+
+        def step(carry, t):
+            x, cot, dparams, resbuf, loss_acc = carry
+            # ---- forward half: sweep t, save its input in slot t%S
+            resbuf = lax.dynamic_update_index_in_dim(
+                resbuf, x, t % S, 0)
+            x_out, loss_t = sweep(params, x, t)
+            loss_acc = loss_acc + loss_t
+            x = lax.ppermute(x_out, PP_AXIS, fwd_perm)
+            # ---- backward half: sweep u = t−(p−1); this stage
+            # backpropagates the sweep it ran 2(p−1−r) steps ago
+            u = t - (p - 1)
+            t_saved = u - (p - 1) + 2 * r
+            x_saved = lax.dynamic_index_in_dim(
+                resbuf, jnp.clip(t_saved, 0, T - 1) % S, 0,
+                keepdims=False)
+            _, vjp_fn = jax.vjp(
+                lambda pp_, xx_: sweep(pp_, xx_, t_saved), params,
+                x_saved)
+            # zero seeds on warmup steps (u < 0) make every invalid
+            # contribution exactly zero — no gradient masking needed
+            live = to_varying((u >= 0).astype(jnp.float32))
+            d_params_t, dx_in = vjp_fn((cot * live, live))
+            dparams = jax.tree.map(jnp.add, dparams, d_params_t)
+            cot = lax.ppermute(dx_in, PP_AXIS, rev_perm)
+            return (x, cot, dparams, resbuf, loss_acc), None
+
+        (x, cot, dparams, resbuf, loss_sum), _ = lax.scan(
+            step, (x0, jnp.zeros_like(x0), zero_grads, resbuf0,
+                   to_varying(jnp.zeros((), jnp.float32))),
+            jnp.arange(T))
+
+        # No manual gradient psums: each per-sweep ``jax.vjp`` still
+        # runs autodiff, so the auto-inserted pvary's transpose
+        # ALREADY psums every leaf over the axes it entered
+        # replicated on (dp+pp for emb/pos/ln_f/w_out, dp for the
+        # pp-sharded stacks) — exactly as in the GPipe path. Adding
+        # explicit psums here double-counts by p (measured: 4x on
+        # replicated leaves at pp=4 before this comment existed).
+        dparams = {k: g / denom for k, g in dparams.items()}
+        return lax.psum(loss_sum, (DP_AXIS, PP_AXIS)) / denom, dparams
+
+    return wrap_program(per_shard, mesh, (specs, data_spec, data_spec),
+                        (P(), specs))
+
+
 def pp_loss_fn(params, tokens, targets, mesh, cfg: TransformerConfig,
-               n_microbatches: int):
+               n_microbatches: int, schedule: str = "gpipe"):
     """Global mean token cross-entropy + full gradient tree through the
     microbatch pipeline.
 
     ``tokens``/``targets``: int32 ``(M, B, S)`` — M microbatches,
     batch-sharded over ``dp``, replicated over ``pp``.
+    ``schedule``: "gpipe" (autodiff backward, all-forward-then-all-
+    backward) or "1f1b" (interleaved hand-rolled backward, O(p)
+    activation memory — see ``_build_pp_1f1b``).
     """
     if tokens.shape[0] != n_microbatches:
         raise ValueError(
             f"expected {n_microbatches} microbatches, got {tokens.shape[0]}")
+    if schedule not in ("gpipe", "1f1b"):
+        raise ValueError(f"unknown pipeline schedule {schedule!r} "
+                         "(known: gpipe, 1f1b)")
     local = (tokens.shape[1] // mesh.shape[DP_AXIS], tokens.shape[2])
-    return _build_pp_loss_and_grad(mesh, cfg, n_microbatches, local)(
+    build = (_build_pp_1f1b if schedule == "1f1b"
+             else _build_pp_loss_and_grad)
+    return build(mesh, cfg, n_microbatches, local)(
         params, tokens, targets)
 
 
 def make_pp_train_step(mesh, cfg: TransformerConfig, n_microbatches: int,
-                       optimizer=None):
+                       optimizer=None, schedule: str = "gpipe"):
     """Jitted pipeline training step (params, opt_state, tokens,
-    targets) -> (params, opt_state, loss)."""
+    targets) -> (params, opt_state, loss). ``schedule``: "gpipe" or
+    "1f1b" (O(p) activation memory — see ``pp_loss_fn``)."""
     import optax
     if optimizer is None:
         optimizer = optax.adam(3e-4)
@@ -202,7 +365,7 @@ def make_pp_train_step(mesh, cfg: TransformerConfig, n_microbatches: int,
     @jax.jit
     def step(params, opt_state, tokens, targets):
         loss, grads = pp_loss_fn(params, tokens, targets, mesh, cfg,
-                                 n_microbatches)
+                                 n_microbatches, schedule=schedule)
         updates, opt_state = optimizer.update(grads, opt_state, params)
         return optax.apply_updates(params, updates), opt_state, loss
 

@@ -89,3 +89,81 @@ def test_pp_validation():
     from icikit.models.transformer.pipeline import pp_param_specs
     with pytest.raises(ValueError):
         pp_param_specs(TransformerConfig(n_experts=4))
+
+@pytest.mark.parametrize("dp,pp,m", [(1, 4, 4), (2, 2, 4), (1, 2, 6)])
+def test_pp_1f1b_matches_gpipe(dp, pp, m):
+    """The hand-rolled 1F1B backward must reproduce GPipe's loss and
+    gradients exactly (same arithmetic, different schedule — the
+    interleaving and the explicit cross-shard psums are the only
+    differences)."""
+    tok, tgt = _microbatches(m=m, seed=5)
+    mesh = make_pp_mesh(dp=dp, pp=pp)
+    params = init_pp_params(jax.random.key(0), CFG, mesh)
+    args = _place_pp(mesh, tok, tgt)
+    loss_g, g_g = pp_loss_fn(params, *args, mesh, CFG, n_microbatches=m)
+    loss_i, g_i = pp_loss_fn(params, *args, mesh, CFG, n_microbatches=m,
+                             schedule="1f1b")
+    np.testing.assert_allclose(float(loss_i), float(loss_g), rtol=1e-6)
+    for k in g_g:
+        np.testing.assert_allclose(np.asarray(g_i[k]), np.asarray(g_g[k]),
+                                   rtol=1e-4, atol=1e-6, err_msg=k)
+
+
+def test_pp_1f1b_traced_schedule_shape():
+    """Machine-check the 1F1B schedule: exactly 2 ppermutes in the
+    whole trace (forward ring hop + reversed cotangent hop, both in
+    the one scan body) and scan length T = m + 2p − 2."""
+    from icikit.bench.pipeline import analytic_1f1b_counts
+    for p, m in [(2, 4), (4, 4), (4, 16)]:
+        cfg = TransformerConfig(vocab=61, d_model=32, n_heads=4,
+                                d_head=8, d_ff=64, n_layers=p,
+                                max_seq=16, compute_dtype="float32")
+        rec = analytic_1f1b_counts(cfg, p, m)
+        # both hops live inside the schedule scan: total ppermutes in
+        # the WHOLE trace is 2, and exactly one scan of length T
+        # contains both (a hop unrolled out of the body, or a stray
+        # same-length scan, fails one of these)
+        assert rec["ppermutes"] == rec["expected_ppermutes"], rec
+        sched = [sc for sc in rec["scans"]
+                 if sc == (rec["expected_T"], 2)]
+        assert len(sched) == 1, rec
+
+
+def test_pp_1f1b_activation_memory_advantage():
+    """The point of 1F1B: O(p) live activations instead of GPipe's
+    O(m). Compare the XLA-reported temp allocation of the two
+    compiled programs at m >> p — the 1F1B program must need
+    substantially less scratch."""
+    m, pp = 16, 4
+    mesh = make_pp_mesh(dp=2, pp=pp)
+    params = init_pp_params(jax.random.key(0), CFG, mesh)
+    tok, tgt = _microbatches(m=m, seed=7)
+    args = _place_pp(mesh, tok, tgt)
+
+    def temp_bytes(schedule):
+        f = jax.jit(lambda p_, a, b: pp_loss_fn(
+            p_, a, b, mesh, CFG, n_microbatches=m, schedule=schedule))
+        mem = f.lower(params, *args).compile().memory_analysis()
+        if mem is None:
+            pytest.skip("backend reports no memory analysis")
+        return mem.temp_size_in_bytes
+
+    gp, i1 = temp_bytes("gpipe"), temp_bytes("1f1b")
+    assert i1 < 0.7 * gp, (gp, i1)
+
+
+def test_pp_train_step_1f1b_smoke():
+    """The train-step API reaches the 1F1B schedule (review finding:
+    the kwarg must be forwarded) and a step runs and learns."""
+    import optax
+    mesh = make_pp_mesh(dp=2, pp=2)
+    params = init_pp_params(jax.random.key(1), CFG, mesh)
+    tok, tgt = _microbatches(m=4, seed=3)
+    tok_d, tgt_d = _place_pp(mesh, tok, tgt)
+    optimizer, step = make_pp_train_step(mesh, CFG, 4, optax.adam(1e-2),
+                                         schedule="1f1b")
+    st = optimizer.init(params)
+    params, st, l0 = step(params, st, tok_d, tgt_d)
+    for _ in range(9):
+        params, st, loss = step(params, st, tok_d, tgt_d)
+    assert float(loss) < float(l0)

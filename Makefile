@@ -4,13 +4,16 @@
 
 PY ?= python
 
-.PHONY: test test-fast bench native clean sweep scaling northstar
+.PHONY: test test-fast chaos bench native clean sweep scaling northstar
 
 test:
 	$(PY) -m pytest tests/ -q
 
 test-fast:
 	$(PY) -m pytest tests/ -q -m "not slow"
+
+chaos:
+	$(PY) -m pytest tests/ -q -m chaos
 
 bench:
 	$(PY) bench.py

@@ -97,6 +97,10 @@ def run_northstar(mesh, quick: bool = False, runs: int = 4):
                 "grade": grade, "strategy": rep.strategy,
                 "n_games": n_games, "n_solutions": rep.n_solutions,
                 "wall_s": rep.wall_s, "imbalance": rep.imbalance,
+                # self-healing telemetry: nonzero deaths/reissues in a
+                # bench row means the run recovered from real faults
+                # (or an ICIKIT_CHAOS drill) rather than running clean
+                "n_deaths": rep.n_deaths, "n_reissues": rep.n_reissues,
             })
     counts_agree = all(
         len({d["n_solutions"] for d in dlb if d["grade"] == g}) == 1
@@ -128,6 +132,7 @@ def run_northstar(mesh, quick: bool = False, runs: int = 4):
             "grade": "skewed", "strategy": rep.strategy,
             "n_games": len(skewed), "n_solutions": rep.n_solutions,
             "wall_s": rep.wall_s, "imbalance": rep.imbalance,
+            "n_deaths": rep.n_deaths, "n_reissues": rep.n_reissues,
         })
     import os
     try:

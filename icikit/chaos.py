@@ -1,0 +1,294 @@
+"""Deterministic fault injection (``icikit.chaos``).
+
+The reference's only failure story is fail-fast: trap the signal, print
+a diagnostic, ``MPI_Abort`` the farm (``utilities.cc:49-58``;
+SURVEY.md §5.3). A production TPU stack instead *survives* stragglers,
+worker death, silent data corruption, and flaky checkpoint I/O — and
+recovery code that is never exercised is recovery code that does not
+work. This module makes failures a first-class, reproducible input:
+
+- every injection point in the framework is a named **site**
+  (``"solitaire.worker.3"``, ``"train.loss"``, ``"ckpt.save"``) calling
+  one of four probes: :func:`maybe_delay` (straggler / hang),
+  :func:`maybe_die` (crash), :func:`maybe_corrupt` (bit-flip, the SDC
+  drill), :func:`maybe_io_fail` (flaky storage);
+- a :class:`FaultPlan` decides, **deterministically**, which call fires:
+  the decision for the *n*-th probe of a given ``(kind, site)`` is a
+  pure hash of ``(seed, kind, site, n)`` — independent of thread
+  interleaving, wall clock, or global RNG state — so a drill replays
+  bit-identically under the same plan;
+- plans are armed with the :func:`inject` context manager or the
+  ``ICIKIT_CHAOS`` environment variable, and injection is **strictly
+  zero-overhead when disabled**: every probe is one module-global read
+  and a ``None`` check, no allocation, no lock.
+
+Plan vocabulary (both the dict API and the env-var spec):
+
+- rate entry      ``"die:solitaire.worker.*" -> 0.25``
+  (kind ``:`` site-glob -> probability per probe call)
+- schedule entry  ``"die:solitaire.worker.1" -> (0,)``
+  (these exact call indices fire, regardless of rates)
+- env spec        ``ICIKIT_CHAOS="seed=7;die:solitaire.worker.*=0.25;io:ckpt.*=@1+3"``
+  (``;``-separated; ``@i+j+k`` is the schedule form)
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import hashlib
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+
+import numpy as np
+
+KINDS = ("delay", "die", "corrupt", "io")
+
+
+class ChaosError(Exception):
+    """Base class for injected faults (lets drills distinguish injected
+    failures from organic ones in assertions)."""
+
+
+class InjectedDeath(ChaosError):
+    """An injected worker crash (``maybe_die`` fired)."""
+
+
+class InjectedIOError(ChaosError, OSError):
+    """An injected I/O failure; also an ``OSError`` so production retry
+    paths treat it exactly like the real thing."""
+
+
+def _u64(*parts) -> int:
+    """Stable 64-bit hash of the stringified parts — the decision
+    stream. blake2b, not ``hash()``: PYTHONHASHSEED must not matter."""
+    raw = "|".join(str(p) for p in parts).encode()
+    return int.from_bytes(hashlib.blake2b(raw, digest_size=8).digest(),
+                          "little")
+
+
+@dataclass
+class FaultPlan:
+    """A reproducible fault schedule.
+
+    ``rates`` maps ``"kind:site-glob"`` to a per-call firing
+    probability; ``schedule`` maps ``"kind:site-glob"`` to explicit
+    call indices that always fire. Globs are ``fnmatch`` patterns over
+    site names. The highest matching rate wins; schedule matches fire
+    unconditionally. ``log`` records every fired fault as
+    ``(kind, site, call_index)`` for drill assertions.
+    """
+
+    seed: int = 0
+    rates: dict = field(default_factory=dict)
+    schedule: dict = field(default_factory=dict)
+    delay_s: float = 0.02
+    corrupt_mode: str = "bitflip"  # or "nan": poison instead of flip
+
+    def __post_init__(self):
+        for key in list(self.rates) + list(self.schedule):
+            kind = key.partition(":")[0]
+            if kind not in KINDS:
+                raise ValueError(
+                    f"unknown fault kind {kind!r} in {key!r} "
+                    f"(known: {', '.join(KINDS)})")
+        if self.corrupt_mode not in ("bitflip", "nan"):
+            raise ValueError(
+                f"unknown corrupt_mode {self.corrupt_mode!r}")
+        self._lock = threading.Lock()
+        self._counts: dict = {}
+        self._sched = {k: frozenset(v if not isinstance(v, int) else (v,))
+                       for k, v in self.schedule.items()}
+        self.log: list = []
+
+    # -- decision core ----------------------------------------------
+
+    def fires(self, kind: str, site: str) -> bool:
+        """Consume one probe call at ``(kind, site)`` and decide it."""
+        return self._decide(kind, site)[0]
+
+    def _decide(self, kind: str, site: str) -> tuple:
+        with self._lock:
+            n = self._counts.get((kind, site), 0)
+            self._counts[(kind, site)] = n + 1
+        fired = False
+        for key, idxs in self._sched.items():
+            k, _, glob = key.partition(":")
+            if k == kind and fnmatch.fnmatchcase(site, glob) and n in idxs:
+                fired = True
+                break
+        if not fired:
+            rate = 0.0
+            for key, r in self.rates.items():
+                k, _, glob = key.partition(":")
+                if k == kind and fnmatch.fnmatchcase(site, glob):
+                    rate = max(rate, float(r))
+            if rate > 0.0:
+                fired = _u64(self.seed, kind, site, n) / 2.0**64 < rate
+        if fired:
+            with self._lock:
+                self.log.append((kind, site, n))
+        return fired, n
+
+    def fired(self, kind: str, site_glob: str = "*") -> int:
+        """How many faults of ``kind`` fired at sites matching the glob
+        so far (drill-assertion helper)."""
+        with self._lock:
+            return sum(1 for k, s, _ in self.log
+                       if k == kind and fnmatch.fnmatchcase(s, site_glob))
+
+    # -- fault bodies (called via the module-level probes) ----------
+
+    def _corrupt(self, site: str, n: int, array):
+        a = np.array(array, copy=True)
+        if a.size == 0:
+            return a
+        h = _u64(self.seed, "corrupt-loc", site, n)
+        if self.corrupt_mode == "nan" and np.issubdtype(a.dtype,
+                                                        np.floating):
+            a.reshape(-1)[h % a.size] = np.nan
+            return a
+        buf = bytearray(a.tobytes())
+        buf[h % len(buf)] ^= 1 << ((h >> 32) % 8)
+        return np.frombuffer(bytes(buf), dtype=a.dtype).reshape(a.shape)
+
+
+# -- global plan + probes -------------------------------------------
+#
+# The probes below are THE hot path: when no plan is armed each one is
+# a single global load plus an identity check — no allocation, no
+# locking, no string formatting (callers pass prebuilt site names).
+
+_ACTIVE: FaultPlan | None = None
+_install_lock = threading.Lock()
+
+
+def active() -> FaultPlan | None:
+    """The armed plan, or None when injection is disabled."""
+    return _ACTIVE
+
+
+class inject:
+    """Arm ``plan`` for the duration of a ``with`` block (re-entrant:
+    the previous plan, if any, is restored on exit)."""
+
+    def __init__(self, plan: FaultPlan):
+        self.plan = plan
+
+    def __enter__(self) -> FaultPlan:
+        global _ACTIVE
+        with _install_lock:
+            self._prev = _ACTIVE
+            _ACTIVE = self.plan
+        return self.plan
+
+    def __exit__(self, *exc):
+        global _ACTIVE
+        with _install_lock:
+            _ACTIVE = self._prev
+        return False
+
+
+def maybe_delay(site: str) -> None:
+    """Straggler drill: sleep ``plan.delay_s`` when the plan fires."""
+    plan = _ACTIVE
+    if plan is None:
+        return
+    if plan.fires("delay", site):
+        time.sleep(plan.delay_s)
+
+
+def maybe_die(site: str) -> None:
+    """Crash drill: raise :class:`InjectedDeath` when the plan fires."""
+    plan = _ACTIVE
+    if plan is None:
+        return
+    if plan.fires("die", site):
+        raise InjectedDeath(site)
+
+
+def maybe_corrupt(site: str, array):
+    """SDC drill: return ``array`` with one deterministic bit flipped
+    (or one element poisoned to NaN in ``corrupt_mode="nan"``) when the
+    plan fires; the input object untouched otherwise."""
+    plan = _ACTIVE
+    if plan is None:
+        return array
+    fired, n = plan._decide("corrupt", site)
+    if fired:
+        return plan._corrupt(site, n, array)
+    return array
+
+
+def maybe_io_fail(site: str) -> None:
+    """Flaky-storage drill: raise :class:`InjectedIOError` when the
+    plan fires."""
+    plan = _ACTIVE
+    if plan is None:
+        return
+    if plan.fires("io", site):
+        raise InjectedIOError(f"injected I/O failure at {site}")
+
+
+def io_retry(site: str, fn, *, retries: int = 3,
+             first_backoff: float = 0.05):
+    """Run ``fn()`` behind the ``maybe_io_fail`` probe at ``site``,
+    retrying ``OSError`` with bounded exponential backoff — the one
+    retry policy shared by every checkpoint writer (a stack that dies
+    on one flaky write loses the run it existed to protect). The probe
+    sits inside the loop, so a drill exercises the retry path itself:
+    each attempt is one probe call."""
+    backoff = first_backoff
+    for attempt in range(retries + 1):
+        try:
+            maybe_io_fail(site)
+            return fn()
+        except OSError:
+            if attempt == retries:
+                raise
+            time.sleep(backoff)
+            backoff *= 2
+
+
+# -- env-var arming -------------------------------------------------
+
+def plan_from_spec(spec: str) -> FaultPlan:
+    """Parse an ``ICIKIT_CHAOS`` spec string into a plan. Entries are
+    ``;``-separated ``key=value`` pairs: plan fields (``seed``,
+    ``delay_s``, ``corrupt_mode``) or fault entries whose key is
+    ``kind:site-glob`` and whose value is a probability or an
+    ``@i+j+k`` schedule."""
+    fields = {"seed": 0, "delay_s": 0.02, "corrupt_mode": "bitflip"}
+    rates: dict = {}
+    schedule: dict = {}
+    for entry in spec.split(";"):
+        entry = entry.strip()
+        if not entry:
+            continue
+        key, sep, value = entry.partition("=")
+        if not sep:
+            raise ValueError(f"bad ICIKIT_CHAOS entry {entry!r} "
+                             "(expected key=value)")
+        key = key.strip()
+        value = value.strip()
+        if ":" in key:
+            if value.startswith("@"):
+                schedule[key] = tuple(
+                    int(i) for i in value[1:].split("+") if i)
+            else:
+                rates[key] = float(value)
+        elif key == "seed":
+            fields["seed"] = int(value)
+        elif key == "delay_s":
+            fields["delay_s"] = float(value)
+        elif key == "corrupt_mode":
+            fields["corrupt_mode"] = value
+        else:
+            raise ValueError(f"unknown ICIKIT_CHAOS field {key!r}")
+    return FaultPlan(rates=rates, schedule=schedule, **fields)
+
+
+_env_spec = os.environ.get("ICIKIT_CHAOS")
+if _env_spec:
+    _ACTIVE = plan_from_spec(_env_spec)

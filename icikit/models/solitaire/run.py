@@ -43,9 +43,11 @@ def main(argv=None):
     ap.add_argument("--max-steps", type=int, default=2_000_000_000,
                     help="per-board DFS node budget (step-limit analog of "
                          "the reference's per-run watchdog)")
-    ap.add_argument("--watchdog", type=int, default=0,
+    ap.add_argument("--watchdog", type=int, default=None,
                     help="arm a whole-run watchdog alarm of N seconds "
-                         "(reference chopsigs_, utilities.cc:49-58)")
+                         "(0 = off; default: ICIKIT_WATCHDOG_S when "
+                         "set, else off; reference chopsigs_, "
+                         "utilities.cc:49-58)")
     ap.add_argument("--checkpoint", default=None,
                     help="chunk-level checkpoint file for the dynamic "
                          "scheduler: completed chunks stream here and a "
@@ -55,9 +57,10 @@ def main(argv=None):
     ap.add_argument("--json", dest="json_path", default=None)
     args = ap.parse_args(argv)
 
-    if args.watchdog:
-        from icikit.utils.guard import chopsigs, disarm
-        chopsigs(args.watchdog)
+    from icikit.utils.guard import chopsigs, disarm, resolve_watchdog_s
+    wd = resolve_watchdog_s(args.watchdog)
+    if wd:
+        chopsigs(wd)
         try:
             return _guarded_main(args)
         finally:

@@ -28,11 +28,13 @@ and can never count as solutions, since a win needs exactly one peg).
 
 from __future__ import annotations
 
+import collections
 import hashlib
 import json
 import os
 import threading
 import time
+import warnings
 from dataclasses import dataclass, field
 from pathlib import Path
 
@@ -40,6 +42,7 @@ import numpy as np
 
 import jax
 
+from icikit import chaos
 from icikit.models.solitaire.game import (
     MAX_DEPTH,
     BoardBatch,
@@ -48,7 +51,23 @@ from icikit.models.solitaire.game import (
     solve_batch,
 )
 
-DEFAULT_CHUNK = 8  # reference chunk_size (main.cc:15)
+DEFAULT_CHUNK = 8       # reference chunk_size (main.cc:15)
+DEFAULT_LEASE_S = 120.0  # hung-worker reissue deadline per pull
+
+
+class NoSurvivorsError(RuntimeError):
+    """Every dynamic-schedule worker died before the queue drained.
+
+    Raised *promptly* — as soon as the last worker dies, not after a
+    join over threads that may never return — and only then: any
+    surviving worker absorbs the dead workers' chunks instead
+    (SURVEY.md §5.3's fail-fast story upgraded to self-healing).
+    ``deaths`` maps worker index -> the exception that killed it.
+    """
+
+    def __init__(self, msg: str, deaths: dict):
+        super().__init__(msg)
+        self.deaths = dict(deaths)
 
 
 @dataclass
@@ -66,6 +85,16 @@ class SolveReport:
     per_worker_games: list = field(default_factory=list)
     per_worker_steps: list = field(default_factory=list)
     n_pulls: int = 0      # dynamic only: queue pulls (= host barriers)
+    # self-healing telemetry (dynamic only): how many workers died, how
+    # many leased chunks were handed back out after a death or an
+    # expired lease, and which worker indices died
+    n_deaths: int = 0
+    n_reissues: int = 0
+    worker_deaths: list = field(default_factory=list)
+    # repr() of the exception that killed each worker, aligned with
+    # worker_deaths — survivors absorbing a death must not make the
+    # underlying error (a real bug, an OOM, an injected drill) invisible
+    death_errors: list = field(default_factory=list)
 
     @property
     def n_solutions(self) -> int:
@@ -101,16 +130,37 @@ class ChunkCheckpoint:
     killed run loses at most the chunks in flight; a restart loads the
     file and only solves what is missing. A dataset/config fingerprint
     in the header refuses to resume onto different work.
+
+    Robustness contract (the chaos drills exercise all three):
+
+    - a corrupt-but-parseable record (bit-flipped on disk into wrong
+      lengths, dtypes, or a bogus chunk index) is *skipped* like a torn
+      tail — the chunk is simply re-solved — instead of crashing the
+      post-join ``np.concatenate``;
+    - duplicate records for one chunk (reissue writes from a revived
+      worker) are explicit last-writer-wins on load, and harmless by
+      construction: the solver is deterministic, so every record for a
+      chunk holds identical arrays;
+    - ``add`` retries transient I/O failures with bounded backoff
+      before letting the error surface as a worker death;
+    - ``close()`` seals the store: a hung worker thread abandoned by
+      ``solve_dynamic``'s bounded join may wake *after* the run
+      returned — and after the caller reused the path for different
+      work — so late ``add`` calls on a sealed store are dropped
+      instead of appended.
     """
 
     _FIELDS = ("solved", "n_moves", "moves", "steps", "status")
     _DTYPES = (bool, np.int32, np.int32, np.int32, np.int32)
 
-    def __init__(self, path, fingerprint: str):
+    def __init__(self, path, fingerprint: str, chunk_size: int = None):
         self.path = Path(path)
         self.fingerprint = fingerprint
+        self.chunk_size = chunk_size
         self._lock = threading.Lock()
+        self._closed = False
         self.loaded: dict[int, tuple] = {}
+        self.n_skipped = 0  # invalid records dropped on load
         if self.path.exists() and self.path.stat().st_size > 0:
             with open(self.path) as f:
                 header = json.loads(f.readline())
@@ -125,24 +175,59 @@ class ChunkCheckpoint:
                         rec = json.loads(line)
                     except json.JSONDecodeError:
                         continue
-                    self.loaded[rec["chunk"]] = tuple(
-                        np.asarray(rec[k], dtype=d)
-                        for k, d in zip(self._FIELDS, self._DTYPES))
+                    parsed = self._validate(rec)
+                    if parsed is None:
+                        self.n_skipped += 1
+                        continue
+                    # duplicate chunk records (reissue writes) are
+                    # last-writer-wins: later lines overwrite earlier
+                    self.loaded[rec["chunk"]] = parsed
         else:
             self.path.parent.mkdir(parents=True, exist_ok=True)
             with open(self.path, "w") as f:
                 f.write(json.dumps({"fingerprint": fingerprint}) + "\n")
 
-    def add(self, chunk: int, arrays: tuple) -> None:
+    def _validate(self, rec) -> tuple | None:
+        """Parse one record into the result-array tuple, or None when
+        anything about it fails the chunk shape/dtype contract."""
+        try:
+            c = rec["chunk"]
+            if not isinstance(c, int) or isinstance(c, bool) or c < 0:
+                return None
+            arrays = tuple(np.asarray(rec[k], dtype=d)
+                           for k, d in zip(self._FIELDS, self._DTYPES))
+        except (KeyError, TypeError, ValueError, OverflowError):
+            return None
+        solved, n_moves, moves, steps, status = arrays
+        n = self.chunk_size if self.chunk_size is not None else len(solved)
+        if any(a.shape != (n,) for a in (solved, n_moves, steps, status)):
+            return None
+        if moves.shape != (n, MAX_DEPTH):
+            return None
+        return arrays
+
+    def add(self, chunk: int, arrays: tuple, retries: int = 3) -> None:
         rec = {"chunk": chunk}
         for k, a in zip(self._FIELDS, arrays):
             rec[k] = np.asarray(a).tolist()
         line = json.dumps(rec) + "\n"
+
+        def write():
+            with self._lock:
+                if self._closed:
+                    return  # stale straggler from a finished run
+                with open(self.path, "a") as f:
+                    f.write(line)
+                    f.flush()
+                    os.fsync(f.fileno())
+
+        chaos.io_retry("solitaire.ckpt.write", write, retries=retries,
+                       first_backoff=0.01)
+
+    def close(self) -> None:
+        """Seal the store; subsequent ``add`` calls are no-ops."""
         with self._lock:
-            with open(self.path, "a") as f:
-                f.write(line)
-                f.flush()
-                os.fsync(f.fileno())
+            self._closed = True
 
 
 def checkpoint_fingerprint(batch: BoardBatch, chunk_size: int,
@@ -200,11 +285,131 @@ def solve_static(batch: BoardBatch, devices=None,
                        per_worker_steps=per_steps)
 
 
+class _LeaseQueue:
+    """Chunk work queue with per-chunk leases — the self-healing core.
+
+    ``claim`` hands out chunks under a lease ``(worker, deadline)``;
+    ``commit`` retires them. A worker death (``mark_dead``) releases its
+    leased chunks back to the queue head for survivors; a lease that
+    outlives its deadline (hung worker) is reaped and reissued the same
+    way. A revived worker's late commit is idempotent: the first commit
+    wins the telemetry, and the *results* are identical either way
+    because the solver is deterministic. Invariant: every chunk is in
+    exactly one of todo / leased / done, so ``todo and leases both
+    empty`` == drained.
+    """
+
+    def __init__(self, chunks, lease_s: float, n_workers: int):
+        self._todo = collections.deque(chunks)
+        self._leases: dict = {}     # chunk -> (worker, deadline)
+        self._done: set = set()
+        self._cv = threading.Condition()
+        self.lease_s = lease_s
+        self.n_workers = n_workers
+        self.n_total = len(chunks)
+        self.deaths: dict = {}      # worker -> exception
+        self.reissues = 0
+        self.pulls = 0
+        self.per_games = [0] * n_workers
+        self.per_steps = [0] * n_workers
+
+    # -- worker side -------------------------------------------------
+
+    def claim(self, worker: int, p: int, max_pull: int) -> list:
+        """Guided pull: ~(todo / 2p) chunks, in [1, max_pull]; empty
+        list means the run is over for this worker. Blocks while the
+        queue is empty but chunks are still leased out — those may come
+        back (death, expired lease) and someone must be left to take
+        them."""
+        with self._cv:
+            while True:
+                if len(self._done) == self.n_total:
+                    return []
+                self._reap_expired()
+                if self._todo:
+                    remaining = len(self._todo)
+                    k = max(1, min(remaining // (2 * p), max_pull))
+                    k = min(k, remaining)
+                    out = [self._todo.popleft() for _ in range(k)]
+                    deadline = time.monotonic() + self.lease_s
+                    for c in out:
+                        self._leases[c] = (worker, deadline)
+                    self.pulls += 1
+                    return out
+                if not self._leases:
+                    return []  # drained (terminate tag, main.cc:93-97)
+                self._cv.wait(min(0.05, self.lease_s / 4))
+
+    def commit(self, worker: int, chunk: int, games: int,
+               steps: int) -> bool:
+        """Retire a solved chunk; returns True on the first commit
+        (duplicates from reissued work change nothing)."""
+        with self._cv:
+            self._leases.pop(chunk, None)
+            if chunk in self._done:
+                return False
+            # a straggler may commit after its expired lease already
+            # bounced the chunk back to the queue — pull it out so no
+            # survivor re-solves finished work (todo/leased/done stay
+            # mutually exclusive)
+            try:
+                self._todo.remove(chunk)
+            except ValueError:
+                pass
+            self._done.add(chunk)
+            self.per_games[worker] += games
+            self.per_steps[worker] += steps
+            self._cv.notify_all()
+            return True
+
+    def mark_dead(self, worker: int, exc: BaseException) -> None:
+        """Record a worker death and hand its leased chunks back."""
+        with self._cv:
+            self.deaths[worker] = exc
+            freed = [c for c, (w, _) in self._leases.items() if w == worker]
+            for c in freed:
+                del self._leases[c]
+                self._todo.appendleft(c)
+            self.reissues += len(freed)
+            self._cv.notify_all()
+
+    def _reap_expired(self) -> None:
+        # caller holds the lock
+        now = time.monotonic()
+        expired = [c for c, (_, dl) in self._leases.items() if dl <= now]
+        for c in expired:
+            del self._leases[c]
+            self._todo.appendleft(c)
+        self.reissues += len(expired)
+
+    # -- monitor side ------------------------------------------------
+
+    def wait_drained(self) -> None:
+        """Block until every chunk is committed; raise NoSurvivorsError
+        the moment the last worker dies with work outstanding."""
+        with self._cv:
+            while len(self._done) < self.n_total:
+                if len(self.deaths) >= self.n_workers:
+                    deaths = {w: e for w, e in sorted(self.deaths.items())}
+                    msg = ("solve_dynamic: all "
+                           f"{self.n_workers} workers died with "
+                           f"{self.n_total - len(self._done)} of "
+                           f"{self.n_total} chunks uncommitted "
+                           f"(reissues={self.reissues}); deaths: "
+                           + "; ".join(f"worker {w}: {e!r}"
+                                       for w, e in deaths.items()))
+                    raise NoSurvivorsError(msg, deaths) \
+                        from next(iter(deaths.values()))
+                self._reap_expired()
+                self._cv.wait(0.05)
+
+
 def solve_dynamic(batch: BoardBatch, devices=None,
                   chunk_size: int = DEFAULT_CHUNK,
                   max_steps: int = 2_000_000_000,
                   checkpoint_path=None,
-                  max_pull: int = 32) -> SolveReport:
+                  max_pull: int = 32,
+                  lease_s: float = DEFAULT_LEASE_S) -> SolveReport:
     """Pull-model dynamic schedule: a shared cursor over fixed-size
     chunks; one host thread per device requests, solves, and reports
     until the queue drains (reference client loop, ``main.cc:146-191``,
@@ -223,7 +428,18 @@ def solve_dynamic(batch: BoardBatch, devices=None,
     keeps the same padded shape, so XLA still compiles exactly once.
 
     ``checkpoint_path``: persist each completed chunk and skip chunks
-    already recorded there on restart (see ``ChunkCheckpoint``)."""
+    already recorded there on restart (see ``ChunkCheckpoint``).
+
+    Self-healing (the chaos drills' target): chunks are handed out
+    under leases (``lease_s`` deadline per pull). A crashed worker's
+    in-flight chunks are reissued to survivors immediately; a hung
+    worker's are reissued when its lease expires (its late duplicate
+    commits are idempotent). The run only fails — promptly, with
+    per-worker death telemetry — when *zero* workers survive
+    (:class:`NoSurvivorsError`). Death and reissue counts surface in
+    the report (``n_deaths``, ``n_reissues``, ``worker_deaths``,
+    ``death_errors``), and a healed run emits a ``RuntimeWarning``
+    naming each dead worker's exception."""
     if devices is None:
         devices = jax.devices()
     n = len(batch)
@@ -237,67 +453,79 @@ def solve_dynamic(batch: BoardBatch, devices=None,
     if checkpoint_path is not None:
         ckpt = ChunkCheckpoint(
             checkpoint_path,
-            checkpoint_fingerprint(batch, chunk_size, max_steps))
+            checkpoint_fingerprint(batch, chunk_size, max_steps),
+            chunk_size=chunk_size)
         for i, arrays in ckpt.loaded.items():
             if i < n_chunks:
                 results[i] = arrays
         pending = [i for i in pending if results[i] is None]
 
-    cursor_lock = threading.Lock()
-    cursor = [0]
-    pulls = [0]
-    per_games = [0] * p
-    per_steps = [0] * p
-    errors: list = []
-
-    def next_chunks() -> range:
-        """Guided pull: ~(remaining / 2p) chunks, in [1, max_pull]."""
-        with cursor_lock:
-            remaining = len(pending) - cursor[0]
-            if remaining <= 0:
-                return range(0)  # terminate tag (main.cc:93-97)
-            k = max(1, min(remaining // (2 * p), max_pull, remaining))
-            j = cursor[0]
-            cursor[0] += k
-            pulls[0] += 1
-            return range(j, j + k)
+    queue = _LeaseQueue(pending, lease_s, p)
 
     def worker(w: int):
         dev = devices[w]
+        site = f"solitaire.worker.{w}"
         try:
             while True:
-                js = next_chunks()
-                if not js:
+                chunks = queue.claim(w, p, max_pull)
+                # crash drill: probed on every pull, including the
+                # terminal empty one, so a scheduled first-pull death
+                # fires deterministically even when a fast peer drained
+                # the queue before this thread got a chunk
+                chaos.maybe_die(site)
+                if not chunks:
                     return
+                chaos.maybe_delay(site)  # straggler / hang drill
                 outs = []
-                for j in js:  # async dispatches, one barrier per pull
-                    i = pending[j]
+                for i in chunks:  # async dispatches, one barrier/pull
                     sl = slice(i * chunk_size, (i + 1) * chunk_size)
                     pg = jax.device_put(padded.pegs[sl], dev)
                     pl = jax.device_put(padded.playable[sl], dev)
                     outs.append((i, solve_batch(pg, pl, max_steps)))
                 jax.block_until_ready([o for _, o in outs])
                 for i, out in outs:
-                    results[i] = tuple(np.asarray(o) for o in out)
+                    arrays = tuple(np.asarray(o) for o in out)
+                    results[i] = arrays
+                    # durable record first, then retire the lease: an
+                    # I/O death here leaves the chunk leased, so it
+                    # reissues like any other crash
                     if ckpt is not None:
-                        ckpt.add(i, results[i])
+                        ckpt.add(i, arrays)
                     real = min(chunk_size, max(0, n - i * chunk_size))
-                    per_games[w] += real
-                    per_steps[w] += int(results[i][3][:real].sum())
-        except BaseException as e:  # surface worker crashes to the caller
-            errors.append(e)
+                    queue.commit(w, i, real, int(arrays[3][:real].sum()))
+        except BaseException as e:  # a dead worker, not a dead farm
+            queue.mark_dead(w, e)
 
     t0 = time.perf_counter()
-    if n_chunks:
+    if pending:
         threads = [threading.Thread(target=worker, args=(w,), daemon=True)
                    for w in range(p)]
         for t in threads:
             t.start()
+        queue.wait_drained()
+        # survivors exit on their own (claim returns empty once done);
+        # hung stragglers are daemons whose late commits are idempotent,
+        # so completed work is never held hostage to their join
         for t in threads:
-            t.join()
+            t.join(timeout=1.0)
+    if ckpt is not None:
+        # an abandoned straggler waking after this return must not
+        # append a record computed from THIS dataset to a file the
+        # caller may have rewritten for different work
+        ckpt.close()
     wall = time.perf_counter() - t0
-    if errors:
-        raise errors[0]
+
+    if queue.deaths:
+        # the run healed, but the errors that killed workers must stay
+        # visible — a genuine bug absorbed by reissue would otherwise
+        # masquerade as successful self-healing forever
+        warnings.warn(
+            f"solve_dynamic: {len(queue.deaths)} of {p} workers died; "
+            f"{queue.reissues} leased chunks were reissued to "
+            "survivors; deaths: "
+            + "; ".join(f"worker {w}: {e!r}"
+                        for w, e in sorted(queue.deaths.items())),
+            RuntimeWarning, stacklevel=2)
 
     if n_chunks:
         solved = np.concatenate([r[0] for r in results])[:n]
@@ -312,8 +540,14 @@ def solve_dynamic(batch: BoardBatch, devices=None,
     return SolveReport(solved=solved, n_moves=n_moves, moves=moves,
                        steps=steps, status=status, wall_s=wall,
                        strategy="dynamic", chunk_size=chunk_size,
-                       per_worker_games=per_games,
-                       per_worker_steps=per_steps, n_pulls=pulls[0])
+                       per_worker_games=queue.per_games,
+                       per_worker_steps=queue.per_steps,
+                       n_pulls=queue.pulls,
+                       n_deaths=len(queue.deaths),
+                       n_reissues=queue.reissues,
+                       worker_deaths=sorted(queue.deaths),
+                       death_errors=[repr(queue.deaths[w])
+                                     for w in sorted(queue.deaths)])
 
 
 def simulate_schedule(steps: np.ndarray, p: int, strategy: str,

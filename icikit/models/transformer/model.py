@@ -531,9 +531,11 @@ def _local_loss(params, tokens, targets, cfg, p_sp, p_dp, p_tp, denom):
         cdt = h.dtype
         # explicit replication-lift: the custom-vjp kernel returns a
         # dp/sp-varying dw, so the usual auto-pvary (whose transpose is
-        # the cross-shard gradient psum) must be placed by hand
-        w = lax.pcast(params["w_out"].astype(cdt), (DP_AXIS, SP_AXIS),
-                      to="varying")
+        # the cross-shard gradient psum) must be placed by hand (older
+        # jax has neither vma tracking nor lax.pcast — no tag needed)
+        w = params["w_out"].astype(cdt)
+        if hasattr(lax, "pcast"):
+            w = lax.pcast(w, (DP_AXIS, SP_AXIS), to="varying")
         nll = fused_xent(h.reshape(b * s, cfg.d_model), w,
                          targets.reshape(b * s),
                          save_exp=cfg.xent_save_exp).reshape(b, s)

@@ -272,9 +272,14 @@ def _build_pp_1f1b(mesh, cfg: TransformerConfig, n_microbatches: int,
             # same varying-manual-axes tags as the sweep's outputs —
             # so every carry starts explicitly varying over the mesh
             # (pcast only the axes the leaf doesn't already vary over)
-            cur = getattr(jax.typeof(v), "vma", frozenset())
+            from icikit.ops.pallas_common import varying_axes
+            cur = varying_axes(v)
             missing = tuple(a for a in axes if a not in cur)
-            return lax.pcast(v, missing, to="varying") if missing else v
+            # older jax has neither vma tracking nor lax.pcast; there
+            # the carries need no tags and the cast must be skipped
+            if missing and hasattr(lax, "pcast"):
+                return lax.pcast(v, missing, to="varying")
+            return v
 
         # gradient accumulators keep each param's OWN vma tags: the
         # per-sweep vjp returns cotangents psummed back to exactly

@@ -48,6 +48,7 @@ _BLOCKS = (1024, 512, 256, 128, 64, 32, 16, 8)
 from icikit.ops.pallas_common import LN2 as _LN2
 from icikit.ops.pallas_common import LOG2E as _LOG2E
 from icikit.ops.pallas_common import out_struct as _out_struct
+from icikit.ops.pallas_common import tpu_compiler_params
 
 
 def _pick_block(s: int) -> int | None:
@@ -365,7 +366,7 @@ def _fwd_single_call(qt, kt, vt, causal, scale, bq, bk, interpret,
         scratch_shapes=bias_scratch,
         # the (bq, bk) f32 score/bias tiles exceed the default 16 MB
         # scoped budget at bq = bk = 1024
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             vmem_limit_bytes=64 * 1024 * 1024),
         interpret=interpret,
     )(qt, kt, vt)
@@ -441,7 +442,7 @@ def _fwd_call(qt, kt, vt, causal, scale, bq, bk, interpret, ksplit=1,
         # the (3·bq, bk) bias tile overflows Mosaic's default 16 MB
         # scoped-VMEM budget at bq = bk = 1024 (v5e has 128 MB); other
         # configurations keep the default guardrail
-        **({"compiler_params": pltpu.CompilerParams(
+        **({"compiler_params": tpu_compiler_params(
             vmem_limit_bytes=64 * 1024 * 1024)} if use_bias else {}),
         interpret=interpret,
     )(qt, kt, vt)
@@ -679,7 +680,7 @@ def _bwd_fused_tiled_call(qt, kt, vt, do, lse, delta, causal, scale,
         ],
         # The whole-sequence dq accumulator deliberately exceeds
         # Mosaic's default 16 MB scoped-VMEM budget; v5e has 128 MB.
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             vmem_limit_bytes=100 * 1024 * 1024),
         interpret=interpret,
     )(qt, kt, vt, do, lse, delta)
@@ -725,7 +726,7 @@ def _bwd_call(qt, kt, vt, do, lse, delta, causal, scale, bq, bk, interpret):
             scratch_shapes=bias_scratch,
             # the (bq, bk) f32 bias tile exceeds the 16 MB default
             # scoped budget at bq = bk = 1024
-            **({"compiler_params": pltpu.CompilerParams(
+            **({"compiler_params": tpu_compiler_params(
                 vmem_limit_bytes=64 * 1024 * 1024)} if causal else {}),
             interpret=interpret,
         )(qt, kt, vt, do, lse, delta)

@@ -8,13 +8,33 @@ LOG2E = 1.4426950408889634
 LN2 = 0.6931471805599453
 
 
+def tpu_compiler_params(**kw):
+    """``pltpu.CompilerParams`` across the rename (older jax calls the
+    same dataclass ``TPUCompilerParams``)."""
+    from jax.experimental.pallas import tpu as pltpu
+    cls = getattr(pltpu, "CompilerParams", None)
+    if cls is None:
+        cls = pltpu.TPUCompilerParams
+    return cls(**kw)
+
+
+def varying_axes(x) -> frozenset:
+    """The value's varying-manual-axes tags. Empty on jax versions
+    without ``jax.typeof``/vma tracking — which do not check
+    replication either, so "no tags" is the correct answer there."""
+    typeof = getattr(jax, "typeof", None)
+    if typeof is None:
+        return frozenset()
+    return getattr(typeof(x), "vma", frozenset())
+
+
 def out_struct(shape, dtype, *operands):
     """ShapeDtypeStruct carrying the union of the operands' varying
     mesh axes, so pallas_call composes with shard_map's (default-on)
     replication checking instead of forcing check_vma=False."""
     vma = frozenset()
     for x in operands:
-        vma = vma | getattr(jax.typeof(x), "vma", frozenset())
+        vma = vma | varying_axes(x)
     try:
         return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
     except TypeError:  # older jax: no vma argument, no check either

@@ -44,6 +44,7 @@ from jax.experimental.pallas import tpu as pltpu
 from icikit.ops.pallas_common import LN2 as _LN2
 from icikit.ops.pallas_common import LOG2E as _LOG2E
 from icikit.ops.pallas_common import out_struct as _out_struct
+from icikit.ops.pallas_common import tpu_compiler_params
 
 # Default tile geometry. bt rows of x stay resident while bv-wide vocab
 # chunks stream; (bt, bv) = (1024, 2048) puts the fp32 score tile at
@@ -193,7 +194,7 @@ def _fwd_call(x, w, targets, bt, bv, interpret, save=False):
             pltpu.VMEM((bt, 1), jnp.float32),   # running sum-exp
             pltpu.VMEM((bt, 1), jnp.float32),   # target logit (nats)
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             vmem_limit_bytes=64 * 1024 * 1024),
         interpret=interpret,
     )(x, w, t2)
@@ -219,7 +220,7 @@ def _g_call(x, w, targets, lse, dnll, bt, bv, interpret):
         ],
         out_specs=pl.BlockSpec((bt, bv), lambda it, iv: (it, iv)),
         out_shape=_out_struct((t, v), x.dtype, x, w, targets, lse, dnll),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             vmem_limit_bytes=64 * 1024 * 1024),
         interpret=interpret,
     )(x, w, targets.reshape(nt, 1, bt), lse.reshape(nt, 1, bt),
@@ -241,7 +242,7 @@ def _g_saved_call(e, mrun, targets, lse, dnll, bt, bv, interpret):
         out_specs=pl.BlockSpec((bt, bv), lambda it, iv: (it, iv)),
         out_shape=_out_struct((t, v), e.dtype, e, mrun, targets, lse,
                               dnll),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             vmem_limit_bytes=64 * 1024 * 1024),
         interpret=interpret,
     )(e, mrun, targets.reshape(nt, 1, bt), lse.reshape(nt, 1, bt),

@@ -90,8 +90,14 @@ def init_distributed(coordinator_address: str | None = None,
     one-``MPI_Init``-per-process discipline
     (``Communication/src/main.cc:396``).
     """
-    if jax.distributed.is_initialized():
-        return True
+    is_init = getattr(jax.distributed, "is_initialized", None)
+    if is_init is not None:
+        if is_init():
+            return True
+    else:  # older jax: probe the client on the global state object
+        state = getattr(jax.distributed, "global_state", None)
+        if state is not None and getattr(state, "client", None) is not None:
+            return True
     explicit = (coordinator_address is not None
                 or num_processes is not None or process_id is not None)
     if not (explicit or _cluster_detectable()):

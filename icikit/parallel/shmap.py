@@ -22,19 +22,24 @@ except ImportError:  # pragma: no cover - older jax
 from icikit.utils.registry import get_algorithm
 
 
+_HAS_VMA = hasattr(jax, "typeof")  # vma tracking arrived with jax.typeof
+
+
 def shard_map(f, *, check_vma: bool = True, **kw):
     """``jax.shard_map``, with an opt-out for varying-manual-axes
     checking. Bodies containing ``pallas_call``s must pass
     ``check_vma=False``: Pallas output avals carry no vma information,
     which newer jax rejects under the (default-on) check. Pure
     ppermute/psum schedules keep the check — it is exactly the
-    replication-consistency validation this library wants."""
-    if check_vma:
+    replication-consistency validation this library wants.
+
+    On jax without vma tracking the legacy ``check_rep`` validator has
+    no rule for ``pallas_call`` at all, so checking is disabled across
+    the board there: degraded validation beats broken composition."""
+    if check_vma and _HAS_VMA:
         return _shard_map(f, **kw)
-    try:
+    if _HAS_VMA:
         return _shard_map(f, check_vma=False, **kw)
-    except TypeError:
-        pass
     try:  # pre-0.6 jax spells the flag check_rep
         return _shard_map(f, check_rep=False, **kw)
     except TypeError:

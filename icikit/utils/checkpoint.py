@@ -19,6 +19,8 @@ import os
 import jax
 from jax.sharding import NamedSharding, PartitionSpec
 
+from icikit import chaos
+
 
 def _abstract_like(tree, mesh=None):
     """ShapeDtypeStruct pytree carrying each leaf's sharding — the
@@ -60,11 +62,32 @@ class TrainCheckpointer:
                 max_to_keep=max_to_keep, create=True),
         )
 
-    def save(self, step: int, state) -> None:
+    def save(self, step: int, state, retries: int = 3) -> None:
         """Asynchronous: returns once the state is snapshotted off the
         devices; shard writes complete in the background (Orbax blocks
-        a subsequent save/restore itself, and ``close()`` drains)."""
-        self._mgr.save(step, args=self._ocp.args.StandardSave(state))
+        a subsequent save/restore itself, and ``close()`` drains).
+
+        Transient I/O failures (``OSError`` — flaky NFS/GCS mounts, and
+        the ``chaos`` drill's injected equivalent) are retried with
+        bounded exponential backoff before the error surfaces
+        (``chaos.io_retry``). Because the shard writes are async, a
+        background-write failure from an *earlier* save can also
+        surface here (Orbax re-raises it on the next manager call) —
+        it rides the same retry, and a retry that finds the step
+        already committed by the background writer treats that as
+        success. Errors still pending at ``close()`` surface there."""
+        def attempt():
+            try:
+                self._mgr.save(
+                    step, args=self._ocp.args.StandardSave(state))
+            except ValueError:
+                # a retry after a partially-surfaced failure may find
+                # the step already committed — that IS the saved state
+                if step in (self._mgr.all_steps() or ()):
+                    return
+                raise
+
+        chaos.io_retry("train.ckpt.save", attempt, retries=retries)
 
     def wait_until_finished(self) -> None:
         self._mgr.wait_until_finished()

@@ -21,7 +21,12 @@ import pytest  # noqa: E402
 # env-derived config defaults — override via the config API, which works
 # any time before backend initialization.
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    # jax < 0.5 has no such option; the XLA_FLAGS fallback above
+    # already forced the 8-device host-platform simulation
+    pass
 
 from icikit.utils.mesh import make_mesh  # noqa: E402
 
@@ -30,6 +35,9 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: long-running test (subprocess scale points, "
         "big fixtures)")
+    config.addinivalue_line(
+        "markers", "chaos: fault-injection soak test (worker death, "
+        "stragglers, bit-flips, I/O faults; run via `make chaos`)")
 
 
 @pytest.fixture(scope="session")

@@ -1,0 +1,268 @@
+"""Deterministic fault injection (`icikit.chaos`): same plan, same
+faults — and strictly zero overhead when disabled.
+
+The reference can only provoke failures by hand (kill a PBS job,
+yank a node); here a drill is an input: a (seed, rates | schedule)
+plan whose decisions are pure hashes of (seed, kind, site, call-index),
+independent of thread interleaving and global RNG state."""
+
+import threading
+import tracemalloc
+
+import numpy as np
+import pytest
+
+from icikit import chaos
+from icikit.chaos import FaultPlan, InjectedDeath, InjectedIOError
+
+
+def _drive(plan, sites, calls=40):
+    """Probe every (kind, site) `calls` times under the plan; return
+    the fired-fault log."""
+    with chaos.inject(plan):
+        for _ in range(calls):
+            for s in sites:
+                try:
+                    chaos.maybe_die(s)
+                except InjectedDeath:
+                    pass
+                chaos.maybe_delay(s)
+                try:
+                    chaos.maybe_io_fail(s)
+                except InjectedIOError:
+                    pass
+                chaos.maybe_corrupt(s, np.zeros(4, np.float32))
+    return list(plan.log)
+
+
+def test_same_seed_same_schedule():
+    sites = ["w.0", "w.1", "ckpt.save"]
+    mk = lambda: FaultPlan(seed=7, delay_s=0.0, rates={
+        "die:w.*": 0.3, "io:ckpt.*": 0.5, "corrupt:w.1": 0.2})
+    a = _drive(mk(), sites)
+    b = _drive(mk(), sites)
+    assert a and a == b
+
+
+def test_different_seed_different_schedule():
+    sites = ["w.0", "w.1"]
+    a = _drive(FaultPlan(seed=1, delay_s=0.0, rates={"die:w.*": 0.3}),
+               sites)
+    b = _drive(FaultPlan(seed=2, delay_s=0.0, rates={"die:w.*": 0.3}),
+               sites)
+    assert a != b
+
+
+def test_decisions_independent_of_interleaving():
+    """The n-th probe of a (kind, site) fires identically no matter how
+    calls from different sites interleave — the property that makes a
+    multi-threaded drill replayable."""
+    sites = [f"w.{i}" for i in range(4)]
+    plan_seq = FaultPlan(seed=3, rates={"die:w.*": 0.4})
+    with chaos.inject(plan_seq):
+        for s in sites:          # site-major order
+            for _ in range(50):
+                try:
+                    chaos.maybe_die(s)
+                except InjectedDeath:
+                    pass
+
+    plan_thr = FaultPlan(seed=3, rates={"die:w.*": 0.4})
+
+    def hammer(s):
+        for _ in range(50):
+            try:
+                chaos.maybe_die(s)
+            except InjectedDeath:
+                pass
+
+    with chaos.inject(plan_thr):
+        ts = [threading.Thread(target=hammer, args=(s,)) for s in sites]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+    assert sorted(plan_seq.log) == sorted(plan_thr.log)
+
+
+def test_rate_one_always_fires_rate_zero_never():
+    plan = FaultPlan(seed=0, rates={"die:a": 1.0, "die:b": 0.0})
+    with chaos.inject(plan):
+        for _ in range(10):
+            with pytest.raises(InjectedDeath):
+                chaos.maybe_die("a")
+            chaos.maybe_die("b")  # never raises
+    assert plan.fired("die", "a") == 10
+    assert plan.fired("die", "b") == 0
+
+
+def test_schedule_fires_exact_call_indices():
+    plan = FaultPlan(schedule={"die:w.1": (0, 2)})
+    hits = []
+    with chaos.inject(plan):
+        for n in range(5):
+            try:
+                chaos.maybe_die("w.1")
+            except InjectedDeath:
+                hits.append(n)
+            chaos.maybe_die("w.0")  # glob does not match: never fires
+    assert hits == [0, 2]
+
+
+def test_glob_site_matching():
+    plan = FaultPlan(rates={"io:ckpt.*": 1.0})
+    with chaos.inject(plan):
+        with pytest.raises(InjectedIOError):
+            chaos.maybe_io_fail("ckpt.save")
+        chaos.maybe_io_fail("train.step")  # no match
+
+
+def test_corrupt_is_deterministic_single_bitflip():
+    a = np.arange(32, dtype=np.float32)
+    mk = lambda: FaultPlan(seed=11, rates={"corrupt:x": 1.0})
+    with chaos.inject(mk()):
+        c1 = chaos.maybe_corrupt("x", a)
+    with chaos.inject(mk()):
+        c2 = chaos.maybe_corrupt("x", a)
+    np.testing.assert_array_equal(c1, c2)      # replayable
+    assert not np.array_equal(c1, a)           # and it did corrupt
+    xor = np.frombuffer(c1.tobytes(), np.uint8) ^ np.frombuffer(
+        a.tobytes(), np.uint8)
+    assert int(np.unpackbits(xor).sum()) == 1  # exactly one bit
+    np.testing.assert_array_equal(a, np.arange(32, dtype=np.float32))
+
+
+def test_corrupt_nan_mode_poisons_one_element():
+    a = np.ones(16, np.float32)
+    plan = FaultPlan(rates={"corrupt:x": 1.0}, corrupt_mode="nan")
+    with chaos.inject(plan):
+        c = chaos.maybe_corrupt("x", a)
+    assert int(np.isnan(c).sum()) == 1
+    assert not np.isnan(a).any()
+
+
+def test_disabled_probes_are_inert_and_allocation_free():
+    """No plan armed: every probe is a global read + None check. The
+    hot path must not allocate — `solve_dynamic` probes on every pull
+    and the train loop on every step, drill or no drill."""
+    assert chaos.active() is None
+    arr = np.zeros(8, np.float32)
+    site = "hot.path"
+    probes = [chaos.maybe_die, chaos.maybe_delay, chaos.maybe_io_fail]
+    for p in probes:   # warm up: frames, method caches
+        p(site)
+    assert chaos.maybe_corrupt(site, arr) is arr  # same object, no copy
+    loops = list(range(2000))
+    tracemalloc.start()
+    before = tracemalloc.take_snapshot()
+    for _ in loops:
+        chaos.maybe_die(site)
+        chaos.maybe_delay(site)
+        chaos.maybe_io_fail(site)
+        chaos.maybe_corrupt(site, arr)
+    after = tracemalloc.take_snapshot()
+    tracemalloc.stop()
+    # attribute to chaos.py only: the process has background threads
+    # (XLA, executors) that allocate on their own schedule
+    flt = [tracemalloc.Filter(True, chaos.__file__)]
+    stats = after.filter_traces(flt).compare_to(
+        before.filter_traces(flt), "lineno")
+    # a handful of one-off interpreter allocations (frame objects on a
+    # cold free-list) are tolerated; anything scaling with the 8000
+    # probe calls is not
+    new_blocks = sum(s.count_diff for s in stats if s.count_diff > 0)
+    new_bytes = sum(s.size_diff for s in stats if s.size_diff > 0)
+    assert new_blocks < 50 and new_bytes < 4096, (
+        f"disabled probes allocate per call: {new_blocks} blocks, "
+        f"{new_bytes} bytes over 8000 calls")
+
+
+def test_inject_restores_previous_plan():
+    outer, inner = FaultPlan(seed=1), FaultPlan(seed=2)
+    assert chaos.active() is None
+    with chaos.inject(outer):
+        assert chaos.active() is outer
+        with chaos.inject(inner):
+            assert chaos.active() is inner
+        assert chaos.active() is outer
+    assert chaos.active() is None
+
+
+def test_injected_io_error_is_oserror():
+    # production retry paths catch OSError; the drill must ride them
+    assert issubclass(InjectedIOError, OSError)
+
+
+def test_io_retry_retries_transient_failures():
+    plan = FaultPlan(schedule={"io:x": (0,)})  # first attempt only
+    with chaos.inject(plan):
+        out = chaos.io_retry("x", lambda: "ok", first_backoff=0.001)
+    assert out == "ok"
+    assert plan.fired("io") == 1  # one failure, one successful retry
+
+
+def test_io_retry_bounded_then_raises():
+    plan = FaultPlan(rates={"io:x": 1.0})  # storage is down, not flaky
+    with chaos.inject(plan):
+        with pytest.raises(InjectedIOError):
+            chaos.io_retry("x", lambda: "ok", retries=2,
+                           first_backoff=0.001)
+    assert plan.fired("io") == 3  # initial attempt + 2 retries, no more
+
+
+def test_io_retry_non_oserror_propagates_immediately():
+    calls = []
+
+    def broken():
+        calls.append(1)
+        raise ValueError("logic bug, not weather")
+
+    with pytest.raises(ValueError):
+        chaos.io_retry("x", broken, first_backoff=0.001)
+    assert len(calls) == 1  # never retried
+
+
+def test_env_spec_parsing():
+    plan = chaos.plan_from_spec(
+        "seed=7; die:w.*=0.25; io:ckpt.*=@1+3; delay_s=0.5;"
+        " corrupt_mode=nan")
+    assert plan.seed == 7
+    assert plan.rates == {"die:w.*": 0.25}
+    assert plan.schedule == {"io:ckpt.*": (1, 3)}
+    assert plan.delay_s == 0.5
+    assert plan.corrupt_mode == "nan"
+
+
+@pytest.mark.parametrize("spec", [
+    "frob=1",                 # unknown field
+    "explode:w.*=0.5",        # unknown fault kind
+    "die:w.*",                # missing =value
+])
+def test_env_spec_rejects_garbage(spec):
+    with pytest.raises(ValueError):
+        chaos.plan_from_spec(spec)
+
+
+def test_unknown_kind_rejected_at_plan_construction():
+    with pytest.raises(ValueError):
+        FaultPlan(rates={"explode:w.*": 0.5})
+
+
+def test_env_var_arms_plan_at_import():
+    """ICIKIT_CHAOS in the environment arms a plan before any probe
+    runs — the no-code-changes path for drilling a deployed entry
+    point. Checked in a subprocess: arming happens at import time."""
+    import os
+    import subprocess
+    import sys
+
+    code = ("import icikit.chaos as c; p = c.active(); "
+            "print(p.seed, sorted(p.rates), sorted(p.schedule))")
+    env = dict(os.environ,
+               ICIKIT_CHAOS="seed=5;die:w.*=0.5;io:ckpt.*=@2+7")
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))
+    out = subprocess.run([sys.executable, "-c", code], env=env,
+                         capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert out.stdout.split() == ["5", "['die:w.*']", "['io:ckpt.*']"]

@@ -1,0 +1,188 @@
+"""Chaos soak drills: both long-running pipelines under injected worker
+death, stragglers, bit-flips, and I/O faults — asserting the recovered
+result is IDENTICAL to the fault-free run.
+
+The solitaire solver is deterministic, so recovery is checked by exact
+equality (the same oracle discipline as test_fuzz_collectives.py); the
+train loop is checked by completing every step with a finite loss and
+at least one recorded rollback. Everything is replayable: the fault
+schedule is a pure function of the chaos plan, never of thread timing.
+
+Marked slow + chaos (`make chaos`): each drill pays a fresh XLA
+compile; tier-1 (`-m 'not slow'`) stays within budget.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+import jax
+
+from icikit import chaos
+from icikit.models.solitaire import generate_dataset, solve_dynamic
+
+pytestmark = [pytest.mark.slow, pytest.mark.chaos]
+
+
+def _arrays(report):
+    return (report.solved, report.n_moves, report.moves, report.steps,
+            report.status)
+
+
+def test_solve_dynamic_survives_death_of_all_but_one_worker():
+    """The acceptance drill: p-1 of p workers die mid-run (plus
+    straggler delays on the survivor); the survivor absorbs every
+    reissued chunk and the report is bitwise-identical to fault-free."""
+    p = 4
+    assert jax.device_count() >= p
+    devices = jax.devices()[:p]
+    ds = generate_dataset(48, "easy", seed=17)
+
+    baseline = solve_dynamic(ds, devices=devices, chunk_size=4)
+    assert baseline.n_deaths == 0 and baseline.n_reissues == 0
+
+    plan = chaos.FaultPlan(
+        seed=5,
+        # workers 1..3 claim their first pull, then crash; worker 0
+        # limps (straggler sleeps) but survives and drains the queue
+        schedule={f"die:solitaire.worker.{w}": (0,)
+                  for w in range(1, p)},
+        rates={"delay:solitaire.worker.0": 0.5},
+        delay_s=0.005)
+    with chaos.inject(plan):
+        healed = solve_dynamic(ds, devices=devices, chunk_size=4)
+
+    for a, b in zip(_arrays(baseline), _arrays(healed)):
+        np.testing.assert_array_equal(a, b)   # exact, bitwise
+    assert healed.n_deaths == p - 1
+    assert healed.worker_deaths == [1, 2, 3]
+    assert all("InjectedDeath" in e for e in healed.death_errors)
+    assert healed.n_reissues > 0              # dead workers' leases
+    assert sum(healed.per_worker_games) >= len(ds)
+    assert healed.per_worker_games[0] > 0     # the survivor did work
+
+
+def test_solve_dynamic_chaos_replays_bit_identically():
+    """Same plan, same faults, same report: the whole drill is a pure
+    function of (dataset, chunk plan, chaos seed)."""
+    p = 2
+    devices = jax.devices()[:p]
+    ds = generate_dataset(24, "easy", seed=23)
+
+    def drill():
+        plan = chaos.FaultPlan(
+            seed=9, rates={"delay:solitaire.worker.*": 0.3},
+            schedule={"die:solitaire.worker.1": (1,)}, delay_s=0.005)
+        with chaos.inject(plan):
+            rep = solve_dynamic(ds, devices=devices, chunk_size=4)
+        return rep, sorted(plan.log)
+
+    rep1, log1 = drill()
+    rep2, log2 = drill()
+    assert log1 == log2
+    for a, b in zip(_arrays(rep1), _arrays(rep2)):
+        np.testing.assert_array_equal(a, b)
+    assert rep1.n_deaths == rep2.n_deaths == 1
+
+
+def test_solve_dynamic_death_plus_flaky_checkpoint(tmp_path):
+    """Worker death AND flaky checkpoint storage at once: retried
+    writes land, the run heals, and a restart trusts the file."""
+    p = 3
+    devices = jax.devices()[:p]
+    ds = generate_dataset(36, "easy", seed=31)
+    baseline = solve_dynamic(ds, devices=devices, chunk_size=4)
+
+    ck = tmp_path / "chaos.ckpt"
+    plan = chaos.FaultPlan(
+        seed=2,
+        schedule={"die:solitaire.worker.2": (0,)},
+        # every ~5th write attempt fails; ChunkCheckpoint.add retries
+        rates={"io:solitaire.ckpt.write": 0.2})
+    with chaos.inject(plan):
+        healed = solve_dynamic(ds, devices=devices, chunk_size=4,
+                               checkpoint_path=str(ck))
+    for a, b in zip(_arrays(baseline), _arrays(healed)):
+        np.testing.assert_array_equal(a, b)
+    assert healed.n_deaths == 1
+    assert plan.fired("io") > 0               # the drill actually bit
+
+    # a restart resumes every chunk from the survivor-written file
+    resumed = solve_dynamic(ds, devices=devices, chunk_size=4,
+                            checkpoint_path=str(ck))
+    for a, b in zip(_arrays(baseline), _arrays(resumed)):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_train_loop_survives_nan_steps_and_flaky_ckpt(tmp_path, capsys):
+    """Anomaly-guard drill: injected NaN losses are skipped, a streak
+    triggers rollback to the last committed checkpoint, the first
+    checkpoint save needs an I/O retry — and the run still completes
+    every step with a finite final loss."""
+    from icikit.models.transformer.train import train
+
+    plan = chaos.FaultPlan(
+        # probe call n at train.loss == 0-based step: corrupt steps
+        # 5-6 (1-based) into NaN; rollback-after-2 fires on the second.
+        # io @0: the step-3 checkpoint's first write attempt fails and
+        # is retried (TrainCheckpointer backoff), not forfeited.
+        schedule={"corrupt:train.loss": (4, 5),
+                  "io:train.ckpt.save": (0,)},
+        corrupt_mode="nan")
+    with chaos.inject(plan):
+        rc = train(["--steps", "12", "--batch", "4", "--vocab", "32",
+                    "--d-model", "32", "--n-heads", "2", "--d-head", "8",
+                    "--d-ff", "64", "--n-layers", "1", "--seq", "16",
+                    "--compute-dtype", "float32", "--log-every", "3",
+                    "--sample-tokens", "0", "--guard-rollback-after", "2",
+                    "--ckpt-dir", str(tmp_path / "run"),
+                    "--ckpt-every", "3"])
+    assert rc == 0
+    recs = [json.loads(line) for line in
+            capsys.readouterr().out.strip().splitlines()]
+
+    anomalies = [r for r in recs if r.get("event") == "anomaly"]
+    rollbacks = [r for r in recs if r.get("event") == "rollback"]
+    assert len(anomalies) == 2                # both injected NaNs seen
+    assert len(rollbacks) == 1                # streak of 2 -> one rewind
+    assert rollbacks[0]["to_step"] == 3       # last committed ckpt
+    assert not any(r.get("event") == "ckpt_save_failed" for r in recs)
+
+    steps = [r for r in recs if "step" in r and "loss" in r]
+    assert steps[-1]["step"] == 12            # completed all steps
+    assert np.isfinite(steps[-1]["loss"])     # and recovered
+
+    summary = [r for r in recs if r.get("event") == "guard_summary"]
+    assert summary and summary[0]["anomalies"] == 2
+    assert summary[0]["rollbacks"] == 1
+    assert summary[0]["ckpt_save_failures"] == 0
+    assert plan.fired("io") == 1
+
+    # determinism of the fault schedule itself: same plan, same log
+    assert sorted(plan.log) == [("corrupt", "train.loss", 4),
+                                ("corrupt", "train.loss", 5),
+                                ("io", "train.ckpt.save", 0)]
+
+
+def test_train_loop_rolls_back_to_start_without_ckpt(capsys):
+    """No checkpoint dir: the guard's rollback target degrades to the
+    start-of-run state, and the run still finishes finite."""
+    from icikit.models.transformer.train import train
+
+    plan = chaos.FaultPlan(
+        schedule={"corrupt:train.loss": (2, 3, 4)}, corrupt_mode="nan")
+    with chaos.inject(plan):
+        rc = train(["--steps", "8", "--batch", "4", "--vocab", "32",
+                    "--d-model", "32", "--n-heads", "2", "--d-head", "8",
+                    "--d-ff", "64", "--n-layers", "1", "--seq", "16",
+                    "--compute-dtype", "float32", "--log-every", "2",
+                    "--sample-tokens", "0",
+                    "--guard-rollback-after", "3"])
+    assert rc == 0
+    recs = [json.loads(line) for line in
+            capsys.readouterr().out.strip().splitlines()]
+    rollbacks = [r for r in recs if r.get("event") == "rollback"]
+    assert len(rollbacks) == 1 and rollbacks[0]["to_step"] == 0
+    steps = [r for r in recs if "step" in r and "loss" in r]
+    assert steps[-1]["step"] == 8 and np.isfinite(steps[-1]["loss"])

@@ -554,3 +554,219 @@ def test_host_pool_reproduces_modeled_schedule_ranking():
         n_threads=n_workers, chunk_size=chunk, return_workers=True)
     for c0 in range(0, len(skewed), chunk):
         assert len(set(workers[c0:c0 + chunk])) == 1
+
+
+# ---------------------------------------------------------------------------
+# Self-healing dynamic schedule: lease queue + hardened checkpoint
+# (fast, queue-level drills; full-pipeline chaos soaks live in
+# tests/test_chaos_soak.py)
+
+
+def _queue(chunks, lease_s=60.0, workers=2):
+    from icikit.models.solitaire.scheduler import _LeaseQueue
+    return _LeaseQueue(list(range(chunks)), lease_s, workers)
+
+
+def test_lease_queue_death_reissues_inflight_chunks():
+    q = _queue(4, workers=2)
+    mine = q.claim(0, p=2, max_pull=2)
+    assert mine  # leased to worker 0
+    q.mark_dead(0, RuntimeError("boom"))
+    assert q.reissues == len(mine)
+    # the survivor drains everything, including the reissued chunks
+    seen = []
+    while True:
+        got = q.claim(1, p=2, max_pull=4)
+        if not got:
+            break
+        for c in got:
+            assert q.commit(1, c, games=1, steps=1)
+        seen += got
+    assert sorted(seen) == [0, 1, 2, 3]
+    assert q.deaths.keys() == {0}
+
+
+def test_lease_queue_expired_lease_reissues_and_late_commit_is_noop():
+    q = _queue(2, lease_s=0.0, workers=2)  # leases expire immediately
+    hung = q.claim(0, p=2, max_pull=1)
+    assert hung == [0]
+    # worker 1 pulls: the expired lease is reaped and chunk 0 reissued
+    got = []
+    while len(got) < 2:
+        pulled = q.claim(1, p=2, max_pull=1)
+        assert pulled
+        got += pulled
+        assert q.commit(1, pulled[0], games=1, steps=1)
+    assert sorted(got) == [0, 1]
+    assert q.reissues >= 1
+    # the hung worker finally finishes: duplicate commit changes nothing
+    assert q.commit(0, 0, games=1, steps=1) is False
+    assert q.per_games[0] == 0  # first commit won the telemetry
+    assert q.claim(0, p=2, max_pull=1) == []  # drained
+
+
+def test_lease_queue_no_survivors_raises_promptly():
+    import time as _time
+
+    from icikit.models.solitaire.scheduler import NoSurvivorsError
+    q = _queue(4, workers=2)
+    q.claim(0, p=2, max_pull=1)
+    t0 = _time.monotonic()
+    q.mark_dead(0, RuntimeError("first"))
+    q.mark_dead(1, ValueError("second"))
+    with pytest.raises(NoSurvivorsError) as ei:
+        q.wait_drained()
+    # prompt: no join over threads that will never return
+    assert _time.monotonic() - t0 < 5.0
+    assert ei.value.deaths.keys() == {0, 1}
+    assert "worker 0" in str(ei.value) and "worker 1" in str(ei.value)
+    assert "2 workers died" in str(ei.value)
+
+
+def test_solve_dynamic_all_workers_dead_error_telemetry():
+    """End-to-end: every worker dies -> NoSurvivorsError with per-worker
+    telemetry, raised without waiting on wedged joins."""
+    from icikit import chaos
+    from icikit.models.solitaire.scheduler import NoSurvivorsError
+
+    ds = generate_dataset(16, "easy", seed=3)
+    p = min(2, jax.device_count())
+    plan = chaos.FaultPlan(schedule={
+        f"die:solitaire.worker.{w}": (0,) for w in range(p)})
+    with chaos.inject(plan):
+        with pytest.raises(NoSurvivorsError) as ei:
+            solve_dynamic(ds, devices=jax.devices()[:p], chunk_size=4)
+    assert sorted(ei.value.deaths) == list(range(p))
+    assert all(isinstance(e, chaos.InjectedDeath)
+               for e in ei.value.deaths.values())
+
+
+def test_chunk_checkpoint_skips_corrupt_but_parseable_records(tmp_path):
+    """A bit-flipped-on-disk record that still parses as JSON (wrong
+    lengths, wrong chunk index, wrong types) must be skipped like a
+    torn tail — never crash the post-join concatenate."""
+    import json as _json
+
+    from icikit.models.solitaire.scheduler import ChunkCheckpoint
+
+    ds = generate_dataset(16, "easy", seed=9)
+    ck = tmp_path / "c.ckpt"
+    full = solve_dynamic(ds, chunk_size=8, checkpoint_path=str(ck))
+
+    good = _json.loads(open(ck).readlines()[1])
+    bad = [
+        dict(good, solved=good["solved"][:-1]),        # short array
+        dict(good, chunk="one"),                       # bogus index
+        dict(good, chunk=-2),
+        dict(good, n_moves="abc"),                     # wrong type
+        dict(good, moves=[[0] * 3] * 8),               # wrong width
+        dict(good, steps=None),
+    ]
+    with open(ck, "a") as f:
+        for rec in bad:
+            f.write(_json.dumps(rec) + "\n")
+
+    from icikit.models.solitaire.scheduler import checkpoint_fingerprint
+    fp = checkpoint_fingerprint(ds, 8, 2_000_000_000)
+    store = ChunkCheckpoint(str(ck), fp, chunk_size=8)
+    assert store.n_skipped == len(bad)
+
+    resumed = solve_dynamic(ds, chunk_size=8, checkpoint_path=str(ck))
+    np.testing.assert_array_equal(resumed.solved, full.solved)
+    np.testing.assert_array_equal(resumed.steps, full.steps)
+
+
+def test_chunk_checkpoint_duplicates_are_last_writer_wins(tmp_path):
+    """Reissue writes can record one chunk twice; load must keep the
+    LAST record (both are correct in production — the solver is
+    deterministic — but the contract must be pinned)."""
+    from icikit.models.solitaire.game import MAX_DEPTH
+    from icikit.models.solitaire.scheduler import ChunkCheckpoint
+
+    ck = tmp_path / "dup.ckpt"
+    store = ChunkCheckpoint(str(ck), "fp", chunk_size=4)
+
+    def rec(tag):
+        return (np.zeros(4, bool), np.zeros(4, np.int32),
+                np.full((4, MAX_DEPTH), -1, np.int32),
+                np.full(4, tag, np.int32), np.zeros(4, np.int32))
+
+    store.add(0, rec(111))
+    store.add(0, rec(222))  # the reissue's duplicate
+    again = ChunkCheckpoint(str(ck), "fp", chunk_size=4)
+    assert list(again.loaded) == [0]
+    assert (again.loaded[0][3] == 222).all()
+
+
+def test_chunk_checkpoint_sealed_after_close_drops_late_adds(tmp_path):
+    """A hung worker abandoned by solve_dynamic's bounded join may wake
+    after the run returned and the caller reused the path for other
+    work — its late add() on the sealed store must be dropped, not
+    appended past the new run's fingerprint guard."""
+    from icikit.models.solitaire.game import MAX_DEPTH
+    from icikit.models.solitaire.scheduler import ChunkCheckpoint
+
+    ck = tmp_path / "sealed.ckpt"
+    store = ChunkCheckpoint(str(ck), "fp", chunk_size=2)
+    arrays = (np.zeros(2, bool), np.zeros(2, np.int32),
+              np.full((2, MAX_DEPTH), -1, np.int32),
+              np.zeros(2, np.int32), np.zeros(2, np.int32))
+    store.add(0, arrays)
+    store.close()
+    store.add(1, arrays)  # the straggler's stale write
+    assert list(ChunkCheckpoint(str(ck), "fp", chunk_size=2).loaded) \
+        == [0]
+
+
+def test_chunk_checkpoint_add_retries_transient_io_failures(tmp_path):
+    """One flaky write must not kill a worker: add() retries with
+    bounded backoff (first two attempts fail here, third lands)."""
+    from icikit import chaos
+    from icikit.models.solitaire.game import MAX_DEPTH
+    from icikit.models.solitaire.scheduler import ChunkCheckpoint
+
+    ck = tmp_path / "flaky.ckpt"
+    store = ChunkCheckpoint(str(ck), "fp", chunk_size=2)
+    arrays = (np.zeros(2, bool), np.zeros(2, np.int32),
+              np.full((2, MAX_DEPTH), -1, np.int32),
+              np.zeros(2, np.int32), np.zeros(2, np.int32))
+    plan = chaos.FaultPlan(
+        schedule={"io:solitaire.ckpt.write": (0, 1, 3)})
+    with chaos.inject(plan):
+        store.add(0, arrays)                    # retried internally
+        with pytest.raises(OSError):
+            store.add(1, arrays, retries=0)     # retries exhausted
+    assert plan.fired("io") == 3
+    assert list(ChunkCheckpoint(str(ck), "fp", chunk_size=2).loaded) \
+        == [0]
+
+
+def test_lease_queue_late_commit_cancels_pending_reissue():
+    """A straggler whose lease was reaped may still finish first: its
+    commit must retire the chunk AND pull it back out of the queue so
+    no survivor re-solves finished work."""
+    q = _queue(1, lease_s=0.0, workers=1)
+    assert q.claim(0, p=1, max_pull=1) == [0]
+    with q._cv:                     # reap without a competing claim
+        q._reap_expired()
+    assert list(q._todo) == [0] and q.reissues == 1
+    assert q.commit(0, 0, games=1, steps=1) is True
+    assert not q._todo              # the pending reissue was cancelled
+    assert q.claim(0, p=1, max_pull=1) == []  # drained
+
+
+def test_solve_dynamic_partial_death_warns_and_reports_errors():
+    """A healed run must not hide the error that killed a worker: it
+    lands in SolveReport.death_errors and a RuntimeWarning."""
+    from icikit import chaos
+
+    ds = generate_dataset(16, "easy", seed=5)
+    p = min(2, jax.device_count())
+    plan = chaos.FaultPlan(schedule={"die:solitaire.worker.1": (0,)})
+    with chaos.inject(plan):
+        with pytest.warns(RuntimeWarning, match="worker 1"):
+            rep = solve_dynamic(ds, devices=jax.devices()[:p],
+                                chunk_size=4)
+    assert rep.n_deaths == 1 and rep.worker_deaths == [1]
+    assert len(rep.death_errors) == 1
+    assert "InjectedDeath" in rep.death_errors[0]

@@ -118,7 +118,26 @@ def test_watchdog_flag_smoke(capsys):
     import signal
     recs = _run(capsys, "--watchdog", "600")
     assert any("loss" in r for r in recs)
+    armed = [r for r in recs if r.get("event") == "watchdog_armed"]
+    assert armed and armed[0]["timeout_s"] == 600
     assert signal.alarm(0) == 0  # train() already disarmed
+
+
+def test_watchdog_env_var_arms_without_flag(monkeypatch, capsys):
+    # ICIKIT_WATCHDOG_S must reach runs launched with no --watchdog at
+    # all — the batch-queue budget knob needs no CLI edit
+    import signal
+    monkeypatch.setenv("ICIKIT_WATCHDOG_S", "700")
+    recs = _run(capsys)
+    armed = [r for r in recs if r.get("event") == "watchdog_armed"]
+    assert armed and armed[0]["timeout_s"] == 700
+    assert signal.alarm(0) == 0  # disarmed on the way out
+
+
+def test_watchdog_explicit_zero_beats_env(monkeypatch, capsys):
+    monkeypatch.setenv("ICIKIT_WATCHDOG_S", "700")
+    recs = _run(capsys, "--watchdog", "0")
+    assert not any(r.get("event") == "watchdog_armed" for r in recs)
 
 
 def test_sample_skipped_when_no_room(capsys):

@@ -4,7 +4,8 @@
 
 PY ?= python
 
-.PHONY: test test-fast chaos bench native clean sweep scaling northstar
+.PHONY: test test-fast chaos bench native clean sweep scaling northstar \
+	trace-demo check
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -14,6 +15,33 @@ test-fast:
 
 chaos:
 	$(PY) -m pytest tests/ -q -m chaos
+
+# end-to-end observability self-check: tiny train + healed solve +
+# collective sweep under an armed obs session (validates the Chrome
+# trace, the metrics keys, and the disabled-path overhead), then the
+# same tiny train env-armed via ICIKIT_OBS with the exported trace
+# checked by the structural validator
+trace-demo:
+	JAX_PLATFORMS=cpu $(PY) -m icikit.obs.demo \
+		--trace /tmp/icikit_trace.json --metrics /tmp/icikit_obs_metrics.json
+	JAX_PLATFORMS=cpu \
+	ICIKIT_OBS="trace=/tmp/icikit_trace_env.json;metrics=/tmp/icikit_obs_metrics_env.json;jsonl=stderr" \
+	$(PY) -m icikit.models.transformer.train --steps 4 --batch 4 \
+		--vocab 32 --d-model 32 --n-heads 2 --d-head 8 --d-ff 64 \
+		--n-layers 1 --seq 16 --compute-dtype float32 --log-every 2 \
+		--sample-tokens 0 > /dev/null
+	$(PY) -m icikit.obs.check /tmp/icikit_trace_env.json
+
+# lint: telemetry goes through the icikit.obs event bus, not bare
+# prints — a new `print(json.dumps(...)` outside icikit/obs/ fails CI
+check:
+	@bad=$$(grep -rn "print(json\.dumps" icikit --include='*.py' \
+		| grep -v '^icikit/obs/'); \
+	if [ -n "$$bad" ]; then \
+		echo "bare print(json.dumps telemetry — route it through icikit.obs:"; \
+		echo "$$bad"; exit 1; \
+	fi
+	@echo "check OK: no bare print(json.dumps telemetry outside icikit/obs/"
 
 bench:
 	$(PY) bench.py

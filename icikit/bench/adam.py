@@ -27,6 +27,8 @@ from __future__ import annotations
 import argparse
 import json
 
+from icikit import obs
+
 
 def run_bench(params_m: float = 211.0, runs: int = 4,
               grad_dtype: str = "bfloat16") -> list[dict]:
@@ -95,8 +97,7 @@ def main(argv=None) -> int:
     ap.add_argument("--json", dest="json_path", default=None)
     args = ap.parse_args(argv)
     recs = run_bench(args.params_m, args.runs, args.grad_dtype)
-    for rec in recs:
-        print(json.dumps(rec))
+    obs.emit_records(recs)
     if args.json_path:
         # append: record files accumulate across invocations
         with open(args.json_path, "a") as f:

@@ -23,6 +23,8 @@ from __future__ import annotations
 import argparse
 import json
 
+from icikit import obs
+
 
 # Loop-invariant bytes XLA's memory-space-assignment pass keeps
 # VMEM-resident across decode steps on this chip, calibrated once from
@@ -303,8 +305,7 @@ def main(argv=None) -> int:
         recs = [run_bench(args.preset, args.dp, args.tp, args.batch,
                           args.prompt, args.n_new, args.sampling,
                           args.runs, args.kv_heads)]
-    for rec in recs:
-        print(json.dumps(rec))
+    obs.emit_records(recs)
     if args.json_path:
         # append: record files accumulate across invocations (the
         # studies' best-of protocol depends on it; "w" here once

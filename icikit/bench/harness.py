@@ -20,6 +20,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from icikit import obs
 from icikit.parallel.allgather import all_gather_blocks
 from icikit.parallel.allreduce import all_reduce
 from icikit.parallel.alltoall import all_to_all_blocks
@@ -49,6 +50,10 @@ class BenchRecord:
     best_s: float
     busbw_gbps: float     # effective per-device bus bandwidth
     verified: bool
+    # id of this measurement's span in the obs trace (empty when
+    # tracing was off): a BENCH_*.json row found wanting can be looked
+    # up in the matching trace.json by args.trace_id
+    trace_id: str = ""
 
     def to_json(self) -> str:
         return json.dumps(dataclasses.asdict(self))
@@ -159,20 +164,33 @@ def sweep_collective(mesh, family: str, algorithm: str,
     for msize in sizes:
         run, verify = _setup(family, mesh, axis, msize, np.dtype(dtype))
         verified = bool(verify(jax.block_until_ready(run(algorithm))))
+        block_bytes = msize * np.dtype(dtype).itemsize
+        bus_bytes = _bus_bytes(family, p, block_bytes)
         # Named host annotation around the whole timing loop so profiler
         # traces attribute device work per collective/size (SURVEY.md
         # §5.1) — outside the timed region, so timings stay comparable
-        # whether or not a profiler session is active.
+        # whether or not a profiler session is active. The obs span
+        # mirrors it on the host timeline and its trace_id is stamped
+        # into the record so BENCH_*.json rows correlate with traces.
         with jax.profiler.TraceAnnotation(
-                f"{family}/{algorithm}/p{p}/m{msize}"):
-            res = timeit(run, algorithm, runs=runs, warmup=warmup)
-        block_bytes = msize * np.dtype(dtype).itemsize
+                f"{family}/{algorithm}/p{p}/m{msize}"), \
+             obs.span("bench.collective", family=family,
+                      algorithm=algorithm, p=p, msize=msize,
+                      bytes_per_block=block_bytes,
+                      bus_bytes=bus_bytes) as sp:
+            res = timeit(run, algorithm, runs=runs, warmup=warmup,
+                         emit=lambda s: obs.observe(
+                             "collective.run_ms", s * 1e3))
+        # achieved traffic: per-device bus bytes x timed executions
+        obs.count("collective.bytes", int(bus_bytes * res.runs))
+        busbw = bus_bytes / res.best_s / 1e9
+        obs.observe("collective.busbw_gbps", busbw)
         records.append(BenchRecord(
             family=family, algorithm=algorithm, p=p, msize=msize,
             dtype=np.dtype(dtype).name, bytes_per_block=block_bytes,
             runs=runs, mean_s=res.mean_s, best_s=res.best_s,
-            busbw_gbps=_bus_bytes(family, p, block_bytes) / res.best_s / 1e9,
-            verified=verified))
+            busbw_gbps=busbw, verified=verified,
+            trace_id="" if sp.trace_id is None else str(sp.trace_id)))
     return records
 
 

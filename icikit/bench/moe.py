@@ -34,6 +34,8 @@ import argparse
 import json
 import sys
 
+from icikit import obs
+
 
 def _route(n_tokens: int, d_model: int, n_experts: int,
            skew: float, seed: int):
@@ -253,8 +255,7 @@ def main(argv=None) -> int:
                   "host-thread mesh", file=sys.stderr)
             return 1
         disp_records = dispatch_bench(p=args.devices, runs=args.runs)
-    for r in cap_records + disp_records:
-        print(json.dumps(r))
+    obs.emit_records(cap_records + disp_records)
     if args.json_path:
         # append: record files accumulate across invocations
         with open(args.json_path, "a") as f:

@@ -388,6 +388,7 @@ def main(argv=None) -> int:
         # append: record files accumulate across invocations (the
         # studies' best-of protocol depends on it; "w" here once
         # destroyed committed records)
+        from icikit import obs
         with open(args.json_path, "a") as f:
             for r in coll:
                 f.write(json.dumps(
@@ -399,6 +400,13 @@ def main(argv=None) -> int:
                 f.write(json.dumps({"kind": "dlb", **d}) + "\n")
             f.write(json.dumps({"kind": "checks", **checks,
                                 **meta}) + "\n")
+            # with ICIKIT_OBS armed, the run's metrics travel with its
+            # records: step latency percentiles, reissue counts, bytes
+            # moved — the provenance a bare wall_s column lacks
+            snap = obs.metrics_snapshot()
+            if snap is not None:
+                f.write(json.dumps(obs.json_safe(
+                    {"kind": "obs_metrics", **meta, **snap})) + "\n")
     for name, ok in checks.items():
         print(f"{'PASS' if ok else 'FAIL'} {name}")
     return 0 if all(checks.values()) else 1

@@ -34,6 +34,8 @@ import argparse
 import json
 import sys
 
+from icikit import obs
+
 
 def analytic_pp_counts(cfg, p: int, m: int, b: int = 2,
                        s: int = 16) -> dict:
@@ -413,8 +415,7 @@ def main(argv=None) -> int:
             measured = bubble_sweep(args.pp, ms, runs=args.runs,
                                     b_micro=args.bmicro, s=args.seq,
                                     d_model=args.dmodel)
-    for r in analytic + measured:
-        print(json.dumps(r))
+    obs.emit_records(analytic + measured)
     if args.json_path:
         # append: record files accumulate across invocations
         with open(args.json_path, "a") as f:

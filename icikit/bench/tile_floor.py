@@ -31,6 +31,8 @@ import json
 import sys
 from functools import partial
 
+from icikit import obs
+
 
 def _mxu_kernel(q_ref, k_ref, v_ref, o_ref, acc, *, scale, nk):
     """Both dots + minimal glue, no softmax statistics."""
@@ -263,8 +265,7 @@ def main(argv=None) -> int:
     ap.add_argument("--json", dest="json_path", default=None)
     args = ap.parse_args(argv)
     records = measure(args.seq, d=args.dhead, windows=args.windows)
-    for r in records:
-        print(json.dumps(r))
+    obs.emit_records(records)
     print(render(records), file=sys.stderr)
     if args.json_path:
         # append: record files accumulate across invocations

@@ -15,11 +15,12 @@ CLI: ``python -m icikit.bench.train [--preset small|base] [--dp N ...]``
 from __future__ import annotations
 
 import argparse
-import json
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from icikit import obs
 
 PEAK_FLOPS = {
     # bf16 dense peak per chip, published spec sheets.
@@ -331,7 +332,7 @@ def main(argv=None) -> int:
                     calibrate_peak=args.calibrate_peak,
                     optimizer=args.optimizer, windows=args.windows,
                     softmax_shift=args.softmax_shift, head=args.head)
-    print(json.dumps(rec))
+    obs.emit_records([rec])
     return 0
 
 

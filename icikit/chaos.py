@@ -43,6 +43,9 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
+from icikit.obs import bus as _bus
+from icikit.obs import tracer as _tracer
+
 KINDS = ("delay", "die", "corrupt", "io")
 
 
@@ -129,6 +132,16 @@ class FaultPlan:
         if fired:
             with self._lock:
                 self.log.append((kind, site, n))
+        # auditable drills: every probe decision is an event, so soak
+        # tests assert exactly which sites fired instead of counting
+        # side effects (no sink installed -> emit returns immediately)
+        if _bus.enabled():
+            _bus.emit("chaos.fired" if fired else "chaos.skipped",
+                      kind=kind, site=site, call=n, seed=self.seed)
+        if fired:
+            # tick mark on the span timeline: a trace shows *where* in
+            # a pull/step the fault landed
+            _tracer.instant("chaos.fired", kind=kind, site=site, call=n)
         return fired, n
 
     def fired(self, kind: str, site_glob: str = "*") -> int:

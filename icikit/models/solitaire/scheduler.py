@@ -42,7 +42,7 @@ import numpy as np
 
 import jax
 
-from icikit import chaos
+from icikit import chaos, obs
 from icikit.models.solitaire.game import (
     MAX_DEPTH,
     BoardBatch,
@@ -258,12 +258,13 @@ def solve_static(batch: BoardBatch, devices=None,
 
     outs = []
     t0 = time.perf_counter()
-    for d in range(p):
-        sl = slice(d * per, (d + 1) * per)
-        pg = jax.device_put(padded.pegs[sl], devices[d])
-        pl = jax.device_put(padded.playable[sl], devices[d])
-        outs.append(solve_batch(pg, pl, max_steps))
-    outs = jax.block_until_ready(outs)
+    with obs.span("solve.static", n=n, p=p, per=per):
+        for d in range(p):
+            sl = slice(d * per, (d + 1) * per)
+            pg = jax.device_put(padded.pegs[sl], devices[d])
+            pl = jax.device_put(padded.playable[sl], devices[d])
+            outs.append(solve_batch(pg, pl, max_steps))
+        outs = jax.block_until_ready(outs)
     wall = time.perf_counter() - t0
 
     parts = [tuple(np.asarray(o) for o in out) for out in outs]
@@ -321,24 +322,29 @@ class _LeaseQueue:
         queue is empty but chunks are still leased out — those may come
         back (death, expired lease) and someone must be left to take
         them."""
-        with self._cv:
-            while True:
+        while True:
+            expired, out = (), None
+            with self._cv:
                 if len(self._done) == self.n_total:
-                    return []
-                self._reap_expired()
-                if self._todo:
-                    remaining = len(self._todo)
-                    k = max(1, min(remaining // (2 * p), max_pull))
-                    k = min(k, remaining)
-                    out = [self._todo.popleft() for _ in range(k)]
-                    deadline = time.monotonic() + self.lease_s
-                    for c in out:
-                        self._leases[c] = (worker, deadline)
-                    self.pulls += 1
-                    return out
-                if not self._leases:
-                    return []  # drained (terminate tag, main.cc:93-97)
-                self._cv.wait(min(0.05, self.lease_s / 4))
+                    out = []
+                else:
+                    expired = self._reap_expired()
+                    if self._todo:
+                        remaining = len(self._todo)
+                        k = max(1, min(remaining // (2 * p), max_pull))
+                        k = min(k, remaining)
+                        out = [self._todo.popleft() for _ in range(k)]
+                        deadline = time.monotonic() + self.lease_s
+                        for c in out:
+                            self._leases[c] = (worker, deadline)
+                        self.pulls += 1
+                    elif not self._leases:
+                        out = []  # drained (terminate tag, main.cc:93-97)
+                    else:
+                        self._cv.wait(min(0.05, self.lease_s / 4))
+            self._emit_expired(expired)
+            if out is not None:
+                return out
 
     def commit(self, worker: int, chunk: int, games: int,
                steps: int) -> bool:
@@ -346,21 +352,24 @@ class _LeaseQueue:
         (duplicates from reissued work change nothing)."""
         with self._cv:
             self._leases.pop(chunk, None)
-            if chunk in self._done:
-                return False
-            # a straggler may commit after its expired lease already
-            # bounced the chunk back to the queue — pull it out so no
-            # survivor re-solves finished work (todo/leased/done stay
-            # mutually exclusive)
-            try:
-                self._todo.remove(chunk)
-            except ValueError:
-                pass
-            self._done.add(chunk)
-            self.per_games[worker] += games
-            self.per_steps[worker] += steps
-            self._cv.notify_all()
-            return True
+            dup = chunk in self._done
+            if not dup:
+                # a straggler may commit after its expired lease
+                # already bounced the chunk back to the queue — pull it
+                # out so no survivor re-solves finished work
+                # (todo/leased/done stay mutually exclusive)
+                try:
+                    self._todo.remove(chunk)
+                except ValueError:
+                    pass
+                self._done.add(chunk)
+                self.per_games[worker] += games
+                self.per_steps[worker] += steps
+                self._cv.notify_all()
+        if dup:
+            obs.emit("scheduler.duplicate_commit", worker=worker,
+                     chunk=chunk)
+        return not dup
 
     def mark_dead(self, worker: int, exc: BaseException) -> None:
         """Record a worker death and hand its leased chunks back."""
@@ -372,23 +381,43 @@ class _LeaseQueue:
                 self._todo.appendleft(c)
             self.reissues += len(freed)
             self._cv.notify_all()
+        # bus + metrics outside the lock: a slow sink must never stall
+        # the queue (or deadlock a sink that itself reads queue state)
+        obs.emit("scheduler.worker_death", worker=worker,
+                 error=repr(exc), reissued_chunks=freed)
+        obs.count("scheduler.deaths")
+        obs.count("scheduler.reissues", len(freed))
+        obs.instant("scheduler.worker_death", worker=worker)
 
-    def _reap_expired(self) -> None:
-        # caller holds the lock
+    def _reap_expired(self) -> list:
+        # caller holds the lock; returns the reaped chunks so the
+        # caller can _emit_expired them AFTER releasing it
         now = time.monotonic()
         expired = [c for c, (_, dl) in self._leases.items() if dl <= now]
         for c in expired:
             del self._leases[c]
             self._todo.appendleft(c)
         self.reissues += len(expired)
+        return expired
+
+    def _emit_expired(self, expired) -> None:
+        # bus + metrics outside the lock (the mark_dead discipline):
+        # a slow sink must never stall the queue
+        if expired:
+            obs.emit("scheduler.lease_expired", chunks=list(expired))
+            obs.count("scheduler.lease_expired", len(expired))
+            obs.count("scheduler.reissues", len(expired))
 
     # -- monitor side ------------------------------------------------
 
     def wait_drained(self) -> None:
         """Block until every chunk is committed; raise NoSurvivorsError
         the moment the last worker dies with work outstanding."""
-        with self._cv:
-            while len(self._done) < self.n_total:
+        while True:
+            expired = ()
+            with self._cv:
+                if len(self._done) >= self.n_total:
+                    return
                 if len(self.deaths) >= self.n_workers:
                     deaths = {w: e for w, e in sorted(self.deaths.items())}
                     msg = ("solve_dynamic: all "
@@ -400,8 +429,9 @@ class _LeaseQueue:
                                        for w, e in deaths.items()))
                     raise NoSurvivorsError(msg, deaths) \
                         from next(iter(deaths.values()))
-                self._reap_expired()
+                expired = self._reap_expired()
                 self._cv.wait(0.05)
+            self._emit_expired(expired)
 
 
 def solve_dynamic(batch: BoardBatch, devices=None,
@@ -466,54 +496,88 @@ def solve_dynamic(batch: BoardBatch, devices=None,
         dev = devices[w]
         site = f"solitaire.worker.{w}"
         try:
-            while True:
-                chunks = queue.claim(w, p, max_pull)
-                # crash drill: probed on every pull, including the
-                # terminal empty one, so a scheduled first-pull death
-                # fires deterministically even when a fast peer drained
-                # the queue before this thread got a chunk
-                chaos.maybe_die(site)
-                if not chunks:
-                    return
-                chaos.maybe_delay(site)  # straggler / hang drill
-                outs = []
-                for i in chunks:  # async dispatches, one barrier/pull
-                    sl = slice(i * chunk_size, (i + 1) * chunk_size)
-                    pg = jax.device_put(padded.pegs[sl], dev)
-                    pl = jax.device_put(padded.playable[sl], dev)
-                    outs.append((i, solve_batch(pg, pl, max_steps)))
-                jax.block_until_ready([o for _, o in outs])
-                for i, out in outs:
-                    arrays = tuple(np.asarray(o) for o in out)
-                    results[i] = arrays
-                    # durable record first, then retire the lease: an
-                    # I/O death here leaves the chunk leased, so it
-                    # reissues like any other crash
-                    if ckpt is not None:
-                        ckpt.add(i, arrays)
-                    real = min(chunk_size, max(0, n - i * chunk_size))
-                    queue.commit(w, i, real, int(arrays[3][:real].sum()))
+            # worker-lifetime span on this thread's timeline: the gaps
+            # between its pull spans ARE the straggler/imbalance story
+            # the DLB study exists to show
+            with obs.span("solve.worker", worker=w):
+                while True:
+                    chunks = queue.claim(w, p, max_pull)
+                    # crash drill: probed on every pull, including the
+                    # terminal empty one, so a scheduled first-pull
+                    # death fires deterministically even when a fast
+                    # peer drained the queue before this thread got a
+                    # chunk
+                    chaos.maybe_die(site)
+                    if not chunks:
+                        return
+                    chaos.maybe_delay(site)  # straggler / hang drill
+                    with obs.span("solve.pull", worker=w,
+                                  n_chunks=len(chunks)):
+                        outs = []
+                        # async dispatches, one barrier per pull
+                        for i in chunks:
+                            sl = slice(i * chunk_size,
+                                       (i + 1) * chunk_size)
+                            with obs.span("solve.chunk", chunk=i,
+                                          worker=w):
+                                pg = jax.device_put(padded.pegs[sl], dev)
+                                pl = jax.device_put(padded.playable[sl],
+                                                    dev)
+                                outs.append((i, solve_batch(pg, pl,
+                                                            max_steps)))
+                        jax.block_until_ready([o for _, o in outs])
+                        for i, out in outs:
+                            arrays = tuple(np.asarray(o) for o in out)
+                            results[i] = arrays
+                            # durable record first, then retire the
+                            # lease: an I/O death here leaves the chunk
+                            # leased, so it reissues like any other
+                            # crash
+                            if ckpt is not None:
+                                ckpt.add(i, arrays)
+                            real = min(chunk_size,
+                                       max(0, n - i * chunk_size))
+                            queue.commit(w, i, real,
+                                         int(arrays[3][:real].sum()))
+                            obs.count("scheduler.commits")
         except BaseException as e:  # a dead worker, not a dead farm
             queue.mark_dead(w, e)
 
     t0 = time.perf_counter()
     if pending:
-        threads = [threading.Thread(target=worker, args=(w,), daemon=True)
-                   for w in range(p)]
-        for t in threads:
-            t.start()
-        queue.wait_drained()
-        # survivors exit on their own (claim returns empty once done);
-        # hung stragglers are daemons whose late commits are idempotent,
-        # so completed work is never held hostage to their join
-        for t in threads:
-            t.join(timeout=1.0)
+        with obs.span("solve.dynamic", n_chunks=n_chunks, p=p,
+                      chunk_size=chunk_size, pending=len(pending)):
+            threads = [threading.Thread(target=worker, args=(w,),
+                                        daemon=True)
+                       for w in range(p)]
+            for t in threads:
+                t.start()
+            queue.wait_drained()
+            # survivors exit on their own (claim returns empty once
+            # done); hung stragglers are daemons whose late commits are
+            # idempotent, so completed work is never held hostage to
+            # their join
+            for t in threads:
+                t.join(timeout=1.0)
     if ckpt is not None:
         # an abandoned straggler waking after this return must not
         # append a record computed from THIS dataset to a file the
         # caller may have rewritten for different work
         ckpt.close()
     wall = time.perf_counter() - t0
+
+    # register the healing counters even on a clean run ("0 reissues"
+    # is telemetry; a missing key is a blind spot) and publish the
+    # run's scheduling summary on the bus
+    obs.count("scheduler.reissues", 0)
+    obs.count("scheduler.deaths", 0)
+    obs.count("scheduler.lease_expired", 0)
+    obs.count("scheduler.pulls", queue.pulls)
+    if obs.enabled():
+        obs.emit("scheduler.drained", strategy="dynamic",
+                 n_chunks=n_chunks, pulls=queue.pulls,
+                 deaths=len(queue.deaths), reissues=queue.reissues,
+                 wall_s=round(wall, 4))
 
     if queue.deaths:
         # the run healed, but the errors that killed workers must stay

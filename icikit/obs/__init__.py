@@ -1,0 +1,231 @@
+"""icikit.obs — unified tracing & metrics (spans, event bus, Chrome
+trace, metrics registry).
+
+The reference's whole observability story was ``MPI_Barrier`` +
+reset-on-read ``get_timer()`` + rank-0 printf; a production stack needs
+to explain *where time and bytes went* and make recovery drills
+auditable. This package is that layer, dependency-free and
+disabled-by-default:
+
+- **event bus** (:mod:`icikit.obs.bus`) — ``emit("anomaly", step=3)``
+  fans out to pluggable sinks (stderr/stdout JSONL, in-memory ring,
+  file). Replaces every bare ``print(json.dumps(...))``.
+- **spans** (:mod:`icikit.obs.tracer`) — ``with span("solve.chunk",
+  chunk=i):`` nested, thread-aware regions exported as a
+  Perfetto-loadable ``trace.json`` (:mod:`icikit.obs.chrome`), and
+  optionally mirrored onto the device timeline via
+  ``jax.profiler.TraceAnnotation``.
+- **metrics** (:mod:`icikit.obs.metrics`) — counters / gauges /
+  histograms (``collective.bytes``, ``scheduler.reissues``,
+  ``train.step_ms`` p50/p99), snapshotted into bench reports.
+
+Zero-overhead contract: with nothing armed, every probe
+(``emit``/``span``/``count``/``observe``) is one module-global read
+plus a ``None``/truthiness check — no allocation, no formatting
+(``span()`` returns a shared singleton). ``bench_overhead()`` measures
+it; docs/DESIGN.md quotes the numbers.
+
+Arming::
+
+    ICIKIT_OBS=1 python -m icikit.models.transformer.train ...
+        # -> JSONL events on stderr; trace.json + obs_metrics.json
+        #    written at exit
+
+    ICIKIT_OBS="trace=/tmp/t.json;metrics=/tmp/m.json;jsonl=off"
+        # ;-separated spec: trace=PATH|off, metrics=PATH|off,
+        #    jsonl=stderr|stdout|PATH|off, mirror=1 (device-timeline
+        #    mirroring via jax.profiler.TraceAnnotation)
+
+or programmatically: ``obs.start_tracing()``, ``obs.enable_metrics()``,
+``obs.add_sink(obs.RingSink())`` — see ``session()`` for the one-call
+scoped form tests use.
+"""
+
+from __future__ import annotations
+
+import atexit
+import os
+import time
+
+from icikit.obs import chrome
+from icikit.obs import metrics as _metrics_mod
+from icikit.obs import tracer as _tracer_mod
+from icikit.obs.bus import (  # noqa: F401
+    FileSink,
+    JsonlSink,
+    RingSink,
+    Sink,
+    add_sink,
+    dumps_strict,
+    emit,
+    enabled,
+    installed,
+    json_safe,
+    remove_sink,
+)
+from icikit.obs.chrome import export as export_trace  # noqa: F401
+from icikit.obs.chrome import validate as validate_trace  # noqa: F401
+from icikit.obs.metrics import (  # noqa: F401
+    Registry,
+    count,
+    disable_metrics,
+    enable_metrics,
+    gauge,
+    metrics,
+    observe,
+)
+from icikit.obs.metrics import snapshot as metrics_snapshot  # noqa: F401
+from icikit.obs.tracer import (  # noqa: F401
+    NOOP_SPAN,
+    TraceBuffer,
+    instant,
+    span,
+    start_tracing,
+    stop_tracing,
+    traced,
+    tracing,
+)
+
+
+def emit_records(records) -> None:
+    """Route a CLI's result records through the bus under a scoped
+    stdout sink: one strict-JSON line per record on stdout (the
+    historical ``print(json.dumps(rec))`` bytes, for finite payloads),
+    with the same records delivered to whatever sinks ``ICIKIT_OBS``
+    armed. The one record-output path every bench CLI shares."""
+    with installed(JsonlSink("stdout")):
+        for rec in records:
+            emit(None, **rec)
+
+
+class session:
+    """Scoped all-in-one arming (the test/demo form)::
+
+        with obs.session(ring := obs.RingSink()) as s:
+            ...
+        s.trace.snapshot(); ring.events; s.registry.snapshot()
+
+    Installs the given sinks, arms tracing and metrics, and restores
+    the previous state (including a previously armed env session) on
+    exit. ``s.trace`` is the :class:`TraceBuffer`, ``s.registry`` the
+    metrics :class:`Registry`.
+    """
+
+    def __init__(self, *sinks, trace: bool = True, metrics: bool = True,
+                 mirror_device: bool = False):
+        self._sinks = sinks
+        self._want_trace = trace
+        self._want_metrics = metrics
+        self._mirror = mirror_device
+        self.trace = None
+        self.registry = None
+
+    def __enter__(self):
+        for s in self._sinks:
+            add_sink(s)
+        self._prev_trace = _tracer_mod._swap(
+            TraceBuffer(mirror_device=self._mirror)
+            if self._want_trace else None)
+        self.trace = tracing()
+        self._prev_metrics = _metrics_mod._swap(
+            Registry() if self._want_metrics else None)
+        self.registry = metrics()
+        return self
+
+    def __exit__(self, *exc):
+        for s in self._sinks:
+            remove_sink(s)
+        _tracer_mod._swap(self._prev_trace)
+        _metrics_mod._swap(self._prev_metrics)
+        return False
+
+
+def bench_overhead(n: int = 200_000) -> dict:
+    """Measure the disabled fast path against an empty loop: ns/call
+    for ``span()`` entry+exit and ``emit()`` with no sink. The numbers
+    back the zero-overhead claim (docs/DESIGN.md quotes a run)."""
+    from icikit.obs import tracer as _t
+    if _t._TRACE is not None or enabled():
+        raise RuntimeError("bench_overhead needs obs fully disabled")
+    r = range(n)
+    t0 = time.perf_counter()
+    for _ in r:
+        pass
+    empty_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for _ in r:
+        with span("x"):
+            pass
+    span_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for _ in r:
+        emit("x", a=1)
+    emit_s = time.perf_counter() - t0
+    return {
+        "n": n,
+        "empty_loop_ns": empty_s / n * 1e9,
+        "span_disabled_ns": span_s / n * 1e9,
+        "emit_no_sink_ns": emit_s / n * 1e9,
+    }
+
+
+# -- env arming (ICIKIT_OBS) ----------------------------------------
+
+def parse_spec(spec: str) -> dict:
+    """Parse an ``ICIKIT_OBS`` spec into option dict (see module
+    docstring). ``"1"``/``"true"``/``"on"`` selects every default."""
+    opts = {"jsonl": "stderr", "trace": "trace.json",
+            "metrics": "obs_metrics.json", "mirror": False}
+    if spec.strip().lower() in ("1", "true", "on", "yes"):
+        return opts
+    for entry in spec.split(";"):
+        entry = entry.strip()
+        if not entry:
+            continue
+        key, sep, value = entry.partition("=")
+        key, value = key.strip(), value.strip()
+        if not sep or key not in opts:
+            raise ValueError(f"bad ICIKIT_OBS entry {entry!r} (known: "
+                             f"{', '.join(sorted(opts))})")
+        if key == "mirror":
+            opts["mirror"] = value.lower() in ("1", "true", "on", "yes")
+        else:
+            opts[key] = value
+    return opts
+
+
+def _arm_from_env(spec: str) -> None:
+    opts = parse_spec(spec)
+    flush_paths = {}
+    if opts["jsonl"] != "off":
+        if opts["jsonl"] in ("stderr", "stdout"):
+            add_sink(JsonlSink(opts["jsonl"]))
+        else:
+            add_sink(FileSink(opts["jsonl"]))
+    if opts["trace"] != "off":
+        start_tracing(mirror_device=opts["mirror"])
+        flush_paths["trace"] = opts["trace"]
+    if opts["metrics"] != "off":
+        enable_metrics()
+        flush_paths["metrics"] = opts["metrics"]
+    if flush_paths:
+        atexit.register(_flush_env_session, flush_paths)
+
+
+def _flush_env_session(paths: dict) -> None:
+    """atexit hook for env-armed sessions: write the trace and the
+    metrics snapshot where the spec asked."""
+    import json as _json
+    tb = stop_tracing()
+    if "trace" in paths and tb is not None:
+        chrome.export(paths["trace"], tb.snapshot())
+    reg = disable_metrics()
+    if "metrics" in paths and reg is not None:
+        with open(paths["metrics"], "w") as f:
+            _json.dump(json_safe(reg.snapshot()), f, indent=1)
+
+
+_env_spec = os.environ.get("ICIKIT_OBS")
+if _env_spec and _env_spec.strip().lower() not in ("", "0", "off",
+                                                   "false"):
+    _arm_from_env(_env_spec)

@@ -1,0 +1,19 @@
+"""CLI entry for the Chrome-trace structural validator.
+
+``python -m icikit.obs.chrome`` works but trips runpy's
+found-in-sys.modules RuntimeWarning (the package ``__init__`` imports
+:mod:`icikit.obs.chrome` before runpy re-executes it as ``__main__``);
+this module is NOT imported by the package, so the blessed CLI stays
+warning-free::
+
+    python -m icikit.obs.check trace.json    # exit 0 iff valid
+"""
+
+from __future__ import annotations
+
+import sys
+
+from icikit.obs.chrome import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,181 @@
+"""Chrome-trace (Perfetto) export and validation.
+
+The export format is the Trace Event Format's "JSON Object Format":
+``{"traceEvents": [...], "displayTimeUnit": "ms"}`` with ``B``/``E``
+duration events, ``i`` instants, and ``M`` metadata — loadable directly
+in ``chrome://tracing`` or https://ui.perfetto.dev ("Open trace file").
+
+:func:`validate` is the structural checker the tests and ``make
+trace-demo`` run against every export: valid JSON, every ``B`` matched
+by an ``E`` on the same thread (well-nested, LIFO), timestamps
+monotonic per thread. It exists because a trace that silently violates
+nesting loads as garbage in Perfetto — the failure mode is "confusing
+picture", not an error message, so the checker has to be mechanical.
+
+CLI::
+
+    python -m icikit.obs.check trace.json    # exit 0 iff valid
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+
+def to_chrome(events: list) -> dict:
+    """Wrap raw trace events in the Chrome JSON-object envelope."""
+    return {"traceEvents": list(events), "displayTimeUnit": "ms"}
+
+
+def close_dangling(events: list) -> list:
+    """Synthetic ``E`` events for every ``B`` no thread ever closed —
+    in LIFO order per thread, stamped ``closed_by: "export"``.
+
+    A worker the scheduler abandoned mid-span (a hung straggler whose
+    join timed out — a scenario the farm is *designed* to survive) is
+    still inside its region at export time; without these closes the
+    export of a healthy healed run fails the structural validator.
+    Timestamps reuse the thread's last seen ``ts`` so per-thread
+    monotonicity holds.
+    """
+    stacks: dict[tuple, list] = {}
+    last_ts: dict[tuple, float] = {}
+    for ev in events:
+        if not isinstance(ev, dict):
+            continue
+        key = (ev.get("pid"), ev.get("tid"))
+        ts = ev.get("ts")
+        if isinstance(ts, (int, float)) and not isinstance(ts, bool):
+            last_ts[key] = ts
+        if ev.get("ph") == "B":
+            stacks.setdefault(key, []).append(ev.get("name"))
+        elif ev.get("ph") == "E":
+            if stacks.get(key):
+                stacks[key].pop()
+    closes = []
+    for key, stack in sorted(stacks.items(), key=repr):
+        for name in reversed(stack):
+            closes.append({
+                "ph": "E", "name": name, "pid": key[0], "tid": key[1],
+                "ts": last_ts.get(key, 0),
+                "args": {"closed_by": "export"}})
+    return closes
+
+
+def export(path, events: list) -> dict:
+    """Write ``events`` to ``path`` as a Chrome-trace JSON file
+    (dangling spans closed — see :func:`close_dangling`); returns the
+    written object."""
+    events = list(events)
+    obj = to_chrome(events + close_dangling(events))
+    with open(path, "w") as f:
+        json.dump(obj, f)
+    return obj
+
+
+def validate(trace) -> list[str]:
+    """Structural problems in a Chrome trace; empty list == valid.
+
+    ``trace`` is the loaded object (dict envelope or bare event list),
+    a JSON string, or a path. Checks:
+
+    - parses as JSON into the envelope/array format;
+    - every event is a dict with a ``ph``;
+    - ``B``/``E`` pairs balance per (pid, tid) and match LIFO (an ``E``
+      naming a different span than the innermost open ``B`` is a
+      nesting violation);
+    - ``ts`` is numeric and monotonic (non-decreasing) per (pid, tid)
+      across timestamped events;
+    - ``X`` complete events carry a non-negative ``dur``.
+    """
+    problems: list[str] = []
+    if isinstance(trace, str):
+        if trace.lstrip()[:1] in ("{", "["):
+            try:
+                trace = json.loads(trace)
+            except json.JSONDecodeError as e:
+                return [f"not valid JSON: {e}"]
+        else:
+            try:
+                with open(trace) as f:
+                    trace = json.load(f)
+            except (OSError, json.JSONDecodeError) as e:
+                return [f"cannot load trace: {e}"]
+    if isinstance(trace, dict):
+        events = trace.get("traceEvents")
+        if not isinstance(events, list):
+            return ["envelope has no 'traceEvents' list"]
+    elif isinstance(trace, list):
+        events = trace
+    else:
+        return [f"trace must be a dict or list, got {type(trace).__name__}"]
+
+    stacks: dict[tuple, list] = {}    # (pid, tid) -> open B names
+    last_ts: dict[tuple, float] = {}  # (pid, tid) -> last seen ts
+    for i, ev in enumerate(events):
+        if not isinstance(ev, dict):
+            problems.append(f"event {i}: not an object")
+            continue
+        ph = ev.get("ph")
+        if not isinstance(ph, str):
+            problems.append(f"event {i}: missing 'ph'")
+            continue
+        if ph == "M":
+            continue  # metadata carries no timestamp
+        key = (ev.get("pid"), ev.get("tid"))
+        ts = ev.get("ts")
+        if not isinstance(ts, (int, float)) or isinstance(ts, bool):
+            problems.append(f"event {i} ({ph}): non-numeric ts {ts!r}")
+            continue
+        if key in last_ts and ts < last_ts[key]:
+            problems.append(
+                f"event {i} ({ph} {ev.get('name')!r}): ts {ts} goes "
+                f"backwards on tid {key[1]} (prev {last_ts[key]})")
+        last_ts[key] = ts
+        if ph == "B":
+            stacks.setdefault(key, []).append(ev.get("name"))
+        elif ph == "E":
+            stack = stacks.get(key)
+            if not stack:
+                problems.append(
+                    f"event {i}: E {ev.get('name')!r} on tid {key[1]} "
+                    "with no open B")
+                continue
+            opened = stack.pop()
+            name = ev.get("name")
+            if name is not None and name != opened:
+                problems.append(
+                    f"event {i}: E {name!r} closes B {opened!r} on tid "
+                    f"{key[1]} (nesting violation)")
+        elif ph == "X":
+            dur = ev.get("dur")
+            if not isinstance(dur, (int, float)) or dur < 0:
+                problems.append(f"event {i}: X with bad dur {dur!r}")
+    for (pid, tid), stack in stacks.items():
+        if stack:
+            problems.append(
+                f"tid {tid}: {len(stack)} unclosed B event(s): "
+                + ", ".join(repr(n) for n in stack))
+    return problems
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if len(argv) != 1:
+        print("usage: python -m icikit.obs.check TRACE_JSON",
+              file=sys.stderr)
+        return 2
+    problems = validate(argv[0])
+    if problems:
+        for p in problems:
+            print(f"INVALID: {p}", file=sys.stderr)
+        return 1
+    with open(argv[0]) as f:
+        n = len(json.load(f).get("traceEvents", []))
+    print(f"OK: {argv[0]} is a valid Chrome trace ({n} events)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

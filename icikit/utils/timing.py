@@ -45,15 +45,25 @@ def fence(out):
 
 class Stopwatch:
     """Reset-on-read stopwatch (reference ``get_timer``,
-    ``Dynamic-Load-Balancing/src/utilities.cc:61-68``)."""
+    ``Dynamic-Load-Balancing/src/utilities.cc:61-68``).
 
-    def __init__(self):
+    ``emit``, when given, is called with each elapsed reading (seconds)
+    — the hook that lets a caller forward readings into the
+    ``icikit.obs`` metrics registry (e.g. ``emit=lambda s:
+    obs.observe("phase_ms", s * 1e3)``) without wrapping every read
+    site in a second timer.
+    """
+
+    def __init__(self, emit=None):
+        self._emit = emit
         self._last = time.perf_counter()
 
     def __call__(self) -> float:
         now = time.perf_counter()
         elapsed = now - self._last
         self._last = now
+        if self._emit is not None:
+            self._emit(elapsed)
         return elapsed
 
 
@@ -376,7 +386,7 @@ def timeit_windows(fn, args: tuple, chain, windows: int = 5,
 
 
 def timeit(fn, *args, runs: int = 10, warmup: int = 2,
-           sync: str = "auto") -> TimeitResult:
+           sync: str = "auto", emit=None) -> TimeitResult:
     """Time ``fn(*args)`` with device fencing.
 
     Mirrors the reference's ``test_runs`` repetition loop
@@ -385,6 +395,12 @@ def timeit(fn, *args, runs: int = 10, warmup: int = 2,
     ``jax.block_until_ready``; "transfer" uses the corner-scalar
     transfer fence; "auto" picks "block" on CPU (cheap and reliable
     there) and "transfer" elsewhere (see ``fence``).
+
+    ``emit``, when given, receives each measured per-run time (seconds,
+    fence-corrected) as it lands — bench harnesses point it at the
+    ``icikit.obs`` metrics registry so timings flow into snapshots
+    without a second instrumentation layer. Called outside the timed
+    region; it cannot perturb the measurement.
     """
     if sync == "auto":
         sync = "block" if jax.default_backend() == "cpu" else "transfer"
@@ -415,6 +431,8 @@ def timeit(fn, *args, runs: int = 10, warmup: int = 2,
         watch()
         wait(fn(*args))
         per_run.append(max(watch() - fence_s, 1e-9))
+        if emit is not None:
+            emit(per_run[-1])
     total = sum(per_run)
     return TimeitResult(mean_s=total / runs, total_s=total, runs=runs,
                         per_run_s=per_run)

@@ -1,0 +1,475 @@
+"""Unified tracing & metrics (`icikit.obs`): the event bus delivers to
+every sink and never to none, spans export as a valid Chrome trace
+(balanced B/E per thread, monotonic timestamps — the golden-file
+checks), the metrics registry snapshots JSON-safe, and the whole layer
+costs nothing when disabled."""
+
+import json
+import math
+import threading
+import tracemalloc
+
+import pytest
+
+from icikit import chaos, obs
+from icikit.obs import bus, tracer
+from icikit.utils.timing import Stopwatch, timeit
+
+
+@pytest.fixture(autouse=True)
+def _obs_fully_disabled():
+    """Every test starts and ends with no sinks, no tracer, no
+    registry — a leaked global here would silently tax the whole
+    suite."""
+    assert not bus.enabled(), "sink leaked into test"
+    assert tracer.tracing() is None, "tracer leaked into test"
+    assert obs.metrics() is None, "registry leaked into test"
+    yield
+    assert not bus.enabled(), "test leaked a sink"
+    assert tracer.tracing() is None, "test leaked a tracer"
+    assert obs.metrics() is None, "test leaked a registry"
+
+
+# -- event bus ------------------------------------------------------
+
+def test_emit_without_sink_is_noop():
+    obs.emit("anything", x=1)  # must not raise, must not format
+
+
+def test_ring_sink_captures_in_order():
+    ring = obs.RingSink()
+    with bus.installed(ring):
+        obs.emit("a", i=0)
+        obs.emit("b", i=1)
+        obs.emit("a", i=2)
+    obs.emit("late", i=3)  # after scope: not captured
+    assert [e["event"] for e in ring.events] == ["a", "b", "a"]
+    assert [e["i"] for e in ring.of_type("a")] == [0, 2]
+
+
+def test_event_none_omits_key():
+    ring = obs.RingSink()
+    with bus.installed(ring):
+        obs.emit(None, step=3, loss=1.5)
+    assert ring.events == [{"step": 3, "loss": 1.5}]
+
+
+def test_ring_sink_bounded():
+    ring = obs.RingSink(capacity=4)
+    with bus.installed(ring):
+        for i in range(10):
+            obs.emit("e", i=i)
+    assert [e["i"] for e in ring.events] == [6, 7, 8, 9]
+
+
+def test_broken_sink_does_not_stop_delivery():
+    class Broken(obs.Sink):
+        def write(self, ev):
+            raise RuntimeError("boom")
+
+    ring = obs.RingSink()
+    with bus.installed(Broken()), bus.installed(ring):
+        obs.emit("x")
+    assert len(ring.events) == 1
+
+
+def test_jsonl_sink_strict_json(capsys):
+    with bus.installed(obs.JsonlSink("stdout")):
+        obs.emit("loss", value=float("nan"), inf=float("inf"), ok=1.5)
+    lines = capsys.readouterr().out.strip().splitlines()
+    assert len(lines) == 1
+    # strict parser: bare NaN/Infinity would raise here
+    ev = json.loads(lines[0], parse_constant=lambda c: pytest.fail(
+        f"non-strict JSON constant {c} on the wire"))
+    assert ev["value"] == "nan" and ev["inf"] == "inf" and ev["ok"] == 1.5
+
+
+def test_jsonl_sink_matches_print_json_dumps(capsys):
+    """The migrated telemetry must be byte-identical to the historical
+    `print(json.dumps(rec))` lines for finite payloads."""
+    rec = {"step": 7, "loss": 2.25, "tokens_per_s": 1234.5}
+    with bus.installed(obs.JsonlSink("stdout")):
+        obs.emit(None, **rec)
+    assert capsys.readouterr().out == json.dumps(rec) + "\n"
+
+
+def test_file_sink_appends_jsonl(tmp_path):
+    path = tmp_path / "events.jsonl"
+    sink = obs.FileSink(str(path))
+    with bus.installed(sink):
+        obs.emit("a", i=0)
+        obs.emit("b", i=1)
+    sink.close()
+    sink.write({"event": "late"})  # post-close: dropped, no crash
+    evs = [json.loads(l) for l in path.read_text().splitlines()]
+    assert [e["event"] for e in evs] == ["a", "b"]
+
+
+def test_jsonl_sink_filter_drops_only_for_that_sink(capsys):
+    """The trainer's record-sink discipline: a filtered sink drops the
+    event, other sinks still receive it."""
+    ring = obs.RingSink()
+    record = obs.JsonlSink("stdout", filter=lambda ev: not str(
+        ev.get("event", "")).startswith("chaos."))
+    with bus.installed(record), bus.installed(ring):
+        obs.emit("chaos.skipped", site="w.0")
+        obs.emit(None, step=1, loss=2.0)
+    out = capsys.readouterr().out
+    assert "chaos.skipped" not in out and '"step": 1' in out
+    assert [e.get("event") for e in ring.events] == ["chaos.skipped",
+                                                     None]
+
+
+def test_emit_records_stdout_contract(capsys):
+    """The shared CLI record path: historical print(json.dumps) bytes
+    on stdout, same records on armed sinks, sink scoped to the call."""
+    recs = [{"kind": "r", "i": 0}, {"kind": "r", "i": 1}]
+    ring = obs.RingSink()
+    with bus.installed(ring):
+        obs.emit_records(recs)
+    assert capsys.readouterr().out == "".join(
+        json.dumps(r) + "\n" for r in recs)
+    assert ring.events == recs
+
+
+def test_json_safe_recurses():
+    out = bus.json_safe({"a": [float("nan"), 1.0],
+                         "b": {"c": float("-inf")}})
+    assert out == {"a": ["nan", 1.0], "b": {"c": "-inf"}}
+    assert bus.json_safe((1.0, 2.0)) == [1.0, 2.0]
+
+
+# -- spans / Chrome trace golden checks -----------------------------
+
+def test_trace_exports_valid_and_nested(tmp_path):
+    """The golden-file check: a nested multi-span run exports to a
+    trace.json the structural validator fully accepts."""
+    with obs.session() as s:
+        with obs.span("outer", run=1) as outer:
+            with obs.span("inner", chunk=0):
+                pass
+            with obs.span("inner", chunk=1):
+                pass
+        obs.instant("tick", n=2)
+    path = tmp_path / "trace.json"
+    obs.export_trace(str(path), s.trace.snapshot())
+    assert obs.validate_trace(str(path)) == []
+
+    trace = json.loads(path.read_text())
+    assert trace["traceEvents"]
+    names = [(e["ph"], e["name"]) for e in trace["traceEvents"]
+             if e["ph"] in "BEi"]
+    assert names == [("B", "outer"), ("B", "inner"), ("E", "inner"),
+                     ("B", "inner"), ("E", "inner"), ("E", "outer"),
+                     ("i", "tick")]
+    # children carry the parent's span id; records can join on trace_id
+    begins = [e for e in trace["traceEvents"] if e["ph"] == "B"]
+    assert outer.trace_id == begins[0]["args"]["trace_id"]
+    assert all(b["args"]["parent"] == outer.trace_id
+               for b in begins[1:])
+
+
+def test_trace_timestamps_monotonic_per_thread():
+    """Each thread gets its own timeline with monotonic timestamps —
+    threads run *sequentially* here on purpose: the OS reuses thread
+    idents after a join, and the buffer's synthetic tids must keep the
+    dead thread's track separate from its ident-reusing successor."""
+    with obs.session(metrics=False) as s:
+        def work():
+            for i in range(5):
+                with obs.span("t.work", i=i):
+                    pass
+        for _ in range(4):
+            t = threading.Thread(target=work)
+            t.start()
+            t.join()
+        with obs.span("main"):
+            pass
+    events = s.trace.snapshot()
+    assert obs.validate_trace(events) == []
+    per_tid = {}
+    for e in events:
+        if "ts" in e:
+            per_tid.setdefault(e["tid"], []).append(e["ts"])
+    assert len(per_tid) == 5  # 4 workers + main thread, never merged
+    for tss in per_tid.values():
+        assert tss == sorted(tss)
+    named = [e for e in events
+             if e["ph"] == "M" and e["name"] == "thread_name"]
+    assert len(named) == 5  # one metadata record per timeline
+
+
+def test_validator_catches_unbalanced_b():
+    bad = [{"ph": "B", "name": "x", "pid": 1, "tid": 1, "ts": 0}]
+    assert any("unclosed" in p for p in obs.validate_trace(bad))
+
+
+def test_validator_catches_orphan_e():
+    bad = [{"ph": "E", "name": "x", "pid": 1, "tid": 1, "ts": 0}]
+    assert any("no open B" in p for p in obs.validate_trace(bad))
+
+
+def test_validator_catches_nesting_violation():
+    bad = [{"ph": "B", "name": "a", "pid": 1, "tid": 1, "ts": 0},
+           {"ph": "B", "name": "b", "pid": 1, "tid": 1, "ts": 1},
+           {"ph": "E", "name": "a", "pid": 1, "tid": 1, "ts": 2},
+           {"ph": "E", "name": "b", "pid": 1, "tid": 1, "ts": 3}]
+    assert any("nesting violation" in p for p in obs.validate_trace(bad))
+
+
+def test_validator_catches_backwards_ts():
+    bad = [{"ph": "B", "name": "a", "pid": 1, "tid": 1, "ts": 5},
+           {"ph": "E", "name": "a", "pid": 1, "tid": 1, "ts": 3}]
+    assert any("backwards" in p for p in obs.validate_trace(bad))
+
+
+def test_validator_accepts_interleaved_threads():
+    ok = [{"ph": "B", "name": "a", "pid": 1, "tid": 1, "ts": 0},
+          {"ph": "B", "name": "b", "pid": 1, "tid": 2, "ts": 1},
+          {"ph": "E", "name": "a", "pid": 1, "tid": 1, "ts": 2},
+          {"ph": "E", "name": "b", "pid": 1, "tid": 2, "ts": 3}]
+    assert obs.validate_trace(ok) == []
+
+
+def test_validator_rejects_garbage():
+    assert obs.validate_trace("not json {")
+    assert obs.validate_trace(42)
+    assert obs.validate_trace({"noTraceEvents": []})
+    assert any("bad dur" in p for p in obs.validate_trace(
+        [{"ph": "X", "name": "x", "pid": 1, "tid": 1, "ts": 0,
+          "dur": -1}]))
+
+
+def test_chrome_cli_checker(tmp_path, capsys):
+    from icikit.obs import chrome
+    good = tmp_path / "good.json"
+    with obs.session(metrics=False) as s:
+        with obs.span("a"):
+            pass
+    chrome.export(str(good), s.trace.snapshot())
+    assert chrome.main([str(good)]) == 0
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps({"traceEvents": [
+        {"ph": "B", "name": "x", "pid": 1, "tid": 1, "ts": 0}]}))
+    assert chrome.main([str(bad)]) == 1
+    capsys.readouterr()
+
+
+def test_traced_decorator():
+    @obs.traced("deco.fn", tag="t")
+    def fn(x):
+        return x + 1
+
+    assert fn(1) == 2  # disabled path: plain call
+    with obs.session(metrics=False) as s:
+        assert fn(2) == 3
+    names = [e["name"] for e in s.trace.snapshot() if e["ph"] == "B"]
+    assert names == ["deco.fn"]
+    assert fn.__name__ == "fn"
+
+
+def test_export_closes_spans_of_abandoned_threads(tmp_path):
+    """A hung straggler the scheduler abandons (join timeout — a
+    scenario the farm heals through) dies mid-span; the export must
+    still validate, with the synthetic closes marked as such."""
+    with obs.session(metrics=False) as s:
+        def hang_midspan():
+            obs.span("solve.worker", worker=9).__enter__()
+        t = threading.Thread(target=hang_midspan)
+        t.start()
+        t.join()
+        with obs.span("main"):
+            pass
+    raw = s.trace.snapshot()
+    assert any("unclosed" in p for p in obs.validate_trace(raw))
+    path = tmp_path / "trace.json"
+    obs.export_trace(str(path), raw)
+    assert obs.validate_trace(str(path)) == []
+    evs = json.loads(path.read_text())["traceEvents"]
+    synth = [e for e in evs
+             if e.get("args", {}).get("closed_by") == "export"]
+    assert [e["name"] for e in synth] == ["solve.worker"]
+
+
+# -- metrics --------------------------------------------------------
+
+def test_metrics_disabled_helpers_are_noops():
+    obs.count("x")
+    obs.gauge("x", 1.0)
+    obs.observe("x", 1.0)
+    assert obs.metrics_snapshot() is None
+
+
+def test_registry_counters_gauges_histograms():
+    with obs.session(trace=False) as s:
+        obs.count("sched.reissues", 3)
+        obs.count("sched.reissues")
+        obs.count("sched.deaths", 0)  # registers without moving
+        obs.gauge("workers", 7)
+        for v in [1.0, 2.0, 3.0, 4.0]:
+            obs.observe("step_ms", v)
+        snap = s.registry.snapshot()
+    assert snap["counters"] == {"sched.deaths": 0, "sched.reissues": 4}
+    assert snap["gauges"] == {"workers": 7.0}
+    h = snap["histograms"]["step_ms"]
+    assert h["count"] == 4 and h["sum"] == 10.0
+    assert h["min"] == 1.0 and h["max"] == 4.0 and h["mean"] == 2.5
+    assert h["p50"] in (2.0, 3.0) and h["p99"] == 4.0
+    json.dumps(snap, allow_nan=False)  # snapshot is JSON-safe
+
+
+def test_histogram_decimation_bounded_exact_aggregates():
+    h = obs.Registry().histogram("x")
+    n = 20_000
+    for i in range(n):
+        h.observe(float(i))
+    assert h.count == n and h.min == 0.0 and h.max == float(n - 1)
+    assert h.total == sum(range(n))
+    assert len(h._sample) < 4096  # bounded memory
+    # the stride-decimated sample still spans the stream evenly
+    assert abs(h.percentile(50) - n / 2) < n * 0.05
+
+
+def test_empty_histogram_summary():
+    h = obs.Registry().histogram("x")
+    s = h.summary()
+    assert s["count"] == 0 and s["p50"] is None and s["mean"] is None
+
+
+# -- session / env spec ---------------------------------------------
+
+def test_session_restores_previous_state():
+    outer = tracer.start_tracing()
+    try:
+        with obs.session(metrics=False) as s:
+            assert tracer.tracing() is s.trace is not outer
+        assert tracer.tracing() is outer
+    finally:
+        tracer.stop_tracing()
+
+
+def test_parse_spec_defaults_and_custom():
+    d = obs.parse_spec("1")
+    assert d == {"jsonl": "stderr", "trace": "trace.json",
+                 "metrics": "obs_metrics.json", "mirror": False}
+    d = obs.parse_spec("trace=/tmp/t.json;jsonl=off;mirror=1")
+    assert d["trace"] == "/tmp/t.json" and d["jsonl"] == "off"
+    assert d["mirror"] is True and d["metrics"] == "obs_metrics.json"
+    with pytest.raises(ValueError):
+        obs.parse_spec("bogus=1")
+    with pytest.raises(ValueError):
+        obs.parse_spec("trace")  # no '='
+
+
+# -- zero-overhead contract -----------------------------------------
+
+def test_disabled_span_is_shared_singleton():
+    a = obs.span("x", big=list(range(100)))
+    b = obs.span("y")
+    assert a is b is obs.NOOP_SPAN
+    with a as sp:
+        assert sp.trace_id is None
+
+
+def test_disabled_paths_allocate_nothing():
+    """The probe discipline shared with icikit.chaos: no sink and no
+    tracer means no allocation on the hot path."""
+    def hot():
+        for _ in range(300):
+            with obs.span("s"):
+                pass
+            obs.emit("e", a=1)
+            obs.count("c")
+            obs.observe("h", 1.0)
+
+    hot()  # warm up any lazy internals
+    tracemalloc.start()
+    hot()
+    _, peak = tracemalloc.get_traced_memory()
+    tracemalloc.stop()
+    assert peak < 4096, f"disabled obs path allocated {peak} B"
+
+
+# -- timing emit hooks ----------------------------------------------
+
+def test_stopwatch_emit_hook():
+    got = []
+    w = Stopwatch(emit=got.append)
+    a, b = w(), w()
+    assert got == [a, b] and all(v >= 0 for v in got)
+    # default stays hook-free
+    assert Stopwatch()() >= 0
+
+
+def test_timeit_emit_feeds_metrics():
+    import jax.numpy as jnp
+    with obs.session(trace=False) as s:
+        res = timeit(lambda: jnp.zeros(8), runs=3, warmup=1,
+                     emit=lambda sec: obs.observe("bench.run_ms",
+                                                  sec * 1e3))
+        snap = s.registry.snapshot()
+    h = snap["histograms"]["bench.run_ms"]
+    assert h["count"] == res.runs == 3
+    assert math.isclose(h["sum"], res.total_s * 1e3, rel_tol=1e-6)
+
+
+# -- chaos events ---------------------------------------------------
+
+def test_chaos_probes_emit_fired_and_skipped_events():
+    ring = obs.RingSink()
+    plan = chaos.FaultPlan(schedule={"delay:w.0": (1,)}, delay_s=0.0)
+    with bus.installed(ring), chaos.inject(plan):
+        chaos.maybe_delay("w.0")  # call 0: skipped
+        chaos.maybe_delay("w.0")  # call 1: fires
+    fired = ring.of_type("chaos.fired")
+    skipped = ring.of_type("chaos.skipped")
+    assert [(e["kind"], e["site"], e["call"]) for e in fired] == [
+        ("delay", "w.0", 1)]
+    assert [(e["kind"], e["site"], e["call"]) for e in skipped] == [
+        ("delay", "w.0", 0)]
+    assert all(e["seed"] == plan.seed for e in fired + skipped)
+
+
+def test_chaos_fired_lands_on_trace_timeline():
+    plan = chaos.FaultPlan(schedule={"delay:w.0": (0,)}, delay_s=0.0)
+    with obs.session(metrics=False) as s, chaos.inject(plan):
+        with obs.span("pull"):
+            chaos.maybe_delay("w.0")
+    events = s.trace.snapshot()
+    assert obs.validate_trace(events) == []
+    insts = [e for e in events if e["ph"] == "i"]
+    assert [e["name"] for e in insts] == ["chaos.fired"]
+    assert insts[0]["args"]["site"] == "w.0"
+
+
+# -- integration: the dynamic scheduler under obs -------------------
+
+def test_solve_dynamic_obs_wiring():
+    """One healed solve run yields a valid trace, the scheduler
+    counters (including zero-valued ones), and lease/death events —
+    the acceptance criteria's scheduler half, in-process."""
+    from icikit.models.solitaire.dataset import generate_dataset
+    from icikit.models.solitaire.scheduler import solve_dynamic
+
+    ring = obs.RingSink()
+    plan = chaos.FaultPlan(schedule={"die:solitaire.worker.0": (0,)})
+    with obs.session(ring) as s, chaos.inject(plan):
+        with pytest.warns(RuntimeWarning, match="worker 0"):
+            rep = solve_dynamic(generate_dataset(16, "easy", seed=3),
+                                chunk_size=4)
+        events = s.trace.snapshot()
+        snap = s.registry.snapshot()
+    assert rep.n_deaths == 1 and rep.n_reissues > 0
+    assert obs.validate_trace(events) == []
+    names = {e["name"] for e in events if e["ph"] == "B"}
+    assert {"solve.dynamic", "solve.worker", "solve.pull",
+            "solve.chunk"} <= names
+    c = snap["counters"]
+    assert c["scheduler.deaths"] == 1
+    assert c["scheduler.reissues"] == rep.n_reissues
+    assert c["scheduler.commits"] >= 4
+    assert "scheduler.lease_expired" in c  # registered even at 0
+    deaths = ring.of_type("scheduler.worker_death")
+    assert len(deaths) == 1 and deaths[0]["reissued_chunks"]
+    assert ring.of_type("scheduler.drained")[0]["reissues"] == \
+        rep.n_reissues

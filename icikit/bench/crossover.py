@@ -80,13 +80,26 @@ def predict_time(alg: str, p: int, n: int, alpha_s: float,
     return local + work + comm
 
 
+def alpha_key(a_us) -> str:
+    """The string key a given α is filed under in ``crossover_table``
+    (``f"{a_us:g}"`` — 1.0 and 1 collapse to "1")."""
+    return f"{float(a_us):g}"
+
+
 def crossover_table(n: int, ps=None,
                     incumbent: str = "bitonic",
                     challenger: str = "quicksort",
                     alphas_us=ALPHAS_US) -> dict:
     """Times per (alpha, alg, p) plus, per alpha, the first p where
     ``challenger`` undercuts ``incumbent`` (None if never within
-    ``ps``)."""
+    ``ps``).
+
+    The per-α maps (``times``, ``crossover_p``) are keyed by STRING
+    keys (``alpha_key``): ``json.dumps`` silently stringifies float
+    keys, so a table keyed by floats changed shape the moment it
+    round-tripped through ``crossover.jsonl`` — the in-memory and
+    serialized forms now match exactly (pinned by the round-trip
+    test)."""
     if ps is None:
         ps = tuple(2 ** k for k in range(1, 11))  # 2..1024
     algs = (incumbent, challenger)
@@ -96,13 +109,13 @@ def crossover_table(n: int, ps=None,
     for a_us in alphas_us:
         times = {alg: [predict_time(alg, p, n, a_us * 1e-6)
                        for p in ps] for alg in algs}
-        out["times"][a_us] = times
+        out["times"][alpha_key(a_us)] = times
         cross = None
         for i, p in enumerate(ps):
             if times[challenger][i] < times[incumbent][i]:
                 cross = p
                 break
-        out["crossover_p"][a_us] = cross
+        out["crossover_p"][alpha_key(a_us)] = cross
     return out
 
 
@@ -128,21 +141,21 @@ def render_markdown(tab: dict) -> str:
         + " | crossover |",
         "|---|" + "---|" * (len(tab["ps"]) + 1),
     ]
-    for a_us, times in tab["times"].items():
+    for a_key, times in tab["times"].items():
         cells = []
         for i in range(len(tab["ps"])):
             ti = times[inc][i] * 1e3
             tc = times[ch][i] * 1e3
             win = ch[0] if tc < ti else inc[0]
             cells.append(f"{ti:.2f}/{tc:.2f} {win}")
-        cr = tab["crossover_p"][a_us]
+        cr = tab["crossover_p"][a_key]
         tail = f" **p = {cr}** |" if cr else " — |"
-        lines.append(f"| {a_us:g} | " + " | ".join(cells) + " |" + tail)
+        lines.append(f"| {a_key} | " + " | ".join(cells) + " |" + tail)
     # the prose quotes the COMPUTED crossovers, not frozen examples
     cross_desc = ", ".join(
-        (f"p={cr} at {a_us:g} µs" if cr else f"none ≤ {tab['ps'][-1]} "
-         f"at {a_us:g} µs")
-        for a_us, cr in tab["crossover_p"].items())
+        (f"p={cr} at {a_key} µs" if cr else f"none ≤ {tab['ps'][-1]} "
+         f"at {a_key} µs")
+        for a_key, cr in tab["crossover_p"].items())
     lines += [
         "",
         f"Cells are modeled ms {inc}/{ch} with the winner tagged; "

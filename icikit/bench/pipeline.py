@@ -41,12 +41,12 @@ def analytic_pp_counts(cfg, p: int, m: int, b: int = 2,
                        s: int = 16) -> dict:
     """Trace the pipeline loss program and count its structure."""
     import jax
-    from jax.sharding import AbstractMesh
 
     from icikit.models.transformer.pipeline import (
         DP_AXIS, PP_AXIS, _build_pp_loss_and_grad)
+    from icikit.utils.mesh import abstract_mesh
 
-    mesh = AbstractMesh((1, p), (DP_AXIS, PP_AXIS))
+    mesh = abstract_mesh((1, p), (DP_AXIS, PP_AXIS))
     # _build_pp_loss_and_grad wraps in jit+shard_map; tracing the
     # wrapped callable over abstract operands counts the real program
     fn = _build_pp_loss_and_grad(mesh, cfg, m, (b, s))
@@ -98,12 +98,12 @@ def analytic_1f1b_counts(cfg, p: int, m: int, b: int = 2,
     the scan trip count."""
     import jax
     import jax.numpy as jnp
-    from jax.sharding import AbstractMesh
 
     from icikit.models.transformer.pipeline import (
         DP_AXIS, PP_AXIS, _build_pp_1f1b)
+    from icikit.utils.mesh import abstract_mesh
 
-    mesh = AbstractMesh((1, p), (DP_AXIS, PP_AXIS))
+    mesh = abstract_mesh((1, p), (DP_AXIS, PP_AXIS))
     fn = _build_pp_1f1b(mesh, cfg, m, (b, s))
     shapes = _pp_param_shapes(cfg)
     params = {k: jax.ShapeDtypeStruct(v, jnp.float32)

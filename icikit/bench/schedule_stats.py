@@ -137,13 +137,17 @@ def _walk(jaxpr, depth_in: int, acc: dict, p: int):
     return max([max_depth] + [d_of(v) for v in jaxpr.outvars])
 
 
+def _abstract_mesh(p: int, axis: str):
+    from icikit.utils.mesh import abstract_mesh
+    return abstract_mesh((p,), (axis,))
+
+
 def analyze_collective(family: str, algorithm: str, p: int,
                        msize: int = 4096, dtype="float32",
                        axis: str = "p") -> ScheduleStats:
     """Trace one registered schedule at (p, msize) and count its
     communication statically — no devices, no execution."""
     import jax
-    from jax.sharding import AbstractMesh
 
     from icikit.parallel.shmap import build_collective
 
@@ -151,7 +155,7 @@ def analyze_collective(family: str, algorithm: str, p: int,
              "reduce": ("sum", 0), "scan": ("sum", True),
              "broadcast": (0,), "scatter": (0,), "gather": (0,)
              }.get(family, ())
-    mesh = AbstractMesh((p,), (axis,))
+    mesh = _abstract_mesh(p, axis)
     fn = build_collective(family, algorithm, mesh, axis, extra)
     jaxpr = jax.make_jaxpr(fn)(_global_input(family, p, msize, dtype))
     acc = {"calls": 0, "bytes": 0.0, "vendor": 0}
@@ -177,10 +181,9 @@ def analyze_sort(algorithm: str, p: int, n: int,
     the jaxpr, so the counts are exact, not per-iteration estimates.
     """
     import jax
-    from jax.sharding import AbstractMesh
 
     n_loc = max(1, n // p)
-    mesh = AbstractMesh((p,), ("p",))
+    mesh = _abstract_mesh(p, "p")
     if algorithm == "bitonic":
         from icikit.models.sort.bitonic import _build
         fn = _build(mesh, "p")

@@ -673,14 +673,13 @@ def solve_host(batch: BoardBatch, n_threads: int = 0,
 
     from icikit import native
 
-    if not native.available():
-        # the Python fallback solves serially: report ONE worker so
-        # the telemetry describes the run that actually happened (a
-        # fabricated n-thread split would publish imbalance =
-        # n_threads for both strategies)
-        n_threads = 1
-    elif n_threads <= 0:
-        n_threads = os.cpu_count() or 1
+    # resolve through the same rule solve_batch applies internally, so
+    # the per_games/per_steps domains below always match the worker
+    # ids the pool reports (on the serial Python fallback this is ONE
+    # worker — the telemetry describes the run that actually happened;
+    # a fabricated n-thread split would publish imbalance = n_threads
+    # for both strategies)
+    n_threads = native.resolve_n_threads(n_threads)
     t0 = time.perf_counter()
     solved, n_moves, moves, steps, workers = native.solve_batch(
         batch.pegs, batch.playable, max_steps=max_steps,

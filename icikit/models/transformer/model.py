@@ -86,9 +86,12 @@ class TransformerConfig:
     # constant-shift softmax forward (ops/flash_attention): removes
     # the rowmax chain — the measured exposed VPU cost of the tile
     # loop — with a traced exact-fallback on overflow. None = exact
-    # online softmax; 16.0 is safe for unit-variance streams. Applies
-    # to the local (p_sp == 1) flash path only.
-    softmax_shift: float | None = None
+    # online softmax; 16.0 is safe for unit-variance streams and is
+    # the default since r6 (every headline long-context row used the
+    # shift and its exact-fallback is traced and dryrun-tested; the
+    # r6 defaults audit shipped the measured winners). Applies to the
+    # local (p_sp == 1) flash path only.
+    softmax_shift: float | None = 16.0
     # Positional encoding: "learned" (trained absolute table, the
     # default) or "rope" (rotary on Q/K — relative positions, so every
     # schedule applies it locally with global indices; no "pos" param).
@@ -107,8 +110,25 @@ class TransformerConfig:
     # Fused-head backward mode (r5 structural A/B): save the forward's
     # bf16 shifted-exponential chunks so the backward skips the logits
     # recompute matmul (ops/xent.py save_exp). Costs a live (T, V)
-    # bf16 residual between forward and backward.
-    xent_save_exp: bool = False
+    # bf16 residual between forward and backward. Default ON since r6
+    # (measured winner: −1.0 ms r5, and it makes the fused backward's
+    # g rebuild matmul-free — the combined headline configuration).
+    xent_save_exp: bool = True
+    # r6 fused head backward: dx and dw come out of the backward
+    # kernels directly (g rebuilt in VMEM and contracted on the spot,
+    # no (T, V) g round-trip through HBM — measured −2.1 ms/step at
+    # the base preset). False restores the matmul formulation for the
+    # A/B (ops/xent.py fused_bwd).
+    xent_fused_bwd: bool = True
+    # Residual save-stack writer for the layer scan: "xla" (lax.scan,
+    # XLA-owned stacking — the default) or "pallas" (explicit stacks
+    # written by the layout-pinned ops/stack_write kernel, full-layer
+    # rematerialization in the backward). The r6 A/B measured the
+    # pallas path +6.3 ms/step at the base preset — the copies it
+    # removes cost less than the policy-saved dots it gives up — so
+    # the default stays "xla" with the attempt reachable; see
+    # docs/DESIGN.md "Round-6".
+    save_stack: str = "xla"
     # Sequence-parallel schedule for sp > 1: "ring" (neighbor ppermute
     # K/V rotation, any sequence length) or "ulysses" (all-to-all
     # head<->sequence re-shard; needs n_heads/tp divisible by sp).
@@ -168,6 +188,9 @@ def _check_cfg(cfg: TransformerConfig) -> None:
     if cfg.n_kv_heads and cfg.n_heads % cfg.n_kv_heads:
         raise ValueError(f"n_kv_heads={cfg.n_kv_heads} must divide "
                          f"n_heads={cfg.n_heads}")
+    if cfg.save_stack not in ("xla", "pallas"):
+        raise ValueError(f"unknown save_stack {cfg.save_stack!r} "
+                         "(known: xla, pallas)")
 
 
 def _is_gqa(cfg: TransformerConfig) -> bool:
@@ -409,7 +432,11 @@ def _forward_local(params, tokens, cfg: TransformerConfig, p_sp: int,
 
     n_rep = _n_rep(cfg)
 
-    def attention(q, k, v):
+    # ``positions`` rides as an explicit argument (not a closure): the
+    # pallas save-stack path routes the layer through a custom-vjp
+    # boundary, and every traced value crossing it must be a real
+    # argument — a closed-over tracer would leak.
+    def attention(q, k, v, positions):
         if cfg.pos_encoding == "rope":
             q = apply_rope(q, positions, cfg.rope_theta)
             k = apply_rope(k, positions, cfg.rope_theta)
@@ -455,9 +482,29 @@ def _forward_local(params, tokens, cfg: TransformerConfig, p_sp: int,
         return (_dense_ffn_block(x, lp, cdt, psum_tp),
                 jnp.zeros((), jnp.float32))
 
-    def layer(x, lp):
-        x = _attn_block(x, lp, cdt, attention, psum_tp)
+    def layer(x, lp, positions):
+        x = _attn_block(x, lp, cdt,
+                        lambda q, k, v: attention(q, k, v, positions),
+                        psum_tp)
         return ffn(x, lp)
+
+    layer_params = {k: params[k] for k in _layer_keys(cfg)}
+    if cfg.save_stack == "pallas":
+        # Explicit Pallas-written residual stacks + full-layer
+        # rematerialization (ops/stack_write.remat_scan_stacked) —
+        # the r6 measured attempt at the XLA save-stack layout
+        # copies. A measured dead-end at the base preset (+6.3 ms,
+        # DESIGN.md "Round-6"); reachable for re-measurement.
+        from icikit.ops.stack_write import remat_scan_stacked
+        x, aux_total = remat_scan_stacked(layer, x, layer_params,
+                                          positions)
+        x = _rms_norm(x, params["ln_f"]).astype(cdt)
+        if head == "hidden":
+            return x, aux_total
+        logits = jnp.einsum(
+            "bsd,vd->bsv", x,
+            params["w_out"].astype(cdt)).astype(jnp.float32)
+        return logits, aux_total
 
     if cfg.remat and cfg.remat_policy == "except_attn":
         # Attention stays outside the checkpointed regions: its
@@ -477,12 +524,12 @@ def _forward_local(params, tokens, cfg: TransformerConfig, p_sp: int,
 
         def scan_body(x, lp):
             q, k, v = jax.checkpoint(pre, policy=dots)(x, lp)
-            attn = attention(q, k, v)
+            attn = attention(q, k, v, positions)
             return jax.checkpoint(post, policy=dots)(x, attn, lp)
     else:
-        scan_body = _maybe_remat(layer, cfg)
+        scan_body = _maybe_remat(
+            lambda x, lp: layer(x, lp, positions), cfg)
 
-    layer_params = {k: params[k] for k in _layer_keys(cfg)}
     x, auxes = lax.scan(scan_body, x, layer_params,
                         unroll=cfg.scan_unroll)
     x = _rms_norm(x, params["ln_f"]).astype(cdt)
@@ -538,7 +585,8 @@ def _local_loss(params, tokens, targets, cfg, p_sp, p_dp, p_tp, denom):
             w = lax.pcast(w, (DP_AXIS, SP_AXIS), to="varying")
         nll = fused_xent(h.reshape(b * s, cfg.d_model), w,
                          targets.reshape(b * s),
-                         save_exp=cfg.xent_save_exp).reshape(b, s)
+                         save_exp=cfg.xent_save_exp,
+                         fused_bwd=cfg.xent_fused_bwd).reshape(b, s)
     else:
         logits, aux = _forward_local(params, tokens, cfg, p_sp, p_dp)
         if cfg.vocab_parallel:

@@ -35,6 +35,39 @@ _build_error: str | None = None
 MAX_DEPTH = 25
 
 
+def _run_make_locked() -> str | None:
+    """Run the lazy build under an exclusive ``flock`` on a sentinel
+    file, so two processes first-loading concurrently serialize on the
+    link step instead of one of them dlopen-ing a partially-written
+    ``.so`` (make's rename is not atomic across the compile+link
+    recipe). Returns an error string, or None on success. The lock
+    file lives next to the library — same filesystem, so flock
+    semantics hold wherever the build writes."""
+    lock_path = os.path.join(_HERE, ".build.lock")
+    try:
+        lock_f = open(lock_path, "w")
+    except OSError:
+        lock_f = None  # read-only install: fall through unlocked
+    try:
+        if lock_f is not None:
+            try:
+                import fcntl
+                fcntl.flock(lock_f, fcntl.LOCK_EX)
+            except (ImportError, OSError):
+                pass  # non-POSIX: best effort, identical to pre-lock
+        try:
+            subprocess.run(
+                ["make", "-C", _HERE, "-s"], check=True,
+                capture_output=True, text=True, timeout=120)
+        except (subprocess.SubprocessError, OSError) as e:
+            out = getattr(e, "stderr", "") or str(e)
+            return f"native build failed: {out.strip()[:500]}"
+        return None
+    finally:
+        if lock_f is not None:
+            lock_f.close()  # closing drops the flock
+
+
 def _try_load():
     global _lib, _build_error
     with _lock:
@@ -43,25 +76,32 @@ def _try_load():
         # Always run make BEFORE the first dlopen: make's own mtime
         # check makes this a no-op when the library is current, and it
         # refreshes a stale prebuilt one from before newer sources.
-        # Rebuilding after a failed CDLL probe cannot work — glibc
+        # Rebuilding after a SUCCESSFUL CDLL cannot work — glibc
         # dlopen returns the already-mapped handle for the same path,
-        # so a post-load rebuild would never be picked up this process.
-        try:
-            subprocess.run(
-                ["make", "-C", _HERE, "-s"], check=True,
-                capture_output=True, text=True, timeout=120)
-        except (subprocess.SubprocessError, OSError) as e:
-            if not os.path.exists(_LIB_PATH):
-                out = getattr(e, "stderr", "") or str(e)
-                _build_error = f"native build failed: {out.strip()[:500]}"
-                return None
-            # no toolchain but a prebuilt library exists: try it (the
-            # symbol probe below rejects it if too old)
-        try:
-            lib = ctypes.CDLL(_LIB_PATH)
-        except OSError as e:
-            _build_error = f"native load failed: {e}"
+        # so a post-load rebuild would never be picked up this
+        # process. A FAILED CDLL maps nothing, so one retry after a
+        # re-make is sound — it covers the racing-writer case the
+        # flock closes for new processes but cannot retroactively fix
+        # for a probe that read a torn file mid-replace.
+        build_err = _run_make_locked()
+        if build_err is not None and not os.path.exists(_LIB_PATH):
+            _build_error = build_err
             return None
+        # reaching here with build_err set = no toolchain but a
+        # prebuilt library exists: try it (the symbol probe below
+        # rejects it if too old)
+        lib = None
+        for attempt in (0, 1):
+            try:
+                lib = ctypes.CDLL(_LIB_PATH)
+                break
+            except OSError as e:
+                if attempt == 1:
+                    _build_error = f"native load failed: {e}"
+                    return None
+                # serialize behind any in-flight writer, rebuild if
+                # the artifact is torn, then retry the load once
+                _run_make_locked()
         if not (hasattr(lib, "ik_markov_fill")
                 and hasattr(lib, "ik_solve_batch_w")):
             # stale prebuilt library and no working toolchain to
@@ -229,6 +269,19 @@ def solve(pegs: int, playable: int,
     return st == 1, list(moves[:n_moves.value]), int(steps.value)
 
 
+def resolve_n_threads(n_threads: int = 0) -> int:
+    """The worker count ``solve_batch`` will actually use for this
+    request: explicit positive counts pass through; ``<= 0`` resolves
+    to the host's logical CPUs on the native path (``solver.cc``'s
+    ``hardware_concurrency`` rule) and to 1 on the serial Python
+    fallback. Callers building per-worker telemetry
+    (``scheduler.solve_host``) get the worker-id domain from here
+    instead of re-deriving it."""
+    if n_threads > 0:
+        return n_threads
+    return (os.cpu_count() or 1) if available() else 1
+
+
 def solve_batch(pegs: np.ndarray, playable: np.ndarray,
                 max_steps: int = 2**62, n_threads: int = 0,
                 chunk_size: int = 8, return_workers: bool = False):
@@ -236,11 +289,19 @@ def solve_batch(pegs: np.ndarray, playable: np.ndarray,
     n_moves int32[B], moves int32[B,25], steps int64[B]); with
     ``return_workers`` also int32[B] of the pool worker that solved
     each board (0 = the server thread) — the DLB study's per-worker
-    telemetry. The Python fallback solves serially: worker 0."""
+    telemetry. The Python fallback solves serially: worker 0.
+
+    ``n_threads <= 0`` is resolved HERE (to the host's logical CPU
+    count — mirroring ``solver.cc``'s ``hardware_concurrency``
+    resolution) rather than passed through opaquely, so the returned
+    worker-id domain is always known to the caller: with
+    ``return_workers`` the ids lie in ``[0, resolved_n_threads)``
+    regardless of who chose the count."""
     pegs = np.ascontiguousarray(pegs, np.uint32)
     playable = np.ascontiguousarray(playable, np.uint32)
     n = len(pegs)
     lib = _try_load()
+    n_threads = resolve_n_threads(n_threads)
     workers = np.zeros(n, np.int32)
     if lib is None:
         from icikit.models.solitaire.game import solve_one_py

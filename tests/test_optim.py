@@ -184,15 +184,18 @@ def test_fused_adam_matches_optax(use_pallas):
     for k in pa:
         np.testing.assert_allclose(np.asarray(pa[k]), np.asarray(pf[k]),
                                    rtol=2e-6, atol=2e-7, err_msg=k)
-    # moments too: m/v trees must match optax's mu/nu
+    # moments too: m/v trees must match optax's mu/nu (atol spans the
+    # cross-jit fusion flutter on near-zero gradient elements — the
+    # two step programs compile separately, and the r6 constant-shift
+    # forward gives XLA more reassociation freedom)
     mu, nu = sa[0].mu, sa[0].nu
     for k in mu:
         np.testing.assert_allclose(np.asarray(mu[k]),
                                    np.asarray(sf[0][k]),
-                                   rtol=2e-6, atol=1e-8, err_msg=k)
+                                   rtol=2e-6, atol=2e-7, err_msg=k)
         np.testing.assert_allclose(np.asarray(nu[k]),
                                    np.asarray(sf[1][k]),
-                                   rtol=2e-6, atol=1e-10, err_msg=k)
+                                   rtol=2e-6, atol=1e-9, err_msg=k)
 
 
 def test_fused_adam_bf16_moments_state_dtypes_and_first_steps():
@@ -224,6 +227,54 @@ def test_fused_adam_bf16_moments_state_dtypes_and_first_steps():
         np.testing.assert_allclose(np.asarray(pa[k], np.float32),
                                    np.asarray(pb[k], np.float32),
                                    rtol=5e-3, atol=5e-4, err_msg=k)
+
+
+def test_fused_adam_pallas_bf16_moments_matches_xla():
+    """The pallas + bf16-moments combination (r6 satellite): kernel-
+    covered leaves reproduce the XLA one-pass update bit-for-bit-close,
+    and sublane-ragged leaves (rows % 16 != 0 with bf16 operands) take
+    the XLA fallback instead of handing Mosaic an untileable block."""
+    from icikit.ops.adam import _use_pallas, adam_apply
+
+    rng = np.random.default_rng(3)
+
+    def leaves(shape):
+        p = {"w": jnp.asarray(rng.normal(size=shape), jnp.float32)}
+        m = {"w": jnp.asarray(rng.normal(size=shape) * 0.1, jnp.bfloat16)}
+        v = {"w": jnp.asarray(rng.random(shape) * 0.01, jnp.bfloat16)}
+        g = {"w": jnp.asarray(rng.normal(size=shape), jnp.bfloat16)}
+        return p, m, v, g
+
+    # covered: 32 rows of 128 lanes satisfies the bf16 sublane rule
+    p, m, v, g = leaves((32, 128))
+    assert _use_pallas(p["w"], m["w"], v["w"], g["w"])
+    out_pl = adam_apply(p, m, v, g, 1e-3, jnp.int32(2), use_pallas=True)
+    out_xla = adam_apply(p, m, v, g, 1e-3, jnp.int32(2), use_pallas=False)
+    # params update in fp32 — tight; bf16 moment stores may differ by
+    # one ulp where the kernel's fused multiply-add and XLA's unfused
+    # chain land on opposite sides of a rounding tie
+    np.testing.assert_allclose(np.asarray(out_pl[0]["w"]),
+                               np.asarray(out_xla[0]["w"]),
+                               rtol=1e-6, atol=1e-7)
+    for a, b in ((out_pl[1]["w"], out_xla[1]["w"]),
+                 (out_pl[2]["w"], out_xla[2]["w"])):
+        assert a.dtype == jnp.bfloat16
+        np.testing.assert_allclose(np.asarray(a, np.float32),
+                                   np.asarray(b, np.float32),
+                                   rtol=1e-2, atol=1e-3)
+
+    # ragged: 24 rows breaks the bf16 sublane rule (fine for fp32) —
+    # the gate must route it to the fallback, and the whole-tree API
+    # must still produce the right numbers
+    p, m, v, g = leaves((24, 128))
+    assert not _use_pallas(p["w"], m["w"], v["w"], g["w"])
+    assert _use_pallas(p["w"], p["w"], p["w"], p["w"])  # fp32: rows%8
+    out_pl = adam_apply(p, m, v, g, 1e-3, jnp.int32(2), use_pallas=True)
+    out_xla = adam_apply(p, m, v, g, 1e-3, jnp.int32(2), use_pallas=False)
+    for a, b in zip(jax.tree.leaves(out_pl), jax.tree.leaves(out_xla)):
+        np.testing.assert_allclose(np.asarray(a, np.float32),
+                                   np.asarray(b, np.float32),
+                                   rtol=1e-5, atol=1e-6)
 
 
 def test_fused_adam_kernel_leaf_matches_reference():

@@ -118,17 +118,50 @@ def test_crossover_prediction_structure():
     properties that carry the science — bitonic wins the small-p
     low-latency regime, and raising per-round latency can only move
     the crossover EARLIER (the latency-depth mechanism)."""
-    from icikit.bench.crossover import crossover_table, render_markdown
+    from icikit.bench.crossover import (alpha_key, crossover_table,
+                                        render_markdown)
 
     tab = crossover_table(1 << 16, ps=(2, 4, 8, 16, 32, 64),
                           alphas_us=(1.0, 50.0))
     assert tab["algs"] == ["bitonic", "quicksort"]
-    t1 = tab["times"][1.0]
+    t1 = tab["times"][alpha_key(1.0)]
     assert t1["bitonic"][0] < t1["quicksort"][0]  # small p: bitonic
-    crossings = [tab["crossover_p"][a] for a in (1.0, 50.0)]
+    crossings = [tab["crossover_p"][alpha_key(a)] for a in (1.0, 50.0)]
     # higher alpha crosses no later than lower alpha (None = never)
     if crossings[0] is not None:
         assert crossings[1] is not None
         assert crossings[1] <= crossings[0]
     md = render_markdown(tab)
     assert "crossover" in md and "| 50 |" in md
+
+
+def test_crossover_table_json_roundtrip():
+    """The per-α maps are keyed by strings (alpha_key), so the
+    in-memory table and its crossover.jsonl serialization have the
+    SAME shape — json.dumps silently stringified the old float keys,
+    making every consumer of the file diverge from every consumer of
+    the dict. Traces are seeded synthetically so this pin is a pure
+    shape test (analyze_sort itself is exercised above and its
+    AbstractMesh path is a known jax-0.4.37 env gap)."""
+    import json
+
+    from icikit.bench import crossover
+
+    n, ps = 1 << 14, (2, 4, 8)
+    seeded = {}
+    for alg in ("bitonic", "quicksort"):
+        for p in ps:
+            key = (alg, p, n)
+            seeded[key] = crossover._TRACE_CACHE.get(
+                key, (p.bit_length(), 4 * n // p))
+    old = dict(crossover._TRACE_CACHE)
+    crossover._TRACE_CACHE.update(seeded)
+    try:
+        tab = crossover.crossover_table(n, ps=ps, alphas_us=(1.0, 25.0))
+    finally:
+        crossover._TRACE_CACHE.clear()
+        crossover._TRACE_CACHE.update(old)
+    back = json.loads(json.dumps(tab))
+    assert back == tab
+    assert set(tab["times"]) == {"1", "25"}
+    assert set(tab["crossover_p"]) == {"1", "25"}

@@ -144,9 +144,32 @@ def test_timeit_windows_stamps_session_quality():
     res = timeit_windows(lambda x: x + 1, (jnp.ones(64),),
                          lambda a, out: (out,), windows=2, runs=1)
     q = res.session_quality()
-    assert set(q) == {"spread_ratio", "escalated", "degraded"}
+    assert {"spread_ratio", "escalated", "degraded"} <= set(q)
     assert res.windows >= 2
     assert q["spread_ratio"] == pytest.approx(res.spread_ratio, abs=1e-3)
+
+
+def test_session_canary_stamped_and_cached(monkeypatch):
+    """The fixed canary kernel (VERDICT r5 weak #3): measured once per
+    process, stamped into session_quality so cross-round headline
+    walks are attributable to fabric mood vs regression."""
+    from icikit.utils import timing
+    from icikit.utils.timing import session_canary, timeit_windows
+
+    monkeypatch.setattr(timing, "_canary_cache", None)
+    c = session_canary()
+    assert c is not None and c["canary_gbs"] > 0 and c["canary_ms"] > 0
+    # cached: the second call returns the same object, no re-measure
+    assert session_canary() is timing._canary_cache
+    res = timeit_windows(lambda x: x + 1, (jnp.ones(64),),
+                         lambda a, out: (out,), windows=2, runs=1)
+    q = res.session_quality()
+    assert q["canary_gbs"] == c["canary_gbs"]
+    # and the kill switch for hosts where even 8 MiB matters
+    monkeypatch.setenv("ICIKIT_CANARY", "0")
+    assert session_canary() is None
+    monkeypatch.setenv("ICIKIT_CANARY", "1")
+    assert session_canary() is not None  # cache survives the toggle
 
 
 def test_rng_partition_invariance(mesh8):

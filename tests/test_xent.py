@@ -155,6 +155,69 @@ def test_save_exp_grads_bf16_storage_rounding():
                                rtol=0.05, atol=0.02)
 
 
+@pytest.mark.parametrize("save", [False, True])
+def test_fused_bwd_matches_matmul_bwd_and_oracle(save):
+    """The r6 fused backward (dx/dw contracted in-kernel, no g matrix
+    in HBM) must reproduce the matmul formulation and the oracle at
+    fp32 tolerance — both flavors: recompute (g from a rebuilt logits
+    chunk) and saved (g from the stored exponentials). Multi-chunk
+    blocks exercise both accumulator grids (dx over the vocab grid,
+    dw over the transposed token grid)."""
+    x, w, tgt = _case(512, 128, 1024)
+    sel = jnp.asarray(RNG.standard_normal(512).astype(np.float32))
+
+    def loss(fuse):
+        def f(x, w):
+            return jnp.sum(fused_xent(x, w, tgt, block_t=256,
+                                      block_v=512, save_exp=save,
+                                      fused_bwd=fuse) * sel)
+        return f
+
+    dx_f, dw_f = jax.grad(loss(True), argnums=(0, 1))(x, w)
+    dx_m, dw_m = jax.grad(loss(False), argnums=(0, 1))(x, w)
+    # vs the matmul formulation: same g, same fp32 accumulation — only
+    # reassociation differs
+    np.testing.assert_allclose(np.asarray(dx_f), np.asarray(dx_m),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(dw_f), np.asarray(dw_m),
+                               rtol=1e-4, atol=1e-5)
+
+    def oracle(x, w):
+        return jnp.sum(_oracle_nll(x, w, tgt) * sel)
+
+    dx_o, dw_o = jax.grad(oracle, argnums=(0, 1))(x, w)
+    np.testing.assert_allclose(np.asarray(dx_f), np.asarray(dx_o),
+                               rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(np.asarray(dw_f), np.asarray(dw_o),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_fused_bwd_bf16_dtypes_and_tolerance():
+    """bf16 operands through the fused backward: cotangents come out
+    in the params' dtypes and match the matmul formulation to bf16
+    storage tolerance."""
+    x, w, tgt = _case(512, 128, 1024)
+    x, w = x.astype(jnp.bfloat16), w.astype(jnp.bfloat16)
+
+    def loss(fuse, save):
+        def f(x, w):
+            return jnp.mean(fused_xent(x, w, tgt, block_t=256,
+                                       block_v=512, save_exp=save,
+                                       fused_bwd=fuse))
+        return f
+
+    for save in (False, True):
+        dx_f, dw_f = jax.grad(loss(True, save), argnums=(0, 1))(x, w)
+        dx_m, dw_m = jax.grad(loss(False, save), argnums=(0, 1))(x, w)
+        assert dx_f.dtype == jnp.bfloat16 and dw_f.dtype == jnp.bfloat16
+        np.testing.assert_allclose(np.asarray(dx_f, np.float32),
+                                   np.asarray(dx_m, np.float32),
+                                   rtol=0.05, atol=0.02)
+        np.testing.assert_allclose(np.asarray(dw_f, np.float32),
+                                   np.asarray(dw_m, np.float32),
+                                   rtol=0.05, atol=0.02)
+
+
 def test_supported_gate():
     assert xent_supported(1024, 128, 2048, jnp.bfloat16)
     assert xent_supported(256, 256, 512, jnp.float32)
@@ -173,6 +236,10 @@ def test_shape_mismatch_raises():
         fused_xent(x, w[:, :64], tgt)
     with pytest.raises(ValueError, match="fused xent needs"):
         fused_xent(x, w, tgt, block_t=100)  # 256 % 100 != 0
+    # mixed operand dtypes would silently degrade the saved-flavor dw
+    # through the narrower storage — rejected up front
+    with pytest.raises(ValueError, match="dtype mismatch"):
+        fused_xent(x.astype(jnp.bfloat16), w, tgt)
 
 
 def test_sharded_dp_tokens():

@@ -1,0 +1,52 @@
+"""Round-6 train-ceiling structural A/Bs (VERDICT r5 #1/#2) at the
+base preset: the two named routes — fused head backward (dx/dw
+contracted in-kernel, no g round-trip) and the Pallas save-stack
+writer — measured against the r5 combined winner (saved head + bf16
+moments), plus the constant-shift forward, interleaved within one
+session so every variant sees the same tunnel mood. The session
+canary (utils/timing.session_canary) is stamped into every record via
+session_quality. Appends records to train_ab_r6.jsonl.
+
+Usage: python tools/train_ab_r6.py [batch ...]   (default: 8)
+"""
+
+import json
+import sys
+
+from icikit.bench.train import run_bench
+
+
+def main():
+    batches = [int(b) for b in (sys.argv[1:] or ["8"])]
+    variants = [
+        # r5 combined winner re-measured = this session's baseline
+        dict(head="saved", optimizer="fused-bf16mom",
+             head_bwd="matmul", softmax_shift=None),
+        # route (1): fused head backward, saved + recompute flavors
+        dict(head="saved", optimizer="fused-bf16mom",
+             head_bwd="fused", softmax_shift=None),
+        dict(head="recompute", optimizer="fused-bf16mom",
+             head_bwd="fused", softmax_shift=None),
+        # + the constant-shift forward (the defaults-audit winner)
+        dict(head="saved", optimizer="fused-bf16mom",
+             head_bwd="fused", softmax_shift=16.0),
+        # route (2): the Pallas save-stack writer, on the best config
+        dict(head="saved", optimizer="fused-bf16mom",
+             head_bwd="fused", softmax_shift=16.0,
+             save_stack="pallas"),
+        # shipped-defaults run (must reproduce the headline row)
+        dict(),
+    ]
+    for batch in batches:
+        for v in variants:
+            rec = run_bench("base", 1, 1, 1, batch, steps=10, warmup=3,
+                            windows=3, **v)
+            rec["ab"] = v
+            print(json.dumps(rec), flush=True)
+            with open("train_ab_r6.jsonl", "a") as f:
+                f.write(json.dumps(rec) + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -5,7 +5,7 @@
 PY ?= python
 
 .PHONY: test test-fast chaos bench native clean sweep scaling northstar \
-	trace-demo check
+	trace-demo check decode-smoke
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -42,6 +42,19 @@ check:
 		echo "$$bad"; exit 1; \
 	fi
 	@echo "check OK: no bare print(json.dumps telemetry outside icikit/obs/"
+
+# multi-token decode smoke: a tiny CPU speculative decode under an
+# armed obs session — the acceptance counters/spans must flow and the
+# exported Chrome trace must pass the structural validator (keeps the
+# weights-stationary decode path collected alongside its tier-1 tests)
+decode-smoke:
+	JAX_PLATFORMS=cpu \
+	ICIKIT_OBS="trace=/tmp/icikit_decode_trace.json;metrics=/tmp/icikit_decode_metrics.json;jsonl=off" \
+	$(PY) -m icikit.bench.decode --preset tiny --batch 2 --prompt 8 \
+		--new 12 --speculate 3 --draft-layers 1 --runs 1 > /dev/null
+	$(PY) -m icikit.obs.check /tmp/icikit_decode_trace.json
+	@grep -q "decode.spec.draft_accepted" /tmp/icikit_decode_metrics.json \
+		&& echo "decode-smoke OK: trace valid, acceptance counters present"
 
 bench:
 	$(PY) bench.py

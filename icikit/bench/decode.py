@@ -130,9 +130,76 @@ def measure_hbm_bw(gib: float = 2.0, iters: int = 30,
     return min(bw, nameplate)
 
 
+# v5e decode pass-time model constants (DECODE.md "Multi-token
+# decode"): the measured streaming-read ceiling the weight stream runs
+# at, and the fixed per-pass scaffolding derived from the committed
+# b=1 floor row (0.703 ms at ~374 MB -> t_fix = 0.703 - bytes/BW).
+SPEC_STREAM_GBPS = 700.0
+SPEC_FLOOR_MS = 0.703
+
+
+def spec_bytes_per_iter(cfg, batch: int, cache_len: float, k: int,
+                        draft_layers: int,
+                        vmem_resident: int = VMEM_RESIDENT_BYTES):
+    """HBM bytes one speculative draft+verify iteration reads, split
+    (draft_bytes_total, verify_bytes). The drafter streams the first
+    ``draft_layers`` layers' params + the shared head once per draft
+    token ((k-1)×); the verify pass is byte-identical to one
+    single-token step (same full param + cache read — that k tokens
+    come out of it is the whole point). The VMEM-resident subtraction
+    applies once per pass, exactly as in ``decode_bytes_per_token``."""
+    from icikit.bench.train import matmul_param_count
+    kv_heads = cfg.n_kv_heads or cfg.n_heads
+    head = cfg.vocab * cfg.d_model
+    p_layers = matmul_param_count(cfg) - 2 * head   # minus emb + head
+    cache = 2.0 * (2 * batch * cache_len * kv_heads * cfg.d_head
+                   * cfg.n_layers)
+    frac = draft_layers / cfg.n_layers
+    draft_pass = (max(0.0, 2.0 * (p_layers * frac + head) - vmem_resident)
+                  + cache * frac)
+    verify = decode_bytes_per_token(cfg, batch, cache_len, vmem_resident)
+    return (k - 1) * draft_pass, verify
+
+
+def spec_cost_model(cfg, batch: int, cache_len: float, k: int,
+                    draft_layers: int, tokens_per_step: float,
+                    floor_ms: float = SPEC_FLOOR_MS,
+                    stream_gbps: float = SPEC_STREAM_GBPS) -> dict:
+    """Acceptance-rate × cost model: projected v5e effective ms/token
+    at the MEASURED ``tokens_per_step`` (the device-independent
+    quantity this harness measures wherever it runs).
+
+    Pass-time model: t_pass = t_fix·(L'/L) + bytes/BW, with BW the
+    measured streaming ceiling and t_fix the fixed per-pass
+    scaffolding backed out of the committed b=1 floor row — the
+    layer-proportional share is the round-5 profile's serialized
+    per-layer fusion cost. Fields carry every model input so a future
+    TPU session can re-derive or refute the projection row by row."""
+    bw = stream_gbps * 1e9
+    base_bytes = decode_bytes_per_token(cfg, batch, cache_len)
+    t_fix_ms = max(0.0, floor_ms - base_bytes / bw * 1e3)
+    draft_b, verify_b = spec_bytes_per_iter(cfg, batch, cache_len, k,
+                                            draft_layers)
+    frac = draft_layers / cfg.n_layers
+    t_iter_ms = ((k - 1) * (t_fix_ms * frac) + t_fix_ms
+                 + (draft_b + verify_b) / bw * 1e3)
+    eff = t_iter_ms / tokens_per_step
+    return {
+        "model_stream_gbps": stream_gbps,
+        "model_floor_ms": floor_ms,
+        "model_t_fix_ms": round(t_fix_ms, 4),
+        "model_bytes_iter": draft_b + verify_b,
+        "model_iter_ms": round(t_iter_ms, 4),
+        "projected_eff_ms_per_token": round(eff, 4),
+        "projected_vs_floor": round(eff / floor_ms, 4),
+    }
+
+
 def run_bench(preset: str, dp: int, tp: int, batch: int, prompt_len: int,
               n_new: int, sampling: str = "greedy", runs: int = 3,
-              kv_heads: int = 0, windows: int = 3) -> dict:
+              kv_heads: int = 0, windows: int = 3, speculate: int = 0,
+              draft_layers: int = 0,
+              decode_step: str = "unfused") -> dict:
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -140,19 +207,32 @@ def run_bench(preset: str, dp: int, tp: int, batch: int, prompt_len: int,
 
     from icikit.bench.train import PRESETS
     from icikit.models.transformer import (
-        TransformerConfig, greedy_generate, init_params, sample_generate)
+        TransformerConfig, greedy_generate, init_params, sample_generate,
+        speculative_generate)
+    from icikit.models.transformer.decode import (
+        _resolve_decode_step as _resolve_step)
     from icikit.models.transformer.model import make_model_mesh
     from icikit.utils.timing import fence
 
     over = dict(PRESETS[preset])
-    over["max_seq"] = max(over["max_seq"], prompt_len + n_new)
-    cfg = TransformerConfig(**over, n_kv_heads=kv_heads)
+    over["max_seq"] = max(over["max_seq"],
+                          prompt_len + n_new + 2 * max(0, speculate - 1))
+    cfg = TransformerConfig(**over, n_kv_heads=kv_heads,
+                            decode_step=decode_step)
     mesh = make_model_mesh(dp=dp, tp=tp, sp=1)
     params = init_params(jax.random.key(0), cfg, mesh)
     rng = np.random.default_rng(0)
     sh = NamedSharding(mesh, P("dp", None))
+    if speculate and sampling != "greedy":
+        raise ValueError("--speculate is greedy-only (verify-and-accept "
+                         "is exact prefix matching)")
+    d_layers = draft_layers or max(1, cfg.n_layers // 2)
 
     def gen(prompt, n):
+        if speculate:
+            return speculative_generate(params, prompt, mesh, cfg, n,
+                                        k=speculate,
+                                        draft_layers=d_layers)
         if sampling == "greedy":
             return greedy_generate(params, prompt, mesh, cfg, n)
         return sample_generate(params, prompt, mesh, cfg, n,
@@ -194,9 +274,20 @@ def run_bench(preset: str, dp: int, tp: int, batch: int, prompt_len: int,
     # its parameter+cache bytes faster than nameplate HBM allows.
     from icikit.utils.timing import timeit_windows
     nameplate = hbm_nameplate_bytes()
-    floor_s = (n_new * decode_bytes_per_token(
-        cfg, batch, prompt_len + n_new) / nameplate
-        if nameplate else None)
+    if speculate:
+        # the speculative path's physical floor is NOT the single-token
+        # byte model — a fully-accepted k-window reads (draft + verify)
+        # bytes for k tokens, so its per-token minimum is iter_bytes/k;
+        # clamping spec rows against the single-token floor would
+        # discard a genuinely winning row as "implausibly fast"
+        d_b, v_b = spec_bytes_per_iter(cfg, batch, prompt_len + n_new,
+                                       speculate, d_layers)
+        bytes_per_token_floor = (d_b + v_b) / speculate
+    else:
+        bytes_per_token_floor = decode_bytes_per_token(
+            cfg, batch, prompt_len + n_new)
+    floor_s = (n_new * bytes_per_token_floor / nameplate
+               if nameplate else None)
     res = timeit_windows(lambda prompt: gen(prompt, n_new), (p0,),
                          chain, windows=windows, runs=runs, warmup=1,
                          floor_s=floor_s)
@@ -204,9 +295,41 @@ def run_bench(preset: str, dp: int, tp: int, batch: int, prompt_len: int,
     bw = decode_bytes_per_token(
         cfg, batch, prompt_len + n_new) / per_token_s
     kv_tag = f"_kv{kv_heads}" if kv_heads else ""
+    spec_tag = (f"_spec{speculate}d{d_layers}" if speculate else "")
+    step_tag = ("" if decode_step == "unfused" else f"_{decode_step}")
+    rec_extra = {}
+    if speculate:
+        # one extra generation with the telemetry read: the measured
+        # acceptance rate is the device-independent half of the
+        # acceptance × cost model (DECODE.md "Multi-token decode")
+        _, st = speculative_generate(params, p0, mesh, cfg, n_new,
+                                     k=speculate, draft_layers=d_layers,
+                                     return_stats=True)
+        # achieved read bandwidth under the SPECULATIVE byte model at
+        # the measured acceptance (iter bytes buy tokens_per_step
+        # tokens); the single-token model would overstate it
+        bw = ((d_b + v_b) / st["tokens_per_step"]) / per_token_s
+        rec_extra = {
+            "speculate": speculate,
+            "draft_layers": d_layers,
+            "acceptance_rate": round(st["acceptance_rate"], 4),
+            "tokens_per_step": round(st["tokens_per_step"], 4),
+            "verify_steps": st["verify_steps"],
+            **spec_cost_model(cfg, batch, prompt_len + n_new, speculate,
+                              d_layers, st["tokens_per_step"]),
+        }
     return {
         "metric": f"decode_{preset}_dp{dp}tp{tp}_b{batch}{kv_tag}"
-                  f"_p{prompt_len}_n{n_new}_{sampling}",
+                  f"_p{prompt_len}_n{n_new}_{sampling}"
+                  f"{spec_tag}{step_tag}",
+        "decode_step": decode_step,
+        # the arm that actually ran: an "auto" row on a geometry the
+        # gate rejects falls back to unfused, and analysis must be
+        # able to tell a fused row from a fallback row
+        "decode_step_resolved": ("fused" if _resolve_step(cfg)
+                                 else "unfused"),
+        "backend": jax.default_backend(),
+        **rec_extra,
         "value": round(batch / per_token_s, 1),
         "unit": "tokens/s",
         "per_token_ms": round(per_token_s * 1e3, 3),
@@ -234,7 +357,9 @@ def run_bench(preset: str, dp: int, tp: int, batch: int, prompt_len: int,
 
 def run_sweep(preset: str, batches, prompt_len: int, n_new: int,
               runs: int = 3, kv_heads: int = 0, dp: int = 1,
-              tp: int = 1, sampling: str = "greedy") -> list[dict]:
+              tp: int = 1, sampling: str = "greedy", speculate: int = 0,
+              draft_layers: int = 0,
+              decode_step: str = "unfused") -> list[dict]:
     """Batch sweep against the measured HBM roofline (DECODE.md).
 
     Decode reads all parameters once per *step* regardless of batch, so
@@ -259,7 +384,9 @@ def run_sweep(preset: str, batches, prompt_len: int, n_new: int,
         # floor bounds what a *kernel* can do, not what a noisy probe
         # reports.
         rec = run_bench(preset, dp, tp, b, prompt_len, n_new,
-                        sampling=sampling, runs=runs, kv_heads=kv_heads)
+                        sampling=sampling, runs=runs, kv_heads=kv_heads,
+                        speculate=speculate, draft_layers=draft_layers,
+                        decode_step=decode_step)
         rec["roofline_gbps"] = round(bw_ceiling / 1e9, 1)
         rec["pct_roofline"] = round(
             100.0 * rec["read_gbps"] / (bw_ceiling / 1e9), 1)
@@ -289,6 +416,24 @@ def main(argv=None) -> int:
                     choices=["greedy", "sample"])
     ap.add_argument("--runs", type=int, default=3)
     ap.add_argument("--kv-heads", type=int, default=0)
+    ap.add_argument("--speculate", type=int, default=0, metavar="K",
+                    help="self-speculative multi-token decode with a "
+                         "k-token verify window (greedy only; 0 = "
+                         "baseline single-token decode). Rows carry "
+                         "the measured acceptance rate and the "
+                         "acceptance × cost model projection")
+    ap.add_argument("--draft-layers", type=int, default=0,
+                    help="truncated-depth drafter (default: "
+                         "n_layers // 2)")
+    ap.add_argument("--decode-step", default="unfused",
+                    choices=["auto", "fused", "unfused"],
+                    help="single-token inner step: 'fused' = one "
+                         "Pallas launch per layer (rope + cache write "
+                         "+ flash-decode read), 'unfused' = the JAX "
+                         "formulation, 'auto' = fused on TPU when "
+                         "supported. Default 'unfused' so baseline "
+                         "rows are unambiguous — fused rows opt in "
+                         "and carry the tag")
     ap.add_argument("--sweep", default=None, metavar="B1,B2,...",
                     help="batch sweep vs the measured HBM roofline "
                          "(one JSON line per batch, with pct_roofline; "
@@ -300,11 +445,15 @@ def main(argv=None) -> int:
                          [int(b) for b in args.sweep.split(",")],
                          args.prompt, args.n_new, args.runs,
                          args.kv_heads, args.dp, args.tp,
-                         args.sampling)
+                         args.sampling, args.speculate,
+                         args.draft_layers, args.decode_step)
     else:
         recs = [run_bench(args.preset, args.dp, args.tp, args.batch,
                           args.prompt, args.n_new, args.sampling,
-                          args.runs, args.kv_heads)]
+                          args.runs, args.kv_heads,
+                          speculate=args.speculate,
+                          draft_layers=args.draft_layers,
+                          decode_step=args.decode_step)]
     obs.emit_records(recs)
     if args.json_path:
         # append: record files accumulate across invocations (the

@@ -34,6 +34,12 @@ PEAK_FLOPS = {
 PRESETS = {
     "tiny": dict(vocab=256, d_model=128, n_heads=4, d_head=32, d_ff=512,
                  n_layers=2, max_seq=128),
+    # tiny at the TPU-native head width: the fused decode-step kernel
+    # (ops/flash_attention.decode_step_attention) gates on d_head=128,
+    # so the CPU-runnable decode A/B rows need a d_head=128 geometry
+    # that is still interpreter-sized
+    "tiny128": dict(vocab=256, d_model=128, n_heads=2, d_head=128,
+                    d_ff=512, n_layers=2, max_seq=128),
     # d_head = 128 everywhere: the MXU is a 128x128 systolic array, so
     # QK^T (contraction = d_head) and PV (output width = d_head) both
     # run at half rate at d_head = 64 — measured on v5e, d_head 64 -> 128

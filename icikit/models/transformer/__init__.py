@@ -37,6 +37,9 @@ from icikit.models.transformer.decode import (  # noqa: F401
     greedy_generate,
     sample_generate,
 )
+from icikit.models.transformer.speculative import (  # noqa: F401
+    speculative_generate,
+)
 from icikit.models.transformer.moe import moe_ffn_shard  # noqa: F401
 from icikit.models.transformer.pipeline import (  # noqa: F401
     init_pp_params,

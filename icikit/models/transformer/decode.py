@@ -12,6 +12,33 @@ Token selection is pluggable: greedy argmax (``greedy_generate``) or
 temperature / top-k / nucleus sampling (``sample_generate``, keyed by a
 JAX PRNG key folded with the dp shard index and step, so shards and
 steps draw independently and runs are reproducible).
+
+The per-layer building blocks (projection, attention close, FFN,
+logits head) live in ``_DecodeCtx`` so the weights-stationary
+multi-token path (``speculative.py``) composes the *same* math into
+k-token verify windows instead of duplicating it — one source of
+truth for what a decode layer is.
+
+Two single-token inner-step implementations are selectable via
+``TransformerConfig.decode_step``:
+
+- ``"unfused"`` — the JAX formulation (rope → cache
+  dynamic-update-slice → masked attention), ~8 serialized sub-µs
+  fusions per layer at b=1 (the round-5 profile's scaffolding).
+- ``"fused"`` — one Pallas launch per layer
+  (``ops.flash_attention.decode_step_attention``): RoPE-apply +
+  cache column write + masked flash-decode read collapsed, caches
+  donated in place. MHA-only (see ``decode_step_supported``); forcing
+  it on an unsupported geometry fails loudly.
+- ``"auto"`` — fused on TPU when supported, else unfused (CPU runs
+  the kernel in interpret mode, which is correct but slow — tests opt
+  in explicitly).
+
+The shipped default is ``"unfused"``: the kernel is parity-pinned but
+its TPU wall-time win is unmeasured, and per the defaults-audit rule a
+winner ships as default only with its A/B row (see the
+``TransformerConfig.decode_step`` comment and DECODE.md "Multi-token
+decode").
 """
 
 from __future__ import annotations
@@ -39,7 +66,12 @@ from icikit.models.transformer.model import (
     repeat_kv,
 )
 from icikit.models.transformer.moe import moe_ffn_shard
-from icikit.ops.flash_attention import resolve_attention_impl
+from icikit.ops.flash_attention import (
+    decode_step_attention,
+    decode_step_cache_len,
+    decode_step_supported,
+    resolve_attention_impl,
+)
 from icikit.ops.rope import apply_rope, rope_sincos
 from icikit.parallel.shmap import wrap_program
 
@@ -71,6 +103,33 @@ def _masked_attention(q, ks, vs, mask, scale, n_rep):
     out = jnp.einsum("bgrqk,bkgd->bqgrd", w.astype(vs.dtype), vs,
                      preferred_element_type=jnp.float32)
     return out.reshape(b, one, h, dh).astype(q.dtype)
+
+
+def _window_masked_attention(q, ks, vs, mask, scale, n_rep):
+    """k-token verify-window attention: q (b, w, h, dh) against the
+    un-repeated padded cache ks/vs (b, T, h/n_rep, dh) under a
+    *per-row* mask (b, w, T) — speculative rows sit at different
+    offsets, so the window positions (and with them the causal
+    frontier) vary across the batch. Same grouped-einsum GQA structure
+    as ``_masked_attention``; w is the verify width (≤ k, tiny), so
+    the dense masked read stays the right shape."""
+    b, w_len, h, dh = q.shape
+    if n_rep == 1:
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, ks,
+                            preferred_element_type=jnp.float32) * scale
+        logits = jnp.where(mask[:, None, :, :], logits, NEG_INF)
+        w = jax.nn.softmax(logits, axis=-1)
+        out = jnp.einsum("bhqk,bkhd->bqhd", w.astype(vs.dtype), vs,
+                         preferred_element_type=jnp.float32)
+        return out.astype(q.dtype)
+    qg = q.reshape(b, w_len, h // n_rep, n_rep, dh)
+    logits = jnp.einsum("bqgrd,bkgd->bgrqk", qg, ks,
+                        preferred_element_type=jnp.float32) * scale
+    logits = jnp.where(mask[:, None, None, :, :], logits, NEG_INF)
+    w = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bgrqk,bkgd->bqgrd", w.astype(vs.dtype), vs,
+                     preferred_element_type=jnp.float32)
+    return out.reshape(b, w_len, h, dh).astype(q.dtype)
 
 
 def _top_k_mask(lg, k):
@@ -109,6 +168,156 @@ def _make_selector(sampling):
     return select
 
 
+class _DecodeCtx:
+    """Per-shard decode building blocks, closed over (cfg, mesh)
+    statics — the single source for the layer math shared by the
+    one-token loop, the fused-step loop, and the speculative k-token
+    verify windows. Every method is called *inside* the shard_map
+    program (they use ``lax.axis_index``/``lax.psum``)."""
+
+    def __init__(self, cfg: TransformerConfig, mesh):
+        _check_mesh_cfg(cfg, mesh)
+        self.cfg = cfg
+        self.cdt = jnp.dtype(cfg.compute_dtype)
+        self.scale = cfg.d_head ** -0.5
+        self.n_rep = _n_rep(cfg)
+        self.p_dp = mesh.shape[DP_AXIS]
+        self.layer_keys = _layer_keys(cfg)
+
+    def qkv_proj(self, x, lp):
+        h = _rms_norm(x, lp["ln1"]).astype(self.cdt)
+        return _project_qkv(h, lp, self.cdt)
+
+    def close_attn(self, x, attn, lp):
+        o = jnp.einsum("bshe,hed->bsd", attn.astype(self.cdt),
+                       lp["wo"].astype(self.cdt))
+        return x + lax.psum(o.astype(jnp.float32), TP_AXIS)
+
+    def ffn(self, x, lp):
+        cfg = self.cfg
+        if cfg.n_experts:
+            # Dropless dispatch at decode (capacity = all local tokens):
+            # the training-time capacity drop is a pool-level property
+            # that an incremental decode cannot reproduce, and dropping
+            # tokens at inference only hurts; experts still shard over
+            # dp, carried by the configured all-to-all schedule.
+            h2 = _rms_norm(x, lp["ln2"]).astype(self.cdt)
+            m, _ = moe_ffn_shard(
+                h2, lp["wr"].astype(self.cdt), lp["we1"].astype(self.cdt),
+                lp["we2"].astype(self.cdt), axis=DP_AXIS, p=self.p_dp,
+                n_experts=cfg.n_experts,
+                capacity_factor=float(cfg.n_experts),
+                algorithm=cfg.moe_algorithm)
+            return x + m.astype(jnp.float32)
+        return _dense_ffn_block(x, lp, self.cdt,
+                                lambda v: lax.psum(v, TP_AXIS))
+
+    def logits(self, params, x):
+        """fp32 logits from hidden state ``x (..., D)`` — any leading
+        shape (the one-token loop passes (b, D), the verify window
+        (b, w, D))."""
+        cfg = self.cfg
+        h = _rms_norm(x, params["ln_f"])
+        lg = jnp.einsum("...d,vd->...v", h.astype(self.cdt),
+                        params["w_out"].astype(self.cdt)
+                        ).astype(jnp.float32)
+        if cfg.vocab_parallel:
+            # Reassemble the full row by scattering the local shard
+            # into zeros and psum'ing. This costs ~2x an all_gather's
+            # traffic (ring allreduce vs gather on a (b, V) row — tiny
+            # per step), but psum output is statically tp-invariant:
+            # shard_map's replication check rejects the all_gather form
+            # (its output carries a varying-over-tp tag in this jax).
+            r = lax.axis_index(TP_AXIS)
+            v_loc = lg.shape[-1]
+            full = jnp.zeros(lg.shape[:-1] + (cfg.vocab,), jnp.float32)
+            start = (0,) * (lg.ndim - 1) + (r * v_loc,)
+            full = lax.dynamic_update_slice(full, lg, start)
+            lg = lax.psum(full, TP_AXIS)
+        return lg
+
+    def embed(self, params, tokens, positions):
+        """Token embedding (+ learned positional rows when configured).
+        ``tokens``/``positions``: (b, w) — positions may vary per row
+        (the speculative path)."""
+        x = params["emb"][tokens]
+        if self.cfg.pos_encoding == "learned":
+            x = x + params["pos"][positions]
+        return x
+
+
+def _prefill(ctx: _DecodeCtx, params, prompt, s_prompt: int, total: int,
+             fused: bool):
+    """Full causal forward over the prompt, returning the final hidden
+    states ``x (b, s, D)`` and the padded per-layer K/V caches stacked
+    on dim 0. Cache layout: ``(L, b, total, hkv, dh)`` for the JAX
+    step, ``(L, b*h, total, dh)`` (heads flattened into rows) for the
+    fused Pallas step — the layout its grid addresses directly."""
+    cfg = ctx.cfg
+    b = prompt.shape[0]
+    lp = {k: params[k] for k in ctx.layer_keys}
+    x = ctx.embed(params, prompt,
+                  jnp.broadcast_to(jnp.arange(s_prompt), prompt.shape))
+
+    def prefill_layer(x, lp1):
+        q, k, v = ctx.qkv_proj(x, lp1)
+        if cfg.pos_encoding == "rope":
+            # the cache stores rotated keys, as every step's are
+            pos = jnp.arange(s_prompt)
+            q = apply_rope(q, pos, cfg.rope_theta)
+            k = apply_rope(k, pos, cfg.rope_theta)
+        # Attend over the prompt's own K/V only; the total-length
+        # zero padding exists solely for the scan-carry cache shape.
+        # GQA: the cache keeps the n_kv_heads projections; repeat
+        # serves the query-head groups at attention time only.
+        # cfg.attention_impl routes long prompts through the fused
+        # kernel (tiny/odd prompt lengths fall back to the oracle).
+        attn = resolve_attention_impl(cfg.attention_impl)(
+            q, repeat_kv(k, ctx.n_rep), repeat_kv(v, ctx.n_rep),
+            causal=True, scale=ctx.scale)
+        x = ctx.close_attn(x, attn, lp1)
+        x = ctx.ffn(x, lp1)
+        if fused:
+            # (b, s, h, dh) -> rows = b*h, columns = positions
+            h = k.shape[2]
+            kr = k.transpose(0, 2, 1, 3).reshape(b * h, s_prompt, -1)
+            vr = v.transpose(0, 2, 1, 3).reshape(b * h, s_prompt, -1)
+            ks = jnp.zeros((b * h, total, k.shape[3]), k.dtype)
+            vs = jnp.zeros_like(ks)
+            ks = lax.dynamic_update_slice_in_dim(ks, kr, 0, 1)
+            vs = lax.dynamic_update_slice_in_dim(vs, vr, 0, 1)
+        else:
+            ks = jnp.zeros((b, total) + k.shape[2:], k.dtype)
+            vs = jnp.zeros_like(ks)
+            ks = lax.dynamic_update_slice_in_dim(ks, k, 0, 1)
+            vs = lax.dynamic_update_slice_in_dim(vs, v, 0, 1)
+        return x, (ks, vs)
+
+    return lax.scan(prefill_layer, x, lp)
+
+
+def _resolve_decode_step(cfg: TransformerConfig) -> bool:
+    """True when the generate program should use the fused Pallas
+    inner step. ``"auto"`` arms it only on TPU (CPU would run the
+    interpreter on the hot loop); ``"fused"`` forces it — and fails
+    loudly when the gate rejects the geometry, so an A/B can never
+    silently measure the fallback. (Mode-name validation lives in
+    ``_check_cfg`` — the single gate at config construction.)"""
+    mode = cfg.decode_step
+    if mode == "unfused":
+        return False
+    ok = decode_step_supported(cfg.d_head, _n_rep(cfg),
+                               jnp.dtype(cfg.compute_dtype))
+    if mode == "fused":
+        if not ok:
+            raise ValueError(
+                "decode_step='fused' but the kernel gate rejects this "
+                f"config (d_head={cfg.d_head}, n_rep={_n_rep(cfg)}) — "
+                "MHA with d_head % 128 == 0 required")
+        return True
+    return ok and jax.default_backend() == "tpu"
+
+
 @lru_cache(maxsize=None)
 def _build_generate(mesh, cfg: TransformerConfig, s_prompt: int, n_new: int,
                     sampling: tuple = ("greedy",)):
@@ -118,61 +327,17 @@ def _build_generate(mesh, cfg: TransformerConfig, s_prompt: int, n_new: int,
     if mesh.shape[SP_AXIS] != 1:
         raise ValueError("decoding requires sp=1 (sequence is not "
                          "sharded at decode time)")
-    cdt = jnp.dtype(cfg.compute_dtype)
     total = s_prompt + n_new
     if total > cfg.max_seq:
         raise ValueError(f"prompt + new tokens = {total} exceeds "
                          f"max_seq = {cfg.max_seq}")
-    scale = cfg.d_head ** -0.5
-    _check_mesh_cfg(cfg, mesh)
-    n_rep = _n_rep(cfg)
-    p_dp = mesh.shape[DP_AXIS]
-    layer_keys = _layer_keys(cfg)
-
-    def qkv_proj(x, lp):
-        h = _rms_norm(x, lp["ln1"]).astype(cdt)
-        return _project_qkv(h, lp, cdt)
-
-    def close_attn(x, attn, lp):
-        o = jnp.einsum("bshe,hed->bsd", attn.astype(cdt),
-                       lp["wo"].astype(cdt))
-        return x + lax.psum(o.astype(jnp.float32), TP_AXIS)
-
-    def ffn(x, lp):
-        if cfg.n_experts:
-            # Dropless dispatch at decode (capacity = all local tokens):
-            # the training-time capacity drop is a pool-level property
-            # that an incremental decode cannot reproduce, and dropping
-            # tokens at inference only hurts; experts still shard over
-            # dp, carried by the configured all-to-all schedule.
-            h2 = _rms_norm(x, lp["ln2"]).astype(cdt)
-            m, _ = moe_ffn_shard(
-                h2, lp["wr"].astype(cdt), lp["we1"].astype(cdt),
-                lp["we2"].astype(cdt), axis=DP_AXIS, p=p_dp,
-                n_experts=cfg.n_experts,
-                capacity_factor=float(cfg.n_experts),
-                algorithm=cfg.moe_algorithm)
-            return x + m.astype(jnp.float32)
-        return _dense_ffn_block(x, lp, cdt,
-                                lambda v: lax.psum(v, TP_AXIS))
-
-    def logits_last(params, x_last):
-        h = _rms_norm(x_last, params["ln_f"])
-        lg = jnp.einsum("bd,vd->bv", h.astype(cdt),
-                        params["w_out"].astype(cdt)).astype(jnp.float32)
-        if cfg.vocab_parallel:
-            # Reassemble the full row by scattering the local shard
-            # into zeros and psum'ing. This costs ~2x an all_gather's
-            # traffic (ring allreduce vs gather on a (b, V) row — tiny
-            # per step), but psum output is statically tp-invariant:
-            # shard_map's replication check rejects the all_gather form
-            # (its output carries a varying-over-tp tag in this jax).
-            r = lax.axis_index(TP_AXIS)
-            v_loc = lg.shape[1]
-            full = jnp.zeros((lg.shape[0], cfg.vocab), jnp.float32)
-            full = lax.dynamic_update_slice(full, lg, (0, r * v_loc))
-            lg = lax.psum(full, TP_AXIS)
-        return lg
+    ctx = _DecodeCtx(cfg, mesh)
+    fused = _resolve_decode_step(cfg)
+    # the fused kernel's cache block wants a sublane-divisible column
+    # count; the pad columns are dead (masked, never written)
+    cache_len = (decode_step_cache_len(total, ctx.cdt) if fused
+                 else total)
+    layer_keys = ctx.layer_keys
 
     def per_shard(params, prompt, key_data, knobs):
         b = prompt.shape[0]
@@ -182,37 +347,9 @@ def _build_generate(mesh, cfg: TransformerConfig, s_prompt: int, n_new: int,
         key = jax.random.fold_in(jax.random.wrap_key_data(key_data),
                                  lax.axis_index(DP_AXIS))
 
-        # --- prefill: full causal forward, caching padded K/V.
-        x = params["emb"][prompt]
-        if cfg.pos_encoding == "learned":
-            x = x + params["pos"][:s_prompt]
-
-        def prefill_layer(x, lp1):
-            q, k, v = qkv_proj(x, lp1)
-            if cfg.pos_encoding == "rope":
-                # the cache stores rotated keys, as every step's are
-                pos = jnp.arange(s_prompt)
-                q = apply_rope(q, pos, cfg.rope_theta)
-                k = apply_rope(k, pos, cfg.rope_theta)
-            # Attend over the prompt's own K/V only; the total-length
-            # zero padding exists solely for the scan-carry cache shape.
-            # GQA: the cache keeps the n_kv_heads projections; repeat
-            # serves the query-head groups at attention time only.
-            # cfg.attention_impl routes long prompts through the fused
-            # kernel (tiny/odd prompt lengths fall back to the oracle).
-            attn = resolve_attention_impl(cfg.attention_impl)(
-                q, repeat_kv(k, n_rep), repeat_kv(v, n_rep),
-                causal=True, scale=scale)
-            x = close_attn(x, attn, lp1)
-            x = ffn(x, lp1)
-            ks = jnp.zeros((b, total) + k.shape[2:], k.dtype)
-            vs = jnp.zeros_like(ks)
-            ks = lax.dynamic_update_slice_in_dim(ks, k, 0, 1)
-            vs = lax.dynamic_update_slice_in_dim(vs, v, 0, 1)
-            return x, (ks, vs)
-
-        x, (kcache, vcache) = lax.scan(prefill_layer, x, lp)
-        tok0 = select(logits_last(params, x[:, -1]),
+        x, (kcache, vcache) = _prefill(ctx, params, prompt, s_prompt,
+                                       cache_len, fused)
+        tok0 = select(ctx.logits(params, x[:, -1]),
                       jax.random.fold_in(key, 0), knobs)
 
         # --- decode loop: one position at a time against the cache.
@@ -243,22 +380,48 @@ def _build_generate(mesh, cfg: TransformerConfig, s_prompt: int, n_new: int,
             mask = jnp.arange(total) <= cur
             sincos = (rope_sincos(cur[None], cfg.d_head, cfg.rope_theta)
                       if cfg.pos_encoding == "rope" else None)
+            if fused:
+                # duplicated tables: the kernel's split-half rotation
+                # is two fmas against concat([c, c]) / concat([s, s])
+                if sincos is not None:
+                    cos2 = jnp.concatenate([sincos[0], sincos[0]], -1)
+                    sin2 = jnp.concatenate([sincos[1], sincos[1]], -1)
+                else:
+                    cos2 = jnp.ones((1, cfg.d_head), jnp.float32)
+                    sin2 = jnp.zeros((1, cfg.d_head), jnp.float32)
             kc2, vc2 = [], []
             for li in range(n_layers):
                 lp1 = {kk: lp[kk][li] for kk in layer_keys}
-                q, k, v = qkv_proj(x, lp1)
-                if cfg.pos_encoding == "rope":
-                    pos = cur[None]
-                    q = apply_rope(q, pos, cfg.rope_theta, sincos)
-                    k = apply_rope(k, pos, cfg.rope_theta, sincos)
-                ks = lax.dynamic_update_slice_in_dim(kc[li], k, cur, 1)
-                vs = lax.dynamic_update_slice_in_dim(vc[li], v, cur, 1)
-                attn = _masked_attention(q, ks, vs, mask, scale, n_rep)
-                x = close_attn(x, attn, lp1)
-                x = ffn(x, lp1)
+                q, k, v = ctx.qkv_proj(x, lp1)
+                if fused:
+                    # one Pallas launch: rope + cache column write +
+                    # masked flash-decode read (rope applied in-kernel)
+                    h_loc = q.shape[2]
+                    dh = q.shape[3]
+                    attn, ks, vs = decode_step_attention(
+                        q.reshape(b * h_loc, dh),
+                        k.reshape(b * h_loc, dh),
+                        v.reshape(b * h_loc, dh),
+                        kc[li], vc[li], cur, cos2, sin2,
+                        scale=ctx.scale,
+                        rope=cfg.pos_encoding == "rope")
+                    attn = attn.reshape(b, 1, h_loc, dh)
+                else:
+                    if cfg.pos_encoding == "rope":
+                        pos = cur[None]
+                        q = apply_rope(q, pos, cfg.rope_theta, sincos)
+                        k = apply_rope(k, pos, cfg.rope_theta, sincos)
+                    ks = lax.dynamic_update_slice_in_dim(kc[li], k,
+                                                         cur, 1)
+                    vs = lax.dynamic_update_slice_in_dim(vc[li], v,
+                                                         cur, 1)
+                    attn = _masked_attention(q, ks, vs, mask, ctx.scale,
+                                             ctx.n_rep)
+                x = ctx.close_attn(x, attn, lp1)
+                x = ctx.ffn(x, lp1)
                 kc2.append(ks)
                 vc2.append(vs)
-            nxt = select(logits_last(params, x[:, 0]),
+            nxt = select(ctx.logits(params, x[:, 0]),
                          jax.random.fold_in(key, i + 1), knobs)
             return (nxt, tuple(kc2), tuple(vc2)), token
 

@@ -129,6 +129,19 @@ class TransformerConfig:
     # the default stays "xla" with the attempt reachable; see
     # docs/DESIGN.md "Round-6".
     save_stack: str = "xla"
+    # Single-token decode inner step: "unfused" (JAX rope + cache
+    # dynamic-update-slice + masked attention — ~8 serialized sub-µs
+    # fusions per layer at b=1, the round-5 scaffolding), "fused" (one
+    # Pallas launch per layer, ops/flash_attention.decode_step_attention
+    # — MHA-only, caches donated in place; fails loudly off-gate), or
+    # "auto" (fused on TPU when the gate accepts the geometry, unfused
+    # elsewhere). Default "unfused": the kernel is parity-pinned but
+    # its TPU wall-time win is UNMEASURED (this round's session was
+    # CPU-only — interpret-mode rows in decode_spec_r7.jsonl measure
+    # the interpreter, not Mosaic); per the defaults-audit discipline
+    # a winner ships as default only with its A/B row. See DECODE.md
+    # "Multi-token decode".
+    decode_step: str = "unfused"
     # Sequence-parallel schedule for sp > 1: "ring" (neighbor ppermute
     # K/V rotation, any sequence length) or "ulysses" (all-to-all
     # head<->sequence re-shard; needs n_heads/tp divisible by sp).
@@ -191,6 +204,9 @@ def _check_cfg(cfg: TransformerConfig) -> None:
     if cfg.save_stack not in ("xla", "pallas"):
         raise ValueError(f"unknown save_stack {cfg.save_stack!r} "
                          "(known: xla, pallas)")
+    if cfg.decode_step not in ("auto", "fused", "unfused"):
+        raise ValueError(f"unknown decode_step {cfg.decode_step!r} "
+                         "(known: auto, fused, unfused)")
 
 
 def _is_gqa(cfg: TransformerConfig) -> bool:
@@ -688,12 +704,49 @@ class FusedAdam:
                 jnp.zeros((), jnp.int32))
 
 
-def make_train_step(mesh, cfg: TransformerConfig, optimizer=None):
+def _grads_finite(loss, grads):
+    """On-device finiteness sentinel: one scalar ``bool`` that is True
+    iff the loss AND every floating gradient leaf are finite. The
+    per-leaf ``isfinite`` all-reductions are tiny elementwise scans
+    XLA fuses into the gradient writes — the whole check adds no HBM
+    pass and, crucially, no host sync (ROADMAP "Anomaly guard below
+    the loss sentinel": the host-side guard pays a device fence every
+    step to inspect the loss; this catches non-finite *grads* in the
+    same step for free)."""
+    ok = jnp.isfinite(loss)
+    for g in jax.tree.leaves(grads):
+        if jnp.issubdtype(g.dtype, jnp.floating):
+            ok = ok & jnp.isfinite(g).all()
+    return ok
+
+
+def _select_tree(ok, new, old):
+    """Per-leaf ``where(ok, new, old)`` — the on-device skip: a step
+    whose gradients went non-finite commits NOTHING (params and
+    optimizer state hold), so poisoned updates can never be adopted
+    regardless of when the host looks."""
+    return jax.tree.map(
+        lambda n, o: jnp.where(ok, n, o), new, old)
+
+
+def make_train_step(mesh, cfg: TransformerConfig, optimizer=None,
+                    guard: str = "none"):
     """Jitted full training step: (params, opt_state, tokens, targets)
     -> (params, opt_state, loss). ``optimizer`` is any optax
     GradientTransformation (default: adam(3e-4)), or a ``FusedAdam``
-    for the one-pass fused-kernel optimizer tail."""
+    for the one-pass fused-kernel optimizer tail.
+
+    ``guard="device"`` fuses an on-device ``isfinite`` reduction over
+    the loss and every gradient leaf into the step: the update is
+    committed through a ``where(ok, new, old)`` select, so a
+    non-finite step is skipped ON DEVICE in the same step — no host
+    sync — and the step returns a fourth output, the ``ok`` bool
+    scalar, which callers may inspect lazily (e.g. only at logging
+    fences). ``guard="none"`` keeps the historical 3-tuple."""
     import optax
+    if guard not in ("none", "device"):
+        raise ValueError(f"unknown guard {guard!r} "
+                         "(known: none, device)")
     if optimizer is None:
         optimizer = optax.adam(3e-4)
     if cfg.grad_dtype not in ("compute", "float32"):
@@ -756,6 +809,12 @@ def make_train_step(mesh, cfg: TransformerConfig, optimizer=None):
                 out_specs=(pspecs, pspecs, pspecs))
             new_p, new_m, new_v = apply(params, m, v, grads,
                                         jnp.asarray(lr, jnp.float32), t)
+            if guard == "device":
+                ok = _grads_finite(loss, grads)
+                new_p, new_st = _select_tree(
+                    ok, (new_p, (new_m, new_v, t)),
+                    (params, opt_state))
+                return new_p, new_st, loss, ok
             return new_p, (new_m, new_v, t), loss
 
         return optimizer, fused_step
@@ -770,7 +829,13 @@ def make_train_step(mesh, cfg: TransformerConfig, optimizer=None):
         grads = jax.tree.map(
             lambda g: g.astype(jnp.float32)
             if jnp.issubdtype(g.dtype, jnp.floating) else g, grads)
-        updates, opt_state = optimizer.update(grads, opt_state, params)
-        return optax.apply_updates(params, updates), opt_state, loss
+        updates, new_opt = optimizer.update(grads, opt_state, params)
+        new_params = optax.apply_updates(params, updates)
+        if guard == "device":
+            ok = _grads_finite(loss, grads)
+            new_params, new_opt = _select_tree(
+                ok, (new_params, new_opt), (params, opt_state))
+            return new_params, new_opt, loss, ok
+        return new_params, new_opt, loss
 
     return optimizer, step

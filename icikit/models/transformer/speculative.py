@@ -1,0 +1,294 @@
+"""Weights-stationary multi-token decode: self-speculative draft +
+k-token verify (greedy).
+
+Why: decode b=1 is HBM-read-bound — every single-token step streams
+the whole matmul parameter set to produce ONE token, and the round-5
+ablation pinned the b=1 floor at 69% of nameplate with the weight
+stream itself already at the measured streaming ceiling (DECODE.md).
+The only lever left is *serving structure*: make one weight pass
+produce several tokens. This module is that lever, the standard
+production-inference move (Leviathan et al., ICML 2023 speculative
+decoding, on Pope et al.'s MLSys 2023 batched-inference roofline
+framing), specialized to greedy decode where verification is exact
+prefix matching:
+
+- **Self-speculative drafter** — the first ``draft_layers`` of the
+  SAME stacked weights with the shared ``ln_f``/``w_out`` head (no
+  second model). Because layer ``l``'s K/V for a committed position
+  depends only on layers ``< l``, the drafter reuses the main KV cache
+  for its truncated depth — no second cache, no extra memory.
+- **k-token verify step** — the pending token plus ``k−1`` draft
+  tokens run through the full stacked-layer forward in ONE pass
+  (causal inside the window, one weight read per k tokens instead of
+  per token), writing k cache columns and yielding the model's greedy
+  choice after every window prefix.
+- **Verify-and-accept on device** — longest-prefix match inside the
+  jitted while-loop (no per-token host sync): ``m`` matching drafts
+  commit ``m+1`` tokens (the model's correction/extension after the
+  matched prefix rides along free). Rejected columns beyond the
+  accepted frontier stay in the cache but are causally masked and
+  overwritten when reached — the cache cursor is the source of truth.
+
+Greedy equivalence is exact, not approximate: every committed token is
+the full model's argmax conditioned on the committed prefix, so the
+output is token-identical to ``greedy_generate`` for ANY ``k`` and
+draft depth (pinned by ``tests/test_speculative.py``). Acceptance
+counters flow through ``icikit.obs`` (one device read per generation,
+after the loop).
+
+Batching: rows accept different counts per step, so positions, masks
+and output offsets are per-row; finished rows freeze (their state
+re-commits identical values) until the slowest row reaches ``n_new``.
+
+Restrictions: greedy only (sampled speculative needs rejection-
+sampling bookkeeping — out of scope), ``sp = 1`` (as all decoding),
+and no MoE (``n_experts > 0`` routes tokens over a dp all-to-all
+inside the layer, which would deadlock under the per-shard-divergent
+while-loop trip counts).
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from icikit import obs
+from icikit.models.transformer.decode import (
+    _DecodeCtx,
+    _prefill,
+    _window_masked_attention,
+)
+from icikit.models.transformer.model import (
+    DP_AXIS,
+    SP_AXIS,
+    TransformerConfig,
+    param_specs,
+)
+from icikit.ops.rope import apply_rope, rope_sincos
+from icikit.parallel.shmap import wrap_program
+
+# stats vector layout (int32): one device read per generation
+_N_STATS = 3
+_S_ITERS, _S_ROW_STEPS, _S_ACCEPTED = range(_N_STATS)
+
+
+def _row_update(cache, upd, starts):
+    """Per-row window write: ``cache (b, T, ...)``, ``upd (b, w, ...)``
+    written at row-specific column ``starts (b,)`` — rows sit at
+    different offsets once acceptance diverges."""
+    return jax.vmap(
+        lambda c, u, s: lax.dynamic_update_slice_in_dim(c, u, s, 0))(
+        cache, upd, starts)
+
+
+def _window_pass(ctx: _DecodeCtx, params, lp, kc, vc, toks, cur,
+                 layers, cache_len: int):
+    """Run window ``toks (b, w)`` at per-row positions ``cur..cur+w-1``
+    through ``layers`` (a range — the drafter passes the truncated
+    prefix, verify the full stack), writing w cache columns per layer.
+    Returns (hidden (b, w, D) fp32-stream, kc', vc')."""
+    cfg = ctx.cfg
+    b, w = toks.shape
+    pos = cur[:, None] + jnp.arange(w)[None, :]          # (b, w)
+    x = ctx.embed(params, toks, pos)
+    sincos = (rope_sincos(pos, cfg.d_head, cfg.rope_theta)
+              if cfg.pos_encoding == "rope" else None)
+    # per-row causal frontier: window query i sees cache column t iff
+    # t <= cur_row + i — committed prefix plus the window's own prefix
+    mask = (jnp.arange(cache_len)[None, None, :] <= pos[:, :, None])
+    kc2, vc2 = list(kc), list(vc)
+    for li in layers:
+        lp1 = {kk: lp[kk][li] for kk in ctx.layer_keys}
+        q, k, v = ctx.qkv_proj(x, lp1)
+        if sincos is not None:
+            q = apply_rope(q, pos, cfg.rope_theta, sincos)
+            k = apply_rope(k, pos, cfg.rope_theta, sincos)
+        ks = _row_update(kc2[li], k, cur)
+        vs = _row_update(vc2[li], v, cur)
+        attn = _window_masked_attention(q, ks, vs, mask, ctx.scale,
+                                        ctx.n_rep)
+        x = ctx.close_attn(x, attn, lp1)
+        x = ctx.ffn(x, lp1)
+        kc2[li], vc2[li] = ks, vs
+    return x, tuple(kc2), tuple(vc2)
+
+
+@lru_cache(maxsize=None)
+def _build_speculative(mesh, cfg: TransformerConfig, s_prompt: int,
+                       n_new: int, k: int, draft_layers: int):
+    if n_new < 1:
+        raise ValueError(f"n_new must be >= 1, got {n_new}")
+    if k < 1:
+        raise ValueError(f"k must be >= 1, got {k}")
+    if not 1 <= draft_layers <= cfg.n_layers:
+        raise ValueError(f"draft_layers={draft_layers} must be in "
+                         f"[1, n_layers={cfg.n_layers}]")
+    if mesh.shape[SP_AXIS] != 1:
+        raise ValueError("decoding requires sp=1 (sequence is not "
+                         "sharded at decode time)")
+    if cfg.n_experts:
+        raise ValueError(
+            "speculative decode does not support MoE (n_experts > 0): "
+            "expert dispatch is a dp all-to-all inside the layer and "
+            "the accept loop's trip count diverges across dp shards")
+    # rows can overshoot n_new by up to k-1 committed-then-discarded
+    # tokens (max frozen cursor = s_prompt + n_new + k - 2), and a
+    # FROZEN row keeps re-running its window — its writes land at
+    # cursor..cursor+k-1 and must stay in bounds WITHOUT the
+    # dynamic-update-slice start clamp kicking in: a clamped write
+    # would stomp committed cache columns with wrong-position K/V.
+    # Padding by 2(k-1) keeps every frozen re-write beyond the row's
+    # committed frontier, so freezing really does re-commit identical
+    # values (and, for learned positions, every gather stays inside
+    # the table).
+    cache_len = s_prompt + n_new + 2 * (k - 1)
+    if cache_len > cfg.max_seq:
+        raise ValueError(
+            f"prompt + new + 2(k-1) = {cache_len} exceeds max_seq = "
+            f"{cfg.max_seq} (the verify window overshoots by up to "
+            "k-1 and frozen rows re-write one window beyond that)")
+    ctx = _DecodeCtx(cfg, mesh)
+    n_layers = cfg.n_layers
+    W = n_new + k  # output buffer: active writes end < n_new-1+k,
+    #                frozen rows park their k-wide write at n_new
+
+    def per_shard(params, prompt):
+        b = prompt.shape[0]
+        lp = {kk: params[kk] for kk in ctx.layer_keys}
+        x, (kcache, vcache) = _prefill(ctx, params, prompt, s_prompt,
+                                       cache_len, fused=False)
+        kc = tuple(kcache[li] for li in range(n_layers))
+        vc = tuple(vcache[li] for li in range(n_layers))
+        tok0 = jnp.argmax(ctx.logits(params, x[:, -1]), axis=-1)
+
+        out = jnp.zeros((b, W), jnp.int32).at[:, 0].set(
+            tok0.astype(jnp.int32))
+        init = (tok0.astype(jnp.int32),                  # pending token
+                jnp.full((b,), s_prompt, jnp.int32),     # its position
+                jnp.ones((b,), jnp.int32),               # tokens done
+                out, kc, vc,
+                jnp.zeros((_N_STATS,), jnp.int32))
+
+        def cond(carry):
+            _, _, n_done, *_ = carry
+            return jnp.any(n_done < n_new)
+
+        def body(carry):
+            tok, cur, n_done, out, kc, vc, stats = carry
+            active = n_done < n_new                      # (b,) bool
+
+            # --- draft: k-1 greedy single-token steps through the
+            # first draft_layers of the SAME weights (shared head),
+            # writing their truncated-depth K/V into the shared cache
+            # (identical to what verify recomputes for those layers)
+            drafts = []
+            t, c = tok, cur
+            for _ in range(k - 1):
+                x, kc, vc = _window_pass(ctx, params, lp, kc, vc,
+                                         t[:, None], c,
+                                         range(draft_layers), cache_len)
+                t = jnp.argmax(ctx.logits(params, x[:, 0]),
+                               axis=-1).astype(jnp.int32)
+                drafts.append(t)
+                c = c + 1
+
+            # --- verify: the pending token + k-1 drafts in ONE
+            # stacked-layer pass — all matmul weights read once per
+            # k-token window (the weights-stationary step)
+            w_toks = jnp.stack([tok, *drafts], axis=1)   # (b, k)
+            x, kc, vc = _window_pass(ctx, params, lp, kc, vc, w_toks,
+                                     cur, range(n_layers), cache_len)
+            g = jnp.argmax(ctx.logits(params, x),
+                           axis=-1).astype(jnp.int32)    # (b, k)
+
+            # longest accepted prefix: draft j is right iff it equals
+            # the model's choice after the previous window prefix
+            matches = (w_toks[:, 1:] == g[:, :-1])       # (b, k-1)
+            m = jnp.cumprod(matches.astype(jnp.int32),
+                            axis=1).sum(axis=1)          # (b,)
+            a = jnp.where(active, m + 1, 0)              # committed now
+            new_tok = jnp.take_along_axis(g, m[:, None], axis=1)[:, 0]
+
+            # commit g[:, :m+1] at the row's output offset (the tail of
+            # the k-wide write is overwritten by the next iteration);
+            # frozen rows park their write in the discard zone at n_new
+            start = jnp.where(active, n_done, n_new)
+            out = _row_update(out, g, start)
+
+            stats = stats + jnp.stack([
+                jnp.int32(1),
+                active.sum().astype(jnp.int32),
+                jnp.where(active, m, 0).sum().astype(jnp.int32)])
+            return (jnp.where(active, new_tok, tok), cur + a,
+                    n_done + a, out, kc, vc, stats)
+
+        (_, _, _, out, _, _, stats) = lax.while_loop(cond, body, init)
+        stats = lax.psum(stats, DP_AXIS)
+        return (jnp.concatenate(
+            [prompt, out[:, :n_new].astype(prompt.dtype)], axis=1),
+            stats)
+
+    return wrap_program(per_shard, mesh,
+                        (param_specs(cfg), P(DP_AXIS, None)),
+                        (P(DP_AXIS, None), P()))
+
+
+def speculative_generate(params, prompt, mesh, cfg: TransformerConfig,
+                         n_new: int, k: int = 4,
+                         draft_layers: int | None = None,
+                         return_stats: bool = False):
+    """Greedy continuation via self-speculative multi-token decode.
+
+    Token-identical to ``greedy_generate(params, prompt, mesh, cfg,
+    n_new)`` for any ``k``/``draft_layers`` — the speculation changes
+    the *cost structure* (weights read once per accepted window, not
+    once per token), never the sampled sequence.
+
+    Args:
+      k: verify-window width — 1 pending + ``k-1`` draft tokens per
+        weights pass (``k=1`` degenerates to baseline single-token).
+      draft_layers: truncated drafter depth (default ``n_layers // 2``,
+        min 1). ``draft_layers == n_layers`` makes the drafter exact
+        and the acceptance rate 1.0 (every step commits k tokens).
+      return_stats: also return the acceptance telemetry dict.
+
+    Acceptance counters flow through ``icikit.obs``
+    (``decode.spec.*`` counters + an ``acceptance`` observation) —
+    one device readback per *generation*, after the jitted loop; the
+    accept/commit logic itself runs on device.
+    """
+    if draft_layers is None:
+        draft_layers = max(1, cfg.n_layers // 2)
+    with obs.span("decode.speculative", k=k, draft_layers=draft_layers,
+                  n_new=n_new):
+        toks, stats = _build_speculative(
+            mesh, cfg, prompt.shape[1], n_new, int(k),
+            int(draft_layers))(params, prompt)
+        s = np.asarray(stats)
+    steps = int(s[_S_ITERS])
+    row_steps = int(s[_S_ROW_STEPS])
+    accepted = int(s[_S_ACCEPTED])
+    proposed = row_steps * (k - 1)
+    obs.count("decode.spec.verify_steps", steps)
+    obs.count("decode.spec.draft_proposed", proposed)
+    obs.count("decode.spec.draft_accepted", accepted)
+    acceptance = accepted / proposed if proposed else 1.0
+    obs.observe("decode.spec.acceptance", acceptance)
+    if not return_stats:
+        return toks
+    return toks, {
+        "verify_steps": steps,
+        "row_steps": row_steps,
+        "draft_proposed": proposed,
+        "draft_accepted": accepted,
+        "acceptance_rate": acceptance,
+        # committed tokens per weights pass per row — the
+        # weights-stationarity figure the cost model consumes
+        "tokens_per_step": ((accepted + row_steps) / row_steps
+                            if row_steps else float(k)),
+    }

@@ -983,3 +983,148 @@ def resolve_attention_impl(name: str):
         raise ValueError(f"unknown attention impl {name!r} "
                          f"(known: {', '.join(sorted(impls))})")
     return impls[name]
+
+
+# ----------------------------------------------------- fused decode step
+
+def _rope_rotate(x, cos2, sin2, dh):
+    """Split-half RoPE on a (rows, dh) tile: ``cos2``/``sin2`` are the
+    duplicated tables ``concat([c, c])``/``concat([s, s])`` (1, dh), so
+    the rotation is two fmas plus one half-lane swap."""
+    x32 = x.astype(jnp.float32)
+    h = dh // 2
+    rot = jnp.concatenate([-x32[:, h:], x32[:, :h]], axis=1)
+    return x32 * cos2 + rot * sin2
+
+
+def _decode_step_kernel(cur_ref, q_ref, k_ref, v_ref, cos_ref, sin_ref,
+                        kc_ref, vc_ref, o_ref, ko_ref, vo_ref, *,
+                        scale, rope, total, dh):
+    """One (batch*head) row of the fused decode attention inner step:
+    RoPE-apply on the new q/k, KV-cache column write at ``cur``, and
+    the masked flash-decode read — the ops the round-5 profile charged
+    ~8 serialized sub-µs fusions per layer at b=1 (DECODE.md),
+    collapsed into one kernel launch per layer.
+
+    The cache rides twice: as a read-only VMEM input block (the
+    attention operand) and as a 1-row *aliased* output block addressed
+    by the scalar-prefetched ``cur`` (stack_write's discipline), so the
+    HBM write-back per step is one (1, dh) row, not the whole cache.
+    The just-written column therefore isn't in the input block — its
+    logit/value contributions are patched in from the fresh q/k/v
+    registers instead (``t == cur`` select below)."""
+    cur = cur_ref[0]
+    q = q_ref[...]                                   # (1, dh)
+    k = k_ref[...]
+    v = v_ref[...]
+    if rope:
+        cos2, sin2 = cos_ref[...], sin_ref[...]
+        q = _rope_rotate(q, cos2, sin2, dh).astype(q_ref.dtype)
+        k = _rope_rotate(k, cos2, sin2, dh).astype(k_ref.dtype)
+    ko_ref[0] = k.astype(ko_ref.dtype)               # cache column write
+    vo_ref[0] = v.astype(vo_ref.dtype)
+    kc = kc_ref[0]                                   # (total, dh), stale
+    raw = lax.dot_general(q, kc, (((1,), (1,)), ((), ())),
+                          preferred_element_type=jnp.float32)   # (1, T)
+    qk = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                         preferred_element_type=jnp.float32)    # (1, 1)
+    t_idx = lax.broadcasted_iota(jnp.int32, (1, total), 1)
+    logits = jnp.where(t_idx < cur, raw * scale, NEG_INF)
+    logits = jnp.where(t_idx == cur, qk * scale, logits)
+    m = jnp.max(logits, axis=1, keepdims=True)
+    w = jnp.exp(logits - m)     # masked lanes: exp(NEG_INF - m) -> 0
+    l = jnp.sum(w, axis=1, keepdims=True)
+    w_cur = jnp.sum(jnp.where(t_idx == cur, w, 0.0), axis=1,
+                    keepdims=True)
+    w_past = jnp.where(t_idx < cur, w, 0.0)
+    acc = lax.dot_general(w_past.astype(vc_ref.dtype), vc_ref[0],
+                          (((1,), (0,)), ((), ())),
+                          preferred_element_type=jnp.float32)
+    acc = acc + w_cur * v.astype(jnp.float32)
+    o_ref[...] = (acc / l).astype(o_ref.dtype)
+
+
+def decode_step_supported(d_head: int, n_rep: int, dtype) -> bool:
+    """Gate for the fused decode-step kernel: MHA only (the GQA grouped
+    einsum keeps the un-repeated-cache structure the kernel doesn't
+    model), lane-exact head dim, and a backend with a Mosaic lowering
+    (CPU runs interpret mode so the same path is testable off-TPU).
+    Callers pad the cache length to ``decode_step_cache_len`` — the
+    sublane rule lives there, not here."""
+    if n_rep != 1 or d_head % 128 or d_head < 128:
+        return False
+    return jax.default_backend() in ("tpu", "cpu")
+
+
+def decode_step_cache_len(total: int, dtype) -> int:
+    """Cache columns the fused step's block wants: ``total`` rounded up
+    to the dtype's sublane multiple (the (total, dh) cache block's
+    second-minor dim). The pad columns are dead — the kernel's
+    ``t <= cur`` mask never reaches them."""
+    from icikit.ops.pallas_common import sublane
+    sub = sublane(dtype)
+    return (total + sub - 1) // sub * sub
+
+
+def decode_step_attention(q, k, v, kcache, vcache, cur, cos, sin, *,
+                          scale: float, rope: bool,
+                          interpret: bool | None = None):
+    """Fused single-token decode attention step (MHA).
+
+    Args:
+      q, k, v: this step's projections, ``(rows, dh)`` with
+        ``rows = b * h`` (heads flattened into the grid).
+      kcache, vcache: ``(rows, total, dh)`` padded caches; returned
+        updated **in place** (buffers are donated via
+        ``input_output_aliases``; only the written column moves).
+      cur: traced scalar — the column to write / last visible position.
+      cos, sin: duplicated RoPE tables ``(1, dh)`` fp32 (ignored when
+        ``rope=False`` but must be passed for a stable operand list).
+      scale: logit scale.
+
+    Returns ``(attn (rows, dh), kcache', vcache')``.
+
+    Collapses RoPE-apply + cache column write + masked flash-decode
+    read into one launch per layer — the fused-single-token arm of the
+    multi-token decode study (DECODE.md "Multi-token decode"). Callers
+    must check ``decode_step_supported`` first.
+    """
+    rows, dh = q.shape
+    total = kcache.shape[1]
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    idx = jnp.asarray(cur, jnp.int32).reshape(1)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(rows,),
+        in_specs=[
+            pl.BlockSpec((1, dh), lambda g, i: (g, 0)),       # q
+            pl.BlockSpec((1, dh), lambda g, i: (g, 0)),       # k
+            pl.BlockSpec((1, dh), lambda g, i: (g, 0)),       # v
+            pl.BlockSpec((1, dh), lambda g, i: (0, 0)),       # cos
+            pl.BlockSpec((1, dh), lambda g, i: (0, 0)),       # sin
+            pl.BlockSpec((1, total, dh), lambda g, i: (g, 0, 0)),  # kc
+            pl.BlockSpec((1, total, dh), lambda g, i: (g, 0, 0)),  # vc
+        ],
+        out_specs=[
+            pl.BlockSpec((1, dh), lambda g, i: (g, 0)),       # attn
+            # one-row cache write-back, addressed by the prefetched cur
+            pl.BlockSpec((1, 1, dh), lambda g, i: (g, i[0], 0)),
+            pl.BlockSpec((1, 1, dh), lambda g, i: (g, i[0], 0)),
+        ],
+    )
+    attn, kc, vc = pl.pallas_call(
+        partial(_decode_step_kernel, scale=float(scale), rope=bool(rope),
+                total=total, dh=dh),
+        grid_spec=grid_spec,
+        out_shape=[
+            _out_struct((rows, dh), q.dtype, q, k, v, kcache, vcache),
+            _out_struct(kcache.shape, kcache.dtype, q, k, v, kcache,
+                        vcache),
+            _out_struct(vcache.shape, vcache.dtype, q, k, v, kcache,
+                        vcache),
+        ],
+        input_output_aliases={6: 1, 7: 2},   # donate both caches
+        interpret=interpret,
+    )(idx, q, k, v, cos, sin, kcache, vcache)
+    return attn, kc, vc

@@ -20,32 +20,40 @@ import jax.numpy as jnp
 
 def rope_angles(positions: jax.Array, d: int,
                 theta: float = 10000.0) -> jax.Array:
-    """Angles ``(len(positions), d/2)`` in fp32."""
+    """Angles ``(..., s, d/2)`` in fp32. ``positions`` is ``(s,)``
+    (shared across the batch) or ``(b, s)`` (per-row positions — the
+    speculative decode path, where rows accept different token counts
+    and their windows sit at different offsets)."""
     if d % 2:
         raise ValueError(f"head dim must be even for RoPE, got {d}")
     inv = theta ** (-jnp.arange(0, d, 2, dtype=jnp.float32) / d)
-    return positions.astype(jnp.float32)[:, None] * inv[None, :]
+    return positions.astype(jnp.float32)[..., :, None] * inv
 
 
 def rope_sincos(positions: jax.Array, d: int, theta: float = 10000.0):
-    """Precomputed ``(cos, sin)`` tables, each ``(s, d/2)`` fp32 — for
-    callers that apply the same positions to many tensors (the decode
-    loop applies one position across every layer; computing the angle
-    chain per layer was pure serialized-fusion overhead at b=1)."""
+    """Precomputed ``(cos, sin)`` tables, each ``(s, d/2)`` fp32 (or
+    ``(b, s, d/2)`` for per-row positions) — for callers that apply the
+    same positions to many tensors (the decode loop applies one
+    position across every layer; computing the angle chain per layer
+    was pure serialized-fusion overhead at b=1)."""
     ang = rope_angles(positions, d, theta)
     return jnp.cos(ang), jnp.sin(ang)
 
 
 def apply_rope(x: jax.Array, positions: jax.Array,
                theta: float = 10000.0, sincos=None) -> jax.Array:
-    """Rotate ``x (b, s, h, d)`` by its positions ``(s,)``; same dtype.
-    ``sincos``: optional precomputed ``rope_sincos`` tables (positions
-    is then ignored)."""
+    """Rotate ``x (b, s, h, d)`` by its positions ``(s,)`` — or
+    per-row ``(b, s)`` — keeping the dtype. ``sincos``: optional
+    precomputed ``rope_sincos`` tables (positions is then ignored)."""
     d = x.shape[-1]
     if sincos is None:
         sincos = rope_sincos(positions, d, theta)
-    cos = sincos[0][None, :, None, :]
-    sin = sincos[1][None, :, None, :]
+    if sincos[0].ndim == 3:            # per-row tables (b, s, d/2)
+        cos = sincos[0][:, :, None, :]
+        sin = sincos[1][:, :, None, :]
+    else:                              # shared tables (s, d/2)
+        cos = sincos[0][None, :, None, :]
+        sin = sincos[1][None, :, None, :]
     x1 = x[..., :d // 2].astype(jnp.float32)
     x2 = x[..., d // 2:].astype(jnp.float32)
     out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos],

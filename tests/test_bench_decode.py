@@ -24,3 +24,34 @@ def test_decode_bytes_accounting():
     b1 = decode_bytes_per_token(cfg, batch=1, cache_len=16)
     b2 = decode_bytes_per_token(cfg, batch=1, cache_len=32)
     assert b2 > b1  # longer cache reads more
+
+
+def test_decode_bench_speculative():
+    rec = run_bench("tiny", dp=1, tp=1, batch=2, prompt_len=8, n_new=8,
+                    runs=1, speculate=3, draft_layers=1)
+    assert rec["speculate"] == 3 and rec["draft_layers"] == 1
+    assert 0.0 <= rec["acceptance_rate"] <= 1.0
+    assert 1.0 <= rec["tokens_per_step"] <= 3.0
+    # the acceptance × cost model rides on every speculative row
+    assert rec["projected_eff_ms_per_token"] > 0
+    assert "_spec3d1" in rec["metric"]
+    assert rec["backend"]  # provenance: rows from CPU and TPU differ
+
+
+def test_spec_cost_model_anchors():
+    """At tokens_per_step = 1 and k = 1 the model must reproduce the
+    baseline floor exactly (no drafts, one verify pass = one
+    single-token step); more tokens per step must strictly help."""
+    from icikit.bench.decode import spec_cost_model
+    from icikit.bench.train import PRESETS
+    from icikit.models.transformer import TransformerConfig
+    cfg = TransformerConfig(**PRESETS["base"])
+    m1 = spec_cost_model(cfg, 1, 320, k=1, draft_layers=6,
+                         tokens_per_step=1.0)
+    assert m1["projected_eff_ms_per_token"] == m1["model_floor_ms"]
+    m2 = spec_cost_model(cfg, 1, 320, k=4, draft_layers=6,
+                         tokens_per_step=3.0)
+    m3 = spec_cost_model(cfg, 1, 320, k=4, draft_layers=6,
+                         tokens_per_step=1.5)
+    assert m2["projected_eff_ms_per_token"] < m3[
+        "projected_eff_ms_per_token"]

@@ -144,3 +144,105 @@ def test_sample_skipped_when_no_room(capsys):
     recs = _run(capsys, "--sample-tokens", "100")  # seq=32, prompt=8
     samples = [r for r in recs if r.get("event") == "sample"]
     assert samples and len(samples[0]["tokens"]) == 8 + 24  # clamped
+
+
+def test_device_guard_step_skips_nonfinite_on_device():
+    """make_train_step(guard="device"): the fused isfinite reduction
+    skips a poisoned update ON DEVICE — params and optimizer state
+    hold bit-for-bit, ok comes back False — with no host inspection
+    of the loss anywhere in the loop."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from icikit.models.transformer import TransformerConfig, init_params
+    from icikit.models.transformer.model import (make_model_mesh,
+                                                 make_train_step)
+
+    cfg = TransformerConfig(vocab=32, d_model=32, n_heads=2, d_head=16,
+                            d_ff=64, n_layers=1, max_seq=16,
+                            compute_dtype="float32")
+    mesh = make_model_mesh(dp=1, tp=1, sp=1)
+    params = init_params(jax.random.key(0), cfg, mesh)
+    _, step = make_train_step(mesh, cfg, optax.adam(1e-3),
+                              guard="device")
+    rng = np.random.default_rng(0)
+    tok = jnp.asarray(rng.integers(0, 32, (2, 16)), jnp.int32)
+    tgt = jnp.asarray(rng.integers(0, 32, (2, 16)), jnp.int32)
+    opt_state = optax.adam(1e-3).init(params)
+
+    # clean step: ok, params move
+    p1, st1, loss, ok = step(params, opt_state, tok, tgt)
+    assert bool(np.asarray(ok))
+    assert not np.array_equal(np.asarray(p1["w1"]),
+                              np.asarray(params["w1"]))
+
+    # poisoned params -> non-finite grads -> on-device skip
+    bad = dict(params)
+    bad["w1"] = bad["w1"].at[0, 0, 0].set(jnp.nan)
+    p2, st2, loss2, ok2 = step(bad, opt_state, tok, tgt)
+    assert not bool(np.asarray(ok2))
+    for k in bad:
+        np.testing.assert_array_equal(np.asarray(p2[k]),
+                                      np.asarray(bad[k]))
+    for a, b in zip(jax.tree.leaves(st2), jax.tree.leaves(opt_state)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_device_guard_mode_drill(capsys):
+    """Trainer --guard-mode device under the chaos NaN drill: the
+    anomaly/rollback events land at the next logging fence (with
+    their original step numbers) and the run recovers finite."""
+    import numpy as np
+
+    from icikit import chaos
+
+    plan = chaos.FaultPlan(schedule={"corrupt:train.loss": (3, 4)},
+                           corrupt_mode="nan")
+    with chaos.inject(plan):
+        recs = _run(capsys, "--guard-mode", "device",
+                    "--guard-rollback-after", "2", "--steps", "9",
+                    "--sample-tokens", "0")
+    anoms = [r for r in recs if r.get("event") == "anomaly"]
+    rolls = [r for r in recs if r.get("event") == "rollback"]
+    assert [a["step"] for a in anoms] == [4, 5]
+    assert len(rolls) == 1 and rolls[0]["to_step"] == 0
+    summary = [r for r in recs if r.get("event") == "guard_summary"]
+    assert summary[0]["anomalies"] == 2
+    assert summary[0]["rollbacks"] == 1
+    steps = [r for r in recs if "loss" in r and "event" not in r]
+    assert np.isfinite(steps[-1]["loss"])
+
+
+def test_device_guard_fused_adam_step():
+    """The FusedAdam fused_step honors guard="device" too (the t
+    counter must also hold on a skipped step)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from icikit.models.transformer import (FusedAdam, TransformerConfig,
+                                           init_params)
+    from icikit.models.transformer.model import (make_model_mesh,
+                                                 make_train_step)
+
+    cfg = TransformerConfig(vocab=32, d_model=32, n_heads=2, d_head=16,
+                            d_ff=64, n_layers=1, max_seq=16,
+                            compute_dtype="float32")
+    mesh = make_model_mesh(dp=1, tp=1, sp=1)
+    params = init_params(jax.random.key(0), cfg, mesh)
+    opt, step = make_train_step(mesh, cfg, FusedAdam(1e-3),
+                                guard="device")
+    opt_state = opt.init(params)
+    rng = np.random.default_rng(0)
+    tok = jnp.asarray(rng.integers(0, 32, (2, 16)), jnp.int32)
+    tgt = jnp.asarray(rng.integers(0, 32, (2, 16)), jnp.int32)
+    bad = dict(params)
+    bad["w1"] = bad["w1"].at[0, 0, 0].set(jnp.inf)
+    p2, st2, _, ok = step(bad, opt_state, tok, tgt)
+    assert not bool(np.asarray(ok))
+    assert int(np.asarray(st2[2])) == 0     # t held
+    for k in bad:
+        np.testing.assert_array_equal(np.asarray(p2[k]),
+                                      np.asarray(bad[k]))

@@ -5,7 +5,7 @@
 PY ?= python
 
 .PHONY: test test-fast chaos bench native clean sweep scaling northstar \
-	trace-demo check decode-smoke
+	trace-demo check decode-smoke draft-smoke
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -55,6 +55,25 @@ decode-smoke:
 	$(PY) -m icikit.obs.check /tmp/icikit_decode_trace.json
 	@grep -q "decode.spec.draft_accepted" /tmp/icikit_decode_metrics.json \
 		&& echo "decode-smoke OK: trace valid, acceptance counters present"
+
+# trained-draft-head smoke: a tiny self-distillation run (draft head
+# armed, per-step draft.loss/draft.top1_agree on the obs bus) that
+# finishes with a greedy speculative decode using the head it just
+# trained (--draft-sample), all under an armed obs session — the
+# exported trace must pass the structural validator and the metrics
+# snapshot must hold both the distill and the acceptance counters
+draft-smoke:
+	JAX_PLATFORMS=cpu \
+	ICIKIT_OBS="trace=/tmp/icikit_draft_trace.json;metrics=/tmp/icikit_draft_metrics.json;jsonl=off" \
+	$(PY) -m icikit.models.transformer.train --steps 8 --batch 4 \
+		--vocab 32 --d-model 32 --n-heads 2 --d-head 8 --d-ff 64 \
+		--n-layers 2 --seq 32 --compute-dtype float32 --log-every 4 \
+		--lr 1e-2 --draft-head --draft-layers 1 --draft-sample 8 \
+		--sample-tokens 0 > /dev/null
+	$(PY) -m icikit.obs.check /tmp/icikit_draft_trace.json
+	@grep -q "draft.loss" /tmp/icikit_draft_metrics.json && \
+		grep -q "decode.spec.draft_accepted" /tmp/icikit_draft_metrics.json && \
+		echo "draft-smoke OK: trace valid, distill + trained-drafter metrics present"
 
 bench:
 	$(PY) bench.py

@@ -195,11 +195,92 @@ def spec_cost_model(cfg, batch: int, cache_len: float, k: int,
     }
 
 
+def load_measured_alpha(path: str, batch: int = 1) -> dict:
+    """Measured acceptance per (k, draft_layers, drafter) from a study
+    records file (rows with ``kind == "acceptance"``, as written by
+    ``tools/decode_spec_study.py`` / ``tools/draft_head_study.py``).
+    The LAST matching row wins — record files append across rounds, so
+    later measurements supersede earlier ones. Rows without a
+    ``drafter`` field are the r7 shared-head measurements."""
+    import json as _json
+    out = {}
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            r = _json.loads(line)
+            if r.get("kind") != "acceptance" or r.get("batch") != batch:
+                continue
+            key = (int(r["k"]), int(r["draft_layers"]),
+                   r.get("drafter", "shared"))
+            out[key] = r
+    return out
+
+
+def cost_model_rows(alpha_from: str, preset: str = "base",
+                    batch: int = 1, cache_len: int = 320,
+                    alpha_batch: int = 1) -> list[dict]:
+    """The priced verdict, reproducible by one command: evaluate
+    ``spec_cost_model`` at every acceptance point MEASURED in
+    ``alpha_from`` instead of hand-entered α values. Each row carries
+    the α row's provenance (source file, drafter, train steps) plus
+    the break-even curve, so DECODE.md's verdict table regenerates
+    from the records alone."""
+    from icikit.bench.train import PRESETS
+    from icikit.models.transformer import TransformerConfig
+
+    cfg = TransformerConfig(**PRESETS[preset])
+    measured = load_measured_alpha(alpha_from, batch=alpha_batch)
+    if not measured:
+        raise ValueError(f"no kind='acceptance' rows at batch="
+                         f"{alpha_batch} in {alpha_from}")
+    rows = []
+    for (k, ld, drafter), src in sorted(measured.items()):
+        a = float(src["acceptance_rate"])
+        # the measurement model and the pricing preset differ in
+        # depth; what transfers is the depth FRACTION (the r7 cost
+        # model is depth-fraction-dominated), so a toy α at L_d of
+        # n_layers prices the preset at the same fraction
+        frac = ld / src["n_layers"] if src.get("n_layers") else 0.25
+        ld_price = max(1, round(cfg.n_layers * frac))
+        tps = 1.0 + (k - 1) * a
+        m = spec_cost_model(cfg, batch, cache_len, k, ld_price,
+                            tokens_per_step=tps)
+        iter_ms = m["model_iter_ms"]
+        be = ((iter_ms / SPEC_FLOOR_MS - 1) / (k - 1) if k > 1
+              else None)
+        be15 = ((iter_ms / (0.85 * SPEC_FLOOR_MS) - 1) / (k - 1)
+                if k > 1 else None)
+        rows.append({
+            "kind": "projection",
+            "preset": preset, "batch": batch, "cache_len": cache_len,
+            "k": k, "draft_layers": ld_price,
+            "draft_fraction": round(frac, 4),
+            "drafter": drafter,
+            "measured_acceptance": a,
+            "measured_draft_layers": ld,
+            "measured_n_layers": src.get("n_layers"),
+            "alpha_source": alpha_from,
+            "alpha_batch": alpha_batch,
+            "alpha_train_steps": src.get("train_steps"),
+            "breakeven_acceptance": (round(be, 4)
+                                     if be is not None else None),
+            "breakeven_acceptance_15pct": (round(be15, 4)
+                                           if be15 is not None
+                                           else None),
+            "clears_15pct": (a >= be15 if be15 is not None else None),
+            **m,
+        })
+    return rows
+
+
 def run_bench(preset: str, dp: int, tp: int, batch: int, prompt_len: int,
               n_new: int, sampling: str = "greedy", runs: int = 3,
               kv_heads: int = 0, windows: int = 3, speculate: int = 0,
               draft_layers: int = 0,
-              decode_step: str = "unfused") -> dict:
+              decode_step: str = "unfused",
+              drafter: str = "shared") -> dict:
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -217,8 +298,16 @@ def run_bench(preset: str, dp: int, tp: int, batch: int, prompt_len: int,
     over = dict(PRESETS[preset])
     over["max_seq"] = max(over["max_seq"],
                           prompt_len + n_new + 2 * max(0, speculate - 1))
+    if drafter not in ("shared", "trained"):
+        raise ValueError(f"unknown drafter {drafter!r} "
+                         "(known: shared, trained)")
+    # trained-drafter rows carry the draft branch (random-init here —
+    # this harness measures the wall-time machinery; the study tool
+    # measures acceptance with an actually-trained head)
+    draft_over = ({"draft_head": True, "draft_layers": draft_layers}
+                  if drafter == "trained" else {})
     cfg = TransformerConfig(**over, n_kv_heads=kv_heads,
-                            decode_step=decode_step)
+                            decode_step=decode_step, **draft_over)
     mesh = make_model_mesh(dp=dp, tp=tp, sp=1)
     params = init_params(jax.random.key(0), cfg, mesh)
     rng = np.random.default_rng(0)
@@ -226,13 +315,24 @@ def run_bench(preset: str, dp: int, tp: int, batch: int, prompt_len: int,
     if speculate and sampling != "greedy":
         raise ValueError("--speculate is greedy-only (verify-and-accept "
                          "is exact prefix matching)")
-    d_layers = draft_layers or max(1, cfg.n_layers // 2)
+    if draft_layers:
+        d_layers = draft_layers
+    elif drafter == "trained":
+        # match speculative_generate's own default: the trained head
+        # drafts at its configured exit depth (quarter), not the
+        # shared drafter's half-depth default — a trained row must
+        # measure the depth the head reads (and the study prices)
+        from icikit.models.transformer.draft import draft_exit_layer
+        d_layers = draft_exit_layer(cfg)
+    else:
+        d_layers = max(1, cfg.n_layers // 2)
 
     def gen(prompt, n):
         if speculate:
             return speculative_generate(params, prompt, mesh, cfg, n,
                                         k=speculate,
-                                        draft_layers=d_layers)
+                                        draft_layers=d_layers,
+                                        drafter=drafter)
         if sampling == "greedy":
             return greedy_generate(params, prompt, mesh, cfg, n)
         return sample_generate(params, prompt, mesh, cfg, n,
@@ -296,6 +396,8 @@ def run_bench(preset: str, dp: int, tp: int, batch: int, prompt_len: int,
         cfg, batch, prompt_len + n_new) / per_token_s
     kv_tag = f"_kv{kv_heads}" if kv_heads else ""
     spec_tag = (f"_spec{speculate}d{d_layers}" if speculate else "")
+    if speculate and drafter != "shared":
+        spec_tag += f"_{drafter}"
     step_tag = ("" if decode_step == "unfused" else f"_{decode_step}")
     rec_extra = {}
     if speculate:
@@ -304,7 +406,7 @@ def run_bench(preset: str, dp: int, tp: int, batch: int, prompt_len: int,
         # acceptance × cost model (DECODE.md "Multi-token decode")
         _, st = speculative_generate(params, p0, mesh, cfg, n_new,
                                      k=speculate, draft_layers=d_layers,
-                                     return_stats=True)
+                                     drafter=drafter, return_stats=True)
         # achieved read bandwidth under the SPECULATIVE byte model at
         # the measured acceptance (iter bytes buy tokens_per_step
         # tokens); the single-token model would overstate it
@@ -312,6 +414,7 @@ def run_bench(preset: str, dp: int, tp: int, batch: int, prompt_len: int,
         rec_extra = {
             "speculate": speculate,
             "draft_layers": d_layers,
+            "drafter": drafter,
             "acceptance_rate": round(st["acceptance_rate"], 4),
             "tokens_per_step": round(st["tokens_per_step"], 4),
             "verify_steps": st["verify_steps"],
@@ -359,7 +462,8 @@ def run_sweep(preset: str, batches, prompt_len: int, n_new: int,
               runs: int = 3, kv_heads: int = 0, dp: int = 1,
               tp: int = 1, sampling: str = "greedy", speculate: int = 0,
               draft_layers: int = 0,
-              decode_step: str = "unfused") -> list[dict]:
+              decode_step: str = "unfused",
+              drafter: str = "shared") -> list[dict]:
     """Batch sweep against the measured HBM roofline (DECODE.md).
 
     Decode reads all parameters once per *step* regardless of batch, so
@@ -386,7 +490,7 @@ def run_sweep(preset: str, batches, prompt_len: int, n_new: int,
         rec = run_bench(preset, dp, tp, b, prompt_len, n_new,
                         sampling=sampling, runs=runs, kv_heads=kv_heads,
                         speculate=speculate, draft_layers=draft_layers,
-                        decode_step=decode_step)
+                        decode_step=decode_step, drafter=drafter)
         rec["roofline_gbps"] = round(bw_ceiling / 1e9, 1)
         rec["pct_roofline"] = round(
             100.0 * rec["read_gbps"] / (bw_ceiling / 1e9), 1)
@@ -425,6 +529,28 @@ def main(argv=None) -> int:
     ap.add_argument("--draft-layers", type=int, default=0,
                     help="truncated-depth drafter (default: "
                          "n_layers // 2)")
+    ap.add_argument("--drafter", default="shared",
+                    choices=["shared", "trained"],
+                    help="speculative drafter: 'shared' = the free "
+                         "truncated-depth/shared-head readout (r7), "
+                         "'trained' = the trained early-exit draft "
+                         "head (random-init here — wall-time "
+                         "machinery rows; acceptance comes from the "
+                         "study tools)")
+    ap.add_argument("--cost-model", action="store_true",
+                    help="no hardware run: evaluate spec_cost_model at "
+                         "every acceptance point measured in "
+                         "--alpha-from and emit kind='projection' "
+                         "rows (the reproducible priced verdict)")
+    ap.add_argument("--alpha-from", default=None, metavar="RECORDS",
+                    help="records file with measured kind='acceptance' "
+                         "rows (e.g. decode_spec_r8.jsonl)")
+    ap.add_argument("--alpha-batch", type=int, default=1,
+                    help="which measured batch's acceptance rows to "
+                         "price (default 1 — the b=1 latency route)")
+    ap.add_argument("--cache-len", type=int, default=320,
+                    help="cost-model cache length (320 = the study's "
+                         "64-prompt + 256-generated shape)")
     ap.add_argument("--decode-step", default="unfused",
                     choices=["auto", "fused", "unfused"],
                     help="single-token inner step: 'fused' = one "
@@ -440,20 +566,29 @@ def main(argv=None) -> int:
                          "overrides --batch, honors the other flags)")
     ap.add_argument("--json", dest="json_path", default=None)
     args = ap.parse_args(argv)
-    if args.sweep:
+    if args.cost_model:
+        if not args.alpha_from:
+            ap.error("--cost-model requires --alpha-from RECORDS")
+        recs = cost_model_rows(args.alpha_from, preset=args.preset,
+                               batch=args.batch,
+                               cache_len=args.cache_len,
+                               alpha_batch=args.alpha_batch)
+    elif args.sweep:
         recs = run_sweep(args.preset,
                          [int(b) for b in args.sweep.split(",")],
                          args.prompt, args.n_new, args.runs,
                          args.kv_heads, args.dp, args.tp,
                          args.sampling, args.speculate,
-                         args.draft_layers, args.decode_step)
+                         args.draft_layers, args.decode_step,
+                         args.drafter)
     else:
         recs = [run_bench(args.preset, args.dp, args.tp, args.batch,
                           args.prompt, args.n_new, args.sampling,
                           args.runs, args.kv_heads,
                           speculate=args.speculate,
                           draft_layers=args.draft_layers,
-                          decode_step=args.decode_step)]
+                          decode_step=args.decode_step,
+                          drafter=args.drafter)]
     obs.emit_records(recs)
     if args.json_path:
         # append: record files accumulate across invocations (the

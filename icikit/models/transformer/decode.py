@@ -444,6 +444,9 @@ def greedy_generate(params, prompt, mesh, cfg: TransformerConfig,
                     n_new: int) -> jax.Array:
     """Greedy continuation: int32 ``prompt`` (B, S) sharded over dp ->
     (B, S + n_new) tokens (prompt followed by the argmax decode)."""
+    from icikit import chaos
+    chaos.maybe_delay("decode.prefill")   # host boundary of the jitted
+    chaos.maybe_die("decode.prefill")     # prefill+decode program
     key_data = jax.random.key_data(jax.random.key(0))  # unused by greedy
     knobs = jnp.ones((2,), jnp.float32)                 # unused by greedy
     return _build_generate(mesh, cfg, prompt.shape[1], n_new)(
@@ -466,6 +469,9 @@ def sample_generate(params, prompt, mesh, cfg: TransformerConfig,
     if not 0 <= top_k <= cfg.vocab:
         raise ValueError(f"top_k must be in [0, vocab={cfg.vocab}], "
                          f"got {top_k}")
+    from icikit import chaos
+    chaos.maybe_delay("decode.prefill")
+    chaos.maybe_die("decode.prefill")
     knobs = jnp.asarray([temperature, top_p], jnp.float32)
     return _build_generate(mesh, cfg, prompt.shape[1], n_new,
                            ("sample", int(top_k)))(

@@ -168,6 +168,32 @@ class TransformerConfig:
     # narrow). "float32" keeps full-precision gradients. Measured on
     # v5e (base preset): "compute" saves ~4 ms/step.
     grad_dtype: str = "compute"
+    # Trained early-exit draft head (models/transformer/draft.py): an
+    # RMS-norm + low-rank adapter readout over the layer-L_d residual,
+    # self-distilled against the full model inside the train step
+    # (stop-gradient through the trunk) and swapped in as the
+    # speculative-decode drafter. The r7 pricing found the free
+    # shared-head drafter 3-10x below break-even acceptance; this head
+    # is the named fix (DECODE.md "Multi-token decode").
+    draft_head: bool = False
+    # Exit depth L_d the head reads/trains at (0 = n_layers // 4, min
+    # 1 — quarter depth, the cheapest depth to pay back per the r7
+    # cost model).
+    draft_layers: int = 0
+    # Gelu-adapter width R (draft_a: (D, R), draft_b: (R, D);
+    # draft_b zero-init, so the untrained head IS the shared-head
+    # drafter). The r8 study needed R = 4×d_model to saturate the
+    # Markov toy's acceptance; the head is still ~1000x smaller than
+    # the trunk at the base preset.
+    draft_rank: int = 32
+    # Tie the draft unembedding to w_out (zero extra decode bytes —
+    # the verify pass streams w_out anyway). False gives the head its
+    # own (V, D) table, stored/sharded exactly like w_out.
+    draft_tied: bool = True
+    # Distillation mix: draft loss = (1-draft_kl)*CE(targets) +
+    # draft_kl*KL(teacher || draft), teacher = the same forward's
+    # full-model logits under stop_gradient.
+    draft_kl: float = 0.5
 
 
 def make_model_mesh(n_devices: int | None = None, dp: int = 1, tp: int = 1,
@@ -207,6 +233,23 @@ def _check_cfg(cfg: TransformerConfig) -> None:
     if cfg.decode_step not in ("auto", "fused", "unfused"):
         raise ValueError(f"unknown decode_step {cfg.decode_step!r} "
                          "(known: auto, fused, unfused)")
+    if cfg.draft_head:
+        if not 0 <= cfg.draft_layers <= cfg.n_layers:
+            raise ValueError(
+                f"draft_layers={cfg.draft_layers} must be in "
+                f"[0, n_layers={cfg.n_layers}] (0 = quarter depth)")
+        if cfg.draft_rank < 1:
+            raise ValueError(f"draft_rank must be >= 1, got "
+                             f"{cfg.draft_rank}")
+        if not 0.0 <= cfg.draft_kl <= 1.0:
+            raise ValueError(f"draft_kl must be in [0, 1], got "
+                             f"{cfg.draft_kl}")
+        if cfg.save_stack == "pallas":
+            raise ValueError(
+                "draft_head distillation needs the layer scan split at "
+                "the exit layer; save_stack='pallas' routes the whole "
+                "stack through one remat_scan_stacked and cannot "
+                "surface the L_d residual (use save_stack='xla')")
 
 
 def _is_gqa(cfg: TransformerConfig) -> bool:
@@ -288,6 +331,9 @@ def param_specs(cfg: TransformerConfig) -> dict:
             "w1": P(None, None, TP_AXIS),             # (L, D, F)
             "w2": P(None, TP_AXIS, None),             # (L, F, D)
         })
+    if cfg.draft_head:
+        from icikit.models.transformer.draft import draft_param_specs
+        specs.update(draft_param_specs(cfg))
     return specs
 
 
@@ -327,6 +373,13 @@ def init_params(key, cfg: TransformerConfig, mesh: Mesh) -> dict:
     else:
         params["w1"] = norm(ks[4], (L, D, F), D)
         params["w2"] = norm(ks[5], (L, F, D), F)
+    if cfg.draft_head:
+        # fold_in, not a wider split: the trunk leaves must stay
+        # bitwise identical to the same seed's no-draft init (the
+        # draft branch is an optional add-on, not a reshuffle)
+        from icikit.models.transformer.draft import init_draft_params
+        params.update(init_draft_params(
+            jax.random.fold_in(key, 0x0D_4A_F7), cfg, params["w_out"]))
     specs = param_specs(cfg)
     return {k: jax.device_put(v, NamedSharding(mesh, specs[k]))
             for k, v in params.items()}
@@ -417,12 +470,19 @@ def _maybe_remat(layer, cfg: TransformerConfig):
 
 
 def _forward_local(params, tokens, cfg: TransformerConfig, p_sp: int,
-                   p_dp: int, head: str = "logits"):
+                   p_dp: int, head: str = "logits",
+                   draft_exit: int | None = None):
     """Per-shard forward: tokens (b_loc, s_loc) -> (logits fp32,
     summed MoE aux loss); with ``head="hidden"`` returns the final
     normed hidden state (b, s, D) in compute dtype instead — the
     fused-xent loss path consumes that directly and never materializes
     logits.
+
+    ``draft_exit=L_d`` splits the layer scan at L_d and additionally
+    returns the RAW residual stream after layer L_d (pre-``ln_f``, the
+    draft head's input) as a third output — the same per-layer math in
+    two scans, so the trunk numerics are unchanged (pinned by
+    tests/test_draft_head.py's trunk-gradient parity).
 
     Activations are replicated over tp (every psum over tp closes a
     column->row parallel pair), batch-local over dp, sequence-local
@@ -546,14 +606,32 @@ def _forward_local(params, tokens, cfg: TransformerConfig, p_sp: int,
         scan_body = _maybe_remat(
             lambda x, lp: layer(x, lp, positions), cfg)
 
-    x, auxes = lax.scan(scan_body, x, layer_params,
-                        unroll=cfg.scan_unroll)
+    if draft_exit is None:
+        x, auxes = lax.scan(scan_body, x, layer_params,
+                            unroll=cfg.scan_unroll)
+        aux_sum = auxes.sum()
+        x_mid = None
+    else:
+        lp_lo = {k: v[:draft_exit] for k, v in layer_params.items()}
+        x, aux_lo = lax.scan(scan_body, x, lp_lo,
+                             unroll=cfg.scan_unroll)
+        x_mid = x
+        aux_sum = aux_lo.sum()
+        if draft_exit < cfg.n_layers:
+            lp_hi = {k: v[draft_exit:] for k, v in layer_params.items()}
+            x, aux_hi = lax.scan(scan_body, x, lp_hi,
+                                 unroll=cfg.scan_unroll)
+            aux_sum = aux_sum + aux_hi.sum()
     x = _rms_norm(x, params["ln_f"]).astype(cdt)
     if head == "hidden":
-        return x, auxes.sum()
-    logits = jnp.einsum("bsd,vd->bsv", x,
-                        params["w_out"].astype(cdt)).astype(jnp.float32)
-    return logits, auxes.sum()
+        out = x
+    else:
+        out = jnp.einsum(
+            "bsd,vd->bsv", x,
+            params["w_out"].astype(cdt)).astype(jnp.float32)
+    if draft_exit is None:
+        return out, aux_sum
+    return out, aux_sum, x_mid
 
 
 def _vocab_parallel_nll(logits, targets):
@@ -577,6 +655,67 @@ def _vocab_parallel_nll(logits, targets):
     return m + jnp.log(z) - tgt_logit                          # (b, s)
 
 
+def _vp_log_softmax(lg):
+    """Per-shard log-probabilities from vocab-sharded logits
+    (b, s, V/tp): the max shift reduces over tp via the differentiable
+    all_gather (stability-only, gradient cancels — same note as
+    ``_vocab_parallel_nll``), the partition function via one psum."""
+    m = lax.stop_gradient(jnp.max(
+        lax.all_gather(lg.max(axis=-1), TP_AXIS, axis=0), axis=0))
+    z = lax.psum(jnp.exp(lg - m[..., None]).sum(-1), TP_AXIS)
+    return lg - m[..., None] - jnp.log(z)[..., None]
+
+
+def _vp_argmax(lg):
+    """Global argmax token ids from vocab-sharded logits: each shard's
+    (local max, global index) pair gathers over tp and the winning
+    shard's index is selected — metrics-only (no gradient)."""
+    v_loc = lg.shape[-1]
+    r = lax.axis_index(TP_AXIS)
+    gm = lax.all_gather(lg.max(axis=-1), TP_AXIS, axis=0)   # (tp, b, s)
+    gi = lax.all_gather(jnp.argmax(lg, axis=-1) + r * v_loc,
+                        TP_AXIS, axis=0)
+    win = jnp.argmax(gm, axis=0)                            # (b, s)
+    return jnp.take_along_axis(gi, win[None], axis=0)[0]
+
+
+def _draft_distill(params, x_mid, teacher_logits, targets, cfg,
+                   denom):
+    """Self-distillation terms for the draft head, per shard: returns
+    (draft_loss, top1_agree) as local sums/``denom`` (the caller
+    psums over dp×sp, and over tp under ``vocab_parallel``).
+
+    The trunk is frozen to the draft loss by construction:
+    ``x_mid`` enters under stop_gradient (only ``draft_*`` leaves
+    receive cotangents) and the teacher side is stop_gradient'd
+    wholesale — the main loss's trunk gradients are bitwise unchanged
+    by arming the head (pinned by tests/test_draft_head.py).
+    ``teacher_logits`` are the shard's fp32 logits — vocab-sharded
+    under ``vocab_parallel``, full-width otherwise."""
+    from icikit.models.transformer.draft import draft_local_logits
+    cdt = jnp.dtype(cfg.compute_dtype)
+    sl = draft_local_logits(params, lax.stop_gradient(x_mid), cfg, cdt)
+    tl = lax.stop_gradient(teacher_logits)
+    if cfg.vocab_parallel:
+        ce = _vocab_parallel_nll(sl, targets)               # (b, s)
+        s_logp = _vp_log_softmax(sl)
+        t_logp = lax.stop_gradient(_vp_log_softmax(tl))
+        kl = lax.psum((jnp.exp(t_logp) * (t_logp - s_logp)).sum(-1),
+                      TP_AXIS)
+        agree = (_vp_argmax(tl) == _vp_argmax(sl))
+    else:
+        s_logp = jax.nn.log_softmax(sl, axis=-1)
+        t_logp = jax.nn.log_softmax(tl, axis=-1)
+        ce = -jnp.take_along_axis(s_logp, targets[..., None],
+                                  axis=-1)[..., 0]
+        kl = (jnp.exp(t_logp) * (t_logp - s_logp)).sum(-1)
+        agree = (jnp.argmax(tl, axis=-1) == jnp.argmax(sl, axis=-1))
+    mix = cfg.draft_kl
+    dloss = ((1.0 - mix) * ce + mix * kl).sum() / denom
+    top1 = agree.sum().astype(jnp.float32) / denom
+    return dloss, top1
+
+
 def _use_fused_head(cfg, b: int, s: int) -> bool:
     if not cfg.fused_head or cfg.vocab_parallel:
         return False
@@ -586,11 +725,21 @@ def _use_fused_head(cfg, b: int, s: int) -> bool:
 
 
 def _local_loss(params, tokens, targets, cfg, p_sp, p_dp, p_tp, denom):
+    """Per-shard loss, plus a (possibly empty) dict of auxiliary
+    metrics — the draft head's distill loss and top-1 agreement when
+    ``cfg.draft_head`` (the value_and_grad caller rides them out as
+    ``has_aux``)."""
     b, s = tokens.shape
+    draft_exit = None
+    if cfg.draft_head:
+        from icikit.models.transformer.draft import draft_exit_layer
+        draft_exit = draft_exit_layer(cfg)
+    x_mid = teacher = None
     if _use_fused_head(cfg, b, s):
         from icikit.ops.xent import fused_xent
-        h, aux = _forward_local(params, tokens, cfg, p_sp, p_dp,
-                                head="hidden")
+        fwd = _forward_local(params, tokens, cfg, p_sp, p_dp,
+                             head="hidden", draft_exit=draft_exit)
+        h, aux = fwd[0], fwd[1]
         cdt = h.dtype
         # explicit replication-lift: the custom-vjp kernel returns a
         # dp/sp-varying dw, so the usual auto-pvary (whose transpose is
@@ -603,8 +752,22 @@ def _local_loss(params, tokens, targets, cfg, p_sp, p_dp, p_tp, denom):
                          targets.reshape(b * s),
                          save_exp=cfg.xent_save_exp,
                          fused_bwd=cfg.xent_fused_bwd).reshape(b, s)
+        if draft_exit is not None:
+            x_mid = fwd[2]
+            # the fused head never materializes logits — the distill
+            # teacher re-derives them from the final hidden state
+            # under stop_gradient (one extra (T, V) matmul, paid only
+            # while a draft head is training)
+            teacher = lax.stop_gradient(
+                jnp.einsum("bsd,vd->bsv", h,
+                           params["w_out"].astype(cdt))
+                .astype(jnp.float32))
     else:
-        logits, aux = _forward_local(params, tokens, cfg, p_sp, p_dp)
+        fwd = _forward_local(params, tokens, cfg, p_sp, p_dp,
+                             draft_exit=draft_exit)
+        logits, aux = fwd[0], fwd[1]
+        if draft_exit is not None:
+            x_mid, teacher = fwd[2], logits
         if cfg.vocab_parallel:
             nll = _vocab_parallel_nll(logits, targets)
         else:
@@ -620,7 +783,16 @@ def _local_loss(params, tokens, targets, cfg, p_sp, p_dp, p_tp, denom):
         # varying-over-tp tag; one scalar psum makes the replication
         # explicit for shard_map's check (exact for power-of-2 tp).
         loss = lax.psum(loss, TP_AXIS) / p_tp
-    return loss
+    metrics = {}
+    if draft_exit is not None:
+        dloss, top1 = _draft_distill(params, x_mid, teacher, targets,
+                                     cfg, denom)
+        if cfg.vocab_parallel:
+            dloss = lax.psum(dloss, TP_AXIS) / p_tp
+            top1 = lax.psum(top1, TP_AXIS) / p_tp
+        loss = loss + dloss
+        metrics = {"draft_loss": dloss, "draft_top1_agree": top1}
+    return loss, metrics
 
 
 @lru_cache(maxsize=None)
@@ -632,20 +804,28 @@ def _build_loss_and_grad(mesh, cfg: TransformerConfig, batch_shape):
     specs = param_specs(cfg)
     data_spec = P(DP_AXIS, SP_AXIS)
 
+    metric_specs = ({"draft_loss": P(), "draft_top1_agree": P()}
+                    if cfg.draft_head else {})
+
     def per_shard(params, tokens, targets):
-        loss, grads = jax.value_and_grad(_local_loss)(
+        (loss, metrics), grads = jax.value_and_grad(
+            _local_loss, has_aux=True)(
             params, tokens, targets, cfg, p_sp, p_dp,
             mesh.shape[TP_AXIS], denom)
         # No explicit gradient psums: each param enters replicated over
         # the axes its spec doesn't name, the auto-inserted pvary's
         # transpose IS the cross-shard psum, so ``grads`` leaves are
         # already fully reduced (and carry their params' replication).
-        return lax.psum(loss, (DP_AXIS, SP_AXIS)), grads
+        # Metrics are local sums over global denominators — the same
+        # (dp, sp) psum completes them.
+        metrics = {k: lax.psum(v, (DP_AXIS, SP_AXIS))
+                   for k, v in metrics.items()}
+        return lax.psum(loss, (DP_AXIS, SP_AXIS)), grads, metrics
 
     return wrap_program(
         per_shard, mesh,
         in_specs=(specs, data_spec, data_spec),
-        out_specs=(P(), specs))
+        out_specs=(P(), specs, metric_specs))
 
 
 def loss_fn(params, tokens, targets, mesh, cfg: TransformerConfig):
@@ -653,6 +833,15 @@ def loss_fn(params, tokens, targets, mesh, cfg: TransformerConfig):
 
     ``tokens``/``targets``: int32 ``(B, S)`` sharded ``P(dp, sp)``.
     """
+    loss, grads, _ = loss_and_metrics(params, tokens, targets, mesh, cfg)
+    return loss, grads
+
+
+def loss_and_metrics(params, tokens, targets, mesh,
+                     cfg: TransformerConfig):
+    """``loss_fn`` plus the auxiliary metric dict — ``draft_loss`` /
+    ``draft_top1_agree`` global scalars when ``cfg.draft_head``, empty
+    otherwise."""
     local = (tokens.shape[0] // mesh.shape[DP_AXIS],
              tokens.shape[1] // mesh.shape[SP_AXIS])
     return _build_loss_and_grad(mesh, cfg, local)(params, tokens, targets)
@@ -742,7 +931,12 @@ def make_train_step(mesh, cfg: TransformerConfig, optimizer=None,
     non-finite step is skipped ON DEVICE in the same step — no host
     sync — and the step returns a fourth output, the ``ok`` bool
     scalar, which callers may inspect lazily (e.g. only at logging
-    fences). ``guard="none"`` keeps the historical 3-tuple."""
+    fences). ``guard="none"`` keeps the historical 3-tuple.
+
+    With ``cfg.draft_head`` the step additionally returns a FINAL
+    metrics dict (``draft_loss``, ``draft_top1_agree`` device scalars
+    — the self-distillation telemetry); existing signatures are
+    unchanged when the head is off."""
     import optax
     if guard not in ("none", "device"):
         raise ValueError(f"unknown guard {guard!r} "
@@ -765,9 +959,10 @@ def make_train_step(mesh, cfg: TransformerConfig, optimizer=None,
     # halve. Both lists are EXPLICIT param names, not prefixes: a new
     # param added to init_params without a verdict here must fail
     # loudly, never get silently narrowed.
-    KEEP_FP32 = {"ln1", "ln2", "ln_f", "emb", "pos"}
+    KEEP_FP32 = {"ln1", "ln2", "ln_f", "emb", "pos", "draft_ln"}
     NARROW_OK = {"wo", "w_out", "wq", "wkv", "wqkv",
-                 "wr", "we1", "we2", "w1", "w2"}
+                 "wr", "we1", "we2", "w1", "w2",
+                 "draft_a", "draft_b", "draft_out"}
 
     def narrow(p):
         if cfg.grad_dtype == "float32":
@@ -791,8 +986,8 @@ def make_train_step(mesh, cfg: TransformerConfig, optimizer=None,
 
         @jax.jit
         def fused_step(params, opt_state, tokens, targets):
-            loss, grads = loss_fn(narrow(params), tokens, targets,
-                                  mesh, cfg)
+            loss, grads, metrics = loss_and_metrics(
+                narrow(params), tokens, targets, mesh, cfg)
             m, v, t = opt_state
             t = t + 1
             lr = opt.lr(t) if callable(opt.lr) else opt.lr
@@ -814,14 +1009,19 @@ def make_train_step(mesh, cfg: TransformerConfig, optimizer=None,
                 new_p, new_st = _select_tree(
                     ok, (new_p, (new_m, new_v, t)),
                     (params, opt_state))
+                if cfg.draft_head:
+                    return new_p, new_st, loss, ok, metrics
                 return new_p, new_st, loss, ok
+            if cfg.draft_head:
+                return new_p, (new_m, new_v, t), loss, metrics
             return new_p, (new_m, new_v, t), loss
 
         return optimizer, fused_step
 
     @jax.jit
     def step(params, opt_state, tokens, targets):
-        loss, grads = loss_fn(narrow(params), tokens, targets, mesh, cfg)
+        loss, grads, metrics = loss_and_metrics(
+            narrow(params), tokens, targets, mesh, cfg)
         # moments accumulate from fp32 inputs: adam squares its
         # gradient input, and a bf16 g**2 carries ~2^-8 relative error
         # into nu every step — the HBM saving lives in the stacked
@@ -835,7 +1035,11 @@ def make_train_step(mesh, cfg: TransformerConfig, optimizer=None,
             ok = _grads_finite(loss, grads)
             new_params, new_opt = _select_tree(
                 ok, (new_params, new_opt), (params, opt_state))
+            if cfg.draft_head:
+                return new_params, new_opt, loss, ok, metrics
             return new_params, new_opt, loss, ok
+        if cfg.draft_head:
+            return new_params, new_opt, loss, metrics
         return new_params, new_opt, loss
 
     return optimizer, step

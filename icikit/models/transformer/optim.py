@@ -45,11 +45,19 @@ def make_schedule(lr: float, schedule: str = "constant", *,
         [warmup_steps])
 
 
+def draft_mask(params):
+    """Per-leaf bool pytree selecting the trained-draft-head branch
+    (``draft_*`` leaves) — the optimizer's param group."""
+    from icikit.models.transformer.draft import is_draft_key
+    return {k: is_draft_key(k) for k in params}
+
+
 def make_optimizer(lr: float = 3e-4, schedule: str = "constant", *,
                    warmup_steps: int = 0, total_steps: int = 0,
                    min_lr_ratio: float = 0.0, grad_clip: float = 0.0,
                    weight_decay: float = 0.0, accum_steps: int = 1,
-                   b1: float = 0.9, b2: float = 0.999):
+                   b1: float = 0.9, b2: float = 0.999,
+                   draft_lr_mult: float = 1.0):
     """Adam/AdamW with optional global-norm clipping, LR schedule, and
     gradient accumulation.
 
@@ -58,6 +66,14 @@ def make_optimizer(lr: float = 3e-4, schedule: str = "constant", *,
     parameters move every ``accum_steps`` calls with the *mean*
     microbatch gradient — arithmetically the large-batch step when
     microbatches are equal-sized (the loss is a per-token mean).
+
+    ``draft_lr_mult`` != 1 gives the trained-draft-head branch its own
+    effective learning rate (a masked post-Adam update scale over the
+    ``draft_*`` leaves — for Adam, scaling the update IS scaling the
+    LR): the head is a fresh low-rank readout distilling against a
+    possibly long-trained trunk, so its stable LR differs from the
+    trunk's. ``0`` freezes the branch outright (e.g. measuring a
+    trained head while the trunk keeps moving).
     """
     sched = make_schedule(lr, schedule, warmup_steps=warmup_steps,
                           total_steps=total_steps,
@@ -70,6 +86,9 @@ def make_optimizer(lr: float = 3e-4, schedule: str = "constant", *,
                                  weight_decay=weight_decay))
     else:
         parts.append(optax.adam(sched, b1=b1, b2=b2))
+    if draft_lr_mult != 1.0:
+        parts.append(optax.masked(
+            optax.scale(float(draft_lr_mult)), draft_mask))
     tx = optax.chain(*parts) if len(parts) > 1 else parts[0]
     if accum_steps > 1:
         tx = optax.MultiSteps(tx, every_k_schedule=accum_steps)

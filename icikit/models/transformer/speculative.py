@@ -57,7 +57,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
-from icikit import obs
+from icikit import chaos, obs
 from icikit.models.transformer.decode import (
     _DecodeCtx,
     _prefill,
@@ -120,7 +120,8 @@ def _window_pass(ctx: _DecodeCtx, params, lp, kc, vc, toks, cur,
 
 @lru_cache(maxsize=None)
 def _build_speculative(mesh, cfg: TransformerConfig, s_prompt: int,
-                       n_new: int, k: int, draft_layers: int):
+                       n_new: int, k: int, draft_layers: int,
+                       drafter: str = "shared"):
     if n_new < 1:
         raise ValueError(f"n_new must be >= 1, got {n_new}")
     if k < 1:
@@ -157,6 +158,19 @@ def _build_speculative(mesh, cfg: TransformerConfig, s_prompt: int,
     W = n_new + k  # output buffer: active writes end < n_new-1+k,
     #                frozen rows park their k-wide write at n_new
 
+    if drafter == "trained":
+        from icikit.models.transformer.draft import draft_readout
+
+        def draft_logits(params, x):
+            # the trained early-exit head reads the RAW layer-L_d
+            # residual (its own norm scale — ln_f is calibrated for
+            # layer-L statistics); the verify pass below is untouched,
+            # so token-identity to greedy holds for ANY head state
+            return draft_readout(params, x, cfg, ctx.cdt)
+    else:
+        def draft_logits(params, x):
+            return ctx.logits(params, x)
+
     def per_shard(params, prompt):
         b = prompt.shape[0]
         lp = {kk: params[kk] for kk in ctx.layer_keys}
@@ -192,7 +206,7 @@ def _build_speculative(mesh, cfg: TransformerConfig, s_prompt: int,
                 x, kc, vc = _window_pass(ctx, params, lp, kc, vc,
                                          t[:, None], c,
                                          range(draft_layers), cache_len)
-                t = jnp.argmax(ctx.logits(params, x[:, 0]),
+                t = jnp.argmax(draft_logits(params, x[:, 0]),
                                axis=-1).astype(jnp.int32)
                 drafts.append(t)
                 c = c + 1
@@ -241,35 +255,72 @@ def _build_speculative(mesh, cfg: TransformerConfig, s_prompt: int,
 def speculative_generate(params, prompt, mesh, cfg: TransformerConfig,
                          n_new: int, k: int = 4,
                          draft_layers: int | None = None,
-                         return_stats: bool = False):
+                         return_stats: bool = False,
+                         drafter: str = "auto"):
     """Greedy continuation via self-speculative multi-token decode.
 
     Token-identical to ``greedy_generate(params, prompt, mesh, cfg,
-    n_new)`` for any ``k``/``draft_layers`` — the speculation changes
-    the *cost structure* (weights read once per accepted window, not
-    once per token), never the sampled sequence.
+    n_new)`` for any ``k``/``draft_layers``/``drafter`` — the
+    speculation changes the *cost structure* (weights read once per
+    accepted window, not once per token), never the sampled sequence:
+    every committed token is the verify pass's full-model argmax.
 
     Args:
       k: verify-window width — 1 pending + ``k-1`` draft tokens per
         weights pass (``k=1`` degenerates to baseline single-token).
-      draft_layers: truncated drafter depth (default ``n_layers // 2``,
-        min 1). ``draft_layers == n_layers`` makes the drafter exact
+      draft_layers: truncated drafter depth. Default: the trained
+        head's exit depth (``draft.draft_exit_layer``) under
+        ``drafter="trained"``, else ``n_layers // 2`` (min 1).
+        ``draft_layers == n_layers`` makes the shared drafter exact
         and the acceptance rate 1.0 (every step commits k tokens).
       return_stats: also return the acceptance telemetry dict.
+      drafter: ``"shared"`` = the r7 free drafter (truncated depth
+        through the shared ``ln_f``/``w_out`` head), ``"trained"`` =
+        the trained early-exit draft head (requires ``cfg.draft_head``
+        and the ``draft_*`` param branch), ``"auto"`` = trained when
+        the config arms it, shared otherwise.
 
     Acceptance counters flow through ``icikit.obs``
     (``decode.spec.*`` counters + an ``acceptance`` observation) —
     one device readback per *generation*, after the jitted loop; the
     accept/commit logic itself runs on device.
     """
+    if drafter not in ("auto", "shared", "trained"):
+        raise ValueError(f"unknown drafter {drafter!r} "
+                         "(known: auto, shared, trained)")
+    if drafter == "auto":
+        drafter = "trained" if cfg.draft_head else "shared"
+    if drafter == "trained":
+        if not cfg.draft_head:
+            raise ValueError("drafter='trained' requires a config with "
+                             "draft_head=True (the head's exit depth "
+                             "and rank live on the config)")
+        if "draft_ln" not in params:
+            raise ValueError(
+                "drafter='trained' but params carry no draft_* branch "
+                "— init_params with cfg.draft_head (and train the "
+                "head: an untrained head drafts exactly like 'shared')")
+        if draft_layers is None:
+            from icikit.models.transformer.draft import draft_exit_layer
+            draft_layers = draft_exit_layer(cfg)
     if draft_layers is None:
         draft_layers = max(1, cfg.n_layers // 2)
+    # chaos sites (host boundaries of the decode pipeline): prefill/
+    # program dispatch, drafter selection, and the stats readback —
+    # drilled by tests/test_chaos_decode.py
+    chaos.maybe_delay("decode.spec.prefill")
+    chaos.maybe_die("decode.spec.prefill")
+    chaos.maybe_delay(f"decode.spec.drafter.{drafter}")
+    chaos.maybe_die(f"decode.spec.drafter.{drafter}")
     with obs.span("decode.speculative", k=k, draft_layers=draft_layers,
-                  n_new=n_new):
+                  n_new=n_new, drafter=drafter):
         toks, stats = _build_speculative(
             mesh, cfg, prompt.shape[1], n_new, int(k),
-            int(draft_layers))(params, prompt)
-        s = np.asarray(stats)
+            int(draft_layers), drafter)(params, prompt)
+        # SDC drill on the telemetry boundary: a corrupted stats
+        # readback must skew counters only, never the committed tokens
+        s = chaos.maybe_corrupt("decode.spec.verify.stats",
+                                np.asarray(stats))
     steps = int(s[_S_ITERS])
     row_steps = int(s[_S_ROW_STEPS])
     accepted = int(s[_S_ACCEPTED])
@@ -282,6 +333,7 @@ def speculative_generate(params, prompt, mesh, cfg: TransformerConfig,
     if not return_stats:
         return toks
     return toks, {
+        "drafter": drafter,
         "verify_steps": steps,
         "row_steps": row_steps,
         "draft_proposed": proposed,

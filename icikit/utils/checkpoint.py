@@ -17,6 +17,7 @@ from __future__ import annotations
 import os
 
 import jax
+import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec
 
 from icikit import chaos
@@ -37,9 +38,66 @@ def _abstract_like(tree, mesh=None):
         sharding = getattr(x, "sharding", None)
         if mesh is not None and not isinstance(sharding, NamedSharding):
             sharding = NamedSharding(mesh, PartitionSpec())
+        if isinstance(sharding, NamedSharding):
+            # normalize trailing-None spec padding: jitted programs
+            # emit arrays with the stripped spelling, so a restore
+            # target carrying the padded one (e.g. straight out of
+            # init_params' device_put) would hand the training loop
+            # avals it was never traced with — one spurious recompile
+            # per resume, and on this jax a numerically drifting one
+            # (see TrainCheckpointer.restore's placed())
+            spec = tuple(sharding.spec)
+            while spec and spec[-1] is None:
+                spec = spec[:-1]
+            sharding = NamedSharding(sharding.mesh,
+                                     PartitionSpec(*spec))
         return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=sharding)
 
     return jax.tree_util.tree_map(one, tree)
+
+
+def _is_namedtuple(x) -> bool:
+    return isinstance(x, tuple) and hasattr(x, "_fields")
+
+
+def _merge_restored(like, saved):
+    """Overlay a raw-restored checkpoint tree onto ``like``: positions
+    absent from the checkpoint keep ``like``'s freshly initialized
+    values, saved-only leaves are dropped, overlapping leaves take the
+    checkpointed value. ``saved`` is the tree as Orbax reconstructs it
+    WITHOUT a target — dicts for saved namedtuples, lists for tuples,
+    ``None`` for empty nodes — and the result is rebuilt with
+    ``like``'s container types."""
+    if saved is None:
+        # empty node (e.g. optax EmptyState): no leaves either way
+        return like
+    if isinstance(like, dict):
+        if not isinstance(saved, dict):
+            raise ValueError(
+                "lenient restore: target holds a dict where the "
+                f"checkpoint holds {type(saved).__name__} — only "
+                "added/removed dict leaves can be reconciled")
+        return {k: (_merge_restored(v, saved[k]) if k in saved else v)
+                for k, v in like.items()}
+    if _is_namedtuple(like):
+        if not isinstance(saved, dict) or set(saved) != set(like._fields):
+            raise ValueError(
+                "lenient restore: checkpoint node does not match "
+                f"target {type(like).__name__}{like._fields} — a "
+                "changed optimizer link is structural, only "
+                "added/removed dict leaves can be reconciled")
+        return type(like)(*[_merge_restored(getattr(like, f), saved[f])
+                            for f in like._fields])
+    if isinstance(like, (list, tuple)):
+        if not isinstance(saved, (list, tuple)) or len(saved) != len(like):
+            raise ValueError(
+                "lenient restore: checkpoint and target disagree on a "
+                "tuple-structured node (optimizer state built with "
+                "different flags?) — only added/removed dict leaves "
+                "can be reconciled")
+        kids = [_merge_restored(l, s) for l, s in zip(like, saved)]
+        return type(like)(kids)
+    return saved
 
 
 class TrainCheckpointer:
@@ -96,20 +154,84 @@ class TrainCheckpointer:
         self._mgr.wait_until_finished()
         return self._mgr.latest_step()
 
-    def restore(self, like, step: int | None = None, mesh=None):
+    def restore(self, like, step: int | None = None, mesh=None,
+                missing_ok: bool = False):
         """Return (step, state) with ``like``'s shardings (non-mesh
         leaves replicated onto ``mesh`` when given); raises
-        FileNotFoundError when the directory holds no checkpoint."""
+        FileNotFoundError when the directory holds no checkpoint.
+
+        ``missing_ok=True`` reconciles *added/removed dict leaves*
+        between the checkpoint and ``like`` instead of failing on the
+        structure mismatch: leaves ``like`` has but the checkpoint
+        lacks keep their freshly initialized values, and checkpointed
+        leaves ``like`` no longer wants are dropped. This is the
+        upgrade/downgrade path for optional param branches — e.g. the
+        trained draft head: a pre-draft checkpoint resumes into a
+        ``--draft-head`` run (the head starts fresh mid-distill), and
+        a draft checkpoint still loads into a plain trunk. Tuple-
+        structured nodes (optimizer chain links) must still match —
+        those changes are structural and stay a hard error."""
         self._mgr.wait_until_finished()  # drain any in-flight save
         if step is None:
             step = self._mgr.latest_step()
         if step is None:
             raise FileNotFoundError(
                 f"no checkpoint found under {self._mgr.directory}")
-        state = self._mgr.restore(
-            step,
-            args=self._ocp.args.StandardRestore(_abstract_like(like, mesh)))
-        return step, state
+
+        def placed(target):
+            # Two silent restore defects are healed here, both found
+            # by the resume-bitwise pin (diagnosed r8):
+            #
+            # 1. Orbax can fill REPLICATED shards inconsistently on
+            #    this stack: for a leaf replicated over dp, the
+            #    replica rows beyond the first come back with
+            #    different bytes. ``np.asarray`` reads replica 0, so
+            #    value checks pass — but the computation on the other
+            #    dp rows consumes the bad copies and the resumed run
+            #    silently diverges.
+            # 2. Restored shardings carry trailing-None-padded
+            #    PartitionSpecs (a different spelling than jit
+            #    outputs), so the next train step recompiles against
+            #    avals it was never run with.
+            #
+            # One host round-trip per leaf fixes both: pull the
+            # replica-0 bytes and re-place them with a fresh
+            # device_put onto the (normalized, see _abstract_like)
+            # target sharding — placement and replication are then
+            # done by jax, not trusted from the reader. Restores are
+            # rare and teaching-scale; correctness beats the copy.
+            # Multi-host arrays are not fully addressable and keep the
+            # direct restore.
+            state = self._mgr.restore(
+                step, args=self._ocp.args.StandardRestore(target))
+
+            def replace(t, x):
+                sharding = getattr(t, "sharding", None)
+                if (sharding is None
+                        or not getattr(x, "is_fully_addressable", True)):
+                    return x
+                return jax.device_put(np.asarray(x), sharding)
+
+            return jax.tree_util.tree_map(replace, target, state)
+
+        if missing_ok:
+            # no target: Orbax reconstructs the SAVED tree as plain
+            # containers (this needs no item metadata, which a fresh
+            # manager on a cold directory does not always expose);
+            # merge onto ``like`` and re-place every leaf exactly as
+            # the strict path does
+            raw = self._mgr.restore(
+                step, args=self._ocp.args.StandardRestore())
+            merged = _merge_restored(like, raw)
+            target = _abstract_like(like, mesh)
+            return step, jax.tree_util.tree_map(
+                lambda t, x: (jax.device_put(np.asarray(x), t.sharding)
+                              if getattr(t, "sharding", None) is not None
+                              and getattr(x, "is_fully_addressable",
+                                          True)
+                              else x),
+                target, merged)
+        return step, placed(_abstract_like(like, mesh))
 
     def close(self) -> None:
         self._mgr.close()

@@ -38,6 +38,66 @@ def test_decode_bench_speculative():
     assert rec["backend"]  # provenance: rows from CPU and TPU differ
 
 
+def test_decode_bench_trained_drafter():
+    """--drafter trained builds the draft branch (random-init) and the
+    row carries the drafter tag — the wall-time machinery path."""
+    rec = run_bench("tiny", dp=1, tp=1, batch=2, prompt_len=8, n_new=8,
+                    runs=1, speculate=2, draft_layers=1,
+                    drafter="trained")
+    assert rec["drafter"] == "trained"
+    assert "_spec2d1_trained" in rec["metric"]
+    assert 0.0 <= rec["acceptance_rate"] <= 1.0
+
+
+def test_cost_model_from_records(tmp_path):
+    """The reproducible-verdict path: measured acceptance rows in,
+    priced projection rows out — last row per (k, L_d, drafter) wins,
+    depth fractions map onto the pricing preset."""
+    import json
+    from icikit.bench.decode import cost_model_rows
+    path = tmp_path / "acc.jsonl"
+    rows = [
+        # superseded older measurement (lower α) — must NOT be priced
+        {"kind": "acceptance", "batch": 1, "k": 2, "draft_layers": 1,
+         "n_layers": 4, "drafter": "trained", "acceptance_rate": 0.10,
+         "train_steps": 100},
+        {"kind": "acceptance", "batch": 1, "k": 2, "draft_layers": 1,
+         "n_layers": 4, "drafter": "trained", "acceptance_rate": 0.40,
+         "train_steps": 3000},
+        # r7-style row without a drafter field -> "shared"
+        {"kind": "acceptance", "batch": 1, "k": 2, "draft_layers": 2,
+         "n_layers": 4, "acceptance_rate": 0.15, "train_steps": 3000},
+        # other batch: excluded at alpha_batch=1
+        {"kind": "acceptance", "batch": 8, "k": 2, "draft_layers": 1,
+         "n_layers": 4, "drafter": "trained", "acceptance_rate": 0.9},
+    ]
+    with open(path, "w") as f:
+        for r in rows:
+            f.write(json.dumps(r) + "\n")
+    out = cost_model_rows(str(path), preset="base", alpha_batch=1)
+    assert len(out) == 2
+    by = {(r["k"], r["draft_fraction"], r["drafter"]): r for r in out}
+    tr = by[(2, 0.25, "trained")]
+    assert tr["measured_acceptance"] == 0.40          # latest row won
+    assert tr["draft_layers"] == 3                    # 12 * 0.25
+    assert tr["alpha_train_steps"] == 3000
+    # α=0.40 beats the ~0.336 quarter-depth break-even
+    assert tr["measured_acceptance"] > tr["breakeven_acceptance"]
+    assert tr["projected_eff_ms_per_token"] < tr["model_floor_ms"]
+    sh = by[(2, 0.5, "shared")]
+    assert sh["draft_layers"] == 6
+    assert sh["measured_acceptance"] < sh["breakeven_acceptance"]
+
+
+def test_cost_model_requires_acceptance_rows(tmp_path):
+    import pytest
+    from icikit.bench.decode import cost_model_rows
+    path = tmp_path / "empty.jsonl"
+    path.write_text("")
+    with pytest.raises(ValueError, match="acceptance"):
+        cost_model_rows(str(path))
+
+
 def test_spec_cost_model_anchors():
     """At tokens_per_step = 1 and k = 1 the model must reproduce the
     baseline floor exactly (no drafts, one verify pass = one

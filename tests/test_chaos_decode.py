@@ -1,0 +1,128 @@
+"""Chaos injection sites in the decode path (speculative.py/decode.py)
+— the first slice of ROADMAP's "chaos coverage for the remaining
+pipelines".
+
+Sites drilled:
+
+- ``decode.prefill``          — greedy/sampled generate dispatch
+- ``decode.spec.prefill``     — speculative program dispatch
+- ``decode.spec.drafter.*``   — drafter selection (site-named per
+                                drafter, so a drill can target the
+                                trained head specifically)
+- ``decode.spec.verify.stats``— SDC drill on the acceptance-stats
+                                readback: corrupt telemetry must skew
+                                counters only, never committed tokens
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from icikit import chaos
+from icikit.models.transformer import (
+    TransformerConfig,
+    init_params,
+    speculative_generate,
+)
+from icikit.models.transformer.decode import greedy_generate
+from icikit.models.transformer.model import make_model_mesh
+
+CFG = TransformerConfig(vocab=61, d_model=32, n_heads=2, d_head=8,
+                        d_ff=64, n_layers=2, max_seq=32,
+                        compute_dtype="float32")
+
+
+def _setup():
+    mesh = make_model_mesh(dp=1, tp=1, sp=1)
+    params = init_params(jax.random.key(0), CFG, mesh)
+    rng = np.random.default_rng(0)
+    pd = jnp.asarray(rng.integers(0, 61, (2, 8)), jnp.int32)
+    return mesh, params, pd
+
+
+def test_decode_prefill_die_site():
+    mesh, params, pd = _setup()
+    plan = chaos.FaultPlan(schedule={"die:decode.prefill": (0,)})
+    with chaos.inject(plan):
+        with pytest.raises(chaos.InjectedDeath):
+            greedy_generate(params, pd, mesh, CFG, 4)
+        # next call: that schedule index is consumed — recovery is
+        # a plain retry
+        out = greedy_generate(params, pd, mesh, CFG, 4)
+    assert out.shape == (2, 12)
+    assert plan.fired("die", "decode.prefill") == 1
+
+
+def test_spec_prefill_and_drafter_die_sites():
+    mesh, params, pd = _setup()
+    # the first call dies at prefill BEFORE reaching the drafter
+    # probe, so the drafter site's call counter is still 0 when the
+    # second call gets there
+    plan = chaos.FaultPlan(schedule={
+        "die:decode.spec.prefill": (0,),
+        "die:decode.spec.drafter.shared": (0,),
+    })
+    with chaos.inject(plan):
+        with pytest.raises(chaos.InjectedDeath):
+            speculative_generate(params, pd, mesh, CFG, 4, k=2)
+        with pytest.raises(chaos.InjectedDeath):
+            # second pass survives prefill, dies at drafter dispatch
+            speculative_generate(params, pd, mesh, CFG, 4, k=2)
+        out = speculative_generate(params, pd, mesh, CFG, 4, k=2)
+    assert out.shape == (2, 12)
+    assert plan.fired("die", "decode.spec.*") == 2
+
+
+def test_spec_drafter_site_is_named_per_drafter():
+    """A drill targeting the trained drafter must not fire on shared
+    dispatches (and vice versa) — the site name carries the drafter."""
+    import dataclasses
+    cfg = dataclasses.replace(CFG, draft_head=True, draft_layers=1,
+                              draft_rank=4)
+    mesh = make_model_mesh(dp=1, tp=1, sp=1)
+    params = init_params(jax.random.key(0), cfg, mesh)
+    rng = np.random.default_rng(0)
+    pd = jnp.asarray(rng.integers(0, 61, (2, 8)), jnp.int32)
+    plan = chaos.FaultPlan(
+        schedule={"die:decode.spec.drafter.trained": (0, 1, 2)})
+    with chaos.inject(plan):
+        # shared dispatch sails through the trained-only drill
+        speculative_generate(params, pd, mesh, cfg, 4, k=2,
+                             drafter="shared")
+        with pytest.raises(chaos.InjectedDeath):
+            speculative_generate(params, pd, mesh, cfg, 4, k=2,
+                                 drafter="trained")
+    assert plan.fired("die", "decode.spec.drafter.trained") == 1
+    assert plan.fired("die", "decode.spec.drafter.shared") == 0
+
+
+def test_spec_stats_corruption_skews_telemetry_not_tokens():
+    """The SDC drill at the stats readback: committed tokens are
+    unaffected (they never pass through the stats vector), telemetry
+    stays JSON-safe."""
+    import json
+    mesh, params, pd = _setup()
+    base = np.asarray(speculative_generate(params, pd, mesh, CFG, 6,
+                                           k=2))
+    plan = chaos.FaultPlan(
+        schedule={"corrupt:decode.spec.verify.stats": (0,)})
+    with chaos.inject(plan):
+        out, st = speculative_generate(params, pd, mesh, CFG, 6, k=2,
+                                       return_stats=True)
+    assert plan.fired("corrupt", "decode.spec.verify.stats") == 1
+    np.testing.assert_array_equal(np.asarray(out), base)
+    json.dumps(st)   # telemetry must stay serializable even when skewed
+
+
+def test_spec_delay_sites_fire_without_changing_output():
+    mesh, params, pd = _setup()
+    base = np.asarray(speculative_generate(params, pd, mesh, CFG, 6,
+                                           k=3))
+    plan = chaos.FaultPlan(rates={"delay:decode.spec.*": 1.0},
+                           delay_s=0.001)
+    with chaos.inject(plan):
+        out = speculative_generate(params, pd, mesh, CFG, 6, k=3)
+    np.testing.assert_array_equal(np.asarray(out), base)
+    assert plan.fired("delay", "decode.spec.prefill") == 1
+    assert plan.fired("delay", "decode.spec.drafter.shared") == 1

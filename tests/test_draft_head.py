@@ -1,0 +1,271 @@
+"""Trained early-exit draft head tests (models/transformer/draft.py).
+
+The load-bearing invariants:
+
+1. **Token identity is drafter-independent** — ``speculative_generate``
+   with the trained head (any head state, trained or random) stays
+   token-identical to baseline greedy across meshes, GQA, rope and the
+   vocab-parallel head: committed tokens are always the verify pass's
+   full-model argmax.
+2. **The head is a pure add-on to training** — arming ``draft_head``
+   leaves the trunk's gradients (and the trunk's init) bitwise
+   unchanged: x_mid and the tied unembedding enter the distill loss
+   under stop_gradient, so only ``draft_*`` leaves move from it.
+3. **Zero-init equivalence** — the freshly initialized head (zero
+   adapter, unit norm scale, tied table) IS the r7 shared-head
+   drafter: identical draft tokens, identical acceptance.
+4. Distillation learns (draft loss falls, top-1 agreement rises), and
+   the optimizer param group (``draft_lr_mult``) really scopes to the
+   ``draft_*`` leaves.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from icikit.models.transformer import (
+    TransformerConfig,
+    init_params,
+    make_train_step,
+    speculative_generate,
+)
+from icikit.models.transformer.decode import greedy_generate
+from icikit.models.transformer.model import (
+    loss_and_metrics,
+    loss_fn,
+    make_model_mesh,
+    param_specs,
+)
+
+CFG = TransformerConfig(vocab=61, d_model=32, n_heads=4, d_head=8,
+                        d_ff=64, n_layers=4, max_seq=48,
+                        compute_dtype="float32",
+                        draft_head=True, draft_layers=1, draft_rank=8)
+
+
+def _prompt(mesh, b=3, s=8, vocab=61, seed=0):
+    rng = np.random.default_rng(seed)
+    return jax.device_put(
+        jnp.asarray(rng.integers(0, vocab, (b, s)), jnp.int32),
+        NamedSharding(mesh, P("dp", None)))
+
+
+def _perturbed(params, scale=0.5, seed=3):
+    """A *non-trivially wrong* draft head: random adapter B — drafts
+    must now disagree with the shared head, and identity must hold
+    anyway."""
+    k = jax.random.key(seed)
+    return {**params,
+            "draft_b": scale * jax.random.normal(
+                k, params["draft_b"].shape, jnp.float32)}
+
+
+def test_param_branch_and_trunk_init_parity():
+    mesh = make_model_mesh(dp=1, tp=1, sp=1)
+    cfg0 = dataclasses.replace(CFG, draft_head=False)
+    p0 = init_params(jax.random.key(0), cfg0, mesh)
+    p1 = init_params(jax.random.key(0), CFG, mesh)
+    assert {"draft_ln", "draft_a", "draft_b"} == set(p1) - set(p0)
+    for k in p0:  # arming the head must not reshuffle the trunk init
+        np.testing.assert_array_equal(np.asarray(p0[k]),
+                                      np.asarray(p1[k]))
+    assert p1["draft_a"].shape == (CFG.d_model, CFG.draft_rank)
+    assert not np.any(np.asarray(p1["draft_b"]))   # zero adapter
+
+
+def test_zero_init_head_is_the_shared_drafter():
+    """Fresh head == r7 shared-head drafter: same drafts, same
+    acceptance, token for token."""
+    mesh = make_model_mesh(dp=1, tp=1, sp=1)
+    params = init_params(jax.random.key(0), CFG, mesh)
+    pd = _prompt(mesh)
+    out_t, st_t = speculative_generate(params, pd, mesh, CFG, 10, k=3,
+                                       draft_layers=1,
+                                       drafter="trained",
+                                       return_stats=True)
+    out_s, st_s = speculative_generate(params, pd, mesh, CFG, 10, k=3,
+                                       draft_layers=1,
+                                       drafter="shared",
+                                       return_stats=True)
+    np.testing.assert_array_equal(np.asarray(out_t), np.asarray(out_s))
+    assert st_t["acceptance_rate"] == st_s["acceptance_rate"]
+    assert st_t["drafter"] == "trained"
+
+
+@pytest.mark.parametrize("k", [2, 4])
+def test_trained_head_token_identity(k):
+    """A deliberately WRONG head still yields baseline-greedy tokens —
+    the accept loop only ever commits full-model argmaxes."""
+    mesh = make_model_mesh(dp=1, tp=1, sp=1)
+    params = _perturbed(init_params(jax.random.key(0), CFG, mesh))
+    pd = _prompt(mesh)
+    base = np.asarray(greedy_generate(params, pd, mesh, CFG, n_new=10))
+    got = np.asarray(speculative_generate(params, pd, mesh, CFG, 10,
+                                          k=k, drafter="trained"))
+    np.testing.assert_array_equal(got, base)
+
+
+@pytest.mark.parametrize("dp,tp", [(1, 4), (2, 2)])
+@pytest.mark.parametrize("variant", ["dense", "rope", "vocab_parallel",
+                                     "gqa", "untied"])
+def test_trained_head_identity_sharded(dp, tp, variant):
+    over = {"rope": {"pos_encoding": "rope"},
+            "vocab_parallel": {"vocab_parallel": True},
+            "gqa": {"n_kv_heads": 2},
+            "untied": {"draft_tied": False},
+            "dense": {}}[variant]
+    if variant == "gqa" and 2 % tp:
+        pytest.skip("kv heads must divide over tp")
+    cfg = TransformerConfig(vocab=64, d_model=32, n_heads=4, d_head=8,
+                            d_ff=64, n_layers=3, max_seq=32,
+                            compute_dtype="float32",
+                            draft_head=True, draft_layers=1,
+                            draft_rank=4, **over)
+    mesh = make_model_mesh(dp=dp, tp=tp, sp=1)
+    params = _perturbed(init_params(jax.random.key(0), cfg, mesh))
+    pd = _prompt(mesh, b=4, s=6, vocab=64, seed=1)
+    base = np.asarray(greedy_generate(params, pd, mesh, cfg, n_new=8))
+    got = np.asarray(speculative_generate(params, pd, mesh, cfg, 8,
+                                          k=3, drafter="trained"))
+    np.testing.assert_array_equal(got, base)
+
+
+def test_auto_drafter_resolution_and_validation():
+    mesh = make_model_mesh(dp=1, tp=1, sp=1)
+    params = init_params(jax.random.key(0), CFG, mesh)
+    pd = _prompt(mesh)
+    # auto on a draft cfg -> trained (reported in stats)
+    _, st = speculative_generate(params, pd, mesh, CFG, 6, k=2,
+                                 return_stats=True)
+    assert st["drafter"] == "trained"
+    # default draft_layers under trained = the configured exit depth
+    cfg0 = dataclasses.replace(CFG, draft_head=False)
+    p0 = init_params(jax.random.key(0), cfg0, mesh)
+    _, st0 = speculative_generate(p0, pd, mesh, cfg0, 6, k=2,
+                                  return_stats=True)
+    assert st0["drafter"] == "shared"
+    with pytest.raises(ValueError, match="drafter"):
+        speculative_generate(p0, pd, mesh, cfg0, 6, k=2,
+                             drafter="bogus")
+    with pytest.raises(ValueError, match="draft_head"):
+        speculative_generate(p0, pd, mesh, cfg0, 6, k=2,
+                             drafter="trained")
+    with pytest.raises(ValueError, match="draft_"):
+        # draft cfg but params missing the branch
+        speculative_generate(p0, pd, mesh, CFG, 6, k=2,
+                             drafter="trained")
+
+
+def test_distill_is_invisible_to_trunk_gradients():
+    """The satellite pin for "stop-gradient through the trunk": with
+    the head armed, every trunk leaf's gradient is BITWISE the
+    no-draft gradient (loss differs — the draft term rides on top —
+    but only draft_* leaves feel it)."""
+    mesh = make_model_mesh(dp=1, tp=1, sp=1)
+    cfg0 = dataclasses.replace(CFG, draft_head=False)
+    p0 = init_params(jax.random.key(0), cfg0, mesh)
+    p1 = init_params(jax.random.key(0), CFG, mesh)
+    rng = np.random.default_rng(0)
+    tok = jnp.asarray(rng.integers(0, 61, (4, 16)), jnp.int32)
+    tgt = jnp.asarray(rng.integers(0, 61, (4, 16)), jnp.int32)
+    l0, g0 = loss_fn(p0, tok, tgt, mesh, cfg0)
+    l1, g1, m1 = loss_and_metrics(p1, tok, tgt, mesh, CFG)
+    assert float(l1) > float(l0)      # the draft CE+KL term is in there
+    for k in g0:
+        np.testing.assert_array_equal(np.asarray(g0[k]),
+                                      np.asarray(g1[k]))
+    for k in ("draft_ln", "draft_a", "draft_b"):
+        assert k in g1
+    assert set(m1) == {"draft_loss", "draft_top1_agree"}
+    assert np.isfinite(float(m1["draft_loss"]))
+
+
+def test_distillation_learns():
+    """A few dozen steps on a fixed batch: draft loss drops, top-1
+    agreement with the teacher rises far above the untrained start."""
+    import optax
+    mesh = make_model_mesh(dp=1, tp=1, sp=1)
+    params = init_params(jax.random.key(0), CFG, mesh)
+    _, step = make_train_step(mesh, CFG, optax.adam(1e-2))
+    st = optax.adam(1e-2).init(params)
+    rng = np.random.default_rng(0)
+    tok = jnp.asarray(rng.integers(0, 61, (4, 16)), jnp.int32)
+    tgt = jnp.asarray(rng.integers(0, 61, (4, 16)), jnp.int32)
+    first = None
+    for i in range(30):
+        params, st, loss, metrics = step(params, st, tok, tgt)
+        if first is None:
+            first = {k: float(v) for k, v in metrics.items()}
+    last = {k: float(v) for k, v in metrics.items()}
+    assert last["draft_loss"] < first["draft_loss"] * 0.7
+    assert last["draft_top1_agree"] > first["draft_top1_agree"] + 0.2
+
+
+def test_draft_lr_mult_scopes_to_the_head():
+    """draft_lr_mult=0 freezes exactly the draft branch: trunk leaves
+    move, draft leaves hold bitwise."""
+    from icikit.models.transformer.optim import make_optimizer
+    mesh = make_model_mesh(dp=1, tp=1, sp=1)
+    params = init_params(jax.random.key(0), CFG, mesh)
+    tx = make_optimizer(1e-2, draft_lr_mult=0.0)
+    _, step = make_train_step(mesh, CFG, tx)
+    st = tx.init(params)
+    rng = np.random.default_rng(0)
+    tok = jnp.asarray(rng.integers(0, 61, (4, 16)), jnp.int32)
+    tgt = jnp.asarray(rng.integers(0, 61, (4, 16)), jnp.int32)
+    new, _, _, _ = step(params, st, tok, tgt)
+    for k in params:
+        if k.startswith("draft_"):
+            np.testing.assert_array_equal(
+                np.asarray(new[k]), np.asarray(params[k]),
+                err_msg=f"{k} moved under draft_lr_mult=0")
+    for k in ("w1", "w2", "wqkv", "emb"):
+        assert not np.array_equal(np.asarray(new[k]),
+                                  np.asarray(params[k]))
+
+
+def test_vocab_parallel_distill_matches_replicated():
+    """The distributed CE/KL/argmax reductions under the Megatron head
+    reproduce the replicated-head draft metrics (same params, same
+    batch, tp=4 vs tp=1)."""
+    cfg_r = TransformerConfig(vocab=64, d_model=32, n_heads=4, d_head=8,
+                              d_ff=64, n_layers=2, max_seq=16,
+                              compute_dtype="float32",
+                              draft_head=True, draft_layers=1,
+                              draft_rank=4)
+    cfg_v = dataclasses.replace(cfg_r, vocab_parallel=True)
+    mesh1 = make_model_mesh(dp=1, tp=1, sp=1)
+    mesh4 = make_model_mesh(dp=1, tp=4, sp=1)
+    params1 = _perturbed(init_params(jax.random.key(0), cfg_r, mesh1))
+    specs_v = param_specs(cfg_v)
+    params4 = {k: jax.device_put(np.asarray(v),
+                                 NamedSharding(mesh4, specs_v[k]))
+               for k, v in params1.items()}
+    rng = np.random.default_rng(1)
+    tok = jnp.asarray(rng.integers(0, 64, (2, 16)), jnp.int32)
+    tgt = jnp.asarray(rng.integers(0, 64, (2, 16)), jnp.int32)
+    l1, _, m1 = loss_and_metrics(params1, tok, tgt, mesh1, cfg_r)
+    l4, _, m4 = loss_and_metrics(params4, tok, tgt, mesh4, cfg_v)
+    assert float(m1["draft_top1_agree"]) == pytest.approx(
+        float(m4["draft_top1_agree"]), abs=1e-6)
+    assert float(m1["draft_loss"]) == pytest.approx(
+        float(m4["draft_loss"]), rel=2e-5)
+
+
+def test_config_validation():
+    # validation fires at param_specs (_check_cfg), like every other
+    # config knob
+    with pytest.raises(ValueError, match="draft_layers"):
+        param_specs(TransformerConfig(n_layers=2, draft_head=True,
+                                      draft_layers=5))
+    with pytest.raises(ValueError, match="draft_rank"):
+        param_specs(TransformerConfig(draft_head=True, draft_rank=0))
+    with pytest.raises(ValueError, match="draft_kl"):
+        param_specs(TransformerConfig(draft_head=True, draft_kl=1.5))
+    with pytest.raises(ValueError, match="save_stack"):
+        param_specs(TransformerConfig(draft_head=True,
+                                      save_stack="pallas"))

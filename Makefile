@@ -5,7 +5,7 @@
 PY ?= python
 
 .PHONY: test test-fast chaos bench native clean sweep scaling northstar \
-	trace-demo check decode-smoke draft-smoke
+	trace-demo check decode-smoke draft-smoke serve-smoke
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -42,6 +42,12 @@ check:
 		echo "$$bad"; exit 1; \
 	fi
 	@echo "check OK: no bare print(json.dumps telemetry outside icikit/obs/"
+	@bad=$$(grep -rn "time\.time(" icikit/serve --include='*.py'); \
+	if [ -n "$$bad" ]; then \
+		echo "wall clock in icikit/serve — SLO math must use time.monotonic:"; \
+		echo "$$bad"; exit 1; \
+	fi
+	@echo "check OK: icikit/serve SLO clocks are monotonic"
 
 # multi-token decode smoke: a tiny CPU speculative decode under an
 # armed obs session — the acceptance counters/spans must flow and the
@@ -74,6 +80,30 @@ draft-smoke:
 	@grep -q "draft.loss" /tmp/icikit_draft_metrics.json && \
 		grep -q "decode.spec.draft_accepted" /tmp/icikit_draft_metrics.json && \
 		echo "draft-smoke OK: trace valid, distill + trained-drafter metrics present"
+
+# continuous-batching serving smoke: a tiny Poisson-arrival engine run
+# under an armed obs session — the serve.request spans must pass the
+# structural trace validator and the SLO histograms must land in the
+# metrics snapshot — then the KV-page corruption drill end-to-end via
+# ICIKIT_CHAOS (the victim request fails its integrity verify, retries
+# on fresh blocks, the run completes, and --expect-chaos asserts the
+# probe actually fired)
+serve-smoke:
+	JAX_PLATFORMS=cpu \
+	ICIKIT_OBS="trace=/tmp/icikit_serve_trace.json;metrics=/tmp/icikit_serve_metrics.json;jsonl=off" \
+	$(PY) -m icikit.bench.serve --preset tiny --rows 2 --requests 6 \
+		--rate 50 --prompt 8 --new-min 4 --new-max 8 --block-size 4 \
+		--mode continuous --seed 0 > /dev/null
+	$(PY) -m icikit.obs.check /tmp/icikit_serve_trace.json
+	@grep -q "serve.ttft_ms" /tmp/icikit_serve_metrics.json && \
+		grep -q "serve.tpot_ms" /tmp/icikit_serve_metrics.json && \
+		echo "serve-smoke OK: trace valid, SLO histograms present"
+	JAX_PLATFORMS=cpu ICIKIT_CHAOS="seed=0;corrupt:serve.kv.page=@0" \
+	$(PY) -m icikit.bench.serve --preset tiny --rows 2 --requests 4 \
+		--rate 100 --prompt 8 --new-min 4 --new-max 8 --block-size 4 \
+		--integrity pages --mode continuous --seed 0 \
+		--expect-chaos corrupt:serve.kv.page > /dev/null
+	@echo "serve-smoke chaos OK: KV-page drill fired and the run completed"
 
 bench:
 	$(PY) bench.py

@@ -20,7 +20,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from icikit import obs
+from icikit import chaos, obs
 from icikit.parallel.allgather import all_gather_blocks
 from icikit.parallel.allreduce import all_reduce
 from icikit.parallel.alltoall import all_to_all_blocks
@@ -161,9 +161,19 @@ def sweep_collective(mesh, family: str, algorithm: str,
     """Benchmark one algorithm across a message-size sweep."""
     p = mesh_axis_size(mesh, axis)
     records = []
+    # chaos sites (ROADMAP 5c: the bench harness had none): a sweep-
+    # boundary crash/straggler drill, and an SDC probe on the verify
+    # payload — a flipped bit in the collective's output must flip
+    # `verified` to False in the record, proving the closed-form check
+    # actually polices the bytes it claims to
+    site = f"bench.harness.{family}"
+    chaos.maybe_delay(site)
+    chaos.maybe_die(site)
     for msize in sizes:
         run, verify = _setup(family, mesh, axis, msize, np.dtype(dtype))
-        verified = bool(verify(jax.block_until_ready(run(algorithm))))
+        out = np.asarray(jax.block_until_ready(run(algorithm)))
+        out = chaos.maybe_corrupt("bench.harness.verify", out)
+        verified = bool(verify(out))
         block_bytes = msize * np.dtype(dtype).itemsize
         bus_bytes = _bus_bytes(family, p, block_bytes)
         # Named host annotation around the whole timing loop so profiler

@@ -1,0 +1,319 @@
+"""Serving benchmark: Poisson arrivals, continuous batching vs static.
+
+The serving-side analog of ``icikit.bench.decode``: where that harness
+prices one generate call, this one prices a *traffic pattern* — N
+requests arriving as a Poisson process, each wanting its own number of
+new tokens — under the two batching disciplines the engine exists to
+compare:
+
+- ``continuous`` — :class:`icikit.serve.Engine`: requests admitted
+  into the fixed-width decode batch at step boundaries the moment a
+  row frees up; occupancy, not the slowest request, sets throughput.
+- ``static`` — the pre-engine discipline: wait until ``rows`` requests
+  have arrived, run one ``greedy_generate`` over the batch to the
+  *longest* request's length, repeat. Short rows idle inside the
+  batch and everyone's first token waits for the whole batch — the
+  two wastes continuous batching removes.
+
+Both modes replay the SAME seeded workload (arrival offsets, prompts,
+per-request lengths), so the comparison is at matched offered load.
+Outputs are per-request greedy decodes in both modes, so total useful
+tokens are identical by construction — the records differ only in
+wall-clock shape: sustained tokens/s, TTFT/TPOT/queue-wait p50/p99.
+
+Every record is backend-stamped. On CPU the absolute numbers measure
+the XLA:CPU decode stack (and the engine's per-step dispatch overhead,
+which a TPU run amortizes far better); the continuous-vs-static
+*ratio* is the portable claim — it comes from occupancy accounting,
+not from hardware speed. See docs/SERVING.md.
+
+CLI::
+
+    python -m icikit.bench.serve --preset tiny --rows 4 --requests 32 \
+        --rate 4 --prompt 16 --new-min 8 --new-max 48 --mode both
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+from icikit import chaos, obs
+
+
+def make_workload(n_requests: int, rate_rps: float, prompt_len: int,
+                  new_min: int, new_max: int, vocab: int,
+                  seed: int = 0) -> list:
+    """Seeded Poisson trace: ``[(offset_s, prompt, n_new), ...]`` with
+    exponential inter-arrivals at ``rate_rps`` and per-request lengths
+    uniform in ``[new_min, new_max]``."""
+    rng = np.random.default_rng(seed)
+    gaps = rng.exponential(1.0 / rate_rps, size=n_requests)
+    offsets = np.cumsum(gaps)
+    out = []
+    for i in range(n_requests):
+        prompt = rng.integers(0, vocab, (prompt_len,)).astype(np.int32)
+        n_new = int(rng.integers(new_min, new_max + 1))
+        out.append((float(offsets[i]), prompt, n_new))
+    return out
+
+
+def _pcts(xs) -> dict:
+    if not xs:
+        return {"p50": None, "p99": None}
+    a = np.asarray(xs, np.float64)
+    return {"p50": round(float(np.percentile(a, 50)), 3),
+            "p99": round(float(np.percentile(a, 99)), 3)}
+
+
+def run_continuous(params, mesh, cfg, serve_cfg, workload,
+                   max_retries: int = 2) -> dict:
+    """Drive the engine over the arrival trace; returns the record."""
+    from icikit.serve import Engine, ServeConfig  # noqa: F401
+    eng = Engine(params, mesh, cfg, serve_cfg)
+    # warm the compiles (prefill at this prompt length + the step
+    # program) outside the timed window — both modes are warmed, so
+    # neither charges XLA compilation to the traffic
+    warm = eng.submit(workload[0][1], 2)
+    eng.run()
+    assert eng.queue.request(warm).state == "done"
+    eng.reset_stats()   # keep the warm-up out of occupancy/step figures
+    t0 = time.monotonic()
+    rids = [eng.submit(p, n, not_before=t0 + off, max_retries=max_retries)
+            for off, p, n in workload]
+    eng.run()
+    makespan = time.monotonic() - t0
+    ttft, tpot, qwait, tokens = [], [], [], 0
+    failed = 0
+    for rid in rids:
+        req = eng.queue.request(rid)
+        if req.state != "done":
+            failed += 1
+            continue
+        slo = req.slo()
+        tokens += len(req.tokens)
+        if "ttft_ms" in slo:
+            ttft.append(slo["ttft_ms"])
+        if "tpot_ms" in slo:
+            tpot.append(slo["tpot_ms"])
+        if "queue_wait_ms" in slo:
+            qwait.append(slo["queue_wait_ms"])
+    return {
+        "mode": "continuous",
+        "tokens": tokens,
+        "makespan_s": round(makespan, 4),
+        "tokens_per_s": round(tokens / makespan, 2),
+        "engine_steps": eng.n_steps,
+        "tokens_per_step_row": round(
+            tokens / max(1, eng.row_steps), 4),
+        "occupancy_mean": round(eng.occupancy_mean(), 4),
+        "completed": len(rids) - failed,
+        "failed": failed,
+        "retries": sum(eng.queue.request(r).attempts - 1 for r in rids),
+        "preemptions": sum(eng.queue.request(r).preempted
+                           for r in rids),
+        "ttft_ms": _pcts(ttft),
+        "tpot_ms": _pcts(tpot),
+        "queue_wait_ms": _pcts(qwait),
+    }
+
+
+def run_static(params, mesh, cfg, rows: int, workload) -> dict:
+    """The static-batch baseline at the same offered load: batches of
+    ``rows`` in arrival order, each decoded to its longest member.
+
+    TTFT here is batch-completion minus arrival — without continuous
+    admission (or streaming) a request's first token is not *available*
+    until its batch returns; TPOT is the batch's decode time per token
+    (every row pays the longest row's steps). That is the cost model
+    this baseline exists to expose, not an unfair handicap.
+    """
+    import jax.numpy as jnp
+
+    from icikit.models.transformer import greedy_generate
+    s_prompt = len(workload[0][1])
+    batches = [workload[i:i + rows]
+               for i in range(0, len(workload), rows)]
+
+    def gen(prompts, n_max):
+        return np.asarray(greedy_generate(
+            params, jnp.asarray(np.stack(prompts)), mesh, cfg, n_max))
+
+    # warm every (batch-shape, n_max) program outside the clock
+    for batch in batches:
+        prompts = [p for _, p, _ in batch]
+        while len(prompts) < rows:
+            prompts.append(prompts[-1])
+        gen(prompts, max(n for _, _, n in batch))
+
+    t0 = time.monotonic()
+    ttft, tpot, tokens = [], [], 0
+    for batch in batches:
+        arrivals = [t0 + off for off, _, _ in batch]
+        wait = max(arrivals) - time.monotonic()
+        if wait > 0:
+            time.sleep(wait)   # batch formation: wait for the last row
+        start = time.monotonic()
+        n_max = max(n for _, _, n in batch)
+        prompts = [p for _, p, _ in batch]
+        while len(prompts) < rows:  # ragged tail: pad, discard outputs
+            prompts.append(prompts[-1])
+        out = gen(prompts, n_max)
+        end = time.monotonic()
+        for (off, p, n), row in zip(batch, out):
+            tokens += n                     # kept tokens only
+            ttft.append((end - (t0 + off)) * 1e3)
+            tpot.append((end - start) / n_max * 1e3)
+        del out
+    makespan = time.monotonic() - t0
+    return {
+        "mode": "static",
+        "tokens": tokens,
+        "makespan_s": round(makespan, 4),
+        "tokens_per_s": round(tokens / makespan, 2),
+        "batches": len(batches),
+        # occupancy a static batch achieves: useful row-tokens over
+        # paid row-steps (rows idle behind the longest member)
+        "occupancy_mean": round(
+            tokens / sum(rows * max(n for _, _, n in b)
+                         for b in batches), 4),
+        "completed": len(workload),
+        "failed": 0,
+        "ttft_ms": _pcts(ttft),
+        "tpot_ms": _pcts(tpot),
+        "prompt_len": s_prompt,
+    }
+
+
+def run_bench(preset: str, rows: int, n_requests: int, rate_rps: float,
+              prompt_len: int, new_min: int, new_max: int,
+              block_size: int = 8, n_blocks: int = 0,
+              speculate: int = 1, ngram_n: int = 3,
+              integrity: str = "none", dp: int = 1, tp: int = 1,
+              seed: int = 0, mode: str = "both",
+              compute_dtype: str = "") -> list[dict]:
+    import jax
+
+    from icikit.bench.train import PRESETS
+    from icikit.models.transformer import TransformerConfig, init_params
+    from icikit.models.transformer.model import make_model_mesh
+    from icikit.serve import ServeConfig
+
+    over = dict(PRESETS[preset])
+    horizon = prompt_len + new_max + max(0, speculate - 1)
+    over["max_seq"] = max(over["max_seq"], horizon)
+    if compute_dtype:
+        # CPU protocol note: XLA:CPU re-packs bf16 weight operands to
+        # fp32 on every program call — generate's scanned loop hoists
+        # that conversion, the engine's per-call step cannot (measured
+        # 54 vs 27 ms per b=4 small-preset step), so a bf16 CPU row
+        # would charge the engine an XLA:CPU artifact a native-bf16
+        # TPU never pays. fp32 puts both modes on the same arithmetic.
+        over["compute_dtype"] = compute_dtype
+    cfg = TransformerConfig(**over)
+    mesh = make_model_mesh(dp=dp, tp=tp, sp=1)
+    params = init_params(jax.random.key(0), cfg, mesh)
+    if not n_blocks:
+        # enough for a full batch of worst-case rows plus slack
+        per_row = -(-horizon // block_size)
+        n_blocks = per_row * (rows // dp) + per_row
+    serve_cfg = ServeConfig(max_rows=rows, block_size=block_size,
+                            n_blocks=n_blocks, max_prompt=prompt_len,
+                            max_new=new_max, speculate_k=speculate,
+                            ngram_n=ngram_n, integrity=integrity)
+    workload = make_workload(n_requests, rate_rps, prompt_len, new_min,
+                             new_max, cfg.vocab, seed)
+    common = {
+        "kind": "serve",
+        "preset": preset,
+        "backend": jax.default_backend(),
+        "rows": rows, "dp": dp, "tp": tp,
+        "n_requests": n_requests,
+        "rate_rps": rate_rps,
+        "prompt_len": prompt_len,
+        "new_min": new_min, "new_max": new_max,
+        "block_size": block_size, "n_blocks": n_blocks,
+        "speculate": speculate,
+        "integrity": integrity,
+        "compute_dtype": cfg.compute_dtype,
+        "seed": seed,
+        # measured-where-we-ran provenance (the decode-bench rule):
+        # CPU rows price the ratio, a v5e session prices the absolute
+        "note": ("CPU-measured" if jax.default_backend() == "cpu"
+                 else "device-measured"),
+    }
+    recs = []
+    if mode in ("both", "continuous"):
+        recs.append({**common, **run_continuous(params, mesh, cfg,
+                                                serve_cfg, workload)})
+    if mode in ("both", "static"):
+        recs.append({**common, **run_static(params, mesh, cfg, rows,
+                                            workload)})
+    return recs
+
+
+def main(argv=None) -> int:
+    from icikit.bench.train import PRESETS
+
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--preset", default="tiny", choices=sorted(PRESETS))
+    ap.add_argument("--rows", type=int, default=4,
+                    help="engine batch width B / static batch size")
+    ap.add_argument("--requests", type=int, default=16)
+    ap.add_argument("--rate", type=float, default=4.0,
+                    help="Poisson arrival rate (requests/s)")
+    ap.add_argument("--prompt", type=int, default=16)
+    ap.add_argument("--new-min", type=int, default=8)
+    ap.add_argument("--new-max", type=int, default=32)
+    ap.add_argument("--block-size", type=int, default=8)
+    ap.add_argument("--blocks", type=int, default=0,
+                    help="KV pool blocks per dp shard (0 = sized to "
+                         "the batch)")
+    ap.add_argument("--speculate", type=int, default=1, metavar="K",
+                    help="k-token ngram-drafted verify windows "
+                         "(1 = single-token decode)")
+    ap.add_argument("--ngram-n", type=int, default=3)
+    ap.add_argument("--integrity", default="none",
+                    choices=["none", "pages"])
+    ap.add_argument("--dp", type=int, default=1)
+    ap.add_argument("--tp", type=int, default=1)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--mode", default="both",
+                    choices=["both", "continuous", "static"])
+    ap.add_argument("--compute-dtype", default="",
+                    help="override the preset's compute dtype (the "
+                         "committed CPU rows use float32 — see the "
+                         "XLA:CPU bf16 repack note in run_bench)")
+    ap.add_argument("--expect-chaos", default=None, metavar="KIND:SITE",
+                    help="exit nonzero unless the armed ICIKIT_CHAOS "
+                         "plan fired at least once at KIND:SITE-glob "
+                         "(smoke-drill assertion)")
+    ap.add_argument("--json", dest="json_path", default=None)
+    args = ap.parse_args(argv)
+    recs = run_bench(args.preset, args.rows, args.requests, args.rate,
+                     args.prompt, args.new_min, args.new_max,
+                     args.block_size, args.blocks, args.speculate,
+                     args.ngram_n, args.integrity, args.dp, args.tp,
+                     args.seed, args.mode, args.compute_dtype)
+    obs.emit_records(recs)
+    if args.json_path:
+        # append: record files accumulate across invocations
+        with open(args.json_path, "a") as f:
+            for rec in recs:
+                f.write(json.dumps(rec) + "\n")
+    if args.expect_chaos:
+        kind, _, glob = args.expect_chaos.partition(":")
+        plan = chaos.active()
+        fired = plan.fired(kind, glob or "*") if plan else 0
+        if not fired:
+            print(f"expected chaos {args.expect_chaos} never fired "
+                  f"(plan={'armed' if plan else 'absent'})")
+            return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -86,6 +86,26 @@ def _row_update(cache, upd, starts):
         cache, upd, starts)
 
 
+def _accept_window(w_toks, g, active):
+    """Longest-prefix accept — the ONE source of truth for verify
+    semantics, shared with the serving engine
+    (``icikit.serve.engine``): draft j is right iff it equals the
+    model's choice after the previous window prefix; ``m`` matches
+    commit ``m + 1`` tokens (the model's correction/extension after
+    the matched prefix rides along free). Returns ``(m, a, new_tok)``
+    with ``a`` zeroed on inactive rows."""
+    k = w_toks.shape[1]
+    if k > 1:
+        matches = (w_toks[:, 1:] == g[:, :-1])       # (b, k-1)
+        m = jnp.cumprod(matches.astype(jnp.int32),
+                        axis=1).sum(axis=1)          # (b,)
+    else:
+        m = jnp.zeros(w_toks.shape[:1], jnp.int32)
+    a = jnp.where(active, m + 1, 0)
+    new_tok = jnp.take_along_axis(g, m[:, None], axis=1)[:, 0]
+    return m, a, new_tok
+
+
 def _window_pass(ctx: _DecodeCtx, params, lp, kc, vc, toks, cur,
                  layers, cache_len: int):
     """Run window ``toks (b, w)`` at per-row positions ``cur..cur+w-1``
@@ -121,7 +141,7 @@ def _window_pass(ctx: _DecodeCtx, params, lp, kc, vc, toks, cur,
 @lru_cache(maxsize=None)
 def _build_speculative(mesh, cfg: TransformerConfig, s_prompt: int,
                        n_new: int, k: int, draft_layers: int,
-                       drafter: str = "shared"):
+                       drafter: str = "shared", ngram_n: int = 3):
     if n_new < 1:
         raise ValueError(f"n_new must be >= 1, got {n_new}")
     if k < 1:
@@ -171,6 +191,12 @@ def _build_speculative(mesh, cfg: TransformerConfig, s_prompt: int,
         def draft_logits(params, x):
             return ctx.logits(params, x)
 
+    if drafter == "ngram":
+        # zero-model-cost proposals: no drafting forward passes, no
+        # truncated-depth cache writes — verify (unchanged) prices and
+        # polices them exactly like model drafts
+        from icikit.serve.ngram_draft import ngram_propose
+
     def per_shard(params, prompt):
         b = prompt.shape[0]
         lp = {kk: params[kk] for kk in ctx.layer_keys}
@@ -196,37 +222,43 @@ def _build_speculative(mesh, cfg: TransformerConfig, s_prompt: int,
             tok, cur, n_done, out, kc, vc, stats = carry
             active = n_done < n_new                      # (b,) bool
 
-            # --- draft: k-1 greedy single-token steps through the
-            # first draft_layers of the SAME weights (shared head),
-            # writing their truncated-depth K/V into the shared cache
-            # (identical to what verify recomputes for those layers)
-            drafts = []
-            t, c = tok, cur
-            for _ in range(k - 1):
-                x, kc, vc = _window_pass(ctx, params, lp, kc, vc,
-                                         t[:, None], c,
-                                         range(draft_layers), cache_len)
-                t = jnp.argmax(draft_logits(params, x[:, 0]),
-                               axis=-1).astype(jnp.int32)
-                drafts.append(t)
-                c = c + 1
+            if drafter == "ngram" and k > 1:
+                # --- draft (free): longest-suffix-match proposals
+                # over the committed sequence so far — no forward
+                # passes, no cache writes on the draft side at all
+                seq = jnp.concatenate([prompt.astype(jnp.int32), out],
+                                      axis=1)
+                d = ngram_propose(seq, s_prompt + n_done, k, ngram_n)
+                w_toks = jnp.concatenate([tok[:, None], d], axis=1)
+            else:
+                # --- draft: k-1 greedy single-token steps through the
+                # first draft_layers of the SAME weights (shared head),
+                # writing their truncated-depth K/V into the shared
+                # cache (identical to what verify recomputes for those
+                # layers)
+                drafts = []
+                t, c = tok, cur
+                for _ in range(k - 1):
+                    x, kc, vc = _window_pass(ctx, params, lp, kc, vc,
+                                             t[:, None], c,
+                                             range(draft_layers),
+                                             cache_len)
+                    t = jnp.argmax(draft_logits(params, x[:, 0]),
+                                   axis=-1).astype(jnp.int32)
+                    drafts.append(t)
+                    c = c + 1
+                w_toks = jnp.stack([tok, *drafts], axis=1)   # (b, k)
 
             # --- verify: the pending token + k-1 drafts in ONE
             # stacked-layer pass — all matmul weights read once per
             # k-token window (the weights-stationary step)
-            w_toks = jnp.stack([tok, *drafts], axis=1)   # (b, k)
             x, kc, vc = _window_pass(ctx, params, lp, kc, vc, w_toks,
                                      cur, range(n_layers), cache_len)
             g = jnp.argmax(ctx.logits(params, x),
                            axis=-1).astype(jnp.int32)    # (b, k)
 
-            # longest accepted prefix: draft j is right iff it equals
-            # the model's choice after the previous window prefix
-            matches = (w_toks[:, 1:] == g[:, :-1])       # (b, k-1)
-            m = jnp.cumprod(matches.astype(jnp.int32),
-                            axis=1).sum(axis=1)          # (b,)
-            a = jnp.where(active, m + 1, 0)              # committed now
-            new_tok = jnp.take_along_axis(g, m[:, None], axis=1)[:, 0]
+            # longest accepted prefix (shared accept rule)
+            m, a, new_tok = _accept_window(w_toks, g, active)
 
             # commit g[:, :m+1] at the row's output offset (the tail of
             # the k-wide write is overwritten by the next iteration);
@@ -256,7 +288,7 @@ def speculative_generate(params, prompt, mesh, cfg: TransformerConfig,
                          n_new: int, k: int = 4,
                          draft_layers: int | None = None,
                          return_stats: bool = False,
-                         drafter: str = "auto"):
+                         drafter: str = "auto", ngram_n: int = 3):
     """Greedy continuation via self-speculative multi-token decode.
 
     Token-identical to ``greedy_generate(params, prompt, mesh, cfg,
@@ -277,17 +309,23 @@ def speculative_generate(params, prompt, mesh, cfg: TransformerConfig,
       drafter: ``"shared"`` = the r7 free drafter (truncated depth
         through the shared ``ln_f``/``w_out`` head), ``"trained"`` =
         the trained early-exit draft head (requires ``cfg.draft_head``
-        and the ``draft_*`` param branch), ``"auto"`` = trained when
-        the config arms it, shared otherwise.
+        and the ``draft_*`` param branch), ``"ngram"`` = the
+        zero-model-cost longest-suffix-match proposer
+        (``icikit.serve.ngram_draft`` — no drafting forward passes at
+        all; the first rung of the ROADMAP 3b fallback ladder, kept
+        opt-in here until its acceptance is measured on a real stream
+        per the defaults-audit rule), ``"auto"`` = trained when the
+        config arms it, shared otherwise.
+      ngram_n: max suffix length the ``"ngram"`` drafter matches.
 
     Acceptance counters flow through ``icikit.obs``
     (``decode.spec.*`` counters + an ``acceptance`` observation) —
     one device readback per *generation*, after the jitted loop; the
     accept/commit logic itself runs on device.
     """
-    if drafter not in ("auto", "shared", "trained"):
+    if drafter not in ("auto", "shared", "trained", "ngram"):
         raise ValueError(f"unknown drafter {drafter!r} "
-                         "(known: auto, shared, trained)")
+                         "(known: auto, shared, trained, ngram)")
     if drafter == "auto":
         drafter = "trained" if cfg.draft_head else "shared"
     if drafter == "trained":
@@ -316,7 +354,7 @@ def speculative_generate(params, prompt, mesh, cfg: TransformerConfig,
                   n_new=n_new, drafter=drafter):
         toks, stats = _build_speculative(
             mesh, cfg, prompt.shape[1], n_new, int(k),
-            int(draft_layers), drafter)(params, prompt)
+            int(draft_layers), drafter, int(ngram_n))(params, prompt)
         # SDC drill on the telemetry boundary: a corrupted stats
         # readback must skew counters only, never the committed tokens
         s = chaos.maybe_corrupt("decode.spec.verify.stats",

@@ -42,11 +42,24 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
+from icikit import chaos
 from icikit.parallel.shmap import wrap_program
 from icikit.utils.mesh import DEFAULT_AXIS
 from icikit.utils.registry import get_algorithm
 
 DCN_AXIS = "dcn"
+
+# Chaos sites (ROADMAP 5c: the multi-host launcher had none). All sit
+# at host boundaries — where a real fleet loses processes — so drills
+# exercise bring-up failure and cross-tier dispatch without touching
+# the jitted schedules themselves (a clean-plan run stays bitwise
+# identical to an unarmed one; tests/test_chaos_sites.py proves it):
+#
+# - ``multihost.init``      — delay/die during runtime bring-up (the
+#   MPI_Init analog: the launcher hook elastic recovery will retry)
+# - ``multihost.hier.<op>`` — delay/die at each hierarchical
+#   collective's dispatch boundary (allreduce / allgather /
+#   reducescatter / alltoall)
 
 _COORD_ENV_VARS = (
     # Set by cluster launchers that jax.distributed can auto-detect
@@ -90,6 +103,8 @@ def init_distributed(coordinator_address: str | None = None,
     one-``MPI_Init``-per-process discipline
     (``Communication/src/main.cc:396``).
     """
+    chaos.maybe_delay("multihost.init")
+    chaos.maybe_die("multihost.init")
     is_init = getattr(jax.distributed, "is_initialized", None)
     if is_init is not None:
         if is_init():
@@ -223,6 +238,8 @@ def hierarchical_all_reduce(x: jax.Array, mesh: Mesh,
     Returns:
       Same shape/sharding; every row is the full elementwise reduction.
     """
+    chaos.maybe_delay("multihost.hier.allreduce")
+    chaos.maybe_die("multihost.hier.allreduce")
     p_ici = mesh.shape[ici_axis]
     if x.ndim != 2 or x.shape[1] % p_ici:
         raise ValueError(
@@ -276,6 +293,8 @@ def hierarchical_all_gather(x: jax.Array, mesh: Mesh,
       row holds all p blocks in global order — the flat
       ``all_gather_blocks`` contract, with DCN traffic cut ×p_ici.
     """
+    chaos.maybe_delay("multihost.hier.allgather")
+    chaos.maybe_die("multihost.hier.allgather")
     if x.ndim != 2:
         raise ValueError(
             f"hierarchical_all_gather needs (p, m) input; got {x.shape}")
@@ -329,6 +348,8 @@ def hierarchical_reduce_scatter(x: jax.Array, mesh: Mesh,
       device row to chunk id (an allgather with the inverse layout, or
       ``hierarchical_all_reduce``'s final ICI gather, undoes it).
     """
+    chaos.maybe_delay("multihost.hier.reducescatter")
+    chaos.maybe_die("multihost.hier.reducescatter")
     p_ici = mesh.shape[ici_axis]
     p_dcn = mesh.shape[dcn_axis]
     if x.ndim != 2 or x.shape[1] % (p_ici * p_dcn):
@@ -381,6 +402,8 @@ def hierarchical_all_to_all(x: jax.Array, mesh: Mesh,
       ``all_to_all_blocks`` contract, with cross-DCN messages
       aggregated ×p_ici.
     """
+    chaos.maybe_delay("multihost.hier.alltoall")
+    chaos.maybe_die("multihost.hier.alltoall")
     p = mesh.shape[dcn_axis] * mesh.shape[ici_axis]
     if x.ndim != 3 or x.shape[1] != p:
         raise ValueError(

@@ -1,0 +1,31 @@
+"""icikit.serve — continuous-batching serving engine.
+
+The composition layer ROADMAP item 1 asked for: the token-identical
+decode core (``models/transformer/decode.py``), the lease-queue
+self-healing pattern (``models/solitaire/scheduler.py``), and the obs
+bus, assembled into a multi-request engine with a paged KV cache,
+SLO accounting, and request-level chaos drills. See docs/SERVING.md
+for the architecture and ``icikit.bench.serve`` for the Poisson
+benchmark.
+"""
+
+from icikit.serve.engine import (  # noqa: F401
+    Engine,
+    IntegrityError,
+    ServeConfig,
+    prompt_checksum,
+)
+from icikit.serve.kvpool import (  # noqa: F401
+    BlockAllocator,
+    KVPool,
+    PoolExhausted,
+)
+from icikit.serve.ngram_draft import (  # noqa: F401
+    ngram_propose,
+    ngram_propose_host,
+)
+from icikit.serve.scheduler import (  # noqa: F401
+    PoisonedPromptError,
+    Request,
+    RequestQueue,
+)

@@ -1,0 +1,658 @@
+"""Continuous-batching serving engine over the shared decode core.
+
+``greedy_generate`` is a *batch* program: B prompts in, B continuations
+out, every row marching in lockstep until the slowest finishes. A
+serving system faces the opposite shape — requests arrive one at a
+time, finish at different lengths, and throughput is set by how full
+the decode batch *stays*, not by how big one batch once was. This
+engine is the Orca-style composition step over everything below it:
+
+- **prefill/decode disaggregation** — admission runs the request's
+  prompt through the shared ``_prefill`` (one compiled program per
+  prompt length), scatters its K/V into pool blocks, and produces the
+  first token; the decode loop never pays prompt-shaped work.
+- **continuous batching** — one fixed-width step program (``B`` rows,
+  paged attention over per-row block tables) runs forever; finished
+  rows are evicted and their slots re-admitted from the queue at
+  *step boundaries* (and, with ``speculate_k >= 2``, at
+  speculative-verify boundaries — the step IS the verify window).
+- **paged KV cache** — rows gather their own blocks back into a
+  contiguous view under a per-row causal mask
+  (``_window_masked_attention``), so a corrupted or recycled page can
+  only ever be read by the request whose table points at it.
+- **token identity** — every committed token is the full model's
+  argmax over the row's own committed prefix, computed by the same
+  ``_DecodeCtx`` math as single-request decode; outputs are
+  greedy-token-identical per request to ``greedy_generate`` (pinned
+  across staggered admission, mixed prompt lengths, speculative
+  on/off, dp/tp meshes in ``tests/test_serve_engine.py``).
+- **speculative serving** — ``speculate_k >= 2`` turns the step into a
+  k-token verify window fed by the zero-cost n-gram drafter
+  (``serve/ngram_draft.py``); acceptance semantics are exactly
+  ``speculative_generate``'s (longest prefix, m matches commit m+1
+  tokens).
+
+Scheduling rides :class:`icikit.serve.scheduler.RequestQueue` — leases
+renewed per step, expiry reissue (dead-request abandonment), retry
+with backoff on transient failures (pool preemption, KV-integrity
+mismatch), idempotent completion commits.
+
+SLO accounting flows through ``icikit.obs``: ``serve.ttft_ms`` /
+``serve.tpot_ms`` / ``serve.queue_wait_ms`` histograms,
+``serve.occupancy_rows`` / ``serve.kv.occupancy`` gauges,
+``serve.tokens`` counters, a ``serve.request`` span per admission and
+a ``serve.engine.step`` span per step (chrome-checker-valid).
+
+Chaos sites (drilled in ``tests/test_serve_chaos.py``):
+
+- ``serve.admit``        — delay/die at admission;
+- ``serve.admit.prompt`` — SDC on the claimed prompt bytes; detection
+  is the submit-time checksum → ``PoisonedPromptError`` → rejected
+  without retry, engine keeps serving;
+- ``serve.step``         — delay/die at the step boundary (a die is an
+  engine crash: leases expire, requests reissue to the next engine);
+- ``serve.kv.page``      — SDC on a sealed KV page; with
+  ``integrity="pages"`` the owner request fails its completion
+  verify and retries on fresh blocks while co-batched requests'
+  outputs stay bitwise unchanged (containment is structural: nobody
+  else's block table maps that page).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from icikit import chaos, obs
+from icikit.serve.kvpool import KVPool, PoolExhausted
+from icikit.serve.ngram_draft import DEFAULT_N, ngram_propose_host
+from icikit.serve.scheduler import (
+    PoisonedPromptError,
+    Request,
+    RequestQueue,
+    prompt_checksum,
+)
+
+
+class IntegrityError(RuntimeError):
+    """A request's sealed KV pages failed their checksum re-verify."""
+
+
+@dataclass(frozen=True)
+class ServeConfig:
+    """Engine geometry — all static (they shape the compiled step)."""
+
+    max_rows: int = 4        # decode batch width B (divisible by dp)
+    block_size: int = 8      # KV block = this many token columns
+    n_blocks: int = 64       # allocatable blocks per dp shard
+    max_prompt: int = 64     # admission ceilings (validation, buffers)
+    max_new: int = 64
+    speculate_k: int = 1     # 1 = single-token; >= 2 = ngram verify
+    ngram_n: int = DEFAULT_N
+    integrity: str = "none"  # "none" | "pages" (seal + verify)
+
+
+@dataclass
+class _Row:
+    """Host-side state of one occupied engine slot."""
+
+    req: Request
+    shard: int
+    s_prompt: int
+    n_done: int              # committed tokens (includes the pending)
+    sealed: int              # blocks checksummed so far
+    seq: int = 0             # claim generation captured at admission
+    owner: str = ""          # pool-ownership token: rid + claim seq
+    # tokens accumulate HERE, not on the shared Request object: the
+    # claim-seq fence covers queue mutations, but a stalled engine
+    # resuming after its lease was reaped must also be unable to
+    # interleave host-side appends into the live claimant's output —
+    # only the fenced complete() publishes a row's tokens
+    tokens: list = field(default_factory=list)
+
+
+class Engine:
+    """One engine = one compiled step program + host admission loop.
+
+    ``params`` / ``mesh`` / ``cfg`` are the model triple every decode
+    entry point takes; ``serve`` the engine geometry; ``queue`` the
+    shared :class:`RequestQueue` (created if omitted — multi-engine
+    setups share one queue, which is what makes lease-expiry reissue
+    across engines work).
+    """
+
+    def __init__(self, params, mesh, cfg, serve: ServeConfig,
+                 queue: RequestQueue | None = None):
+        from icikit.models.transformer.model import DP_AXIS
+        if cfg.n_experts:
+            raise ValueError(
+                "the serving engine does not support MoE "
+                "(n_experts > 0): expert dispatch is a dp all-to-all "
+                "whose routing this engine's paged step has not been "
+                "built for")
+        if serve.speculate_k < 1:
+            raise ValueError(
+                f"speculate_k must be >= 1, got {serve.speculate_k}")
+        if serve.integrity not in ("none", "pages"):
+            raise ValueError(
+                f"unknown integrity {serve.integrity!r} "
+                "(known: none, pages)")
+        self.dp = mesh.shape[DP_AXIS]
+        if serve.max_rows % self.dp:
+            raise ValueError(
+                f"max_rows={serve.max_rows} must divide over "
+                f"dp={self.dp}")
+        k = serve.speculate_k
+        horizon = serve.max_prompt + serve.max_new + k - 1
+        if horizon > cfg.max_seq:
+            raise ValueError(
+                f"max_prompt + max_new + k - 1 = {horizon} exceeds "
+                f"max_seq = {cfg.max_seq}")
+        bs = serve.block_size
+        self.nb_per_row = -(-horizon // bs)           # block-table width
+        if self.nb_per_row > serve.n_blocks:
+            raise ValueError(
+                f"one max-size request needs {self.nb_per_row} blocks "
+                f"but the pool holds {serve.n_blocks} per shard")
+        self.params = self._cast_weights(params, cfg)
+        self.mesh = mesh
+        self.cfg = cfg
+        self.serve = serve
+        self.queue = queue if queue is not None else RequestQueue()
+        self.pool = KVPool(cfg, mesh, serve.n_blocks, bs)
+        B = serve.max_rows
+        self.rows: list[_Row | None] = [None] * B
+        self._toks = np.zeros(B, np.int32)
+        self._curs = np.zeros(B, np.int32)
+        self._active = np.zeros(B, bool)
+        self._btab = np.zeros((B, self.nb_per_row), np.int32)
+        self._seq_buf = np.zeros(
+            (B, serve.max_prompt + serve.max_new), np.int32)
+        self._step_fn = self._build_step()
+        self._prefill_fns: dict = {}
+        self.n_steps = 0
+        self._occ_rows = 0       # sum of active rows over steps
+
+    @staticmethod
+    def _cast_weights(params, cfg):
+        """Pre-cast the matmul weights to the compute dtype ONCE.
+
+        Every layer consumes these via ``.astype(compute_dtype)``;
+        inside ``generate``'s single compiled loop XLA hoists that
+        conversion out of the scan, but the engine's step is its own
+        program per call and would re-convert the parameter stream
+        every token. Token identity is unaffected: ``astype`` on an
+        already-cast array yields the same round-to-nearest values
+        ``generate`` computes in-loop; norm scales, embeddings and
+        positional tables stay fp32 (their math is fp32 in both
+        paths). Note the XLA:CPU caveat measured in round 9: CPU gemm
+        re-packs bf16 operands to fp32 per *call*, so this pre-cast
+        only pays on native-bf16 backends — the committed CPU bench
+        rows run fp32 compute instead (icikit.bench.serve)."""
+        import jax.numpy as jnp
+
+        from icikit.models.transformer.model import _attn_param_keys
+        cdt = jnp.dtype(cfg.compute_dtype)
+        if cdt == jnp.float32:
+            return params
+        cast = set(_attn_param_keys(cfg)) | {"wo", "w1", "w2", "w_out"}
+        return {k: (v.astype(cdt) if k in cast else v)
+                for k, v in params.items()}
+
+    # -- compiled programs -------------------------------------------
+
+    def _pool_spec(self):
+        from jax.sharding import PartitionSpec as P
+
+        from icikit.models.transformer.model import DP_AXIS, TP_AXIS
+        return P(DP_AXIS, None, None, TP_AXIS, None)
+
+    def _build_step(self):
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+
+        from icikit.models.transformer.decode import (
+            _DecodeCtx,
+            _window_masked_attention,
+        )
+        from icikit.models.transformer.model import DP_AXIS, param_specs
+        from icikit.models.transformer.speculative import _accept_window
+        from icikit.ops.rope import apply_rope, rope_sincos
+
+        cfg = self.cfg
+        ctx = _DecodeCtx(cfg, self.mesh)
+        k = self.serve.speculate_k
+        bs = self.serve.block_size
+        NB = self.nb_per_row
+        T = NB * bs
+        n_layers = cfg.n_layers
+
+        def per_shard(params, toks, curs, active, btab, drafts, kc, vc):
+            b = toks.shape[0]
+            lp = {kk: params[kk] for kk in ctx.layer_keys}
+            w_toks = jnp.concatenate([toks[:, None], drafts], axis=1)
+            pos = curs[:, None] + jnp.arange(k)[None, :]     # (b, k)
+            x = ctx.embed(params, w_toks, pos)
+            sincos = (rope_sincos(pos, cfg.d_head, cfg.rope_theta)
+                      if cfg.pos_encoding == "rope" else None)
+            # per-row causal frontier over the row's own paged view
+            mask = (jnp.arange(T)[None, None, :] <= pos[:, :, None])
+            # physical write targets; inactive rows park on trash 0
+            pages = jnp.take_along_axis(btab, pos // bs, axis=1)
+            pages = jnp.where(active[:, None], pages, 0)
+            slots = pos % bs
+            kc2, vc2 = [], []
+            for li in range(n_layers):
+                lp1 = {kk: lp[kk][li] for kk in ctx.layer_keys}
+                q, k_, v_ = ctx.qkv_proj(x, lp1)
+                if sincos is not None:
+                    q = apply_rope(q, pos, cfg.rope_theta, sincos)
+                    k_ = apply_rope(k_, pos, cfg.rope_theta, sincos)
+                kp, vp = kc[li][0], vc[li][0]
+                kp = kp.at[pages, slots].set(k_.astype(kp.dtype))
+                vp = vp.at[pages, slots].set(v_.astype(vp.dtype))
+                # the paged gather: this row's blocks, contiguous again
+                ks = kp[btab].reshape(b, T, *kp.shape[2:])
+                vs = vp[btab].reshape(b, T, *vp.shape[2:])
+                attn = _window_masked_attention(q, ks, vs, mask,
+                                                ctx.scale, ctx.n_rep)
+                x = ctx.close_attn(x, attn, lp1)
+                x = ctx.ffn(x, lp1)
+                kc2.append(kp[None])
+                vc2.append(vp[None])
+            g = jnp.argmax(ctx.logits(params, x),
+                           axis=-1).astype(jnp.int32)        # (b, k)
+            # the ONE accept rule, shared with speculative_generate —
+            # the engine-vs-generate identity contract hangs on it
+            _, a, new_tok = _accept_window(w_toks, g, active)
+            return (g, a, jnp.where(active, new_tok, toks),
+                    tuple(kc2), tuple(vc2))
+
+        ps = self._pool_spec()
+        pools = (ps,) * n_layers
+        import jax
+
+        from icikit.parallel.shmap import shard_map as _shard_map
+        # pools are DONATED: the step rewrites the whole arena
+        # functionally, and without donation XLA must copy every
+        # buffer per token step (pool.update drops the old refs, so
+        # reuse is safe; KVPool allocates distinct per-layer buffers)
+        return jax.jit(_shard_map(
+            per_shard, mesh=self.mesh,
+            in_specs=(param_specs(cfg), P(DP_AXIS), P(DP_AXIS),
+                      P(DP_AXIS), P(DP_AXIS, None), P(DP_AXIS, None),
+                      pools, pools),
+            out_specs=(P(DP_AXIS, None), P(DP_AXIS), P(DP_AXIS),
+                       pools, pools)), donate_argnums=(6, 7))
+
+    def _build_prefill(self, s_prompt: int):
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+
+        from icikit.models.transformer.decode import _DecodeCtx, _prefill
+        from icikit.models.transformer.model import DP_AXIS, param_specs
+
+        cfg = self.cfg
+        ctx = _DecodeCtx(cfg, self.mesh)
+        bs = self.serve.block_size
+        npref = -(-s_prompt // bs)
+        n_layers = cfg.n_layers
+
+        def per_shard(params, prompt, pages, kc, vc):
+            # prompt replicated: every shard computes the same prefill;
+            # only the owner shard's pages are real (others trash 0)
+            x, (kcache, vcache) = _prefill(ctx, params, prompt,
+                                           s_prompt, npref * bs,
+                                           fused=False)
+            tok0 = jnp.argmax(ctx.logits(params, x[:, -1]),
+                              axis=-1).astype(jnp.int32)
+            kc2, vc2 = [], []
+            for li in range(n_layers):
+                kp, vp = kc[li][0], vc[li][0]
+                kb = kcache[li][0].reshape(npref, bs, *kp.shape[2:])
+                vb = vcache[li][0].reshape(npref, bs, *vp.shape[2:])
+                kc2.append(kp.at[pages[0]].set(kb.astype(kp.dtype))[None])
+                vc2.append(vp.at[pages[0]].set(vb.astype(vp.dtype))[None])
+            return tok0, tuple(kc2), tuple(vc2)
+
+        ps = self._pool_spec()
+        pools = (ps,) * n_layers
+        import jax
+
+        from icikit.parallel.shmap import shard_map as _shard_map
+        return jax.jit(_shard_map(
+            per_shard, mesh=self.mesh,
+            in_specs=(param_specs(cfg), P(None, None),
+                      P(DP_AXIS, None), pools, pools),
+            out_specs=(P(None), pools, pools)),
+            donate_argnums=(3, 4)), npref
+
+    # -- admission ---------------------------------------------------
+
+    def _free_slot(self) -> int | None:
+        for s, row in enumerate(self.rows):
+            if row is None:
+                return s
+        return None
+
+    def _shard_of(self, slot: int) -> int:
+        return slot // (self.serve.max_rows // self.dp)
+
+    def _validate(self, req: Request, prompt: np.ndarray) -> None:
+        sv = self.serve
+        if not 1 <= prompt.size <= sv.max_prompt:
+            raise PoisonedPromptError(
+                f"{req.rid}: prompt length {prompt.size} outside "
+                f"[1, {sv.max_prompt}]")
+        if prompt.min(initial=0) < 0 or \
+                prompt.max(initial=0) >= self.cfg.vocab:
+            raise PoisonedPromptError(
+                f"{req.rid}: token id outside [0, {self.cfg.vocab})")
+        if prompt_checksum(prompt) != req.checksum:
+            raise PoisonedPromptError(
+                f"{req.rid}: prompt checksum mismatch (corrupted "
+                "between submit and admission)")
+        if req.n_new > sv.max_new:
+            raise PoisonedPromptError(
+                f"{req.rid}: n_new={req.n_new} exceeds "
+                f"max_new={sv.max_new}")
+
+    def _admit(self) -> int:
+        """Admit queued requests into free slots; returns how many."""
+        admitted = 0
+        while True:
+            slot = self._free_slot()
+            if slot is None:
+                return admitted
+            req = self.queue.claim()
+            if req is None:
+                return admitted
+            chaos.maybe_delay("serve.admit")
+            chaos.maybe_die("serve.admit")
+            prompt = np.asarray(
+                chaos.maybe_corrupt("serve.admit.prompt", req.prompt),
+                np.int32)
+            try:
+                self._validate(req, prompt)
+            except PoisonedPromptError as e:
+                self.queue.fail(req.rid, e, retry=False,
+                                seq=req.claim_seq)
+                continue
+            shard = self._shard_of(slot)
+            s = int(prompt.size)
+            # pool ownership is keyed by (rid, claim generation): a
+            # reaped request re-admitted while a stale row still holds
+            # its old blocks must NOT share a block table with it
+            owner = f"{req.rid}.{req.claim_seq}"
+            try:
+                self.pool.ensure(owner, shard, s)
+            except PoolExhausted:
+                # not the request's fault: back off without burning a
+                # retry — admission re-attempts once rows evict
+                self.queue.release(req.rid, delay=0.005,
+                                   seq=req.claim_seq)
+                return admitted
+            with obs.span("serve.request", rid=req.rid, s_prompt=s,
+                          n_new=req.n_new, slot=slot):
+                self._prefill_into(req, prompt, slot, shard, owner)
+            admitted += 1
+
+    def _prefill_into(self, req: Request, prompt, slot: int,
+                      shard: int, owner: str) -> None:
+        key = prompt.size
+        if key not in self._prefill_fns:
+            self._prefill_fns[key] = self._build_prefill(key)
+        fn, npref = self._prefill_fns[key]
+        table = self.pool.allocators[shard].table(owner)
+        pages = np.zeros((self.dp, npref), np.int32)
+        pages[shard] = table[:npref]
+        tok0, kc, vc = fn(self.params, prompt[None], pages,
+                          self.pool.kc, self.pool.vc)
+        self.pool.update(kc, vc)
+        tok0 = int(np.asarray(tok0)[0])
+        now = time.monotonic()
+        first_admission = req.admit_t is None
+        if first_admission:
+            req.admit_t = now
+        req.first_token_t = now
+        self.rows[slot] = _Row(req=req, shard=shard,
+                               s_prompt=int(prompt.size), n_done=1,
+                               sealed=0, seq=req.claim_seq,
+                               owner=owner, tokens=[tok0])
+        self._toks[slot] = tok0
+        self._curs[slot] = prompt.size
+        self._active[slot] = True
+        self._btab[slot] = 0
+        self._btab[slot, :len(table)] = table
+        self._seq_buf[slot] = 0
+        self._seq_buf[slot, :prompt.size] = prompt
+        self._seq_buf[slot, prompt.size] = tok0
+        obs.count("serve.admitted")
+        if first_admission:
+            # re-admissions keep the first admit_t (the SLO record is
+            # per-request) and must not re-emit its stale wait sample
+            obs.observe("serve.queue_wait_ms",
+                        (req.admit_t - req.arrival_t) * 1e3)
+        # a 1-token request (or an immediate EOS) finishes at prefill
+        if req.n_new <= 1 or tok0 == req.eos_id:
+            self._finish(slot)
+
+    # -- stepping ----------------------------------------------------
+
+    def _ensure_windows(self) -> None:
+        """Grow block tables to cover this step's write window; a row
+        the pool cannot extend is preempted (evicted + re-queued),
+        never silently stalled."""
+        k = self.serve.speculate_k
+        for slot, row in enumerate(self.rows):
+            if row is None:
+                continue
+            try:
+                added = self.pool.ensure(row.owner, row.shard,
+                                         int(self._curs[slot]) + k)
+            except PoolExhausted:
+                # preemption, not failure: the pool filled up around
+                # this row — evict and re-queue without burning a retry
+                self._evict(slot)
+                self.queue.release(row.req.rid, delay=0.005,
+                                   seq=row.seq)
+                continue
+            if added:
+                table = self.pool.allocators[row.shard].table(
+                    row.owner)
+                self._btab[slot, :len(table)] = table
+
+    def _drafts(self) -> np.ndarray:
+        k = self.serve.speculate_k
+        B = self.serve.max_rows
+        if k == 1:
+            return np.zeros((B, 0), np.int32)
+        valid = np.ones(B, np.int32)
+        for slot, row in enumerate(self.rows):
+            if row is not None:
+                valid[slot] = row.s_prompt + row.n_done
+        return ngram_propose_host(self._seq_buf, valid, k,
+                                  self.serve.ngram_n)
+
+    def _step(self) -> None:
+        chaos.maybe_delay("serve.step")
+        chaos.maybe_die("serve.step")
+        self._ensure_windows()
+        self._chaos_pages()
+        if not self._active.any():
+            return
+        k = self.serve.speculate_k
+        with obs.span("serve.engine.step", step=self.n_steps,
+                      rows=int(self._active.sum())):
+            g, a, newtok, kc, vc = self._step_fn(
+                self.params, self._toks, self._curs, self._active,
+                self._btab, self._drafts(), self.pool.kc, self.pool.vc)
+            self.pool.update(kc, vc)
+            g = np.asarray(g)
+            a = np.asarray(a)
+            self._toks = np.asarray(newtok).copy()
+        self.n_steps += 1
+        stepped = self._active.copy()   # rows that ran this step
+        self._occ_rows += int(stepped.sum())
+        committed = 0
+        for slot, row in enumerate(self.rows):
+            if row is None or not self._active[slot]:
+                continue
+            req = row.req
+            self.queue.renew(req.rid, seq=row.seq)
+            a_r = int(a[slot])
+            self._curs[slot] += a_r
+            take = g[slot, :a_r]
+            done = False
+            for t in take:
+                if row.n_done >= req.n_new:
+                    done = True
+                    break
+                row.tokens.append(int(t))
+                self._seq_buf[slot, row.s_prompt + row.n_done] = int(t)
+                row.n_done += 1
+                committed += 1
+                if row.n_done >= req.n_new or \
+                        (req.eos_id is not None and int(t) == req.eos_id):
+                    done = True
+                    break
+            if self.serve.integrity == "pages":
+                self._seal(slot, row)
+            if done:
+                self._finish(slot)
+        if k > 1:
+            # proposed + accepted together make acceptance derivable
+            # from the serve metrics alone — the measured-α row the
+            # ROADMAP 3b "auto ladder flip" gates on
+            obs.count("serve.spec.verify_steps")
+            obs.count("serve.spec.row_steps", int(stepped.sum()))
+            obs.count("serve.spec.draft_proposed",
+                      int(stepped.sum()) * (k - 1))
+            obs.count("serve.spec.draft_accepted",
+                      int(np.maximum(a[stepped] - 1, 0).sum()))
+        obs.count("serve.tokens", committed)
+        obs.gauge("serve.occupancy_rows",
+                  float(self._active.sum()) / self.serve.max_rows)
+        if obs.metrics() is not None:
+            used = {(r.owner, r.shard): int(self._curs[s])
+                    for s, r in enumerate(self.rows) if r is not None}
+            obs.gauge("serve.kv.fragmentation",
+                      self.pool.fragmentation(used))
+
+    def _seal(self, slot: int, row: _Row) -> None:
+        """Checksum blocks the committed frontier has fully passed.
+        The frontier is the pending token's position (its K/V is not
+        yet written) — everything before it is final."""
+        frontier = int(self._curs[slot])
+        bs = self.serve.block_size
+        table = self.pool.allocators[row.shard].table(row.owner)
+        while (row.sealed + 1) * bs <= frontier:
+            self.pool.seal(row.owner, row.shard, row.sealed,
+                           table[row.sealed])
+            row.sealed += 1
+
+    def _chaos_pages(self) -> None:
+        """The KV-page SDC drill hook: when a plan is armed, probe one
+        sealed page per occupied row (deterministic order) and write
+        any corruption back into the arena — exactly what a real
+        in-memory flip would look like to the verify path."""
+        if chaos.active() is None or self.serve.integrity != "pages":
+            return
+        for slot, row in enumerate(self.rows):
+            if row is None or row.sealed == 0:
+                continue
+            table = self.pool.allocators[row.shard].table(row.owner)
+            page = table[0]
+            data = np.asarray(self.pool.kc[0][row.shard, page])
+            out = chaos.maybe_corrupt("serve.kv.page", data)
+            if out is not data:
+                self.pool.poke_page(row.shard, page, 0, out)
+                obs.emit("serve.kv.page_corrupted", rid=row.req.rid,
+                         shard=row.shard, page=int(page))
+
+    # -- eviction / completion ---------------------------------------
+
+    def _evict(self, slot: int) -> None:
+        row = self.rows[slot]
+        self.pool.free(row.owner, row.shard)
+        self.rows[slot] = None
+        self._active[slot] = False
+        self._btab[slot] = 0
+
+    def _finish(self, slot: int) -> None:
+        row = self.rows[slot]
+        req = row.req
+        if self.serve.integrity == "pages":
+            bad = self.pool.verify(row.owner, row.shard)
+            if bad:
+                self._evict(slot)
+                self.queue.fail(req.rid, IntegrityError(
+                    f"{req.rid}: sealed KV pages {bad} failed "
+                    "checksum re-verify"), retry=True, seq=row.seq)
+                obs.count("serve.integrity_failures")
+                return
+        self._evict(slot)
+        if self.queue.complete(req.rid, row.tokens, seq=row.seq):
+            slo = req.slo()
+            if "ttft_ms" in slo:
+                obs.observe("serve.ttft_ms", slo["ttft_ms"])
+            if "tpot_ms" in slo:
+                obs.observe("serve.tpot_ms", slo["tpot_ms"])
+
+    # -- the loop ----------------------------------------------------
+
+    def run(self, drain: bool = True, max_steps: int | None = None):
+        """Serve until the queue drains (or ``max_steps`` decode steps
+        have run); returns the completed-request count for this call.
+        Re-entrant: a fresh engine pointed at the same queue picks up
+        reissued leases from a dead one."""
+        done0 = len(self.queue.done)
+        while True:
+            self.queue.reap_expired()
+            self._admit()
+            if not self._active.any():
+                if not drain or self.queue.drained():
+                    break
+                wait = self.queue.next_visible_in()
+                if wait is None or wait > 0:
+                    time.sleep(0.002 if wait is None
+                               else min(wait, 0.05))
+                continue
+            self._step()
+            if max_steps is not None and self.n_steps >= max_steps:
+                break
+        return len(self.queue.done) - done0
+
+    @property
+    def row_steps(self) -> int:
+        """Total row-steps executed (sum of active rows over steps) —
+        the denominator of tokens-per-row-step figures."""
+        return self._occ_rows
+
+    def occupancy_mean(self) -> float:
+        """Mean decode-batch occupancy over every step so far — the
+        quantity continuous batching exists to maximize."""
+        if not self.n_steps:
+            return 0.0
+        return self._occ_rows / (self.n_steps * self.serve.max_rows)
+
+    def reset_stats(self) -> None:
+        """Zero the step/occupancy accumulators — the bench calls this
+        after its warm-up run so committed occupancy/steps figures
+        describe the measured traffic only."""
+        self.n_steps = 0
+        self._occ_rows = 0
+
+    # -- convenience -------------------------------------------------
+
+    def submit(self, prompt, n_new: int, eos_id: int | None = None,
+               not_before: float | None = None,
+               max_retries: int = 2) -> str:
+        """Queue a request on this engine's queue (``RequestQueue
+        .submit`` stamps the integrity checksum before the request
+        becomes claimable — see ``serve.admit.prompt``)."""
+        return self.queue.submit(prompt, n_new, eos_id=eos_id,
+                                 not_before=not_before,
+                                 max_retries=max_retries)

@@ -1,0 +1,293 @@
+"""Paged KV cache: fixed-size blocks over one preallocated buffer.
+
+The decode stack so far allocates one contiguous ``(total,)`` cache per
+generate call, sized for the worst case — which is exactly what a
+multi-request engine cannot afford: requests arrive with unknown output
+lengths, and reserving max-length contiguous stripes per request either
+caps concurrency at a handful of rows or wastes most of the buffer on
+padding. This module is the vLLM/PagedAttention move specialized to the
+repo's decode core: the cache is **one** preallocated arena of
+fixed-size *blocks* (``block_size`` token columns each), requests own
+*block tables* (ordered lists of block ids), and the engine's attention
+gathers each row's blocks back into a contiguous view under a per-row
+causal mask — so physical placement is arbitrary while the math stays
+the ``_DecodeCtx`` math, token-identically.
+
+Two layers, deliberately separable:
+
+- :class:`BlockAllocator` — pure host-side metadata: a free list over
+  block ids plus per-request block tables. No device state, so the
+  property/fuzz suite (``tests/test_kvpool.py``) can hammer random
+  alloc/extend/free interleavings and assert the invariants (live
+  blocks never alias, the free list conserves capacity, exhaustion
+  raises :class:`PoolExhausted` without partial allocation) at high
+  iteration counts.
+- :class:`KVPool` — the device arena: per-layer K and V buffers of
+  shape ``(dp, n_blocks + 1, block_size, kv_heads, d_head)`` sharded
+  ``P(dp, None, None, tp, None)``, one :class:`BlockAllocator` per dp
+  shard (rows on shard *s* allocate from shard *s*'s block space), and
+  occupancy/fragmentation gauges on the obs bus.
+
+Block 0 of every shard is the **trash block**: engine rows that are
+inactive (empty slots) still execute the step program — their writes
+are routed to block 0, whose contents are garbage by contract and are
+never read unmasked. Allocations therefore hand out ids from
+``[1, n_blocks]``.
+
+Integrity: the pool can remember a checksum per *sealed* block (every
+slot committed — the engine seals block ``j`` of a request once its
+committed frontier passes ``(j + 1) * block_size``) and re-verify the
+request's sealed blocks later; a mismatch is the detection mechanism
+behind the KV-page corruption chaos drill (a corrupted page fails its
+*owning* request only — co-batched requests never gather it).
+"""
+
+from __future__ import annotations
+
+import collections
+import hashlib
+import threading
+
+from icikit import obs
+
+
+class PoolExhausted(RuntimeError):
+    """The free list cannot satisfy an allocation.
+
+    Loud by design: silent admission of a request the pool cannot hold
+    would stall every co-batched request behind an un-extendable row.
+    The engine's policy on catching this is preempt-and-requeue, not
+    crash — but the *allocator* never hands out partial allocations.
+    """
+
+    def __init__(self, requested: int, free: int, capacity: int):
+        super().__init__(
+            f"KV pool exhausted: requested {requested} blocks, "
+            f"{free} free of {capacity}")
+        self.requested = requested
+        self.free = free
+        self.capacity = capacity
+
+
+class BlockAllocator:
+    """Free-list allocator over ``n_blocks`` fixed-size blocks.
+
+    Block ids are ``1..n_blocks`` (0 is the engine's trash block and is
+    never allocated). ``alloc``/``ensure`` are all-or-nothing: on
+    exhaustion they raise :class:`PoolExhausted` with the allocator
+    state unchanged. Thread-safe — the engine is single-threaded today,
+    but the scheduler discipline elsewhere in this repo (``_LeaseQueue``)
+    is that shared metadata takes a lock rather than an assumption.
+    """
+
+    def __init__(self, n_blocks: int, block_size: int):
+        if n_blocks < 1:
+            raise ValueError(f"n_blocks must be >= 1, got {n_blocks}")
+        if block_size < 1:
+            raise ValueError(f"block_size must be >= 1, got {block_size}")
+        self.capacity = n_blocks
+        self.block_size = block_size
+        self._free = collections.deque(range(1, n_blocks + 1))
+        self._tables: dict = {}          # owner -> list[int]
+        self._lock = threading.Lock()
+
+    # -- queries -----------------------------------------------------
+
+    @property
+    def n_free(self) -> int:
+        with self._lock:
+            return len(self._free)
+
+    @property
+    def n_used(self) -> int:
+        return self.capacity - self.n_free
+
+    def owners(self) -> tuple:
+        with self._lock:
+            return tuple(self._tables)
+
+    def table(self, owner) -> tuple:
+        """The owner's block table (ordered; () for unknown owners)."""
+        with self._lock:
+            return tuple(self._tables.get(owner, ()))
+
+    # -- mutation ----------------------------------------------------
+
+    def alloc(self, owner, n: int) -> tuple:
+        """Append ``n`` fresh blocks to ``owner``'s table; returns the
+        new block ids. All-or-nothing on exhaustion."""
+        if n < 0:
+            raise ValueError(f"n must be >= 0, got {n}")
+        with self._lock:
+            if n > len(self._free):
+                raise PoolExhausted(n, len(self._free), self.capacity)
+            got = [self._free.popleft() for _ in range(n)]
+            self._tables.setdefault(owner, []).extend(got)
+        return tuple(got)
+
+    def ensure(self, owner, n_tokens: int) -> tuple:
+        """Grow ``owner``'s table until it covers ``n_tokens`` cache
+        positions; returns the blocks *added* (possibly ())."""
+        need = -(-n_tokens // self.block_size)  # ceil
+        have = len(self._tables.get(owner, ()))
+        return self.alloc(owner, max(0, need - have)) if need > have \
+            else ()
+
+    def free(self, owner) -> int:
+        """Release every block owned by ``owner`` back to the free
+        list; returns how many. Unknown owners free 0 (idempotent —
+        a retried eviction must not corrupt the free list)."""
+        with self._lock:
+            blocks = self._tables.pop(owner, [])
+            self._free.extend(blocks)
+            return len(blocks)
+
+
+def _page_digest(arrays) -> str:
+    """Checksum of one block's K and V content across layers (host
+    bytes in layer order) — the sealed-page integrity fingerprint."""
+    h = hashlib.blake2b(digest_size=16)
+    for a in arrays:
+        h.update(a.tobytes())
+    return h.hexdigest()
+
+
+class KVPool:
+    """The device arena + per-dp-shard allocators + obs gauges.
+
+    ``kc``/``vc`` are per-layer tuples of jax arrays, each of global
+    shape ``(dp, n_blocks + 1, block_size, kv_heads, d_head)`` sharded
+    ``P(dp, None, None, tp, None)`` — engine step programs carry them
+    as carry-style inputs/outputs (the decode.py cache discipline) and
+    write them back via :meth:`update`.
+    """
+
+    def __init__(self, cfg, mesh, n_blocks: int, block_size: int):
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from icikit.models.transformer.model import DP_AXIS, TP_AXIS
+
+        self.cfg = cfg
+        self.mesh = mesh
+        self.n_blocks = n_blocks
+        self.block_size = block_size
+        self.dp = mesh.shape[DP_AXIS]
+        kv_heads = cfg.n_kv_heads or cfg.n_heads
+        shape = (self.dp, n_blocks + 1, block_size, kv_heads, cfg.d_head)
+        sh = NamedSharding(mesh, P(DP_AXIS, None, None, TP_AXIS, None))
+        cdt = jnp.dtype(cfg.compute_dtype)
+
+        def arena():
+            # one DISTINCT buffer per layer/side: the engine donates
+            # these into its step program (in-place pool updates), and
+            # donation rejects aliased inputs
+            return jax.device_put(jnp.zeros(shape, cdt), sh)
+
+        self.kc = tuple(arena() for _ in range(cfg.n_layers))
+        self.vc = tuple(arena() for _ in range(cfg.n_layers))
+        self.allocators = tuple(BlockAllocator(n_blocks, block_size)
+                                for _ in range(self.dp))
+        # (owner, shard, block_index_in_table) -> digest of the sealed
+        # page's K/V bytes across layers
+        self._seals: dict = {}
+        self._gauges()
+
+    # -- device-side content -----------------------------------------
+
+    def update(self, kc, vc) -> None:
+        """Install the step program's updated buffers (the engine calls
+        this once per step with the program outputs)."""
+        self.kc = tuple(kc)
+        self.vc = tuple(vc)
+
+    def page_bytes(self, shard: int, page: int) -> list:
+        """Host copies of one physical block's K and V content for
+        every layer — the integrity read-back (one device read per
+        layer per call; sealing is a per-block, not per-step, event)."""
+        import numpy as np
+        out = []
+        for li in range(self.cfg.n_layers):
+            out.append(np.asarray(self.kc[li][shard, page]))
+            out.append(np.asarray(self.vc[li][shard, page]))
+        return out
+
+    def poke_page(self, shard: int, page: int, layer: int,
+                  array) -> None:
+        """Overwrite one physical K block's content (the chaos drill's
+        write-back path — a deterministic stand-in for an in-memory
+        bit flip)."""
+        import jax.numpy as jnp
+        kc = list(self.kc)
+        kc[layer] = kc[layer].at[shard, page].set(
+            jnp.asarray(array, kc[layer].dtype))
+        self.kc = tuple(kc)
+
+    # -- sealing / integrity -----------------------------------------
+
+    def seal(self, owner, shard: int, block_index: int, page: int) -> None:
+        """Record the checksum of a just-completed (fully committed)
+        block so :meth:`verify` can detect later corruption."""
+        self._seals[(owner, shard, block_index)] = _page_digest(
+            self.page_bytes(shard, page))
+
+    def verify(self, owner, shard: int) -> list:
+        """Re-hash every sealed block of ``owner`` against its recorded
+        digest; returns the list of block indices that FAIL (empty ==
+        intact)."""
+        table = self.allocators[shard].table(owner)
+        bad = []
+        for (o, s, bi), digest in self._seals.items():
+            if o != owner or s != shard:
+                continue
+            if bi >= len(table):
+                continue
+            if _page_digest(self.page_bytes(s, table[bi])) != digest:
+                bad.append(bi)
+        return sorted(bad)
+
+    def drop_seals(self, owner, shard: int) -> None:
+        self._seals = {k: v for k, v in self._seals.items()
+                       if not (k[0] == owner and k[1] == shard)}
+
+    # -- bookkeeping shared with the engine --------------------------
+
+    def free(self, owner, shard: int) -> int:
+        """Release the owner's blocks (and seals) on one shard."""
+        self.drop_seals(owner, shard)
+        n = self.allocators[shard].free(owner)
+        self._gauges()
+        return n
+
+    def ensure(self, owner, shard: int, n_tokens: int) -> tuple:
+        added = self.allocators[shard].ensure(owner, n_tokens)
+        if added:
+            self._gauges()
+        return added
+
+    def occupancy(self) -> float:
+        """Fraction of allocatable blocks currently owned (mean over
+        dp shards)."""
+        used = sum(a.n_used for a in self.allocators)
+        return used / (self.n_blocks * self.dp)
+
+    def fragmentation(self, used_tokens: dict) -> float:
+        """Internal fragmentation: 1 − used-token-slots / allocated
+        slots, given ``{(owner, shard): committed token count}``. Fixed
+        blocks have no external fragmentation; the waste is the
+        partially-filled tail block per request."""
+        alloc_slots = sum(
+            len(self.allocators[s].table(o)) * self.block_size
+            for (o, s) in used_tokens)
+        if not alloc_slots:
+            return 0.0
+        used = sum(min(v, len(self.allocators[s].table(o))
+                       * self.block_size)
+                   for (o, s), v in used_tokens.items())
+        return 1.0 - used / alloc_slots
+
+    def _gauges(self) -> None:
+        obs.gauge("serve.kv.occupancy", self.occupancy())
+        obs.gauge("serve.kv.blocks_free",
+                  sum(a.n_free for a in self.allocators))

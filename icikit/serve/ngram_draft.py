@@ -1,0 +1,109 @@
+"""Zero-model-cost n-gram drafter: longest-suffix-match proposals.
+
+The r8 verdict left speculative decode at break-even with a *trained*
+drafter whose acceptance is corpus-bound (DECODE.md round 8); ROADMAP
+item 3b's answer is a fallback ladder whose first rung costs nothing at
+all: propose the continuation that followed the **last occurrence of
+the current suffix** in the request's own prompt + generated text
+(prompt-lookup decoding). No parameters, no drafting forward passes,
+no extra cache writes — the verify pass already prices one full-stack
+window per iteration, so every accepted n-gram token is pure profit
+and a fully-rejected proposal costs exactly what ``k=1`` decode costs
+plus nothing (the draft side is a handful of integer compares).
+
+Acceptance is workload-dependent by construction: repetitive /
+extractive streams (code, quotes, structured text) accept long runs;
+high-entropy streams accept ~0 and degrade gracefully to the baseline.
+Token identity is unconditional either way — proposals only ever enter
+the model through the verify-and-accept window, which commits the full
+model's argmax regardless of what was proposed
+(``tests/test_ngram_draft.py`` pins it).
+
+The proposer is written in JAX so it runs *inside* the jitted
+speculative while-loop (``speculative_generate(..., drafter="ngram")``)
+— per-row dynamic suffix lengths, no host sync — and the serving
+engine reuses the same function under a tiny jit wrapper for its
+host-side step loop.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache, partial
+
+import jax
+import jax.numpy as jnp
+
+DEFAULT_N = 3
+
+
+def ngram_propose(seq, valid, k: int, n: int = DEFAULT_N):
+    """Propose ``k - 1`` draft tokens per row by longest-suffix match.
+
+    Args:
+      seq: int32 ``(b, S)`` token buffer — committed tokens first
+        (prompt followed by decided continuation), anything beyond
+        ``valid`` is ignorable garbage.
+      valid: int32 ``(b,)`` committed token count per row (may be
+        traced — this runs inside the speculative while-loop).
+      k: verify-window width; ``k - 1`` tokens are proposed.
+      n: maximum suffix length to match (static, small).
+
+    Returns:
+      int32 ``(b, k - 1)`` proposals. Matching rule: score candidate
+      end-positions ``j`` by the longest ``ℓ <= n`` with
+      ``seq[j-ℓ+1 .. j] == seq[v-ℓ .. v-1]``, prefer longer matches
+      then later positions, and propose the tokens following the
+      winner. Rows with no match (or fewer than 2 committed tokens)
+      fall back to repeating their last token — a guess like any
+      other, priced identically by verify.
+    """
+    if k < 2:
+        raise ValueError(f"k must be >= 2 to draft, got {k}")
+    if n < 1:
+        raise ValueError(f"n must be >= 1, got {n}")
+    b, S = seq.shape
+    idx = jnp.arange(S)
+
+    def row(seq_r, v):
+        matchlen = jnp.zeros((S,), jnp.int32)
+        cum = jnp.ones((S,), bool)
+        for i in range(1, n + 1):
+            # i-th token back from the frontier; -1 when the suffix is
+            # shorter than i (matches nothing — tokens are >= 0)
+            last_i = jnp.where(v - i >= 0,
+                               seq_r[jnp.clip(v - i, 0, S - 1)], -1)
+            # sh[j] = seq_r[j - i + 1] (left-pad: out-of-range never eq)
+            sh = (seq_r if i == 1 else jnp.concatenate(
+                [jnp.full((i - 1,), -1, seq_r.dtype),
+                 seq_r[:S - i + 1]]))
+            cum = cum & (sh == last_i)
+            matchlen = matchlen + cum.astype(jnp.int32)
+        # candidates end strictly before the suffix itself
+        score = jnp.where(idx <= v - 2, matchlen * S + idx, -1)
+        j = jnp.argmax(score)
+        ml = jnp.where(score[j] >= 0, matchlen[j], 0)
+        # proposal reads clamp to the committed frontier: positions
+        # j+1+i with index >= v would read the UNWRITTEN tail of the
+        # buffer (zeros — a guaranteed-rejected guess); repeating the
+        # last committed token instead keeps every slot a real token
+        prop_idx = jnp.minimum(j + 1 + jnp.arange(k - 1), v - 1)
+        props = jnp.take(seq_r, jnp.clip(prop_idx, 0, S - 1))
+        fallback = jnp.full((k - 1,),
+                            seq_r[jnp.clip(v - 1, 0, S - 1)])
+        return jnp.where(ml > 0, props, fallback).astype(jnp.int32)
+
+    return jax.vmap(row)(seq, valid)
+
+
+@lru_cache(maxsize=None)
+def _jitted(k: int, n: int):
+    return jax.jit(partial(ngram_propose, k=k, n=n))
+
+
+def ngram_propose_host(seq, valid, k: int, n: int = DEFAULT_N):
+    """Host-friendly wrapper (numpy in, numpy out) over a cached jit of
+    :func:`ngram_propose` — the serving engine's per-step draft call."""
+    import numpy as np
+    out = _jitted(k, n)(jnp.asarray(seq, jnp.int32),
+                        jnp.asarray(valid, jnp.int32))
+    return np.asarray(out)

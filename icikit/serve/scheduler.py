@@ -1,0 +1,322 @@
+"""Request admission brain: leases, retry-with-backoff, idempotent
+commits.
+
+This generalizes the lease-queue pattern that made ``solve_dynamic``
+self-healing (``models/solitaire/scheduler.py:_LeaseQueue``) from
+*chunks of a fixed dataset* to *requests arriving over time*:
+
+- ``submit`` enqueues a request (optionally time-gated — the Poisson
+  bench submits the whole trace up front with per-request
+  ``visible_after`` offsets, so arrival timing is part of the workload,
+  not of the feeding code);
+- ``claim`` hands a queued request to an engine under a **lease**. An
+  engine that keeps running renews the lease every step; an engine
+  that dies stops renewing, the lease expires, and ``reap_expired``
+  puts the request back at the queue head — the dead-request
+  abandonment story, drill-tested in ``tests/test_serve_chaos.py``;
+- ``fail`` re-queues with bounded exponential **backoff** (transient
+  failures: pool preemption, injected faults, KV-integrity mismatch)
+  until ``max_retries`` is spent, then parks the request in ``failed``
+  with its error — a poisoned prompt skips retries entirely
+  (``retry=False``): re-decoding garbage is not a recovery strategy;
+- ``complete`` is **idempotent**: the first commit wins, a late
+  duplicate (an abandoned engine finishing after its lease was
+  reissued) changes nothing and is surfaced on the obs bus, exactly
+  the ``_LeaseQueue.commit`` contract.
+
+Deterministic ids, monotonic clocks (SLO math must survive wall-clock
+steps), bus/metric emission outside the lock (the ``mark_dead``
+discipline: a slow sink must never stall admission).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import heapq
+import itertools
+import threading
+import time
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from icikit import obs
+
+DEFAULT_LEASE_S = 30.0
+
+
+def prompt_checksum(prompt) -> str:
+    """Submit-time fingerprint the engine re-verifies at admission —
+    any corruption of the prompt bytes in between is detected
+    mechanically, not probabilistically. Stamped inside ``submit``
+    BEFORE the request becomes claimable, so no engine can ever admit
+    an unfingerprinted request."""
+    return hashlib.blake2b(
+        np.ascontiguousarray(np.asarray(prompt, np.int32)).tobytes(),
+        digest_size=16).hexdigest()
+
+
+class PoisonedPromptError(ValueError):
+    """A request whose prompt fails admission validation (token ids
+    out of vocabulary range, over-length, or a submit-time checksum
+    mismatch — the SDC drill's detection path). Not retryable: the
+    prompt itself is the fault."""
+
+
+@dataclass
+class Request:
+    """One serving request plus its lifecycle telemetry. Timestamps are
+    ``time.monotonic`` values; ``None`` until the event happens."""
+
+    rid: str
+    prompt: np.ndarray           # int32 (s,)
+    n_new: int
+    checksum: str | None = None  # prompt fingerprint (set by submit)
+    eos_id: int | None = None
+    visible_after: float = 0.0   # arrival time (monotonic)
+    max_retries: int = 2
+    # lifecycle
+    state: str = "queued"        # queued|running|done|failed
+    attempts: int = 0
+    # claim generation: bumped on every claim; engines capture it at
+    # admission and stamp it on renew/complete/fail/release so a
+    # stalled engine whose lease was reaped and reissued can no longer
+    # act on the request (its stamp no longer matches the live lease)
+    claim_seq: int = 0
+    tokens: list = field(default_factory=list)
+    error: str | None = None
+    preempted: int = 0
+    # SLO marks
+    arrival_t: float = 0.0
+    admit_t: float | None = None
+    first_token_t: float | None = None
+    done_t: float | None = None
+
+    def slo(self) -> dict:
+        """TTFT / TPOT / queue-wait in ms (None where the phase never
+        happened). TPOT counts the steady-state tokens: total decode
+        time after the first token over ``n_generated - 1``."""
+        out = {"rid": self.rid, "state": self.state,
+               "attempts": self.attempts, "preempted": self.preempted,
+               "n_tokens": len(self.tokens)}
+        if self.admit_t is not None:
+            out["queue_wait_ms"] = (self.admit_t - self.arrival_t) * 1e3
+        if self.first_token_t is not None:
+            out["ttft_ms"] = (self.first_token_t - self.arrival_t) * 1e3
+        if (self.done_t is not None and self.first_token_t is not None
+                and len(self.tokens) > 1):
+            out["tpot_ms"] = ((self.done_t - self.first_token_t)
+                              / (len(self.tokens) - 1)) * 1e3
+        return out
+
+
+class RequestQueue:
+    """Arrival queue + lease table + terminal stores.
+
+    Invariant (the ``_LeaseQueue`` discipline): every request is in
+    exactly one of queued / leased / done / failed, so ``drained()``
+    is simply "queued and leased both empty".
+    """
+
+    def __init__(self, lease_s: float = DEFAULT_LEASE_S,
+                 backoff_s: float = 0.05):
+        self.lease_s = lease_s
+        self.backoff_s = backoff_s
+        self._lock = threading.Lock()
+        self._ids = itertools.count()
+        # min-heap of (visible_after, seq, rid): time-gated FIFO
+        self._queued: list = []
+        self._requests: dict = {}     # rid -> Request
+        self._leases: dict = {}       # rid -> deadline (monotonic)
+        self.done: dict = {}          # rid -> Request
+        self.failed: dict = {}        # rid -> Request
+        self.n_reissues = 0
+        self.n_duplicate_commits = 0
+
+    # -- producer side -----------------------------------------------
+
+    def submit(self, prompt, n_new: int, eos_id: int | None = None,
+               not_before: float | None = None,
+               max_retries: int = 2) -> str:
+        """Enqueue one request; returns its id. ``not_before`` is an
+        absolute ``time.monotonic`` instant (None = now) — the Poisson
+        bench's arrival process."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if n_new < 1:
+            raise ValueError(f"n_new must be >= 1, got {n_new}")
+        now = time.monotonic()
+        vis = now if not_before is None else float(not_before)
+        with self._lock:
+            seq = next(self._ids)
+            rid = f"r{seq}"
+            req = Request(rid=rid, prompt=prompt, n_new=int(n_new),
+                          checksum=prompt_checksum(prompt),
+                          eos_id=eos_id, visible_after=vis,
+                          max_retries=max_retries, arrival_t=vis)
+            self._requests[rid] = req
+            heapq.heappush(self._queued, (vis, seq, rid))
+        obs.count("serve.submitted")
+        return rid
+
+    # -- engine side -------------------------------------------------
+
+    def claim(self) -> Request | None:
+        """Pop the oldest *visible* queued request under a fresh lease,
+        or None (nothing visible right now — ``next_visible_in`` says
+        how long until something is). Heap entries are lazily deleted:
+        an entry whose request is no longer ``queued`` (a stale
+        duplicate from a reap racing a stale engine's fail) is
+        discarded, so one request can never be admitted twice."""
+        now = time.monotonic()
+        with self._lock:
+            while self._queued and self._queued[0][0] <= now:
+                _, _, rid = heapq.heappop(self._queued)
+                req = self._requests[rid]
+                if req.state != "queued":
+                    continue        # stale duplicate entry
+                req.state = "running"
+                req.attempts += 1
+                req.claim_seq += 1
+                self._leases[rid] = (now + self.lease_s, req.claim_seq)
+                return req
+            return None
+
+    def next_visible_in(self) -> float | None:
+        """Seconds until the head of the queue becomes visible (<= 0 ==
+        visible now); None when the queue is empty."""
+        with self._lock:
+            if not self._queued:
+                return None
+            return self._queued[0][0] - time.monotonic()
+
+    def _lease_live(self, rid: str, seq: int | None) -> bool:
+        """Caller-holds-the-lease check (lock held): with a ``seq``
+        stamp, the live lease must carry that exact claim generation —
+        a stalled engine whose request was reaped/reissued fails this
+        and its late mutation becomes a no-op."""
+        if seq is None:
+            return True   # legacy callers without a stamp
+        lease = self._leases.get(rid)
+        return lease is not None and lease[1] == seq
+
+    def renew(self, rid: str, seq: int | None = None) -> None:
+        """Heartbeat: push the lease deadline out (the engine calls
+        this for every in-flight request at every step boundary)."""
+        with self._lock:
+            if rid in self._leases and self._lease_live(rid, seq):
+                self._leases[rid] = (time.monotonic() + self.lease_s,
+                                     self._leases[rid][1])
+
+    def complete(self, rid: str, tokens,
+                 seq: int | None = None) -> bool:
+        """Idempotent terminal commit; True on the first commit. Late
+        commits (request already terminal, or the caller's lease was
+        reaped and reissued) change nothing — a ``failed`` request is
+        never resurrected by a straggler."""
+        with self._lock:
+            req = self._requests.get(rid)
+            dup = (req is None or req.state in ("done", "failed")
+                   or not self._lease_live(rid, seq))
+            if not dup:
+                self._leases.pop(rid, None)
+                req.state = "done"
+                req.tokens = list(tokens)
+                req.done_t = time.monotonic()
+                self.done[rid] = req
+        if dup:
+            self.n_duplicate_commits += 1
+            obs.emit("serve.duplicate_commit", rid=rid)
+            return False
+        obs.count("serve.completed")
+        return True
+
+    def fail(self, rid: str, exc: BaseException,
+             retry: bool = True, seq: int | None = None) -> str:
+        """Record a failed attempt. Retryable failures re-queue with
+        exponential backoff until ``max_retries`` extra attempts are
+        spent; returns the request's new state. Stale callers (lease
+        reaped and reissued elsewhere) are no-ops."""
+        requeued = False
+        with self._lock:
+            req = self._requests.get(rid)
+            if req is None or req.state in ("done", "failed") \
+                    or not self._lease_live(rid, seq):
+                return "stale"
+            self._leases.pop(rid, None)
+            req.error = repr(exc)
+            if retry and req.attempts <= req.max_retries:
+                delay = self.backoff_s * (2 ** (req.attempts - 1))
+                vis = time.monotonic() + delay
+                req.state = "queued"
+                req.tokens = []
+                req.first_token_t = None
+                heapq.heappush(self._queued,
+                               (vis, next(self._ids), rid))
+                requeued = True
+            else:
+                req.state = "failed"
+                self.failed[rid] = req
+        obs.emit("serve.request_failed", rid=rid, error=repr(exc),
+                 requeued=requeued)
+        obs.count("serve.retries" if requeued else "serve.failed")
+        return "queued" if requeued else "failed"
+
+    def release(self, rid: str, delay: float = 0.0,
+                seq: int | None = None) -> None:
+        """Hand a claimed request back WITHOUT burning a retry — the
+        preemption path (the pool filled up around the request; the
+        request itself did nothing wrong). ``delay`` gates its next
+        visibility so a full engine does not spin on re-claiming it."""
+        with self._lock:
+            req = self._requests.get(rid)
+            if req is None or req.state in ("done", "failed") \
+                    or not self._lease_live(rid, seq):
+                return
+            self._leases.pop(rid, None)
+            req.state = "queued"
+            req.attempts -= 1
+            req.tokens = []
+            req.first_token_t = None
+            req.preempted += 1
+            heapq.heappush(self._queued,
+                           (time.monotonic() + delay,
+                            next(self._ids), rid))
+        obs.emit("serve.request_preempted", rid=rid)
+        obs.count("serve.preemptions")
+
+    # -- monitor side ------------------------------------------------
+
+    def reap_expired(self) -> list:
+        """Re-queue every request whose lease outlived its engine (the
+        dead-request abandonment path); returns the reaped rids."""
+        now = time.monotonic()
+        reaped = []
+        with self._lock:
+            for rid, (deadline, _) in list(self._leases.items()):
+                if deadline > now:
+                    continue
+                del self._leases[rid]
+                req = self._requests[rid]
+                req.state = "queued"
+                req.tokens = []
+                req.first_token_t = None
+                heapq.heappush(self._queued,
+                               (now, next(self._ids), rid))
+                reaped.append(rid)
+            self.n_reissues += len(reaped)
+        if reaped:
+            obs.emit("serve.lease_expired", rids=reaped)
+            obs.count("serve.reissues", len(reaped))
+        return reaped
+
+    def drained(self) -> bool:
+        with self._lock:
+            return not self._queued and not self._leases
+
+    def pending(self) -> int:
+        with self._lock:
+            return len(self._queued) + len(self._leases)
+
+    def request(self, rid: str) -> Request:
+        with self._lock:
+            return self._requests[rid]

@@ -98,6 +98,37 @@ def test_cost_model_requires_acceptance_rows(tmp_path):
         cost_model_rows(str(path))
 
 
+def test_spec_breakeven_batch_rows():
+    """Batch-aware pricing (ROADMAP 3c): rows exist per b, the b=1 row
+    anchors on the committed measured floor, and the model's verdict
+    shape holds — break-even α drifts DOWN with b (the truncated
+    drafter re-reads only its depth fraction of the b-scaled cache)
+    while the absolute baseline worsens."""
+    from icikit.bench.decode import SPEC_FLOOR_MS, spec_breakeven_rows
+    rows = spec_breakeven_rows(preset="base", batches=(1, 4, 16))
+    assert len(rows) == 3 * 3 * 2     # b x k x frac
+    by = {(r["batch"], r["k"], r["draft_fraction"]): r for r in rows}
+    b1 = by[(1, 2, 0.25)]
+    assert b1["baseline_source"] == "measured-floor"
+    assert b1["baseline_ms_per_token"] == SPEC_FLOOR_MS
+    # the b=1 break-even must agree with the r8 committed ~0.336
+    assert abs(b1["breakeven_acceptance"] - 0.336) < 0.01
+    for k in (2, 4, 8):
+        for frac in (0.25, 0.5):
+            be = [by[(b, k, frac)]["breakeven_acceptance"]
+                  for b in (1, 4, 16)]
+            assert be[0] >= be[1] >= be[2]          # drifts down
+    base = [by[(b, 2, 0.25)]["baseline_ms_per_token"]
+            for b in (1, 4, 16)]
+    assert base[0] < base[1] < base[2]              # cache term grows
+    for r in rows:
+        assert r["kind"] == "breakeven"
+        assert 0 < r["breakeven_acceptance"] \
+            < r["breakeven_acceptance_15pct"]
+        if r["batch"] > 1:
+            assert r["baseline_source"] == "modeled"
+
+
 def test_spec_cost_model_anchors():
     """At tokens_per_step = 1 and k = 1 the model must reproduce the
     baseline floor exactly (no drafts, one verify pass = one

@@ -1,0 +1,47 @@
+"""Serving bench harness smoke (tiny preset, CPU, few requests)."""
+
+import numpy as np
+
+from icikit.bench.serve import make_workload, run_bench
+
+
+def test_workload_is_seeded_and_poisson_shaped():
+    w1 = make_workload(8, 10.0, 8, 4, 12, vocab=61, seed=3)
+    w2 = make_workload(8, 10.0, 8, 4, 12, vocab=61, seed=3)
+    assert len(w1) == 8
+    for (o1, p1, n1), (o2, p2, n2) in zip(w1, w2):
+        assert o1 == o2 and n1 == n2
+        np.testing.assert_array_equal(p1, p2)
+    offs = [o for o, _, _ in w1]
+    assert offs == sorted(offs) and offs[0] > 0
+    assert all(4 <= n <= 12 for _, _, n in w1)
+    assert make_workload(8, 10.0, 8, 4, 12, vocab=61, seed=4) != w1
+
+
+def test_serve_bench_both_modes():
+    recs = run_bench("tiny", rows=2, n_requests=5, rate_rps=50.0,
+                     prompt_len=8, new_min=4, new_max=8,
+                     block_size=4, seed=0, mode="both")
+    assert [r["mode"] for r in recs] == ["continuous", "static"]
+    cont, stat = recs
+    # matched load: same workload, same useful tokens by construction
+    assert cont["tokens"] == stat["tokens"] > 0
+    assert cont["completed"] == stat["completed"] == 5
+    assert cont["failed"] == 0
+    for r in recs:
+        assert r["kind"] == "serve" and r["backend"]
+        assert r["tokens_per_s"] > 0
+        assert r["ttft_ms"]["p99"] >= r["ttft_ms"]["p50"] > 0
+        assert 0.0 < r["occupancy_mean"] <= 1.0
+
+
+def test_serve_bench_speculative_mode():
+    recs = run_bench("tiny", rows=2, n_requests=4, rate_rps=100.0,
+                     prompt_len=8, new_min=4, new_max=8,
+                     block_size=4, speculate=3, seed=1,
+                     mode="continuous")
+    [cont] = recs
+    assert cont["speculate"] == 3
+    assert cont["completed"] == 4 and cont["failed"] == 0
+    # ngram verify windows commit >= 1 token per row-step
+    assert cont["tokens_per_step_row"] >= 1.0

@@ -1,0 +1,129 @@
+"""Chaos injection sites in the bench harness and multi-host launcher
+— the two coverage gaps ROADMAP item 5c named.
+
+Each drill proves (a) the probe fires where scheduled and (b) an
+armed-but-never-firing plan leaves results byte-identical to an
+unarmed run — injection sites must be free when cold.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from icikit import chaos
+from icikit.bench.harness import sweep_collective
+from icikit.parallel.multihost import (
+    hierarchical_all_reduce,
+    init_distributed,
+    make_hybrid_mesh,
+)
+from icikit.utils.mesh import shard_along
+
+
+# -- bench harness ---------------------------------------------------
+
+def test_harness_die_site(mesh4):
+    plan = chaos.FaultPlan(schedule={"die:bench.harness.*": (0,)})
+    with chaos.inject(plan):
+        with pytest.raises(chaos.InjectedDeath):
+            sweep_collective(mesh4, "allgather", "xla", sizes=(4,),
+                             runs=1, warmup=0)
+        # the schedule index is consumed: a retry sails through
+        recs = sweep_collective(mesh4, "allgather", "xla", sizes=(4,),
+                                runs=1, warmup=0)
+    assert plan.fired("die", "bench.harness.allgather") == 1
+    assert recs[0].verified
+
+
+def test_harness_verify_catches_injected_sdc(mesh4):
+    """A flipped bit in the collective's output payload must flip
+    `verified` to False — the closed-form check polices real bytes."""
+    plan = chaos.FaultPlan(
+        schedule={"corrupt:bench.harness.verify": (0,)})
+    with chaos.inject(plan):
+        bad = sweep_collective(mesh4, "allreduce", "ring", sizes=(16,),
+                               runs=1, warmup=0)
+        good = sweep_collective(mesh4, "allreduce", "ring",
+                                sizes=(16,), runs=1, warmup=0)
+    assert plan.fired("corrupt", "bench.harness.verify") == 1
+    assert not bad[0].verified
+    assert good[0].verified
+
+
+def test_harness_clean_plan_identical_to_unarmed(mesh4):
+    base = sweep_collective(mesh4, "allgather", "ring", sizes=(4, 16),
+                            runs=1, warmup=0)
+    plan = chaos.FaultPlan(rates={"die:bench.harness.*": 0.0,
+                                  "corrupt:bench.harness.*": 0.0})
+    with chaos.inject(plan):
+        armed = sweep_collective(mesh4, "allgather", "ring",
+                                 sizes=(4, 16), runs=1, warmup=0)
+    assert plan.log == []
+    for b, a in zip(base, armed):
+        # everything but the timing fields must match exactly
+        assert (b.family, b.algorithm, b.p, b.msize, b.dtype,
+                b.bytes_per_block, b.verified) == \
+               (a.family, a.algorithm, a.p, a.msize, a.dtype,
+                a.bytes_per_block, a.verified)
+
+
+# -- multi-host launcher ---------------------------------------------
+
+def _hybrid_x(mesh, m, seed=0):
+    p = mesh.devices.size
+    rng = np.random.default_rng(seed)
+    data = rng.integers(-100, 100, size=(p, m)).astype(np.int32)
+    return data, shard_along(jnp.asarray(data), mesh,
+                             axis_name=("dcn", "p"))
+
+
+def test_multihost_init_die_site():
+    plan = chaos.FaultPlan(schedule={"die:multihost.init": (0,)})
+    with chaos.inject(plan):
+        with pytest.raises(chaos.InjectedDeath):
+            init_distributed()
+        # retry: probe consumed; single-process env stays a no-op
+        assert init_distributed() is False
+    assert plan.fired("die", "multihost.init") == 1
+
+
+def test_multihost_hier_die_site():
+    mesh = make_hybrid_mesh(dcn_size=2, ici_size=2,
+                            devices=jax.devices()[:4])
+    _, x = _hybrid_x(mesh, 8)
+    plan = chaos.FaultPlan(
+        schedule={"die:multihost.hier.allreduce": (0,)})
+    with chaos.inject(plan):
+        with pytest.raises(chaos.InjectedDeath):
+            hierarchical_all_reduce(x, mesh)
+        out = np.asarray(hierarchical_all_reduce(x, mesh))
+    assert plan.fired("die", "multihost.hier.allreduce") == 1
+    assert out.shape == (4, 8)
+
+
+def test_multihost_clean_plan_bitwise_identical():
+    mesh = make_hybrid_mesh(dcn_size=2, ici_size=2,
+                            devices=jax.devices()[:4])
+    data, x = _hybrid_x(mesh, 8)
+    base = np.asarray(hierarchical_all_reduce(x, mesh))
+    plan = chaos.FaultPlan(rates={"die:multihost.*": 0.0,
+                                  "delay:multihost.*": 0.0})
+    with chaos.inject(plan):
+        armed = np.asarray(hierarchical_all_reduce(x, mesh))
+    assert plan.log == []
+    np.testing.assert_array_equal(armed, base)
+    np.testing.assert_array_equal(base[0], data.sum(axis=0))
+
+
+def test_multihost_delay_sites_fire_without_changing_output():
+    mesh = make_hybrid_mesh(dcn_size=2, ici_size=2,
+                            devices=jax.devices()[:4])
+    data, x = _hybrid_x(mesh, 8)
+    base = np.asarray(hierarchical_all_reduce(x, mesh))
+    plan = chaos.FaultPlan(rates={"delay:multihost.hier.*": 1.0},
+                           delay_s=0.001)
+    with chaos.inject(plan):
+        delayed = np.asarray(hierarchical_all_reduce(x, mesh))
+    assert plan.fired("delay", "multihost.hier.allreduce") == 1
+    np.testing.assert_array_equal(delayed, base)

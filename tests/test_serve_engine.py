@@ -1,0 +1,231 @@
+"""Serving engine: token identity vs single-request generate, plus
+admission / eviction / preemption mechanics.
+
+The load-bearing property is the acceptance bar from ROADMAP item 1:
+whatever the admission timing, co-batching, prompt-length mix,
+speculative mode, or mesh, every request's output tokens are
+bitwise what ``greedy_generate`` produces for that request alone.
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from icikit.models.transformer import (
+    TransformerConfig,
+    greedy_generate,
+    init_params,
+)
+from icikit.models.transformer.model import make_model_mesh
+from icikit.serve import Engine, RequestQueue, ServeConfig
+
+CFG = TransformerConfig(vocab=61, d_model=32, n_heads=4, d_head=8,
+                        d_ff=64, n_layers=2, max_seq=64,
+                        compute_dtype="float32")
+
+
+def _baseline(cfg, prompt, n_new):
+    """Single-request greedy reference on a dp=1/tp=1 mesh (tokens are
+    mesh-independent — pinned by tests/test_decode.py)."""
+    mesh = make_model_mesh(dp=1, tp=1, sp=1)
+    params = init_params(jax.random.key(0), cfg, mesh)
+    out = greedy_generate(params, jnp.asarray(prompt)[None], mesh, cfg,
+                          n_new)
+    return np.asarray(out)[0, len(prompt):]
+
+
+def _workload(cfg, lens, seed=1):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(0, cfg.vocab, (s,)).astype(np.int32)
+            for s in lens]
+
+
+def _engine(cfg=CFG, dp=1, tp=1, **over):
+    mesh = make_model_mesh(dp=dp, tp=tp, sp=1)
+    params = init_params(jax.random.key(0), cfg, mesh)
+    sv = dict(max_rows=2, block_size=4, n_blocks=32, max_prompt=16,
+              max_new=16)
+    sv.update(over)
+    return Engine(params, mesh, cfg, ServeConfig(**sv))
+
+
+@pytest.mark.parametrize("speculate_k", [1, 3])
+def test_mixed_lengths_staggered_admission_identity(speculate_k):
+    """4 requests over 2 rows, three prompt lengths, staggered
+    arrivals: every request's tokens match its solo baseline."""
+    prompts = _workload(CFG, [5, 8, 11, 8])
+    n_news = [6, 12, 9, 4]
+    eng = _engine(speculate_k=speculate_k)
+    t0 = time.monotonic()
+    rids = [eng.submit(p, n, not_before=t0 + 0.01 * i)
+            for i, (p, n) in enumerate(zip(prompts, n_news))]
+    assert eng.run() == len(rids)
+    for rid, p, n in zip(rids, prompts, n_news):
+        req = eng.queue.request(rid)
+        assert req.state == "done"
+        np.testing.assert_array_equal(np.asarray(req.tokens),
+                                      _baseline(CFG, p, n))
+
+
+@pytest.mark.parametrize("dp,tp", [(2, 1), (1, 2), (2, 2)])
+def test_identity_across_meshes(dp, tp):
+    prompts = _workload(CFG, [6, 9, 6])
+    eng = _engine(dp=dp, tp=tp, max_rows=2 * dp)
+    rids = [eng.submit(p, 8) for p in prompts]
+    eng.run()
+    for rid, p in zip(rids, prompts):
+        req = eng.queue.request(rid)
+        assert req.state == "done"
+        np.testing.assert_array_equal(np.asarray(req.tokens),
+                                      _baseline(CFG, p, 8))
+
+
+def test_eos_freezes_and_frees_the_row():
+    """A request with eos_id stops at the first EOS token (inclusive)
+    — the engine's output is the solo continuation truncated at EOS,
+    and the freed row admits the next request."""
+    [prompt] = _workload(CFG, [8], seed=3)
+    base = _baseline(CFG, prompt, 12)
+    eos = int(base[4])       # force an early stop at a real token
+    upto = list(base).index(eos) + 1
+    eng = _engine(max_rows=2)
+    r1 = eng.submit(prompt, 12, eos_id=eos)
+    r2 = eng.submit(prompt, 12)      # no EOS: runs to n_new
+    eng.run()
+    req1, req2 = eng.queue.request(r1), eng.queue.request(r2)
+    np.testing.assert_array_equal(np.asarray(req1.tokens), base[:upto])
+    np.testing.assert_array_equal(np.asarray(req2.tokens), base)
+    assert req1.done_t <= req2.done_t
+
+
+def test_single_token_request_finishes_at_prefill():
+    [prompt] = _workload(CFG, [7], seed=4)
+    eng = _engine()
+    rid = eng.submit(prompt, 1)
+    eng.run()
+    req = eng.queue.request(rid)
+    assert req.state == "done"
+    np.testing.assert_array_equal(np.asarray(req.tokens),
+                                  _baseline(CFG, prompt, 1))
+    assert eng.pool.occupancy() == 0.0   # blocks returned
+
+
+def test_pool_preemption_retries_to_completion():
+    """A pool too small for two rows admits serially: the second
+    request is preempted at admission (no retry burned), backs off,
+    and completes with identical tokens once the first evicts."""
+    prompts = _workload(CFG, [8, 8], seed=5)
+    # one row's worst case needs ceil((8+12)/4)=5 blocks; give 7 so
+    # both admit but cannot both extend to full length
+    eng = _engine(n_blocks=7, max_prompt=8, max_new=12)
+    rids = [eng.submit(p, 12, max_retries=0) for p in prompts]
+    eng.run()
+    pre = 0
+    for rid, p in zip(rids, prompts):
+        req = eng.queue.request(rid)
+        assert req.state == "done"     # max_retries=0: preemption must
+        pre += req.preempted           # not have consumed a retry
+        np.testing.assert_array_equal(np.asarray(req.tokens),
+                                      _baseline(CFG, p, 12))
+    assert pre >= 1
+    assert eng.pool.occupancy() == 0.0
+
+
+def test_occupancy_and_slo_marks():
+    prompts = _workload(CFG, [8, 8, 8, 8], seed=6)
+    eng = _engine(max_rows=2)
+    rids = [eng.submit(p, 8) for p in prompts]
+    eng.run()
+    assert 0.5 < eng.occupancy_mean() <= 1.0
+    for rid in rids:
+        slo = eng.queue.request(rid).slo()
+        assert slo["ttft_ms"] >= slo["queue_wait_ms"] >= 0.0
+        assert slo["tpot_ms"] > 0.0
+        assert slo["n_tokens"] == 8
+
+
+def test_queue_lease_expiry_reissues():
+    """Scheduler-level dead-engine story: a claimed request whose
+    lease is never renewed comes back on reap."""
+    q = RequestQueue(lease_s=0.03)
+    rid = q.submit(np.asarray([1, 2], np.int32), 4)
+    req = q.claim()
+    assert req.rid == rid and q.claim() is None
+    assert q.reap_expired() == []          # lease still fresh
+    time.sleep(0.04)
+    assert q.reap_expired() == [rid]
+    again = q.claim()
+    assert again.rid == rid and again.attempts == 2
+
+
+def test_queue_complete_is_idempotent():
+    q = RequestQueue()
+    rid = q.submit(np.asarray([1], np.int32), 2)
+    q.claim()
+    assert q.complete(rid, [5, 6]) is True
+    assert q.complete(rid, [7, 8]) is False     # late duplicate
+    assert q.request(rid).tokens == [5, 6]      # first commit won
+    assert q.n_duplicate_commits == 1
+    assert q.drained()
+
+
+def test_queue_retry_backoff_then_fail():
+    q = RequestQueue(backoff_s=0.01)
+    rid = q.submit(np.asarray([1], np.int32), 2, max_retries=1)
+    q.claim()
+    assert q.fail(rid, RuntimeError("boom")) == "queued"
+    assert q.claim() is None               # backoff gates visibility
+    time.sleep(0.015)
+    assert q.claim().rid == rid
+    assert q.fail(rid, RuntimeError("boom2")) == "failed"
+    assert rid in q.failed and "boom2" in q.failed[rid].error
+    assert q.drained()
+
+
+def test_stale_engine_cannot_double_queue_or_mutate():
+    """A reaped lease fences the old claimant: its fail() is a stale
+    no-op (no duplicate heap entry -> no double admission) and its
+    late complete() cannot commit over the reissued attempt."""
+    q = RequestQueue(lease_s=0.02)
+    rid = q.submit(np.asarray([1, 2], np.int32), 4)
+    # capture the claim generation as an INT at claim time — the
+    # Request object is live and its claim_seq moves on re-claim
+    # (the engine does the same via _Row.seq)
+    old_seq = q.claim().claim_seq
+    time.sleep(0.03)
+    assert q.reap_expired() == [rid]
+    # stale engine still holds the OLD claim generation
+    assert q.fail(rid, RuntimeError("stale"), seq=old_seq) == "stale"
+    fresh = q.claim()
+    assert fresh.rid == rid and q.claim() is None   # exactly one copy
+    assert q.complete(rid, [9, 9], seq=old_seq) is False
+    assert q.request(rid).state == "running"        # not clobbered
+    assert q.complete(rid, [5], seq=fresh.claim_seq) is True
+
+
+def test_late_commit_never_resurrects_a_failed_request():
+    q = RequestQueue(lease_s=0.02)
+    rid = q.submit(np.asarray([1], np.int32), 2, max_retries=0)
+    old_seq = q.claim().claim_seq
+    time.sleep(0.03)
+    q.reap_expired()
+    q.claim()
+    q.fail(rid, RuntimeError("terminal"))           # exhausts retries
+    assert q.request(rid).state == "failed"
+    assert q.complete(rid, [7], seq=old_seq) is False
+    assert q.request(rid).state == "failed"         # stays terminal
+    assert rid in q.failed and rid not in q.done
+
+
+def test_engine_validates_geometry():
+    mesh = make_model_mesh(dp=1, tp=1, sp=1)
+    params = init_params(jax.random.key(0), CFG, mesh)
+    with pytest.raises(ValueError, match="max_seq"):
+        Engine(params, mesh, CFG, ServeConfig(max_prompt=64,
+                                              max_new=64))
+    with pytest.raises(ValueError, match="pool holds"):
+        Engine(params, mesh, CFG, ServeConfig(max_prompt=16,
+                                              max_new=16, n_blocks=2))

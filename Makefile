@@ -5,7 +5,7 @@
 PY ?= python
 
 .PHONY: test test-fast chaos bench native clean sweep scaling northstar \
-	trace-demo check decode-smoke draft-smoke serve-smoke
+	trace-demo check decode-smoke draft-smoke serve-smoke quant-smoke
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -48,6 +48,7 @@ check:
 		echo "$$bad"; exit 1; \
 	fi
 	@echo "check OK: icikit/serve SLO clocks are monotonic"
+	JAX_PLATFORMS=cpu $(PY) tools/quant_lint.py
 
 # multi-token decode smoke: a tiny CPU speculative decode under an
 # armed obs session — the acceptance counters/spans must flow and the
@@ -80,6 +81,26 @@ draft-smoke:
 	@grep -q "draft.loss" /tmp/icikit_draft_metrics.json && \
 		grep -q "decode.spec.draft_accepted" /tmp/icikit_draft_metrics.json && \
 		echo "draft-smoke OK: trace valid, distill + trained-drafter metrics present"
+
+# quantized-decode smoke: a tiny int8 generate (decode-bench row, the
+# acceptance counters still flow) and an int8 serving step, both under
+# an armed obs session with the exported trace structurally validated
+# — keeps the int8 path (weights + KV + engine arenas) exercised
+# end-to-end alongside its tier-1 tests
+quant-smoke:
+	JAX_PLATFORMS=cpu \
+	ICIKIT_OBS="trace=/tmp/icikit_quant_trace.json;metrics=/tmp/icikit_quant_metrics.json;jsonl=off" \
+	$(PY) -m icikit.bench.decode --preset tiny --batch 2 --prompt 8 \
+		--new 12 --decode-quant int8 --runs 1 > /dev/null
+	$(PY) -m icikit.obs.check /tmp/icikit_quant_trace.json
+	JAX_PLATFORMS=cpu \
+	ICIKIT_OBS="trace=/tmp/icikit_quant_serve_trace.json;metrics=/tmp/icikit_quant_serve_metrics.json;jsonl=off" \
+	$(PY) -m icikit.bench.serve --preset tiny --rows 2 --requests 4 \
+		--rate 50 --prompt 8 --new-min 4 --new-max 8 --block-size 4 \
+		--decode-quant int8 --mode continuous --seed 0 > /dev/null
+	$(PY) -m icikit.obs.check /tmp/icikit_quant_serve_trace.json
+	@grep -q "serve.ttft_ms" /tmp/icikit_quant_serve_metrics.json && \
+		echo "quant-smoke OK: int8 generate + serve traces valid"
 
 # continuous-batching serving smoke: a tiny Poisson-arrival engine run
 # under an armed obs session — the serve.request spans must pass the

@@ -39,22 +39,56 @@ from icikit import obs
 VMEM_RESIDENT_BYTES = 10 * 1024 * 1024
 
 
+BYTES_DTYPES = ("bf16", "int8")
+
+
+def _weight_bytes_per_elt(bytes_dtype: str) -> float:
+    if bytes_dtype not in BYTES_DTYPES:
+        raise ValueError(f"unknown bytes_dtype {bytes_dtype!r} "
+                         f"(known: {', '.join(BYTES_DTYPES)})")
+    return 1.0 if bytes_dtype == "int8" else 2.0
+
+
+def quant_scale_count(cfg) -> int:
+    """fp32 per-output-channel scales the int8 decode pytree adds
+    (models/transformer/quant layouts) — the honest overhead term of
+    the int8 byte model (~1/d_in of the weight stream)."""
+    L, D, H, Dh, F = (cfg.n_layers, cfg.d_model, cfg.n_heads,
+                      cfg.d_head, cfg.d_ff)
+    kv = cfg.n_kv_heads or cfg.n_heads
+    if kv != cfg.n_heads:
+        attn = L * H * Dh + L * 2 * kv * Dh      # wq + wkv
+    else:
+        attn = L * 3 * H * Dh                     # wqkv
+    return attn + L * D + L * F + L * D + cfg.vocab  # wo, w1, w2, w_out
+
+
 def decode_bytes_per_token(cfg, batch: int, cache_len: float,
-                           vmem_resident: int = VMEM_RESIDENT_BYTES
-                           ) -> float:
+                           vmem_resident: int = VMEM_RESIDENT_BYTES,
+                           bytes_dtype: str = "bf16") -> float:
     """HBM bytes one decode step must read: every matmul parameter once
-    (bf16 compute copies; the embedding table is a b-row gather, not a
-    full read, so it is excluded) + the KV cache, minus the
-    VMEM-resident share of the loop-invariant parameter stream (see
-    ``VMEM_RESIDENT_BYTES``). ``cache_len`` is the *allocated* cache
-    length — the decode loop attends the full padded cache with a mask
-    every step, not just the filled prefix."""
+    (compute copies at ``bytes_dtype`` width; the embedding table is a
+    b-row gather, not a full read, so it is excluded) + the KV cache,
+    minus the VMEM-resident share of the loop-invariant parameter
+    stream (see ``VMEM_RESIDENT_BYTES``). ``cache_len`` is the
+    *allocated* cache length — the decode loop attends the full padded
+    cache with a mask every step, not just the filled prefix.
+    ``bytes_dtype="int8"`` prices the quantized path: 1 byte/element
+    for weights AND cache, plus the fp32 scale streams (per output
+    channel for weights, per (position, head) for K and V)."""
     from icikit.bench.train import matmul_param_count
     kv_heads = cfg.n_kv_heads or cfg.n_heads
+    wb = _weight_bytes_per_elt(bytes_dtype)
     params = matmul_param_count(cfg) - cfg.vocab * cfg.d_model  # emb gather
     cache = 2 * batch * cache_len * kv_heads * cfg.d_head * cfg.n_layers
-    param_bytes = max(0.0, 2.0 * params - vmem_resident)
-    return param_bytes + 2.0 * cache
+    param_bytes = wb * params
+    cache_bytes = wb * cache
+    if bytes_dtype == "int8":
+        param_bytes += 4.0 * quant_scale_count(cfg)
+        # one fp32 scale per cache column per kv head, K and V
+        cache_bytes += 4.0 * 2 * batch * cache_len * kv_heads \
+            * cfg.n_layers
+    return max(0.0, param_bytes - vmem_resident) + cache_bytes
 
 
 # HBM nameplate read bandwidth by TPU generation (bytes/s), keyed by
@@ -140,36 +174,46 @@ SPEC_FLOOR_MS = 0.703
 
 def spec_bytes_per_iter(cfg, batch: int, cache_len: float, k: int,
                         draft_layers: int,
-                        vmem_resident: int = VMEM_RESIDENT_BYTES):
+                        vmem_resident: int = VMEM_RESIDENT_BYTES,
+                        bytes_dtype: str = "bf16"):
     """HBM bytes one speculative draft+verify iteration reads, split
     (draft_bytes_total, verify_bytes). The drafter streams the first
     ``draft_layers`` layers' params + the shared head once per draft
     token ((k-1)×); the verify pass is byte-identical to one
     single-token step (same full param + cache read — that k tokens
     come out of it is the whole point). The VMEM-resident subtraction
-    applies once per pass, exactly as in ``decode_bytes_per_token``."""
+    applies once per pass, exactly as in ``decode_bytes_per_token``.
+    ``bytes_dtype`` prices both passes at the given storage width
+    (the int8 path quantizes drafter and verify streams alike)."""
     from icikit.bench.train import matmul_param_count
     kv_heads = cfg.n_kv_heads or cfg.n_heads
+    wb = _weight_bytes_per_elt(bytes_dtype)
     head = cfg.vocab * cfg.d_model
     p_layers = matmul_param_count(cfg) - 2 * head   # minus emb + head
-    cache = 2.0 * (2 * batch * cache_len * kv_heads * cfg.d_head
-                   * cfg.n_layers)
+    cache = wb * (2 * batch * cache_len * kv_heads * cfg.d_head
+                  * cfg.n_layers)
     frac = draft_layers / cfg.n_layers
-    draft_pass = (max(0.0, 2.0 * (p_layers * frac + head) - vmem_resident)
-                  + cache * frac)
-    verify = decode_bytes_per_token(cfg, batch, cache_len, vmem_resident)
+    draft_w = wb * (p_layers * frac + head)
+    if bytes_dtype == "int8":
+        sc = quant_scale_count(cfg)
+        draft_w += 4.0 * ((sc - cfg.vocab) * frac + cfg.vocab)
+        cache += 4.0 * 2 * batch * cache_len * kv_heads * cfg.n_layers
+    draft_pass = (max(0.0, draft_w - vmem_resident) + cache * frac)
+    verify = decode_bytes_per_token(cfg, batch, cache_len, vmem_resident,
+                                    bytes_dtype)
     return (k - 1) * draft_pass, verify
 
 
 def _spec_iter_ms(cfg, batch: int, cache_len: float, k: int,
                   draft_layers: int, t_fix_ms: float,
-                  bw: float) -> tuple:
+                  bw: float, bytes_dtype: str = "bf16") -> tuple:
     """One draft+verify iteration under the r7 pass-time model
     (t_pass = t_fix·(L'/L) + bytes/BW) — the single formula both
     ``spec_cost_model`` and ``spec_breakeven_rows`` price with (they
     differ only in how they anchor ``t_fix``/the baseline)."""
     draft_b, verify_b = spec_bytes_per_iter(cfg, batch, cache_len, k,
-                                            draft_layers)
+                                            draft_layers,
+                                            bytes_dtype=bytes_dtype)
     frac = draft_layers / cfg.n_layers
     t_iter_ms = ((k - 1) * t_fix_ms * frac + t_fix_ms
                  + (draft_b + verify_b) / bw * 1e3)
@@ -179,7 +223,8 @@ def _spec_iter_ms(cfg, batch: int, cache_len: float, k: int,
 def spec_cost_model(cfg, batch: int, cache_len: float, k: int,
                     draft_layers: int, tokens_per_step: float,
                     floor_ms: float = SPEC_FLOOR_MS,
-                    stream_gbps: float = SPEC_STREAM_GBPS) -> dict:
+                    stream_gbps: float = SPEC_STREAM_GBPS,
+                    bytes_dtype: str = "bf16") -> dict:
     """Acceptance-rate × cost model: projected v5e effective ms/token
     at the MEASURED ``tokens_per_step`` (the device-independent
     quantity this harness measures wherever it runs).
@@ -189,28 +234,42 @@ def spec_cost_model(cfg, batch: int, cache_len: float, k: int,
     scaffolding backed out of the committed b=1 floor row — the
     layer-proportional share is the round-5 profile's serialized
     per-layer fusion cost. Fields carry every model input so a future
-    TPU session can re-derive or refute the projection row by row."""
+    TPU session can re-derive or refute the projection row by row.
+
+    ``bytes_dtype`` is the r10 axis: ``t_fix`` is ALWAYS backed out of
+    the measured bf16 floor row (the only committed measurement), then
+    the byte terms re-price at the requested width — the int8 rows'
+    ``model_floor_ms_dtype`` is the re-priced single-token floor the
+    quantized path races, and ``projected_vs_floor`` compares against
+    it (apples to apples: int8 speculation vs int8 single-token)."""
     bw = stream_gbps * 1e9
-    base_bytes = decode_bytes_per_token(cfg, batch, cache_len)
-    t_fix_ms = max(0.0, floor_ms - base_bytes / bw * 1e3)
+    base_bytes_bf16 = decode_bytes_per_token(cfg, batch, cache_len)
+    t_fix_ms = max(0.0, floor_ms - base_bytes_bf16 / bw * 1e3)
+    base_bytes = decode_bytes_per_token(cfg, batch, cache_len,
+                                        bytes_dtype=bytes_dtype)
+    floor_dtype = t_fix_ms + base_bytes / bw * 1e3
     t_iter_ms, bytes_iter = _spec_iter_ms(cfg, batch, cache_len, k,
-                                          draft_layers, t_fix_ms, bw)
+                                          draft_layers, t_fix_ms, bw,
+                                          bytes_dtype)
     eff = t_iter_ms / tokens_per_step
     return {
         "model_stream_gbps": stream_gbps,
         "model_floor_ms": floor_ms,
+        "bytes_dtype": bytes_dtype,
+        "model_floor_ms_dtype": round(floor_dtype, 4),
         "model_t_fix_ms": round(t_fix_ms, 4),
         "model_bytes_iter": bytes_iter,
         "model_iter_ms": round(t_iter_ms, 4),
         "projected_eff_ms_per_token": round(eff, 4),
-        "projected_vs_floor": round(eff / floor_ms, 4),
+        "projected_vs_floor": round(eff / floor_dtype, 4),
     }
 
 
 def spec_breakeven_rows(preset: str = "base",
                         batches=(1, 4, 16), ks=(2, 4, 8),
                         draft_fracs=(0.25, 0.5),
-                        cache_len: int = 320) -> list[dict]:
+                        cache_len: int = 320,
+                        bytes_dtype: str = "bf16") -> list[dict]:
     """Batch-aware speculative pricing (ROADMAP 3c): break-even
     acceptance α per batch size b ∈ {1, 4, 16}.
 
@@ -245,10 +304,11 @@ def spec_breakeven_rows(preset: str = "base",
     bw = SPEC_STREAM_GBPS * 1e9
     rows = []
     for b in batches:
-        base_bytes = decode_bytes_per_token(cfg, b, cache_len)
-        # b=1 anchors on the committed measured floor row; larger b
-        # scale the byte term and keep t_fix (per-pass scaffolding is
-        # serialized dispatch work, not per-row work)
+        base_bytes = decode_bytes_per_token(cfg, b, cache_len,
+                                            bytes_dtype=bytes_dtype)
+        # b=1 anchors on the committed measured floor row (ALWAYS the
+        # bf16 measurement — t_fix is dispatch scaffolding, byte-width
+        # independent); larger b scale the byte term and keep t_fix
         t_fix_ms = max(0.0, SPEC_FLOOR_MS - decode_bytes_per_token(
             cfg, 1, cache_len) / bw * 1e3)
         t_base_ms = t_fix_ms + base_bytes / bw * 1e3
@@ -256,7 +316,7 @@ def spec_breakeven_rows(preset: str = "base",
             for frac in draft_fracs:
                 ld = max(1, round(cfg.n_layers * frac))
                 t_iter_ms, _ = _spec_iter_ms(cfg, b, cache_len, k, ld,
-                                             t_fix_ms, bw)
+                                             t_fix_ms, bw, bytes_dtype)
                 be = (t_iter_ms / t_base_ms - 1) / (k - 1)
                 be15 = (t_iter_ms / (0.85 * t_base_ms) - 1) / (k - 1)
                 rows.append({
@@ -267,11 +327,13 @@ def spec_breakeven_rows(preset: str = "base",
                     "k": k,
                     "draft_layers": ld,
                     "draft_fraction": round(ld / cfg.n_layers, 4),
+                    "bytes_dtype": bytes_dtype,
                     "model_stream_gbps": SPEC_STREAM_GBPS,
                     "model_t_fix_ms": round(t_fix_ms, 4),
                     "baseline_ms_per_token": round(t_base_ms, 4),
-                    "baseline_source": ("measured-floor" if b == 1
-                                        else "modeled"),
+                    "baseline_source": (
+                        "measured-floor" if b == 1
+                        and bytes_dtype == "bf16" else "modeled"),
                     "model_iter_ms": round(t_iter_ms, 4),
                     "breakeven_acceptance": round(be, 4),
                     "breakeven_acceptance_15pct": round(be15, 4),
@@ -304,7 +366,8 @@ def load_measured_alpha(path: str, batch: int = 1) -> dict:
 
 def cost_model_rows(alpha_from: str, preset: str = "base",
                     batch: int = 1, cache_len: int = 320,
-                    alpha_batch: int = 1) -> list[dict]:
+                    alpha_batch: int = 1,
+                    bytes_dtype: str = "bf16") -> list[dict]:
     """The priced verdict, reproducible by one command: evaluate
     ``spec_cost_model`` at every acceptance point MEASURED in
     ``alpha_from`` instead of hand-entered α values. Each row carries
@@ -330,11 +393,15 @@ def cost_model_rows(alpha_from: str, preset: str = "base",
         ld_price = max(1, round(cfg.n_layers * frac))
         tps = 1.0 + (k - 1) * a
         m = spec_cost_model(cfg, batch, cache_len, k, ld_price,
-                            tokens_per_step=tps)
+                            tokens_per_step=tps,
+                            bytes_dtype=bytes_dtype)
         iter_ms = m["model_iter_ms"]
-        be = ((iter_ms / SPEC_FLOOR_MS - 1) / (k - 1) if k > 1
+        # the floor the route races is the single-token baseline AT
+        # THE SAME byte width (int8 speculation vs int8 single-token)
+        floor = m["model_floor_ms_dtype"]
+        be = ((iter_ms / floor - 1) / (k - 1) if k > 1
               else None)
-        be15 = ((iter_ms / (0.85 * SPEC_FLOOR_MS) - 1) / (k - 1)
+        be15 = ((iter_ms / (0.85 * floor) - 1) / (k - 1)
                 if k > 1 else None)
         rows.append({
             "kind": "projection",
@@ -364,7 +431,8 @@ def run_bench(preset: str, dp: int, tp: int, batch: int, prompt_len: int,
               kv_heads: int = 0, windows: int = 3, speculate: int = 0,
               draft_layers: int = 0,
               decode_step: str = "unfused",
-              drafter: str = "shared") -> dict:
+              drafter: str = "shared",
+              decode_quant: str = "none") -> dict:
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -391,9 +459,18 @@ def run_bench(preset: str, dp: int, tp: int, batch: int, prompt_len: int,
     draft_over = ({"draft_head": True, "draft_layers": draft_layers}
                   if drafter == "trained" else {})
     cfg = TransformerConfig(**over, n_kv_heads=kv_heads,
-                            decode_step=decode_step, **draft_over)
+                            decode_step=decode_step,
+                            decode_quant=decode_quant, **draft_over)
+    bytes_dtype = "int8" if decode_quant == "int8" else "bf16"
     mesh = make_model_mesh(dp=dp, tp=tp, sp=1)
     params = init_params(jax.random.key(0), cfg, mesh)
+    if decode_quant == "int8":
+        # quantize ONCE outside the timing loop — the measured rows
+        # must price the int8 stream, not the one-time conversion
+        from icikit.models.transformer.decode import (
+            maybe_quantize_params,
+        )
+        params = maybe_quantize_params(params, mesh, cfg)
     rng = np.random.default_rng(0)
     sh = NamedSharding(mesh, P("dp", None))
     if speculate and sampling != "greedy":
@@ -465,11 +542,12 @@ def run_bench(preset: str, dp: int, tp: int, batch: int, prompt_len: int,
         # clamping spec rows against the single-token floor would
         # discard a genuinely winning row as "implausibly fast"
         d_b, v_b = spec_bytes_per_iter(cfg, batch, prompt_len + n_new,
-                                       speculate, d_layers)
+                                       speculate, d_layers,
+                                       bytes_dtype=bytes_dtype)
         bytes_per_token_floor = (d_b + v_b) / speculate
     else:
         bytes_per_token_floor = decode_bytes_per_token(
-            cfg, batch, prompt_len + n_new)
+            cfg, batch, prompt_len + n_new, bytes_dtype=bytes_dtype)
     floor_s = (n_new * bytes_per_token_floor / nameplate
                if nameplate else None)
     res = timeit_windows(lambda prompt: gen(prompt, n_new), (p0,),
@@ -477,9 +555,12 @@ def run_bench(preset: str, dp: int, tp: int, batch: int, prompt_len: int,
                          floor_s=floor_s)
     per_token_s = res.median_s / n_new
     bw = decode_bytes_per_token(
-        cfg, batch, prompt_len + n_new) / per_token_s
+        cfg, batch, prompt_len + n_new,
+        bytes_dtype=bytes_dtype) / per_token_s
     kv_tag = f"_kv{kv_heads}" if kv_heads else ""
     spec_tag = (f"_spec{speculate}d{d_layers}" if speculate else "")
+    if decode_quant == "int8":
+        kv_tag += "_q8"
     if speculate and drafter != "shared":
         spec_tag += f"_{drafter}"
     step_tag = ("" if decode_step == "unfused" else f"_{decode_step}")
@@ -503,7 +584,8 @@ def run_bench(preset: str, dp: int, tp: int, batch: int, prompt_len: int,
             "tokens_per_step": round(st["tokens_per_step"], 4),
             "verify_steps": st["verify_steps"],
             **spec_cost_model(cfg, batch, prompt_len + n_new, speculate,
-                              d_layers, st["tokens_per_step"]),
+                              d_layers, st["tokens_per_step"],
+                              bytes_dtype=bytes_dtype),
         }
     return {
         "metric": f"decode_{preset}_dp{dp}tp{tp}_b{batch}{kv_tag}"
@@ -515,6 +597,8 @@ def run_bench(preset: str, dp: int, tp: int, batch: int, prompt_len: int,
         # able to tell a fused row from a fallback row
         "decode_step_resolved": ("fused" if _resolve_step(cfg)
                                  else "unfused"),
+        "decode_quant": decode_quant,
+        "bytes_dtype": bytes_dtype,
         "backend": jax.default_backend(),
         **rec_extra,
         "value": round(batch / per_token_s, 1),
@@ -547,7 +631,8 @@ def run_sweep(preset: str, batches, prompt_len: int, n_new: int,
               tp: int = 1, sampling: str = "greedy", speculate: int = 0,
               draft_layers: int = 0,
               decode_step: str = "unfused",
-              drafter: str = "shared") -> list[dict]:
+              drafter: str = "shared",
+              decode_quant: str = "none") -> list[dict]:
     """Batch sweep against the measured HBM roofline (DECODE.md).
 
     Decode reads all parameters once per *step* regardless of batch, so
@@ -574,7 +659,8 @@ def run_sweep(preset: str, batches, prompt_len: int, n_new: int,
         rec = run_bench(preset, dp, tp, b, prompt_len, n_new,
                         sampling=sampling, runs=runs, kv_heads=kv_heads,
                         speculate=speculate, draft_layers=draft_layers,
-                        decode_step=decode_step, drafter=drafter)
+                        decode_step=decode_step, drafter=drafter,
+                        decode_quant=decode_quant)
         rec["roofline_gbps"] = round(bw_ceiling / 1e9, 1)
         rec["pct_roofline"] = round(
             100.0 * rec["read_gbps"] / (bw_ceiling / 1e9), 1)
@@ -642,6 +728,21 @@ def main(argv=None) -> int:
     ap.add_argument("--cache-len", type=int, default=320,
                     help="cost-model cache length (320 = the study's "
                          "64-prompt + 256-generated shape)")
+    ap.add_argument("--bytes-dtype", default="bf16",
+                    choices=list(BYTES_DTYPES),
+                    help="storage width the cost model prices weights "
+                         "AND KV at: 'int8' re-prices the floor, "
+                         "break-even α and projections for the "
+                         "quantized decode path (DECODE.md round 10); "
+                         "t_fix stays anchored on the measured bf16 "
+                         "floor row")
+    ap.add_argument("--decode-quant", default="none",
+                    choices=["none", "int8"],
+                    help="run the hardware rows on the quantized "
+                         "decode path (int8 weights + int8 KV, "
+                         "fp32 accumulation; weights quantized once "
+                         "outside the timing loop). Byte models and "
+                         "floors re-price automatically")
     ap.add_argument("--decode-step", default="unfused",
                     choices=["auto", "fused", "unfused"],
                     help="single-token inner step: 'fused' = one "
@@ -662,14 +763,16 @@ def main(argv=None) -> int:
             preset=args.preset,
             batches=tuple(int(b)
                           for b in args.breakeven_batches.split(",")),
-            cache_len=args.cache_len)
+            cache_len=args.cache_len,
+            bytes_dtype=args.bytes_dtype)
     elif args.cost_model:
         if not args.alpha_from:
             ap.error("--cost-model requires --alpha-from RECORDS")
         recs = cost_model_rows(args.alpha_from, preset=args.preset,
                                batch=args.batch,
                                cache_len=args.cache_len,
-                               alpha_batch=args.alpha_batch)
+                               alpha_batch=args.alpha_batch,
+                               bytes_dtype=args.bytes_dtype)
     elif args.sweep:
         recs = run_sweep(args.preset,
                          [int(b) for b in args.sweep.split(",")],
@@ -677,7 +780,7 @@ def main(argv=None) -> int:
                          args.kv_heads, args.dp, args.tp,
                          args.sampling, args.speculate,
                          args.draft_layers, args.decode_step,
-                         args.drafter)
+                         args.drafter, args.decode_quant)
     else:
         recs = [run_bench(args.preset, args.dp, args.tp, args.batch,
                           args.prompt, args.n_new, args.sampling,
@@ -685,7 +788,8 @@ def main(argv=None) -> int:
                           speculate=args.speculate,
                           draft_layers=args.draft_layers,
                           decode_step=args.decode_step,
-                          drafter=args.drafter)]
+                          drafter=args.drafter,
+                          decode_quant=args.decode_quant)]
     obs.emit_records(recs)
     if args.json_path:
         # append: record files accumulate across invocations (the

@@ -194,7 +194,8 @@ def run_bench(preset: str, rows: int, n_requests: int, rate_rps: float,
               speculate: int = 1, ngram_n: int = 3,
               integrity: str = "none", dp: int = 1, tp: int = 1,
               seed: int = 0, mode: str = "both",
-              compute_dtype: str = "") -> list[dict]:
+              compute_dtype: str = "",
+              decode_quant: str = "none") -> list[dict]:
     import jax
 
     from icikit.bench.train import PRESETS
@@ -213,9 +214,19 @@ def run_bench(preset: str, rows: int, n_requests: int, rate_rps: float,
         # would charge the engine an XLA:CPU artifact a native-bf16
         # TPU never pays. fp32 puts both modes on the same arithmetic.
         over["compute_dtype"] = compute_dtype
-    cfg = TransformerConfig(**over)
+    cfg = TransformerConfig(**over, decode_quant=decode_quant)
     mesh = make_model_mesh(dp=dp, tp=tp, sp=1)
     params = init_params(jax.random.key(0), cfg, mesh)
+    if decode_quant == "int8":
+        # quantize ONCE, outside every timed window: the engine already
+        # converts at setup; without this hoist the STATIC baseline
+        # would re-quantize the whole pytree per timed generate call
+        # and the continuous-over-static ratio would be inflated by a
+        # conversion artifact (the bench.decode discipline)
+        from icikit.models.transformer.decode import (
+            maybe_quantize_params,
+        )
+        params = maybe_quantize_params(params, mesh, cfg)
     if not n_blocks:
         # enough for a full batch of worst-case rows plus slack
         per_row = -(-horizon // block_size)
@@ -238,6 +249,7 @@ def run_bench(preset: str, rows: int, n_requests: int, rate_rps: float,
         "block_size": block_size, "n_blocks": n_blocks,
         "speculate": speculate,
         "integrity": integrity,
+        "decode_quant": decode_quant,
         "compute_dtype": cfg.compute_dtype,
         "seed": seed,
         # measured-where-we-ran provenance (the decode-bench rule):
@@ -276,6 +288,12 @@ def main(argv=None) -> int:
                     help="k-token ngram-drafted verify windows "
                          "(1 = single-token decode)")
     ap.add_argument("--ngram-n", type=int, default=3)
+    ap.add_argument("--decode-quant", default="none",
+                    choices=["none", "int8"],
+                    help="serve on the quantized decode path: int8 "
+                         "weights (quantized once at engine setup) + "
+                         "int8 KV arenas with scale pages — the "
+                         "kv_quant='auto' resolution follows")
     ap.add_argument("--integrity", default="none",
                     choices=["none", "pages"])
     ap.add_argument("--dp", type=int, default=1)
@@ -297,7 +315,8 @@ def main(argv=None) -> int:
                      args.prompt, args.new_min, args.new_max,
                      args.block_size, args.blocks, args.speculate,
                      args.ngram_n, args.integrity, args.dp, args.tp,
-                     args.seed, args.mode, args.compute_dtype)
+                     args.seed, args.mode, args.compute_dtype,
+                     args.decode_quant)
     obs.emit_records(recs)
     if args.json_path:
         # append: record files accumulate across invocations

@@ -47,6 +47,14 @@ def sort(x: jax.Array, mesh, axis: str = DEFAULT_AXIS,
          algorithm: str = "bitonic", **kw) -> jax.Array:
     """Sort flat ``x`` ascending across the mesh; returns the flat
     sorted array (same length and dtype)."""
+    from icikit import chaos
+
+    # chaos sites at the dispatch boundary (ROADMAP 5c remainder): the
+    # sort fuzzers run under `delay` plans to shake out schedule-
+    # dependent deadlocks — a straggling dispatch must only ever be
+    # slow, never wrong (drilled in tests/test_chaos_sites.py)
+    chaos.maybe_delay(f"sort.{algorithm}")
+    chaos.maybe_die(f"sort.{algorithm}")
     impl = get_algorithm("sort", algorithm)
     n = x.shape[0]
     blocks, _ = prepare_blocks(x, mesh, axis,

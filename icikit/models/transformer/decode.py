@@ -68,10 +68,12 @@ from icikit.models.transformer.model import (
 from icikit.models.transformer.moe import moe_ffn_shard
 from icikit.ops.flash_attention import (
     decode_step_attention,
+    decode_step_attention_q8,
     decode_step_cache_len,
     decode_step_supported,
     resolve_attention_impl,
 )
+from icikit.ops.quant import qmm, quantize_last
 from icikit.ops.rope import apply_rope, rope_sincos
 from icikit.parallel.shmap import wrap_program
 
@@ -132,6 +134,51 @@ def _window_masked_attention(q, ks, vs, mask, scale, n_rep):
     return out.reshape(b, w_len, h, dh).astype(q.dtype)
 
 
+def _masked_attention_q8(q, ks, vs, ksc, vsc, mask, scale, n_rep):
+    """int8-KV variant of ``_masked_attention``: a thin wrapper over
+    the window form — the single-token mask ``(T,)`` broadcasts as a
+    degenerate per-row window mask ``(1, 1, T)``, so ONE scale-folding
+    implementation serves both callers (a numerics fix lands once)."""
+    return _window_masked_attention_q8(q, ks, vs, ksc, vsc,
+                                       mask[None, None, :], scale,
+                                       n_rep)
+
+
+def _window_masked_attention_q8(q, ks, vs, ksc, vsc, mask, scale,
+                                n_rep):
+    """int8-KV attention over per-row masks (the one q8 formulation —
+    the single-token path wraps it): ``ks``/``vs`` are the *quantized*
+    caches (b, T, h/n_rep, dh) int8 with per-(position, head) scales
+    ``ksc``/``vsc`` (b, T, h/n_rep) fp32; ``mask`` broadcasts against
+    (b, w, T). The dequant FOLDS out of both matmuls — K's scale
+    multiplies the logit row (it is constant over the contracted dh),
+    V's folds into the attention weights before the value contraction
+    — so the int8 cache feeds the einsums directly and a
+    high-precision copy of the cache is never formed. fp32
+    accumulation and softmax throughout."""
+    b, w_len, h, dh = q.shape
+    if n_rep == 1:
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, ks.astype(q.dtype),
+                            preferred_element_type=jnp.float32)
+        logits = logits * ksc.transpose(0, 2, 1)[:, :, None, :] * scale
+        logits = jnp.where(mask[:, None, :, :], logits, NEG_INF)
+        w = jax.nn.softmax(logits, axis=-1)
+        wv = w * vsc.transpose(0, 2, 1)[:, :, None, :]
+        out = jnp.einsum("bhqk,bkhd->bqhd", wv, vs.astype(jnp.float32),
+                         preferred_element_type=jnp.float32)
+        return out.astype(q.dtype)
+    qg = q.reshape(b, w_len, h // n_rep, n_rep, dh)
+    logits = jnp.einsum("bqgrd,bkgd->bgrqk", qg, ks.astype(q.dtype),
+                        preferred_element_type=jnp.float32)
+    logits = logits * ksc.transpose(0, 2, 1)[:, :, None, None, :] * scale
+    logits = jnp.where(mask[:, None, None, :, :], logits, NEG_INF)
+    w = jax.nn.softmax(logits, axis=-1)
+    wv = w * vsc.transpose(0, 2, 1)[:, :, None, None, :]
+    out = jnp.einsum("bgrqk,bkgd->bqgrd", wv, vs.astype(jnp.float32),
+                     preferred_element_type=jnp.float32)
+    return out.reshape(b, w_len, h, dh).astype(q.dtype)
+
+
 def _top_k_mask(lg, k):
     thr = lax.top_k(lg, k)[0][:, -1:]
     return jnp.where(lg < thr, -jnp.inf, lg)
@@ -182,19 +229,53 @@ class _DecodeCtx:
         self.scale = cfg.d_head ** -0.5
         self.n_rep = _n_rep(cfg)
         self.p_dp = mesh.shape[DP_AXIS]
-        self.layer_keys = _layer_keys(cfg)
+        # int8 decode: the layer scan additionally slices the stacked
+        # per-layer scale leaves, and every matmul routes through the
+        # factored-dequant qmm (ops/quant) instead of the fp einsums
+        self.quant = cfg.decode_quant == "int8"
+        self.qimpl = cfg.quant_matvec
+        if self.quant:
+            from icikit.models.transformer.quant import quant_layer_keys
+            self.layer_keys = quant_layer_keys(cfg)
+        else:
+            self.layer_keys = _layer_keys(cfg)
 
     def qkv_proj(self, x, lp):
         h = _rms_norm(x, lp["ln1"]).astype(self.cdt)
-        return _project_qkv(h, lp, self.cdt)
+        if not self.quant:
+            return _project_qkv(h, lp, self.cdt)
+        if "wq" in lp:
+            q = qmm(h, lp["wq"], lp["wq_s"],
+                    impl=self.qimpl).astype(self.cdt)
+            kv = qmm(h, lp["wkv"], lp["wkv_s"],
+                     impl=self.qimpl).astype(self.cdt)
+            return q, kv[:, :, 0], kv[:, :, 1]
+        qkv = qmm(h, lp["wqkv"], lp["wqkv_s"],
+                  impl=self.qimpl).astype(self.cdt)
+        return qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
 
     def close_attn(self, x, attn, lp):
+        if self.quant:
+            # wo stored (D, H', Dh) with the contraction heads last:
+            # local partial sums scale per full output channel, the
+            # existing tp psum closes them
+            o = qmm(attn.astype(self.cdt), lp["wo"], lp["wo_s"],
+                    k_ndim=2, impl=self.qimpl)
+            return x + lax.psum(o, TP_AXIS)
         o = jnp.einsum("bshe,hed->bsd", attn.astype(self.cdt),
                        lp["wo"].astype(self.cdt))
         return x + lax.psum(o.astype(jnp.float32), TP_AXIS)
 
     def ffn(self, x, lp):
         cfg = self.cfg
+        if self.quant:
+            # dense only (MoE is gated off at config construction)
+            h2 = _rms_norm(x, lp["ln2"]).astype(self.cdt)
+            u = jax.nn.gelu(
+                qmm(h2, lp["w1"], lp["w1_s"],
+                    impl=self.qimpl)).astype(self.cdt)
+            m = qmm(u, lp["w2"], lp["w2_s"], impl=self.qimpl)
+            return x + lax.psum(m, TP_AXIS)
         if cfg.n_experts:
             # Dropless dispatch at decode (capacity = all local tokens):
             # the training-time capacity drop is a pool-level property
@@ -218,9 +299,17 @@ class _DecodeCtx:
         (b, w, D))."""
         cfg = self.cfg
         h = _rms_norm(x, params["ln_f"])
-        lg = jnp.einsum("...d,vd->...v", h.astype(self.cdt),
-                        params["w_out"].astype(self.cdt)
-                        ).astype(jnp.float32)
+        if self.quant:
+            # the 67 MB unembedding stream the cost model is floored
+            # by: int8 weights, fp32 accumulation, one scale per vocab
+            # row (ops/quant.qmm routes to the Pallas matvec when the
+            # kernel gate accepts the shape)
+            lg = qmm(h.astype(self.cdt), params["w_out"],
+                     params["w_out_s"], impl=self.qimpl)
+        else:
+            lg = jnp.einsum("...d,vd->...v", h.astype(self.cdt),
+                            params["w_out"].astype(self.cdt)
+                            ).astype(jnp.float32)
         if cfg.vocab_parallel:
             # Reassemble the full row by scattering the local shard
             # into zeros and psum'ing. This costs ~2x an all_gather's
@@ -252,7 +341,14 @@ def _prefill(ctx: _DecodeCtx, params, prompt, s_prompt: int, total: int,
     states ``x (b, s, D)`` and the padded per-layer K/V caches stacked
     on dim 0. Cache layout: ``(L, b, total, hkv, dh)`` for the JAX
     step, ``(L, b*h, total, dh)`` (heads flattened into rows) for the
-    fused Pallas step — the layout its grid addresses directly."""
+    fused Pallas step — the layout its grid addresses directly.
+
+    Under ``decode_quant="int8"`` the returned caches are the QUANTIZED
+    ones — ``(ks int8, vs int8, kss fp32, vss fp32)`` with per-(position,
+    head) scales — quantized at store time (the prompt's own attention
+    above ran on the raw projections, exactly like the engine's paged
+    prefill). High-precision K/V exists only as the transient
+    projection; nothing cache-shaped in fp ever rides the carry."""
     cfg = ctx.cfg
     b = prompt.shape[0]
     lp = {k: params[k] for k in ctx.layer_keys}
@@ -282,15 +378,26 @@ def _prefill(ctx: _DecodeCtx, params, prompt, s_prompt: int, total: int,
             h = k.shape[2]
             kr = k.transpose(0, 2, 1, 3).reshape(b * h, s_prompt, -1)
             vr = v.transpose(0, 2, 1, 3).reshape(b * h, s_prompt, -1)
-            ks = jnp.zeros((b * h, total, k.shape[3]), k.dtype)
-            vs = jnp.zeros_like(ks)
-            ks = lax.dynamic_update_slice_in_dim(ks, kr, 0, 1)
-            vs = lax.dynamic_update_slice_in_dim(vs, vr, 0, 1)
         else:
-            ks = jnp.zeros((b, total) + k.shape[2:], k.dtype)
+            kr, vr = k, v
+        if ctx.quant:
+            kq, ksn = quantize_last(kr)
+            vq, vsn = quantize_last(vr)
+            ks = jnp.zeros(kr.shape[:1] + (total,) + kr.shape[2:],
+                           jnp.int8)
             vs = jnp.zeros_like(ks)
-            ks = lax.dynamic_update_slice_in_dim(ks, k, 0, 1)
-            vs = lax.dynamic_update_slice_in_dim(vs, v, 0, 1)
+            kss = jnp.zeros(ksn.shape[:1] + (total,) + ksn.shape[2:],
+                            jnp.float32)
+            vss = jnp.zeros_like(kss)
+            ks = lax.dynamic_update_slice_in_dim(ks, kq, 0, 1)
+            vs = lax.dynamic_update_slice_in_dim(vs, vq, 0, 1)
+            kss = lax.dynamic_update_slice_in_dim(kss, ksn, 0, 1)
+            vss = lax.dynamic_update_slice_in_dim(vss, vsn, 0, 1)
+            return x, (ks, vs, kss, vss)
+        ks = jnp.zeros(kr.shape[:1] + (total,) + kr.shape[2:], kr.dtype)
+        vs = jnp.zeros_like(ks)
+        ks = lax.dynamic_update_slice_in_dim(ks, kr, 0, 1)
+        vs = lax.dynamic_update_slice_in_dim(vs, vr, 0, 1)
         return x, (ks, vs)
 
     return lax.scan(prefill_layer, x, lp)
@@ -334,9 +441,15 @@ def _build_generate(mesh, cfg: TransformerConfig, s_prompt: int, n_new: int,
     ctx = _DecodeCtx(cfg, mesh)
     fused = _resolve_decode_step(cfg)
     # the fused kernel's cache block wants a sublane-divisible column
-    # count; the pad columns are dead (masked, never written)
-    cache_len = (decode_step_cache_len(total, ctx.cdt) if fused
-                 else total)
+    # count; the pad columns are dead (masked, never written). The int8
+    # fused path additionally wants a lane-divisible count: its scale
+    # rows (rows, total) put the column axis on the LANE dim.
+    if fused:
+        cache_len = (decode_step_cache_len(total, jnp.int8, lane=True)
+                     if ctx.quant
+                     else decode_step_cache_len(total, ctx.cdt))
+    else:
+        cache_len = total
     layer_keys = ctx.layer_keys
 
     def per_shard(params, prompt, key_data, knobs):
@@ -347,8 +460,8 @@ def _build_generate(mesh, cfg: TransformerConfig, s_prompt: int, n_new: int,
         key = jax.random.fold_in(jax.random.wrap_key_data(key_data),
                                  lax.axis_index(DP_AXIS))
 
-        x, (kcache, vcache) = _prefill(ctx, params, prompt, s_prompt,
-                                       cache_len, fused)
+        x, caches = _prefill(ctx, params, prompt, s_prompt,
+                             cache_len, fused)
         tok0 = select(ctx.logits(params, x[:, -1]),
                       jax.random.fold_in(key, 0), knobs)
 
@@ -360,13 +473,23 @@ def _build_generate(mesh, cfg: TransformerConfig, s_prompt: int, n_new: int,
         # re-stack a fresh full cache per step (profiled: ~35% of the
         # b=32 step, a 16.8 MB copy per token), and a scan with
         # dynamically-indexed stacked caches materializes a per-layer
-        # slice copy on the read.
+        # slice copy on the read. Under int8 decode the carry holds the
+        # QUANTIZED caches plus their per-(position, head) scale
+        # buffers — the only cache-shaped allocations on that path.
+        if ctx.quant:
+            kcache, vcache, kscache, vscache = caches
+        else:
+            (kcache, vcache), kscache, vscache = caches, None, None
         n_layers = kcache.shape[0]
         kc = tuple(kcache[li] for li in range(n_layers))
         vc = tuple(vcache[li] for li in range(n_layers))
+        kss = (tuple(kscache[li] for li in range(n_layers))
+               if ctx.quant else ())
+        vss = (tuple(vscache[li] for li in range(n_layers))
+               if ctx.quant else ())
 
         def step(carry, i):
-            token, kc, vc = carry
+            token, kc, vc, kss, vss = carry
             cur = s_prompt + i
             x = params["emb"][token][:, None]
             if cfg.pos_encoding == "learned":
@@ -380,7 +503,7 @@ def _build_generate(mesh, cfg: TransformerConfig, s_prompt: int, n_new: int,
             mask = jnp.arange(total) <= cur
             sincos = (rope_sincos(cur[None], cfg.d_head, cfg.rope_theta)
                       if cfg.pos_encoding == "rope" else None)
-            if fused:
+            if fused and not ctx.quant:
                 # duplicated tables: the kernel's split-half rotation
                 # is two fmas against concat([c, c]) / concat([s, s])
                 if sincos is not None:
@@ -390,10 +513,36 @@ def _build_generate(mesh, cfg: TransformerConfig, s_prompt: int, n_new: int,
                     cos2 = jnp.ones((1, cfg.d_head), jnp.float32)
                     sin2 = jnp.zeros((1, cfg.d_head), jnp.float32)
             kc2, vc2 = [], []
+            kss2, vss2 = [], []
             for li in range(n_layers):
                 lp1 = {kk: lp[kk][li] for kk in layer_keys}
                 q, k, v = ctx.qkv_proj(x, lp1)
-                if fused:
+                if fused and ctx.quant:
+                    # one Pallas launch reading the int8 caches with
+                    # in-kernel dequant (scale folding); rope + column
+                    # quantization happen on the tiny fresh projections
+                    # outside, the scale-row update is one dus
+                    h_loc, dh = q.shape[2], q.shape[3]
+                    if cfg.pos_encoding == "rope":
+                        pos = cur[None]
+                        q = apply_rope(q, pos, cfg.rope_theta, sincos)
+                        k = apply_rope(k, pos, cfg.rope_theta, sincos)
+                    qr = q.reshape(b * h_loc, dh)
+                    kq, ksn = quantize_last(k.reshape(b * h_loc, dh))
+                    vq, vsn = quantize_last(v.reshape(b * h_loc, dh))
+                    ksrow = lax.dynamic_update_slice_in_dim(
+                        kss[li], ksn[:, None], cur, 1)
+                    vsrow = lax.dynamic_update_slice_in_dim(
+                        vss[li], vsn[:, None], cur, 1)
+                    kdq = kq.astype(jnp.float32) * ksn[:, None]
+                    vdq = vq.astype(jnp.float32) * vsn[:, None]
+                    attn, ks, vs = decode_step_attention_q8(
+                        qr, kq, vq, kdq, vdq, kc[li], vc[li],
+                        ksrow, vsrow, cur, scale=ctx.scale)
+                    attn = attn.reshape(b, 1, h_loc, dh)
+                    kss2.append(ksrow)
+                    vss2.append(vsrow)
+                elif fused:
                     # one Pallas launch: rope + cache column write +
                     # masked flash-decode read (rope applied in-kernel)
                     h_loc = q.shape[2]
@@ -411,33 +560,69 @@ def _build_generate(mesh, cfg: TransformerConfig, s_prompt: int, n_new: int,
                         pos = cur[None]
                         q = apply_rope(q, pos, cfg.rope_theta, sincos)
                         k = apply_rope(k, pos, cfg.rope_theta, sincos)
-                    ks = lax.dynamic_update_slice_in_dim(kc[li], k,
-                                                         cur, 1)
-                    vs = lax.dynamic_update_slice_in_dim(vc[li], v,
-                                                         cur, 1)
-                    attn = _masked_attention(q, ks, vs, mask, ctx.scale,
-                                             ctx.n_rep)
+                    if ctx.quant:
+                        kq, ksn = quantize_last(k)
+                        vq, vsn = quantize_last(v)
+                        ks = lax.dynamic_update_slice_in_dim(
+                            kc[li], kq, cur, 1)
+                        vs = lax.dynamic_update_slice_in_dim(
+                            vc[li], vq, cur, 1)
+                        ksrow = lax.dynamic_update_slice_in_dim(
+                            kss[li], ksn, cur, 1)
+                        vsrow = lax.dynamic_update_slice_in_dim(
+                            vss[li], vsn, cur, 1)
+                        attn = _masked_attention_q8(
+                            q, ks, vs, ksrow, vsrow, mask, ctx.scale,
+                            ctx.n_rep)
+                        kss2.append(ksrow)
+                        vss2.append(vsrow)
+                    else:
+                        ks = lax.dynamic_update_slice_in_dim(kc[li], k,
+                                                             cur, 1)
+                        vs = lax.dynamic_update_slice_in_dim(vc[li], v,
+                                                             cur, 1)
+                        attn = _masked_attention(q, ks, vs, mask,
+                                                 ctx.scale, ctx.n_rep)
                 x = ctx.close_attn(x, attn, lp1)
                 x = ctx.ffn(x, lp1)
                 kc2.append(ks)
                 vc2.append(vs)
             nxt = select(ctx.logits(params, x[:, 0]),
                          jax.random.fold_in(key, i + 1), knobs)
-            return (nxt, tuple(kc2), tuple(vc2)), token
+            return (nxt, tuple(kc2), tuple(vc2), tuple(kss2),
+                    tuple(vss2)), token
 
         # n_new - 1 steps: each emits its incoming token and computes the
         # next; the final token needs no further forward pass.
-        (last, _, _), toks = lax.scan(step, (tok0, kc, vc),
-                                      jnp.arange(n_new - 1))
+        (last, _, _, _, _), toks = lax.scan(
+            step, (tok0, kc, vc, kss, vss), jnp.arange(n_new - 1))
         generated = jnp.concatenate(
             [jnp.moveaxis(toks, 0, 1), last[:, None]], axis=1)
         return jnp.concatenate([prompt, generated.astype(prompt.dtype)],
                                axis=1)
 
+    from icikit.models.transformer.quant import decode_param_specs
     return wrap_program(per_shard, mesh,
-                        (param_specs(cfg), P(DP_AXIS, None), P(None),
-                         P(None)),
+                        (decode_param_specs(cfg), P(DP_AXIS, None),
+                         P(None), P(None)),
                         P(DP_AXIS, None))
+
+
+def maybe_quantize_params(params, mesh, cfg: TransformerConfig):
+    """The generate/engine setup hook of the int8 decode path: derive
+    the quantized pytree ONCE when the config arms ``decode_quant`` and
+    ``params`` is still the fp tree (already-quantized trees pass
+    through, so callers that hoist the conversion — the engine, the
+    bench timing loops — pay it exactly once)."""
+    if cfg.decode_quant != "int8":
+        return params
+    from icikit.models.transformer.quant import (
+        is_quantized_params,
+        quantize_decode_params,
+    )
+    if is_quantized_params(params):
+        return params
+    return quantize_decode_params(params, cfg, mesh)
 
 
 def greedy_generate(params, prompt, mesh, cfg: TransformerConfig,
@@ -447,6 +632,7 @@ def greedy_generate(params, prompt, mesh, cfg: TransformerConfig,
     from icikit import chaos
     chaos.maybe_delay("decode.prefill")   # host boundary of the jitted
     chaos.maybe_die("decode.prefill")     # prefill+decode program
+    params = maybe_quantize_params(params, mesh, cfg)
     key_data = jax.random.key_data(jax.random.key(0))  # unused by greedy
     knobs = jnp.ones((2,), jnp.float32)                 # unused by greedy
     return _build_generate(mesh, cfg, prompt.shape[1], n_new)(
@@ -472,6 +658,7 @@ def sample_generate(params, prompt, mesh, cfg: TransformerConfig,
     from icikit import chaos
     chaos.maybe_delay("decode.prefill")
     chaos.maybe_die("decode.prefill")
+    params = maybe_quantize_params(params, mesh, cfg)
     knobs = jnp.asarray([temperature, top_p], jnp.float32)
     return _build_generate(mesh, cfg, prompt.shape[1], n_new,
                            ("sample", int(top_k)))(

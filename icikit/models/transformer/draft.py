@@ -136,8 +136,16 @@ def draft_local_logits(params, x, cfg, cdt):
     """Per-shard draft logits ``(..., V)`` fp32 — vocab-SHARDED
     ``(..., V/tp)`` under ``vocab_parallel``, exactly like the main
     head's local logits (the distill loss reduces them with the same
-    collectives)."""
+    collectives). On the int8 decode path (``params`` is the quantized
+    pytree) the readout streams the quantized table — tied drafting
+    stays zero extra decode bytes: it reads the same int8 ``w_out``
+    the verify pass streams."""
     h = draft_hidden(params, x, cdt)
+    if cfg.decode_quant == "int8" and "w_out_s" in params:
+        from icikit.ops.quant import qmm
+        key = "w_out" if cfg.draft_tied else "draft_out"
+        return qmm(h, params[key], params[key + "_s"],
+                   impl=cfg.quant_matvec)
     w = unembed_weight(params, cfg)
     return jnp.einsum("...d,vd->...v", h,
                       w.astype(cdt)).astype(jnp.float32)

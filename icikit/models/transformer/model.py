@@ -194,6 +194,27 @@ class TransformerConfig:
     # draft_kl*KL(teacher || draft), teacher = the same forward's
     # full-model logits under stop_gradient.
     draft_kl: float = 0.5
+    # Quantized decode (r10): "int8" stores every decode-path matmul
+    # weight AND the KV cache as per-channel symmetric int8 (fp32
+    # accumulation, scales riding the pytree / the cache carry), which
+    # halves the byte stream the r7 cost model proved decode is floored
+    # by (DECODE.md "Quantized decode"). Training is untouched — the
+    # quantized pytree is derived ONCE at generate/engine setup
+    # (models/transformer/quant.quantize_decode_params). Greedy token
+    # identity vs the fp path is explicitly RELAXED to a measured top-1
+    # agreement bar (>= 0.999, tests/test_quant.py); within the int8
+    # path itself, speculative/engine token identity still holds
+    # exactly. "none" = the historical full-precision path. The ops
+    # layer (ops/quant.py QDTYPES) already speaks fp8 — config arming
+    # waits on a TPU pricing session.
+    decode_quant: str = "none"
+    # Kernel routing for the quantized matvecs (unembedding + MLP/attn
+    # projections): "pallas" forces the int8 fp32-accum kernel
+    # (ops/quant.quant_matvec — fails loudly when the gate rejects a
+    # shape, the decode_step="fused" discipline), "xla" forces the
+    # factored dequant einsum (same math, XLA-fused dequant), "auto"
+    # uses the kernel on TPU where supported.
+    quant_matvec: str = "auto"
 
 
 def make_model_mesh(n_devices: int | None = None, dp: int = 1, tp: int = 1,
@@ -233,6 +254,20 @@ def _check_cfg(cfg: TransformerConfig) -> None:
     if cfg.decode_step not in ("auto", "fused", "unfused"):
         raise ValueError(f"unknown decode_step {cfg.decode_step!r} "
                          "(known: auto, fused, unfused)")
+    if cfg.decode_quant not in ("none", "int8"):
+        raise ValueError(
+            f"unknown decode_quant {cfg.decode_quant!r} (known: none, "
+            "int8; the fp8 formats exist in ops/quant.QDTYPES but are "
+            "not config-armed until a TPU session prices them)")
+    if cfg.quant_matvec not in ("auto", "pallas", "xla"):
+        raise ValueError(f"unknown quant_matvec {cfg.quant_matvec!r} "
+                         "(known: auto, pallas, xla)")
+    if cfg.decode_quant != "none" and cfg.n_experts:
+        raise ValueError(
+            "decode_quant currently supports dense FFNs only "
+            "(n_experts > 0 streams expert weights through the MoE "
+            "dispatch, which the quantized matvec path has not been "
+            "built for)")
     if cfg.draft_head:
         if not 0 <= cfg.draft_layers <= cfg.n_layers:
             raise ValueError(

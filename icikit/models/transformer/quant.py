@@ -1,0 +1,262 @@
+"""Decode-path weight quantization: the int8 pytree and its specs.
+
+``quantize_decode_params`` derives, ONCE at generate/engine setup, the
+pytree the int8 decode path consumes (``cfg.decode_quant = "int8"``):
+
+- every decode matmul weight is re-laid-out **output-channels-first,
+  contraction-dim-last** and quantized per-channel symmetric int8
+  (``ops/quant.quantize_last``), with its fp32 scale riding the pytree
+  under ``<name>_s`` — checkpoints, shardings and the program
+  in_specs all see ordinary leaves;
+- the non-matmul leaves (embedding gather, norm scales, positional
+  table, the draft adapter) stay fp32 — they feed fp32 arithmetic
+  directly, exactly the ``make_train_step`` KEEP_FP32 rationale;
+- the layouts put the contraction axis last so ONE kernel contract
+  (``ops/quant.qmm``) serves the unembedding and every projection,
+  and so the per-layer scale leaves stack on dim 0 like their weights
+  (``lp[k][li]`` indexing in the decode scan bodies keeps working).
+
+Layouts (fp leaf -> int8 leaf + scale):
+
+====== ======================= ======================= ==============
+leaf   fp layout               int8 layout             scale
+====== ======================= ======================= ==============
+wqkv   (L, D, 3, H, Dh)        (L, 3, H, Dh, D)        (L, 3, H, Dh)
+wq     (L, D, H, Dh)           (L, H, Dh, D)           (L, H, Dh)
+wkv    (L, D, 2, Hkv, Dh)      (L, 2, Hkv, Dh, D)      (L, 2, Hkv, Dh)
+wo     (L, H, Dh, D)           (L, D, H, Dh)           (L, D)
+w1     (L, D, F)               (L, F, D)               (L, F)
+w2     (L, F, D)               (L, D, F)               (L, D)
+w_out  (V, D)                  (V, D)  (unchanged)     (V,)
+====== ======================= ======================= ==============
+
+(``draft_out``, when untied, quantizes exactly like ``w_out``.)
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from icikit.models.transformer.model import (
+    TP_AXIS,
+    TransformerConfig,
+    _is_gqa,
+    _layer_keys,
+    param_specs,
+)
+from icikit.ops.quant import quantize_last
+
+SCALE_SUFFIX = "_s"
+
+# fp leaf -> (transpose bringing contraction dim(s) last, k_ndim)
+_LAYOUTS = {
+    "wqkv": ((0, 2, 3, 4, 1), 1),
+    "wq": ((0, 2, 3, 1), 1),
+    "wkv": ((0, 2, 3, 4, 1), 1),
+    "wo": ((0, 3, 1, 2), 2),        # contraction = (H, Dh)
+    "w1": ((0, 2, 1), 1),
+    "w2": ((0, 2, 1), 1),
+    "w_out": (None, 1),             # already (V, D)
+    "draft_out": (None, 1),
+}
+
+
+def quant_weight_keys(cfg: TransformerConfig) -> tuple:
+    """The param leaves the int8 decode path stores quantized."""
+    keys = [k for k in _layer_keys(cfg) if k in _LAYOUTS]
+    keys.append("w_out")
+    if cfg.draft_head and not cfg.draft_tied:
+        keys.append("draft_out")
+    return tuple(keys)
+
+
+def is_quantized_params(params) -> bool:
+    """True when ``params`` is already the quantized pytree (the
+    generate entry points quantize on the fly otherwise)."""
+    return ("w_out" + SCALE_SUFFIX) in params
+
+
+def quantize_decode_params(params, cfg: TransformerConfig, mesh=None):
+    """fp params -> the int8 decode pytree (int8 leaves + ``_s`` scales,
+    non-matmul leaves passed through). With ``mesh``, every new leaf is
+    ``device_put`` under its ``quant_param_specs`` sharding; without,
+    leaves stay wherever jit places them (single-program tests)."""
+    if cfg.decode_quant != "int8":
+        raise ValueError("quantize_decode_params needs a config with "
+                         f"decode_quant='int8', got {cfg.decode_quant!r}")
+    if is_quantized_params(params):
+        return params
+    out = dict(params)
+    for k in quant_weight_keys(cfg):
+        perm, k_ndim = _LAYOUTS[k]
+        w = params[k]
+        if perm is not None:
+            w = jnp.transpose(w, perm)
+        if k_ndim > 1:
+            # multi-axis contraction (wo's (H, Dh)): one scale per
+            # OUTPUT channel means quantizing over the flattened
+            # contraction, then restoring the layout
+            flat = w.reshape(w.shape[:-k_ndim] + (-1,))
+            q, s = quantize_last(flat)
+            q = q.reshape(w.shape)
+        else:
+            q, s = quantize_last(w)
+        out[k] = q
+        out[k + SCALE_SUFFIX] = s
+    if mesh is not None:
+        specs = quant_param_specs(cfg)
+        out = {k: jax.device_put(v, NamedSharding(mesh, specs[k]))
+               for k, v in out.items()}
+    return out
+
+
+def quant_param_specs(cfg: TransformerConfig) -> dict:
+    """PartitionSpecs for the quantized pytree: int8 leaves keep their
+    fp leaf's sharded axis (moved with the transpose), scales shard
+    wherever their channel axis was sharded."""
+    specs = dict(param_specs(cfg))
+    qspecs = {
+        # (L, 3, H, Dh, D): heads still over tp
+        "wqkv": (P(None, None, TP_AXIS, None, None),
+                 P(None, None, TP_AXIS, None)),
+        "wq": (P(None, TP_AXIS, None, None), P(None, TP_AXIS, None)),
+        "wkv": (P(None, None, TP_AXIS, None, None),
+                P(None, None, TP_AXIS, None)),
+        # (L, D, H, Dh): contraction heads over tp; the (L, D) scale is
+        # replicated (every tp shard owns whole output channels whose
+        # partial sums close over the existing psum)
+        "wo": (P(None, None, TP_AXIS, None), P()),
+        "w1": (P(None, TP_AXIS, None), P(None, TP_AXIS)),
+        "w2": (P(None, None, TP_AXIS), P()),
+        "w_out": ((P(TP_AXIS, None), P(TP_AXIS))
+                  if cfg.vocab_parallel else (P(), P())),
+    }
+    qspecs["draft_out"] = qspecs["w_out"]
+    for k in quant_weight_keys(cfg):
+        qs, ss = qspecs[k]
+        specs[k] = qs
+        specs[k + SCALE_SUFFIX] = ss
+    return specs
+
+
+def decode_param_specs(cfg: TransformerConfig) -> dict:
+    """The in_specs pytree decode/engine program builders use: the
+    quantized specs when the int8 path is armed, the fp specs
+    otherwise — one switch point for every program builder."""
+    return (quant_param_specs(cfg) if cfg.decode_quant == "int8"
+            else param_specs(cfg))
+
+
+def quant_layer_keys(cfg: TransformerConfig) -> tuple:
+    """Per-layer keys the quantized decode scan bodies slice: the fp
+    layer keys plus the stacked scale leaves."""
+    base = _layer_keys(cfg)
+    return base + tuple(k + SCALE_SUFFIX for k in base if k in _LAYOUTS)
+
+
+# ------------------------------------------------- the parity metric
+
+def _build_forced(mesh, cfg: TransformerConfig, S: int):
+    """Teacher-forced decode program: run committed tokens ``(b, S)``
+    through ONE full-width verify window from empty caches and return
+    the per-position argmax + fp32 logits. ``_window_pass`` writes each
+    position's (quantized, under int8) K/V before attending, so query
+    ``i`` reads exactly the cache state step-``i`` decode would — this
+    IS the decode path's next-token prediction at every prefix, batched
+    (the window/step equivalence is what the speculative token-identity
+    suite pins)."""
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from icikit.models.transformer.decode import _DecodeCtx
+    from icikit.models.transformer.model import DP_AXIS
+    from icikit.models.transformer.speculative import _window_pass
+    from icikit.parallel.shmap import wrap_program
+
+    ctx = _DecodeCtx(cfg, mesh)
+    L = cfg.n_layers
+
+    def per_shard(params, seqs):
+        b = seqs.shape[0]
+        lp = {k: params[k] for k in ctx.layer_keys}
+        kv = cfg.n_kv_heads or cfg.n_heads
+        kv_loc = kv // mesh.shape["tp"]
+        shape = (b, S, kv_loc, cfg.d_head)
+        if ctx.quant:
+            kc = tuple(jnp.zeros(shape, jnp.int8) for _ in range(L))
+            vc = tuple(jnp.zeros(shape, jnp.int8) for _ in range(L))
+            kss = tuple(jnp.zeros(shape[:-1], jnp.float32)
+                        for _ in range(L))
+            vss = tuple(jnp.zeros(shape[:-1], jnp.float32)
+                        for _ in range(L))
+        else:
+            cdt = jnp.dtype(cfg.compute_dtype)
+            kc = tuple(jnp.zeros(shape, cdt) for _ in range(L))
+            vc = tuple(jnp.zeros(shape, cdt) for _ in range(L))
+            kss, vss = (), ()
+        x, *_ = _window_pass(ctx, params, lp, kc, vc, kss, vss, seqs,
+                             jnp.zeros((b,), jnp.int32), range(L), S)
+        lg = ctx.logits(params, x)                      # (b, S, V)
+        return jnp.argmax(lg, axis=-1).astype(jnp.int32), lg
+
+    return wrap_program(per_shard, mesh,
+                        (decode_param_specs(cfg), P(DP_AXIS, None)),
+                        (P(DP_AXIS, None), P(DP_AXIS, None, None)))
+
+
+def measure_top1_agreement(params, seqs, mesh, cfg: TransformerConfig,
+                           s_prompt: int) -> dict:
+    """The r10 parity metric: MEASURED teacher-forced top-1 agreement
+    between the int8 and fp decode paths (DECODE.md "Quantized
+    decode"). Token identity across the paths is explicitly RELAXED —
+    this function is the relaxation's measurement: both paths predict
+    the next token at every committed prefix of ``seqs`` (the fp
+    path's greedy continuations), and agreement is the fraction of
+    generated-region positions where the argmaxes coincide. The dict
+    also reports the max logit deviation, so a test can verify the
+    comparison is not vacuous (the quantized path really computes
+    different numerics, and the bar is met anyway).
+
+    ``cfg`` must have ``decode_quant="int8"``; the fp reference runs
+    the same geometry with quantization off.
+    """
+    import dataclasses
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from icikit.models.transformer.decode import maybe_quantize_params
+
+    if cfg.decode_quant != "int8":
+        raise ValueError("measure_top1_agreement compares the int8 "
+                         "path against fp — pass decode_quant='int8'")
+    seqs = jnp.asarray(seqs, jnp.int32)
+    S = seqs.shape[1]
+    cfg_fp = dataclasses.replace(cfg, decode_quant="none")
+    am_fp, lg_fp = _build_forced(mesh, cfg_fp, S)(params, seqs)
+    qparams = maybe_quantize_params(params, mesh, cfg)
+    am_q8, lg_q8 = _build_forced(mesh, cfg, S)(qparams, seqs)
+    # position i predicts token i+1; score from s_prompt on: position
+    # s_prompt-1 (the deployed path's FIRST token) comes out of
+    # _prefill, whose prompt self-attention runs on the raw
+    # projections — the window formulation here attends the quantized
+    # prompt columns instead, so scoring it would measure a
+    # computation the shipped path never runs
+    lo = s_prompt
+    if lo >= S - 1:
+        raise ValueError(
+            f"no scorable positions: seqs length {S} leaves nothing "
+            f"after the prompt ({s_prompt}) — a silent NaN here would "
+            "read as a failed (or vacuously passed) parity bar")
+    a_fp = np.asarray(am_fp)[:, lo:S - 1]
+    a_q8 = np.asarray(am_q8)[:, lo:S - 1]
+    dlg = float(np.max(np.abs(np.asarray(lg_fp, np.float32)
+                              - np.asarray(lg_q8, np.float32))))
+    return {
+        "n_positions": int(a_fp.size),
+        "n_agree": int((a_fp == a_q8).sum()),
+        "top1_agreement": float((a_fp == a_q8).mean()),
+        "max_logit_abs_diff": dlg,
+    }

@@ -62,13 +62,15 @@ from icikit.models.transformer.decode import (
     _DecodeCtx,
     _prefill,
     _window_masked_attention,
+    _window_masked_attention_q8,
+    maybe_quantize_params,
 )
 from icikit.models.transformer.model import (
     DP_AXIS,
     SP_AXIS,
     TransformerConfig,
-    param_specs,
 )
+from icikit.ops.quant import quantize_last
 from icikit.ops.rope import apply_rope, rope_sincos
 from icikit.parallel.shmap import wrap_program
 
@@ -106,12 +108,15 @@ def _accept_window(w_toks, g, active):
     return m, a, new_tok
 
 
-def _window_pass(ctx: _DecodeCtx, params, lp, kc, vc, toks, cur,
-                 layers, cache_len: int):
+def _window_pass(ctx: _DecodeCtx, params, lp, kc, vc, kss, vss, toks,
+                 cur, layers, cache_len: int):
     """Run window ``toks (b, w)`` at per-row positions ``cur..cur+w-1``
     through ``layers`` (a range — the drafter passes the truncated
     prefix, verify the full stack), writing w cache columns per layer.
-    Returns (hidden (b, w, D) fp32-stream, kc', vc')."""
+    Returns (hidden (b, w, D) fp32-stream, kc', vc', kss', vss').
+    Under int8 decode the caches are quantized (``kss``/``vss`` carry
+    the per-(position, head) scales, written through the same per-row
+    window update); otherwise the scale tuples pass through empty."""
     cfg = ctx.cfg
     b, w = toks.shape
     pos = cur[:, None] + jnp.arange(w)[None, :]          # (b, w)
@@ -122,20 +127,32 @@ def _window_pass(ctx: _DecodeCtx, params, lp, kc, vc, toks, cur,
     # t <= cur_row + i — committed prefix plus the window's own prefix
     mask = (jnp.arange(cache_len)[None, None, :] <= pos[:, :, None])
     kc2, vc2 = list(kc), list(vc)
+    kss2, vss2 = list(kss), list(vss)
     for li in layers:
         lp1 = {kk: lp[kk][li] for kk in ctx.layer_keys}
         q, k, v = ctx.qkv_proj(x, lp1)
         if sincos is not None:
             q = apply_rope(q, pos, cfg.rope_theta, sincos)
             k = apply_rope(k, pos, cfg.rope_theta, sincos)
-        ks = _row_update(kc2[li], k, cur)
-        vs = _row_update(vc2[li], v, cur)
-        attn = _window_masked_attention(q, ks, vs, mask, ctx.scale,
-                                        ctx.n_rep)
+        if ctx.quant:
+            kq, ksn = quantize_last(k)       # (b, w, hkv), per column
+            vq, vsn = quantize_last(v)
+            ks = _row_update(kc2[li], kq, cur)
+            vs = _row_update(vc2[li], vq, cur)
+            kss2[li] = _row_update(kss2[li], ksn, cur)
+            vss2[li] = _row_update(vss2[li], vsn, cur)
+            attn = _window_masked_attention_q8(
+                q, ks, vs, kss2[li], vss2[li], mask, ctx.scale,
+                ctx.n_rep)
+        else:
+            ks = _row_update(kc2[li], k, cur)
+            vs = _row_update(vc2[li], v, cur)
+            attn = _window_masked_attention(q, ks, vs, mask, ctx.scale,
+                                            ctx.n_rep)
         x = ctx.close_attn(x, attn, lp1)
         x = ctx.ffn(x, lp1)
         kc2[li], vc2[li] = ks, vs
-    return x, tuple(kc2), tuple(vc2)
+    return x, tuple(kc2), tuple(vc2), tuple(kss2), tuple(vss2)
 
 
 @lru_cache(maxsize=None)
@@ -200,8 +217,15 @@ def _build_speculative(mesh, cfg: TransformerConfig, s_prompt: int,
     def per_shard(params, prompt):
         b = prompt.shape[0]
         lp = {kk: params[kk] for kk in ctx.layer_keys}
-        x, (kcache, vcache) = _prefill(ctx, params, prompt, s_prompt,
-                                       cache_len, fused=False)
+        x, caches = _prefill(ctx, params, prompt, s_prompt,
+                             cache_len, fused=False)
+        if ctx.quant:
+            kcache, vcache, kscache, vscache = caches
+            kss = tuple(kscache[li] for li in range(n_layers))
+            vss = tuple(vscache[li] for li in range(n_layers))
+        else:
+            kcache, vcache = caches
+            kss, vss = (), ()
         kc = tuple(kcache[li] for li in range(n_layers))
         vc = tuple(vcache[li] for li in range(n_layers))
         tok0 = jnp.argmax(ctx.logits(params, x[:, -1]), axis=-1)
@@ -211,7 +235,7 @@ def _build_speculative(mesh, cfg: TransformerConfig, s_prompt: int,
         init = (tok0.astype(jnp.int32),                  # pending token
                 jnp.full((b,), s_prompt, jnp.int32),     # its position
                 jnp.ones((b,), jnp.int32),               # tokens done
-                out, kc, vc,
+                out, kc, vc, kss, vss,
                 jnp.zeros((_N_STATS,), jnp.int32))
 
         def cond(carry):
@@ -219,7 +243,7 @@ def _build_speculative(mesh, cfg: TransformerConfig, s_prompt: int,
             return jnp.any(n_done < n_new)
 
         def body(carry):
-            tok, cur, n_done, out, kc, vc, stats = carry
+            tok, cur, n_done, out, kc, vc, kss, vss, stats = carry
             active = n_done < n_new                      # (b,) bool
 
             if drafter == "ngram" and k > 1:
@@ -239,10 +263,9 @@ def _build_speculative(mesh, cfg: TransformerConfig, s_prompt: int,
                 drafts = []
                 t, c = tok, cur
                 for _ in range(k - 1):
-                    x, kc, vc = _window_pass(ctx, params, lp, kc, vc,
-                                             t[:, None], c,
-                                             range(draft_layers),
-                                             cache_len)
+                    x, kc, vc, kss, vss = _window_pass(
+                        ctx, params, lp, kc, vc, kss, vss, t[:, None],
+                        c, range(draft_layers), cache_len)
                     t = jnp.argmax(draft_logits(params, x[:, 0]),
                                    axis=-1).astype(jnp.int32)
                     drafts.append(t)
@@ -252,8 +275,9 @@ def _build_speculative(mesh, cfg: TransformerConfig, s_prompt: int,
             # --- verify: the pending token + k-1 drafts in ONE
             # stacked-layer pass — all matmul weights read once per
             # k-token window (the weights-stationary step)
-            x, kc, vc = _window_pass(ctx, params, lp, kc, vc, w_toks,
-                                     cur, range(n_layers), cache_len)
+            x, kc, vc, kss, vss = _window_pass(
+                ctx, params, lp, kc, vc, kss, vss, w_toks, cur,
+                range(n_layers), cache_len)
             g = jnp.argmax(ctx.logits(params, x),
                            axis=-1).astype(jnp.int32)    # (b, k)
 
@@ -271,16 +295,18 @@ def _build_speculative(mesh, cfg: TransformerConfig, s_prompt: int,
                 active.sum().astype(jnp.int32),
                 jnp.where(active, m, 0).sum().astype(jnp.int32)])
             return (jnp.where(active, new_tok, tok), cur + a,
-                    n_done + a, out, kc, vc, stats)
+                    n_done + a, out, kc, vc, kss, vss, stats)
 
-        (_, _, _, out, _, _, stats) = lax.while_loop(cond, body, init)
+        (_, _, _, out, _, _, _, _, stats) = lax.while_loop(cond, body,
+                                                           init)
         stats = lax.psum(stats, DP_AXIS)
         return (jnp.concatenate(
             [prompt, out[:, :n_new].astype(prompt.dtype)], axis=1),
             stats)
 
+    from icikit.models.transformer.quant import decode_param_specs
     return wrap_program(per_shard, mesh,
-                        (param_specs(cfg), P(DP_AXIS, None)),
+                        (decode_param_specs(cfg), P(DP_AXIS, None)),
                         (P(DP_AXIS, None), P()))
 
 
@@ -350,6 +376,7 @@ def speculative_generate(params, prompt, mesh, cfg: TransformerConfig,
     chaos.maybe_die("decode.spec.prefill")
     chaos.maybe_delay(f"decode.spec.drafter.{drafter}")
     chaos.maybe_die(f"decode.spec.drafter.{drafter}")
+    params = maybe_quantize_params(params, mesh, cfg)
     with obs.span("decode.speculative", k=k, draft_layers=draft_layers,
                   n_new=n_new, drafter=drafter):
         toks, stats = _build_speculative(

@@ -1056,13 +1056,17 @@ def decode_step_supported(d_head: int, n_rep: int, dtype) -> bool:
     return jax.default_backend() in ("tpu", "cpu")
 
 
-def decode_step_cache_len(total: int, dtype) -> int:
+def decode_step_cache_len(total: int, dtype, lane: bool = False) -> int:
     """Cache columns the fused step's block wants: ``total`` rounded up
     to the dtype's sublane multiple (the (total, dh) cache block's
     second-minor dim). The pad columns are dead — the kernel's
-    ``t <= cur`` mask never reaches them."""
+    ``t <= cur`` mask never reaches them. ``lane=True`` rounds to the
+    128-lane multiple instead: the int8 step's per-column scale rows
+    ``(rows, total)`` put the column axis on the LANE dim, so the int8
+    cache pads to the stricter of the two (128 covers int8's 32-row
+    sublane too)."""
     from icikit.ops.pallas_common import sublane
-    sub = sublane(dtype)
+    sub = 128 if lane else sublane(dtype)
     return (total + sub - 1) // sub * sub
 
 
@@ -1127,4 +1131,111 @@ def decode_step_attention(q, k, v, kcache, vcache, cur, cos, sin, *,
         input_output_aliases={6: 1, 7: 2},   # donate both caches
         interpret=interpret,
     )(idx, q, k, v, cos, sin, kcache, vcache)
+    return attn, kc, vc
+
+
+def _decode_step_q8_kernel(cur_ref, q_ref, kq_ref, vq_ref, kdq_ref,
+                           vdq_ref, kc_ref, vc_ref, ksc_ref, vsc_ref,
+                           o_ref, ko_ref, vo_ref, *, scale, total, dh):
+    """int8-KV row of the fused decode step: the caches arrive (and
+    stay) int8; the dequant FOLDS — K's per-column scale multiplies the
+    logit row after the int8 dot, V's folds into the attention weights
+    before the value dot — so no high-precision copy of the cache is
+    ever formed, in VMEM or HBM. The fresh column arrives pre-quantized
+    (``kq``/``vq``; rope + round happen on the tiny (rows, dh)
+    projection outside — the scale is a per-row scalar whose (1, 1)
+    write-back Mosaic's lane tiling cannot express, so the scale ROW
+    update is one dus outside the launch) together with its dequantized
+    value (``kdq``/``vdq``) for the ``t == cur`` patch."""
+    cur = cur_ref[0]
+    q = q_ref[...].astype(jnp.float32)               # (1, dh)
+    kdq = kdq_ref[...].astype(jnp.float32)
+    vdq = vdq_ref[...].astype(jnp.float32)
+    ko_ref[0] = kq_ref[...].astype(ko_ref.dtype)     # int8 column write
+    vo_ref[0] = vq_ref[...].astype(vo_ref.dtype)
+    kc = kc_ref[0]                                   # (total, dh) int8
+    ksc = ksc_ref[...]                               # (1, total) fp32
+    vsc = vsc_ref[...]
+    raw = lax.dot_general(q, kc.astype(jnp.float32),
+                          (((1,), (1,)), ((), ())),
+                          preferred_element_type=jnp.float32)   # (1, T)
+    raw = raw * ksc                                  # folded K dequant
+    qk = lax.dot_general(q, kdq, (((1,), (1,)), ((), ())),
+                         preferred_element_type=jnp.float32)    # (1, 1)
+    t_idx = lax.broadcasted_iota(jnp.int32, (1, total), 1)
+    logits = jnp.where(t_idx < cur, raw * scale, NEG_INF)
+    logits = jnp.where(t_idx == cur, qk * scale, logits)
+    m = jnp.max(logits, axis=1, keepdims=True)
+    w = jnp.exp(logits - m)
+    l = jnp.sum(w, axis=1, keepdims=True)
+    w_cur = jnp.sum(jnp.where(t_idx == cur, w, 0.0), axis=1,
+                    keepdims=True)
+    w_past = jnp.where(t_idx < cur, w, 0.0) * vsc    # folded V dequant
+    acc = lax.dot_general(w_past, vc_ref[0].astype(jnp.float32),
+                          (((1,), (0,)), ((), ())),
+                          preferred_element_type=jnp.float32)
+    acc = acc + w_cur * vdq
+    o_ref[...] = (acc / l).astype(o_ref.dtype)
+
+
+def decode_step_attention_q8(q, kq, vq, kdq, vdq, kcache, vcache,
+                             kscale, vscale, cur, *, scale: float,
+                             interpret: bool | None = None):
+    """Fused single-token decode step over INT8 KV caches (MHA).
+
+    Args:
+      q: this step's (already rope-rotated) queries, ``(rows, dh)``.
+      kq, vq: the fresh K/V column, quantized ``(rows, dh)`` int8.
+      kdq, vdq: the same column dequantized ``(rows, dh)`` fp32 (the
+        ``t == cur`` logit/value patch — the kernel's input cache block
+        is stale at the written column, exactly as in the fp kernel).
+      kcache, vcache: ``(rows, total, dh)`` int8 caches, donated and
+        returned updated in place (one int8 row moves per step).
+      kscale, vscale: ``(rows, total)`` fp32 per-column scales, ALREADY
+        holding the fresh column's scale at ``cur`` (the caller's dus;
+        the kernel reads only the ``t < cur`` lanes).
+      cur: traced scalar — the column to write / last visible position.
+
+    Returns ``(attn (rows, dh) fp32, kcache', vcache')``. Callers must
+    check ``decode_step_supported`` first and pad ``total`` with
+    ``decode_step_cache_len(..., lane=True)`` (the scale rows put the
+    column axis on the lane dim).
+    """
+    rows, dh = q.shape
+    total = kcache.shape[1]
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    idx = jnp.asarray(cur, jnp.int32).reshape(1)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(rows,),
+        in_specs=[
+            pl.BlockSpec((1, dh), lambda g, i: (g, 0)),        # q
+            pl.BlockSpec((1, dh), lambda g, i: (g, 0)),        # kq
+            pl.BlockSpec((1, dh), lambda g, i: (g, 0)),        # vq
+            pl.BlockSpec((1, dh), lambda g, i: (g, 0)),        # kdq
+            pl.BlockSpec((1, dh), lambda g, i: (g, 0)),        # vdq
+            pl.BlockSpec((1, total, dh), lambda g, i: (g, 0, 0)),  # kc
+            pl.BlockSpec((1, total, dh), lambda g, i: (g, 0, 0)),  # vc
+            pl.BlockSpec((1, total), lambda g, i: (g, 0)),     # kscale
+            pl.BlockSpec((1, total), lambda g, i: (g, 0)),     # vscale
+        ],
+        out_specs=[
+            pl.BlockSpec((1, dh), lambda g, i: (g, 0)),        # attn
+            pl.BlockSpec((1, 1, dh), lambda g, i: (g, i[0], 0)),
+            pl.BlockSpec((1, 1, dh), lambda g, i: (g, i[0], 0)),
+        ],
+    )
+    attn, kc, vc = pl.pallas_call(
+        partial(_decode_step_q8_kernel, scale=float(scale),
+                total=total, dh=dh),
+        grid_spec=grid_spec,
+        out_shape=[
+            _out_struct((rows, dh), jnp.float32, q, kcache, vcache),
+            _out_struct(kcache.shape, kcache.dtype, q, kcache, vcache),
+            _out_struct(vcache.shape, vcache.dtype, q, kcache, vcache),
+        ],
+        input_output_aliases={6: 1, 7: 2},   # donate both int8 caches
+        interpret=interpret,
+    )(idx, q, kq, vq, kdq, vdq, kcache, vcache, kscale, vscale)
     return attn, kc, vc

@@ -1,0 +1,240 @@
+"""Per-channel symmetric int8 quantization + the int8 matvec kernel.
+
+Why this exists: the r7 decode cost model (DECODE.md, `bench.decode
+spec_cost_model`) proved b=1 decode is BYTES-dominated — 0.703 ms/token
+at the measured 700 GB/s streaming ceiling, with the 67 MB fp32/bf16
+unembedding flooring every shallow-draft scheme. Every byte the weight
+and KV streams shed comes straight off that floor, so an int8 path cuts
+the largest cost term in the inference stack in half (ROADMAP item 2:
+"the single biggest raw-speed lever on record").
+
+Scheme — per-channel symmetric, contraction-dim-last:
+
+- every quantized tensor stores its **contraction axis last** (weights
+  are re-laid-out ``(out..., K)`` at quantize time, the KV cache is
+  already ``(..., d_head)``), so one convention covers weights and
+  cache: ``scale = max|x| / 127`` over the last axis, ``q = round(x /
+  scale)`` clipped to ``[-127, 127]``. Symmetric (no zero point): the
+  dequant is one multiply, which *folds out of the matmul* — ``x @
+  dequant(q, s)`` per output channel equals ``(x @ q) * s`` exactly, so
+  the int8 operand feeds the MXU directly and the fp32 accumulator is
+  scaled once per output element. Zero channels store ``scale = 0`` and
+  dequantize to exact zeros (no epsilon fuzz; the divisor is made safe
+  separately).
+- the formats are parameterized by ``qdtype`` so the fp8 variants slot
+  in behind the same API when a session prices them (``QDTYPES`` maps
+  name -> (dtype, qmax)); only int8 is wired through the model configs
+  today.
+
+Kernel: ``quant_matvec`` — one Pallas launch computing ``(x @ w8^T) *
+scale`` with fp32 accumulation, gridded over output-channel tiles so
+the int8 weight block streams HBM->VMEM once and never materializes in
+high precision. Decode's matvecs are tiny in FLOPs and huge in bytes;
+the kernel's job is to keep the stream at 1 byte/param. The gate
+(``quant_matvec_supported``) mirrors ``decode_step_supported``:
+lane-exact contraction dim, tileable channel count, a backend with a
+Mosaic lowering (CPU runs interpret mode for parity tests). Off-gate
+callers use ``qmm`` below, whose XLA formulation computes the same
+factored math (dequant fused by XLA on TPU; the int8 operand is still
+what HBM streams).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from icikit.ops.pallas_common import out_struct as _out_struct
+
+# name -> (storage dtype, symmetric max). The fp8 rows are the promised
+# plumbing: quantize/dequantize/qmm accept them today, the model-layer
+# wiring (TransformerConfig.decode_quant) arms only "int8" until a TPU
+# session prices the fp8 variants (their win over int8 is MXU-native
+# fp8 matmul throughput, invisible on the CPU protocol).
+QDTYPES = {
+    "int8": (jnp.int8, 127.0),
+    "fp8_e4m3": (getattr(jnp, "float8_e4m3fn", None), 448.0),
+    "fp8_e5m2": (getattr(jnp, "float8_e5m2", None), 57344.0),
+}
+
+
+def _qdtype(name: str):
+    if name not in QDTYPES:
+        raise ValueError(f"unknown quant dtype {name!r} "
+                         f"(known: {', '.join(sorted(QDTYPES))})")
+    dt, qmax = QDTYPES[name]
+    if dt is None:
+        raise ValueError(f"quant dtype {name!r} is not available in "
+                         "this jax build")
+    return jnp.dtype(dt), qmax
+
+
+def quantize_last(x, qdtype: str = "int8"):
+    """Per-channel symmetric quantization over the LAST axis.
+
+    Returns ``(q, scale)`` with ``q`` of ``x.shape`` in the storage
+    dtype and ``scale`` fp32 of ``x.shape[:-1]``. Channels that are
+    identically zero store ``scale = 0`` (their dequant is exact zero);
+    the divisor is replaced by 1 where the scale vanishes, so no
+    NaN/inf ever enters the quantized tensor. Values at the channel
+    max land exactly on ±qmax (saturation is the clip, not overflow).
+    """
+    dt, qmax = _qdtype(qdtype)
+    x32 = jnp.asarray(x, jnp.float32)
+    amax = jnp.max(jnp.abs(x32), axis=-1)
+    scale = amax / qmax
+    safe = jnp.where(scale > 0, scale, 1.0)[..., None]
+    scaled = jnp.clip(x32 / safe, -qmax, qmax)
+    if jnp.issubdtype(dt, jnp.integer):
+        scaled = jnp.round(scaled)
+    # float qdtypes (fp8): the storage cast IS the rounding — fp8
+    # round-to-nearest happens in astype; an integer jnp.round here
+    # would collapse every |x| < scale/2 to zero and double-round the
+    # rest (fp8's value grid is not the integers)
+    return scaled.astype(dt), scale.astype(jnp.float32)
+
+
+def dequantize_last(q, scale):
+    """Inverse of :func:`quantize_last`: fp32 ``q * scale`` with the
+    scale broadcast over the last axis."""
+    return q.astype(jnp.float32) * scale.astype(jnp.float32)[..., None]
+
+
+# ------------------------------------------------------------ the kernel
+
+def _matvec_kernel(x_ref, w_ref, s_ref, o_ref):
+    """One output-channel tile: fp32-accum ``x (rows, K) @ w8 (bn, K)^T``
+    scaled per channel. The int8 block is upcast in VMEM registers only
+    — HBM streamed it at 1 byte/element, which is the whole point."""
+    acc = lax.dot_general(x_ref[...].astype(jnp.float32),
+                          w_ref[...].astype(jnp.float32),
+                          (((1,), (1,)), ((), ())),
+                          preferred_element_type=jnp.float32)
+    o_ref[...] = acc * s_ref[...]
+
+
+def _pick_n_block(n: int) -> int | None:
+    for bn in (512, 256, 128):
+        if n % bn == 0:
+            return bn
+    return None
+
+
+def quant_matvec_supported(rows: int, n: int, k: int) -> bool:
+    """Gate for the Pallas int8 matvec: lane-exact contraction dim,
+    tileable output-channel count, and a backend with a Mosaic lowering
+    (CPU runs interpret mode, so the same path is testable off-TPU).
+    Mirrors ``flash_attention.decode_step_supported``'s contract:
+    callers check first; forcing the kernel off-gate fails loudly."""
+    if k % 128 or k < 128:
+        return False
+    if _pick_n_block(n) is None:
+        return False
+    return jax.default_backend() in ("tpu", "cpu")
+
+
+def quant_matvec(x, w8, scale, *, interpret: bool | None = None):
+    """``(x @ w8^T) * scale`` in one Pallas launch, fp32 out.
+
+    Args:
+      x: ``(rows, K)`` float activations (any float dtype; upcast to
+        fp32 in-register for the accumulation).
+      w8: ``(N, K)`` quantized weights, contraction dim last — the
+        layout ``quantize_last`` produces for re-laid-out weights.
+      scale: ``(N,)`` fp32 per-output-channel scales.
+
+    Returns ``(rows, N)`` fp32. Callers must check
+    :func:`quant_matvec_supported` first; this function raises on an
+    unsupported geometry rather than silently falling back (an A/B row
+    must never measure the fallback by accident).
+    """
+    from jax.experimental import pallas as pl
+
+    rows, k = x.shape
+    n = w8.shape[0]
+    if not quant_matvec_supported(rows, n, k):
+        raise ValueError(
+            f"quant_matvec unsupported for rows={rows}, n={n}, k={k} "
+            "(need k % 128 == 0 and n tileable by 128) — gate with "
+            "quant_matvec_supported")
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    bn = _pick_n_block(n)
+    s2 = scale.reshape(1, n).astype(jnp.float32)
+    out = pl.pallas_call(
+        _matvec_kernel,
+        grid=(n // bn,),
+        in_specs=[
+            pl.BlockSpec((rows, k), lambda i: (0, 0)),
+            pl.BlockSpec((bn, k), lambda i: (i, 0)),
+            pl.BlockSpec((1, bn), lambda i: (0, i)),
+        ],
+        out_specs=pl.BlockSpec((rows, bn), lambda i: (0, i)),
+        out_shape=_out_struct((rows, n), jnp.float32, x, w8, scale),
+        interpret=interpret,
+    )(x, w8, s2)
+    return out
+
+
+def quant_matvec_reference(x, w8, scale):
+    """The reference dequant matmul the kernel's exact-logit tests pin
+    against: fp32 ``x @ w8^T`` scaled per channel — the same factored
+    math, formulated as one XLA dot."""
+    acc = lax.dot_general(jnp.asarray(x, jnp.float32),
+                          jnp.asarray(w8, jnp.float32),
+                          (((1,), (1,)), ((), ())),
+                          preferred_element_type=jnp.float32)
+    return acc * jnp.asarray(scale, jnp.float32)[None, :]
+
+
+# -------------------------------------------------- model-facing helper
+
+def qmm(x, w8, scale, k_ndim: int = 1, impl: str = "auto"):
+    """Quantized matmul with arbitrary leading/output dims, fp32 out.
+
+    ``x (..., K1..Kk)`` against ``w8 (out..., K1..Kk)`` whose LAST
+    ``k_ndim`` axes are the contraction (the quantize-time layout);
+    ``scale (out...)``. Returns ``(..., out...)`` fp32 — the factored
+    dequant ``(x @ q) * s``, exact in exact arithmetic and the fused
+    form on every path (the scale multiplies the accumulator, the int8
+    weight feeds the matmul directly).
+
+    ``impl``: ``"pallas"`` forces the kernel (loud failure off-gate,
+    the ``decode_step="fused"`` discipline), ``"xla"`` forces the
+    einsum formulation, ``"auto"`` uses the kernel on TPU when the
+    gate accepts the flattened shape.
+    """
+    if impl not in ("auto", "pallas", "xla"):
+        raise ValueError(f"unknown quant impl {impl!r} "
+                         "(known: auto, pallas, xla)")
+    bshape = x.shape[:-k_ndim] if k_ndim else x.shape
+    kshape = x.shape[len(bshape):]
+    oshape = w8.shape[:w8.ndim - k_ndim]
+    if tuple(w8.shape[w8.ndim - k_ndim:]) != tuple(kshape):
+        raise ValueError(f"contraction mismatch: x {x.shape} vs "
+                         f"w8 {w8.shape} (k_ndim={k_ndim})")
+    rows = 1
+    for d in bshape:
+        rows *= d
+    k = 1
+    for d in kshape:
+        k *= d
+    n = 1
+    for d in oshape:
+        n *= d
+    use_kernel = impl == "pallas"
+    if impl == "auto":
+        use_kernel = (jax.default_backend() == "tpu"
+                      and quant_matvec_supported(rows, n, k))
+    if use_kernel:
+        out = quant_matvec(x.reshape(rows, k), w8.reshape(n, k),
+                           scale.reshape(n))
+        return out.reshape(*bshape, *oshape)
+    acc = lax.dot_general(
+        x.reshape(rows, k).astype(jnp.float32), w8.reshape(n, k),
+        (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    return (acc * scale.reshape(1, n)).reshape(*bshape, *oshape)

@@ -86,6 +86,28 @@ def _cluster_detectable() -> bool:
     return "," in os.environ.get("TPU_WORKER_HOSTNAMES", "")
 
 
+def _distributed_live() -> bool:
+    """True iff ``jax.distributed`` is already initialized in this
+    process. Version ladder: ``jax.distributed.is_initialized`` (new
+    jax), the public ``global_state`` handle (mid), and the private
+    ``jax._src.distributed.global_state`` (0.4.x, where the public
+    module re-exports neither — probing only the public names made the
+    idempotent second ``init_distributed()`` return False on a LIVE
+    runtime, which is exactly how the two-process bring-up test failed
+    on this jax)."""
+    is_init = getattr(jax.distributed, "is_initialized", None)
+    if is_init is not None:
+        return bool(is_init())
+    state = getattr(jax.distributed, "global_state", None)
+    if state is None:
+        try:
+            from jax._src import distributed as _dsrc
+            state = getattr(_dsrc, "global_state", None)
+        except ImportError:  # pragma: no cover - future jax drops _src
+            state = None
+    return state is not None and getattr(state, "client", None) is not None
+
+
 def init_distributed(coordinator_address: str | None = None,
                      num_processes: int | None = None,
                      process_id: int | None = None,
@@ -105,21 +127,22 @@ def init_distributed(coordinator_address: str | None = None,
     """
     chaos.maybe_delay("multihost.init")
     chaos.maybe_die("multihost.init")
-    is_init = getattr(jax.distributed, "is_initialized", None)
-    if is_init is not None:
-        if is_init():
-            return True
-    else:  # older jax: probe the client on the global state object
-        state = getattr(jax.distributed, "global_state", None)
-        if state is not None and getattr(state, "client", None) is not None:
-            return True
+    if _distributed_live():
+        return True
     explicit = (coordinator_address is not None
                 or num_processes is not None or process_id is not None)
     if not (explicit or _cluster_detectable()):
         return False
-    jax.distributed.initialize(coordinator_address=coordinator_address,
-                               num_processes=num_processes,
-                               process_id=process_id, **kw)
+    try:
+        jax.distributed.initialize(coordinator_address=coordinator_address,
+                                   num_processes=num_processes,
+                                   process_id=process_id, **kw)
+    except RuntimeError:
+        # raced double-initialize (another caller won between the
+        # liveness probe and here): live is live — idempotent contract
+        if _distributed_live():
+            return True
+        raise
     return True
 
 

@@ -92,6 +92,14 @@ class ServeConfig:
     speculate_k: int = 1     # 1 = single-token; >= 2 = ngram verify
     ngram_n: int = DEFAULT_N
     integrity: str = "none"  # "none" | "pages" (seal + verify)
+    # KV-arena precision: "auto" follows cfg.decode_quant (int8 decode
+    # stores int8 KV — the pure bandwidth configuration, no fp arena
+    # exists), "none"/"int8" force, "mixed" holds BOTH arenas over one
+    # allocator and routes per request (Request.quant) — requires
+    # decode_quant="none" so co-batched fp requests stay bitwise
+    # identical to an unquantized engine (the containment pin in
+    # tests/test_serve_quant.py)
+    kv_quant: str = "auto"
 
 
 @dataclass
@@ -105,6 +113,7 @@ class _Row:
     sealed: int              # blocks checksummed so far
     seq: int = 0             # claim generation captured at admission
     owner: str = ""          # pool-ownership token: rid + claim seq
+    side: str = "fp"         # which KV arena serves this row (fp | q8)
     # tokens accumulate HERE, not on the shared Request object: the
     # claim-seq fence covers queue mutations, but a stalled engine
     # resuming after its lease was reaped must also be unable to
@@ -156,21 +165,51 @@ class Engine:
             raise ValueError(
                 f"one max-size request needs {self.nb_per_row} blocks "
                 f"but the pool holds {serve.n_blocks} per shard")
-        self.params = self._cast_weights(params, cfg)
+        kv = serve.kv_quant
+        if kv == "auto":
+            kv = "int8" if cfg.decode_quant == "int8" else "none"
+        if kv not in ("none", "int8", "mixed"):
+            raise ValueError(f"unknown kv_quant {kv!r} "
+                             "(known: auto, none, int8, mixed)")
+        if kv == "mixed" and cfg.decode_quant != "none":
+            raise ValueError(
+                "kv_quant='mixed' requires decode_quant='none': "
+                "quantized weights touch every co-batched row, which "
+                "breaks the fp-requests-bitwise-unchanged containment "
+                "the mixed pool exists for")
+        if kv == "none" and cfg.decode_quant == "int8":
+            raise ValueError(
+                "decode_quant='int8' stores int8 KV (kv_quant 'auto' "
+                "or 'int8'): an fp KV arena on the int8 path would "
+                "reintroduce the high-precision cache stream the "
+                "route exists to remove")
+        self.kv_mode = kv
+        if cfg.decode_quant == "int8":
+            from icikit.models.transformer.decode import (
+                maybe_quantize_params,
+            )
+            # weights quantized ONCE at engine setup; scales ride the
+            # pytree into every step/prefill program
+            self.params = maybe_quantize_params(params, mesh, cfg)
+        else:
+            self.params = self._cast_weights(params, cfg)
         self.mesh = mesh
         self.cfg = cfg
         self.serve = serve
         self.queue = queue if queue is not None else RequestQueue()
-        self.pool = KVPool(cfg, mesh, serve.n_blocks, bs)
+        self.pool = KVPool(cfg, mesh, serve.n_blocks, bs, quant=kv)
         B = serve.max_rows
         self.rows: list[_Row | None] = [None] * B
         self._toks = np.zeros(B, np.int32)
         self._curs = np.zeros(B, np.int32)
         self._active = np.zeros(B, bool)
+        self._isq = np.zeros(B, bool)     # row side (mixed routing)
         self._btab = np.zeros((B, self.nb_per_row), np.int32)
         self._seq_buf = np.zeros(
             (B, serve.max_prompt + serve.max_new), np.int32)
-        self._step_fn = self._build_step()
+        # mixed mode compiles two step variants and dispatches per
+        # step on whether a quantized row is resident (see _build_step)
+        self._step_fns: dict = {}
         self._prefill_fns: dict = {}
         self.n_steps = 0
         self._occ_rows = 0       # sum of active rows over steps
@@ -209,16 +248,33 @@ class Engine:
         from icikit.models.transformer.model import DP_AXIS, TP_AXIS
         return P(DP_AXIS, None, None, TP_AXIS, None)
 
-    def _build_step(self):
+    def _scale_spec(self):
+        from jax.sharding import PartitionSpec as P
+
+        from icikit.models.transformer.model import DP_AXIS, TP_AXIS
+        return P(DP_AXIS, None, None, TP_AXIS)
+
+    def _build_step(self, quant_live: bool):
+        """Compile one step program. ``quant_live`` matters only in
+        "mixed" mode: the False variant skips the q8 quantize/write/
+        dequant-gather entirely (arenas pass through untouched) so an
+        all-fp resident batch pays zero quantization traffic — the
+        host dispatches on ``self._isq.any()`` per step, and fp rows
+        compute identically in both variants (their gather reads the
+        fp arena either way), so flipping programs mid-request cannot
+        change an fp row's tokens."""
         import jax.numpy as jnp
         from jax.sharding import PartitionSpec as P
 
         from icikit.models.transformer.decode import (
             _DecodeCtx,
             _window_masked_attention,
+            _window_masked_attention_q8,
         )
-        from icikit.models.transformer.model import DP_AXIS, param_specs
+        from icikit.models.transformer.model import DP_AXIS
+        from icikit.models.transformer.quant import decode_param_specs
         from icikit.models.transformer.speculative import _accept_window
+        from icikit.ops.quant import quantize_last
         from icikit.ops.rope import apply_rope, rope_sincos
 
         cfg = self.cfg
@@ -228,8 +284,14 @@ class Engine:
         NB = self.nb_per_row
         T = NB * bs
         n_layers = cfg.n_layers
+        mode = self.kv_mode
+        if mode == "mixed" and not quant_live:
+            touch_q8 = False      # arenas thread through untouched
+        else:
+            touch_q8 = mode in ("int8", "mixed")
 
-        def per_shard(params, toks, curs, active, btab, drafts, kc, vc):
+        def per_shard(params, toks, curs, active, isq, btab, drafts,
+                      bufs):
             b = toks.shape[0]
             lp = {kk: params[kk] for kk in ctx.layer_keys}
             w_toks = jnp.concatenate([toks[:, None], drafts], axis=1)
@@ -243,35 +305,83 @@ class Engine:
             pages = jnp.take_along_axis(btab, pos // bs, axis=1)
             pages = jnp.where(active[:, None], pages, 0)
             slots = pos % bs
-            kc2, vc2 = [], []
+            out = {kk: [] for kk in bufs}
             for li in range(n_layers):
                 lp1 = {kk: lp[kk][li] for kk in ctx.layer_keys}
                 q, k_, v_ = ctx.qkv_proj(x, lp1)
                 if sincos is not None:
                     q = apply_rope(q, pos, cfg.rope_theta, sincos)
                     k_ = apply_rope(k_, pos, cfg.rope_theta, sincos)
-                kp, vp = kc[li][0], vc[li][0]
-                kp = kp.at[pages, slots].set(k_.astype(kp.dtype))
-                vp = vp.at[pages, slots].set(v_.astype(vp.dtype))
+                if touch_q8:
+                    # quantize-at-write, exactly the generate-path
+                    # column quantization (token identity to int8
+                    # generate hangs on the byte-for-byte match)
+                    kq, ksn = quantize_last(k_)
+                    vq, vsn = quantize_last(v_)
+                    qkp, qvp = bufs["qkc"][li][0], bufs["qvc"][li][0]
+                    kscp = bufs["ksc"][li][0]
+                    vscp = bufs["vsc"][li][0]
+                    qkp = qkp.at[pages, slots].set(kq)
+                    qvp = qvp.at[pages, slots].set(vq)
+                    kscp = kscp.at[pages, slots].set(ksn)
+                    vscp = vscp.at[pages, slots].set(vsn)
+                    out["qkc"].append(qkp[None])
+                    out["qvc"].append(qvp[None])
+                    out["ksc"].append(kscp[None])
+                    out["vsc"].append(vscp[None])
+                elif mode == "mixed":
+                    for kk in ("qkc", "qvc", "ksc", "vsc"):
+                        out[kk].append(bufs[kk][li])
+                if mode in ("none", "mixed"):
+                    kp, vp = bufs["kc"][li][0], bufs["vc"][li][0]
+                    kp = kp.at[pages, slots].set(k_.astype(kp.dtype))
+                    vp = vp.at[pages, slots].set(v_.astype(vp.dtype))
+                    out["kc"].append(kp[None])
+                    out["vc"].append(vp[None])
                 # the paged gather: this row's blocks, contiguous again
-                ks = kp[btab].reshape(b, T, *kp.shape[2:])
-                vs = vp[btab].reshape(b, T, *vp.shape[2:])
-                attn = _window_masked_attention(q, ks, vs, mask,
-                                                ctx.scale, ctx.n_rep)
+                if mode == "int8":
+                    ks = qkp[btab].reshape(b, T, *qkp.shape[2:])
+                    vs = qvp[btab].reshape(b, T, *qvp.shape[2:])
+                    ksc = kscp[btab].reshape(b, T, *kscp.shape[2:])
+                    vsc = vscp[btab].reshape(b, T, *vscp.shape[2:])
+                    attn = _window_masked_attention_q8(
+                        q, ks, vs, ksc, vsc, mask, ctx.scale,
+                        ctx.n_rep)
+                else:
+                    ks = kp[btab].reshape(b, T, *kp.shape[2:])
+                    vs = vp[btab].reshape(b, T, *vp.shape[2:])
+                    if touch_q8:
+                        # per-row arena select on the gathered INPUTS:
+                        # fp rows' lanes pass through exactly (their
+                        # attention sees the identical values a pure-fp
+                        # engine gathers — the containment pin), int8
+                        # rows read their dequantized pages
+                        kdq = (qkp[btab].reshape(b, T, *qkp.shape[2:])
+                               .astype(jnp.float32)
+                               * kscp[btab].reshape(
+                                   b, T, *kscp.shape[2:])[..., None])
+                        vdq = (qvp[btab].reshape(b, T, *qvp.shape[2:])
+                               .astype(jnp.float32)
+                               * vscp[btab].reshape(
+                                   b, T, *vscp.shape[2:])[..., None])
+                        sel = isq[:, None, None, None]
+                        ks = jnp.where(sel, kdq.astype(ks.dtype), ks)
+                        vs = jnp.where(sel, vdq.astype(vs.dtype), vs)
+                    attn = _window_masked_attention(q, ks, vs, mask,
+                                                    ctx.scale,
+                                                    ctx.n_rep)
                 x = ctx.close_attn(x, attn, lp1)
                 x = ctx.ffn(x, lp1)
-                kc2.append(kp[None])
-                vc2.append(vp[None])
             g = jnp.argmax(ctx.logits(params, x),
                            axis=-1).astype(jnp.int32)        # (b, k)
             # the ONE accept rule, shared with speculative_generate —
             # the engine-vs-generate identity contract hangs on it
             _, a, new_tok = _accept_window(w_toks, g, active)
             return (g, a, jnp.where(active, new_tok, toks),
-                    tuple(kc2), tuple(vc2))
+                    {kk: tuple(v) for kk, v in out.items()})
 
-        ps = self._pool_spec()
-        pools = (ps,) * n_layers
+        bspecs = self.pool.buffer_specs(self._pool_spec(),
+                                        self._scale_spec())
         import jax
 
         from icikit.parallel.shmap import shard_map as _shard_map
@@ -281,53 +391,98 @@ class Engine:
         # reuse is safe; KVPool allocates distinct per-layer buffers)
         return jax.jit(_shard_map(
             per_shard, mesh=self.mesh,
-            in_specs=(param_specs(cfg), P(DP_AXIS), P(DP_AXIS),
-                      P(DP_AXIS), P(DP_AXIS, None), P(DP_AXIS, None),
-                      pools, pools),
+            in_specs=(decode_param_specs(cfg), P(DP_AXIS), P(DP_AXIS),
+                      P(DP_AXIS), P(DP_AXIS), P(DP_AXIS, None),
+                      P(DP_AXIS, None), bspecs),
             out_specs=(P(DP_AXIS, None), P(DP_AXIS), P(DP_AXIS),
-                       pools, pools)), donate_argnums=(6, 7))
+                       bspecs)), donate_argnums=(7,))
 
-    def _build_prefill(self, s_prompt: int):
+    def _build_prefill(self, s_prompt: int, quant_row: bool):
+        """``quant_row`` matters only in "mixed" mode: an fp
+        admission's prefill skips the q8-arena quantize/scatter (its
+        pages live in the fp arena; the q arenas pass through), a
+        quant admission's skips the fp scatter — each request pays
+        exactly its own side's bytes."""
         import jax.numpy as jnp
         from jax.sharding import PartitionSpec as P
 
         from icikit.models.transformer.decode import _DecodeCtx, _prefill
-        from icikit.models.transformer.model import DP_AXIS, param_specs
+        from icikit.models.transformer.model import DP_AXIS
+        from icikit.models.transformer.quant import decode_param_specs
+        from icikit.ops.quant import quantize_last
 
         cfg = self.cfg
         ctx = _DecodeCtx(cfg, self.mesh)
         bs = self.serve.block_size
         npref = -(-s_prompt // bs)
         n_layers = cfg.n_layers
+        mode = self.kv_mode
+        touch_fp = mode == "none" or (mode == "mixed" and not quant_row)
+        touch_q8 = mode == "int8" or (mode == "mixed" and quant_row)
 
-        def per_shard(params, prompt, pages, kc, vc):
+        def per_shard(params, prompt, pages, bufs):
             # prompt replicated: every shard computes the same prefill;
             # only the owner shard's pages are real (others trash 0)
-            x, (kcache, vcache) = _prefill(ctx, params, prompt,
-                                           s_prompt, npref * bs,
-                                           fused=False)
+            x, caches = _prefill(ctx, params, prompt, s_prompt,
+                                 npref * bs, fused=False)
             tok0 = jnp.argmax(ctx.logits(params, x[:, -1]),
                               axis=-1).astype(jnp.int32)
-            kc2, vc2 = [], []
+            if ctx.quant:            # mode == "int8": already int8
+                kcache, vcache, kscache, vscache = caches
+            else:
+                kcache, vcache = caches
+            out = {kk: [] for kk in bufs}
             for li in range(n_layers):
-                kp, vp = kc[li][0], vc[li][0]
-                kb = kcache[li][0].reshape(npref, bs, *kp.shape[2:])
-                vb = vcache[li][0].reshape(npref, bs, *vp.shape[2:])
-                kc2.append(kp.at[pages[0]].set(kb.astype(kp.dtype))[None])
-                vc2.append(vp.at[pages[0]].set(vb.astype(vp.dtype))[None])
-            return tok0, tuple(kc2), tuple(vc2)
+                if "kc" in bufs and not touch_fp:
+                    out["kc"].append(bufs["kc"][li])
+                    out["vc"].append(bufs["vc"][li])
+                elif "kc" in bufs:
+                    kp, vp = bufs["kc"][li][0], bufs["vc"][li][0]
+                    kb = kcache[li][0].reshape(npref, bs,
+                                               *kp.shape[2:])
+                    vb = vcache[li][0].reshape(npref, bs,
+                                               *vp.shape[2:])
+                    out["kc"].append(
+                        kp.at[pages[0]].set(kb.astype(kp.dtype))[None])
+                    out["vc"].append(
+                        vp.at[pages[0]].set(vb.astype(vp.dtype))[None])
+                if "qkc" in bufs and not touch_q8:
+                    for kk in ("qkc", "qvc", "ksc", "vsc"):
+                        out[kk].append(bufs[kk][li])
+                elif "qkc" in bufs:
+                    qkp = bufs["qkc"][li][0]
+                    qvp = bufs["qvc"][li][0]
+                    kscp = bufs["ksc"][li][0]
+                    vscp = bufs["vsc"][li][0]
+                    if ctx.quant:
+                        kq, ksn = kcache[li][0], kscache[li][0]
+                        vq, vsn = vcache[li][0], vscache[li][0]
+                    else:
+                        # mixed: the same per-column quantization the
+                        # int8 generate path applies at store time
+                        kq, ksn = quantize_last(kcache[li][0])
+                        vq, vsn = quantize_last(vcache[li][0])
+                    out["qkc"].append(qkp.at[pages[0]].set(
+                        kq.reshape(npref, bs, *qkp.shape[2:]))[None])
+                    out["qvc"].append(qvp.at[pages[0]].set(
+                        vq.reshape(npref, bs, *qvp.shape[2:]))[None])
+                    out["ksc"].append(kscp.at[pages[0]].set(
+                        ksn.reshape(npref, bs, *kscp.shape[2:]))[None])
+                    out["vsc"].append(vscp.at[pages[0]].set(
+                        vsn.reshape(npref, bs, *vscp.shape[2:]))[None])
+            return tok0, {kk: tuple(v) for kk, v in out.items()}
 
-        ps = self._pool_spec()
-        pools = (ps,) * n_layers
+        bspecs = self.pool.buffer_specs(self._pool_spec(),
+                                        self._scale_spec())
         import jax
 
         from icikit.parallel.shmap import shard_map as _shard_map
         return jax.jit(_shard_map(
             per_shard, mesh=self.mesh,
-            in_specs=(param_specs(cfg), P(None, None),
-                      P(DP_AXIS, None), pools, pools),
-            out_specs=(P(None), pools, pools)),
-            donate_argnums=(3, 4)), npref
+            in_specs=(decode_param_specs(cfg), P(None, None),
+                      P(DP_AXIS, None), bspecs),
+            out_specs=(P(None), bspecs)),
+            donate_argnums=(3,)), npref
 
     # -- admission ---------------------------------------------------
 
@@ -358,6 +513,11 @@ class Engine:
             raise PoisonedPromptError(
                 f"{req.rid}: n_new={req.n_new} exceeds "
                 f"max_new={sv.max_new}")
+        if req.quant and self.kv_mode == "none":
+            raise PoisonedPromptError(
+                f"{req.rid}: quant request on an engine with no int8 "
+                "KV arena (kv_quant='none') — silently serving it at "
+                "full precision would misreport the path it priced")
 
     def _admit(self) -> int:
         """Admit queued requests into free slots; returns how many."""
@@ -401,29 +561,34 @@ class Engine:
 
     def _prefill_into(self, req: Request, prompt, slot: int,
                       shard: int, owner: str) -> None:
-        key = prompt.size
+        quant_row = (self.kv_mode == "int8"
+                     or (self.kv_mode == "mixed" and req.quant))
+        key = (prompt.size, quant_row)
         if key not in self._prefill_fns:
-            self._prefill_fns[key] = self._build_prefill(key)
+            self._prefill_fns[key] = self._build_prefill(prompt.size,
+                                                         quant_row)
         fn, npref = self._prefill_fns[key]
         table = self.pool.allocators[shard].table(owner)
         pages = np.zeros((self.dp, npref), np.int32)
         pages[shard] = table[:npref]
-        tok0, kc, vc = fn(self.params, prompt[None], pages,
-                          self.pool.kc, self.pool.vc)
-        self.pool.update(kc, vc)
+        tok0, bufs = fn(self.params, prompt[None], pages,
+                        self.pool.buffers())
+        self.pool.update(bufs)
         tok0 = int(np.asarray(tok0)[0])
         now = time.monotonic()
         first_admission = req.admit_t is None
         if first_admission:
             req.admit_t = now
         req.first_token_t = now
+        side = "q8" if quant_row else "fp"
         self.rows[slot] = _Row(req=req, shard=shard,
                                s_prompt=int(prompt.size), n_done=1,
                                sealed=0, seq=req.claim_seq,
-                               owner=owner, tokens=[tok0])
+                               owner=owner, side=side, tokens=[tok0])
         self._toks[slot] = tok0
         self._curs[slot] = prompt.size
         self._active[slot] = True
+        self._isq[slot] = side == "q8"
         self._btab[slot] = 0
         self._btab[slot, :len(table)] = table
         self._seq_buf[slot] = 0
@@ -484,12 +649,17 @@ class Engine:
         if not self._active.any():
             return
         k = self.serve.speculate_k
+        live = (bool(self._isq.any()) if self.kv_mode == "mixed"
+                else self.kv_mode == "int8")
+        if live not in self._step_fns:
+            self._step_fns[live] = self._build_step(live)
         with obs.span("serve.engine.step", step=self.n_steps,
                       rows=int(self._active.sum())):
-            g, a, newtok, kc, vc = self._step_fn(
+            g, a, newtok, bufs = self._step_fns[live](
                 self.params, self._toks, self._curs, self._active,
-                self._btab, self._drafts(), self.pool.kc, self.pool.vc)
-            self.pool.update(kc, vc)
+                self._isq, self._btab, self._drafts(),
+                self.pool.buffers())
+            self.pool.update(bufs)
             g = np.asarray(g)
             a = np.asarray(a)
             self._toks = np.asarray(newtok).copy()
@@ -550,7 +720,7 @@ class Engine:
         table = self.pool.allocators[row.shard].table(row.owner)
         while (row.sealed + 1) * bs <= frontier:
             self.pool.seal(row.owner, row.shard, row.sealed,
-                           table[row.sealed])
+                           table[row.sealed], side=row.side)
             row.sealed += 1
 
     def _chaos_pages(self) -> None:
@@ -565,10 +735,12 @@ class Engine:
                 continue
             table = self.pool.allocators[row.shard].table(row.owner)
             page = table[0]
-            data = np.asarray(self.pool.kc[0][row.shard, page])
+            data = self.pool.read_page(row.shard, page, 0,
+                                       side=row.side)
             out = chaos.maybe_corrupt("serve.kv.page", data)
             if out is not data:
-                self.pool.poke_page(row.shard, page, 0, out)
+                self.pool.poke_page(row.shard, page, 0, out,
+                                    side=row.side)
                 obs.emit("serve.kv.page_corrupted", rid=row.req.rid,
                          shard=row.shard, page=int(page))
 
@@ -579,6 +751,7 @@ class Engine:
         self.pool.free(row.owner, row.shard)
         self.rows[slot] = None
         self._active[slot] = False
+        self._isq[slot] = False
         self._btab[slot] = 0
 
     def _finish(self, slot: int) -> None:
@@ -649,10 +822,12 @@ class Engine:
 
     def submit(self, prompt, n_new: int, eos_id: int | None = None,
                not_before: float | None = None,
-               max_retries: int = 2) -> str:
+               max_retries: int = 2, quant: bool = False) -> str:
         """Queue a request on this engine's queue (``RequestQueue
         .submit`` stamps the integrity checksum before the request
-        becomes claimable — see ``serve.admit.prompt``)."""
+        becomes claimable — see ``serve.admit.prompt``). ``quant``
+        routes the request's KV pages to the int8 arena on a
+        ``kv_quant="mixed"`` engine."""
         return self.queue.submit(prompt, n_new, eos_id=eos_id,
                                  not_before=not_before,
-                                 max_retries=max_retries)
+                                 max_retries=max_retries, quant=quant)

@@ -160,77 +160,163 @@ class KVPool:
     ``P(dp, None, None, tp, None)`` — engine step programs carry them
     as carry-style inputs/outputs (the decode.py cache discipline) and
     write them back via :meth:`update`.
+
+    ``quant`` selects the arena set (the int8 serving path, DECODE.md
+    "Quantized decode"):
+
+    - ``"none"`` — the historical compute-dtype arenas only;
+    - ``"int8"`` — int8 arenas ``qkc``/``qvc`` plus per-slot fp32
+      *scale pages* ``ksc``/``vsc`` of shape ``(dp, n_blocks + 1,
+      block_size, kv_heads)``; **no** high-precision KV arena exists
+      on this path (``make check`` lints the invariant);
+    - ``"mixed"`` — both sets over ONE allocator and one block table
+      per request: a block id addresses the same slot in every arena,
+      each row reads only its own side, so fp32 co-batched requests
+      are bitwise untouched by int8 neighbors (the containment pin).
+
+    Sealing checksums the payload a request actually serves from: the
+    int8 side hashes the quantized blocks AND their scale pages (a
+    flipped scale corrupts tokens exactly like a flipped int8 byte).
     """
 
-    def __init__(self, cfg, mesh, n_blocks: int, block_size: int):
+    SIDES = ("fp", "q8")
+
+    def __init__(self, cfg, mesh, n_blocks: int, block_size: int,
+                 quant: str = "none"):
         import jax
         import jax.numpy as jnp
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         from icikit.models.transformer.model import DP_AXIS, TP_AXIS
 
+        if quant not in ("none", "int8", "mixed"):
+            raise ValueError(f"unknown pool quant {quant!r} "
+                             "(known: none, int8, mixed)")
         self.cfg = cfg
         self.mesh = mesh
         self.n_blocks = n_blocks
         self.block_size = block_size
+        self.quant = quant
         self.dp = mesh.shape[DP_AXIS]
         kv_heads = cfg.n_kv_heads or cfg.n_heads
         shape = (self.dp, n_blocks + 1, block_size, kv_heads, cfg.d_head)
+        sshape = shape[:-1]
         sh = NamedSharding(mesh, P(DP_AXIS, None, None, TP_AXIS, None))
+        ssh = NamedSharding(mesh, P(DP_AXIS, None, None, TP_AXIS))
         cdt = jnp.dtype(cfg.compute_dtype)
 
-        def arena():
+        def arena(shp, dtype, shd):
             # one DISTINCT buffer per layer/side: the engine donates
             # these into its step program (in-place pool updates), and
             # donation rejects aliased inputs
-            return jax.device_put(jnp.zeros(shape, cdt), sh)
+            return jax.device_put(jnp.zeros(shp, dtype), shd)
 
-        self.kc = tuple(arena() for _ in range(cfg.n_layers))
-        self.vc = tuple(arena() for _ in range(cfg.n_layers))
+        L = cfg.n_layers
+        self.kc = self.vc = None
+        self.qkc = self.qvc = self.ksc = self.vsc = None
+        if quant in ("none", "mixed"):
+            self.kc = tuple(arena(shape, cdt, sh) for _ in range(L))
+            self.vc = tuple(arena(shape, cdt, sh) for _ in range(L))
+        if quant in ("int8", "mixed"):
+            self.qkc = tuple(arena(shape, jnp.int8, sh)
+                             for _ in range(L))
+            self.qvc = tuple(arena(shape, jnp.int8, sh)
+                             for _ in range(L))
+            self.ksc = tuple(arena(sshape, jnp.float32, ssh)
+                             for _ in range(L))
+            self.vsc = tuple(arena(sshape, jnp.float32, ssh)
+                             for _ in range(L))
         self.allocators = tuple(BlockAllocator(n_blocks, block_size)
                                 for _ in range(self.dp))
-        # (owner, shard, block_index_in_table) -> digest of the sealed
-        # page's K/V bytes across layers
+        # (owner, shard, block_index_in_table) -> (side, digest) of the
+        # sealed page's payload bytes across layers
         self._seals: dict = {}
         self._gauges()
 
+    def _default_side(self) -> str:
+        return "q8" if self.quant == "int8" else "fp"
+
     # -- device-side content -----------------------------------------
 
-    def update(self, kc, vc) -> None:
+    def buffers(self) -> dict:
+        """The arena pytree the step/prefill programs thread through
+        (and donate): keys present depend on the quant mode."""
+        out = {}
+        if self.kc is not None:
+            out["kc"], out["vc"] = self.kc, self.vc
+        if self.qkc is not None:
+            out.update(qkc=self.qkc, qvc=self.qvc,
+                       ksc=self.ksc, vsc=self.vsc)
+        return out
+
+    def buffer_specs(self, pool_spec, scale_spec) -> dict:
+        """PartitionSpec pytree matching :meth:`buffers`."""
+        L = self.cfg.n_layers
+        out = {}
+        if self.kc is not None:
+            out["kc"] = out["vc"] = (pool_spec,) * L
+        if self.qkc is not None:
+            out["qkc"] = out["qvc"] = (pool_spec,) * L
+            out["ksc"] = out["vsc"] = (scale_spec,) * L
+        return out
+
+    def update(self, bufs: dict) -> None:
         """Install the step program's updated buffers (the engine calls
         this once per step with the program outputs)."""
-        self.kc = tuple(kc)
-        self.vc = tuple(vc)
+        for k, v in bufs.items():
+            setattr(self, k, tuple(v))
 
-    def page_bytes(self, shard: int, page: int) -> list:
-        """Host copies of one physical block's K and V content for
-        every layer — the integrity read-back (one device read per
-        layer per call; sealing is a per-block, not per-step, event)."""
+    def page_bytes(self, shard: int, page: int,
+                   side: str | None = None) -> list:
+        """Host copies of one physical block's payload for every layer
+        — the integrity read-back (one device read per layer per call;
+        sealing is a per-block, not per-step, event). The ``"q8"``
+        side returns the QUANTIZED blocks plus their scale pages: the
+        checksum covers exactly the bytes the request decodes from."""
         import numpy as np
+        side = side or self._default_side()
         out = []
         for li in range(self.cfg.n_layers):
-            out.append(np.asarray(self.kc[li][shard, page]))
-            out.append(np.asarray(self.vc[li][shard, page]))
+            if side == "fp":
+                out.append(np.asarray(self.kc[li][shard, page]))
+                out.append(np.asarray(self.vc[li][shard, page]))
+            else:
+                out.append(np.asarray(self.qkc[li][shard, page]))
+                out.append(np.asarray(self.qvc[li][shard, page]))
+                out.append(np.asarray(self.ksc[li][shard, page]))
+                out.append(np.asarray(self.vsc[li][shard, page]))
         return out
 
     def poke_page(self, shard: int, page: int, layer: int,
-                  array) -> None:
+                  array, side: str | None = None) -> None:
         """Overwrite one physical K block's content (the chaos drill's
         write-back path — a deterministic stand-in for an in-memory
         bit flip)."""
         import jax.numpy as jnp
-        kc = list(self.kc)
-        kc[layer] = kc[layer].at[shard, page].set(
-            jnp.asarray(array, kc[layer].dtype))
-        self.kc = tuple(kc)
+        side = side or self._default_side()
+        attr = "kc" if side == "fp" else "qkc"
+        bufs = list(getattr(self, attr))
+        bufs[layer] = bufs[layer].at[shard, page].set(
+            jnp.asarray(array, bufs[layer].dtype))
+        setattr(self, attr, tuple(bufs))
+
+    def read_page(self, shard: int, page: int, layer: int,
+                  side: str | None = None):
+        """One K block's host copy (the chaos drill's read side)."""
+        import numpy as np
+        side = side or self._default_side()
+        src = self.kc if side == "fp" else self.qkc
+        return np.asarray(src[layer][shard, page])
 
     # -- sealing / integrity -----------------------------------------
 
-    def seal(self, owner, shard: int, block_index: int, page: int) -> None:
+    def seal(self, owner, shard: int, block_index: int, page: int,
+             side: str | None = None) -> None:
         """Record the checksum of a just-completed (fully committed)
         block so :meth:`verify` can detect later corruption."""
-        self._seals[(owner, shard, block_index)] = _page_digest(
-            self.page_bytes(shard, page))
+        side = side or self._default_side()
+        self._seals[(owner, shard, block_index)] = (
+            side, _page_digest(self.page_bytes(shard, page, side)))
 
     def verify(self, owner, shard: int) -> list:
         """Re-hash every sealed block of ``owner`` against its recorded
@@ -238,12 +324,13 @@ class KVPool:
         intact)."""
         table = self.allocators[shard].table(owner)
         bad = []
-        for (o, s, bi), digest in self._seals.items():
+        for (o, s, bi), (side, digest) in self._seals.items():
             if o != owner or s != shard:
                 continue
             if bi >= len(table):
                 continue
-            if _page_digest(self.page_bytes(s, table[bi])) != digest:
+            if _page_digest(
+                    self.page_bytes(s, table[bi], side)) != digest:
                 bad.append(bi)
         return sorted(bad)
 

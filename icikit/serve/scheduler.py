@@ -73,6 +73,12 @@ class Request:
     n_new: int
     checksum: str | None = None  # prompt fingerprint (set by submit)
     eos_id: int | None = None
+    # int8-KV routing: on a kv_quant="mixed" engine a quant request's
+    # cache pages live in the int8 arena (its tokens may differ from
+    # the fp path within the measured top-1-agreement bar) while
+    # co-batched fp requests stay bitwise untouched; on an "int8"
+    # engine every request is quantized regardless of the flag
+    quant: bool = False
     visible_after: float = 0.0   # arrival time (monotonic)
     max_retries: int = 2
     # lifecycle
@@ -137,10 +143,11 @@ class RequestQueue:
 
     def submit(self, prompt, n_new: int, eos_id: int | None = None,
                not_before: float | None = None,
-               max_retries: int = 2) -> str:
+               max_retries: int = 2, quant: bool = False) -> str:
         """Enqueue one request; returns its id. ``not_before`` is an
         absolute ``time.monotonic`` instant (None = now) — the Poisson
-        bench's arrival process."""
+        bench's arrival process. ``quant`` routes the request's KV
+        pages to the int8 arena on a mixed-precision engine."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if n_new < 1:
             raise ValueError(f"n_new must be >= 1, got {n_new}")
@@ -152,7 +159,8 @@ class RequestQueue:
             req = Request(rid=rid, prompt=prompt, n_new=int(n_new),
                           checksum=prompt_checksum(prompt),
                           eos_id=eos_id, visible_after=vis,
-                          max_retries=max_retries, arrival_t=vis)
+                          max_retries=max_retries, arrival_t=vis,
+                          quant=bool(quant))
             self._requests[rid] = req
             heapq.heappush(self._queued, (vis, seq, rid))
         obs.count("serve.submitted")

@@ -68,6 +68,70 @@ def test_harness_clean_plan_identical_to_unarmed(mesh4):
                 a.bytes_per_block, a.verified)
 
 
+# -- fuzzers under delay plans (ROADMAP 5c remainder) ----------------
+#
+# The differential fuzzers already prove the schedules compute the
+# right bytes; these drills re-run fuzzer-style random configurations
+# with every dispatch-boundary delay probe firing (rate 1.0), to shake
+# out schedule-dependent deadlocks: a straggling dispatch must finish
+# (no hang — the test completing IS the assertion) and produce results
+# byte-identical to the undelayed run.
+
+def test_collective_fuzzer_under_delay_plan(mesh4):
+    from icikit.bench.harness import sweep_collective
+    rng = np.random.default_rng(5)
+    fams = ("allgather", "allreduce", "alltoall", "reducescatter",
+            "scan")
+    picks = [(fams[rng.integers(len(fams))],
+              int(rng.choice([4, 16, 64]))) for _ in range(6)]
+    base = [sweep_collective(mesh4, fam, "xla", sizes=(m,), runs=1,
+                             warmup=0)[0] for fam, m in picks]
+    plan = chaos.FaultPlan(rates={"delay:bench.harness.*": 1.0},
+                           delay_s=0.002)
+    with chaos.inject(plan):
+        delayed = [sweep_collective(mesh4, fam, "xla", sizes=(m,),
+                                    runs=1, warmup=0)[0]
+                   for fam, m in picks]
+    assert plan.fired("delay", "bench.harness.*") == len(picks)
+    for b, d in zip(base, delayed):
+        assert d.verified
+        assert (b.family, b.p, b.msize, b.verified) == \
+               (d.family, d.p, d.msize, d.verified)
+
+
+@pytest.mark.parametrize("algorithm", ["bitonic", "sample",
+                                       "sample_bitonic", "quicksort"])
+def test_sort_fuzzer_under_delay_plan(mesh4, algorithm):
+    from icikit.models import sort as sort_mod
+    rng = np.random.default_rng(11)
+    xs = [jnp.asarray(rng.integers(-1000, 1000, size=int(n)), jnp.int32)
+          for n in rng.choice([7, 64, 129, 500], size=3)]
+    base = [np.asarray(sort_mod.sort(x, mesh4, algorithm=algorithm))
+            for x in xs]
+    plan = chaos.FaultPlan(rates={"delay:sort.*": 1.0}, delay_s=0.002)
+    with chaos.inject(plan):
+        delayed = [np.asarray(sort_mod.sort(x, mesh4,
+                                            algorithm=algorithm))
+                   for x in xs]
+    assert plan.fired("delay", f"sort.{algorithm}") == len(xs)
+    for x, b, d in zip(xs, base, delayed):
+        np.testing.assert_array_equal(d, b)
+        np.testing.assert_array_equal(b, np.sort(np.asarray(x)))
+
+
+def test_sort_die_site_consumed_then_clean(mesh4):
+    from icikit.models import sort as sort_mod
+    x = jnp.asarray(np.random.default_rng(3).integers(0, 100, 64),
+                    jnp.int32)
+    plan = chaos.FaultPlan(schedule={"die:sort.bitonic": (0,)})
+    with chaos.inject(plan):
+        with pytest.raises(chaos.InjectedDeath):
+            sort_mod.sort(x, mesh4, algorithm="bitonic")
+        out = np.asarray(sort_mod.sort(x, mesh4, algorithm="bitonic"))
+    assert plan.fired("die", "sort.bitonic") == 1
+    np.testing.assert_array_equal(out, np.sort(np.asarray(x)))
+
+
 # -- multi-host launcher ---------------------------------------------
 
 def _hybrid_x(mesh, m, seed=0):

@@ -142,3 +142,81 @@ def test_kvpool_occupancy_and_fragmentation():
     assert pool.fragmentation({("a", 0): 6}) == pytest.approx(0.25)
     pool.free("a", 0)
     assert pool.occupancy() == 0.0
+
+
+def _tiny_cfg():
+    from icikit.models.transformer import TransformerConfig
+    return TransformerConfig(vocab=31, d_model=16, n_heads=2, d_head=8,
+                             d_ff=32, n_layers=2, max_seq=32,
+                             compute_dtype="float32")
+
+
+@pytest.mark.parametrize("quant", ["int8", "mixed"])
+def test_kvpool_int8_arenas_and_allocator_properties(quant):
+    """int8/mixed pools: arena dtypes + the allocator property run on
+    the quantized pool (the allocator is arena-independent by design,
+    and this pins that the int8 wiring kept it so)."""
+    import jax.numpy as jnp
+
+    from icikit.models.transformer.model import make_model_mesh
+    from icikit.serve.kvpool import KVPool
+
+    mesh = make_model_mesh(dp=1, tp=1, sp=1)
+    pool = KVPool(_tiny_cfg(), mesh, n_blocks=8, block_size=4,
+                  quant=quant)
+    assert pool.qkc[0].dtype == jnp.int8
+    assert pool.ksc[0].dtype == jnp.float32
+    assert pool.ksc[0].shape == pool.qkc[0].shape[:-1]
+    if quant == "int8":
+        assert pool.kc is None          # no fp arena on the int8 path
+        assert set(pool.buffers()) == {"qkc", "qvc", "ksc", "vsc"}
+    else:
+        assert pool.kc is not None
+        assert set(pool.buffers()) == {"kc", "vc", "qkc", "qvc",
+                                       "ksc", "vsc"}
+    rng = np.random.default_rng(13)
+    a = pool.allocators[0]
+    owners = [f"r{i}" for i in range(5)]
+    for _ in range(300):
+        o = owners[rng.integers(len(owners))]
+        op = rng.integers(3)
+        try:
+            if op == 0:
+                a.alloc(o, int(rng.integers(0, 4)))
+            elif op == 1:
+                a.ensure(o, int(rng.integers(1, 40)))
+            else:
+                a.free(o)
+        except PoolExhausted as e:
+            assert e.requested > e.free
+        _check_invariants(a)
+
+
+def test_kvpool_int8_seal_covers_scales():
+    """The q8 digest covers the scale pages: corrupting ONLY a scale
+    (payload bytes intact) must fail the verify."""
+    from icikit.models.transformer.model import make_model_mesh
+    from icikit.serve.kvpool import KVPool
+
+    mesh = make_model_mesh(dp=1, tp=1, sp=1)
+    pool = KVPool(_tiny_cfg(), mesh, n_blocks=4, block_size=4,
+                  quant="int8")
+    table = pool.allocators[0].alloc("req", 1)
+    data = np.arange(4 * 2 * 8, dtype=np.int8).reshape(4, 2, 8)
+    pool.poke_page(0, table[0], 0, data)
+    pool.seal("req", 0, 0, table[0])
+    assert pool.verify("req", 0) == []
+    vsc = list(pool.vsc)
+    vsc[1] = vsc[1].at[0, table[0], 2, 1].set(3.25)
+    pool.vsc = tuple(vsc)
+    assert pool.verify("req", 0) == [0]
+
+
+def test_kvpool_rejects_unknown_quant():
+    from icikit.models.transformer.model import make_model_mesh
+    from icikit.serve.kvpool import KVPool
+
+    mesh = make_model_mesh(dp=1, tp=1, sp=1)
+    with pytest.raises(ValueError, match="unknown pool quant"):
+        KVPool(_tiny_cfg(), mesh, n_blocks=4, block_size=4,
+               quant="fp8")

@@ -179,15 +179,28 @@ def test_real_two_process_bringup():
     import sys
     from pathlib import Path
 
+    import jax
+
     repo = Path(__file__).resolve().parents[1]
     worker = Path(__file__).resolve().parent / "multihost_worker.py"
 
-    with socket.socket() as s:  # claim a free port, release it at spawn
-        try:
-            s.bind(("localhost", 0))
-        except OSError as e:  # pragma: no cover
-            pytest.skip(f"cannot bind a local port: {e}")
-        port = s.getsockname()[1]
+    if not hasattr(jax, "distributed") or \
+            not hasattr(jax.distributed, "initialize"):
+        pytest.skip("this jax has no distributed runtime "
+                    "(jax.distributed.initialize missing)")
+
+    def _free_port() -> int:
+        """Claim-then-release with SO_REUSEADDR so the coordinator can
+        rebind the port immediately (a plain claim/release leaves the
+        socket in TIME_WAIT on some hosts — one of the two flake
+        modes this test had)."""
+        with socket.socket() as s:
+            s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            try:
+                s.bind(("localhost", 0))
+            except OSError as e:  # pragma: no cover
+                pytest.skip(f"cannot bind a local port: {e}")
+            return s.getsockname()[1]
 
     env = dict(os.environ)
     keep = [x for x in env.get("PYTHONPATH", "").split(os.pathsep)
@@ -195,22 +208,45 @@ def test_real_two_process_bringup():
     env["PYTHONPATH"] = os.pathsep.join([str(repo)] + keep)
     env.pop("XLA_FLAGS", None)  # worker sets its own device count (4)
 
-    procs = [subprocess.Popen(
-        [sys.executable, str(worker), str(port), str(i)],
-        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
-        cwd=repo, env=env) for i in range(2)]
-    try:
-        outs = [p.communicate(timeout=600)[0] for p in procs]
-    except subprocess.TimeoutExpired:  # pragma: no cover
-        for p in procs:
-            p.kill()
-        pytest.skip("2-process bring-up timed out (loaded host)")
-    if any(p.returncode != 0 for p in procs) and any(
-            sig in out for out in outs
-            for sig in ("Address already in use", "Failed to bind",
-                        "UNAVAILABLE")):
-        pytest.skip("coordinator port was taken between probe and "
-                    "spawn (busy host)")  # pragma: no cover
+    # Port races are transient: retry the whole bring-up on a FRESH
+    # free port instead of skipping on the first collision — a skip is
+    # only honest once the failure mode is environmental, not a race
+    # this loop can win.
+    PORT_SIGS = ("Address already in use", "Failed to bind",
+                 "errno: 98")
+    UNAVAILABLE_SIGS = (
+        "UNAVAILABLE", "DEADLINE_EXCEEDED",
+        "distributed runtime is not available",
+        # this jaxlib build ships no CPU cross-process collectives
+        # (gloo absent): bring-up is structurally impossible, not flaky
+        "Multiprocess computations aren't implemented",
+    )
+    outs = []
+    for attempt in range(3):
+        port = _free_port()
+        procs = [subprocess.Popen(
+            [sys.executable, str(worker), str(port), str(i)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            cwd=repo, env=env) for i in range(2)]
+        try:
+            outs = [p.communicate(timeout=600)[0] for p in procs]
+        except subprocess.TimeoutExpired:  # pragma: no cover
+            for p in procs:
+                p.kill()
+            pytest.skip("2-process bring-up timed out (loaded host)")
+        if all(p.returncode == 0 for p in procs):
+            break
+        joined = "\n".join(outs)
+        if any(sig in joined for sig in PORT_SIGS):
+            continue  # pragma: no cover - fresh port, try again
+        if any(sig in joined for sig in UNAVAILABLE_SIGS):
+            pytest.skip("distributed bring-up unavailable on this host "
+                        f"({next(s for s in UNAVAILABLE_SIGS if s in joined)})"
+                        )  # pragma: no cover
+        break  # a real failure: fall through to the assertions
+    else:  # pragma: no cover - three straight port races
+        pytest.skip("coordinator port kept colliding across 3 fresh "
+                    "ports (busy host)")
     for p, out in zip(procs, outs):
         assert p.returncode == 0, out[-2000:]
         assert "WORKER_OK" in out

@@ -1,0 +1,385 @@
+"""Quantized decode (int8 weights + int8 KV): kernel exactness, scale
+edge cases, and the relaxed parity contract.
+
+The parity bar (DECODE.md "Quantized decode"): token identity vs the
+fp path is explicitly RELAXED to a measured teacher-forced top-1
+agreement — these tests measure it (and verify the relaxation is doing
+work: the paths really compute different logits), while *within* the
+int8 path the speculative/verify token-identity contract still holds
+exactly for every drafter. Kernel-level tests pin the Pallas int8
+matvec bit-exactly against the reference dequant matmul.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from icikit.models.transformer import (
+    TransformerConfig,
+    greedy_generate,
+    init_params,
+    sample_generate,
+    speculative_generate,
+)
+from icikit.models.transformer.model import make_model_mesh
+from icikit.models.transformer.quant import (
+    decode_param_specs,
+    is_quantized_params,
+    measure_top1_agreement,
+    quant_param_specs,
+    quantize_decode_params,
+)
+from icikit.ops.quant import (
+    dequantize_last,
+    qmm,
+    quant_matvec,
+    quant_matvec_reference,
+    quant_matvec_supported,
+    quantize_last,
+)
+
+CFG = TransformerConfig(vocab=61, d_model=32, n_heads=4, d_head=8,
+                        d_ff=64, n_layers=2, max_seq=96,
+                        compute_dtype="float32")
+QCFG = dataclasses.replace(CFG, decode_quant="int8")
+
+
+def _mesh(dp=1, tp=1):
+    return make_model_mesh(dp=dp, tp=tp, sp=1)
+
+
+def _prompt(cfg, b=2, s=8, seed=0):
+    rng = np.random.default_rng(seed)
+    return jnp.asarray(rng.integers(0, cfg.vocab, (b, s)), jnp.int32)
+
+
+# ------------------------------------------------ quantize / dequant
+
+def test_quantize_roundtrip_error_bound():
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(7, 33)) * 10, jnp.float32)
+    q, s = quantize_last(x)
+    assert q.dtype == jnp.int8 and s.shape == (7,)
+    err = np.abs(np.asarray(dequantize_last(q, s)) - np.asarray(x))
+    # symmetric round-to-nearest: per-element error <= scale / 2
+    assert (err <= np.asarray(s)[:, None] / 2 + 1e-7).all()
+
+
+def test_quantize_zero_rows_are_exact_and_finite():
+    x = jnp.zeros((3, 16), jnp.float32)
+    q, s = quantize_last(x)
+    assert (np.asarray(q) == 0).all()
+    assert (np.asarray(s) == 0).all()
+    out = np.asarray(dequantize_last(q, s))
+    assert np.isfinite(out).all() and (out == 0).all()
+    # mixed: one zero row among live rows must not poison neighbors
+    x2 = jnp.asarray(np.stack([np.zeros(16), np.ones(16)]), jnp.float32)
+    q2, s2 = quantize_last(x2)
+    assert np.asarray(s2)[0] == 0 and np.asarray(s2)[1] > 0
+    np.testing.assert_allclose(np.asarray(dequantize_last(q2, s2))[1],
+                               np.ones(16), rtol=1e-6)
+
+
+def test_quantize_saturation_hits_qmax_exactly():
+    x = jnp.asarray([[-5.0, 0.0, 5.0, 2.5]], jnp.float32)
+    q, s = quantize_last(x)
+    qn = np.asarray(q)[0]
+    assert qn[0] == -127 and qn[2] == 127          # the channel max
+    assert np.asarray(s)[0] == pytest.approx(5.0 / 127.0)
+
+
+def test_quantize_rejects_unknown_dtype():
+    with pytest.raises(ValueError, match="unknown quant dtype"):
+        quantize_last(jnp.ones((2, 4)), qdtype="int3")
+
+
+def test_quantize_fp8_uses_float_rounding():
+    """The fp8 plumbing must NOT integer-round: values below scale/2
+    survive (fp8's value grid is not the integers), and dequant error
+    stays within fp8 e4m3 relative precision (~2^-3 of the value) —
+    the broken integer form collapsed 0.001 to exact zero."""
+    from icikit.ops.quant import QDTYPES
+    if QDTYPES["fp8_e4m3"][0] is None:
+        pytest.skip("no fp8_e4m3 in this jax build")
+    x = jnp.asarray([[0.001, 0.002, 0.003, 1.0]], jnp.float32)
+    q, s = quantize_last(x, qdtype="fp8_e4m3")
+    deq = np.asarray(dequantize_last(q, s))[0]
+    assert deq[0] != 0.0                       # sub-half-scale survives
+    np.testing.assert_allclose(deq, np.asarray(x)[0], rtol=0.13)
+
+
+# ------------------------------------------------------ the kernel
+
+def test_quant_matvec_exact_vs_reference():
+    """Kernel-level exact-logit bar: the Pallas int8 matvec must equal
+    the reference dequant matmul BITWISE (fp32 accumulation both)."""
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.normal(size=(4, 256)), jnp.float32)
+    q, s = quantize_last(jnp.asarray(rng.normal(size=(512, 256)),
+                                     jnp.float32))
+    out = np.asarray(quant_matvec(x, q, s))
+    ref = np.asarray(quant_matvec_reference(x, q, s))
+    np.testing.assert_array_equal(out, ref)
+    # and within quantization error of the UNfactored dequant matmul
+    deq = np.asarray(q, np.float32) * np.asarray(s)[:, None]
+    full = np.asarray(x) @ deq.T
+    np.testing.assert_allclose(out, full, rtol=1e-5, atol=1e-5)
+
+
+def test_quant_matvec_gate_rejects_ragged():
+    # ragged contraction dim (not lane-exact) and untileable channel
+    # count must be rejected by the gate, loudly by the kernel
+    assert not quant_matvec_supported(4, 512, 100)   # k % 128 != 0
+    assert not quant_matvec_supported(4, 130, 256)   # n untileable
+    assert quant_matvec_supported(4, 512, 256)
+    x = jnp.ones((4, 100), jnp.float32)
+    q, s = quantize_last(jnp.ones((512, 100), jnp.float32))
+    with pytest.raises(ValueError, match="quant_matvec unsupported"):
+        quant_matvec(x, q, s)
+
+
+def test_qmm_xla_fallback_matches_kernel_math():
+    """The ragged-shape XLA fallback computes the same factored math:
+    on a kernel-supported shape the two impls agree to fp32 tolerance,
+    and impl='pallas' on an unsupported shape fails loudly."""
+    rng = np.random.default_rng(2)
+    x = jnp.asarray(rng.normal(size=(3, 256)), jnp.float32)
+    q, s = quantize_last(jnp.asarray(rng.normal(size=(256, 256)),
+                                     jnp.float32))
+    a = np.asarray(qmm(x, q, s, impl="pallas"))
+    b = np.asarray(qmm(x, q, s, impl="xla"))
+    np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-6)
+    xq, qq, sq = (jnp.ones((3, 20), jnp.float32),) + quantize_last(
+        jnp.ones((7, 20), jnp.float32))
+    with pytest.raises(ValueError, match="unsupported"):
+        qmm(xq, qq, sq, impl="pallas")
+    assert np.asarray(qmm(xq, qq, sq, impl="xla")).shape == (3, 7)
+
+
+# -------------------------------------------------- pytree plumbing
+
+def test_quantize_decode_params_layouts_and_specs():
+    mesh = _mesh()
+    params = init_params(jax.random.key(0), CFG, mesh)
+    qp = quantize_decode_params(params, QCFG, mesh)
+    assert is_quantized_params(qp)
+    assert qp["w_out"].dtype == jnp.int8
+    assert qp["w_out_s"].shape == (CFG.vocab,)
+    L, D, H, Dh, F = (CFG.n_layers, CFG.d_model, CFG.n_heads,
+                      CFG.d_head, CFG.d_ff)
+    assert qp["wqkv"].shape == (L, 3, H, Dh, D)      # contraction last
+    assert qp["wo"].shape == (L, D, H, Dh)
+    assert qp["w1"].shape == (L, F, D)
+    assert qp["w2"].shape == (L, D, F)
+    # specs cover exactly the quantized tree, and idempotence holds
+    assert set(quant_param_specs(QCFG)) == set(qp)
+    assert quantize_decode_params(qp, QCFG, mesh) is qp
+    assert decode_param_specs(CFG).keys() == params.keys()
+
+
+def test_cfg_validation():
+    with pytest.raises(ValueError, match="decode_quant"):
+        greedy_generate({}, _prompt(CFG), _mesh(),
+                        dataclasses.replace(CFG, decode_quant="fp4"), 4)
+    with pytest.raises(ValueError, match="dense FFNs only"):
+        dataclasses.replace(  # construction-time gate via param_specs
+            CFG, decode_quant="int8", n_experts=2)
+        from icikit.models.transformer.model import _check_cfg
+        _check_cfg(dataclasses.replace(CFG, decode_quant="int8",
+                                       n_experts=2))
+
+
+# ------------------------------------------- generate-level parity
+
+def test_int8_generate_runs_and_relaxation_is_measured():
+    """The relaxed parity contract, tested not assumed: the int8 path
+    computes genuinely different logits (the comparison is not
+    vacuous), tokens MAY diverge from fp, and the measured
+    teacher-forced top-1 agreement is the metric that bounds it."""
+    mesh = _mesh()
+    params = init_params(jax.random.key(0), CFG, mesh)
+    prompt = _prompt(CFG)
+    y = greedy_generate(params, prompt, mesh, CFG, 24)
+    r = measure_top1_agreement(params, y, mesh, QCFG, prompt.shape[1])
+    assert r["max_logit_abs_diff"] > 0          # quantization engaged
+    assert r["n_positions"] > 0
+    # random-init toy: near-uniform logits are the worst case for an
+    # argmax metric, and agreement must still be high; the >= 0.999
+    # bar is measured on the TRAINED toy (tools/quant_decode_study.py,
+    # recorded in DECODE.md round 10 + the slow test below)
+    assert r["top1_agreement"] >= 0.9
+    # int8 tokens are a valid continuation of the same prompt
+    yq = greedy_generate(params, prompt, mesh, QCFG, 24)
+    assert np.asarray(yq).shape == np.asarray(y).shape
+    np.testing.assert_array_equal(np.asarray(yq)[:, :prompt.shape[1]],
+                                  np.asarray(prompt))
+    # and an empty scoring region fails LOUDLY, never as NaN agreement
+    with pytest.raises(ValueError, match="no scorable positions"):
+        measure_top1_agreement(params, y[:, :prompt.shape[1] + 1],
+                               mesh, QCFG, prompt.shape[1])
+
+
+@pytest.mark.parametrize("dp,tp", [(1, 1), (2, 4)])
+def test_int8_generate_mesh_invariance(dp, tp):
+    cfg = dataclasses.replace(QCFG, vocab=64, vocab_parallel=tp > 1)
+    mesh1 = _mesh()
+    base_cfg = dataclasses.replace(cfg, vocab_parallel=False)
+    params = init_params(jax.random.key(1),
+                         dataclasses.replace(base_cfg,
+                                             decode_quant="none"),
+                         mesh1)
+    prompt = _prompt(cfg)
+    want = np.asarray(greedy_generate(params, prompt, mesh1, base_cfg,
+                                      12))
+    mesh = _mesh(dp=dp, tp=tp)
+    params_n = init_params(jax.random.key(1),
+                           dataclasses.replace(cfg,
+                                               decode_quant="none"),
+                           mesh)
+    got = np.asarray(greedy_generate(params_n, prompt, mesh, cfg, 12))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_int8_sample_generate_reproducible():
+    mesh = _mesh()
+    params = init_params(jax.random.key(0), CFG, mesh)
+    prompt = _prompt(CFG)
+    a = sample_generate(params, prompt, mesh, QCFG, 12,
+                        jax.random.key(7), temperature=0.8, top_k=8)
+    b = sample_generate(params, prompt, mesh, QCFG, 12,
+                        jax.random.key(7), temperature=0.8, top_k=8)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_prequantized_params_give_identical_tokens():
+    """Hoisting the conversion (the engine/bench pattern) must change
+    nothing: generate with fp params quantized on the fly == generate
+    with an explicitly pre-quantized pytree."""
+    mesh = _mesh()
+    params = init_params(jax.random.key(0), CFG, mesh)
+    prompt = _prompt(CFG)
+    a = np.asarray(greedy_generate(params, prompt, mesh, QCFG, 16))
+    qp = quantize_decode_params(params, QCFG, mesh)
+    b = np.asarray(greedy_generate(qp, prompt, mesh, QCFG, 16))
+    np.testing.assert_array_equal(a, b)
+
+
+# --------------------------------------- speculative token identity
+
+@pytest.mark.parametrize("drafter", ["shared", "ngram"])
+def test_speculative_int8_token_identity(drafter):
+    """WITHIN the int8 path the verify/accept contract is exact: every
+    committed token is the int8 model's argmax, for any drafter."""
+    cfg = dataclasses.replace(QCFG, n_layers=4)
+    mesh = _mesh()
+    params = init_params(jax.random.key(0),
+                         dataclasses.replace(cfg, decode_quant="none"),
+                         mesh)
+    prompt = _prompt(cfg)
+    base = np.asarray(greedy_generate(params, prompt, mesh, cfg, 16))
+    out = np.asarray(speculative_generate(params, prompt, mesh, cfg,
+                                          16, k=3, draft_layers=2,
+                                          drafter=drafter))
+    np.testing.assert_array_equal(out, base)
+
+
+def test_speculative_int8_trained_drafter_identity():
+    cfg = dataclasses.replace(QCFG, n_layers=4, draft_head=True,
+                              draft_layers=1)
+    mesh = _mesh()
+    params = init_params(jax.random.key(0),
+                         dataclasses.replace(cfg, decode_quant="none"),
+                         mesh)
+    prompt = _prompt(cfg)
+    base = np.asarray(greedy_generate(params, prompt, mesh, cfg, 16))
+    out = np.asarray(speculative_generate(params, prompt, mesh, cfg,
+                                          16, k=3, drafter="trained"))
+    np.testing.assert_array_equal(out, base)
+
+
+# ------------------------------------------------ fused decode step
+
+def test_fused_decode_step_q8_token_identity():
+    """The int8 fused Pallas step (in-kernel dequant) is token-
+    identical to the unfused int8 formulation — with and without
+    rope (interpret mode on CPU, the decode_step test discipline)."""
+    from icikit.bench.train import PRESETS
+    for pos in ("learned", "rope"):
+        cfg = TransformerConfig(**PRESETS["tiny128"],
+                                compute_dtype="float32",
+                                pos_encoding=pos, decode_quant="int8")
+        mesh = _mesh()
+        params = init_params(
+            jax.random.key(2),
+            dataclasses.replace(cfg, decode_quant="none"), mesh)
+        prompt = _prompt(cfg, seed=3)
+        unfused = np.asarray(greedy_generate(params, prompt, mesh, cfg,
+                                             10))
+        fused = np.asarray(greedy_generate(
+            params, prompt, mesh,
+            dataclasses.replace(cfg, decode_step="fused"), 10))
+        np.testing.assert_array_equal(fused, unfused)
+
+
+def test_fused_decode_step_q8_caches_stay_int8():
+    """The int8 path's cache carries are int8 + fp32 scales — no
+    cache-shaped fp tensor is allocated (the make-check lint's
+    invariant, asserted here at the prefill boundary)."""
+    from jax.sharding import PartitionSpec as P
+
+    from icikit.models.transformer.decode import _DecodeCtx, _prefill
+    from icikit.parallel.shmap import wrap_program
+    cfg = QCFG
+    mesh = _mesh()
+    params = init_params(jax.random.key(0), CFG, mesh)
+    qp = quantize_decode_params(params, QCFG, mesh)
+    ctx = _DecodeCtx(cfg, mesh)
+    cspec = P(None, "dp", None, None, None)
+    prog = wrap_program(
+        lambda p, t: _prefill(ctx, p, t, 8, 24, fused=False)[1],
+        mesh, (decode_param_specs(cfg), P("dp", None)),
+        (cspec, cspec, P(None, "dp", None, None),
+         P(None, "dp", None, None)))
+    ks, vs, kss, vss = jax.eval_shape(prog, qp, _prompt(cfg))
+    assert ks.dtype == jnp.int8 and vs.dtype == jnp.int8
+    assert kss.dtype == jnp.float32 and vss.dtype == jnp.float32
+
+
+# ---------------------------------------------------- trained bar
+
+@pytest.mark.slow
+def test_trained_toy_clears_top1_agreement_bar():
+    """The measured >= 0.999 bar on a genuinely trained, CONFIDENT
+    model — the regime greedy decode serves (the r10 study's
+    deterministic-corpus toy; validated 1.0 over 3072 positions with
+    max logit deviation ~0.22). On the entropy-limited branch-4 r8
+    teacher the same metric reads ~0.97 with every disagreement at an
+    fp top-2 margin < 0.22 (near-ties where the fp path itself is
+    unstable) — both regimes are recorded by
+    tools/quant_decode_study.py in DECODE.md round 10."""
+    import optax
+
+    from icikit.models.transformer.model import make_train_step
+    from icikit.models.transformer.train import make_markov_sampler
+
+    cfg = TransformerConfig(vocab=16, d_model=64, n_heads=2, d_head=32,
+                            d_ff=256, n_layers=4, max_seq=160,
+                            compute_dtype="float32")
+    mesh = _mesh()
+    qcfg = dataclasses.replace(cfg, decode_quant="int8")
+    sampler = make_markov_sampler(cfg.vocab, seed=0, branch=1)
+    params = init_params(jax.random.key(0), cfg, mesh)
+    _, step = make_train_step(mesh, cfg, optax.adam(3e-3))
+    st = optax.adam(3e-3).init(params)
+    for s in range(1500):
+        chunk = sampler(s, 16, 64)
+        params, st, _ = step(params, st, jnp.asarray(chunk[:, :-1]),
+                             jnp.asarray(chunk[:, 1:]))
+    prompts = jnp.asarray(sampler(9, 32, 64)[:, :32], jnp.int32)
+    y = greedy_generate(params, prompts, mesh, cfg, 96)
+    r = measure_top1_agreement(params, y, mesh, qcfg, 32)
+    assert r["max_logit_abs_diff"] > 0
+    assert r["top1_agreement"] >= 0.999, r

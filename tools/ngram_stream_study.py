@@ -1,0 +1,147 @@
+"""Ngram-drafter acceptance on a REAL (non-synthetic) text stream —
+the ROADMAP 3b precondition row for the ``drafter="auto"`` fallback
+flip.
+
+The r9 serving rows measured the zero-cost n-gram drafter only on a
+synthetic repetitive stream (+6–23% tokens/s), and the defaults-audit
+rule kept it opt-in until a real-text acceptance row exists. This
+study supplies that row without needing a download: the repo's own
+documentation (README/DECODE/docs/*.md — genuine English prose, tens
+of KB) is the corpus, byte-level:
+
+1. train a byte-level toy LM on document windows (the model whose
+   greedy continuations the drafter must match);
+2. run ``speculative_generate(drafter="ngram")`` from held-out prompt
+   windows and read the measured acceptance telemetry;
+3. the shared-drafter baseline runs on the same prompts for contrast
+   (it pays truncated-depth forward passes per proposal; the n-gram
+   drafter pays nothing, so ANY acceptance above the window overhead
+   is profit — the engine's r9 +tokens/s rows are the priced form).
+
+Rows: ``kind="acceptance"`` with ``drafter="ngram"``,
+``corpus="repo-docs-bytes"`` — the same record shape the cost model's
+``--alpha-from`` consumes.
+
+Usage::
+
+    JAX_PLATFORMS=cpu python tools/ngram_stream_study.py \
+        --json decode_spec_r10.jsonl [--quick]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CORPUS_FILES = ("README.md", "DECODE.md", "SCALING.md", "MOE.md",
+                "PIPELINE.md", "docs/DESIGN.md", "docs/SERVING.md",
+                "docs/API.md")
+TOY = dict(vocab=256, d_model=64, n_heads=2, d_head=32, d_ff=256,
+           n_layers=4, max_seq=256, compute_dtype="float32")
+
+
+def load_corpus() -> np.ndarray:
+    parts = []
+    for rel in CORPUS_FILES:
+        path = os.path.join(REPO, rel)
+        if os.path.exists(path):
+            with open(path, "rb") as f:
+                parts.append(np.frombuffer(f.read(), np.uint8))
+    if not parts:
+        raise FileNotFoundError("no corpus docs found")
+    return np.concatenate(parts).astype(np.int32)
+
+
+def train_byte_lm(corpus: np.ndarray, steps: int):
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from icikit.models.transformer import TransformerConfig, init_params
+    from icikit.models.transformer.model import (make_model_mesh,
+                                                 make_train_step)
+
+    cfg = TransformerConfig(**TOY)
+    mesh = make_model_mesh(dp=1, tp=1, sp=1)
+    params = init_params(jax.random.key(0), cfg, mesh)
+    _, step = make_train_step(mesh, cfg, optax.adam(3e-3))
+    st = optax.adam(3e-3).init(params)
+    rng = np.random.default_rng(0)
+    # hold out the final 10% of the byte stream for prompt windows
+    split = int(len(corpus) * 0.9)
+    train_bytes, held = corpus[:split], corpus[split:]
+    loss = None
+    for s in range(steps):
+        starts = rng.integers(0, split - 129, size=16)
+        chunk = np.stack([train_bytes[i:i + 129] for i in starts])
+        params, st, loss = step(params, st,
+                                jnp.asarray(chunk[:, :-1]),
+                                jnp.asarray(chunk[:, 1:]))
+    final = float(np.asarray(loss))
+    print(f"byte LM trained: {steps} steps on {split} bytes, "
+          f"loss {final:.4f} ({final / np.log(2):.2f} bits/byte)",
+          flush=True)
+    return cfg, mesh, params, held
+
+
+def acceptance_rows(quick: bool) -> list:
+    import jax.numpy as jnp
+
+    from icikit.models.transformer import speculative_generate
+
+    steps = 150 if quick else 2500
+    corpus = load_corpus()
+    cfg, mesh, params, held = train_byte_lm(corpus, steps)
+    rng = np.random.default_rng(1)
+    b, s_prompt, n_new = 8, 64, (32 if quick else 128)
+    starts = rng.integers(0, len(held) - s_prompt, size=b)
+    prompts = jnp.asarray(np.stack([held[i:i + s_prompt]
+                                    for i in starts]), jnp.int32)
+    rows = []
+    for drafter, ks in (("ngram", (2, 3, 4, 8)), ("shared", (2, 4))):
+        for k in ks:
+            _, st = speculative_generate(
+                params, prompts, mesh, cfg, n_new, k=k,
+                draft_layers=1, drafter=drafter, ngram_n=3,
+                return_stats=True)
+            rows.append({
+                "kind": "acceptance", "batch": b, "k": k,
+                "draft_layers": 1, "n_layers": cfg.n_layers,
+                "drafter": drafter, "corpus": "repo-docs-bytes",
+                "corpus_bytes": int(len(corpus)),
+                "train_steps": steps,
+                "s_prompt": s_prompt, "n_new": n_new,
+                "acceptance_rate": round(st["acceptance_rate"], 4),
+                "tokens_per_step": round(st["tokens_per_step"], 4),
+                "verify_steps": st["verify_steps"],
+            })
+            print(f"{drafter} k={k}: acceptance "
+                  f"{st['acceptance_rate']:.4f}, tokens/step "
+                  f"{st['tokens_per_step']:.4f}", flush=True)
+    return rows
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--json", dest="json_path",
+                    default="decode_spec_r10.jsonl")
+    ap.add_argument("--quick", action="store_true")
+    args = ap.parse_args(argv)
+    rows = acceptance_rows(args.quick)
+    with open(args.json_path, "a") as f:
+        for r in rows:
+            f.write(json.dumps(r) + "\n")
+    print(f"{len(rows)} rows appended to {args.json_path}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

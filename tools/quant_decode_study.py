@@ -1,0 +1,214 @@
+"""Round-10 quantized-decode study driver (DECODE.md "Quantized
+decode"): measure the relaxed parity bar and re-price the route.
+
+Protocol — two measurement regimes plus the one-command re-pricing:
+
+1. **Confident regime** (the bar): train a deterministic-corpus toy
+   (order-2 Markov, branch=1, vocab 16 — greedy decode's home turf:
+   the trained model's predictions are near-one-hot). Measure
+   teacher-forced top-1 agreement between the int8 and fp decode
+   paths at GENERATE level (``quant.measure_top1_agreement`` — a
+   full-width verify window, i.e. the decode path's next-token argmax
+   at every committed prefix) and at ENGINE level (fp engine vs int8
+   engine over a request workload; the int8 engine is additionally
+   token-identical to int8 generate by the pinned identity contract).
+   Validated this round: **1.0 over 3040 generate positions** and
+   1.0 over the engine workload, with max logit deviation ~0.22 —
+   the comparison is real, the bar (>= 0.999) clears.
+2. **Entropy-limited regime** (the caveat row): the r8 branch-4
+   teacher (loss 1.67 — within ~0.3 of the corpus entropy floor)
+   measures ~0.97, and EVERY disagreement sits at an fp top-2 margin
+   < 0.22 (median 0.03): near-ties where the fp32 path itself is one
+   rounding away from flipping. Both rows are recorded so the bar is
+   honest about where it holds.
+3. **Re-pricing**: ``bench.decode.cost_model_rows(bytes_dtype="int8")``
+   re-verdicts the r8 measured α=0.377 row against the int8 floor,
+   and ``spec_breakeven_rows(bytes_dtype="int8")`` re-prices the
+   batch-aware break-even table — the same rows
+   ``python -m icikit.bench.decode --cost-model --bytes-dtype int8
+   --alpha-from decode_spec_r8.jsonl`` reproduces from records alone.
+
+Usage::
+
+    JAX_PLATFORMS=cpu python tools/quant_decode_study.py \
+        --json decode_spec_r10.jsonl [--quick]
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+# confident-regime toy: deterministic order-2 chain over a small state
+# space the capacity fully memorizes (loss ~0.04 at 1500 steps)
+DET_TOY = dict(vocab=16, d_model=64, n_heads=2, d_head=32, d_ff=256,
+               n_layers=4, max_seq=160, compute_dtype="float32")
+# the r7/r8 pricing toy (branch-4, entropy-limited)
+R8_TOY = dict(vocab=64, d_model=64, n_heads=2, d_head=32, d_ff=256,
+              n_layers=4, max_seq=160, compute_dtype="float32")
+
+
+def _train(toy: dict, branch: int, steps: int, lr: float = 3e-3):
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from icikit.models.transformer import TransformerConfig, init_params
+    from icikit.models.transformer.model import (make_model_mesh,
+                                                 make_train_step)
+    from icikit.models.transformer.train import make_markov_sampler
+
+    cfg = TransformerConfig(**toy)
+    mesh = make_model_mesh(dp=1, tp=1, sp=1)
+    params = init_params(jax.random.key(0), cfg, mesh)
+    sampler = make_markov_sampler(cfg.vocab, seed=0, branch=branch)
+    _, step = make_train_step(mesh, cfg, optax.adam(lr))
+    st = optax.adam(lr).init(params)
+    loss = None
+    for s in range(steps):
+        chunk = sampler(s, 16, 64)
+        params, st, loss = step(params, st,
+                                jnp.asarray(chunk[:, :-1]),
+                                jnp.asarray(chunk[:, 1:]))
+    final = float(np.asarray(loss))
+    print(f"toy trained: vocab={cfg.vocab} branch={branch} "
+          f"{steps} steps, loss {final:.4f}", flush=True)
+    return cfg, mesh, params, sampler, final
+
+
+def _generate_level(cfg, mesh, params, sampler, n_prompts: int,
+                    n_new: int) -> dict:
+    import jax.numpy as jnp
+
+    from icikit.models.transformer import greedy_generate
+    from icikit.models.transformer.quant import measure_top1_agreement
+
+    qcfg = dataclasses.replace(cfg, decode_quant="int8")
+    prompts = jnp.asarray(sampler(9, n_prompts, 64)[:, :32], jnp.int32)
+    y = greedy_generate(params, prompts, mesh, cfg, n_new)
+    return measure_top1_agreement(params, y, mesh, qcfg, 32)
+
+
+def _engine_level(cfg, mesh, params, sampler, n_requests: int,
+                  n_new: int) -> dict:
+    """fp engine vs int8 engine over the same workload: token-level
+    agreement per position (free-running — on the confident toy the
+    paths agree at every prefix, so no divergence ever starts)."""
+    from icikit.serve import Engine, ServeConfig
+
+    qcfg = dataclasses.replace(cfg, decode_quant="int8")
+    rng = np.random.default_rng(5)
+    chunks = sampler(11, n_requests, 64)
+    prompts = [chunks[i, :int(rng.integers(6, 24))].astype(np.int32)
+               for i in range(n_requests)]
+    sv = ServeConfig(max_rows=4, block_size=8,
+                     n_blocks=max(64, 8 * n_requests),
+                     max_prompt=32, max_new=n_new)
+
+    def serve(c):
+        eng = Engine(params, mesh, c, sv)
+        rids = [eng.submit(p, n_new) for p in prompts]
+        eng.run()
+        return [eng.queue.done[r].tokens for r in rids]
+
+    fp = serve(cfg)
+    q8 = serve(qcfg)
+    total = agree = 0
+    for a, b in zip(fp, q8):
+        n = min(len(a), len(b))
+        total += n
+        agree += sum(1 for x, y in zip(a[:n], b[:n]) if x == y)
+    return {"n_positions": total, "n_agree": agree,
+            "top1_agreement": agree / total if total else 0.0}
+
+
+def parity_rows(quick: bool) -> list:
+    rows = []
+    # 1. confident regime — the bar
+    steps = 150 if quick else 1500
+    cfg, mesh, params, sampler, loss = _train(DET_TOY, branch=1,
+                                              steps=steps)
+    gen = _generate_level(cfg, mesh, params, sampler,
+                          8 if quick else 32, 32 if quick else 96)
+    eng = _engine_level(cfg, mesh, params, sampler,
+                        4 if quick else 12, 8 if quick else 24)
+    for level, m in (("generate", gen), ("engine", eng)):
+        rows.append({
+            "kind": "quant_parity", "level": level,
+            "regime": "confident", "corpus": "markov-det-branch1",
+            "train_steps": steps, "train_loss": round(loss, 4),
+            "bar": 0.999, **{k: (round(v, 6)
+                                 if isinstance(v, float) else v)
+                             for k, v in m.items()},
+            "clears_bar": m["top1_agreement"] >= 0.999,
+        })
+        print(f"confident/{level}: agreement "
+              f"{m['top1_agreement']:.6f} over {m['n_positions']} "
+              f"positions", flush=True)
+    # 2. entropy-limited regime — the caveat row
+    steps = 150 if quick else 3000
+    cfg4, mesh4, p4, smp4, loss4 = _train(R8_TOY, branch=4,
+                                          steps=steps)
+    gen4 = _generate_level(cfg4, mesh4, p4, smp4,
+                           8 if quick else 16, 32 if quick else 96)
+    rows.append({
+        "kind": "quant_parity", "level": "generate",
+        "regime": "entropy-limited", "corpus": "markov-order2",
+        "train_steps": steps, "train_loss": round(loss4, 4),
+        "bar": 0.999, **{k: (round(v, 6) if isinstance(v, float)
+                             else v) for k, v in gen4.items()},
+        "clears_bar": gen4["top1_agreement"] >= 0.999,
+        "note": ("disagreements sit at fp top-2 margins below the "
+                 "logit quant noise (near-ties; r10 margin diagnosis: "
+                 "max 0.22, median 0.03)"),
+    })
+    print(f"entropy-limited/generate: agreement "
+          f"{gen4['top1_agreement']:.6f}", flush=True)
+    return rows
+
+
+def pricing_rows(alpha_from: str) -> list:
+    from icikit.bench.decode import cost_model_rows, spec_breakeven_rows
+    rows = []
+    for dt in ("bf16", "int8"):
+        rows.extend(cost_model_rows(alpha_from, preset="base", batch=1,
+                                    bytes_dtype=dt))
+        rows.extend(spec_breakeven_rows(preset="base", bytes_dtype=dt))
+    return rows
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--json", dest="json_path",
+                    default="decode_spec_r10.jsonl")
+    ap.add_argument("--alpha-from", default="decode_spec_r8.jsonl",
+                    help="measured-acceptance records the re-pricing "
+                         "re-verdicts (the r8 α=0.377 rows)")
+    ap.add_argument("--quick", action="store_true",
+                    help="CI-sized run (fewer steps/tokens; the "
+                         "confident toy does not converge, so the "
+                         "bar row is machinery-only)")
+    args = ap.parse_args(argv)
+    rows = parity_rows(args.quick)
+    if os.path.exists(args.alpha_from):
+        rows.extend(pricing_rows(args.alpha_from))
+    else:
+        print(f"no {args.alpha_from}: skipping re-pricing rows",
+              flush=True)
+    with open(args.json_path, "a") as f:
+        for r in rows:
+            f.write(json.dumps(r) + "\n")
+    print(f"{len(rows)} rows appended to {args.json_path}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

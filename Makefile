@@ -125,6 +125,16 @@ serve-smoke:
 		--integrity pages --mode continuous --seed 0 \
 		--expect-chaos corrupt:serve.kv.page > /dev/null
 	@echo "serve-smoke chaos OK: KV-page drill fired and the run completed"
+	JAX_PLATFORMS=cpu \
+	ICIKIT_OBS="trace=/tmp/icikit_serve_prefix_trace.json;metrics=/tmp/icikit_serve_prefix_metrics.json;jsonl=off" \
+	$(PY) -m icikit.bench.serve --preset tiny --rows 2 --requests 6 \
+		--rate 50 --prompt 16 --prefix 12 --new-min 4 --new-max 8 \
+		--block-size 4 --prefill-chunk 8 --compute-dtype float32 \
+		--mode continuous --seed 0 --verify-identity > /dev/null
+	$(PY) -m icikit.obs.check /tmp/icikit_serve_prefix_trace.json
+	@grep -q '"serve.prefix.hits"' /tmp/icikit_serve_prefix_metrics.json && \
+		grep -q '"serve.prefix.hit_tokens"' /tmp/icikit_serve_prefix_metrics.json && \
+		echo "serve-smoke prefix OK: shared-prefix trace valid, cache-hit admissions on the bus"
 
 bench:
 	$(PY) bench.py

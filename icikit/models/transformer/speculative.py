@@ -338,10 +338,18 @@ def speculative_generate(params, prompt, mesh, cfg: TransformerConfig,
         and the ``draft_*`` param branch), ``"ngram"`` = the
         zero-model-cost longest-suffix-match proposer
         (``icikit.serve.ngram_draft`` — no drafting forward passes at
-        all; the first rung of the ROADMAP 3b fallback ladder, kept
-        opt-in here until its acceptance is measured on a real stream
-        per the defaults-audit rule), ``"auto"`` = trained when the
-        config arms it, shared otherwise.
+        all), ``"auto"`` = trained when the config arms it, ngram
+        otherwise. The no-head fallback flipped from "shared" to
+        "ngram" in r11 per the defaults-audit rule, citing the
+        measured r10 row (``decode_spec_r10.jsonl``,
+        ``tools/ngram_stream_study.py``): on the genuine English byte
+        stream the ngram matcher accepts α=0.30 at k=2 (0.21 at k=3)
+        vs the shared drafter's 0.22 on the same stream — and it
+        drafts for free, where the shared drafter pays a
+        truncated-depth forward pass per window, so it dominates the
+        no-head regime on both axes. The engine's host loop offers
+        the suffix-automaton upgrade on the same contract
+        (``ServeConfig(drafter="suffix")``).
       ngram_n: max suffix length the ``"ngram"`` drafter matches.
 
     Acceptance counters flow through ``icikit.obs``
@@ -353,7 +361,10 @@ def speculative_generate(params, prompt, mesh, cfg: TransformerConfig,
         raise ValueError(f"unknown drafter {drafter!r} "
                          "(known: auto, shared, trained, ngram)")
     if drafter == "auto":
-        drafter = "trained" if cfg.draft_head else "shared"
+        # no-head fallback = "ngram" (r11 flip; r10 measured row: the
+        # free matcher out-accepts the shared drafter on a real text
+        # stream — see the docstring)
+        drafter = "trained" if cfg.draft_head else "ngram"
     if drafter == "trained":
         if not cfg.draft_head:
             raise ValueError("drafter='trained' requires a config with "

@@ -19,8 +19,10 @@ from icikit.serve.kvpool import (  # noqa: F401
     BlockAllocator,
     KVPool,
     PoolExhausted,
+    block_hashes,
 )
 from icikit.serve.ngram_draft import (  # noqa: F401
+    SuffixAutomaton,
     ngram_propose,
     ngram_propose_host,
 )

@@ -7,10 +7,25 @@ time, finish at different lengths, and throughput is set by how full
 the decode batch *stays*, not by how big one batch once was. This
 engine is the Orca-style composition step over everything below it:
 
-- **prefill/decode disaggregation** — admission runs the request's
-  prompt through the shared ``_prefill`` (one compiled program per
-  prompt length), scatters its K/V into pool blocks, and produces the
-  first token; the decode loop never pays prompt-shaped work.
+- **prefill/decode disaggregation with chunked prefill** — admission
+  shares the longest cached block-aligned prefix of the prompt
+  straight out of the pool's prefix index (no compute at all for those
+  positions), then streams only the uncached suffix through
+  fixed-width *chunk* programs interleaved with the decode step — one
+  chunk per engine loop pass — so a long prompt inflicts at most one
+  chunk of latency on co-batched decoders per step, and compilation is
+  bounded to a small bucket ladder instead of one program per prompt
+  length.
+- **prefix caching** — full, finalized KV blocks are content-addressed
+  by chain hashes of their token runs (``kvpool.block_hashes``); a new
+  prompt attaches (refcount-shared) to every leading block it matches
+  and pays prefill only for the remainder. Blocks are immutable while
+  shared: the one write-into-shared case (the full-hit last-position
+  recompute) forks the block copy-on-write first. K/V at a position is
+  a pure function of the token prefix, so served tokens stay
+  greedy-identical to single-request ``generate`` whether a prefix
+  came from compute or from cache (pinned in
+  ``tests/test_serve_engine.py``).
 - **continuous batching** — one fixed-width step program (``B`` rows,
   paged attention over per-row block tables) runs forever; finished
   rows are evicted and their slots re-admitted from the queue at
@@ -18,19 +33,40 @@ engine is the Orca-style composition step over everything below it:
   speculative-verify boundaries — the step IS the verify window).
 - **paged KV cache** — rows gather their own blocks back into a
   contiguous view under a per-row causal mask
-  (``_window_masked_attention``), so a corrupted or recycled page can
-  only ever be read by the request whose table points at it.
+  (``_window_masked_attention``); a corrupted page can only ever be
+  read by requests whose tables map it — with sharing that is *every
+  sharer*, which is why sealed-page digests are content-keyed and a
+  failed verify quarantines the page from the prefix index
+  (``tests/test_serve_chaos.py``).
 - **token identity** — every committed token is the full model's
   argmax over the row's own committed prefix, computed by the same
   ``_DecodeCtx`` math as single-request decode; outputs are
   greedy-token-identical per request to ``greedy_generate`` (pinned
   across staggered admission, mixed prompt lengths, speculative
-  on/off, dp/tp meshes in ``tests/test_serve_engine.py``).
+  on/off, dp/tp meshes, cache hit/partial-hit/miss/CoW admissions).
+  The chunk program computes prompt positions with the shared
+  window-einsum attention (the decode stack's one numerics source for
+  every incremental position); ``generate``'s one-shot prefill may
+  route through the flash kernel, whose fp32 reassociation the
+  repo's identity bar already absorbs at the argmax level
+  (``tests/test_decode.py`` pins greedy decode against a dense
+  re-forward oracle under the same tolerance-free token comparison).
 - **speculative serving** — ``speculate_k >= 2`` turns the step into a
-  k-token verify window fed by the zero-cost n-gram drafter
-  (``serve/ngram_draft.py``); acceptance semantics are exactly
-  ``speculative_generate``'s (longest prefix, m matches commit m+1
-  tokens).
+  k-token verify window fed by a zero-model-cost drafter: the in-jit
+  n-gram matcher (``serve/ngram_draft.ngram_propose_host``) or its
+  suffix-automaton upgrade (``drafter="suffix"``, unbounded match
+  length at O(1) amortized host cost per committed token); acceptance
+  semantics are exactly ``speculative_generate``'s (longest prefix, m
+  matches commit m+1 tokens) — proposals never change tokens.
+
+The int8 KV path keeps its round-10 numerics untouched: quantized
+admissions run the exact-length ``_prefill`` program (raw in-prompt
+attention, quantize-at-store — the deployed-prefill semantics the r10
+parity metric was corrected to honor), held in an LRU-bounded program
+cache, and the prefix index never serves the q8 side (a cached
+quantized block cannot reproduce the raw prompt-column attention int8
+``generate`` computes, so sharing would break the engine≡generate
+parity bar; mixed engines still cache their fp rows).
 
 Scheduling rides :class:`icikit.serve.scheduler.RequestQueue` — leases
 renewed per step, expiry reissue (dead-request abandonment), retry
@@ -38,42 +74,64 @@ with backoff on transient failures (pool preemption, KV-integrity
 mismatch), idempotent completion commits.
 
 SLO accounting flows through ``icikit.obs``: ``serve.ttft_ms`` /
-``serve.tpot_ms`` / ``serve.queue_wait_ms`` histograms,
-``serve.occupancy_rows`` / ``serve.kv.occupancy`` gauges,
-``serve.tokens`` counters, a ``serve.request`` span per admission and
-a ``serve.engine.step`` span per step (chrome-checker-valid).
+``serve.tpot_ms`` / ``serve.queue_wait_ms`` / ``serve.max_gap_ms``
+histograms, ``serve.occupancy_rows`` / ``serve.kv.*`` gauges,
+``serve.tokens`` counters, ``serve.prefix.hit_tokens`` histograms +
+``serve.prefix.{hits,misses,cow,quarantined}`` counters, a
+``serve.request`` span per admission, a ``serve.prefill.chunk`` span
+per chunk and a ``serve.engine.step`` span per step
+(chrome-checker-valid).
 
 Chaos sites (drilled in ``tests/test_serve_chaos.py``):
 
-- ``serve.admit``        — delay/die at admission;
-- ``serve.admit.prompt`` — SDC on the claimed prompt bytes; detection
+- ``serve.admit``         — delay/die at admission;
+- ``serve.admit.prompt``  — SDC on the claimed prompt bytes; detection
   is the submit-time checksum → ``PoisonedPromptError`` → rejected
   without retry, engine keeps serving;
-- ``serve.step``         — delay/die at the step boundary (a die is an
-  engine crash: leases expire, requests reissue to the next engine);
-- ``serve.kv.page``      — SDC on a sealed KV page; with
-  ``integrity="pages"`` the owner request fails its completion
-  verify and retries on fresh blocks while co-batched requests'
-  outputs stay bitwise unchanged (containment is structural: nobody
-  else's block table maps that page).
+- ``serve.step``          — delay/die at the step boundary (a die is
+  an engine crash: leases expire, requests reissue to the next
+  engine);
+- ``serve.prefill.chunk`` — delay/die at a chunk boundary;
+- ``serve.kv.page``       — SDC on a sealed KV page; with
+  ``integrity="pages"`` every request whose table maps the page fails
+  its completion verify, the page is quarantined from the prefix
+  index, and retries re-prefill on fresh blocks while non-sharing
+  co-batched requests' outputs stay bitwise unchanged.
 """
 
 from __future__ import annotations
 
+import collections
 import time
 from dataclasses import dataclass, field
 
 import numpy as np
 
 from icikit import chaos, obs
-from icikit.serve.kvpool import KVPool, PoolExhausted
-from icikit.serve.ngram_draft import DEFAULT_N, ngram_propose_host
+from icikit.serve.kvpool import (
+    KVPool,
+    PoolExhausted,
+    block_hashes,
+    chain_extend,
+    chain_seed,
+)
+from icikit.serve.ngram_draft import (
+    DEFAULT_N,
+    SuffixAutomaton,
+    ngram_propose_host,
+)
 from icikit.serve.scheduler import (
     PoisonedPromptError,
     Request,
     RequestQueue,
     prompt_checksum,
 )
+
+# quantized admissions compile one exact-length prefill program per
+# distinct prompt length; this cap bounds the cache (LRU eviction =
+# recompile on re-encounter, never unbounded growth). The fp path
+# needs no cap — its chunk buckets are finitely many by construction.
+PREFILL_PROGRAM_CAP = 8
 
 
 class IntegrityError(RuntimeError):
@@ -89,9 +147,25 @@ class ServeConfig:
     n_blocks: int = 64       # allocatable blocks per dp shard
     max_prompt: int = 64     # admission ceilings (validation, buffers)
     max_new: int = 64
-    speculate_k: int = 1     # 1 = single-token; >= 2 = ngram verify
+    speculate_k: int = 1     # 1 = single-token; >= 2 = drafted verify
     ngram_n: int = DEFAULT_N
+    # "ngram" = the in-jit bounded-suffix matcher (r9, measured r10);
+    # "suffix" = its suffix-automaton upgrade: unbounded longest-suffix
+    # match at O(1) amortized host cost per committed token (the
+    # ROADMAP 3b ladder rung above ngram — same verify/accept contract,
+    # so token identity is unconditional either way)
+    drafter: str = "ngram"
     integrity: str = "none"  # "none" | "pages" (seal + verify)
+    # automatic prefix caching (fp arenas): share cached block-aligned
+    # prompt prefixes instead of recomputing them. Off = every
+    # admission recomputes its full prompt (the A/B baseline arm).
+    prefix_cache: bool = True
+    # prefill chunk ceiling: uncached prompt suffixes stream through
+    # bucket-width chunk programs (powers of two up to this value),
+    # one chunk per engine loop pass. Set >= max_prompt for
+    # whole-prompt (single-chunk) admission — the r11 A/B's "whole"
+    # arm uses exactly that.
+    prefill_chunk: int = 64
     # KV-arena precision: "auto" follows cfg.decode_quant (int8 decode
     # stores int8 KV — the pure bandwidth configuration, no fp arena
     # exists), "none"/"int8" force, "mixed" holds BOTH arenas over one
@@ -110,10 +184,17 @@ class _Row:
     shard: int
     s_prompt: int
     n_done: int              # committed tokens (includes the pending)
-    sealed: int              # blocks checksummed so far
+    sealed: int              # leading table blocks finalized so far
+    prefilled: int = 0       # prompt positions whose K/V is resident
     seq: int = 0             # claim generation captured at admission
     owner: str = ""          # pool-ownership token: rid + claim seq
     side: str = "fp"         # which KV arena serves this row (fp | q8)
+    last_t: float = 0.0      # last token-delivery instant (monotonic)
+    max_gap: float = 0.0     # worst inter-delivery stall so far (s)
+    # chain-hash state at block `sealed - 1`: finalizing block j
+    # extends this by ONE block (O(block), not a re-hash from zero).
+    # Default = chain_seed("fp"); admission overrides for hits/sides.
+    chain: bytes = b"fp"
     # tokens accumulate HERE, not on the shared Request object: the
     # claim-seq fence covers queue mutations, but a stalled engine
     # resuming after its lease was reaped must also be unable to
@@ -148,6 +229,13 @@ class Engine:
             raise ValueError(
                 f"unknown integrity {serve.integrity!r} "
                 "(known: none, pages)")
+        if serve.drafter not in ("ngram", "suffix"):
+            raise ValueError(f"unknown drafter {serve.drafter!r} "
+                             "(known: ngram, suffix)")
+        if serve.prefill_chunk < 1:
+            raise ValueError(
+                f"prefill_chunk must be >= 1, got "
+                f"{serve.prefill_chunk}")
         self.dp = mesh.shape[DP_AXIS]
         if serve.max_rows % self.dp:
             raise ValueError(
@@ -210,9 +298,32 @@ class Engine:
         # mixed mode compiles two step variants and dispatches per
         # step on whether a quantized row is resident (see _build_step)
         self._step_fns: dict = {}
-        self._prefill_fns: dict = {}
+        # fp admissions: chunk programs keyed by bucket width — the
+        # ladder is finite, so so is the cache (the satellite bound)
+        self._chunk_fns: dict = {}
+        self._chunk_widths = self._bucket_ladder(serve.prefill_chunk)
+        # q8 admissions: exact-length prefill programs, LRU-capped
+        self._prefill_fns: collections.OrderedDict = \
+            collections.OrderedDict()
+        # per-slot suffix-automaton drafter state (drafter="suffix")
+        self._automata: dict = {}
+        self._prefix = {"hits": 0, "misses": 0, "hit_tokens": 0,
+                        "full_hits": 0, "cow": 0}
         self.n_steps = 0
         self._occ_rows = 0       # sum of active rows over steps
+
+    @staticmethod
+    def _bucket_ladder(chunk: int) -> tuple:
+        """Power-of-two chunk widths up to ``chunk`` (always included):
+        a prompt remainder takes the smallest covering bucket, so the
+        compiled-chunk-program count is bounded by this ladder's
+        length, not by the prompt-length distribution."""
+        ws, w = [], 8
+        while w < chunk:
+            ws.append(w)
+            w *= 2
+        ws.append(chunk)
+        return tuple(ws)
 
     @staticmethod
     def _cast_weights(params, cfg):
@@ -397,12 +508,106 @@ class Engine:
             out_specs=(P(DP_AXIS, None), P(DP_AXIS), P(DP_AXIS),
                        bspecs)), donate_argnums=(7,))
 
-    def _build_prefill(self, s_prompt: int, quant_row: bool):
-        """``quant_row`` matters only in "mixed" mode: an fp
-        admission's prefill skips the q8-arena quantize/scatter (its
-        pages live in the fp arena; the q arenas pass through), a
-        quant admission's skips the fp scatter — each request pays
-        exactly its own side's bytes."""
+    def _build_chunk(self, width: int):
+        """One compiled prefill-chunk program for fp-side admissions —
+        the replacement for the per-prompt-length program zoo.
+
+        Computes ``width`` prompt positions starting at traced offset
+        ``p0``: projects their q/k/v, writes the K/V into the row's
+        pool blocks (padding positions route to trash block 0), then
+        attends the row's whole paged view under the per-position
+        causal mask — so chunk 2's queries read chunk 1's (or a cache
+        hit's) K/V straight from the pool, and the per-position math
+        is exactly the step program's. ``tok0`` (the argmax at the
+        last valid position) is only meaningful on the chunk that
+        covers position ``s_prompt - 1``, and only on the owner shard
+        (other shards gather trash), hence the per-shard out spec.
+
+        In "mixed" mode this program serves fp rows only (q8 rows take
+        the exact ``_prefill`` path — see the module docstring): the
+        q8 arenas pass through untouched."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+
+        from icikit.models.transformer.decode import (
+            _DecodeCtx,
+            _window_masked_attention,
+        )
+        from icikit.models.transformer.model import DP_AXIS
+        from icikit.models.transformer.quant import decode_param_specs
+        from icikit.ops.rope import apply_rope, rope_sincos
+
+        cfg = self.cfg
+        ctx = _DecodeCtx(cfg, self.mesh)
+        bs = self.serve.block_size
+        NB = self.nb_per_row
+        T = NB * bs
+        n_layers = cfg.n_layers
+        mode = self.kv_mode
+        if mode == "int8":
+            raise RuntimeError(
+                "chunk programs are fp-side only; int8 admissions use "
+                "the exact _prefill path")
+
+        def per_shard(params, toks, p0, n_valid, btab, bufs):
+            # toks (1, width) replicated across shards; btab (1, NB)
+            # is the owner's table on its shard, all-zero elsewhere —
+            # non-owner shards write (and gather) the trash block
+            lp = {kk: params[kk] for kk in ctx.layer_keys}
+            pos = p0[0] + jnp.arange(width)[None, :]         # (1, w)
+            valid = (jnp.arange(width) < n_valid[0])[None, :]
+            x = ctx.embed(params, toks, pos)
+            sincos = (rope_sincos(pos, cfg.d_head, cfg.rope_theta)
+                      if cfg.pos_encoding == "rope" else None)
+            mask = (jnp.arange(T)[None, None, :] <= pos[:, :, None])
+            pages = jnp.take_along_axis(btab, pos // bs, axis=1)
+            pages = jnp.where(valid, pages, 0)   # padding → trash
+            slots = pos % bs
+            out = {kk: [] for kk in bufs}
+            for li in range(n_layers):
+                lp1 = {kk: lp[kk][li] for kk in ctx.layer_keys}
+                q, k_, v_ = ctx.qkv_proj(x, lp1)
+                if sincos is not None:
+                    q = apply_rope(q, pos, cfg.rope_theta, sincos)
+                    k_ = apply_rope(k_, pos, cfg.rope_theta, sincos)
+                kp, vp = bufs["kc"][li][0], bufs["vc"][li][0]
+                kp = kp.at[pages, slots].set(k_.astype(kp.dtype))
+                vp = vp.at[pages, slots].set(v_.astype(vp.dtype))
+                out["kc"].append(kp[None])
+                out["vc"].append(vp[None])
+                if mode == "mixed":
+                    for kk in ("qkc", "qvc", "ksc", "vsc"):
+                        out[kk].append(bufs[kk][li])
+                ks = kp[btab].reshape(1, T, *kp.shape[2:])
+                vs = vp[btab].reshape(1, T, *vp.shape[2:])
+                attn = _window_masked_attention(q, ks, vs, mask,
+                                                ctx.scale, ctx.n_rep)
+                x = ctx.close_attn(x, attn, lp1)
+                x = ctx.ffn(x, lp1)
+            xl = jax.lax.dynamic_slice_in_dim(x, n_valid[0] - 1, 1,
+                                              axis=1)
+            tok0 = jnp.argmax(ctx.logits(params, xl[:, 0]),
+                              axis=-1).astype(jnp.int32)
+            return tok0, {kk: tuple(v) for kk, v in out.items()}
+
+        bspecs = self.pool.buffer_specs(self._pool_spec(),
+                                        self._scale_spec())
+        from icikit.parallel.shmap import shard_map as _shard_map
+        return jax.jit(_shard_map(
+            per_shard, mesh=self.mesh,
+            in_specs=(decode_param_specs(cfg), P(None, None), P(None),
+                      P(None), P(DP_AXIS, None), bspecs),
+            out_specs=(P(DP_AXIS), bspecs)), donate_argnums=(5,))
+
+    def _build_prefill(self, s_prompt: int):
+        """Exact-length whole-prompt prefill for QUANTIZED admissions:
+        the prompt's own attention runs on the raw projections and
+        quantization happens at store time — the deployed int8-prefill
+        semantics the r10 parity metric was corrected to honor, which
+        a write-then-gather chunk over int8 pages cannot reproduce.
+        On a "mixed" engine only the q8 arenas are touched (each
+        request pays exactly its own side's bytes)."""
         import jax.numpy as jnp
         from jax.sharding import PartitionSpec as P
 
@@ -416,9 +621,6 @@ class Engine:
         bs = self.serve.block_size
         npref = -(-s_prompt // bs)
         n_layers = cfg.n_layers
-        mode = self.kv_mode
-        touch_fp = mode == "none" or (mode == "mixed" and not quant_row)
-        touch_q8 = mode == "int8" or (mode == "mixed" and quant_row)
 
         def per_shard(params, prompt, pages, bufs):
             # prompt replicated: every shard computes the same prefill;
@@ -433,43 +635,29 @@ class Engine:
                 kcache, vcache = caches
             out = {kk: [] for kk in bufs}
             for li in range(n_layers):
-                if "kc" in bufs and not touch_fp:
+                if "kc" in bufs:     # mixed: fp arenas pass through
                     out["kc"].append(bufs["kc"][li])
                     out["vc"].append(bufs["vc"][li])
-                elif "kc" in bufs:
-                    kp, vp = bufs["kc"][li][0], bufs["vc"][li][0]
-                    kb = kcache[li][0].reshape(npref, bs,
-                                               *kp.shape[2:])
-                    vb = vcache[li][0].reshape(npref, bs,
-                                               *vp.shape[2:])
-                    out["kc"].append(
-                        kp.at[pages[0]].set(kb.astype(kp.dtype))[None])
-                    out["vc"].append(
-                        vp.at[pages[0]].set(vb.astype(vp.dtype))[None])
-                if "qkc" in bufs and not touch_q8:
-                    for kk in ("qkc", "qvc", "ksc", "vsc"):
-                        out[kk].append(bufs[kk][li])
-                elif "qkc" in bufs:
-                    qkp = bufs["qkc"][li][0]
-                    qvp = bufs["qvc"][li][0]
-                    kscp = bufs["ksc"][li][0]
-                    vscp = bufs["vsc"][li][0]
-                    if ctx.quant:
-                        kq, ksn = kcache[li][0], kscache[li][0]
-                        vq, vsn = vcache[li][0], vscache[li][0]
-                    else:
-                        # mixed: the same per-column quantization the
-                        # int8 generate path applies at store time
-                        kq, ksn = quantize_last(kcache[li][0])
-                        vq, vsn = quantize_last(vcache[li][0])
-                    out["qkc"].append(qkp.at[pages[0]].set(
-                        kq.reshape(npref, bs, *qkp.shape[2:]))[None])
-                    out["qvc"].append(qvp.at[pages[0]].set(
-                        vq.reshape(npref, bs, *qvp.shape[2:]))[None])
-                    out["ksc"].append(kscp.at[pages[0]].set(
-                        ksn.reshape(npref, bs, *kscp.shape[2:]))[None])
-                    out["vsc"].append(vscp.at[pages[0]].set(
-                        vsn.reshape(npref, bs, *vscp.shape[2:]))[None])
+                qkp = bufs["qkc"][li][0]
+                qvp = bufs["qvc"][li][0]
+                kscp = bufs["ksc"][li][0]
+                vscp = bufs["vsc"][li][0]
+                if ctx.quant:
+                    kq, ksn = kcache[li][0], kscache[li][0]
+                    vq, vsn = vcache[li][0], vscache[li][0]
+                else:
+                    # mixed: the same per-column quantization the
+                    # int8 generate path applies at store time
+                    kq, ksn = quantize_last(kcache[li][0])
+                    vq, vsn = quantize_last(vcache[li][0])
+                out["qkc"].append(qkp.at[pages[0]].set(
+                    kq.reshape(npref, bs, *qkp.shape[2:]))[None])
+                out["qvc"].append(qvp.at[pages[0]].set(
+                    vq.reshape(npref, bs, *qvp.shape[2:]))[None])
+                out["ksc"].append(kscp.at[pages[0]].set(
+                    ksn.reshape(npref, bs, *kscp.shape[2:]))[None])
+                out["vsc"].append(vscp.at[pages[0]].set(
+                    vsn.reshape(npref, bs, *vscp.shape[2:]))[None])
             return tok0, {kk: tuple(v) for kk, v in out.items()}
 
         bspecs = self.pool.buffer_specs(self._pool_spec(),
@@ -520,7 +708,11 @@ class Engine:
                 "full precision would misreport the path it priced")
 
     def _admit(self) -> int:
-        """Admit queued requests into free slots; returns how many."""
+        """Admit queued requests into free slots; returns how many.
+        Admission allocates (or cache-shares) the prompt's blocks and
+        stages the row for prefill — the compute itself streams
+        through :meth:`_advance_prefill`, interleaved with decode
+        steps."""
         admitted = 0
         while True:
             slot = self._free_slot()
@@ -542,64 +734,204 @@ class Engine:
                 continue
             shard = self._shard_of(slot)
             s = int(prompt.size)
+            quant_row = (self.kv_mode == "int8"
+                         or (self.kv_mode == "mixed" and req.quant))
+            side = "q8" if quant_row else "fp"
             # pool ownership is keyed by (rid, claim generation): a
             # reaped request re-admitted while a stale row still holds
             # its old blocks must NOT share a block table with it
             owner = f"{req.rid}.{req.claim_seq}"
+            p0 = 0
+            hit: list = []
+            bs = self.serve.block_size
+            chain_hexes: list = []
             try:
+                if self.serve.prefix_cache and side == "fp":
+                    chain_hexes = block_hashes(prompt, bs, side)
+                    hit = self.pool.lookup(shard, chain_hexes)
+                    if hit:
+                        self.pool.share(owner, shard, hit)
+                        p0 = len(hit) * bs
+                        if p0 >= s:
+                            # full block-aligned hit: the last token's
+                            # logits still need computing — recompute
+                            # position s-1 (its write CoW-forks the
+                            # shared tail block in _prefill_chunk)
+                            p0 = s - 1
                 self.pool.ensure(owner, shard, s)
             except PoolExhausted:
                 # not the request's fault: back off without burning a
                 # retry — admission re-attempts once rows evict
+                self.pool.release(owner, shard)
                 self.queue.release(req.rid, delay=0.005,
                                    seq=req.claim_seq)
                 return admitted
             with obs.span("serve.request", rid=req.rid, s_prompt=s,
-                          n_new=req.n_new, slot=slot):
-                self._prefill_into(req, prompt, slot, shard, owner)
+                          n_new=req.n_new, slot=slot,
+                          prefix_hit=p0):
+                now = time.monotonic()
+                if req.admit_t is None:
+                    req.admit_t = now
+                    # re-admissions keep the first admit_t (the SLO
+                    # record is per-request) and must not re-emit its
+                    # stale wait sample
+                    obs.observe("serve.queue_wait_ms",
+                                (now - req.arrival_t) * 1e3)
+                req.prefix_hit_tokens = p0
+                if side == "fp" and self.serve.prefix_cache:
+                    if p0:
+                        self._prefix["hits"] += 1
+                        self._prefix["hit_tokens"] += p0
+                        if len(hit) * bs >= s:
+                            self._prefix["full_hits"] += 1
+                    else:
+                        self._prefix["misses"] += 1
+                    obs.count("serve.prefix.hits" if p0
+                              else "serve.prefix.misses")
+                    obs.observe("serve.prefix.hit_tokens", float(p0))
+                table = self.pool.allocators[shard].table(owner)
+                n_shared = len(hit)
+                # the hexdigest IS the chain state's hex encoding, so
+                # resuming the chain past the shared blocks is a
+                # decode, not a re-hash
+                chain = (bytes.fromhex(chain_hexes[n_shared - 1])
+                         if n_shared else chain_seed(side))
+                self.rows[slot] = _Row(
+                    req=req, shard=shard, s_prompt=s, n_done=0,
+                    sealed=n_shared, prefilled=p0, seq=req.claim_seq,
+                    owner=owner, side=side, chain=chain)
+                self._toks[slot] = 0
+                self._curs[slot] = 0
+                self._active[slot] = False
+                self._isq[slot] = side == "q8"
+                self._btab[slot] = 0
+                self._btab[slot, :len(table)] = table
+                self._seq_buf[slot] = 0
+                self._seq_buf[slot, :s] = prompt
+                obs.count("serve.admitted")
+                if quant_row:
+                    # the int8 path keeps whole-prompt admission (see
+                    # _build_prefill) — run it to completion here
+                    self._prefill_whole(slot, self.rows[slot], prompt)
             admitted += 1
 
-    def _prefill_into(self, req: Request, prompt, slot: int,
-                      shard: int, owner: str) -> None:
-        quant_row = (self.kv_mode == "int8"
-                     or (self.kv_mode == "mixed" and req.quant))
-        key = (prompt.size, quant_row)
-        if key not in self._prefill_fns:
-            self._prefill_fns[key] = self._build_prefill(prompt.size,
-                                                         quant_row)
-        fn, npref = self._prefill_fns[key]
-        table = self.pool.allocators[shard].table(owner)
+    def _prefill_whole(self, slot: int, row: _Row, prompt) -> None:
+        """Quantized admission: one exact-length prefill program,
+        LRU-bounded compile cache."""
+        s = row.s_prompt
+        if s in self._prefill_fns:
+            self._prefill_fns.move_to_end(s)
+        else:
+            self._prefill_fns[s] = self._build_prefill(s)
+            while len(self._prefill_fns) > PREFILL_PROGRAM_CAP:
+                self._prefill_fns.popitem(last=False)
+        fn, npref = self._prefill_fns[s]
+        table = self.pool.allocators[row.shard].table(row.owner)
         pages = np.zeros((self.dp, npref), np.int32)
-        pages[shard] = table[:npref]
+        pages[row.shard] = table[:npref]
         tok0, bufs = fn(self.params, prompt[None], pages,
                         self.pool.buffers())
         self.pool.update(bufs)
-        tok0 = int(np.asarray(tok0)[0])
-        now = time.monotonic()
-        first_admission = req.admit_t is None
-        if first_admission:
-            req.admit_t = now
-        req.first_token_t = now
-        side = "q8" if quant_row else "fp"
-        self.rows[slot] = _Row(req=req, shard=shard,
-                               s_prompt=int(prompt.size), n_done=1,
-                               sealed=0, seq=req.claim_seq,
-                               owner=owner, side=side, tokens=[tok0])
+        row.prefilled = s
+        self._complete_prefill(slot, row, int(np.asarray(tok0)[0]))
+
+    def _advance_prefill(self) -> None:
+        """Run ONE chunk for every row still prefilling — the engine
+        loop alternates this with the decode step, so a long prompt
+        stalls co-batched decoders by at most one chunk per step (the
+        chunked-prefill latency cap)."""
+        for slot, row in enumerate(self.rows):
+            if row is None or row.prefilled >= row.s_prompt:
+                continue
+            self._prefill_chunk(slot, row)
+
+    def _chunk_width(self, rem: int) -> int:
+        rem = min(rem, self.serve.prefill_chunk)
+        for w in self._chunk_widths:
+            if w >= rem:
+                return w
+        return self._chunk_widths[-1]
+
+    def _prefill_chunk(self, slot: int, row: _Row) -> None:
+        chaos.maybe_delay("serve.prefill.chunk")
+        chaos.maybe_die("serve.prefill.chunk")
+        # heartbeat per chunk: pre-r11 the whole prefill ran inside
+        # the claim's fresh lease window; a chunked prefill spanning
+        # many loop passes must renew like the step loop does, or a
+        # prompt longer than lease_s gets reaped and reissued while
+        # this row keeps computing
+        self.queue.renew(row.req.rid, seq=row.seq)
+        bs = self.serve.block_size
+        s = row.s_prompt
+        rem = s - row.prefilled
+        width = self._chunk_width(rem)
+        n_valid = min(rem, width)
+        # CoW guard: never write into a page another owner maps —
+        # fork every block the write window touches while it is
+        # shared. By construction only the full-hit last-position
+        # recompute targets a shared block, but the guard is the
+        # invariant, not the construction.
+        try:
+            forked = False
+            for j in range(row.prefilled // bs,
+                           (row.prefilled + n_valid - 1) // bs + 1):
+                if self.pool.cow(row.owner, row.shard, j,
+                                 side=row.side):
+                    forked = True
+            if forked:
+                self._prefix["cow"] += 1
+                table = self.pool.allocators[row.shard].table(
+                    row.owner)
+                self._btab[slot] = 0
+                self._btab[slot, :len(table)] = table
+        except PoolExhausted:
+            self._evict(slot)
+            self.queue.release(row.req.rid, delay=0.005, seq=row.seq)
+            return
+        key = width
+        if key not in self._chunk_fns:
+            self._chunk_fns[key] = self._build_chunk(width)
+        toks = np.zeros((1, width), np.int32)
+        toks[0, :n_valid] = self._seq_buf[
+            slot, row.prefilled:row.prefilled + n_valid]
+        btab = np.zeros((self.dp, self.nb_per_row), np.int32)
+        btab[row.shard] = self._btab[slot]
+        with obs.span("serve.prefill.chunk", rid=row.req.rid,
+                      p0=row.prefilled, width=width, n_valid=n_valid):
+            tok0, bufs = self._chunk_fns[key](
+                self.params, toks,
+                np.asarray([row.prefilled], np.int32),
+                np.asarray([n_valid], np.int32),
+                btab, self.pool.buffers())
+            self.pool.update(bufs)
+        # second heartbeat AFTER the program: a chunk's compile or
+        # execute can itself outlast lease_s, and the reaper runs at
+        # the loop top right after this returns — the entry renewal
+        # alone would leave that window expired
+        self.queue.renew(row.req.rid, seq=row.seq)
+        row.prefilled += n_valid
+        if row.prefilled >= s:
+            # tok0 is only real on the owner shard (P(DP_AXIS) out)
+            self._complete_prefill(
+                slot, row, int(np.asarray(tok0)[row.shard]))
+
+    def _complete_prefill(self, slot: int, row: _Row,
+                          tok0: int) -> None:
+        req = row.req
+        req.first_token_t = time.monotonic()
+        row.last_t = req.first_token_t
+        row.tokens = [tok0]
+        row.n_done = 1
         self._toks[slot] = tok0
-        self._curs[slot] = prompt.size
+        self._curs[slot] = row.s_prompt
         self._active[slot] = True
-        self._isq[slot] = side == "q8"
-        self._btab[slot] = 0
-        self._btab[slot, :len(table)] = table
-        self._seq_buf[slot] = 0
-        self._seq_buf[slot, :prompt.size] = prompt
-        self._seq_buf[slot, prompt.size] = tok0
-        obs.count("serve.admitted")
-        if first_admission:
-            # re-admissions keep the first admit_t (the SLO record is
-            # per-request) and must not re-emit its stale wait sample
-            obs.observe("serve.queue_wait_ms",
-                        (req.admit_t - req.arrival_t) * 1e3)
+        self._seq_buf[slot, row.s_prompt] = tok0
+        if self.serve.drafter == "suffix" and self.serve.speculate_k > 1:
+            sam = SuffixAutomaton()
+            for t in self._seq_buf[slot, :row.s_prompt + 1]:
+                sam.feed(int(t))
+            self._automata[slot] = sam
+        self._finalize_blocks(slot, row)
         # a 1-token request (or an immediate EOS) finishes at prefill
         if req.n_new <= 1 or tok0 == req.eos_id:
             self._finish(slot)
@@ -612,7 +944,7 @@ class Engine:
         never silently stalled."""
         k = self.serve.speculate_k
         for slot, row in enumerate(self.rows):
-            if row is None:
+            if row is None or row.prefilled < row.s_prompt:
                 continue
             try:
                 added = self.pool.ensure(row.owner, row.shard,
@@ -634,6 +966,12 @@ class Engine:
         B = self.serve.max_rows
         if k == 1:
             return np.zeros((B, 0), np.int32)
+        if self.serve.drafter == "suffix":
+            out = np.zeros((B, k - 1), np.int32)
+            for slot, row in enumerate(self.rows):
+                if row is not None and self._active[slot]:
+                    out[slot] = self._automata[slot].propose(k - 1)
+            return out
         valid = np.ones(B, np.int32)
         for slot, row in enumerate(self.rows):
             if row is not None:
@@ -664,15 +1002,24 @@ class Engine:
             a = np.asarray(a)
             self._toks = np.asarray(newtok).copy()
         self.n_steps += 1
+        now = time.monotonic()
         stepped = self._active.copy()   # rows that ran this step
         self._occ_rows += int(stepped.sum())
         committed = 0
+        feed_sam = (self.serve.drafter == "suffix" and k > 1)
         for slot, row in enumerate(self.rows):
             if row is None or not self._active[slot]:
                 continue
             req = row.req
             self.queue.renew(req.rid, seq=row.seq)
             a_r = int(a[slot])
+            if a_r > 0 and row.n_done < req.n_new:
+                # inter-delivery stall: the span since this row last
+                # committed — whatever co-batched admission work (a
+                # whole-prompt prefill, a chunk) ran in between is IN
+                # this gap, which is what the chunked cap bounds
+                row.max_gap = max(row.max_gap, now - row.last_t)
+                row.last_t = now
             self._curs[slot] += a_r
             take = g[slot, :a_r]
             done = False
@@ -682,14 +1029,15 @@ class Engine:
                     break
                 row.tokens.append(int(t))
                 self._seq_buf[slot, row.s_prompt + row.n_done] = int(t)
+                if feed_sam:
+                    self._automata[slot].feed(int(t))
                 row.n_done += 1
                 committed += 1
                 if row.n_done >= req.n_new or \
                         (req.eos_id is not None and int(t) == req.eos_id):
                     done = True
                     break
-            if self.serve.integrity == "pages":
-                self._seal(slot, row)
+            self._finalize_blocks(slot, row)
             if done:
                 self._finish(slot)
         if k > 1:
@@ -711,23 +1059,47 @@ class Engine:
             obs.gauge("serve.kv.fragmentation",
                       self.pool.fragmentation(used))
 
-    def _seal(self, slot: int, row: _Row) -> None:
-        """Checksum blocks the committed frontier has fully passed.
-        The frontier is the pending token's position (its K/V is not
-        yet written) — everything before it is final."""
-        frontier = int(self._curs[slot])
+    def _finalize_blocks(self, slot: int, row: _Row) -> None:
+        """Seal (integrity) and content-register (prefix cache) every
+        block the committed frontier has fully passed. The frontier is
+        the pending token's position (its K/V is not yet written) —
+        everything before it is final; with a hit, the shared leading
+        blocks arrive already finalized (``row.sealed`` starts past
+        them). Registration is fp-side only — see the module
+        docstring for why quantized pages never enter the index."""
+        integ = self.serve.integrity == "pages"
+        index = self.serve.prefix_cache and row.side == "fp"
+        if not (integ or index):
+            return
         bs = self.serve.block_size
+        # clamp to the RECORDED-token frontier: a speculative window
+        # can accept past n_new (cursor overshoot), leaving positions
+        # whose tokens never entered _seq_buf — a chain hash over
+        # that region would key real K/V under the wrong (zero) token
+        # run and poison the index for future sharers
+        frontier = (min(int(self._curs[slot]),
+                        row.s_prompt + row.n_done)
+                    if row.n_done else row.prefilled)
         table = self.pool.allocators[row.shard].table(row.owner)
         while (row.sealed + 1) * bs <= frontier:
-            self.pool.seal(row.owner, row.shard, row.sealed,
-                           table[row.sealed], side=row.side)
+            j = row.sealed
+            page = table[j]
+            if integ and not self.pool.sealed(row.shard, page):
+                self.pool.seal(row.shard, page, side=row.side)
+            if index:
+                hx, row.chain = chain_extend(
+                    row.chain, self._seq_buf[slot, j * bs:(j + 1) * bs])
+                self.pool.register(row.shard, page, hx)
             row.sealed += 1
 
     def _chaos_pages(self) -> None:
         """The KV-page SDC drill hook: when a plan is armed, probe one
         sealed page per occupied row (deterministic order) and write
         any corruption back into the arena — exactly what a real
-        in-memory flip would look like to the verify path."""
+        in-memory flip would look like to the verify path. With block
+        sharing the probed page may be mapped by several rows: every
+        one of them must then fail its verify (the shared-prefix
+        drill)."""
         if chaos.active() is None or self.serve.integrity != "pages":
             return
         for slot, row in enumerate(self.rows):
@@ -748,11 +1120,12 @@ class Engine:
 
     def _evict(self, slot: int) -> None:
         row = self.rows[slot]
-        self.pool.free(row.owner, row.shard)
+        self.pool.release(row.owner, row.shard)
         self.rows[slot] = None
         self._active[slot] = False
         self._isq[slot] = False
         self._btab[slot] = 0
+        self._automata.pop(slot, None)
 
     def _finish(self, slot: int) -> None:
         row = self.rows[slot]
@@ -760,6 +1133,11 @@ class Engine:
         if self.serve.integrity == "pages":
             bad = self.pool.verify(row.owner, row.shard)
             if bad:
+                # quarantine corrupted pages from the prefix index
+                # BEFORE evicting: no retry (of this or any sharer)
+                # may re-attach the bad content
+                for bi in bad:
+                    self.pool.quarantine(row.owner, row.shard, bi)
                 self._evict(slot)
                 self.queue.fail(req.rid, IntegrityError(
                     f"{req.rid}: sealed KV pages {bad} failed "
@@ -767,12 +1145,16 @@ class Engine:
                 obs.count("serve.integrity_failures")
                 return
         self._evict(slot)
+        if row.n_done > 1:
+            req.max_gap_ms = row.max_gap * 1e3
         if self.queue.complete(req.rid, row.tokens, seq=row.seq):
             slo = req.slo()
             if "ttft_ms" in slo:
                 obs.observe("serve.ttft_ms", slo["ttft_ms"])
             if "tpot_ms" in slo:
                 obs.observe("serve.tpot_ms", slo["tpot_ms"])
+            if "max_gap_ms" in slo:
+                obs.observe("serve.max_gap_ms", slo["max_gap_ms"])
 
     # -- the loop ----------------------------------------------------
 
@@ -785,7 +1167,11 @@ class Engine:
         while True:
             self.queue.reap_expired()
             self._admit()
+            self._advance_prefill()
             if not self._active.any():
+                if any(r is not None and r.prefilled < r.s_prompt
+                       for r in self.rows):
+                    continue        # prefill still streaming
                 if not drain or self.queue.drained():
                     break
                 wait = self.queue.next_visible_in()
@@ -811,12 +1197,26 @@ class Engine:
             return 0.0
         return self._occ_rows / (self.n_steps * self.serve.max_rows)
 
+    def prefix_stats(self) -> dict:
+        """Prefix-cache effectiveness counters for this engine's
+        lifetime (bench records carry these)."""
+        return {
+            **self._prefix,
+            "evictions": sum(a.n_evictions
+                             for a in self.pool.allocators),
+            "cached_blocks": sum(a.n_cached
+                                 for a in self.pool.allocators),
+            "chunk_programs": len(self._chunk_fns),
+        }
+
     def reset_stats(self) -> None:
         """Zero the step/occupancy accumulators — the bench calls this
         after its warm-up run so committed occupancy/steps figures
         describe the measured traffic only."""
         self.n_steps = 0
         self._occ_rows = 0
+        self._prefix = {"hits": 0, "misses": 0, "hit_tokens": 0,
+                        "full_hits": 0, "cow": 0}
 
     # -- convenience -------------------------------------------------
 

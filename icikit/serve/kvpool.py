@@ -13,33 +13,43 @@ gathers each row's blocks back into a contiguous view under a per-row
 causal mask — so physical placement is arbitrary while the math stays
 the ``_DecodeCtx`` math, token-identically.
 
-Two layers, deliberately separable:
+Round 11 grows the pool **prefix-aware** (the vLLM block-sharing /
+SGLang radix-reuse move on this machinery):
 
-- :class:`BlockAllocator` — pure host-side metadata: a free list over
-  block ids plus per-request block tables. No device state, so the
-  property/fuzz suite (``tests/test_kvpool.py``) can hammer random
-  alloc/extend/free interleavings and assert the invariants (live
-  blocks never alias, the free list conserves capacity, exhaustion
-  raises :class:`PoolExhausted` without partial allocation) at high
-  iteration counts.
-- :class:`KVPool` — the device arena: per-layer K and V buffers of
-  shape ``(dp, n_blocks + 1, block_size, kv_heads, d_head)`` sharded
-  ``P(dp, None, None, tp, None)``, one :class:`BlockAllocator` per dp
-  shard (rows on shard *s* allocate from shard *s*'s block space), and
-  occupancy/fragmentation gauges on the obs bus.
+- blocks are **refcounted**: ``share`` attaches an existing block to a
+  second owner's table instead of copying it, ``release`` decrements
+  and only a refcount-0 block leaves circulation. K/V at a position is
+  a pure function of the token prefix, so two requests whose prompts
+  agree on a block-aligned prefix can serve attention from the *same*
+  physical pages;
+- a **content-addressed index** maps chain hashes of full-block token
+  runs to resident pages. The chain (``h_j = H(h_{j-1}, tokens_j,
+  side)``) makes a flat dict equivalent to a radix trie over block
+  paths: an entry's key commits to its entire prefix, so the longest
+  cached prefix is the longest chain of consecutive hits. Entries are
+  side-aware — an int8 block never serves an fp reader;
+- **copy-on-write**: a block with refcount > 1 is immutable; a writer
+  must ``cow`` it first (fresh page, device copy, table swap). A
+  partially-filled tail block is never shared — only full, finalized
+  blocks enter the index;
+- refcount-0 blocks whose content is indexed are retained in an **LRU
+  cached set** rather than freed; allocation takes free pages first
+  and evicts cached pages (dropping their index entries) only under
+  pressure. :class:`PoolExhausted` now means live + cached together
+  cannot satisfy the request.
 
 Block 0 of every shard is the **trash block**: engine rows that are
-inactive (empty slots) still execute the step program — their writes
-are routed to block 0, whose contents are garbage by contract and are
-never read unmasked. Allocations therefore hand out ids from
-``[1, n_blocks]``.
+inactive (empty slots, padded chunk positions) still execute the step
+program — their writes are routed to block 0, whose contents are
+garbage by contract and are never read unmasked. Allocations therefore
+hand out ids from ``[1, n_blocks]``.
 
-Integrity: the pool can remember a checksum per *sealed* block (every
-slot committed — the engine seals block ``j`` of a request once its
-committed frontier passes ``(j + 1) * block_size``) and re-verify the
-request's sealed blocks later; a mismatch is the detection mechanism
-behind the KV-page corruption chaos drill (a corrupted page fails its
-*owning* request only — co-batched requests never gather it).
+Integrity: sealed-page checksums are keyed by ``(shard, page)`` — a
+property of the *content*, not of one owner — so every request whose
+table maps a shared page re-verifies the same digest, and one
+corrupted shared page is detected by every reader. The engine
+quarantines such a page from the index so retries re-prefill on fresh
+blocks (drilled in ``tests/test_serve_chaos.py``).
 """
 
 from __future__ import annotations
@@ -48,36 +58,82 @@ import collections
 import hashlib
 import threading
 
+import numpy as np
+
 from icikit import obs
 
 
 class PoolExhausted(RuntimeError):
-    """The free list cannot satisfy an allocation.
+    """The free list + evictable cached blocks cannot satisfy an
+    allocation.
 
     Loud by design: silent admission of a request the pool cannot hold
     would stall every co-batched request behind an un-extendable row.
     The engine's policy on catching this is preempt-and-requeue, not
     crash — but the *allocator* never hands out partial allocations.
+    ``free`` counts every reclaimable page (free list + refcount-0
+    cached): only *live* blocks are unreclaimable.
     """
 
     def __init__(self, requested: int, free: int, capacity: int):
         super().__init__(
             f"KV pool exhausted: requested {requested} blocks, "
-            f"{free} free of {capacity}")
+            f"{free} reclaimable of {capacity}")
         self.requested = requested
         self.free = free
         self.capacity = capacity
 
 
+def chain_seed(side: str = "fp") -> bytes:
+    """The chain-hash seed (block -1 state) for one arena side."""
+    return side.encode()
+
+
+def chain_extend(prev: bytes, tokens) -> tuple:
+    """Extend a chain-hash state by ONE full block of tokens; returns
+    ``(hexdigest, digest)`` — the index key and the next chain state.
+    O(block) per call, which is what lets the engine finalize block
+    ``j`` without re-hashing blocks ``0..j-1``."""
+    h = hashlib.blake2b(digest_size=16)
+    h.update(prev)
+    h.update(np.ascontiguousarray(
+        np.asarray(tokens, np.int32)).tobytes())
+    return h.hexdigest(), h.digest()
+
+
+def block_hashes(tokens, block_size: int, side: str = "fp") -> list:
+    """Chain hashes of every FULL block of ``tokens`` — the
+    content-address of the prefix index. ``h_j`` commits to blocks
+    ``0..j`` (and the arena side), so a dict over these hashes is a
+    radix trie over block paths: matching ``h_j`` implies the whole
+    prefix matched."""
+    toks = np.ascontiguousarray(np.asarray(tokens, np.int32)).reshape(-1)
+    out = []
+    prev = chain_seed(side)
+    for j in range(toks.size // block_size):
+        hx, prev = chain_extend(
+            prev, toks[j * block_size:(j + 1) * block_size])
+        out.append(hx)
+    return out
+
+
 class BlockAllocator:
-    """Free-list allocator over ``n_blocks`` fixed-size blocks.
+    """Refcounted free-list allocator over ``n_blocks`` fixed blocks.
 
     Block ids are ``1..n_blocks`` (0 is the engine's trash block and is
-    never allocated). ``alloc``/``ensure`` are all-or-nothing: on
-    exhaustion they raise :class:`PoolExhausted` with the allocator
-    state unchanged. Thread-safe — the engine is single-threaded today,
-    but the scheduler discipline elsewhere in this repo (``_LeaseQueue``)
-    is that shared metadata takes a lock rather than an assumption.
+    never allocated). Mutations are all-or-nothing: on exhaustion they
+    raise :class:`PoolExhausted` with the allocator state unchanged.
+    Thread-safe — the engine is single-threaded today, but the
+    scheduler discipline elsewhere in this repo (``_LeaseQueue``) is
+    that shared metadata takes a lock rather than an assumption.
+
+    Every page is in exactly one of three places:
+
+    - **live** — refcount >= 1, mapped by >= 1 block table;
+    - **cached** — refcount 0 but content-indexed (``register``), held
+      in LRU order awaiting either a ``share`` (cache hit revives it)
+      or eviction under allocation pressure;
+    - **free** — on the free list, content unknown.
     """
 
     def __init__(self, n_blocks: int, block_size: int):
@@ -89,7 +145,13 @@ class BlockAllocator:
         self.block_size = block_size
         self._free = collections.deque(range(1, n_blocks + 1))
         self._tables: dict = {}          # owner -> list[int]
+        self._refs: dict = {}            # page -> live refcount
+        self._index: dict = {}           # chain hash -> page
+        self._hash_of: dict = {}         # page -> chain hash
+        # refcount-0 pages kept for reuse, LRU -> MRU order
+        self._cached: collections.OrderedDict = collections.OrderedDict()
         self._lock = threading.Lock()
+        self.n_evictions = 0
 
     # -- queries -----------------------------------------------------
 
@@ -99,8 +161,20 @@ class BlockAllocator:
             return len(self._free)
 
     @property
+    def n_cached(self) -> int:
+        with self._lock:
+            return len(self._cached)
+
+    @property
     def n_used(self) -> int:
-        return self.capacity - self.n_free
+        """LIVE blocks (refcount >= 1). Cached refcount-0 blocks are
+        reclaimable on demand and do not count as used."""
+        with self._lock:
+            return len(self._refs)
+
+    def refcount(self, page: int) -> int:
+        with self._lock:
+            return self._refs.get(page, 0)
 
     def owners(self) -> tuple:
         with self._lock:
@@ -111,17 +185,41 @@ class BlockAllocator:
         with self._lock:
             return tuple(self._tables.get(owner, ()))
 
+    def indexed(self, h: str):
+        """The page registered under chain hash ``h`` (None = miss)."""
+        with self._lock:
+            return self._index.get(h)
+
     # -- mutation ----------------------------------------------------
 
+    def _take(self, n: int) -> list:
+        """Pop ``n`` pages (free list first, then LRU-evict cached),
+        lock held. All-or-nothing; evicted pages lose their index
+        entry. Returns the pages; caller assigns refcounts."""
+        if n > len(self._free) + len(self._cached):
+            raise PoolExhausted(
+                n, len(self._free) + len(self._cached), self.capacity)
+        got = []
+        while len(got) < n and self._free:
+            got.append(self._free.popleft())
+        while len(got) < n:
+            page, _ = self._cached.popitem(last=False)   # LRU victim
+            h = self._hash_of.pop(page)
+            del self._index[h]
+            self.n_evictions += 1
+            got.append(page)
+        return got
+
     def alloc(self, owner, n: int) -> tuple:
-        """Append ``n`` fresh blocks to ``owner``'s table; returns the
-        new block ids. All-or-nothing on exhaustion."""
+        """Append ``n`` fresh exclusive blocks to ``owner``'s table;
+        returns the new block ids. All-or-nothing on exhaustion; may
+        evict LRU cached blocks under pressure."""
         if n < 0:
             raise ValueError(f"n must be >= 0, got {n}")
         with self._lock:
-            if n > len(self._free):
-                raise PoolExhausted(n, len(self._free), self.capacity)
-            got = [self._free.popleft() for _ in range(n)]
+            got = self._take(n)
+            for p in got:
+                self._refs[p] = 1
             self._tables.setdefault(owner, []).extend(got)
         return tuple(got)
 
@@ -133,19 +231,157 @@ class BlockAllocator:
         return self.alloc(owner, max(0, need - have)) if need > have \
             else ()
 
-    def free(self, owner) -> int:
-        """Release every block owned by ``owner`` back to the free
-        list; returns how many. Unknown owners free 0 (idempotent —
-        a retried eviction must not corrupt the free list)."""
+    def share(self, owner, pages) -> None:
+        """Append existing ``pages`` to ``owner``'s table, bumping
+        refcounts — the cache-hit attach. A cached (refcount-0) page
+        revives to live; pages must be live or cached (a free-list
+        page has unknown content and cannot be shared)."""
         with self._lock:
-            blocks = self._tables.pop(owner, [])
-            self._free.extend(blocks)
-            return len(blocks)
+            for p in pages:
+                if self._refs.get(p, 0) == 0 and p not in self._cached:
+                    raise ValueError(
+                        f"cannot share page {p}: neither live nor "
+                        "cached")
+            t = self._tables.setdefault(owner, [])
+            for p in pages:
+                self._cached.pop(p, None)
+                self._refs[p] = self._refs.get(p, 0) + 1
+                t.append(p)
+
+    def release(self, owner) -> tuple:
+        """Drop every reference ``owner`` holds; returns ``(n_released,
+        pages_freed)`` where ``pages_freed`` are the pages that left
+        circulation entirely (refcount hit 0 and no index entry keeps
+        them cached) — the pool drops their seals. Unknown owners
+        release 0 (idempotent — a retried eviction must not corrupt
+        the free list)."""
+        freed = []
+        with self._lock:
+            pages = self._tables.pop(owner, [])
+            # cache in REVERSE table order so the chain root lands at
+            # the MRU end: LRU eviction then takes the deepest block
+            # first, and a truncated chain stays walkable from its
+            # root instead of orphaning its tail (see lookup)
+            for p in reversed(pages):
+                self._refs[p] -= 1
+                if self._refs[p]:
+                    continue
+                del self._refs[p]
+                if p in self._hash_of:
+                    self._cached[p] = None      # MRU end
+                else:
+                    self._free.append(p)
+                    freed.append(p)
+        return len(pages), freed
+
+    def free(self, owner) -> int:
+        """Back-compat shim over :meth:`release` (single-owner call
+        sites and the property suite predate sharing)."""
+        return self.release(owner)[0]
+
+    def cow(self, owner, index: int):
+        """Copy-on-write guard for ``owner``'s table entry ``index``:
+        a block mapped by other owners (refcount > 1) is swapped for a
+        fresh exclusive page; returns ``(old_page, new_page)`` so the
+        pool can copy the device bytes, or None when the block is
+        already exclusive (no fork needed). The fork is NOT indexed —
+        its content address stays with the original."""
+        with self._lock:
+            table = self._tables.get(owner)
+            if table is None or not 0 <= index < len(table):
+                raise ValueError(f"cow: no block {index} for {owner!r}")
+            old = table[index]
+            if self._refs[old] <= 1:
+                return None
+            [new] = self._take(1)
+            self._refs[old] -= 1
+            self._refs[new] = 1
+            table[index] = new
+        return old, new
+
+    # -- prefix index ------------------------------------------------
+
+    def lookup(self, hashes) -> list:
+        """Longest chain of consecutively-indexed pages for ``hashes``
+        (the block-aligned cached prefix). Touches hits to MRU in
+        DEEPEST-first order, leaving the chain ROOT most recent:
+        lookup can only walk a chain from its root, so evicting a
+        root orphans every deeper cached block of that prefix —
+        victims must come leaf-first (the radix-cache discipline)."""
+        out = []
+        with self._lock:
+            for h in hashes:
+                p = self._index.get(h)
+                if p is None:
+                    break
+                out.append(p)
+            for p in reversed(out):
+                if p in self._cached:
+                    self._cached.move_to_end(p)
+        return out
+
+    def register(self, page: int, h: str) -> bool:
+        """Content-address a LIVE page. First registration wins: a
+        duplicate hash (same content already resident) or an
+        already-hashed page is refused — the duplicate page simply
+        stays anonymous and is freed on release."""
+        with self._lock:
+            if h in self._index or page in self._hash_of:
+                return False
+            if self._refs.get(page, 0) < 1:
+                raise ValueError(
+                    f"register: page {page} is not live")
+            self._index[h] = page
+            self._hash_of[page] = h
+            return True
+
+    def deregister(self, page: int) -> bool:
+        """Remove a page's index entry (the corruption quarantine): no
+        new request can share it, and once its refcount drains it goes
+        to the free list instead of the cached set."""
+        with self._lock:
+            h = self._hash_of.pop(page, None)
+            if h is None:
+                return False
+            del self._index[h]
+            if page in self._cached:
+                del self._cached[page]
+                self._free.append(page)
+            return True
+
+
+_COPY_FN = None
+
+
+def _page_copy(buf, shard: int, old: int, new: int):
+    """Copy one physical page within an arena buffer via a donated
+    jitted program: donation lets XLA update the buffer in place, so
+    forking one block costs one page of traffic — not a full-arena
+    materialization per layer per arena (jit caches one executable
+    per (shape, dtype, sharding); indices are traced)."""
+    global _COPY_FN
+    if _COPY_FN is None:
+        import jax
+
+        def cp(b, s, o, n):
+            zeros = (0,) * (b.ndim - 2)
+            page = jax.lax.dynamic_slice(
+                b, (s, o) + zeros, (1, 1) + b.shape[2:])
+            return jax.lax.dynamic_update_slice(
+                b, page, (s, n) + zeros)
+
+        _COPY_FN = jax.jit(cp, donate_argnums=(0,))
+    import jax.numpy as jnp
+    i32 = jnp.int32
+    return _COPY_FN(buf, i32(shard), i32(old), i32(new))
 
 
 def _page_digest(arrays) -> str:
     """Checksum of one block's K and V content across layers (host
-    bytes in layer order) — the sealed-page integrity fingerprint."""
+    bytes in layer order) — the sealed-page integrity fingerprint. On
+    the q8 side the array list interleaves the quantized payload AND
+    its scale pages: a flipped scale corrupts decoded tokens exactly
+    like a flipped int8 byte, so it must flip the digest too."""
     h = hashlib.blake2b(digest_size=16)
     for a in arrays:
         h.update(a.tobytes())
@@ -177,6 +413,8 @@ class KVPool:
     Sealing checksums the payload a request actually serves from: the
     int8 side hashes the quantized blocks AND their scale pages (a
     flipped scale corrupts tokens exactly like a flipped int8 byte).
+    Seals are keyed ``(shard, page)`` — shared pages carry ONE digest
+    every reader re-verifies.
     """
 
     SIDES = ("fp", "q8")
@@ -228,8 +466,9 @@ class KVPool:
                              for _ in range(L))
         self.allocators = tuple(BlockAllocator(n_blocks, block_size)
                                 for _ in range(self.dp))
-        # (owner, shard, block_index_in_table) -> (side, digest) of the
-        # sealed page's payload bytes across layers
+        # (shard, page) -> (side, digest) of the sealed page's payload
+        # bytes across layers — content-keyed so shared pages carry
+        # exactly one digest that every reader re-verifies
         self._seals: dict = {}
         self._gauges()
 
@@ -310,52 +549,123 @@ class KVPool:
 
     # -- sealing / integrity -----------------------------------------
 
-    def seal(self, owner, shard: int, block_index: int, page: int,
+    def seal(self, shard: int, page: int,
              side: str | None = None) -> None:
         """Record the checksum of a just-completed (fully committed)
-        block so :meth:`verify` can detect later corruption."""
+        block so :meth:`verify` can detect later corruption. Keyed by
+        content location, not owner — every sharer verifies the same
+        digest."""
         side = side or self._default_side()
-        self._seals[(owner, shard, block_index)] = (
+        self._seals[(shard, page)] = (
             side, _page_digest(self.page_bytes(shard, page, side)))
 
+    def sealed(self, shard: int, page: int) -> bool:
+        return (shard, page) in self._seals
+
     def verify(self, owner, shard: int) -> list:
-        """Re-hash every sealed block of ``owner`` against its recorded
-        digest; returns the list of block indices that FAIL (empty ==
-        intact)."""
+        """Re-hash every sealed page in ``owner``'s table against its
+        recorded digest; returns the list of block indices that FAIL
+        (empty == intact)."""
         table = self.allocators[shard].table(owner)
         bad = []
-        for (o, s, bi), (side, digest) in self._seals.items():
-            if o != owner or s != shard:
+        for bi, page in enumerate(table):
+            rec = self._seals.get((shard, page))
+            if rec is None:
                 continue
-            if bi >= len(table):
-                continue
+            side, digest = rec
             if _page_digest(
-                    self.page_bytes(s, table[bi], side)) != digest:
+                    self.page_bytes(shard, page, side)) != digest:
                 bad.append(bi)
-        return sorted(bad)
+        return bad
 
-    def drop_seals(self, owner, shard: int) -> None:
-        self._seals = {k: v for k, v in self._seals.items()
-                       if not (k[0] == owner and k[1] == shard)}
+    def _drop_seal(self, shard: int, page: int) -> None:
+        self._seals.pop((shard, page), None)
 
     # -- bookkeeping shared with the engine --------------------------
 
-    def free(self, owner, shard: int) -> int:
-        """Release the owner's blocks (and seals) on one shard."""
-        self.drop_seals(owner, shard)
-        n = self.allocators[shard].free(owner)
+    def release(self, owner, shard: int) -> int:
+        """Drop the owner's references on one shard. Pages that leave
+        circulation (refcount 0 and unindexed) lose their seals;
+        cached pages KEEP theirs — a later sharer re-verifies the same
+        digest."""
+        n, freed = self.allocators[shard].release(owner)
+        for p in freed:
+            self._drop_seal(shard, p)
         self._gauges()
         return n
 
+    # back-compat name (pre-sharing call sites)
+    free = release
+
     def ensure(self, owner, shard: int, n_tokens: int) -> tuple:
         added = self.allocators[shard].ensure(owner, n_tokens)
+        for p in added:
+            # a freshly handed-out page may be a recycled one — any
+            # stale digest from its previous life must not survive
+            self._drop_seal(shard, p)
         if added:
             self._gauges()
         return added
 
+    def share(self, owner, shard: int, pages) -> None:
+        self.allocators[shard].share(owner, pages)
+        self._gauges()
+
+    def lookup(self, shard: int, hashes) -> list:
+        return self.allocators[shard].lookup(hashes)
+
+    def register(self, shard: int, page: int, h: str) -> bool:
+        return self.allocators[shard].register(page, h)
+
+    def quarantine(self, owner, shard: int, block_index: int) -> bool:
+        """Evict one of ``owner``'s pages from the prefix index (the
+        verify-failure path): no future admission can share the
+        corrupted content, and the page drains to the free list once
+        its current readers release. Idempotent."""
+        table = self.allocators[shard].table(owner)
+        if not 0 <= block_index < len(table):
+            return False
+        out = self.allocators[shard].deregister(table[block_index])
+        if out:
+            obs.count("serve.prefix.quarantined")
+        return out
+
+    def cow(self, owner, shard: int, block_index: int,
+            side: str | None = None):
+        """Copy-on-write fork of a shared page: fresh exclusive page,
+        device copy of the page's bytes, seal carried over (the copy
+        IS the sealed content — a caller that then writes different
+        bytes must re-seal). ``side`` restricts the copy to the
+        arenas that actually serve the forking row (sharing is
+        fp-only today, so a mixed engine's fork need not touch the
+        q8 arenas); None copies every arena. Returns ``(old, new)``
+        or None when the page was already exclusive."""
+        pair = self.allocators[shard].cow(owner, block_index)
+        if pair is None:
+            return None
+        old, new = pair
+        names = {"fp": ("kc", "vc"),
+                 "q8": ("qkc", "qvc", "ksc", "vsc")}.get(
+            side, ("kc", "vc", "qkc", "qvc", "ksc", "vsc"))
+        for name in names:
+            bufs = getattr(self, name)
+            if bufs is None:
+                continue
+            setattr(self, name, tuple(
+                _page_copy(b, shard, old, new) for b in bufs))
+        rec = self._seals.get((shard, old))
+        if rec is not None:
+            self._seals[(shard, new)] = rec
+        else:
+            self._drop_seal(shard, new)
+        obs.count("serve.prefix.cow")
+        self._gauges()
+        return pair
+
     def occupancy(self) -> float:
-        """Fraction of allocatable blocks currently owned (mean over
-        dp shards)."""
+        """Fraction of allocatable blocks currently LIVE (mean over dp
+        shards). Cached refcount-0 blocks are reclaimable on demand
+        and do not count."""
         used = sum(a.n_used for a in self.allocators)
         return used / (self.n_blocks * self.dp)
 
@@ -378,3 +688,5 @@ class KVPool:
         obs.gauge("serve.kv.occupancy", self.occupancy())
         obs.gauge("serve.kv.blocks_free",
                   sum(a.n_free for a in self.allocators))
+        obs.gauge("serve.kv.blocks_cached",
+                  sum(a.n_cached for a in self.allocators))

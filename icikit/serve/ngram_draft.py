@@ -24,6 +24,21 @@ speculative while-loop (``speculative_generate(..., drafter="ngram")``)
 — per-row dynamic suffix lengths, no host sync — and the serving
 engine reuses the same function under a tiny jit wrapper for its
 host-side step loop.
+
+Round 11 adds the **suffix-automaton upgrade**
+(:class:`SuffixAutomaton`): the n-gram matcher caps matches at ``n``
+tokens and rescans the whole buffer per proposal; the automaton
+maintains the *unbounded* longest suffix of the committed stream that
+occurred earlier, online, at O(1) amortized host work per committed
+token — the natural next rung of the ROADMAP 3b drafter ladder. It is
+a host-side data structure (its transitions grow dynamically, which a
+jitted while-loop cannot express), so it serves the engine's host
+step loop (``ServeConfig(drafter="suffix")``); the in-jit
+``speculative_generate`` path keeps the windowed matcher. Matching
+semantics differ only in the drafter's *guess* (longest-then-first
+occurrence vs ``n``-capped-then-latest): token identity is
+unconditional for both, because proposals only ever enter the model
+through the verify-and-accept window.
 """
 
 from __future__ import annotations
@@ -93,6 +108,107 @@ def ngram_propose(seq, valid, k: int, n: int = DEFAULT_N):
         return jnp.where(ml > 0, props, fallback).astype(jnp.int32)
 
     return jax.vmap(row)(seq, valid)
+
+
+class SuffixAutomaton:
+    """Online suffix automaton over a committed token stream, with a
+    delayed-by-one matcher for draft proposals.
+
+    After ``feed(t)`` the matcher state is the longest suffix of the
+    stream-so-far that also occurs *ending strictly earlier* (the feed
+    order — match against the automaton of the stream minus the new
+    token, then extend — guarantees the strictness). ``propose(m)``
+    returns the ``m`` tokens that followed that earlier occurrence,
+    clamped to the committed frontier; with no match it falls back to
+    repeating the last token (a guess like any other, priced
+    identically by the verify window).
+
+    Construction is the classic online SAM (Blumer et al.): each state
+    stores its transition map, suffix link, longest-string length, and
+    the end position of its FIRST occurrence (clones inherit the
+    original's — any end position of the matched class works for
+    reading a continuation). Both feed and the matcher step are O(1)
+    amortized, so per-row drafting cost is constant per committed
+    token — no rescans, no bound ``n`` on the match length.
+    """
+
+    __slots__ = ("_next", "_link", "_len", "_end", "_last", "seq",
+                 "_mstate", "_mlen")
+
+    def __init__(self):
+        self._next = [{}]
+        self._link = [-1]
+        self._len = [0]
+        self._end = [-1]
+        self._last = 0
+        self.seq: list = []
+        self._mstate = 0
+        self._mlen = 0
+
+    def _extend(self, t: int) -> None:
+        pos = len(self.seq) - 1          # t already appended
+        cur = len(self._len)
+        self._next.append({})
+        self._len.append(self._len[self._last] + 1)
+        self._link.append(0)
+        self._end.append(pos)
+        p = self._last
+        while p != -1 and t not in self._next[p]:
+            self._next[p][t] = cur
+            p = self._link[p]
+        if p != -1:
+            q = self._next[p][t]
+            if self._len[p] + 1 == self._len[q]:
+                self._link[cur] = q
+            else:
+                clone = len(self._len)
+                self._next.append(dict(self._next[q]))
+                self._len.append(self._len[p] + 1)
+                self._link.append(self._link[q])
+                self._end.append(self._end[q])
+                while p != -1 and self._next[p].get(t) == q:
+                    self._next[p][t] = clone
+                    p = self._link[p]
+                self._link[q] = clone
+                self._link[cur] = clone
+        self._last = cur
+
+    def feed(self, t: int) -> None:
+        """Commit one token: advance the matcher against the automaton
+        of the PREVIOUS stream (so matches end strictly earlier), then
+        extend the automaton with the token."""
+        t = int(t)
+        st, ln = self._mstate, self._mlen
+        while st != 0 and t not in self._next[st]:
+            st = self._link[st]
+            ln = self._len[st]
+        if t in self._next[st]:
+            st = self._next[st][t]
+            ln += 1
+        else:
+            ln = 0
+        self._mstate, self._mlen = st, ln
+        self.seq.append(t)
+        self._extend(t)
+
+    @property
+    def match_len(self) -> int:
+        """Length of the current longest earlier-occurring suffix."""
+        return self._mlen
+
+    def propose(self, m: int):
+        """``m`` draft tokens continuing the matched occurrence."""
+        import numpy as np
+        v = len(self.seq)
+        if v == 0:
+            return np.zeros(m, np.int32)
+        if self._mlen == 0:
+            return np.full(m, self.seq[-1], np.int32)
+        e = self._end[self._mstate]
+        out = np.empty(m, np.int32)
+        for i in range(m):
+            out[i] = self.seq[min(e + 1 + i, v - 1)]
+        return out
 
 
 @lru_cache(maxsize=None)

@@ -81,6 +81,10 @@ class Request:
     quant: bool = False
     visible_after: float = 0.0   # arrival time (monotonic)
     max_retries: int = 2
+    # prompt positions served from the prefix cache at the (latest)
+    # admission — stamped by the engine so per-request SLO records
+    # carry the cache's contribution next to the latency it bought
+    prefix_hit_tokens: int = 0
     # lifecycle
     state: str = "queued"        # queued|running|done|failed
     attempts: int = 0
@@ -97,6 +101,11 @@ class Request:
     admit_t: float | None = None
     first_token_t: float | None = None
     done_t: float | None = None
+    # worst inter-token stall (ms), stamped by the engine at
+    # completion: mean TPOT dilutes a one-off admission stall over
+    # the whole decode; this is the stall itself — the metric the
+    # chunked-prefill latency cap exists to bound
+    max_gap_ms: float | None = None
 
     def slo(self) -> dict:
         """TTFT / TPOT / queue-wait in ms (None where the phase never
@@ -104,7 +113,8 @@ class Request:
         time after the first token over ``n_generated - 1``."""
         out = {"rid": self.rid, "state": self.state,
                "attempts": self.attempts, "preempted": self.preempted,
-               "n_tokens": len(self.tokens)}
+               "n_tokens": len(self.tokens),
+               "prefix_hit_tokens": self.prefix_hit_tokens}
         if self.admit_t is not None:
             out["queue_wait_ms"] = (self.admit_t - self.arrival_t) * 1e3
         if self.first_token_t is not None:
@@ -113,6 +123,8 @@ class Request:
                 and len(self.tokens) > 1):
             out["tpot_ms"] = ((self.done_t - self.first_token_t)
                               / (len(self.tokens) - 1)) * 1e3
+        if self.max_gap_ms is not None:
+            out["max_gap_ms"] = self.max_gap_ms
         return out
 
 

@@ -45,3 +45,48 @@ def test_serve_bench_speculative_mode():
     assert cont["completed"] == 4 and cont["failed"] == 0
     # ngram verify windows commit >= 1 token per row-step
     assert cont["tokens_per_step_row"] >= 1.0
+
+
+def test_shared_prefix_workload_shape():
+    w = make_workload(6, 10.0, 12, 4, 8, vocab=61, seed=5,
+                      prefix_len=8)
+    first = w[0][1]
+    for _, p, _ in w:
+        np.testing.assert_array_equal(p[:8], first[:8])
+    # suffixes actually vary
+    assert len({tuple(p[8:]) for _, p, _ in w}) > 1
+    # prefix == prompt -> fully repeated prompts
+    w2 = make_workload(4, 10.0, 8, 4, 8, vocab=61, seed=5,
+                       prefix_len=8)
+    assert len({tuple(p) for _, p, _ in w2}) == 1
+    import pytest
+    with pytest.raises(ValueError, match="prefix_len"):
+        make_workload(4, 10.0, 8, 4, 8, vocab=61, prefix_len=9)
+
+
+def test_serve_bench_prefix_cache_arms_and_identity_audit():
+    """The r11 A/B shape at smoke scale: cache-on row records hits +
+    a clean identity audit; cache-off row records a cold path. Runs
+    the CPU-fp32 protocol — on XLA:CPU the bf16 engine-vs-generate
+    comparison diverges for the per-call weight-repack reason the r9
+    docs record (pre-existing; the committed rows are fp32)."""
+    from icikit.bench.serve import run_bench
+    on = run_bench("tiny", rows=2, n_requests=5, rate_rps=100.0,
+                   prompt_len=12, new_min=4, new_max=6,
+                   block_size=4, seed=3, mode="continuous",
+                   compute_dtype="float32",
+                   prefix_len=8, prefix_cache=True, prefill_chunk=8,
+                   verify=True)[0]
+    off = run_bench("tiny", rows=2, n_requests=5, rate_rps=100.0,
+                    prompt_len=12, new_min=4, new_max=6,
+                    block_size=4, seed=3, mode="continuous",
+                    compute_dtype="float32",
+                    prefix_len=8, prefix_cache=False, prefill_chunk=8,
+                    verify=True)[0]
+    assert on["prefix_cache"] and not off["prefix_cache"]
+    assert on["prefix"]["hits"] == 5 and on["prefix"]["hit_tokens"] \
+        == 5 * 8
+    assert off["prefix"]["hits"] == 0
+    for r in (on, off):
+        assert r["identity_ok"] and r["identity_checked"] == 5
+        assert r["completed"] == 5 and r["failed"] == 0

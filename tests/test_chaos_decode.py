@@ -61,7 +61,9 @@ def test_spec_prefill_and_drafter_die_sites():
     # second call gets there
     plan = chaos.FaultPlan(schedule={
         "die:decode.spec.prefill": (0,),
-        "die:decode.spec.drafter.shared": (0,),
+        # the default no-head drafter is "ngram" as of the r11 flip —
+        # the drill follows the shipped default's site name
+        "die:decode.spec.drafter.ngram": (0,),
     })
     with chaos.inject(plan):
         with pytest.raises(chaos.InjectedDeath):
@@ -125,4 +127,5 @@ def test_spec_delay_sites_fire_without_changing_output():
         out = speculative_generate(params, pd, mesh, CFG, 6, k=3)
     np.testing.assert_array_equal(np.asarray(out), base)
     assert plan.fired("delay", "decode.spec.prefill") == 1
-    assert plan.fired("delay", "decode.spec.drafter.shared") == 1
+    # default drafter post-r11-flip: ngram
+    assert plan.fired("delay", "decode.spec.drafter.ngram") == 1

@@ -16,7 +16,11 @@ invariants that make paged attention safe:
 import numpy as np
 import pytest
 
-from icikit.serve.kvpool import BlockAllocator, PoolExhausted
+from icikit.serve.kvpool import (
+    BlockAllocator,
+    PoolExhausted,
+    block_hashes,
+)
 
 
 def _check_invariants(a: BlockAllocator):
@@ -110,15 +114,17 @@ def test_kvpool_seal_verify_detects_poke():
     table = pool.allocators[0].alloc("req", 2)
     # write something nonzero into both pages, then seal them
     data = np.arange(4 * 2 * 8, dtype=np.float32).reshape(4, 2, 8)
-    for bi, page in enumerate(table):
-        pool.poke_page(0, page, 0, data + bi)
-        pool.seal("req", 0, bi, page)
+    for page in table:
+        pool.poke_page(0, page, 0, data + page)
+        pool.seal(0, page)
     assert pool.verify("req", 0) == []
     flipped = np.array(data)
     flipped[0, 0, 0] += 1.0
     pool.poke_page(0, table[1], 0, flipped + 1)
     assert pool.verify("req", 0) == [1]
-    pool.drop_seals("req", 0)
+    # seals are content-keyed: releasing the owner frees the pages
+    # (unindexed) and drops their digests with them
+    pool.release("req", 0)
     assert pool.verify("req", 0) == []
 
 
@@ -204,7 +210,7 @@ def test_kvpool_int8_seal_covers_scales():
     table = pool.allocators[0].alloc("req", 1)
     data = np.arange(4 * 2 * 8, dtype=np.int8).reshape(4, 2, 8)
     pool.poke_page(0, table[0], 0, data)
-    pool.seal("req", 0, 0, table[0])
+    pool.seal(0, table[0])
     assert pool.verify("req", 0) == []
     vsc = list(pool.vsc)
     vsc[1] = vsc[1].at[0, table[0], 2, 1].set(3.25)
@@ -220,3 +226,281 @@ def test_kvpool_rejects_unknown_quant():
     with pytest.raises(ValueError, match="unknown pool quant"):
         KVPool(_tiny_cfg(), mesh, n_blocks=4, block_size=4,
                quant="fp8")
+
+
+# ---------------------------------------------------------------- r11:
+# refcounted sharing, the content-addressed prefix index, CoW, LRU
+# eviction — the allocator invariants that make PREFIX-SHARED paged
+# attention safe (ISSUE 8).
+
+
+def _check_sharing_invariants(a: BlockAllocator):
+    """The refcount-world conservation laws:
+
+    - every page is in exactly one of {free, cached, live};
+    - a page's refcount equals its total table occurrences;
+    - cached pages are content-indexed (that is what keeps them);
+    - free + cached + distinct-live == capacity.
+    """
+    from collections import Counter
+    occ = Counter()
+    for o in a.owners():
+        occ.update(a.table(o))
+    with a._lock:
+        free = list(a._free)
+        cached = list(a._cached)
+        refs = dict(a._refs)
+        hashed = set(a._hash_of)
+        index = dict(a._index)
+    assert refs == dict(occ), "refcounts drifted from table occupancy"
+    live = set(refs)
+    assert not live & set(free), "live page on the free list"
+    assert not live & set(cached), "live page in the cached set"
+    assert not set(free) & set(cached), "page both free and cached"
+    assert set(cached) <= hashed, "cached page without an index entry"
+    assert len(free) + len(cached) + len(live) == a.capacity, \
+        "capacity not conserved across free/cached/live"
+    assert all(1 <= p <= a.capacity
+               for p in list(live) + free + cached), \
+        "page id outside [1, capacity] (trash block 0 leaked?)"
+    assert set(index.values()) <= live | set(cached), \
+        "index maps a free-list page"
+
+
+def test_block_hashes_chain_is_prefix_consistent():
+    toks = np.arange(20, dtype=np.int32)
+    h_full = block_hashes(toks, 4)
+    assert len(h_full) == 5
+    # the chain property that makes the flat dict a radix trie: the
+    # hashes of a prefix ARE the prefix of the hashes
+    assert block_hashes(toks[:12], 4) == h_full[:3]
+    # ...and diverging one token past a block boundary changes only
+    # the later hashes
+    other = toks.copy()
+    other[13] += 1
+    ho = block_hashes(other, 4)
+    assert ho[:3] == h_full[:3] and ho[3:] != h_full[3:]
+    # side-aware: an int8 block never answers an fp lookup
+    assert block_hashes(toks, 4, side="q8") != h_full
+    # only FULL blocks hash (the partial tail is never shareable)
+    assert len(block_hashes(toks[:11], 4)) == 2
+
+
+def test_share_revives_cached_and_release_caches_indexed():
+    a = BlockAllocator(8, 4)
+    t = a.alloc("A", 2)
+    hs = ["h0", "h1"]
+    for p, h in zip(t, hs):
+        assert a.register(p, h)
+    n, freed = a.release("A")
+    assert n == 2 and freed == []          # indexed -> cached, not freed
+    assert a.n_cached == 2 and a.n_used == 0 and a.n_free == 6
+    _check_sharing_invariants(a)
+    # lookup walks the chain; share revives to live
+    assert a.lookup(hs) == list(t)
+    assert a.lookup(["h0", "WRONG"]) == [t[0]]   # chain stops at miss
+    a.share("B", t)
+    assert a.n_cached == 0 and a.refcount(t[0]) == 1
+    a.share("C", t)
+    assert a.refcount(t[0]) == 2
+    _check_sharing_invariants(a)
+    # releases peel refcounts; last one re-caches
+    a.release("B")
+    assert a.refcount(t[0]) == 1 and a.n_cached == 0
+    a.release("C")
+    assert a.n_cached == 2
+    _check_sharing_invariants(a)
+
+
+def test_cow_forks_only_shared_blocks():
+    a = BlockAllocator(8, 4)
+    t = a.alloc("A", 2)
+    assert a.register(t[0], "h0")
+    a.share("B", [t[0]])
+    # exclusive block: no fork
+    assert a.cow("A", 1) is None
+    # shared block: B forks, tables stop aliasing, refcounts settle
+    pair = a.cow("B", 0)
+    assert pair is not None
+    old, new = pair
+    assert old == t[0] and new not in t
+    assert a.table("B") == (new,) and a.table("A") == t
+    assert a.refcount(old) == 1 and a.refcount(new) == 1
+    # the fork is anonymous: the content address stays with the
+    # original, so the fork frees (not caches) on release
+    _, freed = a.release("B")
+    assert freed == [new]
+    _check_sharing_invariants(a)
+
+
+def test_lru_eviction_under_pressure_and_honest_exhaustion():
+    a = BlockAllocator(4, 4)
+    t = a.alloc("A", 4)
+    for i, p in enumerate(t):
+        a.register(p, f"h{i}")
+    a.release("A")
+    assert a.n_cached == 4 and a.n_free == 0
+    # touch h2's chain position -> h0 stays LRU... lookup touches the
+    # pages it returns, so look up the chain prefix ending at h1
+    a.lookup(["h0", "h1"])
+    # allocation evicts the LRU cached pages (h2, h3 were untouched
+    # longest? no: insertion order h0..h3, lookup revived h0,h1 to MRU
+    # -> LRU victims are h2 then h3)
+    got = a.alloc("B", 2)
+    assert set(got) == {t[2], t[3]}
+    assert a.n_evictions == 2
+    assert a.indexed("h2") is None and a.indexed("h0") == t[0]
+    _check_sharing_invariants(a)
+    # exhaustion counts reclaimable (free + cached), not just free
+    with pytest.raises(PoolExhausted) as ei:
+        a.alloc("B", 3)
+    assert ei.value.free == 2              # the two cached survivors
+    _check_sharing_invariants(a)
+    # live blocks pin: share a cached page, then over-ask
+    a.share("C", [t[0]])
+    with pytest.raises(PoolExhausted):
+        a.alloc("D", 2)                    # only h1 reclaimable now
+    _check_sharing_invariants(a)
+
+
+def test_deregister_quarantines_from_reuse():
+    a = BlockAllocator(4, 4)
+    [p] = a.alloc("A", 1)
+    a.register(p, "h")
+    # live quarantine: index entry gone, page drains to FREE on release
+    assert a.deregister(p)
+    assert not a.deregister(p)             # idempotent
+    assert a.indexed("h") is None
+    _, freed = a.release("A")
+    assert freed == [p]
+    _check_sharing_invariants(a)
+    # cached quarantine: page moves cached -> free immediately
+    [p2] = a.alloc("B", 1)
+    a.register(p2, "h2")
+    a.release("B")
+    assert a.n_cached == 1
+    assert a.deregister(p2)
+    assert a.n_cached == 0 and a.n_free == 4
+    _check_sharing_invariants(a)
+
+
+def test_refcount_cow_property_fuzz():
+    """Random interleavings of the FULL r11 allocator surface —
+    alloc/ensure/release/register/lookup+share/cow — holding the
+    sharing conservation laws at every step, ending in a drained
+    allocator at full capacity. The classic invariants (no aliasing
+    WITHIN the exclusive world, honest exhaustion) ride along via the
+    refcount==occupancy law."""
+    rng = np.random.default_rng(11)
+    for trial in range(20):
+        cap = int(rng.integers(6, 32))
+        bs = int(rng.integers(1, 6))
+        a = BlockAllocator(cap, bs)
+        owners = [f"r{i}" for i in range(int(rng.integers(2, 7)))]
+        minted = 0
+        for stepi in range(250):
+            o = owners[int(rng.integers(0, len(owners)))]
+            op = rng.integers(0, 6)
+            try:
+                if op == 0:
+                    a.alloc(o, int(rng.integers(0, 4)))
+                elif op == 1:
+                    a.ensure(o, int(rng.integers(1, cap * bs + 1)))
+                elif op == 2:
+                    a.release(o)
+                elif op == 3:
+                    # register a random owned page under a fresh hash
+                    t = a.table(o)
+                    if t:
+                        p = t[int(rng.integers(0, len(t)))]
+                        a.register(p, f"h{minted}")
+                        minted += 1
+                elif op == 4:
+                    # look up a random known hash chain and share it
+                    if minted:
+                        h = f"h{int(rng.integers(0, minted))}"
+                        pages = a.lookup([h])
+                        if pages:
+                            a.share(o, pages)
+                else:
+                    t = a.table(o)
+                    if t:
+                        idx = int(rng.integers(0, len(t)))
+                        before = a.table(o)[idx]
+                        pair = a.cow(o, idx)
+                        if pair is not None:
+                            old, new = pair
+                            assert old == before
+                            # THE CoW law: after a fork, no other
+                            # owner's table maps the fork
+                            for o2 in a.owners():
+                                if o2 != o:
+                                    assert new not in a.table(o2)
+                            assert a.refcount(new) == 1
+            except PoolExhausted as e:
+                assert e.requested > e.free     # raised honestly
+            _check_sharing_invariants(a)
+        for o in owners:
+            a.release(o)
+        _check_sharing_invariants(a)
+        # drain the cache too: evicting everything returns the pool
+        # to mint condition
+        a.alloc("drain", cap)
+        a.release("drain")
+        assert a.n_free == cap and a.n_cached == 0 and a.n_used == 0
+
+
+def test_pool_cow_copies_device_bytes_and_seal():
+    """KVPool.cow must copy every arena's bytes for the forked page
+    (all layers) and carry the content seal — the fork IS the sealed
+    content until somebody writes it."""
+    import jax
+
+    from icikit.models.transformer import TransformerConfig, init_params
+    from icikit.models.transformer.model import make_model_mesh
+    from icikit.serve.kvpool import KVPool
+
+    del jax, init_params
+    cfg = _tiny_cfg()
+    mesh = make_model_mesh(dp=1, tp=1, sp=1)
+    pool = KVPool(cfg, mesh, n_blocks=8, block_size=4)
+    [p] = pool.allocators[0].alloc("A", 1)
+    data = np.arange(4 * 2 * 8, dtype=np.float32).reshape(4, 2, 8)
+    for li in range(cfg.n_layers):
+        pool.poke_page(0, p, li, data + li)
+    pool.seal(0, p)
+    pool.allocators[0].register(p, "h")
+    pool.share("B", 0, [p])
+    pair = pool.cow("B", 0, 0)
+    assert pair is not None
+    old, new = pair
+    assert old == p
+    for li in range(cfg.n_layers):
+        np.testing.assert_array_equal(pool.read_page(0, new, li),
+                                      pool.read_page(0, old, li))
+    # the fork's seal verifies (content bitwise copied)
+    assert pool.verify("B", 0) == []
+    # ...and diverging the fork fails ONLY the fork's owner
+    bad = np.array(data)
+    bad[0, 0, 0] += 7.0
+    pool.poke_page(0, new, 0, bad)
+    assert pool.verify("B", 0) == [0]
+    assert pool.verify("A", 0) == []
+
+
+def test_eviction_takes_chain_leaves_before_roots():
+    """Chain-order LRU discipline: release parks the chain ROOT at
+    the MRU end (lookup can only walk a chain from its root, so
+    evicting a root orphans every deeper cached block); eviction
+    under pressure must therefore take the deepest block first,
+    leaving a shorter but WALKABLE prefix."""
+    a = BlockAllocator(3, 2)
+    t = a.alloc("A", 3)
+    for i, p in enumerate(t):
+        a.register(p, f"c{i}")
+    a.release("A")
+    assert a.n_cached == 3 and a.n_free == 0
+    [got] = a.alloc("B", 1)        # pressure: one eviction
+    assert got == t[2]             # the DEEPEST block, not the root
+    assert a.lookup(["c0", "c1", "c2"]) == [t[0], t[1]]
+    _check_sharing_invariants(a)

@@ -153,3 +153,97 @@ def test_admit_delay_site_fires_without_changing_output():
     assert plan.fired("delay", "serve.admit") >= 1
     np.testing.assert_array_equal(
         np.asarray(eng.queue.request(rid).tokens), bases[0])
+
+
+def test_shared_prefix_block_sdc_fails_every_sharer_and_quarantines():
+    """The r11 drill: SDC on a *shared* prefix block. Every request
+    whose table maps the page must fail its sealed-page verify (the
+    digest is content-keyed — one page, one digest, many readers),
+    the page must leave the prefix index (no retry may re-attach the
+    bad content), and the retries must re-prefill on fresh blocks —
+    with the non-sharing co-batched request's output bitwise
+    unchanged."""
+    from icikit.serve.kvpool import block_hashes
+
+    mesh, params, sv, prompts, bases = _setup(integrity="pages")
+    rng = np.random.default_rng(21)
+    shared_p = rng.integers(0, CFG.vocab, (8,)).astype(np.int32)
+    shared_base = np.asarray(greedy_generate(
+        params, jnp.asarray(shared_p)[None], mesh, CFG, 10))[0, 8:]
+    sv = ServeConfig(**{**sv.__dict__, "max_rows": 4})
+    eng = Engine(params, mesh, CFG, sv)
+    # seed the cache: one clean pass over the shared prompt
+    r_seed = eng.submit(shared_p, 10)
+    eng.run()
+    h0 = block_hashes(shared_p, sv.block_size)[0]
+    page0 = eng.pool.allocators[0].indexed(h0)
+    assert page0 is not None
+    # two sharers + one bystander, admitted together
+    r_b = eng.submit(shared_p, 10)
+    r_c = eng.submit(shared_p, 10)
+    r_d = eng.submit(prompts[1], 10)
+    plan = chaos.FaultPlan(schedule={"corrupt:serve.kv.page": (0,)})
+    with chaos.inject(plan):
+        eng.run()
+    assert plan.fired("corrupt", "serve.kv.page") == 1
+    # every sharer failed once and retried to the correct answer
+    for rid in (r_b, r_c):
+        req = eng.queue.request(rid)
+        assert req.state == "done" and req.attempts == 2
+        np.testing.assert_array_equal(np.asarray(req.tokens),
+                                      shared_base)
+    # the bystander never noticed
+    d = eng.queue.request(r_d)
+    assert d.state == "done" and d.attempts == 1
+    np.testing.assert_array_equal(np.asarray(d.tokens), bases[1])
+    # the seed request's record is untouched
+    assert eng.queue.request(r_seed).attempts == 1
+    # the corrupted page was quarantined from the index: the chain
+    # re-registered onto a FRESH page by the re-prefill
+    assert eng.pool.allocators[0].indexed(h0) != page0
+
+
+def test_prefix_cache_clean_armed_run_identical(monkeypatch=None):
+    """A never-firing plan over prefix-cached traffic (hits, CoW
+    forks, evictions all live) leaves outputs bit-identical to the
+    unarmed baseline — the injection sites stay free under the new
+    admission path too."""
+    mesh, params, sv, prompts, bases = _setup(integrity="pages")
+    rng = np.random.default_rng(22)
+    p = rng.integers(0, CFG.vocab, (8,)).astype(np.int32)
+    base = np.asarray(greedy_generate(
+        params, jnp.asarray(p)[None], mesh, CFG, 10))[0, 8:]
+    eng = Engine(params, mesh, CFG, sv)
+    rids = [eng.submit(p, 10) for _ in range(3)]
+    plan = chaos.FaultPlan(rates={"die:serve.*": 0.0,
+                                  "delay:serve.prefill.chunk": 0.0})
+    with chaos.inject(plan):
+        eng.run()
+    assert plan.log == []
+    assert eng.prefix_stats()["hits"] >= 1
+    for rid in rids:
+        req = eng.queue.request(rid)
+        assert req.state == "done" and req.attempts == 1
+        np.testing.assert_array_equal(np.asarray(req.tokens), base)
+
+
+def test_slow_chunked_prefill_renews_its_lease():
+    """A prompt whose chunked prefill outlasts lease_s must NOT be
+    reaped mid-prefill: each chunk is a heartbeat (the step loop's
+    renewal discipline extends to the prefill stream). Drill: delay
+    every chunk past the lease and assert single-attempt completion
+    with baseline tokens."""
+    mesh, params, sv, prompts, bases = _setup(n=1)
+    q = RequestQueue(lease_s=0.05)
+    sv = ServeConfig(**{**sv.__dict__, "prefill_chunk": 4})
+    eng = Engine(params, mesh, CFG, sv, queue=q)
+    rid = eng.submit(prompts[0], 10)      # 8 tokens -> 2 chunks
+    plan = chaos.FaultPlan(rates={"delay:serve.prefill.chunk": 1.0},
+                           delay_s=0.06)  # each chunk outlives lease_s
+    with chaos.inject(plan):
+        eng.run()
+    assert plan.fired("delay", "serve.prefill.chunk") >= 2
+    req = q.request(rid)
+    assert req.state == "done" and req.attempts == 1
+    assert q.n_reissues == 0
+    np.testing.assert_array_equal(np.asarray(req.tokens), bases[0])

@@ -229,3 +229,259 @@ def test_engine_validates_geometry():
     with pytest.raises(ValueError, match="pool holds"):
         Engine(params, mesh, CFG, ServeConfig(max_prompt=16,
                                               max_new=16, n_blocks=2))
+
+
+# ---------------------------------------------------------------- r11:
+# prefix caching + chunked prefill (ISSUE 8). The identity bar is
+# UNCHANGED — whatever admission skipped (cache hits, partial hits,
+# CoW-forked full hits) or streamed (chunked long prompts), every
+# request's tokens are what greedy_generate produces for it alone.
+
+
+def test_prefix_cache_hit_partial_and_full_identity():
+    """Miss, full-block-aligned full hit (the CoW-recompute path) and
+    partial hit all produce baseline-identical tokens, and the stats
+    ledger records exactly what was skipped."""
+    rng = np.random.default_rng(11)
+    base_p = rng.integers(0, CFG.vocab, (12,)).astype(np.int32)
+    part_p = np.concatenate([base_p[:8],
+                             rng.integers(0, CFG.vocab, (3,))
+                             .astype(np.int32)])
+    eng = _engine(max_rows=1)          # serialize: A seeds the cache
+    r_a = eng.submit(base_p, 8)
+    eng.run()
+    r_b = eng.submit(base_p, 8)        # full hit: 12 = 3 full blocks
+    eng.run()
+    r_c = eng.submit(part_p, 8)        # partial hit: blocks 0-1 only
+    eng.run()
+    for rid, p in [(r_a, base_p), (r_b, base_p), (r_c, part_p)]:
+        req = eng.queue.request(rid)
+        assert req.state == "done"
+        np.testing.assert_array_equal(np.asarray(req.tokens),
+                                      _baseline(CFG, p, 8))
+    st = eng.prefix_stats()
+    assert st["misses"] == 1 and st["hits"] == 2
+    # full hit skips s-1 = 11 positions, partial hit skips 2 blocks = 8
+    assert st["hit_tokens"] == 11 + 8
+    assert st["full_hits"] == 1
+    assert eng.queue.request(r_b).prefix_hit_tokens == 11
+    assert eng.queue.request(r_c).prefix_hit_tokens == 8
+    # blocks came back as reusable cache, not as live occupancy
+    assert eng.pool.occupancy() == 0.0
+    assert sum(a.n_cached for a in eng.pool.allocators) > 0
+
+
+def test_prefix_cache_cow_fork_under_live_sharing():
+    """Two same-prompt requests admitted together after the prefix is
+    cached: both full-hit, and the one whose recompute write targets a
+    block the other still maps must fork it copy-on-write — tokens
+    stay baseline-identical and the fork fires at least once."""
+    rng = np.random.default_rng(12)
+    p = rng.integers(0, CFG.vocab, (8,)).astype(np.int32)
+    eng = _engine(max_rows=2)
+    r0 = eng.submit(p, 10)
+    eng.run()                          # seed the cache
+    rids = [eng.submit(p, 10) for _ in range(2)]
+    eng.run()
+    base = _baseline(CFG, p, 10)
+    for rid in [r0, *rids]:
+        np.testing.assert_array_equal(
+            np.asarray(eng.queue.request(rid).tokens), base)
+    st = eng.prefix_stats()
+    assert st["full_hits"] == 2
+    assert st["cow"] >= 1              # the live-sharing fork fired
+
+
+def test_prefix_cache_off_recomputes_everything():
+    rng = np.random.default_rng(13)
+    p = rng.integers(0, CFG.vocab, (8,)).astype(np.int32)
+    eng = _engine(prefix_cache=False)
+    rids = [eng.submit(p, 8) for _ in range(2)]
+    eng.run()
+    base = _baseline(CFG, p, 8)
+    for rid in rids:
+        np.testing.assert_array_equal(
+            np.asarray(eng.queue.request(rid).tokens), base)
+    st = eng.prefix_stats()
+    assert st["hits"] == 0 and st["misses"] == 0
+    assert sum(a.n_cached for a in eng.pool.allocators) == 0
+
+
+@pytest.mark.parametrize("dp,tp", [(2, 1), (2, 2)])
+def test_prefix_cache_identity_across_meshes(dp, tp):
+    """Shared-prefix traffic over dp/tp meshes: hits are per-shard
+    (the index lives with each shard's allocator) and tokens match
+    the solo baselines regardless of which shard served which copy."""
+    rng = np.random.default_rng(14)
+    p = rng.integers(0, CFG.vocab, (8,)).astype(np.int32)
+    eng = _engine(dp=dp, tp=tp, max_rows=2 * dp)
+    r0 = eng.submit(p, 8)
+    eng.run()
+    rids = [eng.submit(p, 8) for _ in range(2 * dp)]
+    eng.run()
+    base = _baseline(CFG, p, 8)
+    for rid in [r0, *rids]:
+        np.testing.assert_array_equal(
+            np.asarray(eng.queue.request(rid).tokens), base)
+    # every repeat that landed on the seeded shard (slot 0's) hit
+    assert eng.prefix_stats()["hits"] >= 1
+
+
+def test_chunked_prefill_streams_and_bounds_programs():
+    """Prompts of every length through a small chunk: identity holds,
+    and the compiled chunk-program count is bounded by the bucket
+    ladder — NOT by the number of distinct prompt lengths (the r9
+    per-length zoo this replaces)."""
+    cfg = CFG
+    lens = [3, 5, 8, 11, 14, 16, 19, 23, 26, 31]
+    prompts = _workload(cfg, lens, seed=9)
+    eng = _engine(max_rows=2, max_prompt=32, max_new=8, n_blocks=64,
+                  prefill_chunk=8, prefix_cache=False)
+    rids = [eng.submit(p, 6) for p in prompts]
+    eng.run()
+    for rid, p in zip(rids, prompts):
+        req = eng.queue.request(rid)
+        assert req.state == "done"
+        np.testing.assert_array_equal(np.asarray(req.tokens),
+                                      _baseline(cfg, p, 6))
+    assert len(eng._chunk_fns) <= len(eng._chunk_widths)
+    assert len(eng._chunk_widths) <= 5     # the "handful" bound
+    # whole-prompt arm: chunk >= max_prompt -> every admission is one
+    # chunk, still bucket-bounded
+    eng2 = _engine(max_rows=2, max_prompt=32, max_new=8, n_blocks=64,
+                   prefill_chunk=32, prefix_cache=False)
+    rids2 = [eng2.submit(p, 6) for p in prompts[:4]]
+    eng2.run()
+    for rid, p in zip(rids2, prompts[:4]):
+        np.testing.assert_array_equal(
+            np.asarray(eng2.queue.request(rid).tokens),
+            _baseline(cfg, p, 6))
+    assert len(eng2._chunk_fns) <= len(eng2._chunk_widths)
+
+
+def test_prefix_cache_eviction_under_pool_pressure():
+    """A pool sized so that cached prefixes must be LRU-evicted to
+    admit new traffic: admission never deadlocks on a cache-full pool
+    and outputs stay identical."""
+    prompts = _workload(CFG, [8, 8, 8, 8], seed=15)
+    # 2 rows of ceil((8+8)/4)=4 blocks live + little slack
+    eng = _engine(max_rows=2, max_prompt=8, max_new=8, n_blocks=9)
+    rids = [eng.submit(p, 8) for p in prompts]
+    eng.run()
+    for rid, p in zip(rids, prompts):
+        req = eng.queue.request(rid)
+        assert req.state == "done"
+        np.testing.assert_array_equal(np.asarray(req.tokens),
+                                      _baseline(CFG, p, 8))
+    assert eng.prefix_stats()["evictions"] > 0
+
+
+@pytest.mark.parametrize("drafter", ["ngram", "suffix"])
+def test_speculative_drafter_identity(drafter):
+    """Both host drafters under k=3: proposals differ, tokens cannot
+    — the verify window commits the full model's argmax regardless."""
+    # a repetitive prompt gives both matchers something to chew on
+    p = np.asarray([3, 7, 9, 3, 7, 9, 3, 7], np.int32)
+    eng = _engine(speculate_k=3, drafter=drafter)
+    rid = eng.submit(p, 12)
+    eng.run()
+    np.testing.assert_array_equal(
+        np.asarray(eng.queue.request(rid).tokens),
+        _baseline(CFG, p, 12))
+
+
+def test_suffix_automaton_matches_and_proposes():
+    from icikit.serve import SuffixAutomaton
+    sam = SuffixAutomaton()
+    for t in [1, 2, 3, 4, 1, 2, 3]:
+        sam.feed(t)
+    # suffix [1,2,3] occurred at positions 0-2 -> longest match 3,
+    # continuation after that occurrence is 4 then 1, 2...
+    assert sam.match_len == 3
+    np.testing.assert_array_equal(sam.propose(3), [4, 1, 2])
+    sam.feed(4)
+    assert sam.match_len == 4
+    np.testing.assert_array_equal(sam.propose(2), [1, 2])
+    # no-match stream falls back to repeating the last token
+    sam2 = SuffixAutomaton()
+    for t in [5, 6, 7]:
+        sam2.feed(t)
+    assert sam2.match_len == 0
+    np.testing.assert_array_equal(sam2.propose(2), [7, 7])
+
+
+def test_speculative_overshoot_never_poisons_the_index():
+    """A speculative window can accept past n_new (cursor overshoot);
+    the finalize frontier must clamp to the RECORDED tokens, or a
+    block holding real K/V would be registered under a zero-run chain
+    hash and a later prompt could share wrong content. Pin: every
+    index entry matches a chain hash reconstructible from some
+    request's prompt + solo continuation."""
+    from icikit.serve.kvpool import block_hashes
+
+    bs = 2
+    eng = _engine(max_rows=2, block_size=bs, n_blocks=48,
+                  max_prompt=8, max_new=4, speculate_k=4,
+                  drafter="ngram")
+    rng = np.random.default_rng(17)
+    prompts = [np.full((4,), 7, np.int32),
+               np.asarray([3, 9, 3, 9], np.int32),
+               rng.integers(0, CFG.vocab, (6,)).astype(np.int32)]
+    rids = [eng.submit(p, 2) for p in prompts]
+    rids += [eng.submit(p, 4) for p in prompts]
+    eng.run()
+    legal = set()
+    for p in prompts:
+        # the longest token run a block of this request could hold:
+        # prompt + the FULL greedy continuation (overshot positions
+        # hold continuation K/V, but their tokens were never
+        # recorded, so no hash over them may exist)
+        full = np.concatenate([p, _baseline(CFG, p, 8)])
+        legal.update(block_hashes(full, bs))
+    for a in eng.pool.allocators:
+        with a._lock:
+            index = dict(a._index)
+        for h in index:
+            assert h in legal, \
+                "registered hash matches no request's token chain"
+    for rid, p in zip(rids, prompts + prompts):
+        req = eng.queue.request(rid)
+        np.testing.assert_array_equal(
+            np.asarray(req.tokens), _baseline(CFG, p, req.n_new)[
+                :len(req.tokens)])
+
+
+def test_finalize_frontier_clamps_to_recorded_tokens():
+    """White-box pin of the overshoot clamp: a cursor past
+    s_prompt + n_done (speculative windows accept beyond n_new) must
+    not finalize — and in particular not content-register — blocks
+    whose tokens were never recorded."""
+    from icikit.serve.engine import _Row
+
+    eng = _engine(max_rows=1, block_size=2, n_blocks=32, max_prompt=8,
+                  max_new=8)
+    rid = eng.submit(np.asarray([1, 2, 3, 4], np.int32), 2)
+    eng.run()
+    req = eng.queue.request(rid)
+    owner = "wb.overshoot"
+    eng.pool.ensure(owner, 0, 8)
+    row = _Row(req=req, shard=0, s_prompt=4, n_done=2, sealed=0,
+               prefilled=4, owner=owner)
+    eng.rows[0] = row
+    eng._seq_buf[0] = 0
+    eng._seq_buf[0, :6] = [1, 2, 3, 4, 9, 8]   # prompt + 2 recorded
+    eng._curs[0] = 8                           # overshot cursor
+    eng._finalize_blocks(0, row)
+    # recorded frontier = 6 -> blocks (0,1),(2,3),(4,5) finalize,
+    # the block holding unrecorded positions (6,7) must NOT
+    assert row.sealed == 3
+    from icikit.serve.kvpool import block_hashes
+    a = eng.pool.allocators[0]
+    chains = block_hashes(eng._seq_buf[0, :8], 2)
+    # every recorded chain is indexed (here or on an earlier page —
+    # first registration wins); the zero-run chain past the recorded
+    # frontier must not exist
+    assert all(a.indexed(h) is not None for h in chains[:3])
+    assert a.indexed(chains[3]) is None
+    eng.rows[0] = None
+    eng.pool.release(owner, 0)

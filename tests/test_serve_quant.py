@@ -143,7 +143,7 @@ def test_int8_engine_seal_verify_catches_page_and_scale_flips():
     pool = eng.pool
     # re-seal a fresh owner by hand to drill the q8 digest path
     table = pool.allocators[0].alloc("drill", 2)
-    pool.seal("drill", 0, 0, table[0])
+    pool.seal(0, table[0])
     assert pool.verify("drill", 0) == []
     flipped = pool.read_page(0, table[0], 0).copy()
     flipped[0, 0] ^= 1                     # one int8 bit
@@ -180,3 +180,53 @@ def test_int8_engine_chaos_kv_page_drill_contained():
     got = [tuple(queue.done[r].tokens) for r in rids]
     assert got == clean
     assert any(queue.done[r].attempts > 1 for r in rids)
+
+
+def test_int8_engine_prefix_cache_config_is_identity_safe():
+    """prefix_cache=True on an int8 engine must not perturb the
+    engine≡int8-generate parity bar: quantized pages never enter the
+    index (a cached q8 block cannot reproduce the raw prompt-column
+    attention the deployed prefill computes), so repeated prompts
+    admit as recomputes and tokens stay exact."""
+    mesh = _mesh()
+    params = _params(mesh)
+    prompt = np.arange(3, 11, dtype=np.int32)
+    eng = Engine(params, mesh, QCFG,
+                 ServeConfig(**SV, prefix_cache=True))
+    rids = [eng.submit(prompt, 10) for _ in range(3)]
+    eng.run()
+    want = np.asarray(greedy_generate(
+        params, jnp.asarray(prompt)[None], mesh, QCFG, 10))[0, 8:]
+    for rid in rids:
+        np.testing.assert_array_equal(
+            np.asarray(eng.queue.done[rid].tokens), want)
+    st = eng.prefix_stats()
+    assert st["hits"] == 0 and st["misses"] == 0   # q8 never indexes
+    assert sum(a.n_cached for a in eng.pool.allocators) == 0
+
+
+def test_mixed_engine_fp_rows_prefix_hit_with_q8_cobatch():
+    """On a mixed engine the fp side keeps full prefix caching: a
+    repeated fp prompt hits while a quantized row co-batches, and the
+    fp tokens equal the all-fp engine's (containment + caching
+    compose). The q8 row's pages stay out of the index."""
+    mesh = _mesh()
+    params = _params(mesh)
+    rng = np.random.default_rng(31)
+    fp_p = rng.integers(0, CFG.vocab, (8,)).astype(np.int32)
+    q_p = rng.integers(0, CFG.vocab, (8,)).astype(np.int32)
+    base = np.asarray(greedy_generate(
+        params, jnp.asarray(fp_p)[None], mesh, CFG, 10))[0, 8:]
+    eng = Engine(params, mesh, CFG,
+                 ServeConfig(**SV, kv_quant="mixed"))
+    r0 = eng.submit(fp_p, 10)
+    eng.run()                              # seed the fp-side cache
+    r1 = eng.submit(fp_p, 10)              # fp repeat: full hit
+    rq = eng.submit(q_p, 10, quant=True)   # co-batched quantized row
+    eng.run()
+    for rid in (r0, r1):
+        np.testing.assert_array_equal(
+            np.asarray(eng.queue.done[rid].tokens), base)
+    assert eng.queue.done[rq].state == "done"
+    st = eng.prefix_stats()
+    assert st["hits"] == 1 and st["full_hits"] == 1

@@ -86,8 +86,12 @@ def test_full_depth_drafter_accepts_everything():
     mesh = make_model_mesh(dp=1, tp=1, sp=1)
     params = init_params(jax.random.key(0), CFG, mesh)
     pd = _prompt(mesh)
+    # drafter="shared" explicitly: the r11 "auto" flip resolves the
+    # no-head fallback to "ngram", and this test is ABOUT the shared
+    # drafter's full-depth exactness bound
     _, st = speculative_generate(params, pd, mesh, CFG, 10, k=4,
                                  draft_layers=CFG.n_layers,
+                                 drafter="shared",
                                  return_stats=True)
     assert st["acceptance_rate"] == 1.0
     assert st["tokens_per_step"] == 4.0

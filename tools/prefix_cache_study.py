@@ -1,0 +1,136 @@
+"""Round-11 serving study: prefix caching + chunked prefill A/B —
+the reproducible command behind serve_r11.jsonl.
+
+Two questions, each answered by paired arms over the SAME seeded
+workload (matched offered load, per-request token-identity audited
+against single-request ``generate`` in every arm):
+
+1. **Prefix caching** (cache on vs off, chunked admission in both):
+   on the shared-prefix Poisson workload (system-prompt-shaped: a
+   common 48-token prefix, 16-token unique suffixes, short outputs —
+   the regime where prefill dominates TTFT), does block sharing
+   deliver >= 1.3x tokens/s or >= 2x lower p50 TTFT? The cache-on arm
+   measures steady state: warm-up seeds the shared prefix exactly as
+   production traffic would have long since done.
+
+2. **Chunked vs whole prefill** (cache off in both, isolating the
+   admission discipline): with long prompts admitted into a decoding
+   batch, does streaming the prompt through fixed-width chunks reduce
+   the p99 TPOT long-prompt admission inflicts on co-batched
+   decoders, vs paying the whole prompt in one program call?
+
+Usage::
+
+    JAX_PLATFORMS=cpu python tools/prefix_cache_study.py \
+        [--out serve_r11.jsonl] [--seeds 0 1]
+
+CPU-fp32 protocol throughout (the r9 rule: XLA:CPU re-packs bf16
+weight operands per program call, which generate's scanned loop
+hoists but a per-call engine step cannot — and the identity audit
+additionally requires matched arithmetic between the engine's
+per-call programs and generate's scanned loop, which on XLA:CPU only
+fp32 provides). Every row is backend-stamped; absolute tokens/s waits
+on a v5e session like every other decode-side number in this repo.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+try:
+    import icikit  # noqa: F401
+except ModuleNotFoundError:  # `python tools/prefix_cache_study.py`
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+from icikit.bench.serve import run_bench
+
+COMMON = dict(preset="tiny", rows=4, compute_dtype="float32",
+              mode="continuous", verify=True)
+
+# Q1: shared-prefix traffic, cache on/off (chunk 32 both arms).
+# Short outputs keep prefill the dominant per-request cost — the
+# traffic shape the cache exists for (system prompts / few-shot
+# headers); rate 1000 is effectively all-at-once (saturated queue).
+Q1 = dict(n_requests=16, rate_rps=1000.0, prompt_len=64,
+          prefix_len=48, new_min=4, new_max=12, block_size=8,
+          prefill_chunk=32)
+
+# Q2: long prompts, no sharing (prefix 0), chunked (32) vs whole
+# (prefill_chunk >= prompt -> one program call per admission). Longer
+# outputs keep rows decoding while later prompts admit — the
+# co-batched TPOT interference the chunk cap bounds. Prompt 256 puts
+# the whole-prefill call well above this CPU's per-dispatch floor
+# (at s <= 96 tiny-model prefill is dispatch-bound and chunking only
+# multiplies dispatches — measured while scoping this study; the
+# regime where the cap matters is long prompts, which is also the
+# regime the feature exists for).
+Q2 = dict(n_requests=10, rate_rps=1000.0, prompt_len=256,
+          prefix_len=0, new_min=8, new_max=16, block_size=8)
+
+
+def _arm(seed: int, label: str, **over) -> dict:
+    kw = {**COMMON, **over}
+    [rec] = run_bench(
+        kw["preset"], kw["rows"], kw["n_requests"], kw["rate_rps"],
+        kw["prompt_len"], kw["new_min"], kw["new_max"],
+        kw["block_size"], seed=seed, mode=kw["mode"],
+        compute_dtype=kw["compute_dtype"],
+        prefix_len=kw["prefix_len"], prefix_cache=kw["prefix_cache"],
+        prefill_chunk=kw["prefill_chunk"], verify=kw["verify"])
+    rec["study"] = "r11"
+    rec["arm"] = label
+    assert rec["identity_ok"], (
+        f"arm {label} seed {seed}: served tokens diverged from "
+        "single-request generate — the A/B is void")
+    return rec
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--out", default="serve_r11.jsonl")
+    ap.add_argument("--seeds", type=int, nargs="+", default=[0, 1])
+    args = ap.parse_args(argv)
+
+    rows = []
+    for seed in args.seeds:
+        on = _arm(seed, "prefix-cache-on", **Q1, prefix_cache=True)
+        off = _arm(seed, "prefix-cache-off", **Q1, prefix_cache=False)
+        rows += [on, off]
+        tps = on["tokens_per_s"] / off["tokens_per_s"]
+        ttft = off["ttft_ms"]["p50"] / on["ttft_ms"]["p50"]
+        print(f"[seed {seed}] prefix cache: "
+              f"{on['tokens_per_s']} vs {off['tokens_per_s']} tok/s "
+              f"(x{tps:.2f}); p50 TTFT {on['ttft_ms']['p50']} vs "
+              f"{off['ttft_ms']['p50']} ms (x{ttft:.2f} lower); "
+              f"hit_tokens {on['prefix']['hit_tokens']}, "
+              f"identity {on['identity_checked']}+"
+              f"{off['identity_checked']} OK")
+
+        chunked = _arm(seed, "chunked-prefill", **Q2,
+                       prefix_cache=False, prefill_chunk=32)
+        whole = _arm(seed, "whole-prefill", **Q2,
+                     prefix_cache=False,
+                     prefill_chunk=Q2["prompt_len"])
+        rows += [chunked, whole]
+        print(f"[seed {seed}] chunked vs whole prefill: p99 stall "
+              f"(max inter-token gap) {chunked['gap_ms']['p99']} vs "
+              f"{whole['gap_ms']['p99']} ms "
+              f"(x{whole['gap_ms']['p99'] / chunked['gap_ms']['p99']:.2f} lower), "
+              f"p99 TPOT {chunked['tpot_ms']['p99']} vs "
+              f"{whole['tpot_ms']['p99']} ms; tok/s "
+              f"{chunked['tokens_per_s']} vs {whole['tokens_per_s']} "
+              f"(the cap trades throughput for tail latency)")
+
+    with open(args.out, "a") as f:
+        for r in rows:
+            f.write(json.dumps(r) + "\n")
+    print(f"appended {len(rows)} records to {args.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -157,12 +157,50 @@ def check_engine() -> None:
     print("quant-lint: int8 engine pool round-trips int8 OK")
 
 
+def check_block_hash_covers_scales() -> None:
+    """Prefix-cache era integrity: the sealed-block digest — the one
+    fingerprint every sharer of a page re-verifies — must cover the
+    int8 arena's SCALE pages, not just the quantized payload. A
+    flipped scale corrupts decoded tokens exactly like a flipped int8
+    byte, so it must flip the digest too; a digest over payload bytes
+    alone would let scale corruption ride shared blocks undetected."""
+    import numpy as np
+
+    from icikit.models.transformer import TransformerConfig
+    from icikit.models.transformer.model import make_model_mesh
+    from icikit.serve.kvpool import KVPool
+
+    cfg = TransformerConfig(vocab=31, d_model=16, n_heads=2, d_head=8,
+                            d_ff=32, n_layers=2, max_seq=32,
+                            compute_dtype="float32")
+    mesh = make_model_mesh(dp=1, tp=1, sp=1)
+    pool = KVPool(cfg, mesh, n_blocks=4, block_size=4, quant="int8")
+    # the q8 read-back must interleave payload AND scales per layer
+    [page] = pool.allocators[0].alloc("lint", 1)
+    per_layer = len(pool.page_bytes(0, page, "q8")) // cfg.n_layers
+    assert per_layer == 4, (
+        "q8 page_bytes must return qk, qv, ksc, vsc per layer, got "
+        f"{per_layer} arrays")
+    data = np.arange(4 * 2 * 8, dtype=np.int8).reshape(4, 2, 8)
+    pool.poke_page(0, page, 0, data)
+    pool.seal(0, page)
+    assert pool.verify("lint", 0) == []
+    vsc = list(pool.vsc)
+    vsc[1] = vsc[1].at[0, page, 1, 0].add(0.5)   # ONLY a scale moves
+    pool.vsc = tuple(vsc)
+    assert pool.verify("lint", 0) == [0], (
+        "a flipped scale page did NOT fail the sealed-block verify — "
+        "the block hash does not cover the quantized payload's scales")
+    print("quant-lint: sealed-block digest covers int8 scale pages OK")
+
+
 def main() -> int:
     check_pool()
     check_generate()
     check_engine()
+    check_block_hash_covers_scales()
     print("quant-lint OK: no high-precision KV allocated on the "
-          "int8 path")
+          "int8 path; block digests cover scale pages")
     return 0
 
 

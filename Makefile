@@ -48,6 +48,7 @@ check:
 		echo "$$bad"; exit 1; \
 	fi
 	@echo "check OK: icikit/serve SLO clocks are monotonic"
+	$(PY) tools/serve_key_lint.py
 	JAX_PLATFORMS=cpu $(PY) tools/quant_lint.py
 
 # multi-token decode smoke: a tiny CPU speculative decode under an
@@ -135,6 +136,17 @@ serve-smoke:
 	@grep -q '"serve.prefix.hits"' /tmp/icikit_serve_prefix_metrics.json && \
 		grep -q '"serve.prefix.hit_tokens"' /tmp/icikit_serve_prefix_metrics.json && \
 		echo "serve-smoke prefix OK: shared-prefix trace valid, cache-hit admissions on the bus"
+	JAX_PLATFORMS=cpu \
+	ICIKIT_OBS="trace=/tmp/icikit_serve_sampled_trace.json;metrics=/tmp/icikit_serve_sampled_metrics.json;jsonl=off" \
+	$(PY) -m icikit.bench.serve --preset tiny --rows 2 --requests 6 \
+		--rate 2000 --prompt 16 --new-min 4 --new-max 8 --block-size 4 \
+		--prefill-chunk 4 --distinct 1 --temperature 0.7 --top-p 0.9 \
+		--seed-per-request --compute-dtype float32 --mode continuous \
+		--seed 0 --verify-identity > /dev/null
+	$(PY) -m icikit.obs.check /tmp/icikit_serve_sampled_trace.json
+	@grep -q '"serve.prefix.inflight_hits"' /tmp/icikit_serve_sampled_metrics.json && \
+		grep -q '"serve.ttft_ms"' /tmp/icikit_serve_sampled_metrics.json && \
+		echo "serve-smoke sampled OK: sampled duplicate-prompt trace valid, in-flight dedup waiters on the bus"
 
 bench:
 	$(PY) bench.py

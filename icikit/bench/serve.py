@@ -16,10 +16,16 @@ compare:
   two wastes continuous batching removes.
 
 Both modes replay the SAME seeded workload (arrival offsets, prompts,
-per-request lengths), so the comparison is at matched offered load.
-Outputs are per-request greedy decodes in both modes, so total useful
-tokens are identical by construction — the records differ only in
-wall-clock shape: sustained tokens/s, TTFT/TPOT/queue-wait p50/p99.
+per-request lengths, per-request sampling seeds), so the comparison
+is at matched offered load. Outputs are per-request decodes in both
+modes — greedy, or with ``--temperature > 0`` sampled under the r12
+schedule-invariant counter keys (each request's draw is a pure
+function of its seed and position, so batched static decoding and
+the continuous engine produce the same tokens by construction) —
+and the records differ only in wall-clock shape: sustained tokens/s,
+TTFT/TPOT/queue-wait p50/p99. ``--distinct`` shapes duplicate-prompt
+traffic and ``--inflight-dedup`` is the r12 dedup A/B knob
+(``prefill_tokens_computed`` + ``dup_ttft_ms`` carry the result).
 
 Every record is backend-stamped. On CPU the absolute numbers measure
 the XLA:CPU decode stack (and the engine's per-step dispatch overhead,
@@ -46,29 +52,63 @@ from icikit import chaos, obs
 
 def make_workload(n_requests: int, rate_rps: float, prompt_len: int,
                   new_min: int, new_max: int, vocab: int,
-                  seed: int = 0, prefix_len: int = 0) -> list:
-    """Seeded Poisson trace: ``[(offset_s, prompt, n_new), ...]`` with
-    exponential inter-arrivals at ``rate_rps`` and per-request lengths
-    uniform in ``[new_min, new_max]``. ``prefix_len`` > 0 makes the
-    first that many tokens of every prompt IDENTICAL (one seeded
-    draw) — the shared-system-prompt / few-shot-header traffic shape
-    the prefix cache exists for; ``prefix_len == prompt_len`` is the
-    fully-repeated-prompt (full-hit) regime."""
+                  seed: int = 0, prefix_len: int = 0,
+                  distinct: int = 0,
+                  seed_per_request: bool = False,
+                  motif: int = 0) -> list:
+    """Seeded Poisson trace: ``[(offset_s, prompt, n_new, rseed), ...]``
+    with exponential inter-arrivals at ``rate_rps`` and per-request
+    lengths uniform in ``[new_min, new_max]``. ``prefix_len`` > 0
+    makes the first that many tokens of every prompt IDENTICAL (one
+    seeded draw) — the shared-system-prompt / few-shot-header traffic
+    shape the prefix cache exists for; ``prefix_len == prompt_len``
+    is the fully-repeated-prompt (full-hit) regime. ``distinct`` > 0
+    draws only that many distinct prompts and cycles arrivals through
+    them — the duplicate-prompt traffic shape in-flight prefill dedup
+    exists for (concurrent identical prompts at high rates).
+    ``rseed`` is the request's sampling-stream seed
+    (``seed_per_request`` gives each arrival its own; otherwise all
+    share stream 0 — duplicate prompts then sample identical
+    continuations, the dedup study's matched-output regime).
+    ``motif`` > 0 makes each prompt a random ``motif``-token pattern
+    TILED to ``prompt_len`` — the repetitive/extractive traffic shape
+    (structured text, code, quotes) where suffix-match drafting earns
+    its keep; continuations over such contexts loop, which is what
+    the r9/r12 speculation rows price."""
     if not 0 <= prefix_len <= prompt_len:
         raise ValueError(
             f"prefix_len must be in [0, prompt_len], got {prefix_len}")
+    if distinct < 0:
+        raise ValueError(f"distinct must be >= 0, got {distinct}")
+    if motif < 0:
+        raise ValueError(f"motif must be >= 0, got {motif}")
+    if motif and prefix_len:
+        raise ValueError("motif and prefix_len are exclusive "
+                         "workload shapes")
     rng = np.random.default_rng(seed)
     gaps = rng.exponential(1.0 / rate_rps, size=n_requests)
     offsets = np.cumsum(gaps)
     prefix = rng.integers(0, vocab, (prefix_len,)).astype(np.int32)
+
+    def draw_prompt():
+        if motif:
+            m = rng.integers(0, vocab, (motif,)).astype(np.int32)
+            return np.tile(m, -(-prompt_len // motif))[:prompt_len]
+        return np.concatenate([
+            prefix, rng.integers(0, vocab, (prompt_len - prefix_len,))
+            .astype(np.int32)])
+
+    pool = ([draw_prompt() for _ in range(distinct)] if distinct
+            else None)
     out = []
     for i in range(n_requests):
-        suffix = rng.integers(0, vocab,
-                              (prompt_len - prefix_len,)
-                              ).astype(np.int32)
-        prompt = np.concatenate([prefix, suffix])
+        if pool is not None:
+            prompt = pool[i % distinct]
+        else:
+            prompt = draw_prompt()
         n_new = int(rng.integers(new_min, new_max + 1))
-        out.append((float(offsets[i]), prompt, n_new))
+        out.append((float(offsets[i]), prompt, n_new,
+                    i if seed_per_request else 0))
     return out
 
 
@@ -103,12 +143,15 @@ def _pcts(xs) -> dict:
 
 def run_continuous(params, mesh, cfg, serve_cfg, workload,
                    max_retries: int = 2, warm: list | None = None,
-                   verify: bool = False) -> dict:
+                   verify: bool = False, temperature: float = 0.0,
+                   top_k: int = 0, top_p: float = 1.0) -> dict:
     """Drive the engine over the arrival trace; returns the record.
     ``verify=True`` re-decodes every completed request through
-    single-request ``greedy_generate`` (batched by output length) and
-    records the token-identity check in the row — the per-arm
-    acceptance bar of the r11 A/B."""
+    single-request ``greedy_generate`` — or, for sampled arms
+    (``temperature > 0``), ``sample_generate`` with each request's
+    own stream seed — batched by output length, and records the
+    token-identity check in the row: the per-arm acceptance bar of
+    the r11/r12 A/Bs."""
     from icikit.serve import Engine, ServeConfig  # noqa: F401
     eng = Engine(params, mesh, cfg, serve_cfg)
     # warm the compiles (chunk buckets for both the miss and hit
@@ -122,32 +165,47 @@ def run_continuous(params, mesh, cfg, serve_cfg, workload,
     # timed window measures steady-state caching (noted in the
     # record).
     for wp in (warm if warm is not None else [workload[0][1]]):
-        eng.submit(wp, 2)
+        eng.submit(wp, 2, temperature=temperature, top_k=top_k,
+                   top_p=top_p)
         eng.run()
     assert not eng.queue.failed
     eng.reset_stats()   # keep the warm-up out of occupancy/step figures
     t0 = time.monotonic()
-    rids = [eng.submit(p, n, not_before=t0 + off, max_retries=max_retries)
-            for off, p, n in workload]
+    rids = [eng.submit(p, n, not_before=t0 + off,
+                       max_retries=max_retries, seed=rs,
+                       temperature=temperature, top_k=top_k,
+                       top_p=top_p)
+            for off, p, n, rs in workload]
     eng.run()
     makespan = time.monotonic() - t0
     ttft, tpot, qwait, gaps, tokens = [], [], [], [], 0
+    dup_ttft = []       # TTFT of repeat arrivals of an earlier prompt
+    seen_prompts: set = set()
     failed = 0
-    for rid in rids:
+    for rid, (_, p, _, _) in zip(rids, workload):
+        pkey = p.tobytes()
         req = eng.queue.request(rid)
         if req.state != "done":
+            # a failed arrival never shared (or seeded) an in-flight
+            # prefill, so it neither counts as a duplicate nor marks
+            # later arrivals of the same prompt as ones
             failed += 1
             continue
+        is_dup = pkey in seen_prompts
+        seen_prompts.add(pkey)
         slo = req.slo()
         tokens += len(req.tokens)
         if "ttft_ms" in slo:
             ttft.append(slo["ttft_ms"])
+            if is_dup:
+                dup_ttft.append(slo["ttft_ms"])
         if "tpot_ms" in slo:
             tpot.append(slo["tpot_ms"])
         if "queue_wait_ms" in slo:
             qwait.append(slo["queue_wait_ms"])
         if "max_gap_ms" in slo:
             gaps.append(slo["max_gap_ms"])
+    prefix = eng.prefix_stats()
     rec = {
         "mode": "continuous",
         "tokens": tokens,
@@ -169,33 +227,55 @@ def run_continuous(params, mesh, cfg, serve_cfg, workload,
         # interference metric (mean TPOT dilutes a one-off admission
         # stall over the whole decode; this is the stall itself)
         "gap_ms": _pcts(gaps),
-        "prefix": eng.prefix_stats(),
+        # second+ arrivals of an already-seen prompt — the population
+        # the in-flight-dedup A/B prices (p50 of this is the
+        # "second-arrival TTFT" headline)
+        "dup_ttft_ms": _pcts(dup_ttft),
+        # prompt positions actually computed by prefill programs
+        # (chunks + whole-prompt): the dedup A/B's compute metric
+        "prefill_tokens_computed": prefix["prefill_tokens"],
+        "prefix": prefix,
     }
     if verify:
         rec.update(_verify_identity(params, mesh, cfg, eng, workload,
-                                    rids))
+                                    rids, temperature, top_k, top_p))
     return rec
 
 
-def _verify_identity(params, mesh, cfg, eng, workload, rids) -> dict:
+def _verify_identity(params, mesh, cfg, eng, workload, rids,
+                     temperature: float = 0.0, top_k: int = 0,
+                     top_p: float = 1.0) -> dict:
     """Token-identity audit: every completed request's served tokens
-    vs its own single-request greedy decode, batched by output length
-    (one compiled generate per distinct (s, n))."""
+    vs its own single-request decode, batched by output length (one
+    compiled generate per distinct (s, n)). Sampled arms re-decode
+    through ``sample_generate`` with the per-request stream seeds —
+    batching the audit is legitimate BECAUSE the counter keys make
+    each row's draw independent of batch composition."""
+    import jax
     import jax.numpy as jnp
 
     from icikit.models.transformer import greedy_generate
+    from icikit.models.transformer.decode import sample_generate
     by_n: dict = {}
-    for rid, (_, p, n) in zip(rids, workload):
+    for rid, (_, p, n, rs) in zip(rids, workload):
         req = eng.queue.request(rid)
         if req.state == "done":
-            by_n.setdefault(n, []).append((req, p))
+            by_n.setdefault(n, []).append((req, p, rs))
     checked, bad = 0, 0
     for n, group in by_n.items():
-        prompts = np.stack([p for _, p in group])
-        out = np.asarray(greedy_generate(
-            params, jnp.asarray(prompts), mesh, cfg, n))
+        prompts = np.stack([p for _, p, _ in group])
+        if temperature > 0.0:
+            out = np.asarray(sample_generate(
+                params, jnp.asarray(prompts), mesh, cfg, n,
+                jax.random.key(0), temperature=temperature,
+                top_k=top_k, top_p=top_p,
+                seeds=np.asarray([rs for _, _, rs in group],
+                                 np.int32)))
+        else:
+            out = np.asarray(greedy_generate(
+                params, jnp.asarray(prompts), mesh, cfg, n))
         s = prompts.shape[1]
-        for (req, _), row in zip(group, out):
+        for (req, _, _), row in zip(group, out):
             checked += 1
             if list(row[s:s + len(req.tokens)]) != list(req.tokens):
                 bad += 1
@@ -203,7 +283,9 @@ def _verify_identity(params, mesh, cfg, eng, workload, rids) -> dict:
             "identity_ok": bad == 0}
 
 
-def run_static(params, mesh, cfg, rows: int, workload) -> dict:
+def run_static(params, mesh, cfg, rows: int, workload,
+               temperature: float = 0.0, top_k: int = 0,
+               top_p: float = 1.0) -> dict:
     """The static-batch baseline at the same offered load: batches of
     ``rows`` in arrival order, each decoded to its longest member.
 
@@ -211,41 +293,57 @@ def run_static(params, mesh, cfg, rows: int, workload) -> dict:
     admission (or streaming) a request's first token is not *available*
     until its batch returns; TPOT is the batch's decode time per token
     (every row pays the longest row's steps). That is the cost model
-    this baseline exists to expose, not an unfair handicap.
+    this baseline exists to expose, not an unfair handicap. Sampled
+    traffic batches through ``sample_generate`` with the per-request
+    stream seeds — the counter keys make the batched draw identical
+    to each request's solo draw, so both modes still produce the same
+    useful tokens by construction.
     """
+    import jax
     import jax.numpy as jnp
 
     from icikit.models.transformer import greedy_generate
+    from icikit.models.transformer.decode import sample_generate
     s_prompt = len(workload[0][1])
     batches = [workload[i:i + rows]
                for i in range(0, len(workload), rows)]
 
-    def gen(prompts, n_max):
+    def gen(prompts, n_max, seeds):
+        if temperature > 0.0:
+            return np.asarray(sample_generate(
+                params, jnp.asarray(np.stack(prompts)), mesh, cfg,
+                n_max, jax.random.key(0), temperature=temperature,
+                top_k=top_k, top_p=top_p,
+                seeds=np.asarray(seeds, np.int32)))
         return np.asarray(greedy_generate(
             params, jnp.asarray(np.stack(prompts)), mesh, cfg, n_max))
 
+    def padded(batch):
+        prompts = [p for _, p, _, _ in batch]
+        seeds = [rs for _, _, _, rs in batch]
+        while len(prompts) < rows:  # ragged tail: pad, discard outputs
+            prompts.append(prompts[-1])
+            seeds.append(seeds[-1])
+        return prompts, seeds
+
     # warm every (batch-shape, n_max) program outside the clock
     for batch in batches:
-        prompts = [p for _, p, _ in batch]
-        while len(prompts) < rows:
-            prompts.append(prompts[-1])
-        gen(prompts, max(n for _, _, n in batch))
+        prompts, seeds = padded(batch)
+        gen(prompts, max(n for _, _, n, _ in batch), seeds)
 
     t0 = time.monotonic()
     ttft, tpot, tokens = [], [], 0
     for batch in batches:
-        arrivals = [t0 + off for off, _, _ in batch]
+        arrivals = [t0 + off for off, _, _, _ in batch]
         wait = max(arrivals) - time.monotonic()
         if wait > 0:
             time.sleep(wait)   # batch formation: wait for the last row
         start = time.monotonic()
-        n_max = max(n for _, _, n in batch)
-        prompts = [p for _, p, _ in batch]
-        while len(prompts) < rows:  # ragged tail: pad, discard outputs
-            prompts.append(prompts[-1])
-        out = gen(prompts, n_max)
+        n_max = max(n for _, _, n, _ in batch)
+        prompts, seeds = padded(batch)
+        out = gen(prompts, n_max, seeds)
         end = time.monotonic()
-        for (off, p, n), row in zip(batch, out):
+        for (off, p, n, _), row in zip(batch, out):
             tokens += n                     # kept tokens only
             ttft.append((end - (t0 + off)) * 1e3)
             tpot.append((end - start) / n_max * 1e3)
@@ -260,7 +358,7 @@ def run_static(params, mesh, cfg, rows: int, workload) -> dict:
         # occupancy a static batch achieves: useful row-tokens over
         # paid row-steps (rows idle behind the longest member)
         "occupancy_mean": round(
-            tokens / sum(rows * max(n for _, _, n in b)
+            tokens / sum(rows * max(n for _, _, n, _ in b)
                          for b in batches), 4),
         "completed": len(workload),
         "failed": 0,
@@ -280,7 +378,19 @@ def run_bench(preset: str, rows: int, n_requests: int, rate_rps: float,
               decode_quant: str = "none",
               prefix_len: int = 0, prefix_cache: bool = True,
               prefill_chunk: int = 64, drafter: str = "ngram",
-              verify: bool = False) -> list[dict]:
+              verify: bool = False, temperature: float = 0.0,
+              top_k: int = 0, top_p: float = 1.0,
+              seed_per_request: bool = False, distinct: int = 0,
+              inflight_dedup: bool | str = "auto",
+              motif: int = 0, model: tuple | None = None,
+              workload: list | None = None) -> list[dict]:
+    """``model=(params, mesh, cfg)`` overrides the preset-constructed
+    random-init model (the r12 study serves a Markov-TRAINED toy —
+    random init has no confident regime, so low-temperature draws
+    neither follow the drafter nor leave numeric margin);
+    ``workload`` overrides the generated trace with a prebuilt
+    ``[(offset, prompt, n_new, rseed), ...]`` list (in-distribution
+    prompts for a trained model)."""
     import jax
 
     from icikit.bench.train import PRESETS
@@ -288,20 +398,27 @@ def run_bench(preset: str, rows: int, n_requests: int, rate_rps: float,
     from icikit.models.transformer.model import make_model_mesh
     from icikit.serve import ServeConfig
 
-    over = dict(PRESETS[preset])
     horizon = prompt_len + new_max + max(0, speculate - 1)
-    over["max_seq"] = max(over["max_seq"], horizon)
-    if compute_dtype:
-        # CPU protocol note: XLA:CPU re-packs bf16 weight operands to
-        # fp32 on every program call — generate's scanned loop hoists
-        # that conversion, the engine's per-call step cannot (measured
-        # 54 vs 27 ms per b=4 small-preset step), so a bf16 CPU row
-        # would charge the engine an XLA:CPU artifact a native-bf16
-        # TPU never pays. fp32 puts both modes on the same arithmetic.
-        over["compute_dtype"] = compute_dtype
-    cfg = TransformerConfig(**over, decode_quant=decode_quant)
-    mesh = make_model_mesh(dp=dp, tp=tp, sp=1)
-    params = init_params(jax.random.key(0), cfg, mesh)
+    if model is not None:
+        params, mesh, cfg = model
+        if cfg.max_seq < horizon:
+            raise ValueError(f"model max_seq={cfg.max_seq} < workload "
+                             f"horizon {horizon}")
+    else:
+        over = dict(PRESETS[preset])
+        over["max_seq"] = max(over["max_seq"], horizon)
+        if compute_dtype:
+            # CPU protocol note: XLA:CPU re-packs bf16 weight operands
+            # to fp32 on every program call — generate's scanned loop
+            # hoists that conversion, the engine's per-call step
+            # cannot (measured 54 vs 27 ms per b=4 small-preset step),
+            # so a bf16 CPU row would charge the engine an XLA:CPU
+            # artifact a native-bf16 TPU never pays. fp32 puts both
+            # modes on the same arithmetic.
+            over["compute_dtype"] = compute_dtype
+        cfg = TransformerConfig(**over, decode_quant=decode_quant)
+        mesh = make_model_mesh(dp=dp, tp=tp, sp=1)
+        params = init_params(jax.random.key(0), cfg, mesh)
     if decode_quant == "int8":
         # quantize ONCE, outside every timed window: the engine already
         # converts at setup; without this hoist the STATIC baseline
@@ -325,10 +442,15 @@ def run_bench(preset: str, rows: int, n_requests: int, rate_rps: float,
                             ngram_n=ngram_n, integrity=integrity,
                             prefix_cache=prefix_cache,
                             prefill_chunk=prefill_chunk,
-                            drafter=drafter)
-    workload = make_workload(n_requests, rate_rps, prompt_len, new_min,
-                             new_max, cfg.vocab, seed,
-                             prefix_len=prefix_len)
+                            drafter=drafter,
+                            inflight_dedup=inflight_dedup)
+    if workload is None:
+        workload = make_workload(n_requests, rate_rps, prompt_len,
+                                 new_min, new_max, cfg.vocab, seed,
+                                 prefix_len=prefix_len,
+                                 distinct=distinct,
+                                 seed_per_request=seed_per_request,
+                                 motif=motif)
     warm = warm_prompts(workload, cfg.vocab, prefix_len, seed)
     common = {
         "kind": "serve",
@@ -349,6 +471,15 @@ def run_bench(preset: str, rows: int, n_requests: int, rate_rps: float,
         "prefill_chunk": prefill_chunk,
         "drafter": drafter,
         "seed": seed,
+        "temperature": temperature,
+        "top_k": top_k, "top_p": top_p,
+        "seed_per_request": seed_per_request,
+        "distinct": distinct,
+        # the EFFECTIVE state ("auto" follows prefix_cache) so A/B
+        # rows record what actually ran
+        "inflight_dedup": (prefix_cache if inflight_dedup == "auto"
+                           else bool(inflight_dedup)),
+        "motif": motif,
         # measured-where-we-ran provenance (the decode-bench rule):
         # CPU rows price the ratio, a v5e session prices the absolute
         "note": ("CPU-measured" if jax.default_backend() == "cpu"
@@ -358,10 +489,12 @@ def run_bench(preset: str, rows: int, n_requests: int, rate_rps: float,
     if mode in ("both", "continuous"):
         recs.append({**common, **run_continuous(
             params, mesh, cfg, serve_cfg, workload, warm=warm,
-            verify=verify)})
+            verify=verify, temperature=temperature, top_k=top_k,
+            top_p=top_p)})
     if mode in ("both", "static"):
-        recs.append({**common, **run_static(params, mesh, cfg, rows,
-                                            workload)})
+        recs.append({**common, **run_static(
+            params, mesh, cfg, rows, workload,
+            temperature=temperature, top_k=top_k, top_p=top_p)})
     return recs
 
 
@@ -401,8 +534,38 @@ def main(argv=None) -> int:
                          "suffix-automaton upgrade")
     ap.add_argument("--verify-identity", action="store_true",
                     help="re-decode every completed request through "
-                         "single-request generate and record the "
-                         "token-identity audit in the row")
+                         "single-request generate (sampled arms: "
+                         "sample_generate with the per-request stream "
+                         "seeds) and record the token-identity audit "
+                         "in the row")
+    ap.add_argument("--temperature", type=float, default=0.0,
+                    help="sampled serving: > 0 samples every request "
+                         "at this temperature under per-request "
+                         "counter-keyed streams (0 = greedy)")
+    ap.add_argument("--top-k", type=int, default=0,
+                    help="sampled serving: top-k filter (0 = off)")
+    ap.add_argument("--top-p", type=float, default=1.0,
+                    help="sampled serving: nucleus filter (1 = off)")
+    ap.add_argument("--seed-per-request", action="store_true",
+                    help="give each request its own sampling-stream "
+                         "seed (arrival index); default: all share "
+                         "stream 0")
+    ap.add_argument("--distinct", type=int, default=0, metavar="D",
+                    help="duplicate-prompt workload: draw only D "
+                         "distinct prompts and cycle arrivals through "
+                         "them (0 = all distinct) — the in-flight "
+                         "dedup traffic shape")
+    ap.add_argument("--inflight-dedup", default="auto",
+                    choices=["auto", "on", "off"],
+                    help="in-flight prefill dedup (waiters attach to "
+                         "a concurrent identical prefill instead of "
+                         "recomputing) — the r12 A/B knob; 'auto' "
+                         "follows --prefix-cache, 'on' without the "
+                         "cache is rejected loudly")
+    ap.add_argument("--motif", type=int, default=0, metavar="M",
+                    help="repetitive workload: each prompt is a "
+                         "random M-token motif tiled to the prompt "
+                         "length (0 = fully random prompts)")
     ap.add_argument("--speculate", type=int, default=1, metavar="K",
                     help="k-token ngram-drafted verify windows "
                          "(1 = single-token decode)")
@@ -437,7 +600,12 @@ def main(argv=None) -> int:
                      args.seed, args.mode, args.compute_dtype,
                      args.decode_quant, args.prefix,
                      args.prefix_cache == "on", args.prefill_chunk,
-                     args.drafter, args.verify_identity)
+                     args.drafter, args.verify_identity,
+                     args.temperature, args.top_k, args.top_p,
+                     args.seed_per_request, args.distinct,
+                     {"on": True, "off": False,
+                      "auto": "auto"}[args.inflight_dedup],
+                     args.motif)
     obs.emit_records(recs)
     if args.json_path:
         # append: record files accumulate across invocations

@@ -39,6 +39,7 @@ from icikit.models.transformer.decode import (  # noqa: F401
 )
 from icikit.models.transformer.speculative import (  # noqa: F401
     speculative_generate,
+    speculative_sample_generate,
 )
 from icikit.models.transformer.moe import moe_ffn_shard  # noqa: F401
 from icikit.models.transformer.pipeline import (  # noqa: F401

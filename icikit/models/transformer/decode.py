@@ -9,9 +9,17 @@ cache — O(T) per token instead of O(T²) re-forward. Runs on the same
 Megatron pairs exactly as in ``model._forward_local``.
 
 Token selection is pluggable: greedy argmax (``greedy_generate``) or
-temperature / top-k / nucleus sampling (``sample_generate``, keyed by a
-JAX PRNG key folded with the dp shard index and step, so shards and
-steps draw independently and runs are reproducible).
+temperature / top-k / nucleus sampling (``sample_generate``). Sampling
+rides a **schedule-invariant key discipline** (round 12): each row
+draws from a per-request stream ``fold_in(key, seed)``, and the draw
+deciding the token at absolute position ``p`` is keyed
+``fold_in(stream, p)`` — counter-based, never by step count, batch
+slot, or dp shard — so a request's sampled tokens are bitwise
+independent of co-batching, mesh layout, and verify-window shape.
+That is what lets the serving engine pin sampled outputs bitwise
+against single-request ``sample_generate`` and makes speculative
+sampling (``speculative_sample_generate``) distribution-exact AND
+sequence-identical to the non-speculative path.
 
 The per-layer building blocks (projection, attention close, FFN,
 logits head) live in ``_DecodeCtx`` so the weights-stationary
@@ -179,40 +187,134 @@ def _window_masked_attention_q8(q, ks, vs, ksc, vsc, mask, scale,
     return out.reshape(b, w_len, h, dh).astype(q.dtype)
 
 
-def _top_k_mask(lg, k):
-    thr = lax.top_k(lg, k)[0][:, -1:]
-    return jnp.where(lg < thr, -jnp.inf, lg)
+def _sample_filter(lg, temperature, top_k, top_p):
+    """One row's sampling filter over raw fp32 logits ``lg (V,)``:
+    temperature scale, then top-k, then nucleus — with every knob
+    TRACED (per-row knob values compile into one program; the static
+    ``lax.top_k`` is replaced by a sort-threshold, which keeps
+    threshold ties exactly like the static mask did). This is the ONE
+    filter formulation every sampled call site shares — the generate
+    loop, the speculative verify window, the serving engine's
+    step/chunk/prefill programs — so their filtered distributions are
+    the same traced computation and the sampled identity pins are
+    key-schedule facts, not numerics hopes. ``top_k <= 0`` and
+    ``top_p >= 1`` disable the respective filters."""
+    V = lg.shape[-1]
+    x = lg / jnp.maximum(temperature, 1e-6)
+    srt = jnp.sort(x)[::-1]         # the ONE O(V log V) pass per draw
+    kk = jnp.clip(top_k.astype(jnp.int32) - 1, 0, V - 1)
+    thr_k = jnp.where(top_k > 0, srt[kk], -jnp.inf)
+    x = jnp.where(x < thr_k, -jnp.inf, x)
+    # nucleus: keep the smallest sorted prefix with cum prob >= top_p.
+    # The top-k mask only sends a SUFFIX of the descending sort to
+    # -inf, so the filtered sort is derivable from ``srt`` — no second
+    # sort (the sort is the draw's dominant cost at real vocab sizes).
+    srt2 = jnp.where(srt < thr_k, -jnp.inf, srt)
+    probs = jax.nn.softmax(srt2)
+    cum = jnp.cumsum(probs)
+    keep = cum - probs < top_p      # first token always kept
+    thr_p = jnp.min(jnp.where(keep, srt2, jnp.inf))
+    out = jnp.where(x < thr_p, -jnp.inf, x)
+    # neutral knobs bypass BITWISE: a (top_k=0, top_p=1) row's output
+    # is exactly the temperature-scaled logits, never the filtered
+    # reconstruction — so the sort-free fast-path program (filters
+    # compiled out, see _select_token) and this full program select
+    # identically for such rows, and a serving engine may dispatch
+    # between them per step without perturbing any row's draw
+    neutral = (top_k <= 0) & (top_p >= 1.0)
+    return jnp.where(neutral, lg / jnp.maximum(temperature, 1e-6), out)
 
 
-def _top_p_mask(lg, p):
-    """Nucleus filter: keep the smallest prefix of the sorted
-    distribution with cumulative probability >= p (p = 1 keeps all)."""
-    srt = jnp.sort(lg, axis=-1)[:, ::-1]
-    probs = jax.nn.softmax(srt, axis=-1)
-    cum = jnp.cumsum(probs, axis=-1)
-    keep = cum - probs < p          # first token always kept
-    thr = jnp.min(jnp.where(keep, srt, jnp.inf), axis=-1, keepdims=True)
-    return jnp.where(lg < thr, -jnp.inf, lg)
+def _select_token(lg, key, knobs, filters: bool = True):
+    """One row's token draw: ``lg (V,)`` fp32 logits, ``key`` the
+    per-(request, position) PRNG key, ``knobs (3,)`` fp32 =
+    (temperature, top_p, top_k), all traced. ``temperature <= 0`` is
+    the greedy limit — the argmax of the RAW logits, bitwise what the
+    greedy path computes, so a sampled program serving greedy rows
+    reproduces the all-greedy program token-for-token (the serving
+    engine's mixed-batch containment) and rejection-sampled
+    speculation degenerates to the greedy longest-prefix accept.
+
+    ``filters`` is STATIC: False compiles the top-k/top-p machinery
+    (and its O(V log V) sort — the draw's dominant cost at real vocab
+    sizes) out entirely, for call sites that know every row runs pure
+    temperature sampling. Bitwise safe either way: the full filter
+    bypasses neutral-knob rows exactly (see ``_sample_filter``)."""
+    temperature, top_p, top_k = knobs[0], knobs[1], knobs[2]
+    if filters:
+        x = _sample_filter(lg, temperature, top_k, top_p)
+    else:
+        x = lg / jnp.maximum(temperature, 1e-6)
+    samp = jax.random.categorical(key, x)
+    return jnp.where(temperature > 0.0, samp,
+                     jnp.argmax(lg, axis=-1)).astype(jnp.int32)
+
+
+def select_tokens(logits, keys, knobs, filters: bool = True):
+    """Batched keyed selector: ``logits (b, V)`` or ``(b, k, V)``,
+    ``keys`` a matching ``(b,)`` / ``(b, k)`` key array, ``knobs``
+    ``(3,)`` shared or ``(b, 3)`` per row. Each row's draw is a
+    vmapped :func:`_select_token` — it depends only on (its logits,
+    its key, its knobs), never on what else sits in the batch, which
+    is the schedule-invariance the serving engine's identity pin
+    rides on."""
+    sel = lambda lg, k, kn: _select_token(lg, k, kn, filters)
+    per_row = knobs.ndim == 2
+    if logits.ndim == 2:
+        return jax.vmap(sel,
+                        in_axes=(0, 0, 0 if per_row else None))(
+            logits, keys, knobs)
+    inner = jax.vmap(sel, in_axes=(0, 0, None))
+    return jax.vmap(inner, in_axes=(0, 0, 0 if per_row else None))(
+        logits, keys, knobs)
+
+
+def fold_streams(key_data, seeds):
+    """Per-request sampling streams from a base key and per-row
+    ``seeds (b,)``: ``fold_in(base, seed)`` — request data, not batch
+    position, so a request keeps its stream wherever scheduling puts
+    it."""
+    base = jax.random.wrap_key_data(key_data)
+    return jax.vmap(lambda s: jax.random.fold_in(base, s))(seeds)
+
+
+def fold_positions(streams, pos):
+    """Counter-keyed draw keys: ``streams (b,)`` key array folded with
+    absolute positions ``pos (b,)`` or ``(b, k)`` — the draw deciding
+    the token at sequence position ``p`` is keyed ``fold_in(stream,
+    p)``, never by step count, batch slot, or verify-window shape."""
+    if pos.ndim == 1:
+        return jax.vmap(jax.random.fold_in)(streams, pos)
+    return jax.vmap(lambda s, ps: jax.vmap(
+        lambda p: jax.random.fold_in(s, p))(ps))(streams, pos)
+
+
+def request_stream_data(seed: int):
+    """Key data (host ndarray) of the canonical per-request sampling
+    stream ``fold_in(jax.random.key(0), seed)`` — bitwise the stream
+    :func:`sample_generate` derives for a row submitted with
+    ``key=jax.random.key(0), seeds=[seed]``. The serving engine stamps
+    this per request at admission, which makes engine ≡ generate
+    sampled identity a key-schedule fact, and makes lease-reap
+    reissue bitwise deterministic (the seed is request data, not
+    engine state)."""
+    import numpy as np
+    return np.asarray(jax.random.key_data(
+        jax.random.fold_in(jax.random.key(0), int(seed))))
 
 
 def _make_selector(sampling):
-    """sampling: ("greedy",) or ("sample", top_k) — only top_k must be
-    static (``lax.top_k``); temperature and top_p arrive as traced
-    scalars so sweeping them reuses one compiled program. Returns
-    select(logits (b, V) fp32, key, knobs (2,) fp32) -> (b,) int32."""
+    """sampling: ("greedy",) or ("sample", filters) — every sampling
+    KNOB is traced (one compiled program serves any temperature /
+    top-k / top-p value), only the structural ``filters`` flag is
+    static (it decides whether the sort-bearing filter machinery
+    compiles in at all). Returns select(logits (b, V) fp32, keys (b,)
+    key array, knobs (3,) fp32) -> (b,) int32."""
     if sampling[0] == "greedy":
-        return lambda logits, key, knobs: jnp.argmax(logits, axis=-1)
-    _, top_k = sampling
-
-    def select(logits, key, knobs):
-        temperature, top_p = knobs[0], knobs[1]
-        lg = logits / jnp.maximum(temperature, 1e-6)
-        if top_k:
-            lg = _top_k_mask(lg, top_k)
-        lg = _top_p_mask(lg, top_p)
-        return jax.random.categorical(key, lg, axis=-1)
-
-    return select
+        return lambda logits, keys, knobs: jnp.argmax(logits, axis=-1)
+    filters = sampling[1] if len(sampling) > 1 else True
+    return lambda logits, keys, knobs: select_tokens(
+        logits, keys, knobs, filters)
 
 
 class _DecodeCtx:
@@ -452,18 +554,23 @@ def _build_generate(mesh, cfg: TransformerConfig, s_prompt: int, n_new: int,
         cache_len = total
     layer_keys = ctx.layer_keys
 
-    def per_shard(params, prompt, key_data, knobs):
+    def per_shard(params, prompt, seeds, key_data, knobs):
         b = prompt.shape[0]
         lp = {k: params[k] for k in layer_keys}
-        # per-shard stream: dp shards hold different batch rows and must
-        # draw independently; tp/sp shards must agree (they replicate).
-        key = jax.random.fold_in(jax.random.wrap_key_data(key_data),
-                                 lax.axis_index(DP_AXIS))
+        # schedule-invariant per-request streams: each row's stream is
+        # fold_in(base, its seed) — request data, so the draw for the
+        # token at position p (keyed fold_in(stream, p)) is the same
+        # whatever batch, mesh, or admission schedule the row rides.
+        # (Pre-r12 this folded the dp shard index instead, which made
+        # sampled rows depend on their physical placement.)
+        streams = fold_streams(key_data, seeds)
 
         x, caches = _prefill(ctx, params, prompt, s_prompt,
                              cache_len, fused)
         tok0 = select(ctx.logits(params, x[:, -1]),
-                      jax.random.fold_in(key, 0), knobs)
+                      fold_positions(streams,
+                                     jnp.full((b,), s_prompt,
+                                              jnp.int32)), knobs)
 
         # --- decode loop: one position at a time against the cache.
         # Per-layer cache buffers ride the *carry* as a tuple and the
@@ -588,7 +695,9 @@ def _build_generate(mesh, cfg: TransformerConfig, s_prompt: int, n_new: int,
                 kc2.append(ks)
                 vc2.append(vs)
             nxt = select(ctx.logits(params, x[:, 0]),
-                         jax.random.fold_in(key, i + 1), knobs)
+                         fold_positions(streams, cur + 1
+                                        + jnp.zeros((b,), jnp.int32)),
+                         knobs)
             return (nxt, tuple(kc2), tuple(vc2), tuple(kss2),
                     tuple(vss2)), token
 
@@ -604,7 +713,7 @@ def _build_generate(mesh, cfg: TransformerConfig, s_prompt: int, n_new: int,
     from icikit.models.transformer.quant import decode_param_specs
     return wrap_program(per_shard, mesh,
                         (decode_param_specs(cfg), P(DP_AXIS, None),
-                         P(None), P(None)),
+                         P(DP_AXIS), P(None), P(None)),
                         P(DP_AXIS, None))
 
 
@@ -634,20 +743,13 @@ def greedy_generate(params, prompt, mesh, cfg: TransformerConfig,
     chaos.maybe_die("decode.prefill")     # prefill+decode program
     params = maybe_quantize_params(params, mesh, cfg)
     key_data = jax.random.key_data(jax.random.key(0))  # unused by greedy
-    knobs = jnp.ones((2,), jnp.float32)                 # unused by greedy
+    seeds = jnp.zeros((prompt.shape[0],), jnp.int32)    # unused by greedy
+    knobs = jnp.ones((3,), jnp.float32)                 # unused by greedy
     return _build_generate(mesh, cfg, prompt.shape[1], n_new)(
-        params, prompt, key_data, knobs)
+        params, prompt, seeds, key_data, knobs)
 
 
-def sample_generate(params, prompt, mesh, cfg: TransformerConfig,
-                    n_new: int, key, temperature: float = 1.0,
-                    top_k: int = 0, top_p: float = 1.0) -> jax.Array:
-    """Sampled continuation with temperature / top-k / nucleus filters.
-
-    ``key``: a ``jax.random`` PRNG key; the same key reproduces the same
-    continuation. ``top_k=0`` and ``top_p=1.0`` disable the respective
-    filters (``top_k=1`` reduces to greedy).
-    """
+def _check_sampling_args(cfg, temperature, top_k, top_p):
     if temperature < 0:
         raise ValueError(f"temperature must be >= 0, got {temperature}")
     if not 0.0 < top_p <= 1.0:
@@ -655,11 +757,40 @@ def sample_generate(params, prompt, mesh, cfg: TransformerConfig,
     if not 0 <= top_k <= cfg.vocab:
         raise ValueError(f"top_k must be in [0, vocab={cfg.vocab}], "
                          f"got {top_k}")
+
+
+def sample_generate(params, prompt, mesh, cfg: TransformerConfig,
+                    n_new: int, key, temperature: float = 1.0,
+                    top_k: int = 0, top_p: float = 1.0,
+                    seeds=None) -> jax.Array:
+    """Sampled continuation with temperature / top-k / nucleus filters,
+    on the **schedule-invariant key discipline**: row ``r`` draws from
+    the stream ``fold_in(key, seeds[r])``, and the draw deciding the
+    token at absolute position ``p`` is keyed ``fold_in(stream, p)``
+    (counter-based — never by step count, batch slot, or dp shard).
+    A row's continuation therefore depends only on (its prompt, its
+    seed, the knobs): it is bitwise invariant to batch composition,
+    mesh layout, and — via the same keys driving the speculative
+    verify window — to ``speculative_sample_generate``'s window width.
+
+    ``key``: a ``jax.random`` PRNG key; the same (key, seeds)
+    reproduces the same continuations. ``seeds``: per-row int32
+    request seeds (default ``arange(B)`` — distinct streams per row).
+    ``top_k=0`` and ``top_p=1.0`` disable the respective filters
+    (``top_k=1`` reduces to greedy; ``temperature=0`` IS greedy,
+    bitwise).
+    """
+    _check_sampling_args(cfg, temperature, top_k, top_p)
     from icikit import chaos
     chaos.maybe_delay("decode.prefill")
     chaos.maybe_die("decode.prefill")
     params = maybe_quantize_params(params, mesh, cfg)
-    knobs = jnp.asarray([temperature, top_p], jnp.float32)
+    if seeds is None:
+        seeds = jnp.arange(prompt.shape[0], dtype=jnp.int32)
+    else:
+        seeds = jnp.asarray(seeds, jnp.int32)
+    knobs = jnp.asarray([temperature, top_p, top_k], jnp.float32)
+    # filters static: pure temperature sampling compiles the sort out
     return _build_generate(mesh, cfg, prompt.shape[1], n_new,
-                           ("sample", int(top_k)))(
-        params, prompt, jax.random.key_data(key), knobs)
+                           ("sample", top_k > 0 or top_p < 1.0))(
+        params, prompt, seeds, jax.random.key_data(key), knobs)

@@ -40,11 +40,23 @@ Batching: rows accept different counts per step, so positions, masks
 and output offsets are per-row; finished rows freeze (their state
 re-commits identical values) until the slowest row reaches ``n_new``.
 
-Restrictions: greedy only (sampled speculative needs rejection-
-sampling bookkeeping — out of scope), ``sp = 1`` (as all decoding),
-and no MoE (``n_experts > 0`` routes tokens over a dp all-to-all
-inside the layer, which would deadlock under the per-shard-divergent
-while-loop trip counts).
+Round 12 extends the window to SAMPLED requests
+(``speculative_sample_generate``): the verify pass draws each window
+position's token from the temperature/top-k/top-p-filtered target
+distribution under the counter key ``fold_in(stream, position)`` and
+accepts the draft iff the draw equals it. With the repo's
+deterministic drafters (one-hot proposal q) that IS rejection
+sampling — accept prob ``min(1, p(t)/q(t)) = p(t)``, the mismatch
+draw is the normalized-residual resample — so the output is
+distribution-exact; and because the keys are the ones the
+non-speculative sampled loop would use, it is *sequence-identical*
+to ``sample_generate``, bitwise (``temperature → 0`` degenerates to
+the greedy longest-prefix accept, also bitwise).
+
+Restrictions: ``sp = 1`` (as all decoding) and no MoE
+(``n_experts > 0`` routes tokens over a dp all-to-all inside the
+layer, which would deadlock under the per-shard-divergent while-loop
+trip counts).
 """
 
 from __future__ import annotations
@@ -59,11 +71,15 @@ from jax.sharding import PartitionSpec as P
 
 from icikit import chaos, obs
 from icikit.models.transformer.decode import (
+    _check_sampling_args,
     _DecodeCtx,
     _prefill,
     _window_masked_attention,
     _window_masked_attention_q8,
+    fold_positions,
+    fold_streams,
     maybe_quantize_params,
+    select_tokens,
 )
 from icikit.models.transformer.model import (
     DP_AXIS,
@@ -158,7 +174,8 @@ def _window_pass(ctx: _DecodeCtx, params, lp, kc, vc, kss, vss, toks,
 @lru_cache(maxsize=None)
 def _build_speculative(mesh, cfg: TransformerConfig, s_prompt: int,
                        n_new: int, k: int, draft_layers: int,
-                       drafter: str = "shared", ngram_n: int = 3):
+                       drafter: str = "shared", ngram_n: int = 3,
+                       sampling: tuple = ("greedy",)):
     if n_new < 1:
         raise ValueError(f"n_new must be >= 1, got {n_new}")
     if k < 1:
@@ -214,9 +231,19 @@ def _build_speculative(mesh, cfg: TransformerConfig, s_prompt: int,
         # polices them exactly like model drafts
         from icikit.serve.ngram_draft import ngram_propose
 
-    def per_shard(params, prompt):
+    sampled = sampling[0] == "sample"
+    filters = (sampling[1] if sampled and len(sampling) > 1 else True)
+
+    def per_shard(params, prompt, seeds, key_data, knobs):
         b = prompt.shape[0]
         lp = {kk: params[kk] for kk in ctx.layer_keys}
+        # per-request streams under the counter key discipline (see
+        # decode.sample_generate): the draw for the token at absolute
+        # position p is keyed fold_in(stream, p) — identical keys to
+        # the non-speculative sampled loop, which is what makes the
+        # rejection-sampled window SEQUENCE-identical to it, not just
+        # distribution-exact
+        streams = (fold_streams(key_data, seeds) if sampled else None)
         x, caches = _prefill(ctx, params, prompt, s_prompt,
                              cache_len, fused=False)
         if ctx.quant:
@@ -228,7 +255,15 @@ def _build_speculative(mesh, cfg: TransformerConfig, s_prompt: int,
             kss, vss = (), ()
         kc = tuple(kcache[li] for li in range(n_layers))
         vc = tuple(vcache[li] for li in range(n_layers))
-        tok0 = jnp.argmax(ctx.logits(params, x[:, -1]), axis=-1)
+        lg0 = ctx.logits(params, x[:, -1])
+        if sampled:
+            tok0 = select_tokens(
+                lg0, fold_positions(streams,
+                                    jnp.full((b,), s_prompt,
+                                             jnp.int32)), knobs,
+                filters)
+        else:
+            tok0 = jnp.argmax(lg0, axis=-1)
 
         out = jnp.zeros((b, W), jnp.int32).at[:, 0].set(
             tok0.astype(jnp.int32))
@@ -278,10 +313,31 @@ def _build_speculative(mesh, cfg: TransformerConfig, s_prompt: int,
             x, kc, vc, kss, vss = _window_pass(
                 ctx, params, lp, kc, vc, kss, vss, w_toks, cur,
                 range(n_layers), cache_len)
-            g = jnp.argmax(ctx.logits(params, x),
-                           axis=-1).astype(jnp.int32)    # (b, k)
+            g_lg = ctx.logits(params, x)                 # (b, k, V)
+            if sampled:
+                # Rejection-sampled verify (Leviathan/Chen speculative
+                # sampling specialized to DETERMINISTIC drafters): the
+                # proposal distribution q is one-hot at the drafted
+                # token, so accept-with-prob min(1, p(t)/q(t)) = p(t)
+                # and the residual (p − q)+ normalizes to p with t
+                # removed. Drawing t_j ~ p_j with the POSITION key
+                # fold_in(stream, cur+1+j) implements exactly that:
+                # conditioned on t_j == draft_j the draft is accepted
+                # (prob p_j(draft_j)); conditioned on t_j != draft_j,
+                # t_j IS a sample from the normalized residual. And
+                # because the key is the one the non-speculative loop
+                # would use at that position, the committed sequence
+                # is bitwise the sequential sample — speculation
+                # changes the cost structure, never the sample.
+                wkeys = fold_positions(
+                    streams, cur[:, None] + 1 + jnp.arange(k)[None, :])
+                g = select_tokens(g_lg, wkeys, knobs,
+                                  filters)         # (b, k)
+            else:
+                g = jnp.argmax(g_lg, axis=-1).astype(jnp.int32)
 
-            # longest accepted prefix (shared accept rule)
+            # longest accepted prefix (shared accept rule; under
+            # sampling "the model's choice" is the keyed draw)
             m, a, new_tok = _accept_window(w_toks, g, active)
 
             # commit g[:, :m+1] at the row's output offset (the tail of
@@ -306,7 +362,8 @@ def _build_speculative(mesh, cfg: TransformerConfig, s_prompt: int,
 
     from icikit.models.transformer.quant import decode_param_specs
     return wrap_program(per_shard, mesh,
-                        (decode_param_specs(cfg), P(DP_AXIS, None)),
+                        (decode_param_specs(cfg), P(DP_AXIS, None),
+                         P(DP_AXIS), P(None), P(None)),
                         (P(DP_AXIS, None), P()))
 
 
@@ -357,6 +414,66 @@ def speculative_generate(params, prompt, mesh, cfg: TransformerConfig,
     one device readback per *generation*, after the jitted loop; the
     accept/commit logic itself runs on device.
     """
+    return _run_speculative(params, prompt, mesh, cfg, n_new, k,
+                            draft_layers, return_stats, drafter,
+                            ngram_n)
+
+
+def speculative_sample_generate(params, prompt, mesh,
+                                cfg: TransformerConfig, n_new: int,
+                                key, k: int = 4,
+                                temperature: float = 1.0,
+                                top_k: int = 0, top_p: float = 1.0,
+                                seeds=None,
+                                draft_layers: int | None = None,
+                                return_stats: bool = False,
+                                drafter: str = "auto",
+                                ngram_n: int = 3):
+    """SAMPLED continuation via speculative multi-token decode —
+    rejection-sampled verification makes it **distribution-exact**
+    under temperature / top-k / top-p, and the counter key discipline
+    makes it **sequence-identical**, bitwise, to
+    ``sample_generate(params, prompt, mesh, cfg, n_new, key, ...)``
+    with the same ``(key, seeds)`` for ANY ``k`` / draft depth /
+    drafter (pinned in ``tests/test_sampled.py``).
+
+    Construction: the repo's drafters (shared / trained / ngram) all
+    propose deterministically, so the proposal distribution q is
+    one-hot at the drafted token; the standard accept rule
+    ``min(1, p(t)/q(t))`` then reduces to "accept the draft with
+    probability p(draft)", and the residual resample ``(p − q)+`` is
+    a draw from p conditioned off the draft. Drawing t ~ p with the
+    position-counter key implements both at once — and because that
+    key is exactly the one the non-speculative sampled loop uses at
+    that position, every committed token is the identical draw. The
+    ``temperature=0`` limit is the greedy longest-prefix accept,
+    bitwise (``_select_token`` argmaxes raw logits there).
+
+    Sampling args are ``sample_generate``'s (per-row ``seeds``
+    streams, traced knobs); speculation args are
+    ``speculative_generate``'s. Acceptance telemetry flows through
+    ``icikit.obs`` identically.
+    """
+    _check_sampling_args(cfg, temperature, top_k, top_p)
+    if seeds is None:
+        seeds = jnp.arange(prompt.shape[0], dtype=jnp.int32)
+    else:
+        seeds = jnp.asarray(seeds, jnp.int32)
+    knobs = jnp.asarray([temperature, top_p, top_k], jnp.float32)
+    return _run_speculative(params, prompt, mesh, cfg, n_new, k,
+                            draft_layers, return_stats, drafter,
+                            ngram_n,
+                            sampling=("sample",
+                                      top_k > 0 or top_p < 1.0),
+                            seeds=seeds,
+                            key_data=jax.random.key_data(key),
+                            knobs=knobs)
+
+
+def _run_speculative(params, prompt, mesh, cfg, n_new, k, draft_layers,
+                     return_stats, drafter, ngram_n,
+                     sampling=("greedy",), seeds=None, key_data=None,
+                     knobs=None):
     if drafter not in ("auto", "shared", "trained", "ngram"):
         raise ValueError(f"unknown drafter {drafter!r} "
                          "(known: auto, shared, trained, ngram)")
@@ -380,6 +497,10 @@ def speculative_generate(params, prompt, mesh, cfg: TransformerConfig,
             draft_layers = draft_exit_layer(cfg)
     if draft_layers is None:
         draft_layers = max(1, cfg.n_layers // 2)
+    if seeds is None:       # greedy: sampling inputs are dead args
+        seeds = jnp.zeros((prompt.shape[0],), jnp.int32)
+        key_data = jax.random.key_data(jax.random.key(0))
+        knobs = jnp.ones((3,), jnp.float32)
     # chaos sites (host boundaries of the decode pipeline): prefill/
     # program dispatch, drafter selection, and the stats readback —
     # drilled by tests/test_chaos_decode.py
@@ -389,10 +510,12 @@ def speculative_generate(params, prompt, mesh, cfg: TransformerConfig,
     chaos.maybe_die(f"decode.spec.drafter.{drafter}")
     params = maybe_quantize_params(params, mesh, cfg)
     with obs.span("decode.speculative", k=k, draft_layers=draft_layers,
-                  n_new=n_new, drafter=drafter):
+                  n_new=n_new, drafter=drafter,
+                  sampled=sampling[0] == "sample"):
         toks, stats = _build_speculative(
             mesh, cfg, prompt.shape[1], n_new, int(k),
-            int(draft_layers), drafter, int(ngram_n))(params, prompt)
+            int(draft_layers), drafter, int(ngram_n), sampling)(
+            params, prompt, seeds, key_data, knobs)
         # SDC drill on the telemetry boundary: a corrupted stats
         # readback must skew counters only, never the committed tokens
         s = chaos.maybe_corrupt("decode.spec.verify.stats",

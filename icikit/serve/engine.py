@@ -160,6 +160,19 @@ class ServeConfig:
     # prompt prefixes instead of recomputing them. Off = every
     # admission recomputes its full prompt (the A/B baseline arm).
     prefix_cache: bool = True
+    # in-flight prefill dedup (r12): admission announces the chain
+    # hashes of the blocks it is ABOUT to compute; a concurrent
+    # identical/prefix admission whose next needed hash is announced
+    # becomes a WAITER — it attaches to the blocks as the prefiller
+    # finalizes them (progressive registration, riding the r11
+    # refcount/CoW index) instead of computing them itself. If the
+    # prefiller vanishes (eviction/preemption; an engine death takes
+    # the waiter with it and both reissue through lease expiry), the
+    # announcement vanishes and the waiter computes the remainder.
+    # Requires prefix_cache (fp side): "auto" follows prefix_cache,
+    # an explicit True without the cache is rejected loudly, and the
+    # A/B baseline arm passes False.
+    inflight_dedup: bool | str = "auto"
     # prefill chunk ceiling: uncached prompt suffixes stream through
     # bucket-width chunk programs (powers of two up to this value),
     # one chunk per engine loop pass. Set >= max_prompt for
@@ -195,6 +208,13 @@ class _Row:
     # extends this by ONE block (O(block), not a re-hash from zero).
     # Default = chain_seed("fp"); admission overrides for hits/sides.
     chain: bytes = b"fp"
+    # the prompt's full-block chain hashes (fp/prefix-cache side only)
+    # — kept for the waiter's per-pass re-lookup and for withdrawing
+    # in-flight announcements on eviction
+    hashes: list = field(default_factory=list)
+    # in-flight dedup: True while this row is parked waiting for a
+    # concurrent prefiller to finalize the blocks it announced
+    waiting: bool = False
     # tokens accumulate HERE, not on the shared Request object: the
     # claim-seq fence covers queue mutations, but a stalled engine
     # resuming after its lease was reaped must also be unable to
@@ -236,6 +256,19 @@ class Engine:
             raise ValueError(
                 f"prefill_chunk must be >= 1, got "
                 f"{serve.prefill_chunk}")
+        dd = serve.inflight_dedup
+        if dd not in (True, False, "auto"):
+            raise ValueError(f"unknown inflight_dedup {dd!r} "
+                             "(known: auto, True, False)")
+        if dd == "auto":
+            dd = serve.prefix_cache
+        elif dd and not serve.prefix_cache:
+            raise ValueError(
+                "inflight_dedup=True requires prefix_cache: waiters "
+                "attach through the shared block index, which does "
+                "not exist with the cache off (use 'auto' to follow "
+                "prefix_cache, or False for the A/B baseline)")
+        self.dedup = bool(dd)
         self.dp = mesh.shape[DP_AXIS]
         if serve.max_rows % self.dp:
             raise ValueError(
@@ -295,11 +328,27 @@ class Engine:
         self._btab = np.zeros((B, self.nb_per_row), np.int32)
         self._seq_buf = np.zeros(
             (B, serve.max_prompt + serve.max_new), np.int32)
-        # mixed mode compiles two step variants and dispatches per
-        # step on whether a quantized row is resident (see _build_step)
+        # per-request sampling state (r12): each occupied slot's
+        # stream-key data (the canonical fold_in(key(0), seed) —
+        # derived in decode.request_stream_data, so serve/ builds no
+        # keys of its own) and its (temperature, top_p, top_k) knobs.
+        # Greedy rows carry temperature 0, which the selector maps to
+        # raw-logit argmax bitwise.
+        from icikit.models.transformer.decode import request_stream_data
+        self._stream_data = request_stream_data
+        proto = request_stream_data(0)
+        self._kdat = np.zeros((B,) + proto.shape, proto.dtype)
+        self._knobs = np.zeros((B, 3), np.float32)
+        self._knobs[:, 1] = 1.0          # top_p neutral
+        # step variants are compiled per (quantized-row-resident,
+        # sampled-row-resident) and dispatched per step — an all-fp /
+        # all-greedy batch pays zero quantization / sampling traffic,
+        # and flipping programs mid-request cannot change a greedy or
+        # fp row's tokens (see _build_step)
         self._step_fns: dict = {}
-        # fp admissions: chunk programs keyed by bucket width — the
-        # ladder is finite, so so is the cache (the satellite bound)
+        # fp admissions: chunk programs keyed by (bucket width,
+        # sampled-final-chunk) — the ladder is finite, so so is the
+        # cache (the satellite bound)
         self._chunk_fns: dict = {}
         self._chunk_widths = self._bucket_ladder(serve.prefill_chunk)
         # q8 admissions: exact-length prefill programs, LRU-capped
@@ -308,7 +357,8 @@ class Engine:
         # per-slot suffix-automaton drafter state (drafter="suffix")
         self._automata: dict = {}
         self._prefix = {"hits": 0, "misses": 0, "hit_tokens": 0,
-                        "full_hits": 0, "cow": 0}
+                        "full_hits": 0, "cow": 0, "inflight_hits": 0,
+                        "inflight_hit_tokens": 0, "prefill_tokens": 0}
         self.n_steps = 0
         self._occ_rows = 0       # sum of active rows over steps
 
@@ -365,7 +415,8 @@ class Engine:
         from icikit.models.transformer.model import DP_AXIS, TP_AXIS
         return P(DP_AXIS, None, None, TP_AXIS)
 
-    def _build_step(self, quant_live: bool):
+    def _build_step(self, quant_live: bool, sampled: bool,
+                    filters: bool = True):
         """Compile one step program. ``quant_live`` matters only in
         "mixed" mode: the False variant skips the q8 quantize/write/
         dequant-gather entirely (arenas pass through untouched) so an
@@ -373,7 +424,14 @@ class Engine:
         host dispatches on ``self._isq.any()`` per step, and fp rows
         compute identically in both variants (their gather reads the
         fp arena either way), so flipping programs mid-request cannot
-        change an fp row's tokens."""
+        change an fp row's tokens. ``sampled`` is the same move for
+        sampling (r12): the False variant IS the pre-r12 greedy
+        program (the key/knob inputs thread through dead); the True variant
+        selects each window position's token with the row's counter
+        key — and greedy rows there carry temperature 0, which the
+        shared selector maps to raw-logit argmax, so flipping
+        variants mid-request cannot change a greedy row's tokens
+        either (the mixed-batch containment pin)."""
         import jax.numpy as jnp
         from jax.sharding import PartitionSpec as P
 
@@ -381,6 +439,8 @@ class Engine:
             _DecodeCtx,
             _window_masked_attention,
             _window_masked_attention_q8,
+            fold_positions,
+            select_tokens,
         )
         from icikit.models.transformer.model import DP_AXIS
         from icikit.models.transformer.quant import decode_param_specs
@@ -402,7 +462,7 @@ class Engine:
             touch_q8 = mode in ("int8", "mixed")
 
         def per_shard(params, toks, curs, active, isq, btab, drafts,
-                      bufs):
+                      kdat, knobs, bufs):
             b = toks.shape[0]
             lp = {kk: params[kk] for kk in ctx.layer_keys}
             w_toks = jnp.concatenate([toks[:, None], drafts], axis=1)
@@ -483,8 +543,20 @@ class Engine:
                                                     ctx.n_rep)
                 x = ctx.close_attn(x, attn, lp1)
                 x = ctx.ffn(x, lp1)
-            g = jnp.argmax(ctx.logits(params, x),
-                           axis=-1).astype(jnp.int32)        # (b, k)
+            g_lg = ctx.logits(params, x)                     # (b, k, V)
+            if sampled:
+                # per-(row, position) counter keys: the token decided
+                # at window slot j lands at position pos[:, j] + 1 —
+                # the identical key (and identical filter math, via
+                # the shared selector) sample_generate uses there,
+                # which is the engine ≡ generate sampled identity
+                import jax as _jax
+                streams = _jax.random.wrap_key_data(kdat)
+                g = select_tokens(g_lg,
+                                  fold_positions(streams, pos + 1),
+                                  knobs, filters)
+            else:
+                g = jnp.argmax(g_lg, axis=-1).astype(jnp.int32)
             # the ONE accept rule, shared with speculative_generate —
             # the engine-vs-generate identity contract hangs on it
             _, a, new_tok = _accept_window(w_toks, g, active)
@@ -504,11 +576,13 @@ class Engine:
             per_shard, mesh=self.mesh,
             in_specs=(decode_param_specs(cfg), P(DP_AXIS), P(DP_AXIS),
                       P(DP_AXIS), P(DP_AXIS), P(DP_AXIS, None),
+                      P(DP_AXIS, None), P(DP_AXIS, None),
                       P(DP_AXIS, None), bspecs),
             out_specs=(P(DP_AXIS, None), P(DP_AXIS), P(DP_AXIS),
-                       bspecs)), donate_argnums=(7,))
+                       bspecs)), donate_argnums=(9,))
 
-    def _build_chunk(self, width: int):
+    def _build_chunk(self, width: int, sampled: bool = False,
+                     filters: bool = True):
         """One compiled prefill-chunk program for fp-side admissions —
         the replacement for the per-prompt-length program zoo.
 
@@ -518,10 +592,14 @@ class Engine:
         attends the row's whole paged view under the per-position
         causal mask — so chunk 2's queries read chunk 1's (or a cache
         hit's) K/V straight from the pool, and the per-position math
-        is exactly the step program's. ``tok0`` (the argmax at the
-        last valid position) is only meaningful on the chunk that
-        covers position ``s_prompt - 1``, and only on the owner shard
-        (other shards gather trash), hence the per-shard out spec.
+        is exactly the step program's. ``tok0`` (the selection at the
+        last valid position — argmax, or under ``sampled`` the keyed
+        draw at position ``s_prompt``) is only meaningful on the
+        chunk that covers position ``s_prompt - 1``, and only on the
+        owner shard (other shards gather trash), hence the per-shard
+        out spec. ``sampled`` variants are compiled only for the
+        FINAL chunk of a sampled request (mid-chunks discard tok0),
+        so the greedy path never pays the draw.
 
         In "mixed" mode this program serves fp rows only (q8 rows take
         the exact ``_prefill`` path — see the module docstring): the
@@ -533,6 +611,8 @@ class Engine:
         from icikit.models.transformer.decode import (
             _DecodeCtx,
             _window_masked_attention,
+            fold_positions,
+            select_tokens,
         )
         from icikit.models.transformer.model import DP_AXIS
         from icikit.models.transformer.quant import decode_param_specs
@@ -550,7 +630,8 @@ class Engine:
                 "chunk programs are fp-side only; int8 admissions use "
                 "the exact _prefill path")
 
-        def per_shard(params, toks, p0, n_valid, btab, bufs):
+        def per_shard(params, toks, p0, n_valid, btab, kdat, knobs,
+                      bufs):
             # toks (1, width) replicated across shards; btab (1, NB)
             # is the owner's table on its shard, all-zero elsewhere —
             # non-owner shards write (and gather) the trash block
@@ -587,8 +668,18 @@ class Engine:
                 x = ctx.ffn(x, lp1)
             xl = jax.lax.dynamic_slice_in_dim(x, n_valid[0] - 1, 1,
                                               axis=1)
-            tok0 = jnp.argmax(ctx.logits(params, xl[:, 0]),
-                              axis=-1).astype(jnp.int32)
+            lg0 = ctx.logits(params, xl[:, 0])
+            if sampled:
+                # first-token draw at position s_prompt = p0 + n_valid
+                # — the identical counter key (and vmapped selector)
+                # sample_generate's tok0 uses after its own prefill
+                streams = jax.random.wrap_key_data(kdat)
+                tok0 = select_tokens(
+                    lg0, fold_positions(
+                        streams, (p0 + n_valid).astype(jnp.int32)),
+                    knobs[0], filters)
+            else:
+                tok0 = jnp.argmax(lg0, axis=-1).astype(jnp.int32)
             return tok0, {kk: tuple(v) for kk, v in out.items()}
 
         bspecs = self.pool.buffer_specs(self._pool_spec(),
@@ -597,21 +688,30 @@ class Engine:
         return jax.jit(_shard_map(
             per_shard, mesh=self.mesh,
             in_specs=(decode_param_specs(cfg), P(None, None), P(None),
-                      P(None), P(DP_AXIS, None), bspecs),
-            out_specs=(P(DP_AXIS), bspecs)), donate_argnums=(5,))
+                      P(None), P(DP_AXIS, None), P(None, None),
+                      P(None, None), bspecs),
+            out_specs=(P(DP_AXIS), bspecs)), donate_argnums=(7,))
 
-    def _build_prefill(self, s_prompt: int):
+    def _build_prefill(self, s_prompt: int, sampled: bool = False,
+                       filters: bool = True):
         """Exact-length whole-prompt prefill for QUANTIZED admissions:
         the prompt's own attention runs on the raw projections and
         quantization happens at store time — the deployed int8-prefill
         semantics the r10 parity metric was corrected to honor, which
         a write-then-gather chunk over int8 pages cannot reproduce.
         On a "mixed" engine only the q8 arenas are touched (each
-        request pays exactly its own side's bytes)."""
+        request pays exactly its own side's bytes). ``sampled`` draws
+        tok0 with the row's counter key at position ``s_prompt``
+        (engine ≡ int8 ``sample_generate``, same contract as fp)."""
         import jax.numpy as jnp
         from jax.sharding import PartitionSpec as P
 
-        from icikit.models.transformer.decode import _DecodeCtx, _prefill
+        from icikit.models.transformer.decode import (
+            _DecodeCtx,
+            _prefill,
+            fold_positions,
+            select_tokens,
+        )
         from icikit.models.transformer.model import DP_AXIS
         from icikit.models.transformer.quant import decode_param_specs
         from icikit.ops.quant import quantize_last
@@ -622,13 +722,21 @@ class Engine:
         npref = -(-s_prompt // bs)
         n_layers = cfg.n_layers
 
-        def per_shard(params, prompt, pages, bufs):
+        def per_shard(params, prompt, pages, kdat, knobs, bufs):
             # prompt replicated: every shard computes the same prefill;
             # only the owner shard's pages are real (others trash 0)
+            import jax as _jax
             x, caches = _prefill(ctx, params, prompt, s_prompt,
                                  npref * bs, fused=False)
-            tok0 = jnp.argmax(ctx.logits(params, x[:, -1]),
-                              axis=-1).astype(jnp.int32)
+            lg0 = ctx.logits(params, x[:, -1])
+            if sampled:
+                streams = _jax.random.wrap_key_data(kdat)
+                tok0 = select_tokens(
+                    lg0, fold_positions(
+                        streams, jnp.full((1,), s_prompt, jnp.int32)),
+                    knobs[0], filters)
+            else:
+                tok0 = jnp.argmax(lg0, axis=-1).astype(jnp.int32)
             if ctx.quant:            # mode == "int8": already int8
                 kcache, vcache, kscache, vscache = caches
             else:
@@ -668,9 +776,10 @@ class Engine:
         return jax.jit(_shard_map(
             per_shard, mesh=self.mesh,
             in_specs=(decode_param_specs(cfg), P(None, None),
-                      P(DP_AXIS, None), bspecs),
+                      P(DP_AXIS, None), P(None, None), P(None, None),
+                      bspecs),
             out_specs=(P(None), bspecs)),
-            donate_argnums=(3,)), npref
+            donate_argnums=(5,)), npref
 
     # -- admission ---------------------------------------------------
 
@@ -679,6 +788,14 @@ class Engine:
             if row is None:
                 return s
         return None
+
+    def _refresh_btab(self, slot: int, row: _Row) -> None:
+        # re-derive the slot's zero-padded block table from the
+        # allocator's (the single source of truth after any
+        # alloc/share/CoW)
+        table = self.pool.allocators[row.shard].table(row.owner)
+        self._btab[slot] = 0
+        self._btab[slot, :len(table)] = table
 
     def _shard_of(self, slot: int) -> int:
         return slot // (self.serve.max_rows // self.dp)
@@ -706,6 +823,10 @@ class Engine:
                 f"{req.rid}: quant request on an engine with no int8 "
                 "KV arena (kv_quant='none') — silently serving it at "
                 "full precision would misreport the path it priced")
+        if req.top_k > self.cfg.vocab:
+            raise PoisonedPromptError(
+                f"{req.rid}: top_k={req.top_k} exceeds "
+                f"vocab={self.cfg.vocab}")
 
     def _admit(self) -> int:
         """Admit queued requests into free slots; returns how many.
@@ -745,6 +866,8 @@ class Engine:
             hit: list = []
             bs = self.serve.block_size
             chain_hexes: list = []
+            dedup = self.dedup and side == "fp"
+            waiting = False
             try:
                 if self.serve.prefix_cache and side == "fp":
                     chain_hexes = block_hashes(prompt, bs, side)
@@ -758,7 +881,17 @@ class Engine:
                             # position s-1 (its write CoW-forks the
                             # shared tail block in _prefill_chunk)
                             p0 = s - 1
-                self.pool.ensure(owner, shard, s)
+                # in-flight dedup: if the NEXT block this admission
+                # would compute is already being computed by a
+                # co-resident prefiller, park as a waiter — attach to
+                # the blocks as the prefiller finalizes them instead
+                # of duplicating the compute. Suffix blocks (and the
+                # pool window) allocate only once waiting resolves.
+                waiting = (dedup and len(hit) < len(chain_hexes)
+                           and self.pool.announced(
+                               shard, chain_hexes[len(hit)]))
+                if not waiting:
+                    self.pool.ensure(owner, shard, s)
             except PoolExhausted:
                 # not the request's fault: back off without burning a
                 # retry — admission re-attempts once rows evict
@@ -766,6 +899,14 @@ class Engine:
                 self.queue.release(req.rid, delay=0.005,
                                    seq=req.claim_seq)
                 return admitted
+            if not waiting and dedup and p0 < s:
+                # this row is the prefiller for whatever full blocks
+                # it will compute: announce them so a concurrent
+                # duplicate waits instead of recomputing (announce
+                # skips already-indexed hashes; register() settles
+                # each announcement as the block finalizes)
+                self.pool.announce(shard, owner,
+                                   chain_hexes[len(hit):])
             with obs.span("serve.request", rid=req.rid, s_prompt=s,
                           n_new=req.n_new, slot=slot,
                           prefix_hit=p0):
@@ -779,17 +920,25 @@ class Engine:
                                 (now - req.arrival_t) * 1e3)
                 req.prefix_hit_tokens = p0
                 if side == "fp" and self.serve.prefix_cache:
+                    # a waiter is served by the in-flight prefill, not
+                    # the settled index: it counts under inflight_hits
+                    # below, never as a miss (and a p0==0 waiter emits
+                    # no hit_tokens sample — its blocks attach later)
                     if p0:
                         self._prefix["hits"] += 1
                         self._prefix["hit_tokens"] += p0
                         if len(hit) * bs >= s:
                             self._prefix["full_hits"] += 1
-                    else:
+                        obs.count("serve.prefix.hits")
+                        obs.observe("serve.prefix.hit_tokens",
+                                    float(p0))
+                    elif not waiting:
                         self._prefix["misses"] += 1
-                    obs.count("serve.prefix.hits" if p0
-                              else "serve.prefix.misses")
-                    obs.observe("serve.prefix.hit_tokens", float(p0))
-                table = self.pool.allocators[shard].table(owner)
+                        obs.count("serve.prefix.misses")
+                        obs.observe("serve.prefix.hit_tokens", 0.0)
+                if waiting:
+                    self._prefix["inflight_hits"] += 1
+                    obs.count("serve.prefix.inflight_hits")
                 n_shared = len(hit)
                 # the hexdigest IS the chain state's hex encoding, so
                 # resuming the chain past the shared blocks is a
@@ -799,15 +948,21 @@ class Engine:
                 self.rows[slot] = _Row(
                     req=req, shard=shard, s_prompt=s, n_done=0,
                     sealed=n_shared, prefilled=p0, seq=req.claim_seq,
-                    owner=owner, side=side, chain=chain)
+                    owner=owner, side=side, chain=chain,
+                    hashes=chain_hexes, waiting=waiting)
                 self._toks[slot] = 0
                 self._curs[slot] = 0
                 self._active[slot] = False
                 self._isq[slot] = side == "q8"
-                self._btab[slot] = 0
-                self._btab[slot, :len(table)] = table
+                self._refresh_btab(slot, self.rows[slot])
                 self._seq_buf[slot] = 0
                 self._seq_buf[slot, :s] = prompt
+                # sampling state: the canonical per-request stream
+                # (decode.request_stream_data — serve/ builds no keys)
+                # plus the traced knobs; greedy rows carry temp 0
+                self._kdat[slot] = self._stream_data(req.seed)
+                self._knobs[slot] = (req.temperature, req.top_p,
+                                     req.top_k)
                 obs.count("serve.admitted")
                 if quant_row:
                     # the int8 path keeps whole-prompt admission (see
@@ -817,33 +972,102 @@ class Engine:
 
     def _prefill_whole(self, slot: int, row: _Row, prompt) -> None:
         """Quantized admission: one exact-length prefill program,
-        LRU-bounded compile cache."""
+        LRU-bounded compile cache (keyed by (length, sampled))."""
         s = row.s_prompt
-        if s in self._prefill_fns:
-            self._prefill_fns.move_to_end(s)
+        req = row.req
+        key = (s, req.temperature > 0.0,
+               req.temperature > 0.0 and (req.top_k > 0
+                                          or req.top_p < 1.0))
+        if key in self._prefill_fns:
+            self._prefill_fns.move_to_end(key)
         else:
-            self._prefill_fns[s] = self._build_prefill(s)
+            self._prefill_fns[key] = self._build_prefill(
+                s, key[1], key[2])
             while len(self._prefill_fns) > PREFILL_PROGRAM_CAP:
                 self._prefill_fns.popitem(last=False)
-        fn, npref = self._prefill_fns[s]
+        fn, npref = self._prefill_fns[key]
         table = self.pool.allocators[row.shard].table(row.owner)
         pages = np.zeros((self.dp, npref), np.int32)
         pages[row.shard] = table[:npref]
         tok0, bufs = fn(self.params, prompt[None], pages,
+                        self._kdat[slot:slot + 1],
+                        self._knobs[slot:slot + 1],
                         self.pool.buffers())
         self.pool.update(bufs)
         row.prefilled = s
+        self._prefix["prefill_tokens"] += s
         self._complete_prefill(slot, row, int(np.asarray(tok0)[0]))
 
     def _advance_prefill(self) -> None:
         """Run ONE chunk for every row still prefilling — the engine
         loop alternates this with the decode step, so a long prompt
         stalls co-batched decoders by at most one chunk per step (the
-        chunked-prefill latency cap)."""
+        chunked-prefill latency cap). WAITER rows (in-flight dedup)
+        poll/attach here instead of computing; a waiter whose wait
+        resolved this pass falls straight through to its first own
+        chunk."""
         for slot, row in enumerate(self.rows):
-            if row is None or row.prefilled >= row.s_prompt:
+            if row is None:
+                continue
+            if row.waiting:
+                self._advance_waiter(slot, row)
+                row = self.rows[slot]        # may have been evicted
+                if row is None or row.waiting:
+                    continue
+            if row.prefilled >= row.s_prompt:
                 continue
             self._prefill_chunk(slot, row)
+
+    def _advance_waiter(self, slot: int, row: _Row) -> None:
+        """One poll of a waiter row: attach every newly finalized
+        block of its prefix (the prefiller registers blocks
+        progressively as its chunks land), then decide whether to
+        keep waiting — the next needed hash must still be announced
+        by a live prefiller. When the wait resolves (prefiller
+        finished, or vanished via eviction/preemption and withdrew
+        its announcements) the row allocates its remaining window
+        and proceeds through the normal chunk stream for whatever is
+        left. The waiter renews its lease every poll: waiting is
+        progress, not death."""
+        self.queue.renew(row.req.rid, seq=row.seq)
+        bs = self.serve.block_size
+        s = row.s_prompt
+        hit = self.pool.lookup(row.shard, row.hashes)
+        if len(hit) > row.sealed:
+            new = hit[row.sealed:]
+            self.pool.share(row.owner, row.shard, new)
+            n_shared = len(hit)
+            row.sealed = n_shared
+            row.chain = bytes.fromhex(row.hashes[n_shared - 1])
+            p0 = n_shared * bs
+            if p0 >= s:
+                p0 = s - 1          # full duplicate: recompute s-1 only
+            # positions this row will now never compute because it
+            # waited instead
+            self._prefix["inflight_hit_tokens"] += max(
+                0, p0 - row.prefilled)
+            row.prefilled = p0
+            row.req.prefix_hit_tokens = p0
+            self._refresh_btab(slot, row)
+        if (row.sealed < len(row.hashes)
+                and self.pool.announced(row.shard,
+                                        row.hashes[row.sealed])):
+            return                  # still in flight — keep waiting
+        # wait resolved: allocate the rest and become a normal
+        # (possibly prefilling) row; announce any full blocks WE will
+        # now compute (a third duplicate should wait on us)
+        row.waiting = False
+        try:
+            added = self.pool.ensure(row.owner, row.shard, s)
+        except PoolExhausted:
+            self._evict(slot)
+            self.queue.release(row.req.rid, delay=0.005, seq=row.seq)
+            return
+        if added:
+            self._refresh_btab(slot, row)
+        if self.dedup and row.sealed < len(row.hashes):
+            self.pool.announce(row.shard, row.owner,
+                               row.hashes[row.sealed:])
 
     def _chunk_width(self, rem: int) -> int:
         rem = min(rem, self.serve.prefill_chunk)
@@ -880,17 +1104,22 @@ class Engine:
                     forked = True
             if forked:
                 self._prefix["cow"] += 1
-                table = self.pool.allocators[row.shard].table(
-                    row.owner)
-                self._btab[slot] = 0
-                self._btab[slot, :len(table)] = table
+                self._refresh_btab(slot, row)
         except PoolExhausted:
             self._evict(slot)
             self.queue.release(row.req.rid, delay=0.005, seq=row.seq)
             return
-        key = width
+        # the sampled tok0 draw compiles only into the FINAL chunk of
+        # a sampled request; mid-chunks (and all greedy chunks) run
+        # the argmax variant, whose tok0 is identical for greedy rows
+        # and discarded for sampled mid-chunks
+        final = row.prefilled + n_valid >= s
+        req = row.req
+        sampled = bool(final and req.temperature > 0.0)
+        key = (width, sampled,
+               bool(sampled and (req.top_k > 0 or req.top_p < 1.0)))
         if key not in self._chunk_fns:
-            self._chunk_fns[key] = self._build_chunk(width)
+            self._chunk_fns[key] = self._build_chunk(*key)
         toks = np.zeros((1, width), np.int32)
         toks[0, :n_valid] = self._seq_buf[
             slot, row.prefilled:row.prefilled + n_valid]
@@ -902,7 +1131,8 @@ class Engine:
                 self.params, toks,
                 np.asarray([row.prefilled], np.int32),
                 np.asarray([n_valid], np.int32),
-                btab, self.pool.buffers())
+                btab, self._kdat[slot:slot + 1],
+                self._knobs[slot:slot + 1], self.pool.buffers())
             self.pool.update(bufs)
         # second heartbeat AFTER the program: a chunk's compile or
         # execute can itself outlast lease_s, and the reaper runs at
@@ -910,6 +1140,14 @@ class Engine:
         # alone would leave that window expired
         self.queue.renew(row.req.rid, seq=row.seq)
         row.prefilled += n_valid
+        self._prefix["prefill_tokens"] += n_valid
+        # progressive finalization (r12): seal + content-register every
+        # block the prefilled frontier has fully passed NOW, not at
+        # prefill completion — this is what in-flight waiters attach to
+        # chunk by chunk, and what lets a later same-prefix admission
+        # hit mid-prefill
+        if row.n_done == 0:
+            self._finalize_blocks(slot, row)
         if row.prefilled >= s:
             # tok0 is only real on the owner shard (P(DP_AXIS) out)
             self._complete_prefill(
@@ -989,14 +1227,26 @@ class Engine:
         k = self.serve.speculate_k
         live = (bool(self._isq.any()) if self.kv_mode == "mixed"
                 else self.kv_mode == "int8")
-        if live not in self._step_fns:
-            self._step_fns[live] = self._build_step(live)
+        # sampled-variant dispatch mirrors the mixed-quant one: the
+        # draw math compiles in only when a sampled row is resident,
+        # and greedy rows select identically in both variants
+        sk = self._knobs[self._active]
+        samp = bool((sk[:, 0] > 0.0).any())
+        # filters compile in only when some resident sampled row
+        # actually arms top-k/top-p — pure-temperature traffic never
+        # pays the per-draw vocab sort (the bypass in _sample_filter
+        # keeps the variants bitwise-consistent per row)
+        filt = bool((((sk[:, 0] > 0.0) & ((sk[:, 2] > 0)
+                                          | (sk[:, 1] < 1.0)))).any())
+        fkey = (live, samp, filt)
+        if fkey not in self._step_fns:
+            self._step_fns[fkey] = self._build_step(live, samp, filt)
         with obs.span("serve.engine.step", step=self.n_steps,
                       rows=int(self._active.sum())):
-            g, a, newtok, bufs = self._step_fns[live](
+            g, a, newtok, bufs = self._step_fns[fkey](
                 self.params, self._toks, self._curs, self._active,
                 self._isq, self._btab, self._drafts(),
-                self.pool.buffers())
+                self._kdat, self._knobs, self.pool.buffers())
             self.pool.update(bufs)
             g = np.asarray(g)
             a = np.asarray(a)
@@ -1120,11 +1370,17 @@ class Engine:
 
     def _evict(self, slot: int) -> None:
         row = self.rows[slot]
+        # in-flight announcements die with the row: any waiter on them
+        # stops waiting at its next poll and computes the blocks
+        # itself (or re-announces them as the new prefiller)
+        self.pool.withdraw(row.shard, row.owner)
         self.pool.release(row.owner, row.shard)
         self.rows[slot] = None
         self._active[slot] = False
         self._isq[slot] = False
         self._btab[slot] = 0
+        self._knobs[slot] = (0.0, 1.0, 0.0)
+        self._kdat[slot] = 0
         self._automata.pop(slot, None)
 
     def _finish(self, slot: int) -> None:
@@ -1216,18 +1472,27 @@ class Engine:
         self.n_steps = 0
         self._occ_rows = 0
         self._prefix = {"hits": 0, "misses": 0, "hit_tokens": 0,
-                        "full_hits": 0, "cow": 0}
+                        "full_hits": 0, "cow": 0, "inflight_hits": 0,
+                        "inflight_hit_tokens": 0, "prefill_tokens": 0}
 
     # -- convenience -------------------------------------------------
 
     def submit(self, prompt, n_new: int, eos_id: int | None = None,
                not_before: float | None = None,
-               max_retries: int = 2, quant: bool = False) -> str:
+               max_retries: int = 2, quant: bool = False,
+               seed: int = 0, temperature: float = 0.0,
+               top_k: int = 0, top_p: float = 1.0) -> str:
         """Queue a request on this engine's queue (``RequestQueue
         .submit`` stamps the integrity checksum before the request
         becomes claimable — see ``serve.admit.prompt``). ``quant``
         routes the request's KV pages to the int8 arena on a
-        ``kv_quant="mixed"`` engine."""
+        ``kv_quant="mixed"`` engine. ``temperature > 0`` makes the
+        request SAMPLED under its own ``seed`` stream: served tokens
+        are bitwise ``sample_generate`` with base key ``key(0)`` and
+        ``seeds=[seed]`` at the same knobs, for the request alone —
+        schedule-invariant by the counter key discipline."""
         return self.queue.submit(prompt, n_new, eos_id=eos_id,
                                  not_before=not_before,
-                                 max_retries=max_retries, quant=quant)
+                                 max_retries=max_retries, quant=quant,
+                                 seed=seed, temperature=temperature,
+                                 top_k=top_k, top_p=top_p)

@@ -150,6 +150,15 @@ class BlockAllocator:
         self._hash_of: dict = {}         # page -> chain hash
         # refcount-0 pages kept for reuse, LRU -> MRU order
         self._cached: collections.OrderedDict = collections.OrderedDict()
+        # in-flight prefill announcements (r12 dedup): chain hash ->
+        # announcing owner, for blocks an admitted request is
+        # CURRENTLY computing but has not yet finalized/registered.
+        # A concurrent identical/prefix admission that finds its next
+        # needed hash here attaches as a WAITER instead of computing;
+        # entries drain into the index via register() (which clears
+        # them) or vanish with their owner via withdraw() — so a
+        # waiter can never wait on content nobody will produce.
+        self._inflight: dict = {}        # chain hash -> owner
         self._lock = threading.Lock()
         self.n_evictions = 0
 
@@ -324,8 +333,12 @@ class BlockAllocator:
         """Content-address a LIVE page. First registration wins: a
         duplicate hash (same content already resident) or an
         already-hashed page is refused — the duplicate page simply
-        stays anonymous and is freed on release."""
+        stays anonymous and is freed on release. Either way any
+        in-flight announcement of ``h`` is settled: the content is
+        now findable through the index, so nobody should keep waiting
+        on it."""
         with self._lock:
+            self._inflight.pop(h, None)
             if h in self._index or page in self._hash_of:
                 return False
             if self._refs.get(page, 0) < 1:
@@ -334,6 +347,35 @@ class BlockAllocator:
             self._index[h] = page
             self._hash_of[page] = h
             return True
+
+    # -- in-flight prefill announcements (r12 dedup) -----------------
+
+    def announce(self, owner, hashes) -> None:
+        """Declare that ``owner`` is about to compute the blocks behind
+        ``hashes`` (chain hashes of full prompt blocks, in order).
+        First announcer wins per hash — a later identical admission is
+        exactly the waiter the registry exists to create, and it must
+        keep seeing the ORIGINAL announcement until the block lands in
+        the index."""
+        with self._lock:
+            for h in hashes:
+                if h not in self._index:
+                    self._inflight.setdefault(h, owner)
+
+    def withdraw(self, owner) -> None:
+        """Drop every announcement ``owner`` still holds (eviction /
+        preemption / completion cleanup) — waiters on those hashes
+        stop waiting at their next poll and compute the blocks
+        themselves. Idempotent."""
+        with self._lock:
+            stale = [h for h, o in self._inflight.items() if o == owner]
+            for h in stale:
+                del self._inflight[h]
+
+    def announced(self, h: str) -> bool:
+        """Is ``h`` currently being computed by some admitted row?"""
+        with self._lock:
+            return h in self._inflight
 
     def deregister(self, page: int) -> bool:
         """Remove a page's index entry (the corruption quarantine): no
@@ -616,6 +658,15 @@ class KVPool:
 
     def register(self, shard: int, page: int, h: str) -> bool:
         return self.allocators[shard].register(page, h)
+
+    def announce(self, shard: int, owner, hashes) -> None:
+        self.allocators[shard].announce(owner, hashes)
+
+    def withdraw(self, shard: int, owner) -> None:
+        self.allocators[shard].withdraw(owner)
+
+    def announced(self, shard: int, h: str) -> bool:
+        return self.allocators[shard].announced(h)
 
     def quarantine(self, owner, shard: int, block_index: int) -> bool:
         """Evict one of ``owner``'s pages from the prefix index (the

@@ -25,6 +25,21 @@ speculative while-loop (``speculative_generate(..., drafter="ngram")``)
 engine reuses the same function under a tiny jit wrapper for its
 host-side step loop.
 
+Round 12 note — sampled serving: both matchers propose
+**deterministically**, which is exactly what makes rejection-sampled
+verification (``speculative_sample_generate``, the engine's sampled
+``speculate_k`` path) collapse to its simplest exact form. A
+deterministic proposal is a one-hot distribution q, so the standard
+accept rule ``min(1, p(t)/q(t))`` becomes "accept the draft with
+probability p(draft)" and the residual resample ``(p − q)+`` is a
+draw from p conditioned off the draft — both implemented at once by
+drawing t ~ p under the position's counter key and accepting iff t
+equals the proposal. Token-level EXACTNESS is therefore
+unconditional for sampled traffic the same way identity was for
+greedy: proposals gate only how many weight passes a window costs,
+never which keyed draw commits. (A future *stochastic* drafter would
+need the general q-ratio bookkeeping; these matchers never do.)
+
 Round 11 adds the **suffix-automaton upgrade**
 (:class:`SuffixAutomaton`): the n-gram matcher caps matches at ``n``
 tokens and rescans the whole buffer per proposal; the automaton

@@ -79,6 +79,19 @@ class Request:
     # co-batched fp requests stay bitwise untouched; on an "int8"
     # engine every request is quantized regardless of the flag
     quant: bool = False
+    # sampling contract (r12): temperature > 0 makes this a SAMPLED
+    # request — its tokens are drawn from the temperature/top-k/top-p
+    # filtered distribution under the schedule-invariant counter keys
+    # fold_in(fold_in(key(0), seed), position), so the continuation is
+    # a pure function of (prompt, seed, knobs): bitwise identical to
+    # single-request sample_generate(key=key(0), seeds=[seed]) and
+    # bitwise reproducible across lease-reap reissue to another
+    # engine. temperature == 0 (default) is greedy, bitwise unchanged
+    # from the pre-r12 engine.
+    seed: int = 0
+    temperature: float = 0.0
+    top_k: int = 0
+    top_p: float = 1.0
     visible_after: float = 0.0   # arrival time (monotonic)
     max_retries: int = 2
     # prompt positions served from the prefix cache at the (latest)
@@ -155,14 +168,27 @@ class RequestQueue:
 
     def submit(self, prompt, n_new: int, eos_id: int | None = None,
                not_before: float | None = None,
-               max_retries: int = 2, quant: bool = False) -> str:
+               max_retries: int = 2, quant: bool = False,
+               seed: int = 0, temperature: float = 0.0,
+               top_k: int = 0, top_p: float = 1.0) -> str:
         """Enqueue one request; returns its id. ``not_before`` is an
         absolute ``time.monotonic`` instant (None = now) — the Poisson
         bench's arrival process. ``quant`` routes the request's KV
-        pages to the int8 arena on a mixed-precision engine."""
+        pages to the int8 arena on a mixed-precision engine.
+        ``temperature > 0`` makes the request sampled under its own
+        ``seed`` stream (see :class:`Request`); the knobs are
+        validated here so no engine can ever claim an ill-posed
+        sampling contract."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if n_new < 1:
             raise ValueError(f"n_new must be >= 1, got {n_new}")
+        if not temperature >= 0.0:       # also rejects NaN
+            raise ValueError(
+                f"temperature must be >= 0, got {temperature}")
+        if not 0.0 < top_p <= 1.0:
+            raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+        if top_k < 0:
+            raise ValueError(f"top_k must be >= 0, got {top_k}")
         now = time.monotonic()
         vis = now if not_before is None else float(not_before)
         with self._lock:
@@ -172,7 +198,9 @@ class RequestQueue:
                           checksum=prompt_checksum(prompt),
                           eos_id=eos_id, visible_after=vis,
                           max_retries=max_retries, arrival_t=vis,
-                          quant=bool(quant))
+                          quant=bool(quant), seed=int(seed),
+                          temperature=float(temperature),
+                          top_k=int(top_k), top_p=float(top_p))
             self._requests[rid] = req
             heapq.heappush(self._queued, (vis, seq, rid))
         obs.count("serve.submitted")

@@ -9,13 +9,37 @@ def test_workload_is_seeded_and_poisson_shaped():
     w1 = make_workload(8, 10.0, 8, 4, 12, vocab=61, seed=3)
     w2 = make_workload(8, 10.0, 8, 4, 12, vocab=61, seed=3)
     assert len(w1) == 8
-    for (o1, p1, n1), (o2, p2, n2) in zip(w1, w2):
-        assert o1 == o2 and n1 == n2
+    for (o1, p1, n1, s1), (o2, p2, n2, s2) in zip(w1, w2):
+        assert o1 == o2 and n1 == n2 and s1 == s2 == 0
         np.testing.assert_array_equal(p1, p2)
-    offs = [o for o, _, _ in w1]
+    offs = [o for o, _, _, _ in w1]
     assert offs == sorted(offs) and offs[0] > 0
-    assert all(4 <= n <= 12 for _, _, n in w1)
+    assert all(4 <= n <= 12 for _, _, n, _ in w1)
     assert make_workload(8, 10.0, 8, 4, 12, vocab=61, seed=4) != w1
+    # per-request stream seeds = arrival index when armed
+    w3 = make_workload(4, 10.0, 8, 4, 12, vocab=61, seed=3,
+                       seed_per_request=True)
+    assert [s for _, _, _, s in w3] == [0, 1, 2, 3]
+
+
+def test_duplicate_prompt_workload_cycles_distinct():
+    w = make_workload(6, 10.0, 8, 4, 8, vocab=61, seed=6, distinct=2)
+    prompts = [tuple(p) for _, p, _, _ in w]
+    assert len(set(prompts)) == 2
+    assert prompts[0] == prompts[2] == prompts[4]
+    assert prompts[1] == prompts[3] == prompts[5]
+
+
+def test_repetitive_motif_workload_tiles():
+    w = make_workload(3, 10.0, 10, 4, 8, vocab=61, seed=6, motif=4)
+    for _, p, _, _ in w:
+        np.testing.assert_array_equal(p, np.tile(p[:4], 3)[:10])
+    # distinct motifs per request by default
+    assert len({tuple(p) for _, p, _, _ in w}) > 1
+    import pytest
+    with pytest.raises(ValueError, match="exclusive"):
+        make_workload(3, 10.0, 10, 4, 8, vocab=61, motif=4,
+                      prefix_len=4)
 
 
 def test_serve_bench_both_modes():
@@ -51,14 +75,14 @@ def test_shared_prefix_workload_shape():
     w = make_workload(6, 10.0, 12, 4, 8, vocab=61, seed=5,
                       prefix_len=8)
     first = w[0][1]
-    for _, p, _ in w:
+    for _, p, _, _ in w:
         np.testing.assert_array_equal(p[:8], first[:8])
     # suffixes actually vary
-    assert len({tuple(p[8:]) for _, p, _ in w}) > 1
+    assert len({tuple(p[8:]) for _, p, _, _ in w}) > 1
     # prefix == prompt -> fully repeated prompts
     w2 = make_workload(4, 10.0, 8, 4, 8, vocab=61, seed=5,
                        prefix_len=8)
-    assert len({tuple(p) for _, p, _ in w2}) == 1
+    assert len({tuple(p) for _, p, _, _ in w2}) == 1
     import pytest
     with pytest.raises(ValueError, match="prefix_len"):
         make_workload(4, 10.0, 8, 4, 8, vocab=61, prefix_len=9)
@@ -90,3 +114,26 @@ def test_serve_bench_prefix_cache_arms_and_identity_audit():
     for r in (on, off):
         assert r["identity_ok"] and r["identity_checked"] == 5
         assert r["completed"] == 5 and r["failed"] == 0
+
+
+def test_serve_bench_sampled_arm_and_dedup_ledger():
+    """The r12 A/B shape at smoke scale: a sampled duplicate-prompt
+    arm audits clean against per-seed sample_generate, and the dedup
+    ledger (prefill tokens computed + in-flight waiters) responds to
+    the knob."""
+    common = dict(rows=2, n_requests=4, rate_rps=1000.0,
+                  prompt_len=12, new_min=4, new_max=6, block_size=4,
+                  seed=7, mode="continuous", compute_dtype="float32",
+                  prefill_chunk=4, distinct=1, temperature=0.8,
+                  top_p=0.9, seed_per_request=True, verify=True)
+    on = run_bench("tiny", **common, inflight_dedup=True)[0]
+    off = run_bench("tiny", **common, inflight_dedup=False)[0]
+    for r in (on, off):
+        assert r["identity_ok"] and r["identity_checked"] == 4
+        assert r["completed"] == 4 and r["failed"] == 0
+        assert r["temperature"] == 0.8 and r["seed_per_request"]
+    assert on["prefix"]["inflight_hits"] >= 1
+    assert off["prefix"]["inflight_hits"] == 0
+    assert on["prefill_tokens_computed"] < off["prefill_tokens_computed"]
+    # duplicate arrivals have a recorded second-arrival TTFT
+    assert on["dup_ttft_ms"]["p50"] is not None

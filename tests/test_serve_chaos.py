@@ -227,6 +227,64 @@ def test_prefix_cache_clean_armed_run_identical(monkeypatch=None):
         np.testing.assert_array_equal(np.asarray(req.tokens), base)
 
 
+def test_sampled_dead_engine_reissues_bitwise():
+    """The r12 sampled extension of the dead-engine drill: a SAMPLED
+    request abandoned by a dying engine is replayed by a second
+    engine bitwise identically — the counter keys are a pure function
+    of (seed, position), so reissue carries no engine state and the
+    replay IS the original draw."""
+    import jax.numpy as jnp
+
+    from icikit.models.transformer.decode import sample_generate
+    mesh, params, sv, prompts, _ = _setup()
+    sample_bases = [np.asarray(sample_generate(
+        params, jnp.asarray(p)[None], mesh, CFG, 10, jax.random.key(0),
+        temperature=0.9, top_p=0.9, seeds=[40 + i]))[0, 8:]
+        for i, p in enumerate(prompts)]
+    q = RequestQueue(lease_s=0.05)
+    eng1 = Engine(params, mesh, CFG, sv, queue=q)
+    rids = [eng1.submit(p, 10, seed=40 + i, temperature=0.9,
+                        top_p=0.9)
+            for i, p in enumerate(prompts)]
+    plan = chaos.FaultPlan(schedule={"die:serve.step": (0,)})
+    with chaos.inject(plan):
+        with pytest.raises(chaos.InjectedDeath):
+            eng1.run()   # dies at the first step; leases dangle
+        assert not q.drained() and len(q.done) == 0
+        time.sleep(0.06)                     # outlive the leases
+        eng2 = Engine(params, mesh, CFG, sv, queue=q)
+        eng2.run()                           # reap -> reissue -> done
+    assert q.n_reissues == len(rids)
+    for rid, base in zip(rids, sample_bases):
+        req = q.request(rid)
+        assert req.state == "done" and req.attempts == 2
+        np.testing.assert_array_equal(np.asarray(req.tokens), base)
+
+
+def test_sampled_clean_armed_run_identical():
+    """A never-firing plan over mixed greedy+sampled traffic leaves
+    every output bit-identical to the unarmed baselines — the
+    injection sites stay free on the sampled path too."""
+    import jax.numpy as jnp
+
+    from icikit.models.transformer.decode import sample_generate
+    mesh, params, sv, prompts, bases = _setup(integrity="pages")
+    want_s = np.asarray(sample_generate(
+        params, jnp.asarray(prompts[1])[None], mesh, CFG, 10,
+        jax.random.key(0), temperature=1.1, seeds=[9]))[0, 8:]
+    eng = Engine(params, mesh, CFG, sv)
+    r_g = eng.submit(prompts[0], 10)
+    r_s = eng.submit(prompts[1], 10, seed=9, temperature=1.1)
+    plan = chaos.FaultPlan(rates={"die:serve.*": 0.0})
+    with chaos.inject(plan):
+        eng.run()
+    assert plan.log == []
+    np.testing.assert_array_equal(
+        np.asarray(eng.queue.request(r_g).tokens), bases[0])
+    np.testing.assert_array_equal(
+        np.asarray(eng.queue.request(r_s).tokens), want_s)
+
+
 def test_slow_chunked_prefill_renews_its_lease():
     """A prompt whose chunked prefill outlasts lease_s must NOT be
     reaped mid-prefill: each chunk is a heartbeat (the step loop's

@@ -451,6 +451,236 @@ def test_speculative_overshoot_never_poisons_the_index():
                 :len(req.tokens)])
 
 
+# ---------------------------------------------------------------- r12:
+# sampled serving (schedule-invariant per-request keys) + in-flight
+# prefill dedup. The sampled identity bar mirrors the greedy one:
+# whatever the admission timing, co-batching, speculation, or mesh,
+# a sampled request's tokens are bitwise what sample_generate draws
+# for (prompt, seed, knobs) alone — base key jax.random.key(0),
+# seeds=[request.seed], the canonical stream the engine stamps.
+
+
+def _sample_baseline(cfg, prompt, n_new, seed, temperature=0.8,
+                     top_k=0, top_p=0.9):
+    from icikit.models.transformer.decode import sample_generate
+    mesh = make_model_mesh(dp=1, tp=1, sp=1)
+    params = init_params(jax.random.key(0), cfg, mesh)
+    out = sample_generate(params, jnp.asarray(prompt)[None], mesh, cfg,
+                          n_new, jax.random.key(0),
+                          temperature=temperature, top_k=top_k,
+                          top_p=top_p, seeds=[seed])
+    return np.asarray(out)[0, len(prompt):]
+
+
+@pytest.mark.parametrize("speculate_k", [1, 3])
+def test_sampled_identity_staggered_mixed_lengths(speculate_k):
+    """Sampled requests over staggered admission × mixed prompt
+    lengths × speculate on/off: every request's tokens are bitwise
+    its solo sample_generate draw — the r12 acceptance bar."""
+    prompts = _workload(CFG, [5, 8, 11, 8], seed=21)
+    n_news = [6, 12, 9, 4]
+    eng = _engine(speculate_k=speculate_k)
+    t0 = time.monotonic()
+    rids = [eng.submit(p, n, not_before=t0 + 0.01 * i, seed=50 + i,
+                       temperature=0.8, top_p=0.9)
+            for i, (p, n) in enumerate(zip(prompts, n_news))]
+    assert eng.run() == len(rids)
+    for i, (rid, p, n) in enumerate(zip(rids, prompts, n_news)):
+        req = eng.queue.request(rid)
+        assert req.state == "done"
+        np.testing.assert_array_equal(
+            np.asarray(req.tokens), _sample_baseline(CFG, p, n, 50 + i))
+
+
+@pytest.mark.parametrize("dp,tp", [(2, 1), (2, 2)])
+def test_sampled_identity_across_meshes(dp, tp):
+    prompts = _workload(CFG, [6, 9, 6], seed=22)
+    eng = _engine(dp=dp, tp=tp, max_rows=2 * dp)
+    rids = [eng.submit(p, 8, seed=i, temperature=1.2, top_k=16)
+            for i, p in enumerate(prompts)]
+    eng.run()
+    for i, (rid, p) in enumerate(zip(rids, prompts)):
+        req = eng.queue.request(rid)
+        assert req.state == "done"
+        np.testing.assert_array_equal(
+            np.asarray(req.tokens),
+            _sample_baseline(CFG, p, 8, i, temperature=1.2, top_k=16,
+                             top_p=1.0))
+
+
+def test_mixed_greedy_sampled_cobatch_containment():
+    """A greedy request co-batched with sampled neighbors is bitwise
+    what the all-greedy engine serves (the sampled step variant maps
+    temperature-0 rows to raw-logit argmax), and the sampled rows
+    stay bitwise their solo draws."""
+    prompts = _workload(CFG, [8, 8, 6], seed=23)
+    eng = _engine(max_rows=3)
+    r_g = eng.submit(prompts[0], 10)                       # greedy
+    r_s1 = eng.submit(prompts[1], 10, seed=7, temperature=0.9)
+    r_s2 = eng.submit(prompts[2], 8, seed=8, temperature=1.5,
+                      top_p=0.8)
+    eng.run()
+    np.testing.assert_array_equal(
+        np.asarray(eng.queue.request(r_g).tokens),
+        _baseline(CFG, prompts[0], 10))
+    np.testing.assert_array_equal(
+        np.asarray(eng.queue.request(r_s1).tokens),
+        _sample_baseline(CFG, prompts[1], 10, 7, temperature=0.9,
+                         top_p=1.0))
+    np.testing.assert_array_equal(
+        np.asarray(eng.queue.request(r_s2).tokens),
+        _sample_baseline(CFG, prompts[2], 8, 8, temperature=1.5,
+                         top_p=0.8))
+
+
+def test_sampled_seed_reissue_is_deterministic():
+    """The same (prompt, seed, knobs) served twice — different
+    admissions, different co-batches — commits identical tokens: the
+    counter keys carry no engine state."""
+    [p] = _workload(CFG, [8], seed=24)
+    eng = _engine(max_rows=2)
+    r1 = eng.submit(p, 10, seed=3, temperature=1.0)
+    r2 = eng.submit(_workload(CFG, [5], seed=25)[0], 12)   # co-batch
+    eng.run()
+    r3 = eng.submit(p, 10, seed=3, temperature=1.0)        # alone
+    eng.run()
+    np.testing.assert_array_equal(
+        np.asarray(eng.queue.request(r1).tokens),
+        np.asarray(eng.queue.request(r3).tokens))
+    assert eng.queue.request(r2).state == "done"
+
+
+# -------------------------------------------------- in-flight dedup
+
+
+def test_inflight_dedup_waiter_attaches_and_matches():
+    """Two identical prompts admitted together: the second becomes a
+    WAITER (no prefill compute for the shared blocks), both outputs
+    are baseline-identical, and the compute ledger shows the dedup —
+    prefiller pays s positions, waiter pays only the s-1 recompute."""
+    rng = np.random.default_rng(31)
+    p = rng.integers(0, CFG.vocab, (16,)).astype(np.int32)
+    eng = _engine(max_rows=2, block_size=4, n_blocks=32, max_new=8,
+                  prefill_chunk=4)
+    rids = [eng.submit(p, 6) for _ in range(2)]
+    eng.run()
+    base = _baseline(CFG, p, 6)
+    for rid in rids:
+        np.testing.assert_array_equal(
+            np.asarray(eng.queue.request(rid).tokens), base)
+    st = eng.prefix_stats()
+    assert st["inflight_hits"] == 1
+    # 16 (prefiller) + 1 (waiter's s-1 recompute), not 32
+    assert st["prefill_tokens"] == 17
+    assert st["inflight_hit_tokens"] == 15
+
+
+def test_inflight_dedup_off_recomputes_concurrently():
+    rng = np.random.default_rng(32)
+    p = rng.integers(0, CFG.vocab, (16,)).astype(np.int32)
+    eng = _engine(max_rows=2, block_size=4, n_blocks=32, max_new=8,
+                  prefill_chunk=4, inflight_dedup=False)
+    rids = [eng.submit(p, 6) for _ in range(2)]
+    eng.run()
+    base = _baseline(CFG, p, 6)
+    for rid in rids:
+        np.testing.assert_array_equal(
+            np.asarray(eng.queue.request(rid).tokens), base)
+    st = eng.prefix_stats()
+    assert st["inflight_hits"] == 0
+    assert st["prefill_tokens"] == 32          # both computed fully
+
+
+def test_inflight_dedup_without_prefix_cache_rejected():
+    """Explicitly arming dedup with the cache off is a loud config
+    error (the silent no-op would read as "dedup delivers nothing" in
+    an A/B); the "auto" default just follows prefix_cache."""
+    with pytest.raises(ValueError, match="requires prefix_cache"):
+        _engine(prefix_cache=False, inflight_dedup=True)
+    with pytest.raises(ValueError, match="unknown inflight_dedup"):
+        _engine(inflight_dedup="on")
+    eng = _engine(prefix_cache=False)          # auto -> off, no raise
+    assert not eng.dedup
+    assert _engine().dedup
+
+
+def test_inflight_dedup_prefix_extension_waiter():
+    """A waiter whose prompt EXTENDS the in-flight prefix: waits for
+    the shared blocks, then computes only its own suffix."""
+    rng = np.random.default_rng(33)
+    shared = rng.integers(0, CFG.vocab, (12,)).astype(np.int32)
+    ext = np.concatenate([shared,
+                          rng.integers(0, CFG.vocab, (4,))
+                          .astype(np.int32)])
+    eng = _engine(max_rows=2, block_size=4, n_blocks=32,
+                  max_prompt=16, max_new=8, prefill_chunk=4)
+    r_a = eng.submit(shared, 6)
+    r_b = eng.submit(ext, 6)
+    eng.run()
+    np.testing.assert_array_equal(
+        np.asarray(eng.queue.request(r_a).tokens),
+        _baseline(CFG, shared, 6))
+    np.testing.assert_array_equal(
+        np.asarray(eng.queue.request(r_b).tokens),
+        _baseline(CFG, ext, 6))
+    st = eng.prefix_stats()
+    assert st["inflight_hits"] == 1
+    # A pays 12; B pays its 4-token suffix only
+    assert st["prefill_tokens"] == 12 + 4
+
+
+def test_inflight_waiter_falls_back_when_prefiller_vanishes():
+    """White-box: evict the prefiller mid-prefill (the preemption
+    path withdraws its announcements) — the waiter stops waiting,
+    computes the blocks itself, and both requests complete with
+    baseline tokens through the normal requeue."""
+    rng = np.random.default_rng(34)
+    p = rng.integers(0, CFG.vocab, (16,)).astype(np.int32)
+    eng = _engine(max_rows=2, block_size=4, n_blocks=32, max_new=8,
+                  prefill_chunk=4)
+    r_a = eng.submit(p, 6)
+    r_b = eng.submit(p, 6)
+    eng._admit()
+    row_b = eng.rows[1]
+    assert row_b is not None and row_b.waiting
+    eng._advance_prefill()                     # A computes one chunk
+    assert eng.rows[1].waiting                 # B still waiting
+    row_a = eng.rows[0]
+    eng._evict(0)                              # preempt the prefiller
+    eng.queue.release(row_a.req.rid, seq=row_a.seq)
+    eng.run()
+    base = _baseline(CFG, p, 6)
+    for rid in (r_a, r_b):
+        req = eng.queue.request(rid)
+        assert req.state == "done"
+        np.testing.assert_array_equal(np.asarray(req.tokens), base)
+
+
+def test_inflight_dedup_sampled_duplicates_share_stream():
+    """Duplicate sampled prompts with the SAME seed: dedup shares the
+    prefill AND both commit the identical sampled continuation; a
+    different seed diverges after the shared prefix."""
+    rng = np.random.default_rng(35)
+    p = rng.integers(0, CFG.vocab, (16,)).astype(np.int32)
+    eng = _engine(max_rows=3, block_size=4, n_blocks=48, max_new=8,
+                  prefill_chunk=4)
+    r1 = eng.submit(p, 6, seed=1, temperature=0.9)
+    r2 = eng.submit(p, 6, seed=1, temperature=0.9)
+    r3 = eng.submit(p, 6, seed=2, temperature=0.9)
+    eng.run()
+    want1 = _sample_baseline(CFG, p, 6, 1, temperature=0.9,
+                             top_p=1.0)
+    want2 = _sample_baseline(CFG, p, 6, 2, temperature=0.9,
+                             top_p=1.0)
+    np.testing.assert_array_equal(
+        np.asarray(eng.queue.request(r1).tokens), want1)
+    np.testing.assert_array_equal(
+        np.asarray(eng.queue.request(r2).tokens), want1)
+    np.testing.assert_array_equal(
+        np.asarray(eng.queue.request(r3).tokens), want2)
+    assert eng.prefix_stats()["inflight_hits"] == 2
+
+
 def test_finalize_frontier_clamps_to_recorded_tokens():
     """White-box pin of the overshoot clamp: a cursor past
     s_prompt + n_done (speculative windows accept beyond n_new) must

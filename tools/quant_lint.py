@@ -115,9 +115,10 @@ def check_generate() -> None:
     s_prompt, n_new = 8, 12
     fn = _build_generate(mesh, cfg, s_prompt, n_new)
     prompt = jnp.zeros((2, s_prompt), jnp.int32)
+    seeds = jnp.zeros((2,), jnp.int32)
     key_data = jax.random.key_data(jax.random.key(0))
-    knobs = jnp.ones((2,), jnp.float32)
-    jaxpr = jax.make_jaxpr(fn)(qp, prompt, key_data, knobs)
+    knobs = jnp.ones((3,), jnp.float32)
+    jaxpr = jax.make_jaxpr(fn)(qp, prompt, seeds, key_data, knobs)
     kv = cfg.n_kv_heads or cfg.n_heads
     tail = (s_prompt + n_new, kv, cfg.d_head)
     bad = _float_cache_avals(jaxpr.jaxpr, tail)

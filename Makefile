@@ -50,6 +50,7 @@ check:
 	@echo "check OK: icikit/serve SLO clocks are monotonic"
 	$(PY) tools/serve_key_lint.py
 	JAX_PLATFORMS=cpu $(PY) tools/quant_lint.py
+	JAX_PLATFORMS=cpu $(PY) tools/chaos_site_lint.py
 
 # multi-token decode smoke: a tiny CPU speculative decode under an
 # armed obs session — the acceptance counters/spans must flow and the

@@ -75,7 +75,7 @@ def _load(path):
 def fig_scaling_msize(records, outdir, family="allgather", p=8):
     import matplotlib.pyplot as plt
     rows = [r for r in records if r.get("family") == family
-            and r["p"] == p]
+            and r["p"] == p and not r.get("checked")]
     if not rows:
         return None
     by_alg = defaultdict(dict)
@@ -105,7 +105,7 @@ def fig_scaling_msize(records, outdir, family="allgather", p=8):
 def fig_scaling_p(records, outdir, family="allgather", msize=65536):
     import matplotlib.pyplot as plt
     rows = [r for r in records if r.get("family") == family
-            and r["msize"] == msize]
+            and r["msize"] == msize and not r.get("checked")]
     if not rows:
         return None
     by_alg = defaultdict(dict)

@@ -25,6 +25,7 @@ from icikit.parallel.allgather import all_gather_blocks
 from icikit.parallel.allreduce import all_reduce
 from icikit.parallel.alltoall import all_to_all_blocks
 from icikit.parallel.collops import broadcast, gather_blocks, scatter_blocks
+from icikit.parallel.integrity import CHECKED_FAMILIES
 from icikit.parallel.reduce import reduce_to_root
 from icikit.parallel.reducescatter import reduce_scatter
 from icikit.parallel.scan import scan_reduce
@@ -35,6 +36,14 @@ from icikit.utils.timing import timeit
 # for all-to-all (main.cc:422-423) and l <= 12 for personalized (:458).
 REFERENCE_SWEEP = tuple(1 << l for l in range(0, 17, 4))
 REFERENCE_SWEEP_PERSONALIZED = tuple(1 << l for l in range(0, 13, 4))
+
+# site registry (chaos satellite): sweep-boundary probes per family +
+# the verify-payload SDC probe
+chaos.register_site("bench.harness.verify")
+chaos.register_site(*(f"bench.harness.{f}" for f in
+                      ("allgather", "alltoall", "allreduce",
+                       "reducescatter", "broadcast", "scatter",
+                       "gather", "scan", "reduce")))
 
 
 @dataclass
@@ -54,6 +63,9 @@ class BenchRecord:
     # tracing was off): a BENCH_*.json row found wanting can be looked
     # up in the matching trace.json by args.trace_id
     trace_id: str = ""
+    # True when the row timed the checksum-carrying schedule
+    # (integrity-overhead A/B rows; SCALING.md "Checked collectives")
+    checked: bool = False
 
     def to_json(self) -> str:
         return json.dumps(dataclasses.asdict(self))
@@ -92,7 +104,8 @@ def _pattern(p: int, msize: int, dtype) -> np.ndarray:
     return ((src * 7919 + k * 13) % 1000).astype(dtype)
 
 
-def _setup(family: str, mesh, axis: str, msize: int, dtype):
+def _setup(family: str, mesh, axis: str, msize: int, dtype,
+           checked: bool = False):
     """Build (input, run_fn_factory, verify_fn) for one family."""
     p = mesh_axis_size(mesh, axis)
     if family in ("allgather", "broadcast", "gather", "allreduce", "scan",
@@ -123,7 +136,14 @@ def _setup(family: str, mesh, axis: str, msize: int, dtype):
         "scan": scan_reduce,
         "reduce": reduce_to_root,
     }
-    run = lambda alg: fns[family](x, mesh, axis, algorithm=alg)
+    if checked:
+        if family not in CHECKED_FAMILIES:
+            raise ValueError(
+                f"checked mode covers {CHECKED_FAMILIES}, not {family}")
+        run = lambda alg: fns[family](x, mesh, axis, algorithm=alg,
+                                      checked=True)
+    else:
+        run = lambda alg: fns[family](x, mesh, axis, algorithm=alg)
 
     def verify(out) -> bool:
         o = np.asarray(out)
@@ -157,8 +177,14 @@ def _setup(family: str, mesh, axis: str, msize: int, dtype):
 def sweep_collective(mesh, family: str, algorithm: str,
                      sizes: Sequence[int] = REFERENCE_SWEEP,
                      dtype=jnp.int32, runs: int = 10, warmup: int = 2,
-                     axis: str = DEFAULT_AXIS) -> list[BenchRecord]:
-    """Benchmark one algorithm across a message-size sweep."""
+                     axis: str = DEFAULT_AXIS,
+                     checked: bool = False) -> list[BenchRecord]:
+    """Benchmark one algorithm across a message-size sweep.
+
+    ``checked=True`` times the checksum-carrying schedule (same
+    algorithm through ``icikit.parallel.integrity``) — the integrity-
+    overhead A/B the SCALING.md defaults audit prices.
+    """
     p = mesh_axis_size(mesh, axis)
     records = []
     # chaos sites (ROADMAP 5c: the bench harness had none): a sweep-
@@ -170,7 +196,8 @@ def sweep_collective(mesh, family: str, algorithm: str,
     chaos.maybe_delay(site)
     chaos.maybe_die(site)
     for msize in sizes:
-        run, verify = _setup(family, mesh, axis, msize, np.dtype(dtype))
+        run, verify = _setup(family, mesh, axis, msize, np.dtype(dtype),
+                             checked=checked)
         out = np.asarray(jax.block_until_ready(run(algorithm)))
         out = chaos.maybe_corrupt("bench.harness.verify", out)
         verified = bool(verify(out))
@@ -200,7 +227,8 @@ def sweep_collective(mesh, family: str, algorithm: str,
             dtype=np.dtype(dtype).name, bytes_per_block=block_bytes,
             runs=runs, mean_s=res.mean_s, best_s=res.best_s,
             busbw_gbps=busbw, verified=verified,
-            trace_id="" if sp.trace_id is None else str(sp.trace_id)))
+            trace_id="" if sp.trace_id is None else str(sp.trace_id),
+            checked=checked))
     return records
 
 
@@ -212,7 +240,18 @@ def sweep_family(mesh, family: str, algorithms: Sequence[str] | None = None,
     from icikit.utils.mesh import UnsupportedMeshError
     from icikit.utils.registry import list_algorithms
     records = []
-    for alg in (algorithms or list_algorithms(family)):
+    algs = list(algorithms or list_algorithms(family))
+    if kw.get("checked"):
+        # the vendor variant is one opaque primitive — there is no
+        # receive step to fold checksums into (integrity module):
+        # dropped from the default sweep, refused when asked for by name
+        if algorithms and "xla" in algs:
+            raise ValueError(
+                "checked mode cannot time the 'xla' vendor variant "
+                "(no receive step to verify inside) — drop it from "
+                "--algorithms")
+        algs = [a for a in algs if a != "xla"]
+    for alg in algs:
         try:
             records.extend(sweep_collective(mesh, family, alg, **kw))
         except UnsupportedMeshError:

@@ -39,6 +39,13 @@ def main(argv=None):
     ap.add_argument("--runs", type=int, default=10)
     ap.add_argument("--warmup", type=int, default=2)
     ap.add_argument("--dtype", default="int32")
+    ap.add_argument("--checked", action="store_true",
+                    help="time the checksum-carrying schedules "
+                         "(icikit.parallel.integrity): per-step "
+                         "on-device verification folded into every "
+                         "exchange — the integrity-overhead A/B rows "
+                         "SCALING.md prices (hand-rolled variants "
+                         "only; 'xla' is skipped)")
     ap.add_argument("--json", dest="json_path", default=None,
                     help="write records as JSON lines to this path")
     ap.add_argument("--profile", dest="profile_dir", default=None,
@@ -68,6 +75,7 @@ def main(argv=None):
     import jax.numpy as jnp
 
     from icikit.bench.harness import (
+        CHECKED_FAMILIES,
         REFERENCE_SWEEP,
         REFERENCE_SWEEP_PERSONALIZED,
         format_table,
@@ -75,6 +83,9 @@ def main(argv=None):
     )
     from icikit.utils.mesh import make_mesh
 
+    if args.checked and args.family not in CHECKED_FAMILIES:
+        ap.error(f"--checked covers {CHECKED_FAMILIES}, "
+                 f"not --family {args.family}")
     mesh = make_mesh(args.devices)
     sizes = (tuple(int(s) for s in args.sizes.split(","))
              if args.sizes else
@@ -86,7 +97,7 @@ def main(argv=None):
     with profiled:
         records = sweep_family(mesh, args.family, algorithms, sizes=sizes,
                                dtype=jnp.dtype(args.dtype), runs=args.runs,
-                               warmup=args.warmup)
+                               warmup=args.warmup, checked=args.checked)
     print(format_table(records))
     if args.json_path:
         # append: record files accumulate across invocations (the

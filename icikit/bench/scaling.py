@@ -55,7 +55,8 @@ def run_scale_point(family: str, p: int, *, algorithms=None, sizes=None,
                     runs: int = 5, dtype: str = "int32",
                     simulate: bool = True,
                     timeout_s: float = 600.0,
-                    bench: str = "collectives") -> list[dict]:
+                    bench: str = "collectives",
+                    checked: bool = False) -> list[dict]:
     """Run one scale point (one subprocess) and return its records.
 
     ``bench``: "collectives" sweeps a collective ``family`` via
@@ -84,6 +85,13 @@ def run_scale_point(family: str, p: int, *, algorithms=None, sizes=None,
             cmd += ["--algorithms", ",".join(algorithms)]
         if sizes:
             cmd += ["--sizes", ",".join(str(s) for s in sizes)]
+        if checked:
+            if bench == "sort":
+                raise ValueError(
+                    "checked scaling covers the collective sweeps only "
+                    "(--bench collectives): the sort bench has no "
+                    "--checked path")
+            cmd += ["--checked"]
         proc = subprocess.run(
             cmd, env=_point_env(p, simulate), capture_output=True,
             text=True, timeout=timeout_s, cwd=_REPO_ROOT)
@@ -214,6 +222,9 @@ def main(argv=None):
     ap.add_argument("--sizes", default=None)
     ap.add_argument("--runs", type=int, default=5)
     ap.add_argument("--dtype", default="int32")
+    ap.add_argument("--checked", action="store_true",
+                    help="sweep the checksum-carrying schedules "
+                         "(integrity-overhead A/B; collectives only)")
     ap.add_argument("--real-devices", action="store_true",
                     help="use local accelerator devices instead of the "
                          "simulated CPU mesh")
@@ -226,6 +237,9 @@ def main(argv=None):
                          "from sort_scaling.jsonl and exit (no new "
                          "measurements)")
     args = ap.parse_args(argv)
+    if args.checked and args.bench != "collectives":
+        ap.error("--checked covers --bench collectives only "
+                 "(the sort bench has no --checked path)")
 
     if args.sort_report:
         write_sort_scaling_md(args.json_path or "sort_scaling.jsonl")
@@ -240,7 +254,8 @@ def main(argv=None):
         sizes=(tuple(int(s) for s in args.sizes.split(","))
                if args.sizes else None),
         runs=args.runs, dtype=args.dtype,
-        simulate=not args.real_devices, bench=args.bench)
+        simulate=not args.real_devices, bench=args.bench,
+        checked=args.checked)
 
     if args.bench == "sort":
         # sort records have their own schema: render a keys/s-vs-p table

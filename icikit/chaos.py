@@ -39,6 +39,7 @@ import hashlib
 import os
 import threading
 import time
+import warnings
 from dataclasses import dataclass, field
 
 import numpy as np
@@ -47,6 +48,76 @@ from icikit.obs import bus as _bus
 from icikit.obs import tracer as _tracer
 
 KINDS = ("delay", "die", "corrupt", "io")
+
+
+# -- site registry ---------------------------------------------------
+#
+# Probe sites used to be bare strings, so a typo in an ICIKIT_CHAOS
+# spec or a drill's FaultPlan silently never fired. Every module now
+# registers its sites at definition (concrete names, or a glob pattern
+# for per-instance families like "solitaire.worker.*"); inject() warns
+# when a plan references nothing registered, and tools/chaos_site_lint
+# holds tests/tools to the same registry in `make check`.
+
+_SITES: set = set()
+_sites_lock = threading.Lock()
+
+
+def register_site(*names: str) -> None:
+    """Declare chaos probe sites (or ``fnmatch`` patterns covering a
+    dynamic family). Idempotent; called at module import next to the
+    code that owns the probes."""
+    with _sites_lock:
+        _SITES.update(names)
+
+
+def registered_sites() -> frozenset:
+    return frozenset(_SITES)
+
+
+def site_known(glob: str) -> bool:
+    """Does a plan entry's site glob plausibly reach any registered
+    site? True when it matches a registered concrete name, or when it
+    overlaps a registered pattern (either direction, plus a
+    pattern-instantiation witness — globs on both sides make exact
+    intersection undecidable-cheaply; these three cover the shapes the
+    repo actually uses)."""
+    with _sites_lock:
+        sites = tuple(_SITES)
+    for s in sites:
+        if fnmatch.fnmatchcase(s, glob):
+            return True
+        if "*" in s and (fnmatch.fnmatchcase(glob, s)
+                         or fnmatch.fnmatchcase(s.replace("*", "0"),
+                                                glob)):
+            return True
+    return False
+
+
+def _site_prefix_known(glob: str) -> bool:
+    """Is the glob's parent namespace (everything up to the last dot)
+    one a registered site already lives in? The runtime warning in
+    :class:`inject` only fires for globs whose parent is populated but
+    whose leaf is not ("collective.allgatherr" beside the registered
+    "collective.allgather" — almost certainly a typo); an unpopulated
+    parent more likely means the owning module simply has not been
+    imported yet (lazily-imported modules register sites under shared
+    family heads — "collective.train.grad_sync" lives in model.py while
+    integrity.py registers "collective.<family>" at package import, so
+    a first-component check would cry typo on a perfectly good drill),
+    and the drill will fire normally once it is. The static lint
+    (tools/chaos_site_lint.py) imports every instrumented module and
+    judges full names, so typos in committed drills still fail CI."""
+    parent = glob.rpartition(".")[0]
+    if not parent:
+        # dotless = the root namespace, where bare-chaos unit tests
+        # mint synthetic names — never a typo signal worth warning on
+        return False
+    with _sites_lock:
+        parents = {s.rpartition(".")[0] for s in _SITES}
+    if any(ch in parent for ch in "*?["):
+        return any(fnmatch.fnmatchcase(p, parent) for p in parents)
+    return parent in parents
 
 
 class ChaosError(Exception):
@@ -112,6 +183,12 @@ class FaultPlan:
         return self._decide(kind, site)[0]
 
     def _decide(self, kind: str, site: str) -> tuple:
+        # armed-path-only registration: the disabled probes stay one
+        # global read + None check; once a plan is consulted the site
+        # provably exists, so the registry reflects reality even for
+        # sites built from runtime ids
+        with _sites_lock:
+            _SITES.add(site)
         with self._lock:
             n = self._counts.get((kind, site), 0)
             self._counts[(kind, site)] = n + 1
@@ -191,6 +268,25 @@ class inject:
 
     def __enter__(self) -> FaultPlan:
         global _ACTIVE
+        # a drill whose site glob reaches no registered site is a drill
+        # that silently never fires — say so, but only for globs whose
+        # site FAMILY is registered (a wholly-unknown prefix usually
+        # means the owning module just isn't imported yet — its sites
+        # register at import, and warning there would teach users to
+        # ignore the real typo signal; synthetic names in bare-chaos
+        # unit tests stay quiet the same way)
+        if _SITES:
+            for key in list(self.plan.rates) + list(self.plan.schedule):
+                glob = key.partition(":")[2]
+                if not site_known(glob) and _site_prefix_known(glob):
+                    warnings.warn(
+                        f"chaos plan entry {key!r} matches no "
+                        "registered probe site — likely a typo, the "
+                        "drill will never fire (known sites: "
+                        "icikit.chaos.registered_sites())",
+                        RuntimeWarning, stacklevel=2)
+                    if _bus.enabled():
+                        _bus.emit("chaos.unknown_site", entry=key)
         with _install_lock:
             self._prev = _ACTIVE
             _ACTIVE = self.plan
@@ -232,6 +328,54 @@ def maybe_corrupt(site: str, array):
     if fired:
         return plan._corrupt(site, n, array)
     return array
+
+
+# Traced in-schedule corruption (the device-side SDC drill). The host
+# probes above can only corrupt at dispatch boundaries — an array the
+# host already holds. Checked collectives instead bake a corruption
+# site INTO the jitted schedule (transport.traced_flip) and arm it per
+# execution through this taint vector, so a drill flips a bit mid-
+# schedule, between two ppermute rounds, where only the in-schedule
+# checksum verify can see it.
+
+TAINT_OFF = np.array([-1, -1, 0, 0], dtype=np.int32)
+
+
+def traced_corrupt_spec(site: str, n_steps: int, p: int) -> np.ndarray:
+    """Consult the armed plan for a traced corruption at ``site``.
+
+    Returns the int32 taint vector ``[step, device, elem_seed, bit]``
+    feeding ``transport.traced_flip``: a fired decision picks — as a
+    pure hash of ``(seed, site, call_index)``, same determinism as
+    every other probe — which of the schedule's ``n_steps`` exchange
+    steps flips, on which of ``p`` devices, at which element/bit.
+    ``TAINT_OFF`` (never fires, bit-identical execution) when no plan
+    is armed, the decision declines, or the schedule has no exchanges.
+    """
+    plan = _ACTIVE
+    if plan is None:
+        return TAINT_OFF
+    # consult the plan even when the schedule has no exchanges, so a
+    # drill's decision indices stay aligned across p (replay-log
+    # parity) and plan.fired() reflects the arming — then say loudly
+    # that the fired flip had nowhere to land (p=1 grad_check, a
+    # 1-wide axis: the drill would otherwise "pass" testing nothing)
+    fired, n = plan._decide("corrupt", site)
+    if not fired:
+        return TAINT_OFF
+    if n_steps <= 0:
+        warnings.warn(
+            f"chaos corrupt:{site} fired but the schedule has no "
+            "exchange steps (1-wide axis?) — nothing to corrupt, the "
+            "drill exercises no verification",
+            RuntimeWarning, stacklevel=2)
+        if _bus.enabled():
+            _bus.emit("chaos.no_exchange_steps", site=site)
+        return TAINT_OFF
+    h = _u64(plan.seed, "corrupt-loc", site, n)
+    return np.array([h % n_steps, (h >> 20) % max(1, p),
+                     (h >> 32) % (1 << 30), (h >> 56) % 32],
+                    dtype=np.int32)
 
 
 def maybe_io_fail(site: str) -> None:

@@ -43,7 +43,12 @@ import numpy as np
 import jax
 
 from icikit import chaos, obs
-from icikit.models.solitaire.game import (
+
+# site registry (chaos satellite): per-worker sites are a dynamic
+# family, declared as the pattern the drills address
+chaos.register_site("solitaire.worker.*", "solitaire.ckpt.write")
+
+from icikit.models.solitaire.game import (  # noqa: E402
     MAX_DEPTH,
     BoardBatch,
     render_board,

@@ -42,21 +42,52 @@ register_algorithm("sort", "quicksort")(hypercube_quicksort_blocks)
 
 SORT_ALGORITHMS = ("bitonic", "sample", "sample_bitonic", "quicksort")
 
+# site registry (chaos satellite): dispatch-boundary probes per
+# algorithm, plus the traced in-schedule corruption site of the
+# checked bitonic exchange network
+from icikit import chaos as _chaos  # noqa: E402
+
+_chaos.register_site(*(f"sort.{a}" for a in SORT_ALGORITHMS))
+_chaos.register_site("sort.bitonic.exchange")
+
 
 def sort(x: jax.Array, mesh, axis: str = DEFAULT_AXIS,
-         algorithm: str = "bitonic", **kw) -> jax.Array:
+         algorithm: str = "bitonic", checked: bool = False,
+         retries: int = 2, **kw) -> jax.Array:
     """Sort flat ``x`` ascending across the mesh; returns the flat
-    sorted array (same length and dtype)."""
-    from icikit import chaos
+    sorted array (same length and dtype).
 
+    ``checked=True`` runs the checksum-carrying exchange network
+    (bitonic only — the sample/quicksort ragged exchanges ride the
+    vendor alltoall carrier, which stays host-boundary-only): every
+    compare-split block is verified at its receive step on device, and
+    a detected flip quarantines + retries the deterministic schedule
+    at this dispatch boundary (``icikit.parallel.integrity``).
+    """
     # chaos sites at the dispatch boundary (ROADMAP 5c remainder): the
     # sort fuzzers run under `delay` plans to shake out schedule-
     # dependent deadlocks — a straggling dispatch must only ever be
     # slow, never wrong (drilled in tests/test_chaos_sites.py)
-    chaos.maybe_delay(f"sort.{algorithm}")
-    chaos.maybe_die(f"sort.{algorithm}")
-    impl = get_algorithm("sort", algorithm)
+    _chaos.maybe_delay(f"sort.{algorithm}")
+    _chaos.maybe_die(f"sort.{algorithm}")
     n = x.shape[0]
+    if checked:
+        if algorithm != "bitonic":
+            raise ValueError(
+                f"checked sort is the bitonic exchange network only "
+                f"(got {algorithm!r}): the other sorts' ragged "
+                "exchanges ride the opaque vendor alltoall")
+        from icikit.models.sort.bitonic import build_checked
+        from icikit.parallel import integrity
+        blocks, _ = prepare_blocks(x, mesh, axis, pow2_local=True)
+        prog, n_box = build_checked(mesh, axis)
+        p = mesh.shape[axis]
+        n_steps = integrity.steps_of(prog, n_box, blocks)
+        out2d = integrity.checked_run(
+            "sort.bitonic.exchange", prog, n_steps, p, (blocks,),
+            retries=retries, label="sort/bitonic")
+        return take_sorted(out2d, n)
+    impl = get_algorithm("sort", algorithm)
     blocks, _ = prepare_blocks(x, mesh, axis,
                                pow2_local=(algorithm == "bitonic"))
     return take_sorted(impl(blocks, mesh, axis, **kw), n)

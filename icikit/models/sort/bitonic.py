@@ -24,6 +24,7 @@ from jax.sharding import PartitionSpec as P
 
 from icikit.ops.merge import bitonic_merge
 from icikit.ops.pallas_sort import local_sort
+from icikit.parallel import transport
 from icikit.parallel.shmap import shard_map, xor_perm
 from icikit.utils.mesh import DEFAULT_AXIS, UnsupportedMeshError, ilog2, is_pow2
 
@@ -48,7 +49,7 @@ def bitonic_sort_shard(a: jax.Array, axis: str, p: int) -> jax.Array:
     for i in range(d):
         for j in range(i, -1, -1):
             bit = 1 << j
-            b = lax.ppermute(a, axis, xor_perm(p, bit))
+            b = transport.ppermute(a, axis, xor_perm(p, bit))
             ibit = (r & (1 << (i + 1))) != 0
             jbit = (r & bit) != 0
             keep_max = ibit != jbit
@@ -65,6 +66,25 @@ def _build(mesh, axis):
         lambda b: bitonic_sort_shard(b[0], axis, p)[None],
         mesh=mesh, in_specs=P(axis), out_specs=P(axis),
         check_vma=False))
+
+
+@lru_cache(maxsize=None)
+def build_checked(mesh, axis):
+    """Checked twin of ``_build``: the same compare-split network
+    traced under the checksum transport, with the traced-corruption
+    taint input — ``prog(x2d, taint) -> (sorted, ok)`` where ``ok`` is
+    the (p, d(d+1)/2) per-device × per-exchange verdict matrix. The
+    dispatch/retry boundary lives in ``models.sort.sort(checked=True)``.
+    Returns ``(program, n_steps_box)`` for ``integrity.steps_of``."""
+    from icikit.parallel.integrity import tracked_shard
+
+    p = mesh.shape[axis]
+    per_shard, n_box = tracked_shard(
+        lambda b: bitonic_sort_shard(b[0], axis, p)[None], axis)
+    prog = jax.jit(shard_map(
+        per_shard, mesh=mesh, in_specs=(P(axis), P()),
+        out_specs=(P(axis), P(axis)), check_vma=False))
+    return prog, n_box
 
 
 def bitonic_sort_blocks(x2d: jax.Array, mesh, axis: str = DEFAULT_AXIS):

@@ -58,8 +58,13 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
-from icikit.models.attention.dense import NEG_INF
-from icikit.models.transformer.model import (
+from icikit import chaos as _chaos
+
+# site registry (chaos satellite): the decode dispatch-boundary drills
+_chaos.register_site("decode.prefill")
+
+from icikit.models.attention.dense import NEG_INF  # noqa: E402
+from icikit.models.transformer.model import (  # noqa: E402
     DP_AXIS,
     SP_AXIS,
     TP_AXIS,

@@ -32,6 +32,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from icikit import chaos as _chaos
 from icikit.models.attention.ring import ring_attention_shard
 from icikit.models.attention.ulysses import ulysses_attention_shard
 from icikit.models.attention.zigzag import zigzag_attention_shard
@@ -953,8 +954,107 @@ def _select_tree(ok, new, old):
         lambda n, o: jnp.where(ok, n, o), new, old)
 
 
+# traced in-schedule corruption site of the checked gradient sync
+# (registered at definition — the chaos site-registry contract)
+GRAD_SYNC_SITE = "collective.train.grad_sync"
+_chaos.register_site(GRAD_SYNC_SITE)
+
+
+def grad_sync_n_steps(mesh) -> int:
+    """Exchange-step count of the ``grad_check="ring"`` digest ring —
+    the ``n_steps`` a ``chaos.traced_corrupt_spec(GRAD_SYNC_SITE, ...)``
+    drill must target. Single source of truth for callers building the
+    taint vector (the trainer); must match the loop in
+    :func:`_make_grad_sync_check`."""
+    return mesh.shape[DP_AXIS] - 1
+
+
+def _make_grad_sync_check(mesh, pspecs):
+    """Checked-collective verdict over the step's gradient sync.
+
+    Each dp shard folds the explicitly psum-reduced gradient leaves
+    into one bit-exact digest (``transport.segment_checksum``) and
+    ring-circulates it over the checked transport. What this verdict
+    polices, precisely: (a) the digest exchange itself — every hop is
+    checksummed, so an in-flight flip (the
+    ``corrupt:collective.train.grad_sync`` drill, or a real flipped
+    wire in this ring) zeroes ``ok``; and (b) cross-replica agreement
+    of the digested value — if the explicit reduction delivers
+    different bytes to different replicas, their digests diverge and
+    the ring comparison fails. A False verdict makes
+    ``make_train_step``'s existing ``where(ok, new, old)`` select skip
+    the commit — no host sync, verdicts drain at fences like every
+    other device-guard flag.
+
+    Honest scope note: what it can NOT catch is a corruption in the
+    loss program's *implicit* AD-transpose psum that this explicit
+    psum then re-mixes — the corrupted sum comes out identical on
+    every replica, so the digests agree on the wrong bytes. The
+    stronger basis (digest each replica's ``grads`` leaf directly and
+    ring-compare — catching any post-sync replica divergence) is the
+    right check on a bitwise-deterministic stack, but on this image
+    the documented jax-0.4.37 XLA:CPU drift (docs/DESIGN.md
+    "Pre-existing tier-1 failures") makes replica bytes diverge
+    *organically*, so the direct basis false-positives every step;
+    flipping to it rides the TPU measurement session.
+
+    dp-sharded leaves (MoE expert weights) carry no dp replication to
+    verify and are excluded. Returns ``(check(grads, taint) -> ok
+    scalar, n_exchange_steps)``.
+    """
+    from icikit.parallel import transport
+    from icikit.parallel.shmap import shift_perm
+
+    p_dp = mesh.shape[DP_AXIS]
+    n_steps = grad_sync_n_steps(mesh)
+
+    def _dp_replicated(spec):
+        return not any(
+            a == DP_AXIS or (isinstance(a, tuple) and DP_AXIS in a)
+            for a in spec)
+
+    keys = tuple(sorted(k for k, s in pspecs.items()
+                        if _dp_replicated(s)))
+
+    def per_shard(gs, taint):
+        dig = jnp.zeros((), jnp.uint32)
+        for k in keys:
+            if jnp.issubdtype(gs[k].dtype, jnp.floating):
+                # digest the dp-REDUCED view: one explicit psum makes
+                # the digested bytes the post-all-reduce value every
+                # replica commits (on stacks whose implicit transpose-
+                # psum already reduced, this scales by p_dp — still
+                # bitwise identical on every replica; on the jax-0.4.37
+                # drift stack, where replicas genuinely diverge before
+                # reduction, it IS the reduction whose output the ring
+                # then polices)
+                dig = dig ^ transport.segment_checksum(
+                    lax.psum(gs[k], DP_AXIS))
+        tr = transport.Tracker(DP_AXIS, taint)
+        equal = jnp.asarray(True)
+        with transport.checked(tr):
+            cur = dig
+            for _ in range(n_steps):
+                cur = transport.ppermute(cur, DP_AXIS,
+                                         shift_perm(p_dp, 1))
+                equal = equal & (cur == dig)
+        ok = tr.verdict().all() & equal
+        # replicate the verdict so the step's select sees one scalar:
+        # total flagged-device count across the whole mesh
+        return lax.psum(jnp.where(ok, 0, 1), (DP_AXIS, TP_AXIS, SP_AXIS))
+
+    def check(grads, taint):
+        gsub = {k: grads[k] for k in keys}
+        sspec = {k: pspecs[k] for k in keys}
+        bad = shard_map(per_shard, mesh=mesh, in_specs=(sspec, P()),
+                        out_specs=P(), check_vma=False)(gsub, taint)
+        return bad == 0
+
+    return check, n_steps
+
+
 def make_train_step(mesh, cfg: TransformerConfig, optimizer=None,
-                    guard: str = "none"):
+                    guard: str = "none", grad_check: str = "none"):
     """Jitted full training step: (params, opt_state, tokens, targets)
     -> (params, opt_state, loss). ``optimizer`` is any optax
     GradientTransformation (default: adam(3e-4)), or a ``FusedAdam``
@@ -971,11 +1071,30 @@ def make_train_step(mesh, cfg: TransformerConfig, optimizer=None,
     With ``cfg.draft_head`` the step additionally returns a FINAL
     metrics dict (``draft_loss``, ``draft_top1_agree`` device scalars
     — the self-distillation telemetry); existing signatures are
-    unchanged when the head is off."""
+    unchanged when the head is off.
+
+    ``grad_check="ring"`` (requires ``guard="device"``) absorbs a
+    checked-collective verdict into ``ok``: the step takes a trailing
+    ``sync_taint`` int32[4] argument (``chaos.traced_corrupt_spec(
+    model.GRAD_SYNC_SITE, ...)`` per dispatch, ``chaos.TAINT_OFF``
+    when no drill is armed) and verifies the gradient sync on device
+    via a checksummed digest ring over dp — a flip in the digest
+    exchange or replica-diverged sync output skips the commit exactly
+    like a non-finite step (precise detection scope and its limits:
+    ``_make_grad_sync_check``)."""
     import optax
     if guard not in ("none", "device"):
         raise ValueError(f"unknown guard {guard!r} "
                          "(known: none, device)")
+    if grad_check not in ("none", "ring"):
+        raise ValueError(f"unknown grad_check {grad_check!r} "
+                         "(known: none, ring)")
+    if grad_check != "none" and guard != "device":
+        raise ValueError(
+            "grad_check needs guard='device': the verdict is absorbed "
+            "through the on-device where(ok, new, old) select")
+    sync_check = (_make_grad_sync_check(mesh, param_specs(cfg))[0]
+                  if grad_check == "ring" else None)
     if optimizer is None:
         optimizer = optax.adam(3e-4)
     if cfg.grad_dtype not in ("compute", "float32"):
@@ -1020,7 +1139,8 @@ def make_train_step(mesh, cfg: TransformerConfig, optimizer=None,
         opt = optimizer
 
         @jax.jit
-        def fused_step(params, opt_state, tokens, targets):
+        def fused_step(params, opt_state, tokens, targets,
+                       sync_taint=None):
             loss, grads, metrics = loss_and_metrics(
                 narrow(params), tokens, targets, mesh, cfg)
             m, v, t = opt_state
@@ -1041,6 +1161,10 @@ def make_train_step(mesh, cfg: TransformerConfig, optimizer=None,
                                         jnp.asarray(lr, jnp.float32), t)
             if guard == "device":
                 ok = _grads_finite(loss, grads)
+                if sync_check is not None:
+                    if sync_taint is None:  # no drill armed this call
+                        sync_taint = jnp.asarray(_chaos.TAINT_OFF)
+                    ok = ok & sync_check(grads, sync_taint)
                 new_p, new_st = _select_tree(
                     ok, (new_p, (new_m, new_v, t)),
                     (params, opt_state))
@@ -1054,7 +1178,7 @@ def make_train_step(mesh, cfg: TransformerConfig, optimizer=None,
         return optimizer, fused_step
 
     @jax.jit
-    def step(params, opt_state, tokens, targets):
+    def step(params, opt_state, tokens, targets, sync_taint=None):
         loss, grads, metrics = loss_and_metrics(
             narrow(params), tokens, targets, mesh, cfg)
         # moments accumulate from fp32 inputs: adam squares its
@@ -1068,6 +1192,10 @@ def make_train_step(mesh, cfg: TransformerConfig, optimizer=None,
         new_params = optax.apply_updates(params, updates)
         if guard == "device":
             ok = _grads_finite(loss, grads)
+            if sync_check is not None:
+                if sync_taint is None:  # no drill armed this call
+                    sync_taint = jnp.asarray(_chaos.TAINT_OFF)
+                ok = ok & sync_check(grads, sync_taint)
             new_params, new_opt = _select_tree(
                 ok, (new_params, new_opt), (params, opt_state))
             if cfg.draft_head:

@@ -70,7 +70,13 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from icikit import chaos, obs
-from icikit.models.transformer.decode import (
+
+# site registry (chaos satellite): speculative drill sites; drafters
+# are a dynamic family ("trained"/"shared"/"ngram"/...)
+chaos.register_site("decode.spec.prefill", "decode.spec.drafter.*",
+                    "decode.spec.verify.stats")
+
+from icikit.models.transformer.decode import (  # noqa: E402
     _check_sampling_args,
     _DecodeCtx,
     _prefill,

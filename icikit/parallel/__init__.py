@@ -34,6 +34,16 @@ from icikit.parallel.collops import (  # noqa: F401
     gather_blocks,
     scatter_blocks,
 )
+from icikit.parallel.integrity import (  # noqa: F401
+    CHECKED_FAMILIES,
+    IntegrityError,
+    checked_all_gather,
+    checked_all_reduce,
+    checked_all_to_all,
+    checked_reduce_scatter,
+    checked_scan,
+    quarantine_counts,
+)
 from icikit.parallel.multihost import (  # noqa: F401
     hier_chunk_index,
     hierarchical_all_gather,

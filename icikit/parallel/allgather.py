@@ -31,6 +31,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from icikit.parallel import transport
 from icikit.parallel.shmap import (
     build_collective,
     register_family,
@@ -52,7 +53,7 @@ def _naive(block: jax.Array, axis: str, p: int) -> jax.Array:
     """p-1 independent rotations of the own block (C2)."""
     r = lax.axis_index(axis)
     out = _own_block_first(block, p, r)
-    recvs = [lax.ppermute(block, axis, shift_perm(p, i)) for i in range(1, p)]
+    recvs = [transport.ppermute(block, axis, shift_perm(p, i)) for i in range(1, p)]
     for i, recv in enumerate(recvs, start=1):
         out = lax.dynamic_update_slice_in_dim(out, recv, jnp.mod(r - i, p), 0)
     return out
@@ -70,7 +71,7 @@ def _ring(block: jax.Array, axis: str, p: int) -> jax.Array:
     out = _own_block_first(block, p, r)
     cur = block
     for i in range(1, p):
-        cur = lax.ppermute(cur, axis, shift_perm(p, 1))
+        cur = transport.ppermute(cur, axis, shift_perm(p, 1))
         out = lax.dynamic_update_slice_in_dim(out, cur, jnp.mod(r - i, p), 0)
     return out
 
@@ -95,7 +96,7 @@ def _recursive_doubling(block: jax.Array, axis: str, p: int) -> jax.Array:
         step = 1 << i
         base = (r >> i) << i  # start of my currently-valid aligned group
         chunk = lax.dynamic_slice_in_dim(out, base, step, 0)
-        recv = lax.ppermute(chunk, axis, xor_perm(p, step))
+        recv = transport.ppermute(chunk, axis, xor_perm(p, step))
         out = lax.dynamic_update_slice_in_dim(out, recv, base ^ step, 0)
     return out
 
@@ -150,10 +151,10 @@ def _recursive_doubling_twins(block: jax.Array, axis: str, p: int) -> jax.Array:
         # involution on [0, p2)), so each buffer receives exactly one
         # non-zero chunk; summing the two partial permutes merges them.
         recv_own = sum(
-            lax.ppermute(chunks[src], axis, perms[(src, "own")])
+            transport.ppermute(chunks[src], axis, perms[(src, "own")])
             for src in ("own", "twin") if perms[(src, "own")])
         recv_twin = sum(
-            lax.ppermute(chunks[src], axis, perms[(src, "twin")])
+            transport.ppermute(chunks[src], axis, perms[(src, "twin")])
             for src in ("own", "twin") if perms[(src, "twin")])
         out_own = lax.dynamic_update_slice_in_dim(
             out_own, recv_own, base_own ^ step, 0)
@@ -178,13 +179,18 @@ register_family("allgather", "sharded",
 
 
 def all_gather_blocks(x: jax.Array, mesh, axis: str = DEFAULT_AXIS,
-                      algorithm: str = "ring") -> jax.Array:
+                      algorithm: str = "ring", checked: bool = False,
+                      retries: int = 2) -> jax.Array:
     """Distributed allgather of block-sharded ``x``.
 
     Args:
       x: global array of shape ``(p, ...)``, sharded along dim 0 — device
         d owns block ``x[d]``.
       algorithm: one of ``ALLGATHER_ALGORITHMS``.
+      checked: run the checksum-carrying schedule — every transmitted
+        block verified at its receive step on device, detected
+        corruption quarantined and retried at the dispatch boundary
+        (``icikit.parallel.integrity``; hand-rolled schedules only).
 
     Returns:
       Array of shape ``(p, p, ...)``: ``out[d]`` is device d's fully
@@ -193,4 +199,8 @@ def all_gather_blocks(x: jax.Array, mesh, axis: str = DEFAULT_AXIS,
       verifies every device's copy, as every rank verified in the
       reference (``:436-441``).
     """
+    if checked:
+        from icikit.parallel import integrity
+        return integrity.checked_all_gather(x, mesh, axis, algorithm,
+                                            retries=retries)
     return build_collective("allgather", algorithm, mesh, axis)(x)

@@ -17,6 +17,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from icikit.parallel import transport
 from icikit.parallel.shmap import (
     build_collective,
     register_family,
@@ -45,7 +46,7 @@ def _recursive_doubling(x: jax.Array, axis: str, p: int, op: str) -> jax.Array:
             "recursive_doubling allreduce requires power-of-2 p")
     combine = _OPS[op][0]
     for i in range(ilog2(p)):
-        recv = lax.ppermute(x, axis, xor_perm(p, 1 << i))
+        recv = transport.ppermute(x, axis, xor_perm(p, 1 << i))
         x = combine(x, recv)
     return x
 
@@ -88,15 +89,24 @@ register_family(
 
 
 def all_reduce(x: jax.Array, mesh, axis: str = DEFAULT_AXIS,
-               algorithm: str = "xla", op: str = "sum") -> jax.Array:
+               algorithm: str = "xla", op: str = "sum",
+               checked: bool = False, retries: int = 2) -> jax.Array:
     """Distributed elementwise reduction.
 
     Args:
       x: global array of shape ``(p, ...)`` sharded along dim 0; device
         d contributes ``x[d]``.
+      checked: checksum-carrying schedule with on-device per-step
+        verification and quarantine-and-retry recovery
+        (``icikit.parallel.integrity``) — requires a hand-rolled
+        algorithm ("ring"/"recursive_doubling"), not "xla".
 
     Returns:
       Array of the same shape/sharding with ``out[d]`` = the full
       reduction (every device ends with the reduced value).
     """
+    if checked:
+        from icikit.parallel import integrity
+        return integrity.checked_all_reduce(x, mesh, axis, algorithm,
+                                            op=op, retries=retries)
     return build_collective("allreduce", algorithm, mesh, axis, (op,))(x)

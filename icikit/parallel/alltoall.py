@@ -28,6 +28,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from icikit.parallel import transport
 from icikit.parallel.shmap import (
     build_collective,
     register_family,
@@ -54,7 +55,7 @@ def _wraparound(buf: jax.Array, axis: str, p: int) -> jax.Array:
     out = lax.dynamic_update_slice_in_dim(out, own, r, 0)
     for i in range(1, p):
         send = lax.dynamic_slice_in_dim(buf, jnp.mod(r + i, p), 1, 0)
-        recv = lax.ppermute(send, axis, shift_perm(p, i))
+        recv = transport.ppermute(send, axis, shift_perm(p, i))
         out = lax.dynamic_update_slice_in_dim(out, recv, jnp.mod(r - i, p), 0)
     return out
 
@@ -67,7 +68,7 @@ def _naive(buf: jax.Array, axis: str, p: int) -> jax.Array:
     own = lax.dynamic_slice_in_dim(buf, r, 1, 0)
     out = lax.dynamic_update_slice_in_dim(out, own, r, 0)
     recvs = [
-        lax.ppermute(
+        transport.ppermute(
             lax.dynamic_slice_in_dim(buf, jnp.mod(r + i, p), 1, 0),
             axis, shift_perm(p, i))
         for i in range(1, p)
@@ -93,7 +94,7 @@ def _ecube(buf: jax.Array, axis: str, p: int) -> jax.Array:
     for i in range(1, p):
         partner = r ^ i
         send = lax.dynamic_slice_in_dim(buf, partner, 1, 0)
-        recv = lax.ppermute(send, axis, xor_perm(p, i))
+        recv = transport.ppermute(send, axis, xor_perm(p, i))
         out = lax.dynamic_update_slice_in_dim(out, recv, partner, 0)
     return out
 
@@ -117,7 +118,7 @@ def _hypercube(buf: jax.Array, axis: str, p: int) -> jax.Array:
         my_bit = (r >> i) & 1
         # … then the p/2 blocks routed through the partner are one slice.
         send = lax.dynamic_slice_in_dim(view, 1 - my_bit, 1, axis=1)
-        recv = lax.ppermute(send, axis, xor_perm(p, bit))
+        recv = transport.ppermute(send, axis, xor_perm(p, bit))
         view = lax.dynamic_update_slice_in_dim(view, recv, 1 - my_bit, 1)
         out = view.reshape((p,) + m_shape)
     return out
@@ -137,13 +138,18 @@ register_family("alltoall", "sharded",
 
 
 def all_to_all_blocks(x: jax.Array, mesh, axis: str = DEFAULT_AXIS,
-                      algorithm: str = "wraparound") -> jax.Array:
+                      algorithm: str = "wraparound",
+                      checked: bool = False,
+                      retries: int = 2) -> jax.Array:
     """Distributed transpose of per-destination blocks.
 
     Args:
       x: global array of shape ``(p, p, ...)`` sharded along dim 0 —
         device s owns row ``x[s]``, whose slot d is the block destined
         for device d.
+      checked: checksum-carrying schedule with on-device per-step
+        verification and quarantine-and-retry recovery
+        (``icikit.parallel.integrity``; hand-rolled schedules only).
 
     Returns:
       Array of the same shape/sharding, equal to ``swapaxes(x, 0, 1)``:
@@ -151,4 +157,8 @@ def all_to_all_blocks(x: jax.Array, mesh, axis: str = DEFAULT_AXIS,
       reference's verification condition
       (``Communication/src/main.cc:478-486``).
     """
+    if checked:
+        from icikit.parallel import integrity
+        return integrity.checked_all_to_all(x, mesh, axis, algorithm,
+                                            retries=retries)
     return build_collective("alltoall", algorithm, mesh, axis)(x)

@@ -1,0 +1,246 @@
+"""Checked collectives: checksum-carrying schedules with
+quarantine-and-retry recovery (ROADMAP 5b, the device-side half of the
+end-to-end integrity story the serving stack already has for KV pages).
+
+A checked collective runs the *same* registered ppermute schedule as
+its unchecked twin, but through the checked transport
+(:mod:`icikit.parallel.transport`): every transmitted block travels
+with an exact bit-fold checksum, verified on the receiving device at
+that step, still inside the jitted program — no host sync in the hot
+path (the ``guard="device"`` discipline). The program returns, beside
+the payload, a per-device × per-step ``ok`` matrix; the dispatch
+boundary drains it, and a False entry names exactly the device and
+schedule step that produced the corruption.
+
+Recovery tier: detection quarantines the flagged devices (counters on
+the obs bus + a host-side ledger) and retries the deterministic
+schedule a bounded number of times. Because schedules are pure
+functions of their input, a retry that verifies clean is bitwise
+identical to a run that was never corrupted — the chaos drills pin
+exactly that. A drill that keeps firing past the retry budget raises
+:class:`IntegrityError`.
+
+What stays host-boundary-only: the ``xla`` vendor variants (the
+collective is a single opaque primitive — there is no receive step to
+verify inside) and the ragged/alltoallv paths that ride the vendor
+carrier. Checked mode refuses those loudly rather than pretending.
+"""
+
+from __future__ import annotations
+
+import threading
+from functools import lru_cache
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from icikit import chaos, obs
+from icikit.parallel import transport
+from icikit.parallel.shmap import _FAMILIES, wrap_program
+from icikit.utils.mesh import DEFAULT_AXIS
+from icikit.utils.registry import get_algorithm
+
+CHECKED_FAMILIES = ("allgather", "allreduce", "alltoall",
+                    "reducescatter", "scan")
+
+# every traced corruption site registered at definition (the site-
+# registry satellite): drills address "corrupt:collective.<family>"
+for _f in CHECKED_FAMILIES:
+    chaos.register_site(f"collective.{_f}")
+
+
+class IntegrityError(RuntimeError):
+    """A checked collective kept failing verification past its retry
+    budget — persistent corruption, not a transient flip."""
+
+
+def _require_checkable(family: str, algorithm: str) -> None:
+    if algorithm == "xla":
+        raise ValueError(
+            f"checked {family} cannot run the 'xla' vendor variant: "
+            "the native collective is one opaque primitive with no "
+            "receive step to verify inside — pick a hand-rolled "
+            "schedule (e.g. 'ring')")
+
+
+def tracked_shard(inner, axis: str):
+    """Wrap a per-shard schedule body for checked tracing: the returned
+    ``per_shard(b, taint)`` runs ``inner`` under a fresh transport
+    Tracker (every ``transport.ppermute`` inside carries + verifies
+    checksums, with the taint's traced-corruption site armed per call)
+    and returns ``(out, verdict[None])``. Also returns the ``n_box``
+    list the trace fills with the schedule's transport-call count —
+    the one place the box protocol lives (checked collectives here,
+    the bitonic exchange network in ``models.sort.bitonic``)."""
+    n_box: list = []
+
+    def per_shard(b, taint):
+        tr = transport.Tracker(axis, taint)
+        with transport.checked(tr):
+            out = inner(b)
+        n_box.append(tr.calls)
+        return out, tr.verdict()[None]
+
+    return per_shard, n_box
+
+
+@lru_cache(maxsize=None)
+def _build_checked(family: str, algorithm: str, mesh, axis: str,
+                   extra: tuple = ()):
+    """The checked twin of ``shmap.build_collective``: same adapter,
+    same impl, but traced under a transport Tracker with a taint input,
+    returning ``(out, ok)`` where ``ok`` is the per-device × per-step
+    verdict matrix. Returns ``(program, n_steps_box)`` — the box is
+    filled with the schedule's transport-call count at first trace."""
+    _require_checkable(family, algorithm)
+    input_kind, adapter = _FAMILIES[family]
+    impl = get_algorithm(family, algorithm)
+    p = mesh.shape[axis]
+    per_shard, n_box = tracked_shard(adapter(impl, axis, p, *extra),
+                                     axis)
+    in_specs = (P(axis) if input_kind == "sharded" else P(), P())
+    prog = wrap_program(per_shard, mesh, in_specs, (P(axis), P(axis)))
+    return prog, n_box
+
+
+def steps_of(prog, n_box, x) -> int:
+    """Transport-call count of a built checked schedule (needed by the
+    taint hash *before* the first execution): an abstract trace fills
+    the box without running or compiling anything."""
+    if not n_box:
+        jax.eval_shape(prog, jax.ShapeDtypeStruct(x.shape, x.dtype),
+                       jax.ShapeDtypeStruct((4,), jnp.int32))
+    return n_box[-1]
+
+
+# -- quarantine ledger + drill-visible stats -------------------------
+# one lock over both: concurrent checked dispatches (the serve engine
+# and the solitaire farm both run multi-threaded in-process) must not
+# drop increments from the very ledger a fleet scheduler would use to
+# stop re-leasing work to a defective core
+
+_ledger_lock = threading.Lock()
+_QUARANTINE: dict = {}
+_STATS = {"detected": 0, "retries": 0, "recoveries": 0, "last": None}
+
+
+def quarantine_counts() -> dict:
+    """Per-device detection counts (device index -> detections) since
+    the last reset — the host-side quarantine ledger mirroring the
+    ``integrity.*`` obs counters."""
+    with _ledger_lock:
+        return dict(_QUARANTINE)
+
+
+def stats() -> dict:
+    with _ledger_lock:
+        return {**_STATS, "last": dict(_STATS["last"] or {})}
+
+
+def reset_stats() -> None:
+    with _ledger_lock:
+        _QUARANTINE.clear()
+        _STATS.update(detected=0, retries=0, recoveries=0, last=None)
+
+
+def checked_run(site: str, prog, n_steps: int, p: int, args: tuple,
+                *, retries: int = 2, label: str = "") -> jax.Array:
+    """Execute a checked program with quarantine-and-retry recovery.
+
+    ``prog(*args, taint) -> (out, ok)``; each attempt consults the
+    armed chaos plan fresh (consuming one ``corrupt:<site>`` decision,
+    so a scheduled drill fires once and the retry runs clean). On
+    detection: quarantine counters for the flagged devices land on the
+    obs bus, the attempt's output is discarded, and the deterministic
+    schedule re-runs — at most ``retries`` times before
+    :class:`IntegrityError`.
+    """
+    label = label or site
+    bad = []
+    for attempt in range(retries + 1):
+        taint = jnp.asarray(chaos.traced_corrupt_spec(site, n_steps, p))
+        out, ok = prog(*args, taint)
+        ok_host = np.asarray(ok)
+        if ok_host.all():
+            if attempt:
+                with _ledger_lock:
+                    _STATS["recoveries"] += 1
+                obs.count("integrity.recoveries")
+                obs.emit("integrity.recovered", collective=label,
+                         attempt=attempt)
+            return out
+        bad = [(int(d), int(s)) for d, s in np.argwhere(~ok_host)]
+        devices = sorted({d for d, _ in bad})
+        steps = sorted({s for _, s in bad})
+        with _ledger_lock:
+            for d in devices:
+                _QUARANTINE[d] = _QUARANTINE.get(d, 0) + 1
+            _STATS["detected"] += 1
+            _STATS["last"] = {"collective": label, "devices": devices,
+                              "steps": steps, "attempt": attempt}
+            if attempt < retries:
+                _STATS["retries"] += 1
+        obs.count("integrity.detected")
+        obs.count("integrity.quarantined_devices", len(devices))
+        obs.emit("integrity.detected", collective=label,
+                 devices=devices, steps=steps, attempt=attempt)
+        if attempt < retries:
+            obs.count("integrity.retries")
+    raise IntegrityError(
+        f"checked {label} failed verification on devices "
+        f"{sorted({d for d, _ in bad})} in {retries + 1} attempts — "
+        "persistent corruption (quarantine ledger: "
+        "icikit.parallel.integrity.quarantine_counts())")
+
+
+def run_checked(family: str, x: jax.Array, mesh,
+                axis: str = DEFAULT_AXIS, algorithm: str = "ring",
+                extra: tuple = (), retries: int = 2) -> jax.Array:
+    """Checked dispatch for a registered collective family: verified
+    output of the ``algorithm`` schedule over block-sharded ``x``,
+    with detection + bounded retry handled at this boundary."""
+    prog, n_box = _build_checked(family, algorithm, mesh, axis,
+                                 tuple(extra))
+    p = mesh.shape[axis]
+    n_steps = steps_of(prog, n_box, x)
+    return checked_run(f"collective.{family}", prog, n_steps, p, (x,),
+                       retries=retries, label=f"{family}/{algorithm}")
+
+
+# -- the checked twins of the family dispatchers ---------------------
+
+
+def checked_all_gather(x, mesh, axis: str = DEFAULT_AXIS,
+                       algorithm: str = "ring", retries: int = 2):
+    return run_checked("allgather", x, mesh, axis, algorithm,
+                       retries=retries)
+
+
+def checked_all_reduce(x, mesh, axis: str = DEFAULT_AXIS,
+                       algorithm: str = "ring", op: str = "sum",
+                       retries: int = 2):
+    return run_checked("allreduce", x, mesh, axis, algorithm,
+                       extra=(op,), retries=retries)
+
+
+def checked_reduce_scatter(x, mesh, axis: str = DEFAULT_AXIS,
+                           algorithm: str = "ring", op: str = "sum",
+                           retries: int = 2):
+    return run_checked("reducescatter", x, mesh, axis, algorithm,
+                       extra=(op,), retries=retries)
+
+
+def checked_all_to_all(x, mesh, axis: str = DEFAULT_AXIS,
+                       algorithm: str = "wraparound",
+                       retries: int = 2):
+    return run_checked("alltoall", x, mesh, axis, algorithm,
+                       retries=retries)
+
+
+def checked_scan(x, mesh, axis: str = DEFAULT_AXIS,
+                 algorithm: str = "hillis_steele", op: str = "sum",
+                 inclusive: bool = True, retries: int = 2):
+    return run_checked("scan", x, mesh, axis, algorithm,
+                       extra=(op, bool(inclusive)), retries=retries)

@@ -47,6 +47,13 @@ from icikit.parallel.shmap import wrap_program
 from icikit.utils.mesh import DEFAULT_AXIS
 from icikit.utils.registry import get_algorithm
 
+# site registry (chaos satellite): every probe site declared at
+# definition so typoed drill plans warn instead of silently never firing
+chaos.register_site("multihost.init",
+                    *(f"multihost.hier.{c}" for c in
+                      ("allreduce", "allgather", "reducescatter",
+                       "alltoall")))
+
 DCN_AXIS = "dcn"
 
 # Chaos sites (ROADMAP 5c: the multi-host launcher had none). All sit

@@ -20,6 +20,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from icikit.parallel import transport
 from icikit.parallel.shmap import (
     build_collective,
     register_family,
@@ -62,7 +63,7 @@ def _ring(x: jax.Array, axis: str, p: int, op: str) -> jax.Array:
         i_send = jnp.mod(r - s + p - 1, p)
         i_recv = jnp.mod(r - s + p - 2, p)
         blk = lax.dynamic_slice_in_dim(acc, i_send, 1, 0)
-        recv = lax.ppermute(blk, axis, shift_perm(p, 1))
+        recv = transport.ppermute(blk, axis, shift_perm(p, 1))
         mine = lax.dynamic_slice_in_dim(acc, i_recv, 1, 0)
         acc = lax.dynamic_update_slice_in_dim(
             acc, combine(mine, recv), i_recv, 0)
@@ -98,7 +99,7 @@ def _recursive_halving(x: jax.Array, axis: str, p: int, op: str) -> jax.Array:
         g = acc.reshape((-1, 2, mask) + acc.shape[1:])  # (groups, 2, 2^i, ...)
         keep = jnp.take(g, bit, axis=1)
         send = jnp.take(g, 1 - bit, axis=1)
-        recv = lax.ppermute(send, axis, xor_perm(p, mask))
+        recv = transport.ppermute(send, axis, xor_perm(p, mask))
         acc = combine(keep, recv)  # (groups, 2^i, ...) -> flatten
         acc = acc.reshape((-1,) + acc.shape[2:])
     return acc[0]  # exactly one chunk remains: chunk r
@@ -119,7 +120,7 @@ def _pairwise(x: jax.Array, axis: str, p: int, op: str) -> jax.Array:
     for s in range(1, p):
         i_send = jnp.mod(r + s, p)
         blk = lax.dynamic_slice_in_dim(chunks, i_send, 1, 0)
-        recv = lax.ppermute(blk, axis, shift_perm(p, s))
+        recv = transport.ppermute(blk, axis, shift_perm(p, s))
         mine = combine(mine, recv)
     return mine[0]
 
@@ -144,13 +145,18 @@ register_family(
 
 
 def reduce_scatter(x: jax.Array, mesh, axis: str = DEFAULT_AXIS,
-                   algorithm: str = "xla", op: str = "sum") -> jax.Array:
+                   algorithm: str = "xla", op: str = "sum",
+                   checked: bool = False, retries: int = 2) -> jax.Array:
     """Distributed reduction scattered across devices.
 
     Args:
       x: global array of shape ``(p, m, ...)`` sharded along dim 0;
         device d contributes the full vector ``x[d]``. ``m`` must be
         divisible by p.
+      checked: checksum-carrying schedule with on-device per-step
+        verification and quarantine-and-retry recovery
+        (``icikit.parallel.integrity``) — requires a hand-rolled
+        algorithm, not "xla".
 
     Returns:
       Array of shape ``(p, m/p, ...)`` sharded along dim 0: ``out[d]`` is
@@ -161,4 +167,8 @@ def reduce_scatter(x: jax.Array, mesh, axis: str = DEFAULT_AXIS,
         raise ValueError(
             f"reduce_scatter needs m divisible by p "
             f"(shape {x.shape}, p={p})")
+    if checked:
+        from icikit.parallel import integrity
+        return integrity.checked_reduce_scatter(x, mesh, axis, algorithm,
+                                                op=op, retries=retries)
     return build_collective("reducescatter", algorithm, mesh, axis, (op,))(x)

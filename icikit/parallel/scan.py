@@ -34,6 +34,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from icikit.parallel import transport
 from icikit.parallel.shmap import (
     build_collective,
     partial_shift_perm,
@@ -66,7 +67,7 @@ def _hillis_steele(x: jax.Array, axis: str, p: int, op: str) -> jax.Array:
     r = lax.axis_index(axis)
     for i in range((p - 1).bit_length()):
         step = 1 << i
-        recv = lax.ppermute(x, axis, partial_shift_perm(p, step))
+        recv = transport.ppermute(x, axis, partial_shift_perm(p, step))
         x = jnp.where(r >= step, combine(x, recv), x)
     return x
 
@@ -82,7 +83,7 @@ def _linear(x: jax.Array, axis: str, p: int, op: str) -> jax.Array:
     acc, cur = x, x
     perm = partial_shift_perm(p, 1)
     for k in range(1, p):
-        cur = lax.ppermute(cur, axis, perm)
+        cur = transport.ppermute(cur, axis, perm)
         acc = jnp.where(r >= k, combine(acc, cur), acc)
     return acc
 
@@ -105,7 +106,7 @@ def _adapter(impl, axis, p, op, inclusive):
         out = impl(b[0], axis, p, op)
         if not inclusive:
             # MPI_Exscan: shift right by one device; device 0 = identity
-            shifted = lax.ppermute(out, axis, partial_shift_perm(p, 1))
+            shifted = transport.ppermute(out, axis, partial_shift_perm(p, 1))
             out = jnp.where(lax.axis_index(axis) == 0,
                             _identity(out.shape, out.dtype, op), shifted)
         return out[None]
@@ -117,7 +118,8 @@ register_family("scan", "sharded", _adapter)
 
 def scan_reduce(x: jax.Array, mesh, axis: str = DEFAULT_AXIS,
                 algorithm: str = "hillis_steele", op: str = "sum",
-                inclusive: bool = True) -> jax.Array:
+                inclusive: bool = True, checked: bool = False,
+                retries: int = 2) -> jax.Array:
     """Distributed prefix reduction over the mesh axis.
 
     Args:
@@ -126,9 +128,17 @@ def scan_reduce(x: jax.Array, mesh, axis: str = DEFAULT_AXIS,
       inclusive: ``True`` → ``out[d] = op(x[0..d])`` (``MPI_Scan``);
         ``False`` → ``out[d] = op(x[0..d-1])``, identity at d=0
         (``MPI_Exscan``).
+      checked: checksum-carrying schedule with on-device per-step
+        verification and quarantine-and-retry recovery
+        (``icikit.parallel.integrity``; hand-rolled schedules only).
 
     Returns:
       Global ``(p, ...)`` with the per-device prefix reductions.
     """
+    if checked:
+        from icikit.parallel import integrity
+        return integrity.checked_scan(x, mesh, axis, algorithm, op=op,
+                                      inclusive=inclusive,
+                                      retries=retries)
     return build_collective("scan", algorithm, mesh, axis,
                             (op, bool(inclusive)))(x)

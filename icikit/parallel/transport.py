@@ -1,0 +1,171 @@
+"""The collective transport: every hand-rolled schedule's exchanges go
+through :func:`ppermute`, which is plain ``lax.ppermute`` until a
+checked-mode :class:`Tracker` is installed for the trace.
+
+Why a seam exists at all: "Cores that don't count"-style silent data
+corruption happens *inside* a schedule — a bit flips in a ppermute
+round and then flows through every remaining round, committing into
+gradients or sort output with nothing downstream able to notice. The
+host-boundary chaos probes (``chaos.maybe_corrupt``) cannot reach
+those bytes: they only see arrays at dispatch fences. Checked mode
+folds a per-segment checksum beside every transmitted block and
+verifies it at each receive step, still inside the jitted program.
+
+Contracts:
+
+- **Zero overhead unchecked.** With no tracker installed,
+  :func:`ppermute` is one thread-local read + a ``None`` check at
+  *trace* time and compiles to exactly ``lax.ppermute`` — runtime cost
+  identical to before this seam existed.
+- **Bit-exact checksums.** :func:`segment_checksum` is a bit-level
+  fold over an integer view of the payload (rotate-XOR over a uint32
+  reinterpretation): dtype-generic, immune to fp reassociation, and
+  guaranteed to change under any single bit flip — so detection is
+  exact, never tolerance-based, and a clean run can never false-positive.
+- **Bit-identical when armed-but-cold.** The traced corruption site
+  (:func:`traced_flip`) applies ``payload ^ 0`` when its taint vector
+  is disarmed — the checked program's output is bitwise identical to
+  the unchecked schedule whether or not a chaos plan is armed.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+_local = threading.local()
+
+
+def _tracker():
+    return getattr(_local, "tracker", None)
+
+
+class Tracker:
+    """Per-trace accumulator for checked-mode transport.
+
+    Install with :class:`checked` around tracing a schedule; every
+    :func:`ppermute` on ``axis`` inside then carries checksums and
+    records a per-receive-step ``ok`` scalar. ``taint`` is the traced
+    corruption control (int32 ``[step, device, elem_seed, bit]``,
+    ``step < 0`` disarmed — see ``chaos.traced_corrupt_spec``).
+    """
+
+    def __init__(self, axis: str, taint):
+        self.axis = axis
+        self.taint = taint
+        self.oks: list = []
+
+    @property
+    def calls(self) -> int:
+        return len(self.oks)
+
+    def verdict(self):
+        """Per-step ok vector, shape ``(max(1, n_steps),)`` bool (a
+        schedule with no exchanges — p=1 — verifies vacuously)."""
+        if not self.oks:
+            return jnp.ones((1,), jnp.bool_)
+        return jnp.stack(self.oks)
+
+    def checked_ppermute(self, x, perm):
+        idx = len(self.oks)
+        cs = segment_checksum(x)
+        y = lax.ppermute(x, self.axis, perm)
+        cs_r = lax.ppermute(cs, self.axis, perm)
+        # the in-transit SDC site: lands between the sender's checksum
+        # and the receiver's verify, like a real flipped wire/core
+        y = traced_flip(y, self.taint, idx, self.axis)
+        self.oks.append(segment_checksum(y) == cs_r)
+        return y
+
+
+class checked:
+    """Install ``tracker`` for the duration of a trace (re-entrant:
+    the innermost tracker wins, the previous one is restored)."""
+
+    def __init__(self, tracker: Tracker):
+        self.tracker = tracker
+
+    def __enter__(self) -> Tracker:
+        self._prev = _tracker()
+        _local.tracker = self.tracker
+        return self.tracker
+
+    def __exit__(self, *exc):
+        _local.tracker = self._prev
+        return False
+
+
+def ppermute(x, axis, perm):
+    """``lax.ppermute`` with checked-mode interposition: under an
+    installed :class:`Tracker` for ``axis``, the block travels with a
+    checksum that is verified on the receiving device at this step."""
+    t = _tracker()
+    if t is None or t.axis != axis:
+        return lax.ppermute(x, axis, perm)
+    return t.checked_ppermute(x, perm)
+
+
+# -- bit-level fold ---------------------------------------------------
+
+
+def _uint_view(x):
+    """Reinterpret ``x`` as same-width unsigned ints (invertible)."""
+    if x.dtype == jnp.bool_:
+        x = x.astype(jnp.uint8)
+    udt = jnp.dtype(f"uint{x.dtype.itemsize * 8}")
+    if x.dtype == udt:
+        return x
+    return lax.bitcast_convert_type(x, udt)
+
+
+def segment_checksum(x) -> jax.Array:
+    """Exact uint32 checksum of one transmitted segment.
+
+    Bit fold, not arithmetic: the payload is bitcast to unsigned ints
+    (64-bit lanes fold high^low), widened to uint32, each lane rotated
+    by ``position % 32``, and XOR-reduced. Properties the verify step
+    relies on: dtype-generic (works on any bit pattern, NaNs included),
+    independent of fp reassociation (no float math at all), and any
+    single bit flip changes exactly one bit of one rotated lane — so
+    it always changes the fold. Cost: one elementwise pass + a
+    reduction, fused by XLA into the schedule's existing data movement.
+    """
+    u = _uint_view(x).reshape(-1)
+    if u.dtype == jnp.uint64:
+        u = ((u >> jnp.uint64(32)) ^ u).astype(jnp.uint32)
+    else:
+        u = u.astype(jnp.uint32)
+    if u.size == 0:
+        return jnp.zeros((), jnp.uint32)
+    s = (jnp.arange(u.size, dtype=jnp.uint32)) % jnp.uint32(32)
+    rot = (u << s) | (u >> ((jnp.uint32(32) - s) & jnp.uint32(31)))
+    return lax.reduce(rot, jnp.zeros((), jnp.uint32),
+                      lambda a, b: lax.bitwise_xor(a, b), (0,))
+
+
+def traced_flip(x, taint, call_idx: int, axis: str):
+    """The traced in-schedule corruption site (the device-side SDC
+    drill). ``taint`` is int32 ``[step, device, elem_seed, bit]``:
+    when ``step == call_idx`` on device ``device``, exactly one bit of
+    one element of ``x`` is flipped *inside the compiled program*;
+    otherwise the applied mask is 0 and ``x ^ 0`` is bit-identical to
+    ``x`` (the armed-but-cold pin). Always traced in checked mode, so
+    the program cache never depends on whether a chaos plan is armed."""
+    u = _uint_view(x)
+    nbits = u.dtype.itemsize * 8
+    flat = u.reshape(-1)
+    do = (taint[0] == call_idx) & (lax.axis_index(axis) == taint[1])
+    idx = jnp.mod(taint[2], flat.size)
+    bit = jnp.mod(taint[3], nbits).astype(u.dtype)
+    mask = jnp.where(do, jnp.ones((), u.dtype) << bit,
+                     jnp.zeros((), u.dtype))
+    flat = flat.at[idx].set(flat[idx] ^ mask)
+    u = flat.reshape(u.shape)
+    if u.dtype == x.dtype:
+        return u
+    if x.dtype == jnp.bool_:
+        return u.astype(jnp.bool_)
+    return lax.bitcast_convert_type(u, x.dtype)

@@ -108,7 +108,13 @@ from dataclasses import dataclass, field
 import numpy as np
 
 from icikit import chaos, obs
-from icikit.serve.kvpool import (
+
+# site registry (chaos satellite): the request-level drill sites
+chaos.register_site("serve.admit", "serve.admit.prompt",
+                    "serve.prefill.chunk", "serve.step",
+                    "serve.kv.page")
+
+from icikit.serve.kvpool import (  # noqa: E402
     KVPool,
     PoolExhausted,
     block_hashes,

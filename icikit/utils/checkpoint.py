@@ -22,6 +22,9 @@ from jax.sharding import NamedSharding, PartitionSpec
 
 from icikit import chaos
 
+# site registry (chaos satellite): flaky-storage drill of every save
+chaos.register_site("train.ckpt.save")
+
 
 def _abstract_like(tree, mesh=None):
     """ShapeDtypeStruct pytree carrying each leaf's sharding — the

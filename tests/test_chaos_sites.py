@@ -132,6 +132,132 @@ def test_sort_die_site_consumed_then_clean(mesh4):
     np.testing.assert_array_equal(out, np.sort(np.asarray(x)))
 
 
+# -- device-side SDC drills: checked collectives ---------------------
+#
+# The probes above corrupt at dispatch boundaries — arrays the host
+# already holds. These drills flip a bit INSIDE the jitted schedule
+# (chaos.traced_corrupt_spec -> transport.traced_flip, between two
+# ppermute rounds) and prove the checked transport's contract: the
+# flip is caught at the producing step, quarantined, and the bounded
+# retry recovers a result bitwise identical to the uncorrupted run.
+
+def _checked_call(family, alg, x, mesh, checked=True):
+    from icikit.parallel.allgather import all_gather_blocks
+    from icikit.parallel.allreduce import all_reduce
+    from icikit.parallel.alltoall import all_to_all_blocks
+    from icikit.parallel.reducescatter import reduce_scatter
+    from icikit.parallel.scan import scan_reduce
+    fns = {"allgather": all_gather_blocks, "allreduce": all_reduce,
+           "alltoall": all_to_all_blocks,
+           "reducescatter": reduce_scatter, "scan": scan_reduce}
+    return fns[family](x, mesh, algorithm=alg, checked=checked)
+
+
+def _checked_input(family, mesh4):
+    p = 4
+    rng = np.random.default_rng(13)
+    if family == "alltoall":
+        data = rng.integers(-1000, 1000, (p, p, 8)).astype(np.int32)
+    elif family == "reducescatter":
+        data = rng.integers(-1000, 1000, (p, p * 8)).astype(np.int32)
+    else:
+        data = rng.integers(-1000, 1000, (p, 16)).astype(np.int32)
+    return shard_along(jnp.asarray(data), mesh4, "p")
+
+
+@pytest.mark.parametrize("family,alg", [
+    ("allgather", "ring"),
+    ("allgather", "recursive_doubling"),
+    ("allreduce", "ring"),
+    ("allreduce", "recursive_doubling"),
+    ("reducescatter", "ring"),
+    ("reducescatter", "recursive_halving"),
+    ("alltoall", "wraparound"),
+    ("alltoall", "hypercube"),
+    ("scan", "hillis_steele"),
+])
+def test_checked_collective_catches_in_schedule_flip(mesh4, family, alg):
+    from icikit.parallel import integrity
+
+    x = _checked_input(family, mesh4)
+    base = np.asarray(_checked_call(family, alg, x, mesh4,
+                                    checked=False))
+    integrity.reset_stats()
+    plan = chaos.FaultPlan(
+        seed=21, schedule={f"corrupt:collective.{family}": (0,)})
+    with chaos.inject(plan):
+        healed = np.asarray(_checked_call(family, alg, x, mesh4))
+    assert plan.fired("corrupt", f"collective.{family}") == 1
+    st = integrity.stats()
+    # caught at the step that produced it: exactly one (device, step)
+    # cell of the verdict matrix flagged, then recovered by retry
+    assert st["detected"] == 1 and st["recoveries"] == 1, st
+    assert len(st["last"]["devices"]) == 1
+    assert len(st["last"]["steps"]) == 1
+    # and the recovered bytes are identical to the uncorrupted run
+    np.testing.assert_array_equal(healed, base)
+
+
+@pytest.mark.parametrize("family,alg", [
+    ("allgather", "ring"), ("allreduce", "ring"),
+    ("reducescatter", "ring"), ("alltoall", "wraparound"),
+    ("scan", "hillis_steele"),
+])
+def test_checked_clean_armed_run_bit_identical(mesh4, family, alg):
+    """The standing pin: an armed-but-never-firing corrupt plan leaves
+    checked results byte-identical to unchecked unarmed runs — the
+    checksum machinery must be free when cold."""
+    from icikit.parallel import integrity
+
+    x = _checked_input(family, mesh4)
+    base = np.asarray(_checked_call(family, alg, x, mesh4,
+                                    checked=False))
+    integrity.reset_stats()
+    plan = chaos.FaultPlan(rates={"corrupt:collective.*": 0.0})
+    with chaos.inject(plan):
+        armed = np.asarray(_checked_call(family, alg, x, mesh4))
+    assert plan.log == []
+    np.testing.assert_array_equal(armed, base)
+    assert integrity.stats()["detected"] == 0  # zero false positives
+
+
+def test_checked_sort_catches_in_schedule_flip(mesh4):
+    from icikit.models import sort as sort_mod
+    from icikit.parallel import integrity
+
+    x = jnp.asarray(np.random.default_rng(3).integers(-1000, 1000, 129),
+                    jnp.int32)
+    base = np.asarray(sort_mod.sort(x, mesh4, algorithm="bitonic"))
+    integrity.reset_stats()
+    plan = chaos.FaultPlan(
+        seed=9, schedule={"corrupt:sort.bitonic.exchange": (0,)})
+    with chaos.inject(plan):
+        healed = np.asarray(sort_mod.sort(x, mesh4, algorithm="bitonic",
+                                          checked=True))
+    assert plan.fired("corrupt", "sort.bitonic.exchange") == 1
+    st = integrity.stats()
+    assert st["detected"] == 1 and st["recoveries"] == 1, st
+    np.testing.assert_array_equal(healed, base)
+    np.testing.assert_array_equal(healed, np.sort(np.asarray(x)))
+
+
+def test_checked_sort_clean_armed_bit_identical(mesh4):
+    from icikit.models import sort as sort_mod
+    from icikit.parallel import integrity
+
+    x = jnp.asarray(np.random.default_rng(4).standard_normal(200),
+                    jnp.float32)
+    base = np.asarray(sort_mod.sort(x, mesh4, algorithm="bitonic"))
+    integrity.reset_stats()
+    plan = chaos.FaultPlan(rates={"corrupt:sort.*": 0.0})
+    with chaos.inject(plan):
+        armed = np.asarray(sort_mod.sort(x, mesh4, algorithm="bitonic",
+                                         checked=True))
+    assert plan.log == []
+    np.testing.assert_array_equal(armed, base)
+    assert integrity.stats()["detected"] == 0
+
+
 # -- multi-host launcher ---------------------------------------------
 
 def _hybrid_x(mesh, m, seed=0):

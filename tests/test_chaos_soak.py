@@ -115,6 +115,119 @@ def test_solve_dynamic_death_plus_flaky_checkpoint(tmp_path):
         np.testing.assert_array_equal(a, b)
 
 
+def test_checked_collectives_soak_inside_solve_dynamic_run():
+    """The checked-collective leg: one armed plan drives worker death,
+    straggler delays AND in-schedule collective corruption through a
+    solve_dynamic run with checked gradient-style collectives
+    interleaved between the scheduler's pulls (the training-farm shape:
+    work scheduling and checked syncs sharing one fault session).
+    Every corruption the rate plan lands inside a schedule is detected
+    and retried; the healed solve report and every collective result
+    are bitwise identical to the fault-free run."""
+    import jax.numpy as jnp
+
+    from icikit.parallel.allgather import all_gather_blocks
+    from icikit.parallel.allreduce import all_reduce
+    from icikit.parallel import integrity
+    from icikit.utils.mesh import make_mesh, shard_along
+
+    p = 4
+    devices = jax.devices()[:p]
+    mesh = make_mesh(p)
+    ds = generate_dataset(32, "easy", seed=41)
+    rng = np.random.default_rng(41)
+    payloads = [rng.integers(-1000, 1000, (p, 64)).astype(np.int32)
+                for _ in range(6)]
+    xs = [shard_along(jnp.asarray(d), mesh, "p") for d in payloads]
+
+    def workload(checked):
+        rep = solve_dynamic(ds, devices=devices, chunk_size=4)
+        outs = []
+        for i, x in enumerate(xs):
+            fn = all_reduce if i % 2 else all_gather_blocks
+            kw = {"checked": True, "retries": 6} if checked else {}
+            outs.append(np.asarray(fn(x, mesh, algorithm="ring", **kw)))
+        return rep, outs
+
+    base_rep, base_outs = workload(checked=False)
+    assert base_rep.n_deaths == 0
+
+    integrity.reset_stats()
+    plan = chaos.FaultPlan(
+        seed=6,
+        schedule={"die:solitaire.worker.3": (0,)},
+        rates={"delay:solitaire.worker.*": 0.2,
+               # every checked dispatch (and every retry) consults
+               # this rate: over 6 collectives the drill fires
+               # repeatedly, mid-schedule, while the farm is also
+               # healing deaths; the widened retry budget above keeps
+               # a fired-again retry a recovery, not an exhaustion
+               "corrupt:collective.*": 0.5},
+        delay_s=0.003)
+    with chaos.inject(plan):
+        healed_rep, healed_outs = workload(checked=True)
+
+    # the farm healed bitwise...
+    for a, b in zip(_arrays(base_rep), _arrays(healed_rep)):
+        np.testing.assert_array_equal(a, b)
+    assert healed_rep.n_deaths == 1
+    # ...and every checked collective recovered bitwise too
+    for a, b in zip(base_outs, healed_outs):
+        np.testing.assert_array_equal(a, b)
+    st = integrity.stats()
+    fired = plan.fired("corrupt", "collective.*")
+    assert fired > 0, "the corrupt rate never landed — dead drill"
+    assert st["detected"] == fired  # every injected flip was caught
+    assert st["recoveries"] > 0
+    assert st["detected"] == st["retries"], (
+        "every detection must recover within the retry budget")
+    # replay determinism: the same plan reproduces the same fault log
+    integrity.reset_stats()
+    plan2 = chaos.FaultPlan(
+        seed=6,
+        schedule={"die:solitaire.worker.3": (0,)},
+        rates={"delay:solitaire.worker.*": 0.2,
+               "corrupt:collective.*": 0.5},
+        delay_s=0.003)
+    with chaos.inject(plan2):
+        rep2, outs2 = workload(checked=True)
+    assert (sorted(e for e in plan2.log if e[0] == "corrupt")
+            == sorted(e for e in plan.log if e[0] == "corrupt"))
+    for a, b in zip(healed_outs, outs2):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_train_loop_checked_grad_sync_drill(capsys):
+    """--checked-grad-sync end-to-end: an in-schedule flip in the
+    gradient-sync digest ring (the corrupt:collective.train.grad_sync
+    drill) surfaces as a device-guard anomaly at the fence — the step
+    was skipped on device — and the run still completes finite."""
+    from icikit.models.transformer.train import train
+
+    plan = chaos.FaultPlan(
+        # traced_corrupt_spec consults once per step: call index 3 ==
+        # 1-based step 4
+        schedule={"corrupt:collective.train.grad_sync": (3,)})
+    with chaos.inject(plan):
+        rc = train(["--steps", "8", "--batch", "4", "--vocab", "32",
+                    "--d-model", "32", "--n-heads", "2", "--d-head", "8",
+                    "--d-ff", "64", "--n-layers", "1", "--seq", "16",
+                    "--dp", "2", "--compute-dtype", "float32",
+                    "--log-every", "2", "--sample-tokens", "0",
+                    "--guard-mode", "device", "--checked-grad-sync"])
+    assert rc == 0
+    assert plan.fired("corrupt", "collective.train.grad_sync") == 1
+    recs = [json.loads(line) for line in
+            capsys.readouterr().out.strip().splitlines()]
+    anomalies = [r for r in recs if r.get("event") == "anomaly"]
+    assert [a["step"] for a in anomalies] == [4]
+    steps = [r for r in recs if "step" in r and "loss" in r]
+    assert steps[-1]["step"] == 8
+    assert np.isfinite(steps[-1]["loss"])
+    summary = [r for r in recs if r.get("event") == "guard_summary"]
+    assert summary and summary[0]["anomalies"] == 1
+
+
 def test_train_loop_survives_nan_steps_and_flaky_ckpt(tmp_path, capsys):
     """Anomaly-guard drill: injected NaN losses are skipped, a streak
     triggers rollback to the last committed checkpoint, the first

@@ -9,6 +9,7 @@ ran power-of-2 process counts and one dtype)."""
 import numpy as np
 import pytest
 
+from icikit import chaos
 from icikit.bench.harness import _setup
 from icikit.utils.mesh import UnsupportedMeshError, make_mesh
 from icikit.utils.registry import list_algorithms
@@ -37,3 +38,84 @@ def test_random_config_verifies(seed):
     assert verify(out), (
         f"oracle mismatch: {family}/{algorithm} p={p} msize={msize} "
         f"{dtype}")
+
+
+# -- checked-mode fuzz (device-side integrity) -----------------------
+#
+# Same random-config discipline over the checksum-carrying schedules:
+# (a) a clean corpus under an ARMED-but-cold corrupt plan must verify
+# against the oracle with ZERO detections (the checksum is exact, so
+# false positives are a hard failure, not noise), and (b) under a
+# scheduled corrupt plan every injected in-schedule flip must be
+# detected and retried back to the oracle result.
+
+from icikit.parallel.integrity import CHECKED_FAMILIES  # noqa: E402
+
+# movement-only families shuffle any bit pattern; reductions keep
+# dtypes whose numpy oracle matches device arithmetic exactly
+_MOVE_DTYPES = (np.int32, np.float32, np.float16, np.int8)
+_REDUCE_DTYPES = (np.int32, np.float32)
+
+
+def _checked_pick(seed):
+    rng = np.random.default_rng(10_000 + seed)
+    family = CHECKED_FAMILIES[rng.integers(len(CHECKED_FAMILIES))]
+    p = int(rng.choice([2, 3, 4, 5, 8]))
+    msize = int(rng.choice([1, 3, 8, 17, 64, 200]))
+    pool = (_MOVE_DTYPES if family in ("allgather", "alltoall")
+            else _REDUCE_DTYPES)
+    dtype = np.dtype(pool[rng.integers(len(pool))])
+    algs = [a for a in list_algorithms(family) if a != "xla"]
+    algorithm = algs[rng.integers(len(algs))]
+    return family, algorithm, p, msize, dtype
+
+
+@pytest.mark.parametrize("seed", range(16))
+def test_checked_random_config_no_false_positives(seed):
+    from icikit.parallel import integrity
+
+    family, algorithm, p, msize, dtype = _checked_pick(seed)
+    mesh = make_mesh(p)
+    run, verify = _setup(family, mesh, "p", msize, dtype, checked=True)
+    integrity.reset_stats()
+    plan = chaos.FaultPlan(rates={"corrupt:collective.*": 0.0})
+    try:
+        with chaos.inject(plan):
+            out = run(algorithm)
+    except UnsupportedMeshError:
+        assert p & (p - 1), (
+            f"{family}/{algorithm} rejected a power-of-2 mesh p={p}")
+        return
+    assert verify(out), (
+        f"oracle mismatch: checked {family}/{algorithm} p={p} "
+        f"msize={msize} {dtype}")
+    assert integrity.stats()["detected"] == 0, (
+        f"false positive: checked {family}/{algorithm} p={p} "
+        f"msize={msize} {dtype} flagged a clean run")
+    assert plan.log == []
+
+
+@pytest.mark.parametrize("seed", range(16))
+def test_checked_random_config_detects_injected_flip(seed):
+    from icikit.parallel import integrity
+
+    family, algorithm, p, msize, dtype = _checked_pick(seed)
+    mesh = make_mesh(p)
+    run, verify = _setup(family, mesh, "p", msize, dtype, checked=True)
+    integrity.reset_stats()
+    plan = chaos.FaultPlan(
+        seed=seed, schedule={f"corrupt:collective.{family}": (0,)})
+    try:
+        with chaos.inject(plan):
+            out = run(algorithm)
+    except UnsupportedMeshError:
+        assert p & (p - 1)
+        return
+    if p == 1:
+        return  # no exchanges to corrupt
+    assert plan.fired("corrupt", f"collective.{family}") == 1
+    st = integrity.stats()
+    assert st["detected"] == 1 and st["recoveries"] == 1, (
+        f"undetected flip: checked {family}/{algorithm} p={p} "
+        f"msize={msize} {dtype}: {st}")
+    assert verify(out), "retry did not recover the oracle result"

@@ -1,0 +1,245 @@
+"""Checked collectives (``icikit.parallel.integrity``): the checksum
+transport, detection precision, quarantine-and-retry recovery, the
+chaos site registry, and the train step's verdict absorption.
+
+The drill suites live in tests/test_chaos_sites.py (per-family SDC
+drills) and tests/test_fuzz_collectives.py (randomized corpus); this
+file unit-tests the machinery those drills stand on.
+"""
+
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from icikit import chaos
+from icikit.parallel import integrity, transport
+from icikit.parallel.allgather import all_gather_blocks
+from icikit.parallel.allreduce import all_reduce
+from icikit.utils.mesh import make_mesh, shard_along
+
+
+# -- segment_checksum: the bit-fold contract -------------------------
+
+# (64-bit lanes need jax_enable_x64, which this suite keeps off; the
+# checksum's uint64 high^low fold stays for x64-enabled stacks)
+@pytest.mark.parametrize("dtype", ["int32", "float32", "float16",
+                                   "bfloat16", "int8", "uint8"])
+def test_checksum_changes_under_every_single_bit_flip(dtype):
+    """Exactness, exhaustively on a small payload: flipping ANY single
+    bit changes the checksum (detection can never miss), and the
+    checksum of the unmodified payload is reproducible (a clean run
+    can never false-positive)."""
+    rng = np.random.default_rng(7)
+    raw = rng.integers(0, 256, size=48, dtype=np.uint8).tobytes()
+    a = np.frombuffer(raw, dtype=np.dtype(dtype)
+                      if dtype != "bfloat16" else np.uint16)
+    base = jnp.asarray(a).view(jnp.bfloat16) if dtype == "bfloat16" \
+        else jnp.asarray(a)
+    cs = jax.jit(transport.segment_checksum)
+    ref = np.asarray(cs(base))
+    assert np.asarray(cs(base)) == ref  # deterministic
+    buf = bytearray(raw)
+    seen = set()
+    for bitpos in range(len(raw) * 8):
+        buf[bitpos // 8] ^= 1 << (bitpos % 8)
+        b = np.frombuffer(bytes(buf), dtype=np.dtype(dtype)
+                          if dtype != "bfloat16" else np.uint16)
+        flipped = (jnp.asarray(b).view(jnp.bfloat16)
+                   if dtype == "bfloat16" else jnp.asarray(b))
+        got = np.asarray(cs(flipped))
+        assert got != ref, f"missed flip at bit {bitpos} ({dtype})"
+        seen.add(int(got))
+        buf[bitpos // 8] ^= 1 << (bitpos % 8)  # restore
+
+
+def test_checked_on_single_device_mesh_is_vacuously_ok():
+    """p=1: no exchanges, so the verdict is vacuous and the checked
+    path still returns the exact payload (shape contract intact)."""
+    x = jnp.asarray(np.random.default_rng(0).standard_normal(64),
+                    jnp.float32)
+    mesh = make_mesh(1)
+    base = np.asarray(all_gather_blocks(x[None], mesh, algorithm="ring",
+                                        checked=True))
+    again = np.asarray(all_gather_blocks(x[None], mesh, algorithm="ring"))
+    np.testing.assert_array_equal(base[0], np.asarray(x)[None])
+    np.testing.assert_array_equal(base, again)
+
+
+# -- checked dispatch: detection, quarantine, retry, exhaustion ------
+
+def test_checked_rejects_vendor_variant(mesh4):
+    x = shard_along(jnp.ones((4, 8), jnp.int32), mesh4, "p")
+    with pytest.raises(ValueError, match="vendor"):
+        all_reduce(x, mesh4, algorithm="xla", checked=True)
+
+
+def test_detection_names_the_producing_device_and_step(mesh4):
+    data = np.arange(4 * 16, dtype=np.int32).reshape(4, 16)
+    x = shard_along(jnp.asarray(data), mesh4, "p")
+    base = np.asarray(all_gather_blocks(x, mesh4, algorithm="ring"))
+    integrity.reset_stats()
+    plan = chaos.FaultPlan(seed=5,
+                           schedule={"corrupt:collective.allgather": (0,)})
+    with chaos.inject(plan):
+        healed = np.asarray(all_gather_blocks(x, mesh4, algorithm="ring",
+                                              checked=True))
+    np.testing.assert_array_equal(healed, base)
+    st = integrity.stats()
+    assert st["detected"] == 1 and st["retries"] == 1
+    assert st["recoveries"] == 1
+    # the verdict matrix pinpoints exactly the injected (device, step):
+    # corruption at receive step t is caught at step t, not later (the
+    # corrupted block's onward journey re-checksums consistently)
+    assert len(st["last"]["devices"]) == 1
+    assert len(st["last"]["steps"]) == 1
+    assert 0 <= st["last"]["steps"][0] < 3  # ring over p=4: 3 steps
+    # quarantine ledger mirrors the obs counters
+    assert integrity.quarantine_counts() == {st["last"]["devices"][0]: 1}
+
+
+def test_persistent_corruption_exhausts_retries(mesh4):
+    x = shard_along(jnp.asarray(
+        np.arange(4 * 8, dtype=np.int32).reshape(4, 8)), mesh4, "p")
+    integrity.reset_stats()
+    # rate 1.0: every attempt's dispatch decision fires — a stuck-at
+    # fault, not a transient
+    plan = chaos.FaultPlan(rates={"corrupt:collective.allgather": 1.0})
+    with chaos.inject(plan):
+        with pytest.raises(integrity.IntegrityError, match="persistent"):
+            all_gather_blocks(x, mesh4, algorithm="ring", checked=True,
+                              retries=2)
+    assert plan.fired("corrupt", "collective.allgather") == 3
+    assert integrity.stats()["detected"] == 3
+
+
+def test_retry_consumes_plan_indices_deterministically(mesh4):
+    """Two identical drills replay identically: same fired log, same
+    recovered bytes — the whole recovery is a pure function of the
+    plan (the chaos module's core contract, extended in-schedule)."""
+    x = shard_along(jnp.asarray(
+        np.arange(4 * 8, dtype=np.int32).reshape(4, 8)), mesh4, "p")
+
+    def drill():
+        integrity.reset_stats()
+        plan = chaos.FaultPlan(
+            seed=3, schedule={"corrupt:collective.allreduce": (0, 1)})
+        with chaos.inject(plan):
+            out = np.asarray(all_reduce(x, mesh4, algorithm="ring",
+                                        checked=True))
+        return out, sorted(plan.log), integrity.stats()["detected"]
+
+    out1, log1, d1 = drill()
+    out2, log2, d2 = drill()
+    np.testing.assert_array_equal(out1, out2)
+    assert log1 == log2 and d1 == d2 == 2
+    np.testing.assert_array_equal(
+        out1, np.asarray(all_reduce(x, mesh4, algorithm="ring")))
+
+
+# -- site registry ---------------------------------------------------
+
+def test_registered_sites_cover_the_checked_families():
+    for fam in integrity.CHECKED_FAMILIES:
+        assert chaos.site_known(f"collective.{fam}")
+    assert chaos.site_known("collective.*")
+
+
+def test_inject_warns_on_unknown_site_glob():
+    assert chaos.registered_sites()  # instrumented modules imported
+    plan = chaos.FaultPlan(
+        rates={"die:collective.allgatherr": 0.5})  # chaos-site-lint: off
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        with chaos.inject(plan):
+            pass
+    assert any("no registered probe site" in str(x.message) for x in w)
+
+
+def test_inject_stays_quiet_for_known_sites_and_patterns():
+    import icikit.models.solitaire.scheduler  # noqa: F401 (registers)
+
+    plan = chaos.FaultPlan(rates={"die:solitaire.worker.*": 0.5,
+                                  "corrupt:collective.allgather": 0.1,
+                                  "die:solitaire.worker.1": 0.1})
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        with chaos.inject(plan):
+            pass
+    assert not [x for x in w
+                if "no registered probe site" in str(x.message)]
+
+
+# -- train step absorbs the checked grad-sync verdict ----------------
+
+def _tiny_setup(grad_check):
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from icikit.models.transformer import (
+        TransformerConfig, init_params, make_train_step)
+    from icikit.models.transformer.model import make_model_mesh
+
+    cfg = TransformerConfig(vocab=32, d_model=32, n_heads=2, d_head=8,
+                            d_ff=64, n_layers=1, max_seq=16,
+                            compute_dtype="float32")
+    mesh = make_model_mesh(dp=2, tp=1, sp=1)
+    params = init_params(jax.random.key(0), cfg, mesh)
+    opt, step = make_train_step(mesh, cfg, guard="device",
+                                grad_check=grad_check)
+    state = opt.init(params)
+    rng = np.random.default_rng(0)
+    sh = NamedSharding(mesh, P("dp", "sp"))
+    tok = jax.device_put(jnp.asarray(rng.integers(0, 32, (4, 16))), sh)
+    tgt = jax.device_put(jnp.asarray(rng.integers(0, 32, (4, 16))), sh)
+    return params, state, step, tok, tgt
+
+
+def test_grad_check_requires_device_guard():
+    from icikit.models.transformer import TransformerConfig, make_train_step
+    from icikit.models.transformer.model import make_model_mesh
+
+    cfg = TransformerConfig(vocab=32, d_model=32, n_heads=2, d_head=8,
+                            d_ff=64, n_layers=1, max_seq=16)
+    mesh = make_model_mesh(dp=2, tp=1, sp=1)
+    with pytest.raises(ValueError, match="guard='device'"):
+        make_train_step(mesh, cfg, guard="none", grad_check="ring")
+
+
+def test_corrupted_grad_sync_skips_the_commit():
+    from icikit.models.transformer.model import GRAD_SYNC_SITE
+
+    params, state, step, tok, tgt = _tiny_setup("ring")
+    taint_off = jnp.asarray(chaos.TAINT_OFF)
+    p_ok, st_ok, loss, ok = step(params, state, tok, tgt, taint_off)
+    assert bool(np.asarray(ok))
+    assert any(not np.array_equal(np.asarray(a), np.asarray(b))
+               for a, b in zip(jax.tree.leaves(params),
+                               jax.tree.leaves(p_ok)))
+
+    plan = chaos.FaultPlan(
+        seed=2, schedule={f"corrupt:{GRAD_SYNC_SITE}": (0,)})
+    with chaos.inject(plan):
+        taint = jnp.asarray(
+            chaos.traced_corrupt_spec(GRAD_SYNC_SITE, 1, 2))
+    assert plan.fired("corrupt", GRAD_SYNC_SITE) == 1
+    p_bad, st_bad, loss_bad, ok_bad = step(params, state, tok, tgt,
+                                           taint)
+    assert not bool(np.asarray(ok_bad))
+    # the where(ok, new, old) select held EVERYTHING: params and
+    # optimizer state are bitwise untouched by the corrupted step
+    for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(p_bad)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    for a, b in zip(jax.tree.leaves(state), jax.tree.leaves(st_bad)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_checked_step_bitwise_matches_unchecked_on_clean_runs():
+    params, state, step, tok, tgt = _tiny_setup("ring")
+    params2, state2, plain, _, _ = _tiny_setup("none")
+    out = step(params, state, tok, tgt, jnp.asarray(chaos.TAINT_OFF))
+    ref = plain(params2, state2, tok, tgt)
+    np.testing.assert_array_equal(np.asarray(out[2]), np.asarray(ref[2]))
+    for a, b in zip(jax.tree.leaves(out[0]), jax.tree.leaves(ref[0])):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

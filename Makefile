@@ -51,6 +51,7 @@ check:
 	$(PY) tools/serve_key_lint.py
 	JAX_PLATFORMS=cpu $(PY) tools/quant_lint.py
 	JAX_PLATFORMS=cpu $(PY) tools/chaos_site_lint.py
+	$(PY) tools/tree_accept_lint.py
 
 # multi-token decode smoke: a tiny CPU speculative decode under an
 # armed obs session — the acceptance counters/spans must flow and the
@@ -64,6 +65,14 @@ decode-smoke:
 	$(PY) -m icikit.obs.check /tmp/icikit_decode_trace.json
 	@grep -q "decode.spec.draft_accepted" /tmp/icikit_decode_metrics.json \
 		&& echo "decode-smoke OK: trace valid, acceptance counters present"
+	JAX_PLATFORMS=cpu \
+	ICIKIT_OBS="trace=/tmp/icikit_tree_trace.json;metrics=/tmp/icikit_tree_metrics.json;jsonl=off" \
+	$(PY) -m icikit.bench.decode --preset tiny --batch 2 --prompt 8 \
+		--new 12 --speculate 3 --draft-layers 1 --tree-branch 2 \
+		--drafter ngram --runs 1 > /dev/null
+	$(PY) -m icikit.obs.check /tmp/icikit_tree_trace.json
+	@grep -q "decode.spec.tree.draft_accepted" /tmp/icikit_tree_metrics.json \
+		&& echo "decode-smoke OK: tree leg trace valid, tree acceptance counters present"
 
 # trained-draft-head smoke: a tiny self-distillation run (draft head
 # armed, per-step draft.loss/draft.top1_agree on the obs bus) that

@@ -204,17 +204,124 @@ def spec_bytes_per_iter(cfg, batch: int, cache_len: float, k: int,
     return (k - 1) * draft_pass, verify
 
 
+def tree_bytes_per_iter(cfg, batch: int, cache_len: float, k: int,
+                        draft_layers: int, tree_branch: int,
+                        vmem_resident: int = VMEM_RESIDENT_BYTES,
+                        bytes_dtype: str = "bf16",
+                        drafter_free: bool = False):
+    """HBM bytes one TOKEN-TREE draft+verify iteration moves, split
+    (draft_bytes_total, verify_bytes) — the r14 generalization of
+    ``spec_bytes_per_iter``. The parameter and cache READ streams are
+    window-shape-independent (that is the whole speculative bet), but
+    three terms genuinely scale with TREE SIZE (``w = 1 +
+    (k-1)·b`` linearized nodes), not depth alone:
+
+    - the window's K/V writes: ``w`` fresh cache columns per pass
+      instead of ``k`` (plus scale columns under int8);
+    - the accepted-path relocation: up to ``k`` columns read out of
+      tree scratch and rewritten position-aligned (2× traffic);
+    - the materialized logits: ``(batch, w, vocab)`` fp32 written by
+      the head and read back by the selector — per-NODE, the one
+      vocab-sized term that multiplies with branch count.
+
+    ``drafter_free=True`` zeroes the draft passes (ngram/suffix
+    proposals cost no model bytes — the zero-cost drafters the tree
+    route leans on). Per-node attention/FFN FLOPs also grow with tree
+    size but are NOT charged — this is a bandwidth model; the compute
+    ceiling at large ``w·vocab`` is the v5e A/B's to measure (rows
+    carry ``tree_nodes`` so that session can re-price)."""
+    draft_b, verify_b = spec_bytes_per_iter(cfg, batch, cache_len, k,
+                                            draft_layers,
+                                            vmem_resident, bytes_dtype)
+    if drafter_free:
+        draft_b = 0.0
+    if tree_branch <= 1:
+        return draft_b, verify_b
+    from icikit.models.transformer.speculative import tree_window_width
+    kv_heads = cfg.n_kv_heads or cfg.n_heads
+    wb = _weight_bytes_per_elt(bytes_dtype)
+    w_win = tree_window_width(k, tree_branch)
+    col = wb * 2 * kv_heads * cfg.d_head * cfg.n_layers
+    if bytes_dtype == "int8":
+        col += 4.0 * 2 * kv_heads * cfg.n_layers   # fp32 scale cols
+    extra_writes = batch * (w_win - k) * col       # beyond the chain's
+    reloc = batch * 2 * k * col                    # read + rewrite
+    logits = 4.0 * batch * (w_win - k) * cfg.vocab  # beyond chain's k
+    return draft_b, verify_b + extra_writes + reloc + logits
+
+
+def tree_expected_accept(alpha: float, p_side: float, k: int) -> float:
+    """Expected committed tokens per tree verify pass under the
+    per-position independence model: primary-chain matches follow a
+    depth-truncated geometric at per-position acceptance ``alpha``,
+    and a primary miss lands on a ranked sibling with probability
+    ``p_side`` (committing the sibling PLUS the model's choice after
+    it — ``_accept_tree``'s ``a = m_p + side + 1``):
+
+        E[a] = 1 + α(1-α^d)/(1-α) + p_side·(1-α^d),  d = k-1.
+
+    The estimator's two inputs come straight off measured per-branch
+    acceptance rows (``tree_accept_params``); its output is the
+    ``tokens_per_step`` the cost model prices when extrapolating to
+    an unmeasured depth. At ``p_side = 0`` this is the chain
+    expectation the r7 model used."""
+    d = k - 1
+    if d <= 0:
+        return 1.0
+    if alpha >= 1.0:
+        return float(d + 1)
+    miss = 1.0 - alpha ** d
+    em = alpha * miss / (1.0 - alpha)
+    return 1.0 + em + p_side * miss
+
+
+def tree_accept_params(row: dict) -> tuple[float, float]:
+    """Back out the estimator's (alpha, p_side) from one measured
+    tree acceptance row (``primary_accepted`` / ``sideways_accepted``
+    / ``row_steps`` / ``k``): alpha solves the truncated-geometric
+    mean E[m_p](α) = primary/row_steps by bisection, p_side is the
+    sideways count over the iterations that had a primary miss."""
+    k = int(row["k"])
+    d = k - 1
+    steps = max(1, int(row["row_steps"]))
+    m_bar = min(float(row["primary_accepted"]) / steps, d - 1e-9)
+
+    def em(a):
+        return (a * (1.0 - a ** d) / (1.0 - a) if a < 1.0 else
+                float(d))
+
+    lo, hi = 0.0, 1.0 - 1e-12
+    for _ in range(60):
+        mid = 0.5 * (lo + hi)
+        if em(mid) < m_bar:
+            lo = mid
+        else:
+            hi = mid
+    alpha = 0.5 * (lo + hi)
+    miss = 1.0 - alpha ** d
+    p_side = (float(row["sideways_accepted"]) / (steps * miss)
+              if miss > 1e-9 else 0.0)
+    return alpha, min(1.0, p_side)
+
+
 def _spec_iter_ms(cfg, batch: int, cache_len: float, k: int,
                   draft_layers: int, t_fix_ms: float,
-                  bw: float, bytes_dtype: str = "bf16") -> tuple:
+                  bw: float, bytes_dtype: str = "bf16",
+                  tree_branch: int = 1,
+                  drafter_free: bool = False) -> tuple:
     """One draft+verify iteration under the r7 pass-time model
     (t_pass = t_fix·(L'/L) + bytes/BW) — the single formula both
     ``spec_cost_model`` and ``spec_breakeven_rows`` price with (they
-    differ only in how they anchor ``t_fix``/the baseline)."""
-    draft_b, verify_b = spec_bytes_per_iter(cfg, batch, cache_len, k,
+    differ only in how they anchor ``t_fix``/the baseline).
+    ``tree_branch > 1`` swaps in the tree byte model (and
+    ``drafter_free`` zeroes the draft passes AND their fixed
+    scaffolding — a zero-cost drafter dispatches no programs)."""
+    draft_b, verify_b = tree_bytes_per_iter(cfg, batch, cache_len, k,
                                             draft_layers,
-                                            bytes_dtype=bytes_dtype)
-    frac = draft_layers / cfg.n_layers
+                                            tree_branch,
+                                            bytes_dtype=bytes_dtype,
+                                            drafter_free=drafter_free)
+    frac = 0.0 if drafter_free else draft_layers / cfg.n_layers
     t_iter_ms = ((k - 1) * t_fix_ms * frac + t_fix_ms
                  + (draft_b + verify_b) / bw * 1e3)
     return t_iter_ms, draft_b + verify_b
@@ -224,7 +331,9 @@ def spec_cost_model(cfg, batch: int, cache_len: float, k: int,
                     draft_layers: int, tokens_per_step: float,
                     floor_ms: float = SPEC_FLOOR_MS,
                     stream_gbps: float = SPEC_STREAM_GBPS,
-                    bytes_dtype: str = "bf16") -> dict:
+                    bytes_dtype: str = "bf16",
+                    tree_branch: int = 1,
+                    drafter_free: bool = False) -> dict:
     """Acceptance-rate × cost model: projected v5e effective ms/token
     at the MEASURED ``tokens_per_step`` (the device-independent
     quantity this harness measures wherever it runs).
@@ -250,9 +359,10 @@ def spec_cost_model(cfg, batch: int, cache_len: float, k: int,
     floor_dtype = t_fix_ms + base_bytes / bw * 1e3
     t_iter_ms, bytes_iter = _spec_iter_ms(cfg, batch, cache_len, k,
                                           draft_layers, t_fix_ms, bw,
-                                          bytes_dtype)
+                                          bytes_dtype, tree_branch,
+                                          drafter_free)
     eff = t_iter_ms / tokens_per_step
-    return {
+    out = {
         "model_stream_gbps": stream_gbps,
         "model_floor_ms": floor_ms,
         "bytes_dtype": bytes_dtype,
@@ -263,6 +373,12 @@ def spec_cost_model(cfg, batch: int, cache_len: float, k: int,
         "projected_eff_ms_per_token": round(eff, 4),
         "projected_vs_floor": round(eff / floor_dtype, 4),
     }
+    if tree_branch > 1:
+        out["tree_branch"] = tree_branch
+        out["tree_nodes"] = 1 + (k - 1) * tree_branch
+    if drafter_free:
+        out["drafter_free"] = True
+    return out
 
 
 def spec_breakeven_rows(preset: str = "base",
@@ -359,7 +475,8 @@ def load_measured_alpha(path: str, batch: int = 1) -> dict:
             if r.get("kind") != "acceptance" or r.get("batch") != batch:
                 continue
             key = (int(r["k"]), int(r["draft_layers"]),
-                   r.get("drafter", "shared"))
+                   r.get("drafter", "shared"),
+                   int(r.get("tree_branch", 1)))
             out[key] = r
     return out
 
@@ -383,7 +500,7 @@ def cost_model_rows(alpha_from: str, preset: str = "base",
         raise ValueError(f"no kind='acceptance' rows at batch="
                          f"{alpha_batch} in {alpha_from}")
     rows = []
-    for (k, ld, drafter), src in sorted(measured.items()):
+    for (k, ld, drafter, nb), src in sorted(measured.items()):
         a = float(src["acceptance_rate"])
         # the measurement model and the pricing preset differ in
         # depth; what transfers is the depth FRACTION (the r7 cost
@@ -391,10 +508,23 @@ def cost_model_rows(alpha_from: str, preset: str = "base",
         # n_layers prices the preset at the same fraction
         frac = ld / src["n_layers"] if src.get("n_layers") else 0.25
         ld_price = max(1, round(cfg.n_layers * frac))
-        tps = 1.0 + (k - 1) * a
+        # zero-model-cost drafters (ngram/suffix) dispatch no draft
+        # passes — their rows price draft bytes at zero, exactly what
+        # the machinery pays (tree rows record it either way)
+        free = drafter in ("ngram", "suffix")
+        tps_measured = nb > 1 and "tokens_per_step" in src
+        if tps_measured:
+            # tree rows: tokens_per_step is MEASURED (it includes the
+            # sideways commits the chain formula cannot express); the
+            # estimator's fit is carried beside it as the
+            # extrapolation cross-check
+            tps = float(src["tokens_per_step"])
+        else:
+            tps = 1.0 + (k - 1) * a
         m = spec_cost_model(cfg, batch, cache_len, k, ld_price,
                             tokens_per_step=tps,
-                            bytes_dtype=bytes_dtype)
+                            bytes_dtype=bytes_dtype,
+                            tree_branch=nb, drafter_free=free)
         iter_ms = m["model_iter_ms"]
         # the floor the route races is the single-token baseline AT
         # THE SAME byte width (int8 speculation vs int8 single-token)
@@ -403,7 +533,7 @@ def cost_model_rows(alpha_from: str, preset: str = "base",
               else None)
         be15 = ((iter_ms / (0.85 * floor) - 1) / (k - 1)
                 if k > 1 else None)
-        rows.append({
+        row = {
             "kind": "projection",
             "preset": preset, "batch": batch, "cache_len": cache_len,
             "k": k, "draft_layers": ld_price,
@@ -422,7 +552,27 @@ def cost_model_rows(alpha_from: str, preset: str = "base",
                                            else None),
             "clears_15pct": (a >= be15 if be15 is not None else None),
             **m,
-        })
+        }
+        if nb > 1:
+            # the 15% verdict for a tree row compares the projection
+            # itself (per-position α is not the deciding quantity
+            # once sideways commits enter): effective ms/token vs the
+            # re-priced single-token floor
+            row["clears_15pct"] = (m["projected_eff_ms_per_token"]
+                                   <= 0.85 * m["model_floor_ms_dtype"])
+            # a tree record without the measured field was priced on
+            # the chain formula (no sideways term) — never present
+            # that derived value as a measurement
+            key = ("measured_tokens_per_step" if tps_measured
+                   else "derived_tokens_per_step")
+            row[key] = round(tps, 4)
+            if "primary_accepted" in src:
+                al, ps = tree_accept_params(src)
+                row["est_alpha_primary"] = round(al, 4)
+                row["est_p_side"] = round(ps, 4)
+                row["est_tokens_per_step"] = round(
+                    tree_expected_accept(al, ps, k), 4)
+        rows.append(row)
     return rows
 
 
@@ -432,7 +582,8 @@ def run_bench(preset: str, dp: int, tp: int, batch: int, prompt_len: int,
               draft_layers: int = 0,
               decode_step: str = "unfused",
               drafter: str = "shared",
-              decode_quant: str = "none") -> dict:
+              decode_quant: str = "none",
+              tree_branch: int = 1) -> dict:
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -447,12 +598,16 @@ def run_bench(preset: str, dp: int, tp: int, batch: int, prompt_len: int,
     from icikit.models.transformer.model import make_model_mesh
     from icikit.utils.timing import fence
 
+    from icikit.models.transformer.speculative import tree_window_width
     over = dict(PRESETS[preset])
+    w_win = (tree_window_width(speculate, tree_branch) if speculate
+             else 1)
     over["max_seq"] = max(over["max_seq"],
-                          prompt_len + n_new + 2 * max(0, speculate - 1))
-    if drafter not in ("shared", "trained"):
+                          prompt_len + n_new
+                          + max(0, speculate - 2) + w_win)
+    if drafter not in ("shared", "trained", "ngram"):
         raise ValueError(f"unknown drafter {drafter!r} "
-                         "(known: shared, trained)")
+                         "(known: shared, trained, ngram)")
     # trained-drafter rows carry the draft branch (random-init here —
     # this harness measures the wall-time machinery; the study tool
     # measures acceptance with an actually-trained head)
@@ -493,7 +648,8 @@ def run_bench(preset: str, dp: int, tp: int, batch: int, prompt_len: int,
             return speculative_generate(params, prompt, mesh, cfg, n,
                                         k=speculate,
                                         draft_layers=d_layers,
-                                        drafter=drafter)
+                                        drafter=drafter,
+                                        tree_branch=tree_branch)
         if sampling == "greedy":
             return greedy_generate(params, prompt, mesh, cfg, n)
         return sample_generate(params, prompt, mesh, cfg, n,
@@ -540,10 +696,14 @@ def run_bench(preset: str, dp: int, tp: int, batch: int, prompt_len: int,
         # byte model — a fully-accepted k-window reads (draft + verify)
         # bytes for k tokens, so its per-token minimum is iter_bytes/k;
         # clamping spec rows against the single-token floor would
-        # discard a genuinely winning row as "implausibly fast"
-        d_b, v_b = spec_bytes_per_iter(cfg, batch, prompt_len + n_new,
+        # discard a genuinely winning row as "implausibly fast". Tree
+        # windows price through the tree byte model (which degenerates
+        # to the chain at b=1); the ngram drafter moves no model bytes
+        d_b, v_b = tree_bytes_per_iter(cfg, batch, prompt_len + n_new,
                                        speculate, d_layers,
-                                       bytes_dtype=bytes_dtype)
+                                       tree_branch,
+                                       bytes_dtype=bytes_dtype,
+                                       drafter_free=drafter == "ngram")
         bytes_per_token_floor = (d_b + v_b) / speculate
     else:
         bytes_per_token_floor = decode_bytes_per_token(
@@ -563,6 +723,8 @@ def run_bench(preset: str, dp: int, tp: int, batch: int, prompt_len: int,
         kv_tag += "_q8"
     if speculate and drafter != "shared":
         spec_tag += f"_{drafter}"
+    if speculate and tree_branch > 1:
+        spec_tag += f"_tree{tree_branch}"
     step_tag = ("" if decode_step == "unfused" else f"_{decode_step}")
     rec_extra = {}
     if speculate:
@@ -571,7 +733,8 @@ def run_bench(preset: str, dp: int, tp: int, batch: int, prompt_len: int,
         # acceptance × cost model (DECODE.md "Multi-token decode")
         _, st = speculative_generate(params, p0, mesh, cfg, n_new,
                                      k=speculate, draft_layers=d_layers,
-                                     drafter=drafter, return_stats=True)
+                                     drafter=drafter, return_stats=True,
+                                     tree_branch=tree_branch)
         # achieved read bandwidth under the SPECULATIVE byte model at
         # the measured acceptance (iter bytes buy tokens_per_step
         # tokens); the single-token model would overstate it
@@ -580,13 +743,20 @@ def run_bench(preset: str, dp: int, tp: int, batch: int, prompt_len: int,
             "speculate": speculate,
             "draft_layers": d_layers,
             "drafter": drafter,
+            "tree_branch": tree_branch,
             "acceptance_rate": round(st["acceptance_rate"], 4),
             "tokens_per_step": round(st["tokens_per_step"], 4),
             "verify_steps": st["verify_steps"],
             **spec_cost_model(cfg, batch, prompt_len + n_new, speculate,
                               d_layers, st["tokens_per_step"],
-                              bytes_dtype=bytes_dtype),
+                              bytes_dtype=bytes_dtype,
+                              tree_branch=tree_branch,
+                              drafter_free=drafter == "ngram"),
         }
+        if tree_branch > 1:
+            rec_extra["primary_accepted"] = st["primary_accepted"]
+            rec_extra["sideways_accepted"] = st["sideways_accepted"]
+            rec_extra["sideways_rate"] = round(st["sideways_rate"], 4)
     return {
         "metric": f"decode_{preset}_dp{dp}tp{tp}_b{batch}{kv_tag}"
                   f"_p{prompt_len}_n{n_new}_{sampling}"
@@ -700,13 +870,26 @@ def main(argv=None) -> int:
                     help="truncated-depth drafter (default: "
                          "n_layers // 2)")
     ap.add_argument("--drafter", default="shared",
-                    choices=["shared", "trained"],
+                    choices=["shared", "trained", "ngram"],
                     help="speculative drafter: 'shared' = the free "
                          "truncated-depth/shared-head readout (r7), "
                          "'trained' = the trained early-exit draft "
                          "head (random-init here — wall-time "
                          "machinery rows; acceptance comes from the "
-                         "study tools)")
+                         "study tools), 'ngram' = the zero-model-"
+                         "cost in-jit suffix matcher (r9)")
+    ap.add_argument("--tree-branch", default="1", metavar="B1,B2,...",
+                    help="token-tree speculation (round 14): ranked "
+                         "branches per draft position; 1 = chain "
+                         "verify windows (the pre-tree path, "
+                         "bitwise), B >= 2 = caterpillar tree "
+                         "windows of 1 + (K-1)*B nodes. A comma "
+                         "list emits one row per branch count (the "
+                         "tree sweep axis)")
+    ap.add_argument("--tree-depth", default=None, metavar="K1,K2,...",
+                    help="sweep axis over verify-window depth for "
+                         "tree rows: overrides --speculate with one "
+                         "row per K (crossed with --tree-branch)")
     ap.add_argument("--breakeven", action="store_true",
                     help="no hardware run: emit kind='breakeven' "
                          "batch-aware break-even acceptance rows "
@@ -782,14 +965,22 @@ def main(argv=None) -> int:
                          args.draft_layers, args.decode_step,
                          args.drafter, args.decode_quant)
     else:
+        branches = [int(b) for b in args.tree_branch.split(",")]
+        depths = ([int(k) for k in args.tree_depth.split(",")]
+                  if args.tree_depth else [args.speculate])
+        if (branches != [1] or args.tree_depth) and not any(depths):
+            ap.error("--tree-branch/--tree-depth need a verify "
+                     "window (--speculate K or --tree-depth)")
         recs = [run_bench(args.preset, args.dp, args.tp, args.batch,
                           args.prompt, args.n_new, args.sampling,
                           args.runs, args.kv_heads,
-                          speculate=args.speculate,
+                          speculate=kd,
                           draft_layers=args.draft_layers,
                           decode_step=args.decode_step,
                           drafter=args.drafter,
-                          decode_quant=args.decode_quant)]
+                          decode_quant=args.decode_quant,
+                          tree_branch=nb)
+                for kd in depths for nb in branches]
     obs.emit_records(recs)
     if args.json_path:
         # append: record files accumulate across invocations (the

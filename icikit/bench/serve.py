@@ -371,7 +371,8 @@ def run_static(params, mesh, cfg, rows: int, workload,
 def run_bench(preset: str, rows: int, n_requests: int, rate_rps: float,
               prompt_len: int, new_min: int, new_max: int,
               block_size: int = 8, n_blocks: int = 0,
-              speculate: int = 1, ngram_n: int = 3,
+              speculate: int = 1, tree_branch: int = 1,
+              ngram_n: int = 3,
               integrity: str = "none", dp: int = 1, tp: int = 1,
               seed: int = 0, mode: str = "both",
               compute_dtype: str = "",
@@ -398,7 +399,9 @@ def run_bench(preset: str, rows: int, n_requests: int, rate_rps: float,
     from icikit.models.transformer.model import make_model_mesh
     from icikit.serve import ServeConfig
 
-    horizon = prompt_len + new_max + max(0, speculate - 1)
+    from icikit.models.transformer.speculative import tree_window_width
+    w_win = tree_window_width(speculate, tree_branch)
+    horizon = prompt_len + new_max + max(0, w_win - 1)
     if model is not None:
         params, mesh, cfg = model
         if cfg.max_seq < horizon:
@@ -439,6 +442,7 @@ def run_bench(preset: str, rows: int, n_requests: int, rate_rps: float,
     serve_cfg = ServeConfig(max_rows=rows, block_size=block_size,
                             n_blocks=n_blocks, max_prompt=prompt_len,
                             max_new=new_max, speculate_k=speculate,
+                            tree_branch=tree_branch,
                             ngram_n=ngram_n, integrity=integrity,
                             prefix_cache=prefix_cache,
                             prefill_chunk=prefill_chunk,
@@ -463,6 +467,7 @@ def run_bench(preset: str, rows: int, n_requests: int, rate_rps: float,
         "new_min": new_min, "new_max": new_max,
         "block_size": block_size, "n_blocks": n_blocks,
         "speculate": speculate,
+        "tree_branch": tree_branch,
         "integrity": integrity,
         "decode_quant": decode_quant,
         "compute_dtype": cfg.compute_dtype,
@@ -569,6 +574,12 @@ def main(argv=None) -> int:
     ap.add_argument("--speculate", type=int, default=1, metavar="K",
                     help="k-token ngram-drafted verify windows "
                          "(1 = single-token decode)")
+    ap.add_argument("--tree-branch", type=int, default=1, metavar="B",
+                    help="ranked branches per draft position "
+                         "(round 14): 1 = chain verify windows "
+                         "(bitwise the pre-tree program), B >= 2 = "
+                         "caterpillar token-tree windows of "
+                         "1 + (K-1)*B nodes per step")
     ap.add_argument("--ngram-n", type=int, default=3)
     ap.add_argument("--decode-quant", default="none",
                     choices=["none", "int8"],
@@ -596,6 +607,7 @@ def main(argv=None) -> int:
     recs = run_bench(args.preset, args.rows, args.requests, args.rate,
                      args.prompt, args.new_min, args.new_max,
                      args.block_size, args.blocks, args.speculate,
+                     args.tree_branch,
                      args.ngram_n, args.integrity, args.dp, args.tp,
                      args.seed, args.mode, args.compute_dtype,
                      args.decode_quant, args.prefix,

@@ -195,6 +195,21 @@ class TransformerConfig:
     # draft_kl*KL(teacher || draft), teacher = the same forward's
     # full-model logits under stop_gradient.
     draft_kl: float = 0.5
+    # ON-POLICY self-distillation (round 14): the r8 study diagnosed
+    # the acceptance gap as pure distribution shift — the head agreed
+    # 0.63 with the teacher on CORPUS tokens but only 0.377 on the
+    # model's own continuations, which are the only place a drafter
+    # ever runs. When armed, the train step takes an extra
+    # ``draft_tokens`` batch (the model's own sampled/greedy
+    # continuations, refreshed by the trainer's --draft-sample hook)
+    # and the distill loss moves to it: a SECOND stop-gradient'd
+    # trunk forward over the continuation batch feeds x_mid and the
+    # teacher, masked to the continuation region. Trunk gradients
+    # stay bitwise the draft-off gradients (every path from the
+    # distill term into trunk leaves is stop-gradient'd, exactly as
+    # off-policy — pinned in tests/test_draft_head.py); the honest
+    # extra cost is that forward, paid only while the head trains.
+    draft_on_policy: bool = False
     # Quantized decode (r10): "int8" stores every decode-path matmul
     # weight AND the KV cache as per-channel symmetric int8 (fp32
     # accumulation, scales riding the pytree / the cache carry), which
@@ -286,6 +301,11 @@ def _check_cfg(cfg: TransformerConfig) -> None:
                 "the exit layer; save_stack='pallas' routes the whole "
                 "stack through one remat_scan_stacked and cannot "
                 "surface the L_d residual (use save_stack='xla')")
+    if cfg.draft_on_policy and not cfg.draft_head:
+        raise ValueError(
+            "draft_on_policy=True without draft_head: on-policy "
+            "distillation trains the draft head on the model's own "
+            "continuations — there is no head to train")
 
 
 def _is_gqa(cfg: TransformerConfig) -> bool:
@@ -716,7 +736,7 @@ def _vp_argmax(lg):
 
 
 def _draft_distill(params, x_mid, teacher_logits, targets, cfg,
-                   denom):
+                   denom, weight=None):
     """Self-distillation terms for the draft head, per shard: returns
     (draft_loss, top1_agree) as local sums/``denom`` (the caller
     psums over dp×sp, and over tp under ``vocab_parallel``).
@@ -727,7 +747,13 @@ def _draft_distill(params, x_mid, teacher_logits, targets, cfg,
     wholesale — the main loss's trunk gradients are bitwise unchanged
     by arming the head (pinned by tests/test_draft_head.py).
     ``teacher_logits`` are the shard's fp32 logits — vocab-sharded
-    under ``vocab_parallel``, full-width otherwise."""
+    under ``vocab_parallel``, full-width otherwise.
+
+    ``weight`` (optional, ``(b, s)`` 0/1 fp32) masks positions out of
+    the distill sums — the on-policy path uses it to train on the
+    CONTINUATION region only (the prompt region is corpus-like, the
+    very distribution the on-policy batch exists to leave). ``None``
+    is the historical unweighted computation, bitwise."""
     from icikit.models.transformer.draft import draft_local_logits
     cdt = jnp.dtype(cfg.compute_dtype)
     sl = draft_local_logits(params, lax.stop_gradient(x_mid), cfg, cdt)
@@ -747,8 +773,13 @@ def _draft_distill(params, x_mid, teacher_logits, targets, cfg,
         kl = (jnp.exp(t_logp) * (t_logp - s_logp)).sum(-1)
         agree = (jnp.argmax(tl, axis=-1) == jnp.argmax(sl, axis=-1))
     mix = cfg.draft_kl
-    dloss = ((1.0 - mix) * ce + mix * kl).sum() / denom
-    top1 = agree.sum().astype(jnp.float32) / denom
+    per = (1.0 - mix) * ce + mix * kl
+    agree_f = agree.astype(jnp.float32)
+    if weight is not None:
+        per = per * weight
+        agree_f = agree_f * weight
+    dloss = per.sum() / denom
+    top1 = agree_f.sum() / denom
     return dloss, top1
 
 
@@ -760,21 +791,35 @@ def _use_fused_head(cfg, b: int, s: int) -> bool:
                           jnp.dtype(cfg.compute_dtype))
 
 
-def _local_loss(params, tokens, targets, cfg, p_sp, p_dp, p_tp, denom):
+def _local_loss(params, tokens, targets, cfg, p_sp, p_dp, p_tp, denom,
+                draft_tokens=None, draft_p0: int = 0,
+                draft_denom: int = 1):
     """Per-shard loss, plus a (possibly empty) dict of auxiliary
     metrics — the draft head's distill loss and top-1 agreement when
     ``cfg.draft_head`` (the value_and_grad caller rides them out as
-    ``has_aux``)."""
+    ``has_aux``).
+
+    With ``cfg.draft_on_policy`` and a ``draft_tokens`` batch (the
+    model's own continuations: ``draft_p0`` prompt tokens followed by
+    generated ones), the distill term moves OFF the corpus batch and
+    onto a second forward over the continuation batch, masked to the
+    continuation region (``draft_denom`` is its global counted-token
+    denominator). The main forward then skips the exit-layer scan
+    split entirely — trunk loss and gradients are the draft-off
+    computation (the split is pinned bitwise-neutral anyway, but not
+    paying it is free)."""
     b, s = tokens.shape
     draft_exit = None
     if cfg.draft_head:
         from icikit.models.transformer.draft import draft_exit_layer
         draft_exit = draft_exit_layer(cfg)
+    on_policy = cfg.draft_on_policy and draft_tokens is not None
+    main_exit = None if on_policy else draft_exit
     x_mid = teacher = None
     if _use_fused_head(cfg, b, s):
         from icikit.ops.xent import fused_xent
         fwd = _forward_local(params, tokens, cfg, p_sp, p_dp,
-                             head="hidden", draft_exit=draft_exit)
+                             head="hidden", draft_exit=main_exit)
         h, aux = fwd[0], fwd[1]
         cdt = h.dtype
         # explicit replication-lift: the custom-vjp kernel returns a
@@ -788,7 +833,7 @@ def _local_loss(params, tokens, targets, cfg, p_sp, p_dp, p_tp, denom):
                          targets.reshape(b * s),
                          save_exp=cfg.xent_save_exp,
                          fused_bwd=cfg.xent_fused_bwd).reshape(b, s)
-        if draft_exit is not None:
+        if main_exit is not None:
             x_mid = fwd[2]
             # the fused head never materializes logits — the distill
             # teacher re-derives them from the final hidden state
@@ -800,9 +845,9 @@ def _local_loss(params, tokens, targets, cfg, p_sp, p_dp, p_tp, denom):
                 .astype(jnp.float32))
     else:
         fwd = _forward_local(params, tokens, cfg, p_sp, p_dp,
-                             draft_exit=draft_exit)
+                             draft_exit=main_exit)
         logits, aux = fwd[0], fwd[1]
-        if draft_exit is not None:
+        if main_exit is not None:
             x_mid, teacher = fwd[2], logits
         if cfg.vocab_parallel:
             nll = _vocab_parallel_nll(logits, targets)
@@ -820,7 +865,31 @@ def _local_loss(params, tokens, targets, cfg, p_sp, p_dp, p_tp, denom):
         # explicit for shard_map's check (exact for power-of-2 tp).
         loss = lax.psum(loss, TP_AXIS) / p_tp
     metrics = {}
-    if draft_exit is not None:
+    if on_policy:
+        # the on-policy distill forward: the model's own continuation
+        # batch through the exit-split scan — everything the distill
+        # term reads from it is stop-gradient'd in _draft_distill, so
+        # trunk gradients stay bitwise the draft-off gradients (the
+        # same construction as the fused-corpus path, pinned)
+        dt_in = draft_tokens[:, :-1]
+        dt_tg = draft_tokens[:, 1:]
+        fwd2 = _forward_local(params, dt_in, cfg, p_sp, p_dp,
+                              draft_exit=draft_exit)
+        x_mid2, teacher2 = fwd2[2], fwd2[0]
+        # continuation-only mask: position j predicts token j+1, so
+        # the first continuation token is predicted at j = p0 - 1
+        wt = (jnp.arange(dt_in.shape[1]) >= draft_p0 - 1
+              ).astype(jnp.float32)[None, :]
+        dloss, top1 = _draft_distill(params, x_mid2, teacher2, dt_tg,
+                                     cfg, draft_denom,
+                                     weight=jnp.broadcast_to(
+                                         wt, dt_tg.shape))
+        if cfg.vocab_parallel:
+            dloss = lax.psum(dloss, TP_AXIS) / p_tp
+            top1 = lax.psum(top1, TP_AXIS) / p_tp
+        loss = loss + dloss
+        metrics = {"draft_loss": dloss, "draft_top1_agree": top1}
+    elif draft_exit is not None:
         dloss, top1 = _draft_distill(params, x_mid, teacher, targets,
                                      cfg, denom)
         if cfg.vocab_parallel:
@@ -832,7 +901,8 @@ def _local_loss(params, tokens, targets, cfg, p_sp, p_dp, p_tp, denom):
 
 
 @lru_cache(maxsize=None)
-def _build_loss_and_grad(mesh, cfg: TransformerConfig, batch_shape):
+def _build_loss_and_grad(mesh, cfg: TransformerConfig, batch_shape,
+                         draft_shape=None):
     _check_mesh_cfg(cfg, mesh)
     p_sp = mesh.shape[SP_AXIS]
     p_dp = mesh.shape[DP_AXIS]
@@ -842,6 +912,36 @@ def _build_loss_and_grad(mesh, cfg: TransformerConfig, batch_shape):
 
     metric_specs = ({"draft_loss": P(), "draft_top1_agree": P()}
                     if cfg.draft_head else {})
+
+    if draft_shape is not None:
+        # on-policy distill batch: (local rows, sequence, prompt len).
+        # Decode produced it, so sp = 1 held when it was sampled; the
+        # continuation mask indexes absolute positions, which a
+        # sequence-sharded forward would break.
+        if p_sp != 1:
+            raise ValueError("draft_on_policy needs sp=1 (the "
+                             "continuation batch comes out of decode, "
+                             "which is sp=1 by construction)")
+        db, ds2, dp0 = draft_shape
+        if not 1 <= dp0 < ds2:
+            raise ValueError(
+                f"draft prompt length {dp0} must be in [1, {ds2})")
+        draft_denom = db * (ds2 - dp0) * p_dp * p_sp
+
+        def per_shard_op(params, tokens, targets, draft_tokens):
+            (loss, metrics), grads = jax.value_and_grad(
+                _local_loss, has_aux=True)(
+                params, tokens, targets, cfg, p_sp, p_dp,
+                mesh.shape[TP_AXIS], denom, draft_tokens, dp0,
+                draft_denom)
+            metrics = {k: lax.psum(v, (DP_AXIS, SP_AXIS))
+                       for k, v in metrics.items()}
+            return lax.psum(loss, (DP_AXIS, SP_AXIS)), grads, metrics
+
+        return wrap_program(
+            per_shard_op, mesh,
+            in_specs=(specs, data_spec, data_spec, P(DP_AXIS, None)),
+            out_specs=(P(), specs, metric_specs))
 
     def per_shard(params, tokens, targets):
         (loss, metrics), grads = jax.value_and_grad(
@@ -874,13 +974,25 @@ def loss_fn(params, tokens, targets, mesh, cfg: TransformerConfig):
 
 
 def loss_and_metrics(params, tokens, targets, mesh,
-                     cfg: TransformerConfig):
+                     cfg: TransformerConfig, draft_tokens=None,
+                     draft_p0: int = 0):
     """``loss_fn`` plus the auxiliary metric dict — ``draft_loss`` /
     ``draft_top1_agree`` global scalars when ``cfg.draft_head``, empty
-    otherwise."""
+    otherwise. ``draft_tokens`` (with its static prompt length
+    ``draft_p0``) is the on-policy continuation batch under
+    ``cfg.draft_on_policy``: the distill term (and its metrics) then
+    measure the head on the model's OWN continuations — the
+    on-continuation agreement the r8 study diagnosed as the α that
+    actually matters at decode time."""
     local = (tokens.shape[0] // mesh.shape[DP_AXIS],
              tokens.shape[1] // mesh.shape[SP_AXIS])
-    return _build_loss_and_grad(mesh, cfg, local)(params, tokens, targets)
+    if draft_tokens is None:
+        return _build_loss_and_grad(mesh, cfg, local)(params, tokens,
+                                                      targets)
+    dlocal = (draft_tokens.shape[0] // mesh.shape[DP_AXIS],
+              draft_tokens.shape[1], int(draft_p0))
+    return _build_loss_and_grad(mesh, cfg, local, dlocal)(
+        params, tokens, targets, draft_tokens)
 
 
 class FusedAdam:
@@ -1054,7 +1166,8 @@ def _make_grad_sync_check(mesh, pspecs):
 
 
 def make_train_step(mesh, cfg: TransformerConfig, optimizer=None,
-                    guard: str = "none", grad_check: str = "none"):
+                    guard: str = "none", grad_check: str = "none",
+                    draft_p0: int = 0):
     """Jitted full training step: (params, opt_state, tokens, targets)
     -> (params, opt_state, loss). ``optimizer`` is any optax
     GradientTransformation (default: adam(3e-4)), or a ``FusedAdam``
@@ -1081,7 +1194,16 @@ def make_train_step(mesh, cfg: TransformerConfig, optimizer=None,
     via a checksummed digest ring over dp — a flip in the digest
     exchange or replica-diverged sync output skips the commit exactly
     like a non-finite step (precise detection scope and its limits:
-    ``_make_grad_sync_check``)."""
+    ``_make_grad_sync_check``).
+
+    With ``cfg.draft_on_policy`` the step additionally accepts a
+    trailing ``draft_tokens`` batch (the model's own continuations,
+    ``draft_p0`` prompt tokens wide at the front — ``draft_p0`` is a
+    BUILD-TIME static, it shapes the continuation mask) and the
+    draft head distills on it instead of the corpus batch; passing
+    ``draft_tokens=None`` on an armed config falls back to corpus
+    distillation for that step (the warm-up steps before the first
+    refresh)."""
     import optax
     if guard not in ("none", "device"):
         raise ValueError(f"unknown guard {guard!r} "
@@ -1140,9 +1262,10 @@ def make_train_step(mesh, cfg: TransformerConfig, optimizer=None,
 
         @jax.jit
         def fused_step(params, opt_state, tokens, targets,
-                       sync_taint=None):
+                       sync_taint=None, draft_tokens=None):
             loss, grads, metrics = loss_and_metrics(
-                narrow(params), tokens, targets, mesh, cfg)
+                narrow(params), tokens, targets, mesh, cfg,
+                draft_tokens, draft_p0)
             m, v, t = opt_state
             t = t + 1
             lr = opt.lr(t) if callable(opt.lr) else opt.lr
@@ -1178,9 +1301,11 @@ def make_train_step(mesh, cfg: TransformerConfig, optimizer=None,
         return optimizer, fused_step
 
     @jax.jit
-    def step(params, opt_state, tokens, targets, sync_taint=None):
+    def step(params, opt_state, tokens, targets, sync_taint=None,
+             draft_tokens=None):
         loss, grads, metrics = loss_and_metrics(
-            narrow(params), tokens, targets, mesh, cfg)
+            narrow(params), tokens, targets, mesh, cfg,
+            draft_tokens, draft_p0)
         # moments accumulate from fp32 inputs: adam squares its
         # gradient input, and a bf16 g**2 carries ~2^-8 relative error
         # into nu every step — the HBM saving lives in the stacked

@@ -72,9 +72,14 @@ from jax.sharding import PartitionSpec as P
 from icikit import chaos, obs
 
 # site registry (chaos satellite): speculative drill sites; drafters
-# are a dynamic family ("trained"/"shared"/"ngram"/...)
+# are a dynamic family ("trained"/"shared"/"ngram"/...). The r14
+# token-tree path adds its own host boundaries: tree.build (ranked
+# proposal construction / program dispatch) and tree.verify (the
+# stats readback of a tree window — counters only, never tokens).
 chaos.register_site("decode.spec.prefill", "decode.spec.drafter.*",
-                    "decode.spec.verify.stats")
+                    "decode.spec.verify.stats",
+                    "decode.spec.tree.build",
+                    "decode.spec.tree.verify")
 
 from icikit.models.transformer.decode import (  # noqa: E402
     _check_sampling_args,
@@ -96,9 +101,15 @@ from icikit.ops.quant import quantize_last
 from icikit.ops.rope import apply_rope, rope_sincos
 from icikit.parallel.shmap import wrap_program
 
-# stats vector layout (int32): one device read per generation
-_N_STATS = 3
-_S_ITERS, _S_ROW_STEPS, _S_ACCEPTED = range(_N_STATS)
+# stats vector layout (int32): one device read per generation.
+# PRIMARY counts chain-rule matches only; SIDEWAYS counts iterations
+# that ended by hopping onto a ranked sibling (tree windows; always 0
+# on the chain path, where ACCEPTED == PRIMARY) — the per-branch
+# split the tree cost model's expected-accepted-length estimator
+# consumes (bench.decode.tree_expected_accept).
+_N_STATS = 5
+(_S_ITERS, _S_ROW_STEPS, _S_ACCEPTED, _S_PRIMARY,
+ _S_SIDEWAYS) = range(_N_STATS)
 
 
 def _row_update(cache, upd, starts):
@@ -130,24 +141,186 @@ def _accept_window(w_toks, g, active):
     return m, a, new_tok
 
 
+@lru_cache(maxsize=None)
+def _tree_template(k: int, nb: int):
+    """Static caterpillar-tree template for a (depth ``k-1``, branch
+    ``nb``) verify window, the SpecInfer/EAGLE-style fixed tree shape
+    skewed to the top-ranked chain: the root (pending token) extends
+    into a primary rank-0 chain of ``k-1`` positions, and every
+    primary position additionally carries ``nb - 1`` ranked sibling
+    LEAVES — alternatives the drafter offers at that depth. Only the
+    primary branch extends (a full b-ary tree is b^d nodes; the
+    caterpillar is ``1 + (k-1)·nb`` and captures the dominant
+    failure mode: a near-miss at one position that would otherwise
+    end the window).
+
+    Linearization: node 0 = root; the depth-``i`` (1-based) rank-``r``
+    node sits at index ``1 + (i-1)·nb + r``. ``nb = 1`` is exactly the
+    chain window (indices == depths).
+
+    Returns ``(w, dep, anc, prim_idx)``: window width, per-node depth
+    (w,), the ancestor-or-self visibility matrix (w, w) — the
+    tree-attention mask's static part — and the primary-chain node
+    indices (k,). All numpy: the jitted bodies close over them as
+    constants."""
+    d = k - 1
+    w = 1 + d * nb
+    dep = np.zeros((w,), np.int32)
+    anc = np.zeros((w, w), bool)
+    anc[:, 0] = True              # the root is everyone's ancestor
+    np.fill_diagonal(anc, True)   # every node sees its own column
+    for i in range(d):
+        for r in range(nb):
+            j = 1 + i * nb + r
+            dep[j] = i + 1
+            for i2 in range(i):   # primary ancestors only extend
+                anc[j, 1 + i2 * nb] = True
+    prim_idx = np.concatenate([[0], 1 + np.arange(d) * nb]
+                              ).astype(np.int32)
+    return w, dep, anc, prim_idx
+
+
+def tree_window_width(k: int, tree_branch: int) -> int:
+    """Verify-window width in cache columns: ``k`` for the chain,
+    ``1 + (k-1)·b`` linearized caterpillar nodes for a branch-``b``
+    tree (``tree_branch == 1`` IS the chain). The ONE width formula —
+    the engine's horizon sizing and the bench byte models import it
+    rather than repeating it."""
+    return 1 + (k - 1) * tree_branch if tree_branch > 1 else k
+
+
+def _tree_mask(anc, curs, T: int, w: int):
+    """The tree-attention mask over a ``T``-column cache view, per
+    row: committed prefix (columns < ``cur``) plus the static
+    ancestor-or-self matrix ``anc`` over the window's own ``w``
+    scratch columns (``cur .. cur+w-1``). Shared by the in-jit
+    speculative loop and the serving engine's paged step — the
+    engine-vs-generate bitwise identity at ``tree_branch > 1`` hangs
+    on the two sides building the identical mask."""
+    rel = jnp.arange(T)[None, :] - curs[:, None]          # (b, T)
+    relc = jnp.clip(rel, 0, w - 1)
+    tree_bit = jnp.moveaxis(anc[:, relc], 1, 0)           # (b, w, T)
+    return ((rel < 0)[:, None, :]
+            | (((rel >= 0) & (rel < w))[:, None, :] & tree_bit))
+
+
+def _accept_tree(w_toks, alts, g, g_alt, active):
+    """Tree accept — the chain rule plus one sideways hop. The primary
+    chain runs through :func:`_accept_window` VERBATIM (the ONE accept
+    rule; ``nb = 1`` degenerates to it exactly, which is what makes
+    the b=1 tree path bitwise the chain path), then at the first
+    primary miss the model's keyed choice at the failing depth is
+    compared against the ``nb - 1`` ranked sibling proposals: a hit
+    commits that sibling PLUS the model's choice after it (the
+    sibling is a verified tree node — its successor logits came out
+    of the same batched pass), and the walk stops there (caterpillar
+    template: siblings are leaves).
+
+    Exactness is inherited, not re-argued: every committed token is
+    the model's keyed draw (or argmax) at its own position,
+    conditioned on the committed prefix — the sideways hop merely
+    finds that draw on a different pre-verified node, so sampled
+    output stays bitwise the sequential sample and temp→0 stays
+    bitwise greedy.
+
+    Args: ``w_toks (b, k)`` primary-chain window tokens; ``alts
+    (b, k-1, nb)`` ranked proposals (``alts[:, :, 0]`` IS the primary
+    chain); ``g (b, k)`` the model's choice at root + each primary
+    node; ``g_alt (b, k-1, nb)`` the model's choice at every
+    (depth, rank) node.
+
+    Returns ``(m, m_p, side, a, new_tok, commit, src)``: total
+    matches, primary-only matches, the sideways flag, committed count
+    (zeroed inactive), the new pending token, the k-wide commit
+    vector, and the per-row *window-relative* source columns of the
+    accepted root-to-leaf path (what the cache relocation consumes).
+    """
+    b_rows, k = w_toks.shape
+    nb = alts.shape[2]
+    d = k - 1
+    m_p, _, c = _accept_window(w_toks, g, active)
+    dep = jnp.minimum(m_p, d - 1)          # failing depth (clipped)
+    cand = jnp.take_along_axis(alts, dep[:, None, None],
+                               axis=1)[:, 0]            # (b, nb)
+    galt = jnp.take_along_axis(g_alt, dep[:, None, None],
+                               axis=1)[:, 0]            # (b, nb)
+    # rank 0 is the primary itself: at the failing depth it cannot
+    # equal c by definition of the longest prefix, so the any/argmax
+    # below can never select it — no explicit exclusion needed
+    sibm = (cand == c[:, None]) & (m_p < d)[:, None]
+    side = sibm.any(axis=1)
+    r_star = jnp.argmax(sibm, axis=1)      # first matching rank
+    g_sib = jnp.take_along_axis(galt, r_star[:, None], axis=1)[:, 0]
+    m = m_p + side.astype(jnp.int32)
+    a = jnp.where(active, m + 1, 0)
+    new_tok = jnp.where(side, g_sib, c)
+    at_sib = (side[:, None]
+              & (jnp.arange(k)[None, :] == (m_p + 1)[:, None]))
+    commit = jnp.where(at_sib, g_sib[:, None], g)
+    prim_cols = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32),
+         1 + jnp.arange(d, dtype=jnp.int32) * nb])
+    src = jnp.broadcast_to(prim_cols[None, :], (b_rows, k))
+    src = jnp.where(at_sib, (1 + dep * nb + r_star)[:, None], src)
+    return m, m_p, side, a, new_tok, commit, src
+
+
+def _tree_relocate(kc, vc, kss, vss, cur, src, quant: bool):
+    """Move the accepted root-to-leaf path's K/V (and scales, under
+    int8 decode) from their linearized tree-scratch columns into the
+    position-aligned columns ``cur..cur+k-1`` the next iteration's
+    committed-prefix reads expect. Columns past the accepted frontier
+    hold relocation garbage — they sit beyond every future causal
+    mask until the next window overwrites them (same discipline as
+    the chain path's rejected tail)."""
+    idx = cur[:, None] + src            # (b, k) absolute source cols
+
+    def move(c):
+        ix = idx.reshape(idx.shape + (1,) * (c.ndim - 2))
+        taken = jnp.take_along_axis(c, ix, axis=1)
+        return _row_update(c, taken, cur)
+
+    kc = tuple(move(c) for c in kc)
+    vc = tuple(move(c) for c in vc)
+    if quant:
+        kss = tuple(move(c) for c in kss)
+        vss = tuple(move(c) for c in vss)
+    return kc, vc, kss, vss
+
+
 def _window_pass(ctx: _DecodeCtx, params, lp, kc, vc, kss, vss, toks,
-                 cur, layers, cache_len: int):
+                 cur, layers, cache_len: int, dep=None, anc=None):
     """Run window ``toks (b, w)`` at per-row positions ``cur..cur+w-1``
     through ``layers`` (a range — the drafter passes the truncated
     prefix, verify the full stack), writing w cache columns per layer.
     Returns (hidden (b, w, D) fp32-stream, kc', vc', kss', vss').
     Under int8 decode the caches are quantized (``kss``/``vss`` carry
     the per-(position, head) scales, written through the same per-row
-    window update); otherwise the scale tuples pass through empty."""
+    window update); otherwise the scale tuples pass through empty.
+
+    ``dep``/``anc`` arm the TREE form (both or neither): node ``j``'s
+    logical position is ``cur + dep[j]`` (several nodes share a
+    position — its K/V still lands at scratch column ``cur + j``),
+    and the causal mask becomes committed-prefix (< cur) plus the
+    static ancestor-or-self matrix ``anc`` over the window's own
+    columns — the tree-attention mask. ``dep=None`` is the chain
+    form, bitwise the pre-tree computation (positions == columns,
+    ancestor = every earlier window column)."""
     cfg = ctx.cfg
     b, w = toks.shape
-    pos = cur[:, None] + jnp.arange(w)[None, :]          # (b, w)
+    if dep is None:
+        pos = cur[:, None] + jnp.arange(w)[None, :]      # (b, w)
+        # per-row causal frontier: window query i sees cache column t
+        # iff t <= cur_row + i — committed prefix plus the window's
+        # own prefix
+        mask = (jnp.arange(cache_len)[None, None, :]
+                <= pos[:, :, None])
+    else:
+        pos = cur[:, None] + dep[None, :]                # (b, w)
+        mask = _tree_mask(anc, cur, cache_len, w)
     x = ctx.embed(params, toks, pos)
     sincos = (rope_sincos(pos, cfg.d_head, cfg.rope_theta)
               if cfg.pos_encoding == "rope" else None)
-    # per-row causal frontier: window query i sees cache column t iff
-    # t <= cur_row + i — committed prefix plus the window's own prefix
-    mask = (jnp.arange(cache_len)[None, None, :] <= pos[:, :, None])
     kc2, vc2 = list(kc), list(vc)
     kss2, vss2 = list(kss), list(vss)
     for li in layers:
@@ -181,11 +354,20 @@ def _window_pass(ctx: _DecodeCtx, params, lp, kc, vc, kss, vss, toks,
 def _build_speculative(mesh, cfg: TransformerConfig, s_prompt: int,
                        n_new: int, k: int, draft_layers: int,
                        drafter: str = "shared", ngram_n: int = 3,
-                       sampling: tuple = ("greedy",)):
+                       sampling: tuple = ("greedy",),
+                       tree_branch: int = 1):
     if n_new < 1:
         raise ValueError(f"n_new must be >= 1, got {n_new}")
     if k < 1:
         raise ValueError(f"k must be >= 1, got {k}")
+    if tree_branch < 1:
+        raise ValueError(f"tree_branch must be >= 1, got {tree_branch}")
+    if tree_branch > 1 and k < 2:
+        raise ValueError("tree_branch > 1 needs a draft window "
+                         f"(k >= 2), got k={k}")
+    if tree_branch > cfg.vocab:
+        raise ValueError(f"tree_branch={tree_branch} exceeds "
+                         f"vocab={cfg.vocab}")
     if not 1 <= draft_layers <= cfg.n_layers:
         raise ValueError(f"draft_layers={draft_layers} must be in "
                          f"[1, n_layers={cfg.n_layers}]")
@@ -197,26 +379,38 @@ def _build_speculative(mesh, cfg: TransformerConfig, s_prompt: int,
             "speculative decode does not support MoE (n_experts > 0): "
             "expert dispatch is a dp all-to-all inside the layer and "
             "the accept loop's trip count diverges across dp shards")
+    # Window width: k columns for the chain, 1 + (k-1)·b linearized
+    # tree nodes for a branch-b caterpillar (tree_branch == 1 IS the
+    # chain — same builder key, same program).
+    w_win = tree_window_width(k, tree_branch)
     # rows can overshoot n_new by up to k-1 committed-then-discarded
     # tokens (max frozen cursor = s_prompt + n_new + k - 2), and a
     # FROZEN row keeps re-running its window — its writes land at
-    # cursor..cursor+k-1 and must stay in bounds WITHOUT the
+    # cursor..cursor+w_win-1 and must stay in bounds WITHOUT the
     # dynamic-update-slice start clamp kicking in: a clamped write
     # would stomp committed cache columns with wrong-position K/V.
-    # Padding by 2(k-1) keeps every frozen re-write beyond the row's
-    # committed frontier, so freezing really does re-commit identical
-    # values (and, for learned positions, every gather stays inside
-    # the table).
-    cache_len = s_prompt + n_new + 2 * (k - 1)
+    # Padding by (k-2) + w_win keeps every frozen re-write beyond the
+    # row's committed frontier, so freezing really does re-commit
+    # identical values (and, for learned positions, every gather
+    # stays inside the table). For the chain (w_win = k) this is the
+    # historical 2(k-1).
+    cache_len = s_prompt + n_new + (k - 2) + w_win
     if cache_len > cfg.max_seq:
         raise ValueError(
-            f"prompt + new + 2(k-1) = {cache_len} exceeds max_seq = "
-            f"{cfg.max_seq} (the verify window overshoots by up to "
-            "k-1 and frozen rows re-write one window beyond that)")
+            f"prompt + new + window padding = {cache_len} exceeds "
+            f"max_seq = {cfg.max_seq} (the verify window overshoots "
+            "by up to k-1 and frozen rows re-write one window beyond "
+            "that; tree windows are 1 + (k-1)*tree_branch wide)")
     ctx = _DecodeCtx(cfg, mesh)
     n_layers = cfg.n_layers
     W = n_new + k  # output buffer: active writes end < n_new-1+k,
     #                frozen rows park their k-wide write at n_new
+    if tree_branch > 1:
+        nb = tree_branch
+        w_t, dep_t, anc_t, prim_t = _tree_template(k, nb)
+        dep_c = jnp.asarray(dep_t)
+        anc_c = jnp.asarray(anc_t)
+        prim_c = jnp.asarray(prim_t)
 
     if drafter == "trained":
         from icikit.models.transformer.draft import draft_readout
@@ -235,7 +429,10 @@ def _build_speculative(mesh, cfg: TransformerConfig, s_prompt: int,
         # zero-model-cost proposals: no drafting forward passes, no
         # truncated-depth cache writes — verify (unchanged) prices and
         # polices them exactly like model drafts
-        from icikit.serve.ngram_draft import ngram_propose
+        from icikit.serve.ngram_draft import (
+            ngram_propose,
+            ngram_propose_b,
+        )
 
     sampled = sampling[0] == "sample"
     filters = (sampling[1] if sampled and len(sampling) > 1 else True)
@@ -282,6 +479,78 @@ def _build_speculative(mesh, cfg: TransformerConfig, s_prompt: int,
         def cond(carry):
             _, _, n_done, *_ = carry
             return jnp.any(n_done < n_new)
+
+        def tree_body(carry):
+            tok, cur, n_done, out, kc, vc, kss, vss, stats = carry
+            active = n_done < n_new                      # (b,) bool
+
+            if drafter == "ngram":
+                # ranked zero-cost proposals: the b best suffix
+                # matches each contribute a chain; depth-i rank-r =
+                # the i-th continuation token of the r-th best match
+                seq = jnp.concatenate([prompt.astype(jnp.int32), out],
+                                      axis=1)
+                alts = ngram_propose_b(seq, s_prompt + n_done, k,
+                                       ngram_n, nb)     # (b, k-1, nb)
+            else:
+                # model drafter along the PRIMARY chain only — the
+                # ranked siblings are the same logits' top-b, free
+                # (no extra drafting passes for the b-1 alternatives)
+                alts_steps = []
+                t, c = tok, cur
+                for _ in range(k - 1):
+                    x, kc, vc, kss, vss = _window_pass(
+                        ctx, params, lp, kc, vc, kss, vss, t[:, None],
+                        c, range(draft_layers), cache_len)
+                    _, top = lax.top_k(draft_logits(params, x[:, 0]),
+                                       nb)
+                    t = top[:, 0].astype(jnp.int32)
+                    alts_steps.append(top.astype(jnp.int32))
+                    c = c + 1
+                alts = jnp.stack(alts_steps, axis=1)    # (b, k-1, nb)
+            w_nodes = jnp.concatenate(
+                [tok[:, None], alts.reshape(b, (k - 1) * nb)], axis=1)
+
+            # --- verify: the whole linearized tree in ONE
+            # stacked-layer pass under the tree-attention mask —
+            # still one weights read per window, whatever the shape
+            x, kc, vc, kss, vss = _window_pass(
+                ctx, params, lp, kc, vc, kss, vss, w_nodes, cur,
+                range(n_layers), cache_len, dep=dep_c, anc=anc_c)
+            g_lg = ctx.logits(params, x)             # (b, w, V)
+            if sampled:
+                # each node's draw is keyed by the POSITION of the
+                # token it decides (cur + dep + 1) — several nodes at
+                # one depth share a key, but exactly one sits on the
+                # realized path, and its draw is bitwise the
+                # sequential loop's (same key, same committed-prefix
+                # conditioning — the chain argument, node by node)
+                wkeys = fold_positions(
+                    streams, cur[:, None] + 1 + dep_c[None, :])
+                g_lin = select_tokens(g_lg, wkeys, knobs, filters)
+            else:
+                g_lin = jnp.argmax(g_lg, axis=-1).astype(jnp.int32)
+
+            m, m_p, side, a, new_tok, commit, src = _accept_tree(
+                w_nodes[:, prim_c], alts, g_lin[:, prim_c],
+                g_lin[:, 1:].reshape(b, k - 1, nb), active)
+            # accepted-path K/V out of tree scratch, into the
+            # position-aligned columns the next iteration reads
+            kc, vc, kss, vss = _tree_relocate(kc, vc, kss, vss, cur,
+                                              src, ctx.quant)
+
+            start = jnp.where(active, n_done, n_new)
+            out = _row_update(out, commit, start)
+
+            stats = stats + jnp.stack([
+                jnp.int32(1),
+                active.sum().astype(jnp.int32),
+                jnp.where(active, m, 0).sum().astype(jnp.int32),
+                jnp.where(active, m_p, 0).sum().astype(jnp.int32),
+                jnp.where(active, side, False).sum().astype(
+                    jnp.int32)])
+            return (jnp.where(active, new_tok, tok), cur + a,
+                    n_done + a, out, kc, vc, kss, vss, stats)
 
         def body(carry):
             tok, cur, n_done, out, kc, vc, kss, vss, stats = carry
@@ -355,11 +624,17 @@ def _build_speculative(mesh, cfg: TransformerConfig, s_prompt: int,
             stats = stats + jnp.stack([
                 jnp.int32(1),
                 active.sum().astype(jnp.int32),
-                jnp.where(active, m, 0).sum().astype(jnp.int32)])
+                jnp.where(active, m, 0).sum().astype(jnp.int32),
+                # chain: every accepted token is a primary-chain
+                # match, and no iteration ends sideways
+                jnp.where(active, m, 0).sum().astype(jnp.int32),
+                jnp.int32(0)])
             return (jnp.where(active, new_tok, tok), cur + a,
                     n_done + a, out, kc, vc, kss, vss, stats)
 
-        (_, _, _, out, _, _, _, _, stats) = lax.while_loop(cond, body,
+        loop_body = tree_body if tree_branch > 1 else body
+        (_, _, _, out, _, _, _, _, stats) = lax.while_loop(cond,
+                                                           loop_body,
                                                            init)
         stats = lax.psum(stats, DP_AXIS)
         return (jnp.concatenate(
@@ -377,7 +652,8 @@ def speculative_generate(params, prompt, mesh, cfg: TransformerConfig,
                          n_new: int, k: int = 4,
                          draft_layers: int | None = None,
                          return_stats: bool = False,
-                         drafter: str = "auto", ngram_n: int = 3):
+                         drafter: str = "auto", ngram_n: int = 3,
+                         tree_branch: int = 1):
     """Greedy continuation via self-speculative multi-token decode.
 
     Token-identical to ``greedy_generate(params, prompt, mesh, cfg,
@@ -414,15 +690,28 @@ def speculative_generate(params, prompt, mesh, cfg: TransformerConfig,
         the suffix-automaton upgrade on the same contract
         (``ServeConfig(drafter="suffix")``).
       ngram_n: max suffix length the ``"ngram"`` drafter matches.
+      tree_branch: ranked branches per draft position (round 14).
+        ``1`` = the chain window (bitwise the pre-tree path — same
+        builder key, same program). ``b >= 2`` verifies a
+        caterpillar token tree of ``1 + (k-1)·b`` linearized nodes
+        in the same single weights pass (tree-attention mask over
+        shared-prefix positions): the drafter's rank-0 chain extends,
+        and each depth carries ``b-1`` ranked sibling leaves — a
+        primary miss that lands on a sibling still commits that
+        token plus the model's choice after it. Token identity /
+        distribution exactness are unchanged for any ``b`` (every
+        committed token is still the model's own choice at its
+        position; see ``_accept_tree``).
 
     Acceptance counters flow through ``icikit.obs``
-    (``decode.spec.*`` counters + an ``acceptance`` observation) —
-    one device readback per *generation*, after the jitted loop; the
-    accept/commit logic itself runs on device.
+    (``decode.spec.*`` counters + an ``acceptance`` observation; tree
+    windows add ``decode.spec.tree.*``) — one device readback per
+    *generation*, after the jitted loop; the accept/commit logic
+    itself runs on device.
     """
     return _run_speculative(params, prompt, mesh, cfg, n_new, k,
                             draft_layers, return_stats, drafter,
-                            ngram_n)
+                            ngram_n, tree_branch=tree_branch)
 
 
 def speculative_sample_generate(params, prompt, mesh,
@@ -434,7 +723,8 @@ def speculative_sample_generate(params, prompt, mesh,
                                 draft_layers: int | None = None,
                                 return_stats: bool = False,
                                 drafter: str = "auto",
-                                ngram_n: int = 3):
+                                ngram_n: int = 3,
+                                tree_branch: int = 1):
     """SAMPLED continuation via speculative multi-token decode —
     rejection-sampled verification makes it **distribution-exact**
     under temperature / top-k / top-p, and the counter key discipline
@@ -457,7 +747,12 @@ def speculative_sample_generate(params, prompt, mesh,
 
     Sampling args are ``sample_generate``'s (per-row ``seeds``
     streams, traced knobs); speculation args are
-    ``speculative_generate``'s. Acceptance telemetry flows through
+    ``speculative_generate``'s — including ``tree_branch`` (the
+    multi-branch rejection construction stays exact: the verify draw
+    at a position either lands on one of the ranked one-hot
+    proposals, accepting that branch, or IS the normalized-residual
+    resample — and either way it is the sequential loop's keyed
+    draw, bitwise). Acceptance telemetry flows through
     ``icikit.obs`` identically.
     """
     _check_sampling_args(cfg, temperature, top_k, top_p)
@@ -473,13 +768,13 @@ def speculative_sample_generate(params, prompt, mesh,
                                       top_k > 0 or top_p < 1.0),
                             seeds=seeds,
                             key_data=jax.random.key_data(key),
-                            knobs=knobs)
+                            knobs=knobs, tree_branch=tree_branch)
 
 
 def _run_speculative(params, prompt, mesh, cfg, n_new, k, draft_layers,
                      return_stats, drafter, ngram_n,
                      sampling=("greedy",), seeds=None, key_data=None,
-                     knobs=None):
+                     knobs=None, tree_branch: int = 1):
     if drafter not in ("auto", "shared", "trained", "ngram"):
         raise ValueError(f"unknown drafter {drafter!r} "
                          "(known: auto, shared, trained, ngram)")
@@ -509,41 +804,64 @@ def _run_speculative(params, prompt, mesh, cfg, n_new, k, draft_layers,
         knobs = jnp.ones((3,), jnp.float32)
     # chaos sites (host boundaries of the decode pipeline): prefill/
     # program dispatch, drafter selection, and the stats readback —
-    # drilled by tests/test_chaos_decode.py
+    # drilled by tests/test_chaos_decode.py. Tree windows add their
+    # own build boundary (ranked-proposal program dispatch).
     chaos.maybe_delay("decode.spec.prefill")
     chaos.maybe_die("decode.spec.prefill")
     chaos.maybe_delay(f"decode.spec.drafter.{drafter}")
     chaos.maybe_die(f"decode.spec.drafter.{drafter}")
+    if tree_branch > 1:
+        chaos.maybe_delay("decode.spec.tree.build")
+        chaos.maybe_die("decode.spec.tree.build")
     params = maybe_quantize_params(params, mesh, cfg)
     with obs.span("decode.speculative", k=k, draft_layers=draft_layers,
                   n_new=n_new, drafter=drafter,
+                  tree_branch=tree_branch,
                   sampled=sampling[0] == "sample"):
         toks, stats = _build_speculative(
             mesh, cfg, prompt.shape[1], n_new, int(k),
-            int(draft_layers), drafter, int(ngram_n), sampling)(
+            int(draft_layers), drafter, int(ngram_n), sampling,
+            int(tree_branch))(
             params, prompt, seeds, key_data, knobs)
         # SDC drill on the telemetry boundary: a corrupted stats
         # readback must skew counters only, never the committed tokens
-        s = chaos.maybe_corrupt("decode.spec.verify.stats",
+        s = chaos.maybe_corrupt("decode.spec.tree.verify"
+                                if tree_branch > 1
+                                else "decode.spec.verify.stats",
                                 np.asarray(stats))
     steps = int(s[_S_ITERS])
     row_steps = int(s[_S_ROW_STEPS])
     accepted = int(s[_S_ACCEPTED])
+    primary = int(s[_S_PRIMARY])
+    sideways = int(s[_S_SIDEWAYS])
+    # per-DEPTH opportunities, not raw proposal count: a branch-b tree
+    # proposes (k-1)·b tokens per pass but can accept at most k-1, so
+    # the figure comparable across branch counts (and to the chain α)
+    # is accepted tokens per draft position offered
     proposed = row_steps * (k - 1)
     obs.count("decode.spec.verify_steps", steps)
     obs.count("decode.spec.draft_proposed", proposed)
     obs.count("decode.spec.draft_accepted", accepted)
     acceptance = accepted / proposed if proposed else 1.0
     obs.observe("decode.spec.acceptance", acceptance)
+    if tree_branch > 1:
+        obs.count("decode.spec.tree.draft_accepted", accepted)
+        obs.count("decode.spec.tree.sideways", sideways)
     if not return_stats:
         return toks
     return toks, {
         "drafter": drafter,
+        "tree_branch": int(tree_branch),
         "verify_steps": steps,
         "row_steps": row_steps,
         "draft_proposed": proposed,
         "draft_accepted": accepted,
         "acceptance_rate": acceptance,
+        # the per-branch split the tree cost model's expected-length
+        # estimator consumes: chain-rule matches vs sideways hops
+        "primary_accepted": primary,
+        "sideways_accepted": sideways,
+        "sideways_rate": (sideways / row_steps if row_steps else 0.0),
         # committed tokens per weights pass per row — the
         # weights-stationarity figure the cost model consumes
         "tokens_per_step": ((accepted + row_steps) / row_steps

@@ -109,10 +109,13 @@ import numpy as np
 
 from icikit import chaos, obs
 
-# site registry (chaos satellite): the request-level drill sites
+# site registry (chaos satellite): the request-level drill sites.
+# serve.spec.tree.fork (r14) is the host boundary of a tree verify
+# window: the CoW guard + block-table ensure over the widened scratch
+# window, drilled die/delay in tests/test_serve_chaos.py.
 chaos.register_site("serve.admit", "serve.admit.prompt",
                     "serve.prefill.chunk", "serve.step",
-                    "serve.kv.page")
+                    "serve.kv.page", "serve.spec.tree.fork")
 
 from icikit.serve.kvpool import (  # noqa: E402
     KVPool,
@@ -154,6 +157,16 @@ class ServeConfig:
     max_prompt: int = 64     # admission ceilings (validation, buffers)
     max_new: int = 64
     speculate_k: int = 1     # 1 = single-token; >= 2 = drafted verify
+    # ranked branches per draft position (round 14). 1 = the chain
+    # verify window (the pre-tree program, bitwise). b >= 2 verifies a
+    # caterpillar token tree of 1 + (k-1)*b linearized nodes per step
+    # (tree-attention mask over the row's paged view): the drafter's
+    # rank-0 chain plus b-1 ranked sibling leaves per depth, accepted
+    # by the ONE shared rule (speculative._accept_tree, which runs
+    # _accept_window for the primary chain verbatim) — so engine
+    # output stays bitwise sample_generate / greedy generate per
+    # request at every branch count. Needs speculate_k >= 2.
+    tree_branch: int = 1
     ngram_n: int = DEFAULT_N
     # "ngram" = the in-jit bounded-suffix matcher (r9, measured r10);
     # "suffix" = its suffix-automaton upgrade: unbounded longest-suffix
@@ -251,6 +264,18 @@ class Engine:
         if serve.speculate_k < 1:
             raise ValueError(
                 f"speculate_k must be >= 1, got {serve.speculate_k}")
+        if serve.tree_branch < 1:
+            raise ValueError(
+                f"tree_branch must be >= 1, got {serve.tree_branch}")
+        if serve.tree_branch > 1 and serve.speculate_k < 2:
+            raise ValueError(
+                "tree_branch > 1 needs a draft window "
+                f"(speculate_k >= 2), got "
+                f"speculate_k={serve.speculate_k}")
+        if serve.tree_branch > cfg.vocab:
+            raise ValueError(
+                f"tree_branch={serve.tree_branch} exceeds "
+                f"vocab={cfg.vocab}")
         if serve.integrity not in ("none", "pages"):
             raise ValueError(
                 f"unknown integrity {serve.integrity!r} "
@@ -280,12 +305,20 @@ class Engine:
             raise ValueError(
                 f"max_rows={serve.max_rows} must divide over "
                 f"dp={self.dp}")
+        from icikit.models.transformer.speculative import (
+            tree_window_width,
+        )
         k = serve.speculate_k
-        horizon = serve.max_prompt + serve.max_new + k - 1
+        # verify-window width: k scratch columns for the chain,
+        # 1 + (k-1)*b linearized tree nodes for a branch-b caterpillar
+        # (tree_branch == 1 IS the chain — same program)
+        self.w_win = tree_window_width(k, serve.tree_branch)
+        horizon = serve.max_prompt + serve.max_new + self.w_win - 1
         if horizon > cfg.max_seq:
             raise ValueError(
-                f"max_prompt + max_new + k - 1 = {horizon} exceeds "
-                f"max_seq = {cfg.max_seq}")
+                f"max_prompt + max_new + window - 1 = {horizon} "
+                f"exceeds max_seq = {cfg.max_seq} (tree windows are "
+                "1 + (speculate_k-1)*tree_branch columns wide)")
         bs = serve.block_size
         self.nb_per_row = -(-horizon // bs)           # block-table width
         if self.nb_per_row > serve.n_blocks:
@@ -450,7 +483,12 @@ class Engine:
         )
         from icikit.models.transformer.model import DP_AXIS
         from icikit.models.transformer.quant import decode_param_specs
-        from icikit.models.transformer.speculative import _accept_window
+        from icikit.models.transformer.speculative import (
+            _accept_tree,
+            _accept_window,
+            _tree_mask,
+            _tree_template,
+        )
         from icikit.ops.quant import quantize_last
         from icikit.ops.rope import apply_rope, rope_sincos
 
@@ -466,22 +504,53 @@ class Engine:
             touch_q8 = False      # arenas thread through untouched
         else:
             touch_q8 = mode in ("int8", "mixed")
+        # arenas the relocation (tree path) must move: exactly the
+        # ones this variant writes
+        written = set()
+        if touch_q8:
+            written |= {"qkc", "qvc", "ksc", "vsc"}
+        if mode in ("none", "mixed"):
+            written |= {"kc", "vc"}
+        tb = self.serve.tree_branch
+        tree = tb > 1
+        if tree:
+            w_win, dep_t, anc_t, prim_t = _tree_template(k, tb)
+            dep_c = jnp.asarray(dep_t)
+            anc_c = jnp.asarray(anc_t)
+            prim_c = jnp.asarray(prim_t)
+        else:
+            w_win = k
 
         def per_shard(params, toks, curs, active, isq, btab, drafts,
                       kdat, knobs, bufs):
             b = toks.shape[0]
             lp = {kk: params[kk] for kk in ctx.layer_keys}
             w_toks = jnp.concatenate([toks[:, None], drafts], axis=1)
-            pos = curs[:, None] + jnp.arange(k)[None, :]     # (b, k)
+            if tree:
+                # node j's LOGICAL position (rope, mask, key) is
+                # cur + dep[j]; its K/V still lands at scratch column
+                # cur + j — the accepted root-to-leaf path relocates
+                # into position-aligned columns after accept
+                pos = curs[:, None] + dep_c[None, :]     # (b, w)
+                spos = (curs[:, None]
+                        + jnp.arange(w_win)[None, :])    # (b, w)
+                # tree-attention mask over the paged view — the ONE
+                # construction, shared with _window_pass (the
+                # engine-vs-generate identity hangs on it)
+                mask = _tree_mask(anc_c, curs, T, w_win)
+            else:
+                pos = curs[:, None] + jnp.arange(k)[None, :]  # (b, k)
+                spos = pos
+                # per-row causal frontier over the row's paged view
+                mask = (jnp.arange(T)[None, None, :]
+                        <= pos[:, :, None])
             x = ctx.embed(params, w_toks, pos)
             sincos = (rope_sincos(pos, cfg.d_head, cfg.rope_theta)
                       if cfg.pos_encoding == "rope" else None)
-            # per-row causal frontier over the row's own paged view
-            mask = (jnp.arange(T)[None, None, :] <= pos[:, :, None])
             # physical write targets; inactive rows park on trash 0
-            pages = jnp.take_along_axis(btab, pos // bs, axis=1)
+            pages = jnp.take_along_axis(btab, spos // bs, axis=1)
             pages = jnp.where(active[:, None], pages, 0)
-            slots = pos % bs
+            slots = spos % bs
             out = {kk: [] for kk in bufs}
             for li in range(n_layers):
                 lp1 = {kk: lp[kk][li] for kk in ctx.layer_keys}
@@ -549,13 +618,15 @@ class Engine:
                                                     ctx.n_rep)
                 x = ctx.close_attn(x, attn, lp1)
                 x = ctx.ffn(x, lp1)
-            g_lg = ctx.logits(params, x)                     # (b, k, V)
+            g_lg = ctx.logits(params, x)                 # (b, w, V)
             if sampled:
                 # per-(row, position) counter keys: the token decided
-                # at window slot j lands at position pos[:, j] + 1 —
+                # at window node j lands at position pos[:, j] + 1 —
                 # the identical key (and identical filter math, via
                 # the shared selector) sample_generate uses there,
                 # which is the engine ≡ generate sampled identity
+                # (several tree nodes at one depth share a key, but
+                # exactly one sits on the realized path)
                 import jax as _jax
                 streams = _jax.random.wrap_key_data(kdat)
                 g = select_tokens(g_lg,
@@ -563,6 +634,44 @@ class Engine:
                                   knobs, filters)
             else:
                 g = jnp.argmax(g_lg, axis=-1).astype(jnp.int32)
+            if tree:
+                # the ONE accept rule (primary chain runs
+                # _accept_window verbatim inside _accept_tree) plus
+                # the sideways hop — shared with speculative.py, the
+                # engine-vs-generate identity contract hangs on it
+                alts = drafts.reshape(b, k - 1, tb)
+                m, m_p, side, a, new_tok, commit, src = _accept_tree(
+                    w_toks[:, prim_c], alts, g[:, prim_c],
+                    g[:, 1:].reshape(b, k - 1, tb), active)
+                # accepted root-to-leaf path K/V (and scales) out of
+                # tree scratch, into the position-aligned columns the
+                # next step's committed-prefix reads expect; columns
+                # past the accepted frontier hold relocation garbage
+                # — beyond every future causal mask until the next
+                # window overwrites them (chain-path discipline)
+                src_pos = curs[:, None] + src              # (b, k)
+                dst_pos = (curs[:, None]
+                           + jnp.arange(k)[None, :])       # (b, k)
+                sp_pg = jnp.take_along_axis(btab, src_pos // bs,
+                                            axis=1)
+                dst_pg = jnp.take_along_axis(btab, dst_pos // bs,
+                                             axis=1)
+                dst_pg = jnp.where(active[:, None], dst_pg, 0)
+
+                def reloc(p):
+                    taken = p[sp_pg, src_pos % bs]         # (b, k, …)
+                    return p.at[dst_pg, dst_pos % bs].set(taken)
+
+                out = {kk: ([reloc(v[0])[None] for v in vs]
+                            if kk in written else vs)
+                       for kk, vs in out.items()}
+                tstats = jnp.stack(
+                    [jnp.where(active, m_p, 0),
+                     jnp.where(active, side, False)
+                     .astype(jnp.int32)], axis=1)          # (b, 2)
+                return (commit, a, jnp.where(active, new_tok, toks),
+                        tstats,
+                        {kk: tuple(v) for kk, v in out.items()})
             # the ONE accept rule, shared with speculative_generate —
             # the engine-vs-generate identity contract hangs on it
             _, a, new_tok = _accept_window(w_toks, g, active)
@@ -578,14 +687,16 @@ class Engine:
         # functionally, and without donation XLA must copy every
         # buffer per token step (pool.update drops the old refs, so
         # reuse is safe; KVPool allocates distinct per-layer buffers)
+        outs = ((P(DP_AXIS, None), P(DP_AXIS), P(DP_AXIS),
+                 P(DP_AXIS, None), bspecs) if tree else
+                (P(DP_AXIS, None), P(DP_AXIS), P(DP_AXIS), bspecs))
         return jax.jit(_shard_map(
             per_shard, mesh=self.mesh,
             in_specs=(decode_param_specs(cfg), P(DP_AXIS), P(DP_AXIS),
                       P(DP_AXIS), P(DP_AXIS), P(DP_AXIS, None),
                       P(DP_AXIS, None), P(DP_AXIS, None),
                       P(DP_AXIS, None), bspecs),
-            out_specs=(P(DP_AXIS, None), P(DP_AXIS), P(DP_AXIS),
-                       bspecs)), donate_argnums=(9,))
+            out_specs=outs), donate_argnums=(9,))
 
     def _build_chunk(self, width: int, sampled: bool = False,
                      filters: bool = True):
@@ -1183,16 +1294,39 @@ class Engine:
     # -- stepping ----------------------------------------------------
 
     def _ensure_windows(self) -> None:
-        """Grow block tables to cover this step's write window; a row
-        the pool cannot extend is preempted (evicted + re-queued),
-        never silently stalled."""
-        k = self.serve.speculate_k
+        """Grow block tables to cover this step's write window (the
+        full scratch width — ``w_win`` tree nodes when tree
+        speculation is on); a row the pool cannot extend is preempted
+        (evicted + re-queued), never silently stalled. Tree windows
+        additionally run the CoW guard over every scratch block (the
+        guard is the invariant — a scratch write into a refcount>1
+        block must fork first — even though decode-frontier blocks
+        are never shared by construction): the ``serve.spec.tree
+        .fork`` host boundary, drilled in tests/test_serve_chaos.py."""
+        k = self.w_win
+        tree = self.serve.tree_branch > 1
+        bs = self.serve.block_size
         for slot, row in enumerate(self.rows):
             if row is None or row.prefilled < row.s_prompt:
                 continue
             try:
+                if tree and self._active[slot]:
+                    chaos.maybe_delay("serve.spec.tree.fork")
+                    chaos.maybe_die("serve.spec.tree.fork")
                 added = self.pool.ensure(row.owner, row.shard,
                                          int(self._curs[slot]) + k)
+                if tree and self._active[slot]:
+                    cur = int(self._curs[slot])
+                    forked = False
+                    for j in range(cur // bs,
+                                   (cur + k - 1) // bs + 1):
+                        if self.pool.cow(row.owner, row.shard, j,
+                                         side=row.side):
+                            forked = True
+                    if forked:
+                        self._prefix["cow"] += 1
+                        obs.count("serve.spec.tree.forks")
+                        added = True
             except PoolExhausted:
                 # preemption, not failure: the pool filled up around
                 # this row — evict and re-queue without burning a retry
@@ -1207,9 +1341,30 @@ class Engine:
 
     def _drafts(self) -> np.ndarray:
         k = self.serve.speculate_k
+        tb = self.serve.tree_branch
         B = self.serve.max_rows
         if k == 1:
             return np.zeros((B, 0), np.int32)
+        if tb > 1:
+            # ranked b-way proposals, flattened to the linearized
+            # caterpillar node order (depth-major, rank-minor —
+            # exactly alts.reshape): column 0 of each depth is the
+            # primary chain, bitwise the 1-way draft
+            if self.serve.drafter == "suffix":
+                out = np.zeros((B, k - 1, tb), np.int32)
+                for slot, row in enumerate(self.rows):
+                    if row is not None and self._active[slot]:
+                        out[slot] = self._automata[slot].top_b(
+                            k - 1, tb)
+                return out.reshape(B, (k - 1) * tb)
+            valid = np.ones(B, np.int32)
+            for slot, row in enumerate(self.rows):
+                if row is not None:
+                    valid[slot] = row.s_prompt + row.n_done
+            from icikit.serve.ngram_draft import ngram_propose_b_host
+            return ngram_propose_b_host(
+                self._seq_buf, valid, k, self.serve.ngram_n,
+                tb).reshape(B, (k - 1) * tb)
         if self.serve.drafter == "suffix":
             out = np.zeros((B, k - 1), np.int32)
             for slot, row in enumerate(self.rows):
@@ -1247,12 +1402,19 @@ class Engine:
         fkey = (live, samp, filt)
         if fkey not in self._step_fns:
             self._step_fns[fkey] = self._build_step(live, samp, filt)
+        tree = self.serve.tree_branch > 1
+        tstats = None
         with obs.span("serve.engine.step", step=self.n_steps,
                       rows=int(self._active.sum())):
-            g, a, newtok, bufs = self._step_fns[fkey](
+            outs = self._step_fns[fkey](
                 self.params, self._toks, self._curs, self._active,
                 self._isq, self._btab, self._drafts(),
                 self._kdat, self._knobs, self.pool.buffers())
+            if tree:
+                g, a, newtok, tstats, bufs = outs
+                tstats = np.asarray(tstats)
+            else:
+                g, a, newtok, bufs = outs
             self.pool.update(bufs)
             g = np.asarray(g)
             a = np.asarray(a)
@@ -1299,13 +1461,26 @@ class Engine:
         if k > 1:
             # proposed + accepted together make acceptance derivable
             # from the serve metrics alone — the measured-α row the
-            # ROADMAP 3b "auto ladder flip" gates on
+            # ROADMAP 3b "auto ladder flip" gates on. "proposed" is
+            # per-DEPTH opportunities (k-1 per row-step), not raw
+            # tree-node count: a branch-b tree offers (k-1)*b tokens
+            # but can accept at most k-1, so this is the figure
+            # comparable across branch counts
             obs.count("serve.spec.verify_steps")
             obs.count("serve.spec.row_steps", int(stepped.sum()))
             obs.count("serve.spec.draft_proposed",
                       int(stepped.sum()) * (k - 1))
             obs.count("serve.spec.draft_accepted",
                       int(np.maximum(a[stepped] - 1, 0).sum()))
+            if tree:
+                # the per-branch split the tree cost model's
+                # expected-accepted-length estimator consumes
+                obs.count("serve.spec.tree.draft_accepted",
+                          int(np.maximum(a[stepped] - 1, 0).sum()))
+                obs.count("serve.spec.tree.primary",
+                          int(tstats[stepped, 0].sum()))
+                obs.count("serve.spec.tree.sideways",
+                          int(tstats[stepped, 1].sum()))
         obs.count("serve.tokens", committed)
         obs.gauge("serve.occupancy_rows",
                   float(self._active.sum()) / self.serve.max_rows)

@@ -65,6 +65,11 @@ import jax.numpy as jnp
 
 DEFAULT_N = 3
 
+# suffix-link states top_b consults per depth beyond the matched one
+# (the shorter-suffix alternatives ladder) — a CONSTANT, so per-token
+# drafting work stays O(1) in the stream length
+_TOPB_LINK_HOPS = 4
+
 
 def ngram_propose(seq, valid, k: int, n: int = DEFAULT_N):
     """Propose ``k - 1`` draft tokens per row by longest-suffix match.
@@ -125,6 +130,77 @@ def ngram_propose(seq, valid, k: int, n: int = DEFAULT_N):
     return jax.vmap(row)(seq, valid)
 
 
+def ngram_propose_b(seq, valid, k: int, n: int = DEFAULT_N,
+                    nb: int = 2):
+    """Ranked b-way proposals for the token-tree verify window
+    (round 14): the ``nb`` best suffix matches each contribute a
+    continuation chain, and the depth-``i`` rank-``r`` alternative is
+    the ``i``-th token following the ``r``-th best match.
+
+    Ranking is the scalar the 1-way matcher already maximizes —
+    ``matchlen * S + position`` (longer match first, then later
+    occurrence) — taken top-``nb`` instead of argmax, so rank 0 is
+    bitwise :func:`ngram_propose`'s proposal and ranks are stable
+    under recomputation (the score has no ties: position is a
+    tiebreak by construction). Ranks beyond the available positive-
+    score matches fall back to repeating the row's last committed
+    token — a guess like any other, priced (and policed) by the
+    verify window exactly like every proposal.
+
+    Returns int32 ``(b, k - 1, nb)``. O(S·n) per row per call, the
+    same asymptotics as the 1-way matcher — the extra ranks reuse the
+    one scored scan."""
+    if k < 2:
+        raise ValueError(f"k must be >= 2 to draft, got {k}")
+    if nb < 1:
+        raise ValueError(f"nb must be >= 1, got {nb}")
+    b, S = seq.shape
+    if nb > S:
+        raise ValueError(f"nb={nb} exceeds the token buffer ({S})")
+    idx = jnp.arange(S)
+
+    def row(seq_r, v):
+        matchlen = jnp.zeros((S,), jnp.int32)
+        cum = jnp.ones((S,), bool)
+        for i in range(1, n + 1):
+            last_i = jnp.where(v - i >= 0,
+                               seq_r[jnp.clip(v - i, 0, S - 1)], -1)
+            sh = (seq_r if i == 1 else jnp.concatenate(
+                [jnp.full((i - 1,), -1, seq_r.dtype),
+                 seq_r[:S - i + 1]]))
+            cum = cum & (sh == last_i)
+            matchlen = matchlen + cum.astype(jnp.int32)
+        score = jnp.where(idx <= v - 2, matchlen * S + idx, -1)
+        top_scores, js = jax.lax.top_k(score, nb)       # (nb,)
+        ml = jnp.where(top_scores >= 0,
+                       jnp.take(matchlen, js), 0)       # (nb,)
+        prop_idx = jnp.minimum(js[:, None] + 1 + jnp.arange(k - 1)
+                               [None, :], v - 1)        # (nb, k-1)
+        props = jnp.take(seq_r, jnp.clip(prop_idx, 0, S - 1))
+        fallback = jnp.full((nb, k - 1),
+                            seq_r[jnp.clip(v - 1, 0, S - 1)])
+        out = jnp.where((ml > 0)[:, None], props, fallback)
+        return out.T.astype(jnp.int32)                  # (k-1, nb)
+
+    return jax.vmap(row)(seq, valid)
+
+
+@lru_cache(maxsize=None)
+def _jitted_b(k: int, n: int, nb: int):
+    return jax.jit(partial(ngram_propose_b, k=k, n=n, nb=nb))
+
+
+def ngram_propose_b_host(seq, valid, k: int, n: int = DEFAULT_N,
+                         nb: int = 2):
+    """Host-friendly wrapper (numpy in, numpy out) over a cached jit
+    of :func:`ngram_propose_b` — the serving engine's per-step
+    tree-draft call."""
+    import numpy as np
+    out = _jitted_b(k, n, nb)(jnp.asarray(seq, jnp.int32),
+                              jnp.asarray(valid, jnp.int32))
+    return np.asarray(out)
+
+
 class SuffixAutomaton:
     """Online suffix automaton over a committed token stream, with a
     delayed-by-one matcher for draft proposals.
@@ -148,7 +224,7 @@ class SuffixAutomaton:
     """
 
     __slots__ = ("_next", "_link", "_len", "_end", "_last", "seq",
-                 "_mstate", "_mlen")
+                 "_mstate", "_mlen", "last_topb_ops")
 
     def __init__(self):
         self._next = [{}]
@@ -159,6 +235,8 @@ class SuffixAutomaton:
         self.seq: list = []
         self._mstate = 0
         self._mlen = 0
+        self.last_topb_ops = 0   # transitions examined by top_b
+        #                          (the O(1)/token cost pin's probe)
 
     def _extend(self, t: int) -> None:
         pos = len(self.seq) - 1          # t already appended
@@ -223,6 +301,73 @@ class SuffixAutomaton:
         out = np.empty(m, np.int32)
         for i in range(m):
             out[i] = self.seq[min(e + 1 + i, v - 1)]
+        return out
+
+    def top_b(self, m: int, nb: int):
+        """Ranked ``(m, nb)`` proposals for the token-tree verify
+        window (round 14): column 0 is bitwise :meth:`propose` (the
+        canonical continuation of the matched occurrence); columns
+        ``1..nb-1`` at depth ``i`` are the OTHER tokens the automaton
+        has seen follow the context — read off the cursor state's
+        outgoing transitions, then (ladder) off a bounded walk of its
+        SUFFIX LINKS (the next-shorter matching suffixes: a context
+        too specific to have alternatives defers to the contexts it
+        ends with). Ranking is deterministic: longer matched suffix
+        first (fewer link hops), within a state by the end position
+        of the transition target's first occurrence (latest first,
+        then token ascending) — a pure function of the fed stream,
+        so ranks are stable under recomputation.
+
+        Cost: O(outdegree·log outdegree) over at most
+        ``1 + _TOPB_LINK_HOPS`` states per depth — automaton
+        transitions only, NEVER a rescan of the stream, so per
+        committed token the drafting cost stays O(1) in the stream
+        length (``last_topb_ops`` counts the transitions examined;
+        the unit test bounds it). Ranks with nothing to offer fall
+        back to the primary token (a duplicate proposal — inert at
+        accept time, since the sideways compare only fires after the
+        primary already missed)."""
+        import numpy as np
+        self.last_topb_ops = 0
+        v = len(self.seq)
+        out = np.zeros((m, nb), np.int32)
+        if v == 0:
+            return out
+        if self._mlen == 0:
+            out[:] = self.seq[-1]
+            return out
+        st = self._mstate
+        e = self._end[st]
+        alive = True
+        for i in range(m):
+            prim = self.seq[min(e + 1 + i, v - 1)]
+            out[i, :] = prim            # fallback filler = primary
+            if alive and nb > 1:
+                ranked: list = []
+                seen = {prim}
+                st2, hops = st, 0
+                while (len(ranked) < nb - 1 and st2 > 0
+                       and hops <= _TOPB_LINK_HOPS):
+                    nxt2 = self._next[st2]
+                    self.last_topb_ops += len(nxt2)
+                    more = sorted(
+                        ((t, self._end[s2]) for t, s2 in nxt2.items()
+                         if t not in seen),
+                        key=lambda te: (-te[1], te[0]))
+                    for t, _ in more:
+                        ranked.append(t)
+                        seen.add(t)
+                    st2 = self._link[st2]
+                    hops += 1
+                for r, t in enumerate(ranked[:nb - 1], start=1):
+                    out[i, r] = t
+            if alive and prim in self._next[st] and e + 1 + i < v:
+                st = self._next[st][prim]
+            else:
+                # the primary chain ran off the automaton (clamped
+                # repeat past the frontier): no structure left to
+                # rank — deeper alternatives stay at the fallback
+                alive = False
         return out
 
 

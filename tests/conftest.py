@@ -28,6 +28,24 @@ except AttributeError:
     # already forced the 8-device host-platform simulation
     pass
 
+# Persistent XLA compilation cache (round 14): the module-boundary
+# clear_caches() fixture below bounds memory by dropping compiled
+# executables — at the price of recompiling shared programs in every
+# later module, which makes the near-full suite compile-bound on this
+# CPU image. The on-disk cache turns those recompiles into disk hits
+# (within one run AND across runs) while the in-memory profile stays
+# bounded. ICIKIT_JAX_CACHE=off disables; any other value overrides
+# the cache directory.
+_cache_dir = os.environ.get("ICIKIT_JAX_CACHE",
+                            "/tmp/icikit_jax_cache")
+if _cache_dir != "off":
+    try:
+        jax.config.update("jax_compilation_cache_dir", _cache_dir)
+        jax.config.update(
+            "jax_persistent_cache_min_compile_time_secs", 0.1)
+    except AttributeError:
+        pass    # older jax without the persistent cache: no-op
+
 from icikit.utils.mesh import make_mesh  # noqa: E402
 
 

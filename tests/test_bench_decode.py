@@ -146,3 +146,88 @@ def test_spec_cost_model_anchors():
                          tokens_per_step=1.5)
     assert m2["projected_eff_ms_per_token"] < m3[
         "projected_eff_ms_per_token"]
+
+
+# -- token-tree pricing (round 14) -----------------------------------
+
+def test_tree_bytes_b1_is_chain():
+    """tree_branch=1 degenerates the tree byte model to the chain's,
+    exactly — the pricing analog of the b=1 bitwise program pin."""
+    from icikit.bench.decode import (
+        spec_bytes_per_iter,
+        tree_bytes_per_iter,
+    )
+    from icikit.bench.train import PRESETS
+    from icikit.models.transformer import TransformerConfig
+    cfg = TransformerConfig(**PRESETS["base"])
+    chain = spec_bytes_per_iter(cfg, 1, 320, 4, 3)
+    tree = tree_bytes_per_iter(cfg, 1, 320, 4, 3, tree_branch=1)
+    assert tree == chain
+    # and bytes/pass grow with TREE SIZE at fixed depth
+    b2 = sum(tree_bytes_per_iter(cfg, 1, 320, 4, 3, tree_branch=2))
+    b4 = sum(tree_bytes_per_iter(cfg, 1, 320, 4, 3, tree_branch=4))
+    assert sum(chain) < b2 < b4
+    # zero-cost drafter: no draft bytes at any branch count
+    d0, _ = tree_bytes_per_iter(cfg, 1, 320, 4, 3, tree_branch=2,
+                                drafter_free=True)
+    assert d0 == 0.0
+
+
+def test_tree_expected_accept_estimator():
+    from icikit.bench.decode import (
+        tree_accept_params,
+        tree_expected_accept,
+    )
+    # p_side = 0 is the chain expectation 1 + (k-1)alpha-ish
+    # (truncated geometric): exact at the extremes
+    assert tree_expected_accept(0.0, 0.0, 4) == 1.0
+    assert tree_expected_accept(1.0, 0.0, 4) == 4.0
+    # sideways help is monotone, bounded by one extra commit
+    e0 = tree_expected_accept(0.4, 0.0, 4)
+    e5 = tree_expected_accept(0.4, 0.5, 4)
+    e1 = tree_expected_accept(0.4, 1.0, 4)
+    assert e0 < e5 < e1 <= e0 + 1.0
+    # round-trip: a synthetic measured row at known (alpha, p_side)
+    # recovers both parameters
+    alpha, p_side, k, steps = 0.35, 0.6, 4, 100_000
+    d = k - 1
+    em = alpha * (1 - alpha ** d) / (1 - alpha)
+    row = {"k": k, "row_steps": steps,
+           "primary_accepted": em * steps,
+           "sideways_accepted": p_side * (1 - alpha ** d) * steps}
+    a_hat, p_hat = tree_accept_params(row)
+    assert abs(a_hat - alpha) < 1e-6
+    assert abs(p_hat - p_side) < 1e-6
+
+
+def test_cost_model_understands_tree_records(tmp_path):
+    """--alpha-from with tree acceptance rows: keyed per branch
+    count, measured tokens_per_step priced directly (it carries the
+    sideways commits), estimator fit carried beside it."""
+    import json
+    from icikit.bench.decode import cost_model_rows
+    path = tmp_path / "acc.jsonl"
+    rows = [
+        {"kind": "acceptance", "batch": 1, "k": 3, "draft_layers": 1,
+         "n_layers": 4, "drafter": "ngram", "acceptance_rate": 0.30},
+        {"kind": "acceptance", "batch": 1, "k": 3, "draft_layers": 1,
+         "n_layers": 4, "drafter": "ngram", "acceptance_rate": 0.38,
+         "tree_branch": 4, "tokens_per_step": 1.95, "row_steps": 200,
+         "primary_accepted": 120, "sideways_accepted": 70},
+    ]
+    with open(path, "w") as f:
+        for r in rows:
+            f.write(json.dumps(r) + "\n")
+    out = cost_model_rows(str(path), preset="base", alpha_batch=1)
+    assert len(out) == 2          # chain row AND tree row both priced
+    tree = next(r for r in out if r.get("tree_branch") == 4)
+    chain = next(r for r in out if "tree_branch" not in r)
+    assert tree["tree_nodes"] == 1 + 2 * 4
+    assert tree["measured_tokens_per_step"] == 1.95
+    assert tree["drafter_free"] is True          # ngram = zero cost
+    assert 0.0 < tree["est_alpha_primary"] < 1.0
+    assert tree["est_tokens_per_step"] > 1.0
+    # the tree window moves more bytes than the chain window at the
+    # same depth, but buys more tokens per pass
+    assert tree["model_bytes_iter"] > chain["model_bytes_iter"]
+    assert isinstance(tree["clears_15pct"], bool)

@@ -125,3 +125,142 @@ def test_ngram_drafter_identity_dp_tp_rope():
     got = np.asarray(speculative_generate(params, pd, mesh, cfg, 10,
                                           k=3, drafter="ngram"))
     np.testing.assert_array_equal(got, base)
+
+
+# -- ranked-alternatives APIs (round 14 tree drafting) ----------------
+
+def _prop_b(seq, valid, k, n=3, nb=2):
+    from icikit.serve.ngram_draft import ngram_propose_b
+    return np.asarray(ngram_propose_b(
+        jnp.asarray(seq, jnp.int32)[None],
+        jnp.asarray([valid], jnp.int32), k, n, nb))[0]
+
+
+def test_propose_b_rank0_is_the_1way_proposal():
+    """Column 0 of the b-way matcher is bitwise the argmax matcher —
+    the b=1 tree path really is the chain path's drafting."""
+    rng = np.random.default_rng(3)
+    for _ in range(8):
+        seq = rng.integers(0, 5, 24).tolist()
+        v = rng.integers(3, 24)
+        one = _prop(seq, valid=int(v), k=4)
+        many = _prop_b(seq, valid=int(v), k=4, nb=3)
+        np.testing.assert_array_equal(many[:, 0], one)
+
+
+def test_propose_b_ranks_distinct_matches():
+    # suffix [7, 8]: best (2-gram) match ends at 2 -> continue 9, 4;
+    # rank 1 is the next-best scored end position (the later 1-gram
+    # match of [8] at position 6 -> continue 5, 7)
+    seq = [7, 8, 9, 4, 5, 8, 5, 7, 8, 0, 0, 0]
+    got = _prop_b(seq, valid=9, k=3, nb=2)
+    np.testing.assert_array_equal(got[:, 0], [9, 4])
+    assert got.shape == (2, 2)
+    # rank 1 comes from a DIFFERENT match end than rank 0
+    assert not np.array_equal(got[:, 1], got[:, 0])
+
+
+def test_propose_b_rank_stability():
+    """Same buffer -> same ranked output, call after call (the rank
+    score has no ties by construction: position breaks them)."""
+    rng = np.random.default_rng(4)
+    seq = rng.integers(0, 4, (2, 32)).astype(np.int32)
+    valid = np.asarray([30, 17], np.int32)
+    from icikit.serve.ngram_draft import ngram_propose_b_host
+    a = ngram_propose_b_host(seq, valid, 4, 3, 3)
+    b = ngram_propose_b_host(seq, valid, 4, 3, 3)
+    np.testing.assert_array_equal(a, b)
+    assert a.shape == (2, 3, 3)
+
+
+def test_propose_b_fallback_ranks_are_valid_tokens():
+    # no match at all: every rank falls back to the last token
+    got = _prop_b([1, 2, 3, 4, 5, 6, 0, 0], valid=6, k=3, nb=3)
+    np.testing.assert_array_equal(got, np.full((2, 3), 6))
+
+
+def test_propose_b_validates_args():
+    from icikit.serve.ngram_draft import ngram_propose_b
+    with pytest.raises(ValueError, match="nb must be"):
+        ngram_propose_b(jnp.zeros((1, 4), jnp.int32),
+                        jnp.ones((1,), jnp.int32), k=2, nb=0)
+    with pytest.raises(ValueError, match="exceeds the token buffer"):
+        ngram_propose_b(jnp.zeros((1, 4), jnp.int32),
+                        jnp.ones((1,), jnp.int32), k=2, nb=5)
+
+
+def test_suffix_automaton_top_b_rank0_is_propose():
+    from icikit.serve.ngram_draft import SuffixAutomaton
+    rng = np.random.default_rng(5)
+    sam = SuffixAutomaton()
+    for t in rng.integers(0, 6, 64):
+        sam.feed(int(t))
+    for m in (1, 3, 5):
+        top = sam.top_b(m, 3)
+        np.testing.assert_array_equal(top[:, 0], sam.propose(m))
+        assert top.shape == (m, 3)
+
+
+def test_suffix_automaton_top_b_rank_stability():
+    """Deterministic pure function of the fed stream: a fresh
+    automaton fed the same tokens ranks identically, and repeated
+    calls do not perturb the matcher state."""
+    from icikit.serve.ngram_draft import SuffixAutomaton
+    rng = np.random.default_rng(6)
+    stream = rng.integers(0, 5, 80).tolist()
+    sam1, sam2 = SuffixAutomaton(), SuffixAutomaton()
+    for t in stream:
+        sam1.feed(t)
+        sam2.feed(t)
+    a = sam1.top_b(4, 3)
+    b = sam1.top_b(4, 3)      # idempotent
+    c = sam2.top_b(4, 3)      # fresh build
+    np.testing.assert_array_equal(a, b)
+    np.testing.assert_array_equal(a, c)
+    assert sam1.match_len == sam2.match_len
+
+
+def test_suffix_automaton_top_b_offers_seen_continuations():
+    # stream: "1 2 3 ... 1 2 4 ... 1 2" — after the final "1 2" the
+    # matched factor has been followed by BOTH 3 and 4; rank 0 is the
+    # canonical occurrence's continuation, and the other observed
+    # continuation must appear among the alternatives
+    from icikit.serve.ngram_draft import SuffixAutomaton
+    sam = SuffixAutomaton()
+    for t in [1, 2, 3, 9, 1, 2, 4, 9, 1, 2]:
+        sam.feed(t)
+    top = sam.top_b(1, 3)
+    assert set(top[0]) >= {3, 4}
+
+
+def test_suffix_automaton_top_b_cost_is_stream_length_free():
+    """O(1)/token: the transitions examined per call are bounded by
+    the alphabet, not the stream length — feeding 10x more tokens
+    must not grow the per-call work (the satellite's cost pin)."""
+    from icikit.serve.ngram_draft import SuffixAutomaton
+    rng = np.random.default_rng(7)
+
+    def ops_at(n_tokens):
+        sam = SuffixAutomaton()
+        for t in rng.integers(0, 8, n_tokens):
+            sam.feed(int(t))
+        sam.top_b(4, 3)
+        return sam.last_topb_ops
+
+    short, long_ = ops_at(100), ops_at(1000)
+    # bound: (1 + link hops) states/depth x alphabet, never O(stream)
+    assert long_ <= 2 * short + 5 * 8 * 4, (short, long_)
+
+
+def test_tree_drafter_token_identity():
+    """Proposals (ranked or not) never change tokens: tree-drafted
+    speculative output stays greedy-identical for both zero-cost
+    drafters (the full drafter × branch grid runs in
+    tests/test_tree_spec.py)."""
+    mesh, params, pd = _setup(b=2)
+    base = np.asarray(greedy_generate(params, pd, mesh, CFG, 10))
+    for drafter, nb in (("ngram", 2), ("shared", 3)):
+        got = np.asarray(speculative_generate(
+            params, pd, mesh, CFG, 10, k=3, drafter=drafter,
+            tree_branch=nb))
+        np.testing.assert_array_equal(got, base)

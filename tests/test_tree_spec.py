@@ -1,0 +1,605 @@
+"""Token-tree speculation (round 14) — correctness, exactness, and
+chaos coverage.
+
+The load-bearing claims, each pinned here:
+
+- **b=1 IS the chain path** — ``tree_branch=1`` routes to the same
+  builder key and the same compiled program as the pre-tree call
+  (bitwise, trivially: there is one accept implementation, and the
+  caterpillar degenerates to the chain at b=1 by construction — the
+  ``make check`` lint enforces that structurally).
+- **temp→0 collapses bitwise to greedy longest-prefix accept** —
+  tree-speculated greedy (and temperature-0 sampled) output equals
+  ``greedy_generate`` bitwise across dp/tp meshes, drafters, and
+  branch counts (the full mesh × drafter × b cross product runs
+  under the slow marker; tier-1 keeps a spanning subset).
+- **sampled acceptance is distribution-exact** — tree-speculated
+  sampled output is bitwise ``sample_generate`` at matched seeds
+  (every committed token is the model's own keyed draw at its
+  position — the sideways hop merely finds that draw on a different
+  pre-verified node), and a two-sample chi-square over DISJOINT seed
+  sets at matched (T, top_p) pins the distribution claim
+  statistically, not just by key bookkeeping.
+- **the sideways hop is live machinery** — a branch count covering
+  the whole vocab forces every primary miss onto a sibling, so
+  ``sideways_accepted`` > 0 and per-pass accepted length strictly
+  improves over the chain (the tree must not be dead code that
+  passes identity tests vacuously).
+- **engine ≡ single-request generate with trees on** — the serving
+  engine's tree verify windows commit bitwise what single-request
+  ``greedy_generate`` / ``sample_generate`` commit, per request,
+  across drafters, branch counts, kv arenas, and staggered mixed
+  traffic.
+- **chaos sites** — ``decode.spec.tree.build`` (die/delay at the
+  ranked-proposal program dispatch), ``decode.spec.tree.verify``
+  (SDC on the stats readback skews counters only, never tokens),
+  ``serve.spec.tree.fork`` (die/delay at the engine's tree-window
+  CoW-guard boundary: leases expire, a second engine completes
+  token-identically); clean armed runs stay bit-identical.
+
+Shapes are deliberately uniform across tests (b=2 rows, 8-token
+prompts, n_new=10, k=3): ``_build_speculative`` / the decode
+builders cache per (mesh, cfg, shape, …) and jax Meshes compare by
+value, so uniform shapes let the tests share compiled programs —
+the suite must fit the tier-1 wall-clock budget.
+"""
+
+import dataclasses
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from icikit import chaos
+from icikit.models.transformer import (
+    TransformerConfig,
+    init_params,
+    speculative_generate,
+)
+from icikit.models.transformer.decode import (
+    greedy_generate,
+    sample_generate,
+)
+from icikit.models.transformer.model import make_model_mesh
+from icikit.models.transformer.speculative import (
+    speculative_sample_generate,
+)
+from icikit.serve import Engine, RequestQueue, ServeConfig
+
+CFG = TransformerConfig(vocab=61, d_model=32, n_heads=4, d_head=8,
+                        d_ff=64, n_layers=2, max_seq=64,
+                        compute_dtype="float32")
+N_NEW = 10
+
+
+def _put(mesh, arr):
+    return jax.device_put(jnp.asarray(arr),
+                          NamedSharding(mesh, P("dp", None)))
+
+
+def _prompts(b, s, seed=0, vocab=61):
+    rng = np.random.default_rng(seed)
+    return rng.integers(0, vocab, (b, s)).astype(np.int32)
+
+
+def _setup(dp=1, tp=1, b=2, s=8, seed=0, cfg=CFG):
+    mesh = make_model_mesh(dp=dp, tp=tp, sp=1)
+    params = init_params(jax.random.key(0), cfg, mesh)
+    return mesh, params, _put(mesh, _prompts(b, s, seed=seed,
+                                             vocab=cfg.vocab))
+
+
+# -- b=1 is the chain path -------------------------------------------
+
+def test_tree_b1_bitwise_chain_greedy_and_stats():
+    mesh, params, pd = _setup()
+    chain, st_c = speculative_generate(params, pd, mesh, CFG, N_NEW,
+                                       k=3, return_stats=True)
+    tree, st_t = speculative_generate(params, pd, mesh, CFG, N_NEW,
+                                      k=3, tree_branch=1,
+                                      return_stats=True)
+    np.testing.assert_array_equal(np.asarray(tree), np.asarray(chain))
+    # same program ⇒ same iteration trace, not just same tokens
+    assert st_t["verify_steps"] == st_c["verify_steps"]
+    assert st_t["draft_accepted"] == st_c["draft_accepted"]
+    # chain-path invariants of the widened stats vector: every
+    # accepted token is a primary match, no iteration ends sideways
+    assert st_t["primary_accepted"] == st_t["draft_accepted"]
+    assert st_t["sideways_accepted"] == 0
+
+
+def test_tree_b1_bitwise_chain_sampled():
+    mesh, params, pd = _setup()
+    key = jax.random.key(2)
+    chain = np.asarray(speculative_sample_generate(
+        params, pd, mesh, CFG, N_NEW, key, k=3, temperature=0.9,
+        top_p=0.95, seeds=[1, 2]))
+    tree = np.asarray(speculative_sample_generate(
+        params, pd, mesh, CFG, N_NEW, key, k=3, temperature=0.9,
+        top_p=0.95, seeds=[1, 2], tree_branch=1))
+    np.testing.assert_array_equal(tree, chain)
+
+
+# -- temp→0 collapses bitwise to greedy ------------------------------
+
+def test_tree_greedy_collapse():
+    """Tree-speculated greedy == greedy_generate bitwise: the ngram
+    drafter over b ∈ {1, 2, 4} plus the shared drafter's widest tree
+    (the full drafter × b grid runs under the slow marker); one
+    baseline."""
+    mesh, params, pd = _setup()
+    base = np.asarray(greedy_generate(params, pd, mesh, CFG, N_NEW))
+    for drafter, nb in (("ngram", 1), ("ngram", 2), ("ngram", 4),
+                        ("shared", 4)):
+        got = np.asarray(speculative_generate(
+            params, pd, mesh, CFG, N_NEW, k=3, drafter=drafter,
+            tree_branch=nb))
+        np.testing.assert_array_equal(got, base, err_msg=str(
+            (drafter, nb)))
+
+
+@pytest.mark.parametrize("dp,tp", [(2, 2)])
+def test_tree_greedy_collapse_sharded(dp, tp):
+    """Sharded spanning subset — the dp×tp mesh exercises both
+    parallel axes (the full mesh × drafter × b product, incl. the
+    dp-only mesh, runs under the slow marker below)."""
+    mesh, params, pd = _setup(dp=dp, tp=tp)
+    base = np.asarray(greedy_generate(params, pd, mesh, CFG, N_NEW))
+    for drafter, nb in (("ngram", 2), ("shared", 4)):
+        got = np.asarray(speculative_generate(
+            params, pd, mesh, CFG, N_NEW, k=3, drafter=drafter,
+            tree_branch=nb))
+        np.testing.assert_array_equal(got, base, err_msg=str(
+            (drafter, nb)))
+
+
+@pytest.mark.slow
+def test_tree_greedy_collapse_exhaustive():
+    """The full dp/tp × drafter × b∈{1,2,4} cross product (the
+    acceptance-criteria grid, complete)."""
+    for dp, tp in ((1, 1), (2, 1), (2, 2)):
+        mesh, params, pd = _setup(dp=dp, tp=tp)
+        base = np.asarray(greedy_generate(params, pd, mesh, CFG,
+                                          N_NEW))
+        for drafter in ("ngram", "shared"):
+            for nb in (1, 2, 4):
+                got = np.asarray(speculative_generate(
+                    params, pd, mesh, CFG, N_NEW, k=3,
+                    drafter=drafter, tree_branch=nb))
+                np.testing.assert_array_equal(
+                    got, base, err_msg=str((dp, tp, drafter, nb)))
+
+
+def test_tree_temp_zero_is_greedy_accept_bitwise():
+    """temperature → 0 pins the sampled tree route onto the greedy
+    longest-prefix accept: spec-sampled(T=0, tree) == greedy
+    generate, bitwise."""
+    mesh, params, pd = _setup()
+    greedy = np.asarray(greedy_generate(params, pd, mesh, CFG, N_NEW))
+    spec_t0 = np.asarray(speculative_sample_generate(
+        params, pd, mesh, CFG, N_NEW, jax.random.key(6), k=3,
+        temperature=0.0, drafter="ngram", tree_branch=3))
+    np.testing.assert_array_equal(spec_t0, greedy)
+
+
+def test_tree_trained_drafter_identity():
+    """The trained head's top-b logits rank the siblings — identity
+    must hold regardless of head quality (proposals price throughput,
+    never tokens)."""
+    cfg = dataclasses.replace(CFG, n_layers=4, draft_head=True,
+                              draft_layers=1, draft_rank=4)
+    mesh, params, pd = _setup(cfg=cfg)
+    base = np.asarray(greedy_generate(params, pd, mesh, cfg, N_NEW))
+    got = np.asarray(speculative_generate(
+        params, pd, mesh, cfg, N_NEW, k=3, drafter="trained",
+        tree_branch=2))
+    np.testing.assert_array_equal(got, base)
+
+
+# -- sampled exactness -----------------------------------------------
+
+def test_tree_sampled_bitwise_vs_sample_generate():
+    """Multi-branch rejection sampling commits the identical sequence
+    the sequential sampled loop draws: the verify draw either lands
+    on a ranked one-hot proposal (accepting that branch) or IS the
+    normalized-residual resample — either way it is the sequential
+    loop's keyed draw, bitwise. One baseline, both drafters × b."""
+    mesh, params, pd = _setup()
+    key = jax.random.key(2)
+    base = np.asarray(sample_generate(
+        params, pd, mesh, CFG, N_NEW, key, temperature=0.9,
+        top_p=0.95, seeds=[1, 2]))
+    for drafter, nb in (("ngram", 2), ("shared", 4)):
+        got = np.asarray(speculative_sample_generate(
+            params, pd, mesh, CFG, N_NEW, key, k=3,
+            temperature=0.9, top_p=0.95, seeds=[1, 2],
+            drafter=drafter, tree_branch=nb))
+        np.testing.assert_array_equal(got, base, err_msg=str(
+            (drafter, nb)))
+
+
+def test_tree_sampled_identity_sharded():
+    mesh, params, pd = _setup(dp=2, tp=2)
+    key = jax.random.key(3)
+    base = np.asarray(sample_generate(
+        params, pd, mesh, CFG, N_NEW, key, temperature=1.2, top_k=16))
+    got = np.asarray(speculative_sample_generate(
+        params, pd, mesh, CFG, N_NEW, key, k=3, temperature=1.2,
+        top_k=16, drafter="ngram", tree_branch=2))
+    np.testing.assert_array_equal(got, base)
+
+
+@pytest.mark.slow
+def test_tree_sampled_identity_sharded_exhaustive():
+    for dp, tp in ((2, 1), (2, 2)):
+        mesh, params, pd = _setup(dp=dp, tp=tp)
+        key = jax.random.key(3)
+        base = np.asarray(sample_generate(
+            params, pd, mesh, CFG, N_NEW, key, temperature=1.2,
+            top_k=16))
+        for drafter in ("ngram", "shared"):
+            for nb in (2, 4):
+                got = np.asarray(speculative_sample_generate(
+                    params, pd, mesh, CFG, N_NEW, key, k=3,
+                    temperature=1.2, top_k=16, drafter=drafter,
+                    tree_branch=nb))
+                np.testing.assert_array_equal(
+                    got, base, err_msg=str((dp, tp, drafter, nb)))
+
+
+# 99.9% chi-square quantiles, df = 1..15 (two-sample test below)
+_CHI2_999 = [10.828, 13.816, 16.266, 18.467, 20.515, 22.458, 24.322,
+             26.124, 27.877, 29.588, 31.264, 32.909, 34.528, 36.123,
+             37.697]
+
+
+def _two_sample_chi2(a, b):
+    keep = (a + b) >= 10
+    a2 = np.concatenate([a[keep], [a[~keep].sum()]])
+    b2 = np.concatenate([b[keep], [b[~keep].sum()]])
+    nz = (a2 + b2) > 0
+    a2, b2 = a2[nz], b2[nz]
+    k1 = np.sqrt(b2.sum() / a2.sum())
+    k2 = np.sqrt(a2.sum() / b2.sum())
+    stat = float((((k1 * a2 - k2 * b2) ** 2) / (a2 + b2)).sum())
+    return stat, len(a2) - 1
+
+
+def test_tree_rejection_sampling_chi_square_exactness():
+    """Tree-speculated sampled token frequencies vs baseline
+    sample_generate frequencies at matched (temperature, top_p) over
+    DISJOINT seed sets — the distribution-exactness claim tested as a
+    two-sample problem (the bitwise pins above use matched seeds;
+    this would still catch a construction that broke exactness while
+    preserving per-seed reproducibility)."""
+    cfg = TransformerConfig(vocab=11, d_model=16, n_heads=2, d_head=8,
+                            d_ff=32, n_layers=1, max_seq=64,
+                            compute_dtype="float32")
+    mesh = make_model_mesh(dp=1, tp=1, sp=1)
+    params = init_params(jax.random.key(0), cfg, mesh)
+    b, s, n = 16, 6, 12
+    prompts = _put(mesh, _prompts(b, s, seed=8, vocab=11))
+    key = jax.random.key(7)
+    base_toks, tree_toks = [], []
+    for rep in range(2):
+        seeds_a = np.arange(b) + 1000 * rep
+        seeds_b = np.arange(b) + 1000 * rep + 500
+        base = np.asarray(sample_generate(
+            params, prompts, mesh, cfg, n, key, temperature=1.3,
+            top_p=0.9, seeds=seeds_a))
+        tree = np.asarray(speculative_sample_generate(
+            params, prompts, mesh, cfg, n, key, k=3, temperature=1.3,
+            top_p=0.9, seeds=seeds_b, drafter="ngram",
+            tree_branch=2))
+        base_toks.append(base[:, s:].ravel())
+        tree_toks.append(tree[:, s:].ravel())
+    a = np.bincount(np.concatenate(base_toks), minlength=11)
+    bfreq = np.bincount(np.concatenate(tree_toks), minlength=11)
+    stat, df = _two_sample_chi2(a.astype(np.float64),
+                                bfreq.astype(np.float64))
+    assert df >= 1
+    crit = _CHI2_999[df - 1]
+    assert stat < crit, (
+        f"tree-sampled token frequencies diverge from baseline at "
+        f"p<0.001: chi2={stat:.2f} > {crit} (df={df})")
+
+
+# -- the sideways hop is live machinery ------------------------------
+
+def test_tree_sideways_hop_fires_and_improves_accept_length():
+    """With branch count == vocab, the siblings at each depth cover
+    every token, so each primary miss before the window end MUST land
+    sideways — sideways_accepted > 0 and per-pass accepted length
+    strictly beats the chain's (a random-init shared drafter's
+    primary chain is near-noise, so misses abound). This is the test
+    that keeps the tree machinery from passing every identity pin as
+    dead code."""
+    cfg = TransformerConfig(vocab=11, d_model=16, n_heads=2, d_head=8,
+                            d_ff=32, n_layers=2, max_seq=96,
+                            compute_dtype="float32")
+    mesh = make_model_mesh(dp=1, tp=1, sp=1)
+    params = init_params(jax.random.key(0), cfg, mesh)
+    pd = _put(mesh, _prompts(2, 6, seed=9, vocab=11))
+    base = np.asarray(greedy_generate(params, pd, mesh, cfg, 12))
+    _, st_chain = speculative_generate(params, pd, mesh, cfg, 12, k=3,
+                                       drafter="shared",
+                                       return_stats=True)
+    tree, st = speculative_generate(params, pd, mesh, cfg, 12, k=3,
+                                    drafter="shared", tree_branch=11,
+                                    return_stats=True)
+    np.testing.assert_array_equal(np.asarray(tree), base)
+    assert st["sideways_accepted"] > 0
+    assert st["draft_accepted"] == (st["primary_accepted"]
+                                    + st["sideways_accepted"])
+    # full-vocab siblings: a window can only end at full depth or on
+    # a sideways hop, so per row-step accepted length is pinned at
+    # its structural value — and strictly above the chain's
+    assert st["tokens_per_step"] > st_chain["tokens_per_step"]
+
+
+# -- validation ------------------------------------------------------
+
+def test_tree_branch_validation():
+    mesh, params, pd = _setup()
+    with pytest.raises(ValueError, match="tree_branch must be"):
+        speculative_generate(params, pd, mesh, CFG, 4, k=2,
+                             tree_branch=0)
+    with pytest.raises(ValueError, match="draft window"):
+        speculative_generate(params, pd, mesh, CFG, 4, k=1,
+                             tree_branch=2)
+    with pytest.raises(ValueError, match="exceeds"):
+        speculative_generate(params, pd, mesh, CFG, 4, k=2,
+                             tree_branch=62)
+    with pytest.raises(ValueError, match="tree_branch"):
+        Engine(params, mesh, CFG,
+               ServeConfig(speculate_k=3, tree_branch=0))
+    with pytest.raises(ValueError, match="speculate_k"):
+        Engine(params, mesh, CFG,
+               ServeConfig(speculate_k=1, tree_branch=2))
+
+
+# -- engine ≡ single-request generate with trees on ------------------
+
+def _serve_cfg(**over):
+    sv = dict(max_rows=2, block_size=8, n_blocks=32, max_prompt=16,
+              max_new=16, speculate_k=3)
+    sv.update(over)
+    return ServeConfig(**sv)
+
+
+@pytest.mark.slow
+def test_engine_tree_greedy_identity():
+    """Both zero-cost drafters at b=2, one baseline pair (tier-1
+    keeps engine coverage of both drafters via the sharded/chaos
+    tests — default ngram — and the suffix mixed-traffic audit)."""
+    mesh = make_model_mesh(dp=1, tp=1, sp=1)
+    params = init_params(jax.random.key(0), CFG, mesh)
+    rng = np.random.default_rng(11)
+    prompts = [rng.integers(0, CFG.vocab, (n,)).astype(np.int32)
+               for n in (10, 7)]
+    base = [np.asarray(greedy_generate(
+        params, jnp.asarray(p)[None], mesh, CFG, 12))[0, len(p):]
+        for p in prompts]
+    for drafter in ("ngram", "suffix"):
+        eng = Engine(params, mesh, CFG,
+                     _serve_cfg(tree_branch=2, drafter=drafter))
+        rids = [eng.submit(p, 12) for p in prompts]
+        eng.run()
+        for rid, b in zip(rids, base):
+            np.testing.assert_array_equal(
+                np.asarray(eng.queue.done[rid].tokens), b,
+                err_msg=drafter)
+
+
+def test_engine_tree_sampled_identity_mixed_traffic():
+    """Staggered mixed greedy+sampled traffic through tree verify
+    windows: every request bitwise its single-request counterpart
+    (greedy_generate / sample_generate with the request's own seed
+    stream) — the schedule-invariance audit with trees on."""
+    mesh = make_model_mesh(dp=1, tp=1, sp=1)
+    params = init_params(jax.random.key(0), CFG, mesh)
+    rng = np.random.default_rng(12)
+    reqs = [  # (prompt, n_new, seed, temperature)
+        (rng.integers(0, CFG.vocab, (9,)).astype(np.int32), 10, 3, 0.8),
+        (rng.integers(0, CFG.vocab, (6,)).astype(np.int32), 12, 0, 0.0),
+        (rng.integers(0, CFG.vocab, (11,)).astype(np.int32), 8, 7, 1.1),
+    ]
+    base = []
+    for p, n, sd, T in reqs:
+        if T > 0:
+            out = sample_generate(
+                params, jnp.asarray(p)[None], mesh, CFG, n,
+                jax.random.key(0), temperature=T,
+                seeds=np.asarray([sd], np.int32))
+        else:
+            out = greedy_generate(params, jnp.asarray(p)[None], mesh,
+                                  CFG, n)
+        base.append(np.asarray(out)[0, len(p):])
+    eng = Engine(params, mesh, CFG,
+                 _serve_cfg(tree_branch=2, drafter="suffix",
+                            max_rows=2))
+    # staggered admission: the third request arrives only after the
+    # first completes (max_rows=2 forces queueing either way)
+    rids = [eng.submit(p, n, seed=sd, temperature=T)
+            for p, n, sd, T in reqs]
+    eng.run()
+    for rid, b in zip(rids, base):
+        np.testing.assert_array_equal(
+            np.asarray(eng.queue.done[rid].tokens), b)
+
+
+@pytest.mark.slow
+def test_engine_tree_mixed_quant_containment():
+    """Tree windows on a kv_quant='mixed' engine: the fp co-batch
+    row stays bitwise greedy_generate while an int8 row rides the
+    same tree step (the r10 containment pin, through trees —
+    relocation must move every written arena, scale pages
+    included)."""
+    mesh = make_model_mesh(dp=1, tp=1, sp=1)
+    params = init_params(jax.random.key(0), CFG, mesh)
+    rng = np.random.default_rng(17)
+    p_fp = rng.integers(0, CFG.vocab, (9,)).astype(np.int32)
+    p_q8 = rng.integers(0, CFG.vocab, (8,)).astype(np.int32)
+    base = np.asarray(greedy_generate(
+        params, jnp.asarray(p_fp)[None], mesh, CFG, 10))[0, 9:]
+    eng = Engine(params, mesh, CFG,
+                 _serve_cfg(tree_branch=2, drafter="suffix",
+                            kv_quant="mixed"))
+    r1 = eng.submit(p_fp, 10)
+    r2 = eng.submit(p_q8, 10, quant=True)
+    eng.run()
+    np.testing.assert_array_equal(
+        np.asarray(eng.queue.done[r1].tokens), base)
+    assert len(eng.queue.done[r2].tokens) == 10
+
+
+def test_engine_tree_identity_sharded():
+    dp, tp = 2, 2
+    mesh = make_model_mesh(dp=dp, tp=tp, sp=1)
+    params = init_params(jax.random.key(0), CFG, mesh)
+    rng = np.random.default_rng(13)
+    prompts = [rng.integers(0, CFG.vocab, (8,)).astype(np.int32)
+               for _ in range(2)]
+    base = [np.asarray(greedy_generate(
+        params, _put(mesh, np.broadcast_to(p, (dp, 8)).copy()), mesh,
+        CFG, 10))[0, 8:] for p in prompts]
+    eng = Engine(params, mesh, CFG,
+                 _serve_cfg(tree_branch=2, max_rows=dp))
+    rids = [eng.submit(p, 10) for p in prompts]
+    eng.run()
+    for rid, b in zip(rids, base):
+        np.testing.assert_array_equal(
+            np.asarray(eng.queue.done[rid].tokens), b)
+
+
+@pytest.mark.slow
+def test_engine_tree_identity_sharded_exhaustive():
+    """dp-only mesh + wider branch counts (the tier-1 tests keep the
+    dp×tp mesh and b=2)."""
+    for dp, tp in ((2, 1),):
+        mesh = make_model_mesh(dp=dp, tp=tp, sp=1)
+        params = init_params(jax.random.key(0), CFG, mesh)
+        rng = np.random.default_rng(13)
+        prompts = [rng.integers(0, CFG.vocab, (8,)).astype(np.int32)
+                   for _ in range(2)]
+        base = [np.asarray(greedy_generate(
+            params, _put(mesh, np.broadcast_to(p, (dp, 8)).copy()),
+            mesh, CFG, 10))[0, 8:] for p in prompts]
+        for nb in (2, 3):
+            eng = Engine(params, mesh, CFG,
+                         _serve_cfg(tree_branch=nb, max_rows=dp))
+            rids = [eng.submit(p, 10) for p in prompts]
+            eng.run()
+            for rid, b in zip(rids, base):
+                np.testing.assert_array_equal(
+                    np.asarray(eng.queue.done[rid].tokens), b,
+                    err_msg=str((dp, tp, nb)))
+
+
+# -- chaos: tree sites -----------------------------------------------
+
+def test_tree_build_die_site():
+    mesh, params, pd = _setup()
+    plan = chaos.FaultPlan(
+        schedule={"die:decode.spec.tree.build": (0,)})
+    with chaos.inject(plan):
+        with pytest.raises(chaos.InjectedDeath):
+            speculative_generate(params, pd, mesh, CFG, N_NEW, k=3,
+                                 tree_branch=2)
+        out = speculative_generate(params, pd, mesh, CFG, N_NEW, k=3,
+                                   tree_branch=2)
+    assert np.asarray(out).shape == (2, 18)
+    assert plan.fired("die", "decode.spec.tree.build") == 1
+    # the chain path never reaches the tree build boundary
+    with chaos.inject(chaos.FaultPlan(
+            schedule={"die:decode.spec.tree.build": (0,)})) as p2:
+        speculative_generate(params, pd, mesh, CFG, N_NEW, k=3)
+    assert p2.fired("die", "decode.spec.tree.build") == 0
+
+
+def test_tree_verify_stats_sdc_skews_counters_not_tokens():
+    """SDC at the tree stats readback: committed tokens are bitwise
+    untouched (tokens never pass through the stats vector), telemetry
+    stays JSON-serializable even when skewed."""
+    import json
+    mesh, params, pd = _setup()
+    base = np.asarray(speculative_generate(params, pd, mesh, CFG,
+                                           N_NEW, k=3, tree_branch=2))
+    plan = chaos.FaultPlan(
+        schedule={"corrupt:decode.spec.tree.verify": (0,)})
+    with chaos.inject(plan):
+        out, st = speculative_generate(params, pd, mesh, CFG, N_NEW,
+                                       k=3, tree_branch=2,
+                                       return_stats=True)
+    assert plan.fired("corrupt", "decode.spec.tree.verify") == 1
+    np.testing.assert_array_equal(np.asarray(out), base)
+    json.dumps(st)
+
+
+def test_tree_clean_armed_run_bit_identical():
+    """An armed plan whose probes all fire as delays leaves
+    tree-speculated output bitwise the unarmed run — the standing
+    clean-armed pin, extended to the tree sites."""
+    mesh, params, pd = _setup()
+    base = np.asarray(speculative_generate(params, pd, mesh, CFG,
+                                           N_NEW, k=3, tree_branch=2))
+    plan = chaos.FaultPlan(rates={"delay:decode.spec.tree.*": 1.0},
+                           delay_s=0.001)
+    with chaos.inject(plan):
+        out = speculative_generate(params, pd, mesh, CFG, N_NEW, k=3,
+                                   tree_branch=2)
+    np.testing.assert_array_equal(np.asarray(out), base)
+    assert plan.fired("delay", "decode.spec.tree.build") == 1
+
+
+def test_serve_tree_fork_die_reissues_to_survivor():
+    """Engine dies at the serve.spec.tree.fork boundary mid-serve:
+    leases expire and a second engine pointed at the same queue
+    completes every request token-identically — the dead-engine
+    drill through the tree path."""
+    mesh = make_model_mesh(dp=1, tp=1, sp=1)
+    params = init_params(jax.random.key(0), CFG, mesh)
+    rng = np.random.default_rng(15)
+    prompts = [rng.integers(0, CFG.vocab, (8,)).astype(np.int32)
+               for _ in range(2)]
+    base = [np.asarray(greedy_generate(
+        params, jnp.asarray(p)[None], mesh, CFG, 10))[0, 8:]
+        for p in prompts]
+    q = RequestQueue(lease_s=0.05)
+    sv = _serve_cfg(tree_branch=2, drafter="suffix")
+    eng1 = Engine(params, mesh, CFG, sv, queue=q)
+    rids = [eng1.submit(p, 10) for p in prompts]
+    plan = chaos.FaultPlan(
+        schedule={"die:serve.spec.tree.fork": (2,)})
+    with chaos.inject(plan):
+        with pytest.raises(chaos.InjectedDeath):
+            eng1.run()
+    assert plan.fired("die", "serve.spec.tree.fork") == 1
+    time.sleep(0.06)          # leases expire
+    eng2 = Engine(params, mesh, CFG, sv, queue=q)
+    eng2.run()
+    for rid, b in zip(rids, base):
+        np.testing.assert_array_equal(
+            np.asarray(q.done[rid].tokens), b)
+
+
+def test_serve_tree_fork_delay_site_clean():
+    mesh = make_model_mesh(dp=1, tp=1, sp=1)
+    params = init_params(jax.random.key(0), CFG, mesh)
+    rng = np.random.default_rng(16)
+    p = rng.integers(0, CFG.vocab, (8,)).astype(np.int32)
+    base = np.asarray(greedy_generate(
+        params, jnp.asarray(p)[None], mesh, CFG, 10))[0, 8:]
+    eng = Engine(params, mesh, CFG,
+                 _serve_cfg(tree_branch=2))
+    plan = chaos.FaultPlan(rates={"delay:serve.spec.tree.fork": 1.0},
+                           delay_s=0.001)
+    with chaos.inject(plan):
+        rid = eng.submit(p, 10)
+        eng.run()
+    assert plan.fired("delay", "serve.spec.tree.fork") >= 1
+    np.testing.assert_array_equal(
+        np.asarray(eng.queue.done[rid].tokens), base)

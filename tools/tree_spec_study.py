@@ -1,0 +1,326 @@
+"""Round-14 token-tree + on-policy-distillation study driver
+(DECODE.md "Token-tree speculation", ROADMAP item 3's two levers,
+records ``decode_spec_r14.jsonl``).
+
+Protocol — both levers measured on the SAME r7/r8 toy teacher, priced
+against the r10 int8 floor (0.429 ms/tok):
+
+1. **Teacher**: the r7 Markov toy, trunk-only, byte-identical to
+   ``tools/decode_spec_study.py`` (3000 steps → loss ≈ 1.671).
+2. **Leg (b), on-policy self-distillation**: attach the r8 head
+   (quarter depth, rank 256) and distill against the FROZEN trunk
+   twice — once on corpus tokens (the r8 protocol, re-measured as
+   the baseline) and once ON-POLICY (``cfg.draft_on_policy``: the
+   distill loss moves to the model's OWN greedy continuations,
+   refreshed from current params every few steps — the
+   ``--draft-sample`` trainer hook's exact machinery). r8 diagnosed
+   the α gap as distribution shift (on-corpus agree 0.63 vs 0.377 on
+   continuations); this measures whether closing the shift closes
+   the gap.
+3. **Leg (a), token trees**: greedy speculative acceptance per
+   (k ∈ {2,4}) × (tree_branch ∈ {1,2,4}) × drafter ∈ {trained
+   (on-policy head), ngram}, b=1. ``tree_branch=1`` rows ARE the
+   chain baseline (same program). Tree rows carry the per-branch
+   split (``primary_accepted``/``sideways_accepted``/``row_steps``)
+   the expected-accepted-length estimator consumes.
+4. **Price**: ``icikit.bench.decode.cost_model_rows`` at
+   ``bytes_dtype="int8"`` — the same rows ``python -m
+   icikit.bench.decode --cost-model --alpha-from
+   decode_spec_r14.jsonl --bytes-dtype int8`` reproduces — plus one
+   ``kind="verdict"`` row: the best tree projection vs the 15% bar
+   (0.85 × int8 floor) and the on-policy α vs the 0.42 flip
+   condition, honestly recorded either way.
+
+Usage::
+
+    JAX_PLATFORMS=cpu python tools/tree_spec_study.py \
+        --json decode_spec_r14.jsonl [--quick]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+import numpy as np
+
+# runnable as `python tools/tree_spec_study.py` from the repo root
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+# the r7/r8 toy geometry (tools/decode_spec_study.py)
+TOY = dict(vocab=64, d_model=64, n_heads=2, d_head=32, d_ff=256,
+           n_layers=4, max_seq=160, compute_dtype="float32")
+DRAFT_RANK = 256
+DISTILL_LR = 3e-3
+EXIT_LAYER = 1          # quarter depth — the priced route
+ONP_PROMPT = 8          # on-policy continuation prompts (trainer's 8)
+ONP_TOKENS = 48         # continuation length per refresh
+ONP_EVERY = 8           # steps between refreshes
+
+
+def train_teacher(steps: int):
+    """The r7 acceptance-study model, trunk only — byte-identical to
+    decode_spec_study.train_toy."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from icikit.models.transformer import TransformerConfig, init_params
+    from icikit.models.transformer.model import (make_model_mesh,
+                                                 make_train_step)
+    from icikit.models.transformer.train import make_markov_sampler
+
+    cfg = TransformerConfig(**TOY)
+    mesh = make_model_mesh(dp=1, tp=1, sp=1)
+    params = init_params(jax.random.key(0), cfg, mesh)
+    sampler = make_markov_sampler(cfg.vocab, seed=0)
+    _, step = make_train_step(mesh, cfg, optax.adam(3e-3))
+    opt_state = optax.adam(3e-3).init(params)
+    loss = None
+    for s in range(steps):
+        chunk = sampler(s, 16, 64)
+        params, opt_state, loss = step(params, opt_state,
+                                       jnp.asarray(chunk[:, :-1]),
+                                       jnp.asarray(chunk[:, 1:]))
+    final = float(np.asarray(loss))
+    print(f"teacher trained: {steps} steps, loss {final:.4f}",
+          flush=True)
+    return mesh, params, sampler, final
+
+
+def distill_head(mesh, trunk, sampler, steps: int,
+                 on_policy: bool):
+    """Attach a fresh quarter-depth head and distill it against the
+    frozen trunk — on corpus tokens (r8 protocol) or ON-POLICY on the
+    model's own greedy continuations (the round-14 leg: the distill
+    batch is refreshed from current params every ONP_EVERY steps,
+    exactly the trainer's --draft-sample machinery). The param-group
+    split keeps the trunk bitwise the teacher either way."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from icikit.models.transformer import TransformerConfig
+    from icikit.models.transformer.decode import greedy_generate
+    from icikit.models.transformer.draft import init_draft_params
+    from icikit.models.transformer.model import make_train_step
+
+    cfg = TransformerConfig(**TOY, draft_head=True,
+                            draft_layers=EXIT_LAYER,
+                            draft_rank=DRAFT_RANK, draft_kl=0.5,
+                            draft_on_policy=on_policy)
+    params = dict(trunk)
+    params.update(init_draft_params(
+        jax.random.fold_in(jax.random.key(0), 7), cfg,
+        params["w_out"]))
+    tx = optax.multi_transform(
+        {"draft": optax.adam(DISTILL_LR),
+         "frozen": optax.set_to_zero()},
+        lambda p: {k: ("draft" if k.startswith("draft_") else "frozen")
+                   for k in p})
+    _, step = make_train_step(mesh, cfg, tx,
+                              draft_p0=ONP_PROMPT if on_policy else 0)
+    opt_state = tx.init(params)
+    metrics = None
+    draft_batch = None
+    for s in range(steps):
+        chunk = sampler(100000 + s, 16, 64)
+        tok = jnp.asarray(chunk[:, :-1])
+        if on_policy and s % ONP_EVERY == 0:
+            # the model's own continuations of this batch's prompts,
+            # from CURRENT params — the trunk is frozen here, so one
+            # refresh would suffice; the periodic refresh keeps the
+            # protocol identical to the trainer's co-training hook
+            draft_batch = greedy_generate(
+                params, tok[:, :ONP_PROMPT], mesh, cfg, ONP_TOKENS)
+        params, opt_state, _, metrics = step(
+            params, opt_state, tok, jnp.asarray(chunk[:, 1:]),
+            draft_tokens=draft_batch)
+    m = {k: float(np.asarray(v)) for k, v in metrics.items()}
+    for k in trunk:  # the freeze really froze
+        np.testing.assert_array_equal(np.asarray(trunk[k]),
+                                      np.asarray(params[k]))
+    mode = "on-policy" if on_policy else "corpus"
+    print(f"head distilled ({mode}, L_d={EXIT_LAYER}, "
+          f"rank={DRAFT_RANK}, {steps} steps): draft_loss "
+          f"{m['draft_loss']:.4f}, top1_agree "
+          f"{m['draft_top1_agree']:.4f}", flush=True)
+    return cfg, params, m
+
+
+def measure_rows(quick: bool) -> list:
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from icikit.models.transformer import speculative_generate
+
+    teach_steps = 120 if quick else 3000
+    distill_steps = 120 if quick else 3000
+    n_new = 48 if quick else 96
+    ks = (2,) if quick else (2, 4)
+    branches = (1, 2) if quick else (1, 2, 4)
+    mesh, trunk, sampler, final_loss = train_teacher(teach_steps)
+    rows = []
+    heads = {}
+    for on_policy in (False, True):
+        heads[on_policy] = distill_head(mesh, trunk, sampler,
+                                        distill_steps, on_policy)
+    sh = NamedSharding(mesh, P("dp", None))
+    chunk = sampler(2**31 + 1, 1, 8)
+    prompt = jax.device_put(jnp.asarray(chunk[:, :8]), sh)
+
+    def measure(cfg, params, drafter, k, nb):
+        _, st = speculative_generate(
+            params, prompt, mesh, cfg, n_new, k=k,
+            draft_layers=EXIT_LAYER, drafter=drafter,
+            return_stats=True, tree_branch=nb)
+        return st
+
+    # off-policy trained rows: the r8 baseline re-measured on this
+    # session's teacher — context rows (kind="acceptance_offpolicy",
+    # NOT priced: the committed r8 rows already price that route);
+    # what this study prices is the on-policy head and the trees
+    cfg_off, params_off, tm_off = heads[False]
+    for k in ks:
+        st = measure(cfg_off, params_off, "trained", k, 1)
+        rows.append({
+            "kind": "acceptance_offpolicy",
+            "corpus": "markov-order2",
+            "protocol": "r8-posthoc-distill",
+            "drafter": "trained",
+            "train_steps": teach_steps,
+            "distill_steps": distill_steps,
+            "teacher_loss": round(final_loss, 4),
+            "train_draft_top1_agree":
+                round(tm_off["draft_top1_agree"], 4),
+            "n_layers": cfg_off.n_layers,
+            "batch": 1, "k": k, "draft_layers": EXIT_LAYER,
+            "n_new": n_new,
+            "acceptance_rate": round(st["acceptance_rate"], 4),
+            "tokens_per_step": round(st["tokens_per_step"], 4),
+        })
+        print(f"off-policy baseline k={k}: "
+              f"α={st['acceptance_rate']:.3f}", flush=True)
+
+    cfg_on, params_on, tm_on = heads[True]
+    for drafter, cfg, params in (("trained", cfg_on, params_on),
+                                 ("ngram", cfg_on, params_on)):
+        for k in ks:
+            for nb in branches:
+                st = measure(cfg, params, drafter, k, nb)
+                row = {
+                    "kind": "acceptance",
+                    "corpus": "markov-order2",
+                    "protocol": ("r14-onpolicy-distill"
+                                 if drafter == "trained"
+                                 else "r14-tree"),
+                    "drafter": drafter,
+                    "train_steps": teach_steps,
+                    "distill_steps": distill_steps,
+                    "teacher_loss": round(final_loss, 4),
+                    "train_draft_top1_agree":
+                        round(tm_on["draft_top1_agree"], 4),
+                    "n_layers": cfg.n_layers,
+                    "batch": 1, "k": k,
+                    "draft_layers": EXIT_LAYER,
+                    "n_new": n_new,
+                    "tree_branch": nb,
+                    "acceptance_rate":
+                        round(st["acceptance_rate"], 4),
+                    "tokens_per_step":
+                        round(st["tokens_per_step"], 4),
+                }
+                if nb > 1:
+                    row.update(
+                        row_steps=st["row_steps"],
+                        primary_accepted=st["primary_accepted"],
+                        sideways_accepted=st["sideways_accepted"],
+                        sideways_rate=round(st["sideways_rate"], 4))
+                rows.append(row)
+                print(f"acceptance {drafter} k={k} b={nb}: "
+                      f"α={st['acceptance_rate']:.3f} "
+                      f"tok/pass={st['tokens_per_step']:.3f}"
+                      + (f" (sideways {st['sideways_accepted']})"
+                         if nb > 1 else ""), flush=True)
+    return rows
+
+
+def verdict_row(json_path: str, rows: list, proj: list) -> dict:
+    """The numbers the round exists for: (a) the best tree projection
+    vs the 15% bar against the int8 floor, (b) the on-policy α at
+    (k=2, quarter, chain) vs the 0.42 flip condition — both recorded
+    honestly whether they clear or not."""
+    onp = [r for r in rows if r["kind"] == "acceptance"
+           and r["drafter"] == "trained" and r["k"] == 2
+           and r.get("tree_branch", 1) == 1][0]
+    off = [r for r in rows if r["kind"] == "acceptance_offpolicy"
+           and r["k"] == 2][0]
+    best = min(proj, key=lambda r: r["projected_eff_ms_per_token"])
+    floor = best["model_floor_ms_dtype"]
+    eff = best["projected_eff_ms_per_token"]
+    return {
+        "kind": "verdict",
+        "round": 14,
+        "alpha_source": json_path,
+        "bytes_dtype": best["bytes_dtype"],
+        "int8_floor_ms": floor,
+        "alpha_offpolicy_k2_quarter": off["acceptance_rate"],
+        "alpha_onpolicy_k2_quarter": onp["acceptance_rate"],
+        "onpolicy_clears_042": onp["acceptance_rate"] >= 0.42,
+        "best_projection": {
+            "drafter": best["drafter"], "k": best["k"],
+            "tree_branch": best.get("tree_branch", 1),
+            "measured_acceptance": best["measured_acceptance"],
+            "tokens_per_step": best.get("measured_tokens_per_step"),
+            "projected_eff_ms_per_token": eff,
+        },
+        "projected_win_pct": round(100.0 * (1.0 - eff / floor), 2),
+        "route_breaks_even": eff < floor,
+        "route_clears_15pct": eff <= 0.85 * floor,
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--json", dest="json_path",
+                    default="decode_spec_r14.jsonl")
+    ap.add_argument("--quick", action="store_true",
+                    help="CI-sized run (fewer steps/tokens/arms)")
+    args = ap.parse_args(argv)
+
+    rows = measure_rows(args.quick)
+    with open(args.json_path, "a") as f:
+        for r in rows:
+            f.write(json.dumps(r) + "\n")
+
+    # price every measured point through the shared one-command path
+    # (bit-identical to `python -m icikit.bench.decode --cost-model
+    # --alpha-from <file> --bytes-dtype int8`) — the r14 verdict
+    # races the INT8 floor, the best single-token baseline this repo
+    # has built
+    from icikit.bench.decode import cost_model_rows
+    proj = cost_model_rows(args.json_path, preset="base", batch=1,
+                           cache_len=320, alpha_batch=1,
+                           bytes_dtype="int8")
+    verdict = verdict_row(args.json_path, rows, proj)
+    with open(args.json_path, "a") as f:
+        for r in proj + [verdict]:
+            f.write(json.dumps(r) + "\n")
+    for r in proj:
+        print(f"projection {r['drafter']} k={r['k']} "
+              f"b={r.get('tree_branch', 1)}: "
+              f"α={r['measured_acceptance']:.3f} -> "
+              f"{r['projected_eff_ms_per_token']} ms/tok vs int8 "
+              f"floor {r['model_floor_ms_dtype']}", flush=True)
+    print("verdict:", json.dumps(verdict), flush=True)
+    print(f"wrote {len(rows) + len(proj) + 1} rows to "
+          f"{args.json_path}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

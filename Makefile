@@ -5,7 +5,8 @@
 PY ?= python
 
 .PHONY: test test-fast chaos bench native clean sweep scaling northstar \
-	trace-demo check decode-smoke draft-smoke serve-smoke quant-smoke
+	trace-demo check decode-smoke draft-smoke serve-smoke quant-smoke \
+	obs-smoke
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -52,6 +53,27 @@ check:
 	JAX_PLATFORMS=cpu $(PY) tools/quant_lint.py
 	JAX_PLATFORMS=cpu $(PY) tools/chaos_site_lint.py
 	$(PY) tools/tree_accept_lint.py
+	$(PY) tools/obs_catalog_lint.py
+	$(PY) tools/bench_regress.py --self-check serve_r12.jsonl \
+		serve_r15.jsonl decode_spec_r14.jsonl \
+		--verdict /tmp/icikit_bench_regress.json
+
+# request-scoped tracing + anomaly watch, end to end: a tiny Poisson
+# serve session with the trace AND the watch armed — the exported
+# trace must pass the structural checker (async request trees
+# included), hold at least one COMPLETE per-request span tree, and the
+# clean run must verdict healthy with zero obs.alert events
+obs-smoke:
+	rm -f /tmp/icikit_obs_smoke.jsonl
+	JAX_PLATFORMS=cpu \
+	ICIKIT_OBS="trace=/tmp/icikit_obs_smoke_trace.json;metrics=/tmp/icikit_obs_smoke_metrics.json;jsonl=off" \
+	$(PY) -m icikit.bench.serve --preset tiny --rows 2 --requests 8 \
+		--rate 50 --prompt 16 --new-min 4 --new-max 8 --block-size 4 \
+		--prefill-chunk 8 --speculate 3 --mode continuous --seed 0 \
+		--watch --json /tmp/icikit_obs_smoke.jsonl > /dev/null
+	$(PY) -m icikit.obs.check /tmp/icikit_obs_smoke_trace.json
+	$(PY) tools/obs_smoke_check.py /tmp/icikit_obs_smoke_trace.json \
+		/tmp/icikit_obs_smoke.jsonl
 
 # multi-token decode smoke: a tiny CPU speculative decode under an
 # armed obs session — the acceptance counters/spans must flow and the

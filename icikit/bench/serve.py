@@ -144,14 +144,19 @@ def _pcts(xs) -> dict:
 def run_continuous(params, mesh, cfg, serve_cfg, workload,
                    max_retries: int = 2, warm: list | None = None,
                    verify: bool = False, temperature: float = 0.0,
-                   top_k: int = 0, top_p: float = 1.0) -> dict:
+                   top_k: int = 0, top_p: float = 1.0,
+                   watch: bool = False) -> dict:
     """Drive the engine over the arrival trace; returns the record.
     ``verify=True`` re-decodes every completed request through
     single-request ``greedy_generate`` — or, for sampled arms
     (``temperature > 0``), ``sample_generate`` with each request's
     own stream seed — batched by output length, and records the
     token-identity check in the row: the per-arm acceptance bar of
-    the r11/r12 A/Bs."""
+    the r11/r12 A/Bs. ``watch=True`` arms the standard serving
+    anomaly watch (``obs.watch.serve_watch``) over the enabled
+    metrics registry for the timed window and stamps its per-run
+    health verdict into the record (requires armed metrics — a
+    disabled registry records ``health: None``)."""
     from icikit.serve import Engine, ServeConfig  # noqa: F401
     eng = Engine(params, mesh, cfg, serve_cfg)
     # warm the compiles (chunk buckets for both the miss and hit
@@ -170,13 +175,22 @@ def run_continuous(params, mesh, cfg, serve_cfg, workload,
         eng.run()
     assert not eng.queue.failed
     eng.reset_stats()   # keep the warm-up out of occupancy/step figures
+    w = None
+    if obs.metrics() is not None:
+        # arm scoping (the torn-gauge satellite): the warm-up's parting
+        # gauges (occupancy, KV levels) must not read as THIS timed
+        # window's values in a snapshot taken before the first step
+        obs.metrics().clear_gauges("serve.")
+        if watch:
+            from icikit.obs.watch import serve_watch
+            w = serve_watch().attach()
     t0 = time.monotonic()
     rids = [eng.submit(p, n, not_before=t0 + off,
                        max_retries=max_retries, seed=rs,
                        temperature=temperature, top_k=top_k,
                        top_p=top_p)
             for off, p, n, rs in workload]
-    eng.run()
+    eng.run(watch=w)
     makespan = time.monotonic() - t0
     ttft, tpot, qwait, gaps, tokens = [], [], [], [], 0
     dup_ttft = []       # TTFT of repeat arrivals of an earlier prompt
@@ -236,6 +250,10 @@ def run_continuous(params, mesh, cfg, serve_cfg, workload,
         "prefill_tokens_computed": prefix["prefill_tokens"],
         "prefix": prefix,
     }
+    if watch:
+        # per-run health verdict (None = watch asked for but metrics
+        # disarmed — recorded as an explicit blind spot, not dropped)
+        rec["health"] = w.verdict() if w is not None else None
     if verify:
         rec.update(_verify_identity(params, mesh, cfg, eng, workload,
                                     rids, temperature, top_k, top_p))
@@ -304,6 +322,10 @@ def run_static(params, mesh, cfg, rows: int, workload,
 
     from icikit.models.transformer import greedy_generate
     from icikit.models.transformer.decode import sample_generate
+    if obs.metrics() is not None:
+        # same arm scoping as continuous: the previous arm's parting
+        # serve gauges must not survive into this arm's snapshots
+        obs.metrics().clear_gauges("serve.")
     s_prompt = len(workload[0][1])
     batches = [workload[i:i + rows]
                for i in range(0, len(workload), rows)]
@@ -384,7 +406,8 @@ def run_bench(preset: str, rows: int, n_requests: int, rate_rps: float,
               seed_per_request: bool = False, distinct: int = 0,
               inflight_dedup: bool | str = "auto",
               motif: int = 0, model: tuple | None = None,
-              workload: list | None = None) -> list[dict]:
+              workload: list | None = None,
+              watch: bool = False) -> list[dict]:
     """``model=(params, mesh, cfg)`` overrides the preset-constructed
     random-init model (the r12 study serves a Markov-TRAINED toy —
     random init has no confident regime, so low-temperature draws
@@ -485,6 +508,9 @@ def run_bench(preset: str, rows: int, n_requests: int, rate_rps: float,
         "inflight_dedup": (prefix_cache if inflight_dedup == "auto"
                            else bool(inflight_dedup)),
         "motif": motif,
+        # whether request-scoped tracing was armed for this row — the
+        # serve_r15 overhead A/B pairs rows on this key
+        "tracing": obs.tracing() is not None,
         # measured-where-we-ran provenance (the decode-bench rule):
         # CPU rows price the ratio, a v5e session prices the absolute
         "note": ("CPU-measured" if jax.default_backend() == "cpu"
@@ -495,7 +521,7 @@ def run_bench(preset: str, rows: int, n_requests: int, rate_rps: float,
         recs.append({**common, **run_continuous(
             params, mesh, cfg, serve_cfg, workload, warm=warm,
             verify=verify, temperature=temperature, top_k=top_k,
-            top_p=top_p)})
+            top_p=top_p, watch=watch)})
     if mode in ("both", "static"):
         recs.append({**common, **run_static(
             params, mesh, cfg, rows, workload,
@@ -567,6 +593,12 @@ def main(argv=None) -> int:
                          "recomputing) — the r12 A/B knob; 'auto' "
                          "follows --prefix-cache, 'on' without the "
                          "cache is rejected loudly")
+    ap.add_argument("--watch", action="store_true",
+                    help="arm the standard serving anomaly watch "
+                         "(obs.watch.serve_watch) over the timed "
+                         "continuous window and stamp its health "
+                         "verdict into the row (needs armed metrics, "
+                         "e.g. ICIKIT_OBS)")
     ap.add_argument("--motif", type=int, default=0, metavar="M",
                     help="repetitive workload: each prompt is a "
                          "random M-token motif tiled to the prompt "
@@ -617,7 +649,7 @@ def main(argv=None) -> int:
                      args.seed_per_request, args.distinct,
                      {"on": True, "off": False,
                       "auto": "auto"}[args.inflight_dedup],
-                     args.motif)
+                     args.motif, watch=args.watch)
     obs.emit_records(recs)
     if args.json_path:
         # append: record files accumulate across invocations

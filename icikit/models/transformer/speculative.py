@@ -846,6 +846,7 @@ def _run_speculative(params, prompt, mesh, cfg, n_new, k, draft_layers,
     obs.observe("decode.spec.acceptance", acceptance)
     if tree_branch > 1:
         obs.count("decode.spec.tree.draft_accepted", accepted)
+        obs.count("decode.spec.tree.primary", primary)
         obs.count("decode.spec.tree.sideways", sideways)
     if not return_stats:
         return toks

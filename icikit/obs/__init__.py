@@ -18,6 +18,14 @@ disabled-by-default:
 - **metrics** (:mod:`icikit.obs.metrics`) — counters / gauges /
   histograms (``collective.bytes``, ``scheduler.reissues``,
   ``train.step_ms`` p50/p99), snapshotted into bench reports.
+- **request traces** (:mod:`icikit.obs.trace_ctx`) — one async-span
+  tree per serving request (trace id minted at submit, carried across
+  lease reissue with an explicit ``reissued_from`` edge), exported in
+  the same Chrome trace on ``(cat, id)`` tracks.
+- **anomaly watch** (:mod:`icikit.obs.watch`) — windowed detectors
+  over the metrics stream (SLO burn rate, acceptance drop, KV
+  watermarks, zero-rate alarms) emitting ``obs.alert`` events and a
+  per-run health verdict. See docs/OBSERVABILITY.md.
 
 Zero-overhead contract: with nothing armed, every probe
 (``emit``/``span``/``count``/``observe``) is one module-global read
@@ -49,7 +57,9 @@ import time
 
 from icikit.obs import chrome
 from icikit.obs import metrics as _metrics_mod
+from icikit.obs import trace_ctx  # noqa: F401
 from icikit.obs import tracer as _tracer_mod
+from icikit.obs import watch  # noqa: F401
 from icikit.obs.bus import (  # noqa: F401
     FileSink,
     JsonlSink,

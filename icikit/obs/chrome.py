@@ -30,17 +30,24 @@ def to_chrome(events: list) -> dict:
 
 def close_dangling(events: list) -> list:
     """Synthetic ``E`` events for every ``B`` no thread ever closed —
-    in LIFO order per thread, stamped ``closed_by: "export"``.
+    in LIFO order per thread, stamped ``closed_by: "export"`` — plus
+    synthetic ``e`` closes for every dangling ASYNC ``b`` span (spans
+    keyed by ``(cat, id)``, the request-scoped trees).
 
     A worker the scheduler abandoned mid-span (a hung straggler whose
     join timed out — a scenario the farm is *designed* to survive) is
-    still inside its region at export time; without these closes the
-    export of a healthy healed run fails the structural validator.
-    Timestamps reuse the thread's last seen ``ts`` so per-thread
-    monotonicity holds.
+    still inside its region at export time, and so is a request still
+    queued or leased when an engine run is cut off at ``max_steps``;
+    without these closes the export of a healthy healed run fails the
+    structural validator. Sync closes reuse the thread's last seen
+    ``ts``; async closes are stamped at the trace's global last ``ts``
+    (an async pair may straddle threads, so only the global frontier
+    is guaranteed not to violate any thread's monotonicity).
     """
     stacks: dict[tuple, list] = {}
     last_ts: dict[tuple, float] = {}
+    astacks: dict[tuple, list] = {}   # (cat, id) -> [(name, pid, tid)]
+    max_ts = 0
     for ev in events:
         if not isinstance(ev, dict):
             continue
@@ -48,17 +55,34 @@ def close_dangling(events: list) -> list:
         ts = ev.get("ts")
         if isinstance(ts, (int, float)) and not isinstance(ts, bool):
             last_ts[key] = ts
-        if ev.get("ph") == "B":
+            if ts > max_ts:
+                max_ts = ts
+        ph = ev.get("ph")
+        if ph == "B":
             stacks.setdefault(key, []).append(ev.get("name"))
-        elif ev.get("ph") == "E":
+        elif ph == "E":
             if stacks.get(key):
                 stacks[key].pop()
+        elif ph == "b":
+            akey = (ev.get("cat"), ev.get("id"))
+            astacks.setdefault(akey, []).append(
+                (ev.get("name"), ev.get("pid"), ev.get("tid")))
+        elif ph == "e":
+            akey = (ev.get("cat"), ev.get("id"))
+            if astacks.get(akey):
+                astacks[akey].pop()
     closes = []
     for key, stack in sorted(stacks.items(), key=repr):
         for name in reversed(stack):
             closes.append({
                 "ph": "E", "name": name, "pid": key[0], "tid": key[1],
                 "ts": last_ts.get(key, 0),
+                "args": {"closed_by": "export"}})
+    for akey, stack in sorted(astacks.items(), key=repr):
+        for name, pid, tid in reversed(stack):
+            closes.append({
+                "ph": "e", "name": name, "cat": akey[0], "id": akey[1],
+                "pid": pid, "tid": tid, "ts": max_ts,
                 "args": {"closed_by": "export"}})
     return closes
 
@@ -85,6 +109,11 @@ def validate(trace) -> list[str]:
     - ``B``/``E`` pairs balance per (pid, tid) and match LIFO (an ``E``
       naming a different span than the innermost open ``B`` is a
       nesting violation);
+    - ASYNC ``b``/``e`` pairs carry a ``cat`` and an ``id`` and
+      balance per (cat, id) LIFO — threads do NOT scope them, which is
+      exactly why the request-scoped trees use them: a span may open
+      on one engine's track and close on another's (``n`` async
+      instants need the same keys but no pairing);
     - ``ts`` is numeric and monotonic (non-decreasing) per (pid, tid)
       across timestamped events;
     - ``X`` complete events carry a non-negative ``dur``.
@@ -112,6 +141,7 @@ def validate(trace) -> list[str]:
         return [f"trace must be a dict or list, got {type(trace).__name__}"]
 
     stacks: dict[tuple, list] = {}    # (pid, tid) -> open B names
+    astacks: dict[tuple, list] = {}   # (cat, id) -> open b names
     last_ts: dict[tuple, float] = {}  # (pid, tid) -> last seen ts
     for i, ev in enumerate(events):
         if not isinstance(ev, dict):
@@ -148,6 +178,29 @@ def validate(trace) -> list[str]:
                 problems.append(
                     f"event {i}: E {name!r} closes B {opened!r} on tid "
                     f"{key[1]} (nesting violation)")
+        elif ph in ("b", "e", "n"):
+            cat, aid = ev.get("cat"), ev.get("id")
+            if not isinstance(cat, str) or aid is None:
+                problems.append(
+                    f"event {i}: async {ph} {ev.get('name')!r} "
+                    f"missing cat/id (cat={cat!r}, id={aid!r})")
+                continue
+            akey = (cat, aid)
+            if ph == "b":
+                astacks.setdefault(akey, []).append(ev.get("name"))
+            elif ph == "e":
+                astack = astacks.get(akey)
+                if not astack:
+                    problems.append(
+                        f"event {i}: e {ev.get('name')!r} on async "
+                        f"{akey} with no open b")
+                    continue
+                opened = astack.pop()
+                name = ev.get("name")
+                if name is not None and name != opened:
+                    problems.append(
+                        f"event {i}: e {name!r} closes b {opened!r} "
+                        f"on async {akey} (nesting violation)")
         elif ph == "X":
             dur = ev.get("dur")
             if not isinstance(dur, (int, float)) or dur < 0:
@@ -157,6 +210,11 @@ def validate(trace) -> list[str]:
             problems.append(
                 f"tid {tid}: {len(stack)} unclosed B event(s): "
                 + ", ".join(repr(n) for n in stack))
+    for akey, astack in astacks.items():
+        if astack:
+            problems.append(
+                f"async {akey}: {len(astack)} unclosed b event(s): "
+                + ", ".join(repr(n) for n in astack))
     return problems
 
 

@@ -62,7 +62,7 @@ class Histogram:
     """
 
     __slots__ = ("count", "total", "min", "max", "_sample", "_stride",
-                 "_seen", "_lock", "_cap")
+                 "_seen", "_lock", "_cap", "_over")
 
     def __init__(self, cap: int = 4096):
         self.count = 0
@@ -73,6 +73,7 @@ class Histogram:
         self._stride = 1
         self._seen = 0
         self._cap = cap
+        self._over: dict = {}   # threshold -> observations above it
         self._lock = threading.Lock()
 
     def observe(self, v) -> None:
@@ -84,6 +85,9 @@ class Histogram:
                 self.min = v
             if self.max is None or v > self.max:
                 self.max = v
+            for t in self._over:
+                if v > t:
+                    self._over[t] += 1
             self._seen += 1
             if self._seen >= self._stride:
                 self._seen = 0
@@ -92,24 +96,49 @@ class Histogram:
                     self._sample = self._sample[::2]
                     self._stride *= 2
 
+    def track_over(self, threshold: float) -> None:
+        """Arm an exact above-``threshold`` observation count (the SLO
+        burn-rate numerator — a windowed violation *fraction* cannot be
+        recovered from decimated percentiles, so the watch layer
+        registers its thresholds up front and the histogram counts
+        crossings at observe time: one compare per armed threshold).
+        Idempotent; counts observations from arming onward."""
+        with self._lock:
+            self._over.setdefault(float(threshold), 0)
+
     def percentile(self, q: float) -> float | None:
         """Nearest-rank percentile over the retained sample (q in
         [0, 100])."""
         with self._lock:
-            if not self._sample:
-                return None
             s = sorted(self._sample)
+        return self._pct(s, q)
+
+    @staticmethod
+    def _pct(s: list, q: float):
+        if not s:
+            return None
         idx = min(len(s) - 1, max(0, round(q / 100.0 * (len(s) - 1))))
         return s[idx]
 
     def summary(self) -> dict:
-        p50, p99 = self.percentile(50), self.percentile(99)
-        return {
-            "count": self.count, "sum": self.total,
-            "min": self.min, "max": self.max,
-            "mean": (self.total / self.count) if self.count else None,
-            "p50": p50, "p99": p99,
+        # one lock-scoped copy of EVERY field: the watch layer
+        # snapshots mid-run against concurrent engine-thread observes,
+        # and a count read in one instant with a sum read in the next
+        # is a torn record (mean drifts, burn rates go negative)
+        with self._lock:
+            count, total = self.count, self.total
+            mn, mx = self.min, self.max
+            sample = sorted(self._sample)
+            over = dict(self._over)
+        out = {
+            "count": count, "sum": total,
+            "min": mn, "max": mx,
+            "mean": (total / count) if count else None,
+            "p50": self._pct(sample, 50), "p99": self._pct(sample, 99),
         }
+        if over:
+            out["over"] = {str(t): n for t, n in sorted(over.items())}
+        return out
 
 
 class Registry:
@@ -141,7 +170,11 @@ class Registry:
         return self._get(self._histograms, name, Histogram)
 
     def snapshot(self) -> dict:
-        """JSON-safe view of every metric, for record files."""
+        """JSON-safe view of every metric, for record files. Safe
+        against concurrent emits: the table copy is lock-scoped here
+        and every histogram summary is lock-scoped in
+        :meth:`Histogram.summary` (counter/gauge values are single
+        atomic reads)."""
         with self._lock:
             counters = dict(self._counters)
             gauges = dict(self._gauges)
@@ -152,6 +185,17 @@ class Registry:
             "histograms": {k: h.summary()
                            for k, h in sorted(histograms.items())},
         }
+
+    def clear_gauges(self, prefix: str = "") -> None:
+        """Drop every gauge whose name starts with ``prefix``. Gauges
+        are last-written values: a bench arm that never writes (say)
+        ``serve.occupancy_rows`` would otherwise snapshot the PREVIOUS
+        arm's parting value into its own record — arms call this at
+        their timed-window start so a stale gauge reads as absent, not
+        as a plausible number."""
+        with self._lock:
+            for k in [k for k in self._gauges if k.startswith(prefix)]:
+                del self._gauges[k]
 
 
 # -- module-level fast-path helpers ---------------------------------

@@ -1,0 +1,220 @@
+"""Request-scoped trace context: one causal span tree per request.
+
+The serving stack's thread spans (``serve.engine.step``,
+``serve.prefill.chunk``) answer "what was this engine doing at t" —
+they cannot answer "what happened to request r42": a request's life
+crosses threads (submitted by a client thread, served by an engine
+loop, possibly reissued to a *different* engine after a lease reap),
+and one engine step belongs to every co-batched request at once, so a
+thread-scoped tree has no row for "the request". This module is the
+Dapper-style answer built on Chrome ASYNC events:
+
+- a **trace id** is minted at ``RequestQueue.submit`` and rides the
+  :class:`Request` for its whole life (``req.trace``);
+- every lifecycle edge lands as an async span or instant keyed by
+  ``(cat="serve.req", id=trace_id)`` — async pairs match by id, NOT by
+  thread, so a span opened under one engine's track legally closes
+  under another's (the reissue/handoff case the structural validator
+  covers via its b/e discipline);
+- the tree shape is ``serve.req`` (root, submit → terminal) holding
+  alternating ``serve.req.queued`` (arrival/requeue → claim) and
+  ``serve.req.attempt`` (claim → complete/fail/preempt/reap) segments;
+  inside an attempt: ``serve.req.prefill.chunk`` spans, and instants
+  for admission, first token, per-step batch participation (with the
+  accepted-token and tree primary/sideways stats), CoW forks, dedup
+  attaches, quarantine, retry, preemption, reissue;
+- a **lease reap closes what the dead engine left open**
+  (:meth:`TraceCtx.abandon` stamps ``closed_by: lease_reaped``) and
+  records the abandoned claim generation, so the NEXT attempt opens
+  with an explicit ``reissued_from`` arg — one request, one tree, a
+  visible edge where the engines handed off, no orphan spans.
+
+Discipline (shared with every obs probe): with no tracer armed every
+method is one module-global read plus a ``None`` check — no
+allocation, no clock read — and trace emission never influences
+tokens (the tracing-on ≡ tracing-off bitwise pin in
+``tests/test_trace_ctx.py``). Mutation is fenced by claim generation
+exactly like the queue's lease stamps: a stalled engine whose request
+was reaped and reissued carries a stale ``seq`` and its late span
+calls become no-ops instead of corrupting the live claimant's tree.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+
+from icikit.obs import tracer as _tracer
+
+CAT = "serve.req"
+
+_IDS = itertools.count()
+
+
+def mint(rid: str) -> "TraceCtx":
+    """A fresh context for one request (called by ``submit``; the id is
+    process-unique — rids restart per queue, trace ids never)."""
+    return TraceCtx(rid)
+
+
+class TraceCtx:
+    """Per-request async-span tree state, carried on the Request.
+
+    ``seq``-stamped methods follow the queue's claim-generation fence:
+    ``begin_attempt(seq)`` records the live generation; a later call
+    stamped with any other generation is a no-op (``seq=None`` trusts
+    the caller — the queue's own lifecycle edges, which are already
+    behind its ``_lease_live`` check).
+    """
+
+    __slots__ = ("trace_id", "rid", "_open", "_seq", "_reissued_from",
+                 "_lock")
+
+    def __init__(self, rid: str):
+        self.trace_id = f"req-{next(_IDS)}"
+        self.rid = rid
+        self._open: list = []       # open async span names, LIFO
+        self._seq = None            # live claim generation
+        self._reissued_from = None  # claim seq abandoned by a reap
+        # fences the check-then-act window: without it a stale engine
+        # that passed _live() could stall (GIL release inside an XLA
+        # compile), lose its lease, and land its event in the
+        # REISSUED attempt's tree after abandon() already ran — the
+        # disabled path never touches the lock
+        self._lock = threading.Lock()
+
+    # -- fenced primitives -------------------------------------------
+
+    def _live(self, seq) -> bool:
+        return seq is None or seq == self._seq
+
+    def open(self, name: str, seq=None, **attrs) -> None:
+        tb = _tracer._TRACE
+        if tb is None:
+            return
+        with self._lock:
+            if not self._live(seq):
+                return
+            attrs["rid"] = self.rid
+            tb.async_event("b", name, CAT, self.trace_id, attrs)
+            self._open.append(name)
+
+    def close(self, name: str, seq=None, **attrs) -> None:
+        """Close ``name``, closing through any spans still nested in it
+        (their ``e`` events are stamped ``closed_by: name`` — LIFO, so
+        the structural validator stays satisfied even when a terminal
+        edge arrives while an inner span is open)."""
+        tb = _tracer._TRACE
+        if tb is None:
+            return
+        with self._lock:
+            if not self._live(seq) or name not in self._open:
+                return
+            while self._open:
+                top = self._open.pop()
+                if top == name:
+                    if attrs:
+                        attrs["rid"] = self.rid
+                    tb.async_event("e", top, CAT, self.trace_id,
+                                   attrs or None)
+                    return
+                tb.async_event("e", top, CAT, self.trace_id,
+                               {"closed_by": name})
+
+    def instant(self, name: str, seq=None, **attrs) -> None:
+        tb = _tracer._TRACE
+        if tb is None:
+            return
+        with self._lock:
+            if not self._live(seq):
+                return
+            attrs["rid"] = self.rid
+            tb.async_event("n", name, CAT, self.trace_id, attrs)
+
+    def span(self, name: str, seq=None, **attrs):
+        """Context-manager form for strictly scoped regions (prefill
+        chunks); the shared no-op singleton when tracing is off or the
+        caller's claim is stale."""
+        if _tracer._TRACE is None or not self._live(seq):
+            return _tracer.NOOP_SPAN
+        return _CtxSpan(self, name, seq, attrs)
+
+    # -- lifecycle edges (called by scheduler + engine) --------------
+
+    def begin_attempt(self, seq: int, **attrs) -> None:
+        """Open an attempt segment under claim generation ``seq``; when
+        the previous segment ended in a lease reap, the new segment
+        carries the explicit ``reissued_from`` edge."""
+        with self._lock:
+            self._seq = seq
+            if self._reissued_from is not None:
+                reissued = self._reissued_from
+                self._reissued_from = None
+            else:
+                reissued = None
+        if _tracer._TRACE is None:
+            return
+        # "claim_seq", not "seq": the bare name is the fence parameter
+        # on every ctx method and must stay out of **attrs
+        attrs["claim_seq"] = seq
+        if reissued is not None:
+            attrs["reissued_from"] = reissued
+        self.open("serve.req.attempt", **attrs)
+
+    def end_attempt(self, seq=None, **attrs) -> None:
+        self.close("serve.req.attempt", seq=seq, **attrs)
+
+    def abandon(self, reason: str, seq: int | None = None) -> None:
+        """Close every open span ABOVE the ``serve.req`` root (LIFO,
+        stamped ``closed_by: reason``) — the reaper's move when a
+        lease expires: the dead engine can no longer close what it
+        opened, and the next attempt must start from a clean segment
+        stack, but the request itself is still alive (that is the
+        point of reissue), so the root span survives the reap.
+        Records the abandoned claim generation for the
+        ``reissued_from`` edge, and invalidates the generation so the
+        dead engine's late span calls fence out."""
+        with self._lock:
+            if seq is not None:
+                self._reissued_from = seq
+            self._seq = None
+            tb = _tracer._TRACE
+            if tb is None:
+                del self._open[1 if self._open[:1] == ["serve.req"]
+                               else 0:]
+                return
+            while self._open and self._open[-1] != "serve.req":
+                top = self._open.pop()
+                tb.async_event("e", top, CAT, self.trace_id,
+                               {"closed_by": reason})
+
+
+class _CtxSpan:
+    __slots__ = ("_ctx", "_name", "_seq", "_attrs")
+
+    def __init__(self, ctx: TraceCtx, name: str, seq, attrs: dict):
+        self._ctx = ctx
+        self._name = name
+        self._seq = seq
+        self._attrs = attrs
+
+    def __enter__(self):
+        self._ctx.open(self._name, seq=self._seq, **self._attrs)
+        return self
+
+    def __exit__(self, *exc):
+        self._ctx.close(self._name, seq=self._seq)
+        return False
+
+
+def request_trees(events: list) -> dict:
+    """Group a trace's ``serve.req`` async events by trace id —
+    ``{trace_id: [events...]}`` in stream order. The assertion helper
+    the continuity tests (and ``tools/obs_smoke_check.py``) use to ask
+    "how many request trees, and is each one whole?"."""
+    trees: dict = {}
+    for ev in events:
+        if isinstance(ev, dict) and ev.get("cat") == CAT \
+                and ev.get("ph") in ("b", "e", "n"):
+            trees.setdefault(ev.get("id"), []).append(ev)
+    return trees

@@ -110,6 +110,26 @@ class TraceBuffer:
                 "tid": tid, "ts": _now_us(),
                 "s": "t", "args": dict(attrs)})
 
+    def async_event(self, ph: str, name: str, cat: str, aid: str,
+                    attrs: dict | None = None) -> None:
+        """One Chrome ASYNC event (``ph`` in ``b``/``e``/``n``): a span
+        keyed by ``(cat, id)`` instead of by thread, so it may open on
+        one thread (or synthetic engine track) and close on another —
+        the request-scoped tracing primitive (``icikit.obs.trace_ctx``).
+        Perfetto groups all events of one ``(cat, id)`` into one track;
+        the structural validator pairs ``b``/``e`` per ``(cat, id)``
+        LIFO (``icikit.obs.chrome``)."""
+        tid = self._tid()
+        ev = {"ph": ph, "name": name, "cat": cat, "id": aid,
+              "pid": self.pid, "tid": tid, "ts": _now_us()}
+        if attrs:
+            ev["args"] = dict(attrs)
+        # lock-free append: list.append is atomic under the GIL and
+        # async events carry no cross-event nesting state (pairing is
+        # by (cat, id) at validate time) — this is the serving engine's
+        # per-step hot path, measured in tools/trace_overhead_study.py
+        self.events.append(ev)
+
     def snapshot(self) -> list:
         with self._lock:
             return list(self.events)

@@ -1,0 +1,319 @@
+"""Metrics anomaly watch: windowed detectors over the live registry.
+
+The metrics registry records *what happened*; nothing in the stack
+says *whether that is healthy*. This layer closes the loop: a
+:class:`Watch` polls the registry mid-run (lock-scoped snapshots —
+safe against concurrent engine-thread emits), differences consecutive
+snapshots into **windows**, runs pluggable :class:`Watcher`\\ s over
+each window, emits every finding as a structured ``obs.alert`` event
+on the bus, and renders a per-run health **verdict** that
+``bench.serve`` stamps into its records and the chaos soaks can gate
+on. The "Cores that don't count" posture applies to telemetry too:
+the serve counters are *validated* against expectations here, not
+assumed healthy because they exist.
+
+Detectors (the serve catalog — docs/OBSERVABILITY.md):
+
+- :class:`SloBurnRate` — windowed SLO violation fraction on a latency
+  histogram (``serve.ttft_ms`` / ``serve.tpot_ms`` /
+  ``serve.max_gap_ms``). The numerator is an exact above-threshold
+  count the histogram maintains from arming (``Histogram.track_over``)
+  — decimated percentiles cannot give a violation *fraction*.
+- :class:`AcceptanceDrop` — windowed draft-acceptance ratio for the
+  speculation route (``serve.spec.draft_accepted`` over
+  ``serve.spec.draft_proposed``) under a floor: a drafter gone cold
+  silently turns every verify window into pure overhead.
+- :class:`GaugeWatermark` — high/low watermarks on gauges
+  (``serve.kv.fragmentation`` high, ``serve.kv.occupancy`` high,
+  ``serve.occupancy_rows`` low at saturation).
+- :class:`RateAlarm` — windowed counter-rate alarms where the healthy
+  rate is (near) zero: duplicate commits, integrity failures,
+  quarantined pages, reissues.
+
+Zero-overhead contract: the watch only costs when polled, and polling
+a disabled registry is a no-op; the one hot-path addition is the
+armed over-threshold compare inside ``Histogram.observe`` (nothing
+when no threshold is armed, i.e. always nothing unless a Watch is).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+
+from icikit.obs import bus as _bus
+from icikit.obs import metrics as _metrics
+
+
+@dataclass
+class Alert:
+    """One detector finding over one window."""
+
+    watch: str              # detector name ("slo_burn[serve.ttft_ms]")
+    metric: str             # the metric that tripped
+    value: float            # observed value (burn rate, ratio, level)
+    threshold: float        # the configured bound it crossed
+    severity: str = "warn"
+    detail: str = ""
+
+    def to_event(self) -> dict:
+        return {"watch": self.watch, "metric": self.metric,
+                "value": self.value, "threshold": self.threshold,
+                "severity": self.severity, "detail": self.detail}
+
+
+class Watcher:
+    """Detector interface: ``check(window, snap)`` returns alerts for
+    ONE polling window. ``window`` carries deltas (counters, histogram
+    count/sum/over) plus current gauge levels and the window's
+    wall-span; ``snap`` is the full cumulative snapshot for detectors
+    that want run-so-far context. ``arm(registry)`` runs once at
+    attach — the hook over-threshold detectors use to register their
+    crossings before traffic flows."""
+
+    name = "watcher"
+
+    def arm(self, registry) -> None:
+        pass
+
+    def check(self, window: dict, snap: dict) -> list:
+        raise NotImplementedError  # pragma: no cover - interface
+
+
+class SloBurnRate(Watcher):
+    """Windowed SLO burn: fraction of window observations above
+    ``threshold`` exceeding ``budget`` (with at least ``min_count``
+    observations in the window, so an idle window cannot alarm on one
+    straggler)."""
+
+    def __init__(self, metric: str, threshold: float,
+                 budget: float = 0.25, min_count: int = 8):
+        self.metric = metric
+        self.threshold = float(threshold)
+        self.budget = budget
+        self.min_count = min_count
+        self.name = f"slo_burn[{metric}]"
+
+    def arm(self, registry) -> None:
+        registry.histogram(self.metric).track_over(self.threshold)
+
+    def check(self, window: dict, snap: dict) -> list:
+        h = window["histograms"].get(self.metric)
+        if h is None or h["count"] < self.min_count:
+            return []
+        burn = h["over"].get(str(self.threshold), 0) / h["count"]
+        if burn <= self.budget:
+            return []
+        return [Alert(self.name, self.metric, round(burn, 4),
+                      self.budget,
+                      detail=f"{h['count']} obs in window, SLO "
+                             f"{self.threshold}")]
+
+
+class AcceptanceDrop(Watcher):
+    """Windowed draft-acceptance ratio under ``floor`` (speculation
+    gone cold). Windows offering fewer than ``min_proposed`` draft
+    positions are skipped — the ratio is meaningless at low volume,
+    and a non-speculative run never proposes at all."""
+
+    def __init__(self, floor: float = 0.005, min_proposed: int = 64,
+                 accepted: str = "serve.spec.draft_accepted",
+                 proposed: str = "serve.spec.draft_proposed"):
+        self.floor = floor
+        self.min_proposed = min_proposed
+        self.accepted = accepted
+        self.proposed = proposed
+        self.name = f"acceptance[{proposed}]"
+
+    def check(self, window: dict, snap: dict) -> list:
+        c = window["counters"]
+        prop = c.get(self.proposed, 0)
+        if prop < self.min_proposed:
+            return []
+        ratio = c.get(self.accepted, 0) / prop
+        if ratio >= self.floor:
+            return []
+        return [Alert(self.name, self.accepted, round(ratio, 4),
+                      self.floor,
+                      detail=f"{prop} proposed in window")]
+
+
+class GaugeWatermark(Watcher):
+    """Current gauge level outside ``[low, high]`` (either bound
+    optional; a gauge the run never wrote is skipped, never treated
+    as zero)."""
+
+    def __init__(self, gauge: str, high: float | None = None,
+                 low: float | None = None):
+        self.gauge = gauge
+        self.high = high
+        self.low = low
+        self.name = f"watermark[{gauge}]"
+
+    def check(self, window: dict, snap: dict) -> list:
+        v = window["gauges"].get(self.gauge)
+        if v is None:
+            return []
+        out = []
+        if self.high is not None and v > self.high:
+            out.append(Alert(self.name, self.gauge, v, self.high,
+                             detail="above high watermark"))
+        if self.low is not None and v < self.low:
+            out.append(Alert(self.name, self.gauge, v, self.low,
+                             detail="below low watermark"))
+        return out
+
+
+class RateAlarm(Watcher):
+    """Counter moved more than ``max_in_window`` inside one window —
+    for counters whose healthy rate is zero (duplicate commits,
+    integrity failures, quarantines)."""
+
+    def __init__(self, counter: str, max_in_window: int = 0,
+                 severity: str = "error"):
+        self.counter = counter
+        self.max_in_window = max_in_window
+        self.severity = severity
+        self.name = f"rate[{counter}]"
+
+    def check(self, window: dict, snap: dict) -> list:
+        d = window["counters"].get(self.counter, 0)
+        if d <= self.max_in_window:
+            return []
+        return [Alert(self.name, self.counter, d, self.max_in_window,
+                      severity=self.severity,
+                      detail="window count over alarm bound")]
+
+
+@dataclass
+class _WatchState:
+    prev: dict | None = None
+    prev_t: float = 0.0
+    polls: int = 0
+    alerts: list = field(default_factory=list)
+
+
+class Watch:
+    """Detector harness over one registry.
+
+    ``attach()`` arms the detectors (over-threshold registration) and
+    baselines the first window; ``maybe_poll()`` is the engine-loop
+    probe (time-throttled to ``min_interval_s``); ``poll()`` forces a
+    window; ``verdict()`` closes the final window and renders the
+    per-run health record. Registry resolution is late (armed registry
+    at call time) unless one is pinned at construction, so a Watch
+    built before ``obs.enable_metrics()`` still works.
+    """
+
+    def __init__(self, *watchers: Watcher, registry=None,
+                 min_interval_s: float = 0.05):
+        self.watchers = list(watchers)
+        self._registry = registry
+        self.min_interval_s = min_interval_s
+        self._st = _WatchState()
+        self._armed = False
+
+    def registry(self):
+        return self._registry if self._registry is not None \
+            else _metrics.metrics()
+
+    def attach(self) -> "Watch":
+        reg = self.registry()
+        if reg is None:
+            return self
+        if not self._armed:
+            for w in self.watchers:
+                w.arm(reg)
+            self._armed = True
+        self._st.prev = reg.snapshot()
+        self._st.prev_t = time.monotonic()
+        return self
+
+    def maybe_poll(self) -> None:
+        st = self._st
+        if st.prev is None:
+            return
+        now = time.monotonic()
+        if now - st.prev_t < self.min_interval_s:
+            return
+        self.poll()
+
+    def poll(self) -> list:
+        """One window: snapshot, difference, run detectors, emit
+        ``obs.alert`` events; returns this window's alerts."""
+        reg = self.registry()
+        st = self._st
+        if reg is None or st.prev is None:
+            return []
+        snap = reg.snapshot()
+        now = time.monotonic()
+        window = _window(st.prev, snap, now - st.prev_t)
+        st.prev, st.prev_t = snap, now
+        st.polls += 1
+        alerts = []
+        for w in self.watchers:
+            alerts.extend(w.check(window, snap))
+        for a in alerts:
+            _bus.emit("obs.alert", **a.to_event())
+        st.alerts.extend(alerts)
+        return alerts
+
+    def verdict(self) -> dict:
+        """Close the final window and render the per-run health record
+        (the shape ``bench.serve`` stamps into its rows)."""
+        self.poll()
+        st = self._st
+        return {
+            "healthy": not st.alerts,
+            "n_alerts": len(st.alerts),
+            "polls": st.polls,
+            "watchers": [w.name for w in self.watchers],
+            "alerts": [a.to_event() for a in st.alerts],
+        }
+
+
+def _window(prev: dict, snap: dict, seconds: float) -> dict:
+    """Difference two registry snapshots into one window record."""
+    counters = {k: v - prev["counters"].get(k, 0)
+                for k, v in snap["counters"].items()}
+    hists = {}
+    for k, h in snap["histograms"].items():
+        p = prev["histograms"].get(k, {})
+        pover = p.get("over", {})
+        hists[k] = {
+            "count": h["count"] - p.get("count", 0),
+            "sum": h["sum"] - p.get("sum", 0.0),
+            "over": {t: n - pover.get(t, 0)
+                     for t, n in h.get("over", {}).items()},
+        }
+    return {"seconds": seconds, "counters": counters,
+            "histograms": hists, "gauges": dict(snap["gauges"])}
+
+
+def serve_watch(ttft_slo_ms: float = 5_000.0,
+                tpot_slo_ms: float = 1_000.0,
+                gap_slo_ms: float = 5_000.0,
+                burn_budget: float = 0.25,
+                acceptance_floor: float = 0.005,
+                frag_high: float = 0.9,
+                occupancy_high: float = 0.98,
+                registry=None,
+                min_interval_s: float = 0.05) -> Watch:
+    """The standard serving watch: SLO burn on the three latency
+    histograms, speculation acceptance floor, KV
+    fragmentation/occupancy watermarks, and zero-tolerance alarms on
+    duplicate commits, integrity failures, and quarantined pages.
+    Defaults are deliberately loose for CPU-scale smoke traffic — a
+    clean run must verdict healthy; tune per deployment."""
+    return Watch(
+        SloBurnRate("serve.ttft_ms", ttft_slo_ms, burn_budget),
+        SloBurnRate("serve.tpot_ms", tpot_slo_ms, burn_budget),
+        SloBurnRate("serve.max_gap_ms", gap_slo_ms, burn_budget),
+        AcceptanceDrop(acceptance_floor),
+        GaugeWatermark("serve.kv.fragmentation", high=frag_high),
+        GaugeWatermark("serve.kv.occupancy", high=occupancy_high),
+        RateAlarm("serve.duplicate_commits"),
+        RateAlarm("serve.integrity_failures"),
+        RateAlarm("serve.prefix.quarantined"),
+        registry=registry, min_interval_s=min_interval_s,
+    )

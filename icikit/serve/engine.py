@@ -80,7 +80,14 @@ histograms, ``serve.occupancy_rows`` / ``serve.kv.*`` gauges,
 ``serve.prefix.{hits,misses,cow,quarantined}`` counters, a
 ``serve.request`` span per admission, a ``serve.prefill.chunk`` span
 per chunk and a ``serve.engine.step`` span per step
-(chrome-checker-valid).
+(chrome-checker-valid). On top of the thread spans, every request
+carries its own ASYNC span tree (``obs.trace_ctx``, minted at
+``RequestQueue.submit``): queue-wait and attempt segments, per-chunk
+spans, per-step participation instants with the verify-window accept
+stats, CoW/dedup/quarantine marks — one tree per request across
+lease reissue (``reissued_from`` edges), and the engine step span
+records the co-batch roster of participant trace ids. See
+docs/OBSERVABILITY.md.
 
 Chaos sites (drilled in ``tests/test_serve_chaos.py``):
 
@@ -1081,6 +1088,10 @@ class Engine:
                 self._knobs[slot] = (req.temperature, req.top_p,
                                      req.top_k)
                 obs.count("serve.admitted")
+                req.trace.instant("serve.req.admitted",
+                                  seq=req.claim_seq, slot=slot,
+                                  prefix_hit=p0, waiting=waiting,
+                                  side=side)
                 if quant_row:
                     # the int8 path keeps whole-prompt admission (see
                     # _build_prefill) — run it to completion here
@@ -1106,11 +1117,13 @@ class Engine:
         table = self.pool.allocators[row.shard].table(row.owner)
         pages = np.zeros((self.dp, npref), np.int32)
         pages[row.shard] = table[:npref]
-        tok0, bufs = fn(self.params, prompt[None], pages,
-                        self._kdat[slot:slot + 1],
-                        self._knobs[slot:slot + 1],
-                        self.pool.buffers())
-        self.pool.update(bufs)
+        with row.req.trace.span("serve.req.prefill.whole",
+                                seq=row.seq, s_prompt=s):
+            tok0, bufs = fn(self.params, prompt[None], pages,
+                            self._kdat[slot:slot + 1],
+                            self._knobs[slot:slot + 1],
+                            self.pool.buffers())
+            self.pool.update(bufs)
         row.prefilled = s
         self._prefix["prefill_tokens"] += s
         self._complete_prefill(slot, row, int(np.asarray(tok0)[0]))
@@ -1166,6 +1179,9 @@ class Engine:
             row.prefilled = p0
             row.req.prefix_hit_tokens = p0
             self._refresh_btab(slot, row)
+            row.req.trace.instant("serve.req.dedup_attach",
+                                  seq=row.seq, blocks=len(new),
+                                  prefilled=p0)
         if (row.sealed < len(row.hashes)
                 and self.pool.announced(row.shard,
                                         row.hashes[row.sealed])):
@@ -1222,6 +1238,8 @@ class Engine:
             if forked:
                 self._prefix["cow"] += 1
                 self._refresh_btab(slot, row)
+                row.req.trace.instant("serve.req.cow", seq=row.seq,
+                                      at="prefill.chunk")
         except PoolExhausted:
             self._evict(slot)
             self.queue.release(row.req.rid, delay=0.005, seq=row.seq)
@@ -1243,7 +1261,10 @@ class Engine:
         btab = np.zeros((self.dp, self.nb_per_row), np.int32)
         btab[row.shard] = self._btab[slot]
         with obs.span("serve.prefill.chunk", rid=row.req.rid,
-                      p0=row.prefilled, width=width, n_valid=n_valid):
+                      p0=row.prefilled, width=width, n_valid=n_valid), \
+                row.req.trace.span("serve.req.prefill.chunk",
+                                   seq=row.seq, p0=row.prefilled,
+                                   width=width, n_valid=n_valid):
             tok0, bufs = self._chunk_fns[key](
                 self.params, toks,
                 np.asarray([row.prefilled], np.int32),
@@ -1275,6 +1296,8 @@ class Engine:
         req = row.req
         req.first_token_t = time.monotonic()
         row.last_t = req.first_token_t
+        req.trace.instant("serve.req.first_token", seq=row.seq,
+                          pos=row.s_prompt)
         row.tokens = [tok0]
         row.n_done = 1
         self._toks[slot] = tok0
@@ -1326,6 +1349,9 @@ class Engine:
                     if forked:
                         self._prefix["cow"] += 1
                         obs.count("serve.spec.tree.forks")
+                        row.req.trace.instant("serve.req.cow",
+                                              seq=row.seq,
+                                              at="tree.fork")
                         added = True
             except PoolExhausted:
                 # preemption, not failure: the pool filled up around
@@ -1404,8 +1430,18 @@ class Engine:
             self._step_fns[fkey] = self._build_step(live, samp, filt)
         tree = self.serve.tree_branch > 1
         tstats = None
-        with obs.span("serve.engine.step", step=self.n_steps,
-                      rows=int(self._active.sum())):
+        step_no = self.n_steps
+        step_attrs = {"step": step_no, "rows": int(self._active.sum())}
+        traced = obs.tracing() is not None
+        if traced:
+            # co-batch roster: the step span names every participant's
+            # trace id, so ONE engine step is joinable from EVERY
+            # co-batched request's span tree (the causal fan-in a
+            # per-request view needs to explain interference)
+            step_attrs["roster"] = [
+                r.req.trace.trace_id for s, r in enumerate(self.rows)
+                if r is not None and self._active[s]]
+        with obs.span("serve.engine.step", **step_attrs):
             outs = self._step_fns[fkey](
                 self.params, self._toks, self._curs, self._active,
                 self._isq, self._btab, self._drafts(),
@@ -1431,6 +1467,18 @@ class Engine:
             req = row.req
             self.queue.renew(req.rid, seq=row.seq)
             a_r = int(a[slot])
+            if traced:
+                # per-step batch participation: one instant per
+                # (request, step) with the verify-window outcome — for
+                # k > 1 the step IS the speculation verify window, so
+                # accepted-1 is the drafts this window kept (and the
+                # tree split rides along)
+                sattrs = {"step": step_no, "accepted": a_r}
+                if tstats is not None:
+                    sattrs["primary"] = int(tstats[slot, 0])
+                    sattrs["sideways"] = bool(tstats[slot, 1])
+                req.trace.instant("serve.req.step", seq=row.seq,
+                                  **sattrs)
             if a_r > 0 and row.n_done < req.n_new:
                 # inter-delivery stall: the span since this row last
                 # committed — whatever co-batched admission work (a
@@ -1484,8 +1532,17 @@ class Engine:
         obs.count("serve.tokens", committed)
         obs.gauge("serve.occupancy_rows",
                   float(self._active.sum()) / self.serve.max_rows)
-        if obs.metrics() is not None:
-            used = {(r.owner, r.shard): int(self._curs[s])
+        if obs.metrics() is not None and self.n_steps % 8 == 1:
+            # a prefilling row's cursor is still 0 but its computed
+            # prompt positions hold real K/V: count them, or the
+            # gauge reads 1.0 at every admission and the watch's
+            # fragmentation watermark alarms on healthy traffic.
+            # Sampled every 8th step: the gauge is a level, the
+            # allocator-table walk is real per-step host time
+            # (tools/trace_overhead_study.py), and the watch polls at
+            # a far coarser interval anyway
+            used = {(r.owner, r.shard): max(int(self._curs[s]),
+                                            r.prefilled)
                     for s, r in enumerate(self.rows) if r is not None}
             obs.gauge("serve.kv.fragmentation",
                       self.pool.fragmentation(used))
@@ -1575,6 +1632,8 @@ class Engine:
                 # may re-attach the bad content
                 for bi in bad:
                     self.pool.quarantine(row.owner, row.shard, bi)
+                req.trace.instant("serve.req.quarantine", seq=row.seq,
+                                  pages=[int(b) for b in bad])
                 self._evict(slot)
                 self.queue.fail(req.rid, IntegrityError(
                     f"{req.rid}: sealed KV pages {bad} failed "
@@ -1595,16 +1654,23 @@ class Engine:
 
     # -- the loop ----------------------------------------------------
 
-    def run(self, drain: bool = True, max_steps: int | None = None):
+    def run(self, drain: bool = True, max_steps: int | None = None,
+            watch=None):
         """Serve until the queue drains (or ``max_steps`` decode steps
         have run); returns the completed-request count for this call.
         Re-entrant: a fresh engine pointed at the same queue picks up
-        reissued leases from a dead one."""
+        reissued leases from a dead one. ``watch`` is an optional
+        armed :class:`icikit.obs.watch.Watch`: the loop probes it once
+        per pass (time-throttled inside ``maybe_poll``), which is what
+        gives the anomaly detectors their mid-run windows — the caller
+        renders ``watch.verdict()`` afterwards."""
         done0 = len(self.queue.done)
         while True:
             self.queue.reap_expired()
             self._admit()
             self._advance_prefill()
+            if watch is not None:
+                watch.maybe_poll()
             if not self._active.any():
                 if any(r is not None and r.prefilled < r.s_prompt
                        for r in self.rows):

@@ -41,6 +41,7 @@ from dataclasses import dataclass, field
 import numpy as np
 
 from icikit import obs
+from icikit.obs import trace_ctx
 
 DEFAULT_LEASE_S = 30.0
 
@@ -119,6 +120,10 @@ class Request:
     # the whole decode; this is the stall itself — the metric the
     # chunked-prefill latency cap exists to bound
     max_gap_ms: float | None = None
+    # request-scoped trace context (obs.trace_ctx.TraceCtx): minted at
+    # submit, rides the request across engines — the ONE span tree per
+    # request, attempts linked by reissued_from across lease reaps
+    trace: trace_ctx.TraceCtx | None = None
 
     def slo(self) -> dict:
         """TTFT / TPOT / queue-wait in ms (None where the phase never
@@ -146,7 +151,11 @@ class RequestQueue:
 
     Invariant (the ``_LeaseQueue`` discipline): every request is in
     exactly one of queued / leased / done / failed, so ``drained()``
-    is simply "queued and leased both empty".
+    is simply "queued and leased both empty" — plus the transient
+    requeue **limbo** (lease dropped, trace transitions settling
+    outside the lock, heap entry not yet pushed), which ``drained()``
+    and ``pending()`` count so no engine exits while a reissue is
+    mid-flight.
     """
 
     def __init__(self, lease_s: float = DEFAULT_LEASE_S,
@@ -159,6 +168,12 @@ class RequestQueue:
         self._queued: list = []
         self._requests: dict = {}     # rid -> Request
         self._leases: dict = {}       # rid -> deadline (monotonic)
+        # requests mid-requeue (lease dropped, heap entry not yet
+        # pushed): their trace transitions run outside the lock and
+        # must FINISH before the rid is claimable again, so the
+        # requeue is two-phase — this counter keeps drained()/pending()
+        # honest inside that window
+        self._limbo = 0
         self.done: dict = {}          # rid -> Request
         self.failed: dict = {}        # rid -> Request
         self.n_reissues = 0
@@ -191,16 +206,25 @@ class RequestQueue:
             raise ValueError(f"top_k must be >= 0, got {top_k}")
         now = time.monotonic()
         vis = now if not_before is None else float(not_before)
+        seq = next(self._ids)        # itertools.count: atomic
+        rid = f"r{seq}"
+        req = Request(rid=rid, prompt=prompt, n_new=int(n_new),
+                      checksum=prompt_checksum(prompt),
+                      eos_id=eos_id, visible_after=vis,
+                      max_retries=max_retries, arrival_t=vis,
+                      quant=bool(quant), seed=int(seed),
+                      temperature=float(temperature),
+                      top_k=int(top_k), top_p=float(top_p))
+        req.trace = trace_ctx.mint(rid)
+        # tree root + first queued segment open BEFORE the request
+        # becomes claimable (and outside the lock — the mark_dead
+        # discipline): a concurrent engine claiming the instant the
+        # heap push lands must find the root already open, or its
+        # attempt segment would sit UNDER the root in the LIFO stack
+        # and the terminal close would pop the wrong spans
+        req.trace.open("serve.req", n_new=int(n_new))
+        req.trace.open("serve.req.queued")
         with self._lock:
-            seq = next(self._ids)
-            rid = f"r{seq}"
-            req = Request(rid=rid, prompt=prompt, n_new=int(n_new),
-                          checksum=prompt_checksum(prompt),
-                          eos_id=eos_id, visible_after=vis,
-                          max_retries=max_retries, arrival_t=vis,
-                          quant=bool(quant), seed=int(seed),
-                          temperature=float(temperature),
-                          top_k=int(top_k), top_p=float(top_p))
             self._requests[rid] = req
             heapq.heappush(self._queued, (vis, seq, rid))
         obs.count("serve.submitted")
@@ -216,6 +240,7 @@ class RequestQueue:
         duplicate from a reap racing a stale engine's fail) is
         discarded, so one request can never be admitted twice."""
         now = time.monotonic()
+        claimed = None
         with self._lock:
             while self._queued and self._queued[0][0] <= now:
                 _, _, rid = heapq.heappop(self._queued)
@@ -226,8 +251,13 @@ class RequestQueue:
                 req.attempts += 1
                 req.claim_seq += 1
                 self._leases[rid] = (now + self.lease_s, req.claim_seq)
-                return req
-            return None
+                claimed = req
+                break
+        if claimed is not None:
+            claimed.trace.close("serve.req.queued")
+            claimed.trace.begin_attempt(claimed.claim_seq,
+                                        attempt=claimed.attempts)
+        return claimed
 
     def next_visible_in(self) -> float | None:
         """Seconds until the head of the queue becomes visible (<= 0 ==
@@ -274,8 +304,14 @@ class RequestQueue:
         if dup:
             self.n_duplicate_commits += 1
             obs.emit("serve.duplicate_commit", rid=rid)
+            # the watch layer's zero-rate alarm consumes the counter
+            # form (events are not windowable)
+            obs.count("serve.duplicate_commits")
             return False
         obs.count("serve.completed")
+        req.trace.end_attempt()
+        req.trace.close("serve.req", state="done",
+                        n_tokens=len(req.tokens))
         return True
 
     def fail(self, rid: str, exc: BaseException,
@@ -298,8 +334,7 @@ class RequestQueue:
                 req.state = "queued"
                 req.tokens = []
                 req.first_token_t = None
-                heapq.heappush(self._queued,
-                               (vis, next(self._ids), rid))
+                self._limbo += 1    # claimable only after ctx settles
                 requeued = True
             else:
                 req.state = "failed"
@@ -307,6 +342,20 @@ class RequestQueue:
         obs.emit("serve.request_failed", rid=rid, error=repr(exc),
                  requeued=requeued)
         obs.count("serve.retries" if requeued else "serve.failed")
+        req.trace.end_attempt(outcome="failed")
+        req.trace.instant("serve.req.retry" if requeued
+                          else "serve.req.failed", error=repr(exc))
+        if requeued:
+            # two-phase requeue: the trace transitions above must be
+            # on the buffer before a concurrent engine can claim the
+            # rid and open the next attempt segment
+            req.trace.open("serve.req.queued")
+            with self._lock:
+                heapq.heappush(self._queued,
+                               (vis, next(self._ids), rid))
+                self._limbo -= 1
+        else:
+            req.trace.close("serve.req", state="failed")
         return "queued" if requeued else "failed"
 
     def release(self, rid: str, delay: float = 0.0,
@@ -326,11 +375,17 @@ class RequestQueue:
             req.tokens = []
             req.first_token_t = None
             req.preempted += 1
+            self._limbo += 1        # claimable only after ctx settles
+        obs.emit("serve.request_preempted", rid=rid)
+        obs.count("serve.preemptions")
+        req.trace.end_attempt(outcome="preempted")
+        req.trace.instant("serve.req.preempted")
+        req.trace.open("serve.req.queued")
+        with self._lock:
             heapq.heappush(self._queued,
                            (time.monotonic() + delay,
                             next(self._ids), rid))
-        obs.emit("serve.request_preempted", rid=rid)
-        obs.count("serve.preemptions")
+            self._limbo -= 1
 
     # -- monitor side ------------------------------------------------
 
@@ -339,8 +394,9 @@ class RequestQueue:
         dead-request abandonment path); returns the reaped rids."""
         now = time.monotonic()
         reaped = []
+        reaped_reqs = []
         with self._lock:
-            for rid, (deadline, _) in list(self._leases.items()):
+            for rid, (deadline, seq) in list(self._leases.items()):
                 if deadline > now:
                     continue
                 del self._leases[rid]
@@ -348,22 +404,41 @@ class RequestQueue:
                 req.state = "queued"
                 req.tokens = []
                 req.first_token_t = None
-                heapq.heappush(self._queued,
-                               (now, next(self._ids), rid))
                 reaped.append(rid)
+                reaped_reqs.append((req, seq))
             self.n_reissues += len(reaped)
+            self._limbo += len(reaped)
         if reaped:
             obs.emit("serve.lease_expired", rids=reaped)
             obs.count("serve.reissues", len(reaped))
+            for req, seq in reaped_reqs:
+                # the dead engine can no longer close what it opened:
+                # abandon closes every open span of the tree (stamped
+                # closed_by) and records the reaped claim generation —
+                # the NEXT attempt opens with reissued_from=seq, the
+                # one-request-one-tree continuity edge. Two-phase
+                # requeue: these transitions land BEFORE the second
+                # lock pushes the rid back into the heap, so a
+                # concurrent engine cannot claim-and-begin the next
+                # attempt while abandon is still closing the last one
+                req.trace.abandon("lease_reaped", seq=seq)
+                req.trace.instant("serve.req.reissued", from_seq=seq)
+                req.trace.open("serve.req.queued")
+            with self._lock:
+                for req, _ in reaped_reqs:
+                    heapq.heappush(self._queued,
+                                   (now, next(self._ids), req.rid))
+                self._limbo -= len(reaped)
         return reaped
 
     def drained(self) -> bool:
         with self._lock:
-            return not self._queued and not self._leases
+            return (not self._queued and not self._leases
+                    and not self._limbo)
 
     def pending(self) -> int:
         with self._lock:
-            return len(self._queued) + len(self._leases)
+            return len(self._queued) + len(self._leases) + self._limbo
 
     def request(self, rid: str) -> Request:
         with self._lock:

@@ -1,0 +1,160 @@
+"""Bench regression gate (`tools/bench_regress.py`): paired arms by
+config key, provenance separation, median-of-seeds, noise-widened
+tolerance bands, injected-regression drill, verdict files."""
+
+import json
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                "tools"))
+import bench_regress as br  # noqa: E402
+
+
+def _row(backend="cpu", mode="continuous", seed=0, tps=100.0, **over):
+    row = {"kind": "serve", "preset": "tiny", "backend": backend,
+           "mode": mode, "rows": 4, "rate_rps": 8.0, "seed": seed,
+           "tokens_per_s": tps,
+           "ttft_ms": {"p50": 50.0, "p99": 120.0}}
+    row.update(over)
+    return row
+
+
+def test_config_key_pairs_arms_and_pools_seeds():
+    a, b = _row(seed=0, tps=100.0), _row(seed=1, tps=110.0)
+    assert br.config_key(a) == br.config_key(b)     # seeds pool
+    assert br.config_key(_row(mode="static")) != br.config_key(a)
+    assert br.config_key(_row(rows=8)) != br.config_key(a)
+
+
+def test_provenance_separation_cpu_never_gates_tpu():
+    base = [_row(backend="tpu", tps=1000.0)]
+    fresh = [_row(backend="cpu", tps=100.0)]    # 10x "slower" — but
+    v = br.compare(base, fresh)                 # different provenance
+    assert v["ok"] and v["paired_arms"] == 0
+    assert v["fresh_only_arms"] == 1 and v["baseline_only_arms"] == 1
+
+
+def test_identical_ledger_passes():
+    rows = [_row(seed=s, tps=100.0 + s) for s in range(3)]
+    v = br.compare(rows, rows)
+    assert v["ok"] and v["paired_arms"] == 1 and v["compared"] >= 1
+    assert v["regressions"] == [] and v["improvements"] == []
+
+
+def test_flags_20pct_throughput_regression():
+    base = [_row(seed=s, tps=100.0) for s in range(3)]
+    fresh = [_row(seed=s, tps=80.0) for s in range(3)]
+    v = br.compare(base, fresh)
+    assert not v["ok"]
+    (reg,) = [r for r in v["regressions"]
+              if r["metric"] == "tokens_per_s"]
+    assert reg["ratio"] == pytest.approx(0.8)
+    assert reg["n_baseline"] == 3 and reg["n_fresh"] == 3
+
+
+def test_median_of_seeds_absorbs_one_outlier():
+    base = [_row(seed=s, tps=100.0) for s in range(3)]
+    fresh = [_row(seed=0, tps=99.0), _row(seed=1, tps=98.0),
+             _row(seed=2, tps=20.0)]            # one bad replica
+    v = br.compare(base, fresh)                 # median 98: in band
+    assert v["ok"]
+
+
+def test_band_widens_to_baseline_noise():
+    # baseline spread ±30%: a 15% drop is inside the noise floor even
+    # though the configured band is 10%
+    base = [_row(seed=0, tps=70.0), _row(seed=1, tps=100.0),
+            _row(seed=2, tps=130.0)]
+    fresh = [_row(seed=s, tps=85.0) for s in range(3)]
+    v = br.compare(base, fresh)
+    assert v["ok"]
+
+
+def test_lower_is_better_direction():
+    base = [_row(tps=100.0)]
+    fresh = [_row(tps=100.0)]
+    fresh[0]["ttft_ms"] = {"p50": 500.0, "p99": 600.0}  # 10x worse
+    v = br.compare(base, fresh)
+    assert not v["ok"]
+    assert any(r["metric"] == "ttft_ms.p50"
+               for r in v["regressions"])
+
+
+def test_improvements_reported_not_failed():
+    base = [_row(tps=100.0)]
+    fresh = [_row(tps=150.0)]
+    v = br.compare(base, fresh)
+    assert v["ok"] and any(i["metric"] == "tokens_per_s"
+                           for i in v["improvements"])
+
+
+def test_tracing_false_pairs_with_historical_rows():
+    """A fresh disarmed row (tracing: False — the r15 A/B field) must
+    pair with committed pre-r15 rows that predate the field; armed
+    rows stay a distinct arm (they are slower by design)."""
+    old = _row(tps=100.0)                       # no "tracing" key
+    disarmed = _row(tps=100.0, tracing=False)
+    armed = _row(tps=96.0, tracing=True)
+    assert br.config_key(disarmed) == br.config_key(old)
+    assert br.config_key(armed) != br.config_key(old)
+    v = br.compare([old], [disarmed])
+    assert v["paired_arms"] == 1
+
+
+def test_gate_mode_zero_pairs_fails_by_default(tmp_path):
+    base = tmp_path / "base.jsonl"
+    fresh = tmp_path / "fresh.jsonl"
+    base.write_text(json.dumps(_row(rows=4)) + "\n")
+    fresh.write_text(json.dumps(_row(rows=64)) + "\n")   # never pairs
+    rc = br.main(["--baseline", str(base), "--fresh", str(fresh)])
+    assert rc == 1          # compared nothing must NOT read as PASS
+    rc = br.main(["--baseline", str(base), "--fresh", str(fresh),
+                  "--require-paired", "0"])              # explicit opt-out
+    assert rc == 0
+
+
+def test_self_check_mode_and_verdict_file(tmp_path):
+    ledger = tmp_path / "rows.jsonl"
+    with open(ledger, "w") as f:
+        for s in range(2):
+            f.write(json.dumps(_row(seed=s)) + "\n")
+    verdict_path = tmp_path / "verdict.json"
+    rc = br.main(["--self-check", str(ledger),
+                  "--verdict", str(verdict_path)])
+    assert rc == 0
+    v = json.loads(verdict_path.read_text())
+    assert v["mode"] == "self-check" and v["ok"]
+    assert v["clean_pass"] and v["injection_flagged"]
+    assert v["injected"]["regressions"]
+
+
+def test_gate_mode_cli_and_require_paired(tmp_path):
+    base = tmp_path / "base.jsonl"
+    fresh = tmp_path / "fresh.jsonl"
+    base.write_text(json.dumps(_row(tps=100.0)) + "\n")
+    fresh.write_text(json.dumps(_row(tps=50.0)) + "\n")
+    verdict_path = tmp_path / "v.json"
+    rc = br.main(["--baseline", str(base), "--fresh", str(fresh),
+                  "--verdict", str(verdict_path)])
+    assert rc == 1
+    v = json.loads(verdict_path.read_text())
+    assert v["mode"] == "gate" and not v["ok"]
+    # a gate that paired nothing must be able to say so loudly
+    other = tmp_path / "other.jsonl"
+    other.write_text(json.dumps(_row(rows=64)) + "\n")
+    rc = br.main(["--baseline", str(base), "--fresh", str(other),
+                  "--require-paired", "1"])
+    assert rc == 1
+
+
+def test_committed_ledgers_self_check():
+    """The make-check invocation, in-process: the repo's own ledgers
+    pass clean and flag the planted loss."""
+    root = os.path.join(os.path.dirname(__file__), "..")
+    v = br.self_check([os.path.join(root, "serve_r12.jsonl"),
+                       os.path.join(root, "decode_spec_r14.jsonl")],
+                      br.DEFAULT_METRICS)
+    assert v["ok"] and v["clean_pass"] and v["injection_flagged"]

@@ -1,0 +1,262 @@
+"""Request-scoped tracing (`icikit.obs.trace_ctx`): one async span
+tree per request — whole on clean runs, continuous across dead-engine
+reissue (ONE tree, an explicit ``reissued_from`` edge, no orphan
+spans), fenced against stale engines, and invisible to the served
+tokens (tracing on ≡ tracing off, bitwise)."""
+
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from icikit import chaos, obs
+from icikit.obs import trace_ctx
+from icikit.models.transformer import (
+    TransformerConfig,
+    init_params,
+)
+from icikit.models.transformer.model import make_model_mesh
+from icikit.serve import Engine, RequestQueue, ServeConfig
+
+CFG = TransformerConfig(vocab=61, d_model=32, n_heads=2, d_head=8,
+                        d_ff=64, n_layers=2, max_seq=64,
+                        compute_dtype="float32")
+
+
+def _setup(n=2, seed=1, **over):
+    mesh = make_model_mesh(dp=1, tp=1, sp=1)
+    params = init_params(jax.random.key(0), CFG, mesh)
+    rng = np.random.default_rng(seed)
+    prompts = [rng.integers(0, CFG.vocab, (8,)).astype(np.int32)
+               for _ in range(n)]
+    sv = dict(max_rows=2, block_size=4, n_blocks=32, max_prompt=16,
+              max_new=16)
+    sv.update(over)
+    return mesh, params, ServeConfig(**sv), prompts
+
+
+# -- async-span plumbing (tracer + chrome) --------------------------
+
+def test_async_events_validate_across_threads():
+    """The satellite contract: an async span may open on one thread
+    track and close on another — the validator pairs by (cat, id),
+    not by tid."""
+    with obs.session(metrics=False) as s:
+        s.trace.async_event("b", "x", "c", "id-1")
+        import threading
+        t = threading.Thread(
+            target=lambda: s.trace.async_event("e", "x", "c", "id-1"))
+        t.start()
+        t.join()
+    events = s.trace.snapshot()
+    bs = [e for e in events if e["ph"] == "b"]
+    es = [e for e in events if e["ph"] == "e"]
+    assert bs[0]["tid"] != es[0]["tid"]      # genuinely cross-track
+    assert obs.validate_trace(events) == []
+
+
+def test_validator_catches_async_problems():
+    base = {"pid": 1, "tid": 1, "ts": 0}
+    assert any("unclosed b" in p for p in obs.validate_trace(
+        [{"ph": "b", "name": "x", "cat": "c", "id": 1, **base}]))
+    assert any("no open b" in p for p in obs.validate_trace(
+        [{"ph": "e", "name": "x", "cat": "c", "id": 1, **base}]))
+    assert any("missing cat/id" in p for p in obs.validate_trace(
+        [{"ph": "b", "name": "x", **base}]))
+    # LIFO per id: e naming other than the innermost open b
+    assert any("nesting violation" in p for p in obs.validate_trace(
+        [{"ph": "b", "name": "a", "cat": "c", "id": 1, **base},
+         {"ph": "b", "name": "b", "cat": "c", "id": 1,
+          "pid": 1, "tid": 1, "ts": 1},
+         {"ph": "e", "name": "a", "cat": "c", "id": 1,
+          "pid": 1, "tid": 1, "ts": 2}]))
+    # distinct ids do not interleave-violate
+    assert obs.validate_trace(
+        [{"ph": "b", "name": "a", "cat": "c", "id": 1, **base},
+         {"ph": "b", "name": "b", "cat": "c", "id": 2,
+          "pid": 1, "tid": 1, "ts": 1},
+         {"ph": "e", "name": "a", "cat": "c", "id": 1,
+          "pid": 1, "tid": 1, "ts": 2},
+         {"ph": "e", "name": "b", "cat": "c", "id": 2,
+          "pid": 1, "tid": 1, "ts": 3}]) == []
+
+
+def test_export_closes_dangling_async_spans(tmp_path):
+    with obs.session(metrics=False) as s:
+        s.trace.async_event("b", "req", "c", "id-9")
+        s.trace.async_event("b", "attempt", "c", "id-9")
+    raw = s.trace.snapshot()
+    assert any("unclosed b" in p for p in obs.validate_trace(raw))
+    path = tmp_path / "t.json"
+    obs.export_trace(str(path), raw)
+    assert obs.validate_trace(str(path)) == []
+    import json
+    evs = json.loads(path.read_text())["traceEvents"]
+    synth = [e for e in evs if e["ph"] == "e"
+             and e.get("args", {}).get("closed_by") == "export"]
+    # LIFO: the inner span closes first
+    assert [e["name"] for e in synth] == ["attempt", "req"]
+
+
+# -- TraceCtx unit behavior -----------------------------------------
+
+def test_ctx_disabled_is_noop_and_stale_seq_fences():
+    ctx = trace_ctx.mint("r0")
+    ctx.open("serve.req")          # tracing off: no state, no events
+    assert ctx._open == []
+    with obs.session(metrics=False) as s:
+        ctx.begin_attempt(1)
+        ctx.instant("serve.req.step", seq=1, step=0)
+        ctx.instant("serve.req.step", seq=7, step=1)   # stale: no-op
+        with ctx.span("serve.req.prefill.chunk", seq=7):
+            pass                                       # stale: no-op
+        ctx.end_attempt()
+    names = [(e["ph"], e["name"]) for e in s.trace.snapshot()
+             if e.get("cat") == trace_ctx.CAT]
+    assert names == [("b", "serve.req.attempt"),
+                     ("n", "serve.req.step"),
+                     ("e", "serve.req.attempt")]
+
+
+def test_ctx_close_through_nested(tmp_path):
+    """A terminal edge arriving while an inner span is open closes
+    through it LIFO — the validator must stay satisfied."""
+    ctx = trace_ctx.mint("r0")
+    with obs.session(metrics=False) as s:
+        ctx.open("serve.req")
+        ctx.begin_attempt(1)
+        ctx.open("serve.req.prefill.chunk", seq=1)
+        ctx.close("serve.req", state="done")
+    events = s.trace.snapshot()
+    assert obs.validate_trace(events) == []
+    es = [e for e in events if e["ph"] == "e"]
+    assert [e["name"] for e in es] == ["serve.req.prefill.chunk",
+                                      "serve.req.attempt",
+                                      "serve.req"]
+    assert es[0]["args"]["closed_by"] == "serve.req"
+
+
+# -- engine integration ---------------------------------------------
+
+def test_clean_run_yields_whole_request_trees():
+    mesh, params, sv, prompts = _setup(n=3, speculate_k=3,
+                                       prefill_chunk=4)
+    with obs.session() as s:
+        eng = Engine(params, mesh, CFG, sv)
+        rids = [eng.submit(p, 10) for p in prompts]
+        eng.run()
+        events = s.trace.snapshot()
+    assert obs.validate_trace(events) == []
+    trees = trace_ctx.request_trees(events)
+    assert len(trees) == len(rids)
+    for evs in trees.values():
+        names = [(e["ph"], e["name"]) for e in evs]
+        # root opens first, closes last; queue-wait precedes attempt
+        assert names[0] == ("b", "serve.req")
+        assert names[1] == ("b", "serve.req.queued")
+        assert names[-1] == ("e", "serve.req")
+        flat = [n for _, n in names]
+        assert "serve.req.prefill.chunk" in flat
+        assert "serve.req.first_token" in flat
+        assert "serve.req.step" in flat
+        # balanced within the tree — no orphans, no export synthetics
+        assert sum(1 for ph, _ in names if ph == "b") == \
+            sum(1 for ph, _ in names if ph == "e")
+        assert not any(e.get("args", {}).get("closed_by") == "export"
+                       for e in evs)
+        # speculation stats ride the step instants (k=3: the step IS
+        # the verify window)
+        steps = [e for e in evs if e["name"] == "serve.req.step"]
+        assert all("accepted" in e["args"] for e in steps)
+    # the co-batch roster joins engine steps to request trees
+    rosters = [e["args"]["roster"] for e in events
+               if e.get("name") == "serve.engine.step"
+               and e["ph"] == "B" and e["args"]["rows"]]
+    assert rosters and all(
+        set(r) <= set(trees) for r in rosters)
+
+
+def test_dead_engine_reissue_one_tree_with_edge():
+    """The chaos continuity pin: an engine dies mid-serve, leases
+    expire, a second engine completes — each request has ONE tree,
+    its second attempt carries reissued_from, the reap closed the
+    abandoned spans (no orphans), and the whole trace validates."""
+    mesh, params, sv, prompts = _setup()
+    q = RequestQueue(lease_s=0.05)
+    plan = chaos.FaultPlan(schedule={"die:serve.step": (0,)})
+    with obs.session() as s:
+        eng1 = Engine(params, mesh, CFG, sv, queue=q)
+        rids = [eng1.submit(p, 10) for p in prompts]
+        with chaos.inject(plan):
+            with pytest.raises(chaos.InjectedDeath):
+                eng1.run()
+            time.sleep(0.06)
+            eng2 = Engine(params, mesh, CFG, sv, queue=q)
+            eng2.run()
+        events = s.trace.snapshot()
+    assert q.n_reissues == len(rids)
+    assert obs.validate_trace(events) == []     # no orphan spans
+    trees = trace_ctx.request_trees(events)
+    assert len(trees) == len(rids)              # ONE tree per request
+    for evs in trees.values():
+        attempts = [e for e in evs if e["ph"] == "b"
+                    and e["name"] == "serve.req.attempt"]
+        assert [a["args"]["attempt"] for a in attempts] == [1, 2]
+        # the explicit continuity edge: attempt 2 names the claim
+        # generation the reap abandoned
+        assert attempts[1]["args"]["reissued_from"] == \
+            attempts[0]["args"]["claim_seq"]
+        reaps = [e for e in evs if e["name"] == "serve.req.reissued"]
+        assert len(reaps) == 1
+        # the dead engine's spans were closed BY THE REAP, not left
+        # dangling for the exporter
+        assert any(e["ph"] == "e"
+                   and e.get("args", {}).get("closed_by")
+                   == "lease_reaped" for e in evs)
+        assert evs[-1]["name"] == "serve.req" and evs[-1]["ph"] == "e"
+
+
+def test_tracing_on_off_bitwise_identical_tokens():
+    """Tracing must never touch the served bytes: the same workload
+    (tree speculation armed — the densest instrumentation path)
+    commits identical tokens with tracing on and off."""
+    mesh, params, sv, prompts = _setup(n=3, speculate_k=3,
+                                       tree_branch=2, prefill_chunk=4)
+
+    def serve():
+        eng = Engine(params, mesh, CFG, sv)
+        rids = [eng.submit(p, 10, seed=i, temperature=0.5)
+                for i, p in enumerate(prompts)]
+        eng.run()
+        return [tuple(eng.queue.request(r).tokens) for r in rids]
+
+    base = serve()                       # tracing off
+    with obs.session() as s:
+        traced = serve()                 # tracing + metrics on
+        assert obs.validate_trace(s.trace.snapshot()) == []
+    assert traced == base
+
+
+def test_ctx_ops_disabled_allocate_nothing():
+    """The zero-overhead-disabled re-assertion, trace-ctx ops and the
+    speculation counter sites included (the tracemalloc harness from
+    test_obs, pointed at the new probes)."""
+    import tracemalloc
+    ctx = trace_ctx.mint("r0")
+
+    def hot():
+        for _ in range(300):
+            ctx.instant("serve.req.step", seq=1, step=0, accepted=1)
+            with ctx.span("serve.req.prefill.chunk", seq=1):
+                pass
+            obs.count("serve.spec.tree.draft_accepted", 3)
+            obs.count("serve.spec.tree.primary", 2)
+            obs.count("serve.spec.tree.sideways", 1)
+
+    hot()   # warm lazy internals
+    tracemalloc.start()
+    hot()
+    _, peak = tracemalloc.get_traced_memory()
+    tracemalloc.stop()
+    assert peak < 4096, f"disabled trace-ctx path allocated {peak} B"

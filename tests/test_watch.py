@@ -1,0 +1,194 @@
+"""Anomaly watch (`icikit.obs.watch`): windowed detectors over
+lock-scoped registry snapshots — SLO burn rate with exact
+over-threshold counts, acceptance-drop, watermarks, zero-rate alarms,
+`obs.alert` events on the bus, and the per-run health verdict."""
+
+import threading
+
+import pytest
+
+from icikit import obs
+from icikit.obs import bus, watch
+from icikit.obs.metrics import Registry
+
+
+def _watch_over(reg, *watchers, interval=0.0):
+    w = watch.Watch(*watchers, registry=reg, min_interval_s=interval)
+    return w.attach()
+
+
+# -- histogram over-threshold + race safety -------------------------
+
+def test_track_over_counts_and_snapshots():
+    reg = Registry()
+    h = reg.histogram("x")
+    h.track_over(10.0)
+    for v in (5.0, 15.0, 20.0, 9.0):
+        h.observe(v)
+    s = h.summary()
+    assert s["over"] == {"10.0": 2}
+    assert s["count"] == 4 and s["sum"] == 49.0
+    # snapshot stays strict-JSON serializable
+    import json
+    json.dumps(reg.snapshot(), allow_nan=False)
+
+
+def test_summary_race_safe_against_concurrent_observes():
+    """The satellite pin: snapshots taken mid-run by the watch must
+    never tear (count and sum read under one lock scope — a torn pair
+    shows up as a window mean outside the observed value range)."""
+    reg = Registry()
+    h = reg.histogram("x")
+    stop = threading.Event()
+
+    def pound():
+        while not stop.is_set():
+            h.observe(1.0)
+
+    t = threading.Thread(target=pound)
+    t.start()
+    try:
+        for _ in range(300):
+            s = h.summary()
+            if s["count"]:
+                mean = s["sum"] / s["count"]
+                assert mean == pytest.approx(1.0), s
+    finally:
+        stop.set()
+        t.join()
+
+
+def test_clear_gauges_scopes_arms():
+    reg = Registry()
+    reg.gauge("serve.occupancy_rows").set(0.9)
+    reg.gauge("other.g").set(1.0)
+    reg.clear_gauges("serve.")
+    snap = reg.snapshot()
+    # the stale serve gauge reads as ABSENT, not as a plausible value
+    assert "serve.occupancy_rows" not in snap["gauges"]
+    assert snap["gauges"]["other.g"] == 1.0
+
+
+# -- detectors ------------------------------------------------------
+
+def test_slo_burn_rate_fires_over_budget_only():
+    reg = Registry()
+    w = _watch_over(reg, watch.SloBurnRate("serve.ttft_ms", 100.0,
+                                           budget=0.25, min_count=8))
+    for _ in range(9):
+        reg.histogram("serve.ttft_ms").observe(50.0)
+    assert w.poll() == []                       # burn 0
+    for i in range(10):
+        reg.histogram("serve.ttft_ms").observe(
+            200.0 if i < 5 else 50.0)
+    alerts = w.poll()                           # burn 0.5 this window
+    assert len(alerts) == 1
+    a = alerts[0]
+    assert a.metric == "serve.ttft_ms" and a.value == 0.5
+    # the run-so-far totals never contaminate later windows
+    for _ in range(10):
+        reg.histogram("serve.ttft_ms").observe(50.0)
+    assert w.poll() == []
+
+
+def test_slo_burn_skips_thin_windows():
+    reg = Registry()
+    w = _watch_over(reg, watch.SloBurnRate("serve.ttft_ms", 100.0,
+                                           budget=0.1, min_count=8))
+    for _ in range(7):      # under min_count: one straggler can't alarm
+        reg.histogram("serve.ttft_ms").observe(500.0)
+    assert w.poll() == []
+
+
+def test_acceptance_drop_detector():
+    reg = Registry()
+    w = _watch_over(reg, watch.AcceptanceDrop(floor=0.05,
+                                              min_proposed=64))
+    reg.counter("serve.spec.draft_proposed").add(100)
+    reg.counter("serve.spec.draft_accepted").add(50)
+    assert w.poll() == []                       # healthy 0.5
+    reg.counter("serve.spec.draft_proposed").add(100)
+    reg.counter("serve.spec.draft_accepted").add(1)
+    alerts = w.poll()                           # windowed 0.01 < floor
+    assert len(alerts) == 1 and alerts[0].value == 0.01
+    reg.counter("serve.spec.draft_proposed").add(10)
+    assert w.poll() == []                       # thin window skipped
+
+
+def test_gauge_watermark_skips_unwritten_gauge():
+    reg = Registry()
+    w = _watch_over(reg,
+                    watch.GaugeWatermark("serve.kv.fragmentation",
+                                         high=0.9),
+                    watch.GaugeWatermark("serve.occupancy_rows",
+                                         low=0.1))
+    assert w.poll() == []           # never written: skipped, not 0
+    reg.gauge("serve.kv.fragmentation").set(0.95)
+    reg.gauge("serve.occupancy_rows").set(0.05)
+    alerts = w.poll()
+    assert {a.metric for a in alerts} == {"serve.kv.fragmentation",
+                                          "serve.occupancy_rows"}
+
+
+def test_rate_alarm_windows_not_totals():
+    reg = Registry()
+    w = _watch_over(reg, watch.RateAlarm("serve.duplicate_commits"))
+    reg.counter("serve.duplicate_commits").add(2)
+    alerts = w.poll()
+    assert len(alerts) == 1 and alerts[0].severity == "error"
+    # no NEW movement: the cumulative total must not re-alarm
+    assert w.poll() == []
+
+
+# -- harness: events, verdict, bench integration --------------------
+
+def test_alerts_land_on_bus_and_in_verdict():
+    reg = Registry()
+    ring = obs.RingSink()
+    w = _watch_over(reg, watch.RateAlarm("serve.integrity_failures"))
+    with bus.installed(ring):
+        reg.counter("serve.integrity_failures").add(1)
+        w.poll()
+        verdict = w.verdict()
+    evs = ring.of_type("obs.alert")
+    assert len(evs) == 1
+    assert evs[0]["metric"] == "serve.integrity_failures"
+    assert evs[0]["severity"] == "error"
+    assert verdict["healthy"] is False and verdict["n_alerts"] == 1
+    assert verdict["alerts"][0]["watch"] == \
+        "rate[serve.integrity_failures]"
+    assert verdict["polls"] == 2    # explicit poll + verdict's final
+
+
+def test_clean_verdict_healthy():
+    reg = Registry()
+    w = watch.serve_watch(registry=reg, min_interval_s=0.0).attach()
+    reg.histogram("serve.ttft_ms").observe(10.0)
+    reg.counter("serve.tokens").add(100)
+    reg.gauge("serve.kv.fragmentation").set(0.2)
+    v = w.verdict()
+    assert v["healthy"] is True and v["n_alerts"] == 0
+    assert len(v["watchers"]) >= 8
+
+
+def test_watch_without_registry_is_inert():
+    w = watch.serve_watch().attach()    # no armed registry anywhere
+    w.maybe_poll()
+    assert w.poll() == []
+    assert w.verdict()["polls"] == 0
+
+
+def test_bench_serve_stamps_health(tmp_path):
+    """End-to-end: a tiny continuous bench arm with --watch under an
+    armed registry records a healthy verdict in its row."""
+    from icikit.bench.serve import make_workload, run_bench
+    with obs.session(trace=False):
+        recs = run_bench(
+            "tiny", rows=2, n_requests=3, rate_rps=100.0,
+            prompt_len=8, new_min=2, new_max=4, block_size=4,
+            mode="continuous", compute_dtype="float32", watch=True)
+    (rec,) = recs
+    h = rec["health"]
+    assert h["healthy"] is True and h["n_alerts"] == 0
+    assert h["polls"] >= 1
+    assert rec["tracing"] is False

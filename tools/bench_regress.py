@@ -1,0 +1,330 @@
+#!/usr/bin/env python
+"""Noise-aware bench regression gate over the committed jsonl ledgers.
+
+The repo's bench records (serve_r*.jsonl, decode_spec_r*.jsonl,
+scaling.jsonl, ...) are measurements, but nothing *guards* them: a PR
+that silently costs 20% of serving throughput lands green as long as
+the tests pass. This gate closes that hole mechanically:
+
+- **paired arms** — rows are grouped by their CONFIG KEY: every
+  string/bool field plus the known numeric workload knobs, minus
+  ``seed``. Only groups present in BOTH ledgers are compared, so a
+  fresh ledger may add arms freely and a baseline arm that was not
+  re-measured simply does not gate.
+- **provenance-checked** — ``backend`` / ``compute_dtype`` /
+  ``decode_quant`` / ``note`` are part of the key, so a CPU row can
+  never gate a TPU row (or vice versa): same-provenance rows compare,
+  different-provenance rows are disjoint groups.
+- **median-of-seeds** — within a group, the compared statistic is the
+  median across seed replicas, not any single noisy run.
+- **tolerance bands** — each metric carries a direction and a relative
+  tolerance (``--metric tokens_per_s:higher:0.1``); the effective band
+  additionally widens to the baseline group's own relative half-spread
+  across seeds, so a metric that is intrinsically noisy at this
+  workload scale cannot flap the gate.
+- **machine-readable verdict** — ``--verdict PATH`` writes the full
+  comparison (regressions, improvements, unmatched arms) as JSON; the
+  exit code is the gate.
+
+Modes::
+
+    # gate a fresh re-measure of a ledger's arms against the
+    # committed baseline (fails loudly when NOTHING paired — a gate
+    # that compared zero arms must not pass)
+    python tools/bench_regress.py --baseline serve_r15.jsonl \\
+        --fresh /tmp/serve_remeasure.jsonl --verdict /tmp/verdict.json
+
+    # self-check (make check): the unmodified ledger must pass against
+    # itself AND an injected 20% throughput regression must be flagged
+    python tools/bench_regress.py --self-check serve_r12.jsonl
+"""
+
+from __future__ import annotations
+
+import argparse
+import copy
+import json
+import statistics
+import sys
+
+# numeric fields that are workload CONFIG, not measurement (string and
+# bool fields are config by rule; "seed" is the replica axis)
+NUMERIC_CONFIG = {
+    "rows", "dp", "tp", "sp", "n_requests", "rate_rps", "prompt_len",
+    "new_min", "new_max", "block_size", "n_blocks", "speculate",
+    "tree_branch", "ngram_n", "prefix_len", "prefill_chunk",
+    "temperature", "top_k", "top_p", "distinct", "motif", "k", "b",
+    "batch", "n_new", "prompt", "draft_layers", "n_layers",
+    "train_steps", "distill_steps", "d_model", "n_heads", "d_head",
+    "d_ff", "vocab", "max_seq", "runs", "reps", "tokens_per_s_reps",
+}
+
+# (path, direction, default relative tolerance) — applied when the
+# metric resolves in both groups; unknown-to-a-ledger metrics just
+# don't gate it
+DEFAULT_METRICS = (
+    ("tokens_per_s", "higher", 0.10),
+    ("ttft_ms.p50", "lower", 0.50),
+    ("tpot_ms.p50", "lower", 0.50),
+    ("acceptance_rate", "higher", 0.10),
+    ("tokens_per_step", "higher", 0.10),
+)
+
+
+def load_rows(paths: list) -> list:
+    rows = []
+    for path in paths:
+        with open(path) as f:
+            for ln, line in enumerate(f, 1):
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    row = json.loads(line)
+                except json.JSONDecodeError as e:
+                    raise SystemExit(
+                        f"{path}:{ln}: not valid JSON ({e})")
+                if isinstance(row, dict):
+                    rows.append(row)
+    return rows
+
+
+def config_key(row: dict) -> tuple:
+    """The pairing identity of a row: sorted (field, value) over every
+    config field. Strings and bools are config by rule (that is what
+    makes the key provenance-checked: backend/compute_dtype/note are
+    strings); numbers only via the known-knob list; ``seed`` never."""
+    items = []
+    for k, v in row.items():
+        if k == "seed":
+            continue
+        if k == "tracing" and v is False:
+            # the r15 observability A/B field: False IS the historical
+            # default every pre-r15 row carries implicitly — dropping
+            # it lets fresh disarmed rows pair with committed
+            # baselines, while tracing-armed rows (measurably slower
+            # by design) stay a distinct arm
+            continue
+        if isinstance(v, bool) or isinstance(v, str):
+            items.append((k, v))
+        elif isinstance(v, (int, float)) and k in NUMERIC_CONFIG:
+            items.append((k, v))
+    return tuple(sorted(items))
+
+
+def resolve(row: dict, path: str):
+    """Dotted-path metric lookup (``ttft_ms.p50``); None when absent
+    or non-numeric."""
+    cur = row
+    for part in path.split("."):
+        if not isinstance(cur, dict) or part not in cur:
+            return None
+        cur = cur[part]
+    if isinstance(cur, bool) or not isinstance(cur, (int, float)):
+        return None
+    return float(cur)
+
+
+def group_rows(rows: list) -> dict:
+    groups: dict = {}
+    for row in rows:
+        groups.setdefault(config_key(row), []).append(row)
+    return groups
+
+
+def _median_and_spread(vals: list) -> tuple:
+    """(median, relative half-spread) across seed replicas — the
+    spread is the noise floor the tolerance band widens to."""
+    med = statistics.median(vals)
+    if len(vals) < 2 or med == 0:
+        return med, 0.0
+    half = (max(vals) - min(vals)) / 2.0
+    return med, abs(half / med)
+
+
+def compare(baseline_rows: list, fresh_rows: list,
+            metrics=DEFAULT_METRICS) -> dict:
+    """The gate: returns the verdict dict (``ok`` == no regression)."""
+    base = group_rows(baseline_rows)
+    fresh = group_rows(fresh_rows)
+    shared = [k for k in fresh if k in base]
+    regressions, improvements, compared = [], [], 0
+    for key in shared:
+        label = {k: v for k, v in key}
+        label = {k: label[k] for k in
+                 ("kind", "mode", "backend", "preset", "drafter")
+                 if k in label}
+        for path, direction, tol in metrics:
+            bvals = [v for v in (resolve(r, path) for r in base[key])
+                     if v is not None]
+            fvals = [v for v in (resolve(r, path) for r in fresh[key])
+                     if v is not None]
+            if not bvals or not fvals:
+                continue
+            bmed, bnoise = _median_and_spread(bvals)
+            fmed, _ = _median_and_spread(fvals)
+            compared += 1
+            if bmed == 0:
+                continue
+            band = max(tol, bnoise)
+            ratio = fmed / bmed
+            worse = (ratio < 1.0 - band if direction == "higher"
+                     else ratio > 1.0 + band)
+            better = (ratio > 1.0 + band if direction == "higher"
+                      else ratio < 1.0 - band)
+            entry = {
+                "metric": path, "direction": direction,
+                "baseline": bmed, "fresh": fmed,
+                "ratio": round(ratio, 4), "band": round(band, 4),
+                "n_baseline": len(bvals), "n_fresh": len(fvals),
+                "arm": label,
+            }
+            if worse:
+                regressions.append(entry)
+            elif better:
+                improvements.append(entry)
+    return {
+        "ok": not regressions,
+        "compared": compared,
+        "paired_arms": len(shared),
+        "fresh_only_arms": len(fresh) - len(shared),
+        "baseline_only_arms": len(base) - len(shared),
+        "regressions": regressions,
+        "improvements": improvements,
+    }
+
+
+def parse_metric(spec: str) -> tuple:
+    parts = spec.split(":")
+    if len(parts) != 3 or parts[1] not in ("higher", "lower"):
+        raise SystemExit(
+            f"bad --metric {spec!r} (want PATH:higher|lower:TOL)")
+    return parts[0], parts[1], float(parts[2])
+
+
+def self_check(paths: list, metrics, inject: float = 0.8) -> dict:
+    """The gate's own drill (``make check``): the unmodified ledger
+    must pass against itself, and a synthetic throughput regression
+    (every higher-is-better metric scaled by ``inject``) must be
+    flagged — a gate that cannot see a planted 20% loss is not a
+    gate."""
+    rows = load_rows(paths)
+    if not rows:
+        raise SystemExit(f"no rows in {paths}")
+    clean = compare(rows, rows, metrics)
+    hurt = copy.deepcopy(rows)
+    n_injected = 0
+    for row in hurt:
+        for path, direction, _ in metrics:
+            if direction != "higher":
+                continue
+            cur = resolve(row, path)
+            if cur is None:
+                continue
+            # dotted paths: walk to the leaf's parent
+            parts = path.split(".")
+            parent = row
+            for p in parts[:-1]:
+                parent = parent[p]
+            parent[parts[-1]] = cur * inject
+            n_injected += 1
+    injected = compare(rows, hurt, metrics)
+    return {
+        "mode": "self-check",
+        "ledgers": paths,
+        "rows": len(rows),
+        "clean_pass": clean["ok"],
+        "clean": clean,
+        "injected_scale": inject,
+        "injected_metrics": n_injected,
+        "injection_flagged": bool(injected["regressions"]),
+        "injected": injected,
+        "ok": clean["ok"] and (n_injected == 0
+                              or bool(injected["regressions"])),
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--baseline", action="append", default=[],
+                    metavar="JSONL", help="committed baseline ledger "
+                    "(repeatable; rows pool)")
+    ap.add_argument("--fresh", action="append", default=[],
+                    metavar="JSONL", help="freshly measured ledger "
+                    "(repeatable)")
+    ap.add_argument("--metric", action="append", default=[],
+                    metavar="PATH:DIR:TOL",
+                    help="gate metric, e.g. tokens_per_s:higher:0.1 "
+                         "(repeatable; replaces the defaults)")
+    ap.add_argument("--self-check", nargs="+", default=None,
+                    metavar="JSONL",
+                    help="gate drill: ledger(s) must pass against "
+                         "themselves and flag an injected regression")
+    ap.add_argument("--inject", type=float, default=0.8,
+                    help="self-check injection scale on "
+                         "higher-is-better metrics (default 0.8 = "
+                         "a 20%% loss)")
+    ap.add_argument("--verdict", default=None, metavar="PATH",
+                    help="write the machine-readable verdict JSON")
+    ap.add_argument("--require-paired", type=int, default=1,
+                    metavar="N", help="fail unless at least N arms "
+                    "paired (default 1: a gate that compared nothing "
+                    "must FAIL, not silently pass — 0 opts out)")
+    args = ap.parse_args(argv)
+    metrics = ([parse_metric(m) for m in args.metric]
+               if args.metric else DEFAULT_METRICS)
+    if args.self_check is not None:
+        if args.baseline or args.fresh:
+            raise SystemExit("--self-check excludes --baseline/--fresh")
+        verdict = self_check(args.self_check, metrics, args.inject)
+        desc = (f"self-check {', '.join(args.self_check)}: "
+                f"clean_pass={verdict['clean_pass']} "
+                f"injection_flagged={verdict['injection_flagged']} "
+                f"({verdict['rows']} rows, "
+                f"{verdict['clean']['paired_arms']} arms)")
+    else:
+        if not args.baseline or not args.fresh:
+            ap.error("need --baseline and --fresh (or --self-check)")
+        verdict = compare(load_rows(args.baseline),
+                          load_rows(args.fresh), metrics)
+        verdict["mode"] = "gate"
+        if verdict["paired_arms"] < args.require_paired:
+            verdict["ok"] = False
+            verdict["error"] = (
+                f"only {verdict['paired_arms']} arms paired "
+                f"(require {args.require_paired}) — config keys "
+                "probably drifted")
+        desc = (f"gate: {verdict['paired_arms']} arms paired, "
+                f"{verdict['compared']} metric comparisons, "
+                f"{len(verdict['regressions'])} regressions, "
+                f"{len(verdict['improvements'])} improvements")
+    if args.verdict:
+        with open(args.verdict, "w") as f:
+            json.dump(verdict, f, indent=1)
+    ok = verdict["ok"]
+    print(("PASS " if ok else "FAIL ") + desc)
+    if "error" in verdict:
+        print(f"  {verdict['error']}", file=sys.stderr)
+    # self-check failures are the CLEAN pass's regressions (the
+    # injected pass is SUPPOSED to regress — only its absence fails)
+    detail = (verdict["clean"]["regressions"]
+              if verdict.get("mode") == "self-check"
+              else verdict.get("regressions", []))
+    for r in detail:
+        print(f"  REGRESSION {r['metric']} {r['baseline']:.4g} -> "
+              f"{r['fresh']:.4g} (ratio {r['ratio']}, band "
+              f"{r['band']}) arm={r['arm']}", file=sys.stderr)
+    if (verdict.get("mode") == "self-check"
+            and not verdict["injection_flagged"]
+            and verdict["injected_metrics"]):
+        print("  injected regression NOT flagged — tolerance bands "
+              "swallow a planted "
+              f"{1 - verdict['injected_scale']:.0%} loss",
+              file=sys.stderr)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,62 @@
+#!/usr/bin/env python
+"""Lint: every serving / speculation telemetry name emitted in code
+must appear in docs/OBSERVABILITY.md.
+
+The watch layer and the bench regression gate both key on metric NAMES
+(``serve.ttft_ms``, ``decode.spec.draft_accepted``, ...). A counter
+that exists in code but not in the catalog is telemetry nobody can
+alarm on or will remember exists; a renamed counter silently orphans
+its alert rule. This lint walks ``icikit/`` for literal
+``obs.count/observe/gauge/emit`` names under the ``serve.*`` and
+``decode.spec.*`` prefixes — plus the async request-span names the
+trace_ctx layer opens — and fails on any name the catalog does not
+mention. (The doc may document MORE than code emits — planned names
+are fine; the failure mode is only code the doc lost track of.)
+"""
+
+from __future__ import annotations
+
+import pathlib
+import re
+import sys
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+DOC = ROOT / "docs" / "OBSERVABILITY.md"
+
+EMIT_RE = re.compile(
+    r'obs\.(?:count|observe|gauge|emit)\(\s*"'
+    r'((?:serve|decode\.spec)\.[^"]+)"')
+# request-scoped async span/instant names (trace_ctx call sites in
+# serve/: self-opens inside trace_ctx.py itself count too)
+CTX_RE = re.compile(
+    r'\.(?:open|close|instant|span)\(\s*"(serve\.req[^"]*)"')
+
+
+def emitted_names() -> set:
+    names = set()
+    for path in sorted((ROOT / "icikit").rglob("*.py")):
+        text = path.read_text()
+        names.update(EMIT_RE.findall(text))
+        names.update(CTX_RE.findall(text))
+    return names
+
+
+def main() -> int:
+    if not DOC.exists():
+        print(f"obs catalog lint: {DOC} missing", file=sys.stderr)
+        return 1
+    doc = DOC.read_text()
+    missing = sorted(n for n in emitted_names() if n not in doc)
+    if missing:
+        print("telemetry emitted in code but absent from "
+              "docs/OBSERVABILITY.md's catalog:", file=sys.stderr)
+        for n in missing:
+            print(f"  {n}", file=sys.stderr)
+        return 1
+    print(f"obs catalog lint OK: {len(emitted_names())} "
+          "serve.*/decode.spec.* names all catalogued")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,106 @@
+#!/usr/bin/env python
+"""`make obs-smoke` assertion half: the exported trace must hold at
+least one COMPLETE request span tree and the bench row's health
+verdict must be clean.
+
+Usage::
+
+    python tools/obs_smoke_check.py TRACE_JSON BENCH_JSONL
+
+Checks (beyond ``icikit.obs.check``'s structural validation, which
+the Makefile runs separately):
+
+- the trace contains >= 1 ``serve.req`` async tree, and every tree is
+  WHOLE: balanced b/e, a ``serve.req`` root that closed on its own
+  (no ``closed_by: export`` synthetics — a clean drained run has no
+  dangling request state), at least one prefill span and one step
+  participation instant among the trees;
+- the bench jsonl's continuous row carries ``health.healthy == true``
+  with zero alerts (the clean-run half of the watch contract; the
+  chaos soaks assert the opposite on drilled runs).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if ROOT not in sys.path:  # runnable as `python tools/obs_smoke_check.py`
+    sys.path.insert(0, ROOT)
+
+from icikit.obs import trace_ctx  # noqa: E402
+
+
+def check_trace(path: str) -> list:
+    with open(path) as f:
+        events = json.load(f).get("traceEvents", [])
+    problems = []
+    trees = trace_ctx.request_trees(events)
+    if not trees:
+        return [f"{path}: no serve.req request trees in trace"]
+    saw_prefill = saw_step = False
+    for tid, evs in trees.items():
+        opens = sum(1 for e in evs if e["ph"] == "b")
+        closes = sum(1 for e in evs if e["ph"] == "e")
+        if opens != closes:
+            problems.append(f"{tid}: {opens} opens vs {closes} closes")
+        if not any(e["ph"] == "b" and e["name"] == "serve.req"
+                   for e in evs):
+            problems.append(f"{tid}: no serve.req root span")
+        synth = [e["name"] for e in evs
+                 if e.get("args", {}).get("closed_by") == "export"]
+        if synth:
+            problems.append(
+                f"{tid}: spans only closed by export: {synth} "
+                "(request state dangled past drain)")
+        names = {e["name"] for e in evs}
+        saw_prefill |= bool(names & {"serve.req.prefill.chunk",
+                                     "serve.req.prefill.whole"})
+        saw_step |= "serve.req.step" in names
+    if not saw_prefill:
+        problems.append("no request tree holds a prefill span")
+    if not saw_step:
+        problems.append("no request tree holds a step instant")
+    if not problems:
+        print(f"obs-smoke trace OK: {len(trees)} complete request "
+              f"tree(s)")
+    return problems
+
+
+def check_health(path: str) -> list:
+    rows = [json.loads(l) for l in open(path) if l.strip()]
+    cont = [r for r in rows if r.get("mode") == "continuous"]
+    if not cont:
+        return [f"{path}: no continuous bench row"]
+    problems = []
+    for r in cont:
+        h = r.get("health")
+        if not isinstance(h, dict):
+            problems.append(f"{path}: row has no health verdict "
+                            "(watch not armed?)")
+        elif not h.get("healthy") or h.get("n_alerts"):
+            problems.append(f"{path}: clean run verdicted unhealthy: "
+                            f"{h.get('alerts')}")
+        elif h.get("polls", 0) < 1:
+            problems.append(f"{path}: watch never polled")
+    if not problems:
+        print(f"obs-smoke health OK: {len(cont)} clean continuous "
+              "row(s), zero alerts")
+    return problems
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if len(argv) != 2:
+        print(__doc__, file=sys.stderr)
+        return 2
+    problems = check_trace(argv[0]) + check_health(argv[1])
+    for p in problems:
+        print(f"OBS-SMOKE FAIL: {p}", file=sys.stderr)
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

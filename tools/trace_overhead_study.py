@@ -1,0 +1,124 @@
+#!/usr/bin/env python
+"""Round-15 tracing-overhead study: serve_r15.jsonl.
+
+The acceptance bar for request-scoped tracing: at saturated load the
+fully-armed observability stack (trace buffer + request trees +
+metrics + anomaly watch) must cost <= 5% tokens/s on the serve hot
+path vs the disarmed engine, the armed run's exported trace must be
+chrome-checker-valid and hold a COMPLETE span tree for every request,
+and the clean run must verdict healthy. Both arms land in
+serve_r15.jsonl (config-keyed by the ``tracing`` field, median of
+``--seeds`` replicas each), plus one summary row carrying the
+measured overhead verdict.
+
+Usage::
+
+    JAX_PLATFORMS=cpu python tools/trace_overhead_study.py \\
+        --json serve_r15.jsonl --trace /tmp/icikit_r15_trace.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if ROOT not in sys.path:
+    sys.path.insert(0, ROOT)
+
+from icikit import obs  # noqa: E402
+from icikit.obs import chrome, trace_ctx  # noqa: E402
+from icikit.bench.serve import run_bench  # noqa: E402
+
+ARM = dict(preset="tiny", rows=4, n_requests=24, rate_rps=1000.0,
+           prompt_len=16, new_min=32, new_max=64, block_size=8,
+           speculate=3, drafter="suffix", prefill_chunk=16,
+           compute_dtype="float32", mode="continuous")
+
+
+def run_arm(seed: int, armed: bool, trace_path: str | None):
+    """One replica: fully armed (trace + metrics + watch) or fully
+    disarmed. The armed replica exports and validates its trace and
+    asserts one complete request tree per completed request."""
+    if not armed:
+        (rec,) = run_bench(seed=seed, **ARM)
+        return rec
+    with obs.session() as s:
+        (rec,) = run_bench(seed=seed, watch=True, **ARM)
+        events = s.trace.snapshot()
+    problems = obs.validate_trace(events)
+    assert not problems, problems[:5]
+    trees = trace_ctx.request_trees(events)
+    # warm-up prompts trace too: at LEAST one tree per timed request
+    assert len(trees) >= rec["completed"], (len(trees),
+                                            rec["completed"])
+    whole = sum(
+        1 for evs in trees.values()
+        if sum(e["ph"] == "b" for e in evs)
+        == sum(e["ph"] == "e" for e in evs)
+        and any(e["ph"] == "b" and e["name"] == "serve.req"
+                for e in evs))
+    assert whole == len(trees), (whole, len(trees))
+    assert rec["health"]["healthy"], rec["health"]["alerts"]
+    rec["trace_events"] = len(events)
+    rec["request_trees"] = len(trees)
+    if trace_path:
+        chrome.export(trace_path, events)
+    return rec
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--json", default="serve_r15.jsonl")
+    ap.add_argument("--trace", default="/tmp/icikit_r15_trace.json")
+    ap.add_argument("--seeds", type=int, default=4)
+    ap.add_argument("--bar", type=float, default=0.05,
+                    help="max acceptable relative tokens/s loss")
+    args = ap.parse_args(argv)
+    rows = []
+    tps = {False: [], True: []}
+    for seed in range(args.seeds):
+        # INTERLEAVED arms, order alternating per seed: host drift
+        # (thermal, page cache, allocator state) over a sequential
+        # all-A-then-all-B layout reads as fake overhead at this
+        # measurement scale (observed ~±5% run-to-run on XLA:CPU)
+        order = (False, True) if seed % 2 == 0 else (True, False)
+        for armed in order:
+            rec = run_arm(seed, armed,
+                          args.trace if armed and seed == 0 else None)
+            rec["study"] = "trace_overhead_r15"
+            rows.append(rec)
+            tps[armed].append(rec["tokens_per_s"])
+            print(f"armed={armed} seed={seed}: "
+                  f"{rec['tokens_per_s']} tok/s", flush=True)
+    base = statistics.median(tps[False])
+    armed_tps = statistics.median(tps[True])
+    overhead = 1.0 - armed_tps / base
+    summary = {
+        "kind": "serve_trace_overhead",
+        "study": "trace_overhead_r15",
+        "seeds": args.seeds,
+        "arm": {k: v for k, v in ARM.items()},
+        "tokens_per_s_disarmed": base,
+        "tokens_per_s_armed": armed_tps,
+        "overhead_frac": round(overhead, 4),
+        "bar_frac": args.bar,
+        "within_bar": overhead <= args.bar,
+        "note": "CPU-measured; armed = trace buffer + request trees "
+                "+ metrics + watch, disarmed = all probes on the "
+                "one-global-read fast path",
+    }
+    rows.append(summary)
+    with open(args.json, "a") as f:
+        for rec in rows:
+            f.write(json.dumps(rec) + "\n")
+    print(f"overhead: {overhead:+.2%} (bar {args.bar:.0%}) -> "
+          f"{'OK' if summary['within_bar'] else 'OVER BAR'}")
+    return 0 if summary["within_bar"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -55,7 +55,7 @@ check:
 	$(PY) tools/tree_accept_lint.py
 	$(PY) tools/obs_catalog_lint.py
 	$(PY) tools/bench_regress.py --self-check serve_r12.jsonl \
-		serve_r15.jsonl decode_spec_r14.jsonl \
+		serve_r15.jsonl serve_r16.jsonl decode_spec_r14.jsonl \
 		--verdict /tmp/icikit_bench_regress.json
 
 # request-scoped tracing + anomaly watch, end to end: a tiny Poisson
@@ -179,6 +179,31 @@ serve-smoke:
 	@grep -q '"serve.prefix.inflight_hits"' /tmp/icikit_serve_sampled_metrics.json && \
 		grep -q '"serve.ttft_ms"' /tmp/icikit_serve_sampled_metrics.json && \
 		echo "serve-smoke sampled OK: sampled duplicate-prompt trace valid, in-flight dedup waiters on the bus"
+	rm -rf /tmp/icikit_smoke_store
+	JAX_PLATFORMS=cpu \
+	ICIKIT_OBS="trace=/tmp/icikit_serve_spill_trace.json;metrics=/tmp/icikit_serve_spill_metrics.json;jsonl=off" \
+	$(PY) -m icikit.bench.serve --preset tiny --rows 2 --requests 14 \
+		--rate 200 --prompt 16 --prefix 12 --tenants 4 --zipf 0.0 \
+		--new-min 4 --new-max 8 --block-size 4 --blocks 13 \
+		--host-blocks 16 --prefill-chunk 8 --compute-dtype float32 \
+		--mode continuous --seed 0 \
+		--store-dir /tmp/icikit_smoke_store --verify-identity > /dev/null
+	$(PY) -m icikit.obs.check /tmp/icikit_serve_spill_trace.json
+	@grep -q '"serve.prefix.spill_hits"' /tmp/icikit_serve_spill_metrics.json && \
+		grep -q '"serve.prefix.restores"' /tmp/icikit_serve_spill_metrics.json && \
+		echo "serve-smoke spill OK: tiny-pool Zipf traffic spilled and swapped back in, identity-audited"
+	JAX_PLATFORMS=cpu \
+	ICIKIT_OBS="trace=/tmp/icikit_serve_rewarm_trace.json;metrics=/tmp/icikit_serve_rewarm_metrics.json;jsonl=off" \
+	$(PY) -m icikit.bench.serve --preset tiny --rows 2 --requests 14 \
+		--rate 200 --prompt 16 --prefix 12 --tenants 4 --zipf 0.0 \
+		--new-min 4 --new-max 8 --block-size 4 --blocks 13 \
+		--host-blocks 16 --prefill-chunk 8 --compute-dtype float32 \
+		--mode continuous --seed 0 \
+		--store-dir /tmp/icikit_smoke_store --rewarm \
+		--verify-identity > /dev/null
+	$(PY) -m icikit.obs.check /tmp/icikit_serve_rewarm_trace.json
+	@grep -q '"serve.store.rewarm_blocks"' /tmp/icikit_serve_rewarm_metrics.json && \
+		echo "serve-smoke rewarm OK: restarted engine re-warmed the pending prompts from the persisted store, identity-audited"
 
 bench:
 	$(PY) bench.py

@@ -55,7 +55,8 @@ def make_workload(n_requests: int, rate_rps: float, prompt_len: int,
                   seed: int = 0, prefix_len: int = 0,
                   distinct: int = 0,
                   seed_per_request: bool = False,
-                  motif: int = 0) -> list:
+                  motif: int = 0, tenants: int = 0,
+                  zipf: float = 1.0) -> list:
     """Seeded Poisson trace: ``[(offset_s, prompt, n_new, rseed), ...]``
     with exponential inter-arrivals at ``rate_rps`` and per-request
     lengths uniform in ``[new_min, new_max]``. ``prefix_len`` > 0
@@ -74,7 +75,16 @@ def make_workload(n_requests: int, rate_rps: float, prompt_len: int,
     TILED to ``prompt_len`` — the repetitive/extractive traffic shape
     (structured text, code, quotes) where suffix-match drafting earns
     its keep; continuations over such contexts loop, which is what
-    the r9/r12 speculation rows price."""
+    the r9/r12 speculation rows price.
+
+    ``tenants`` > 0 is the r16 multi-tenant shape: each tenant owns
+    its OWN shared ``prefix_len``-token prefix (its system prompt /
+    few-shot header) and arrivals pick a tenant Zipf-distributed with
+    exponent ``zipf`` (P(rank r) ∝ 1/r^zipf) — the hot tenants' prefix
+    chains stay device-resident while the tail tenants' get evicted
+    under pool pressure, which is exactly the population the spill
+    tier exists to keep serving. Requires ``prefix_len`` > 0; prompt
+    suffixes stay fresh per arrival."""
     if not 0 <= prefix_len <= prompt_len:
         raise ValueError(
             f"prefix_len must be in [0, prompt_len], got {prefix_len}")
@@ -85,17 +95,33 @@ def make_workload(n_requests: int, rate_rps: float, prompt_len: int,
     if motif and prefix_len:
         raise ValueError("motif and prefix_len are exclusive "
                          "workload shapes")
+    if tenants < 0:
+        raise ValueError(f"tenants must be >= 0, got {tenants}")
+    if tenants and not prefix_len:
+        raise ValueError("tenants needs prefix_len > 0 (each tenant "
+                         "owns a shared prompt prefix)")
+    if tenants and (distinct or motif):
+        raise ValueError("tenants and distinct/motif are exclusive "
+                         "workload shapes")
+    if zipf < 0:
+        raise ValueError(f"zipf must be >= 0, got {zipf}")
     rng = np.random.default_rng(seed)
     gaps = rng.exponential(1.0 / rate_rps, size=n_requests)
     offsets = np.cumsum(gaps)
     prefix = rng.integers(0, vocab, (prefix_len,)).astype(np.int32)
+    tprefix = [rng.integers(0, vocab, (prefix_len,)).astype(np.int32)
+               for _ in range(tenants)]
+    if tenants:
+        w = 1.0 / np.arange(1, tenants + 1, dtype=np.float64) ** zipf
+        tprobs = w / w.sum()
 
-    def draw_prompt():
+    def draw_prompt(tenant=None):
         if motif:
             m = rng.integers(0, vocab, (motif,)).astype(np.int32)
             return np.tile(m, -(-prompt_len // motif))[:prompt_len]
+        head = prefix if tenant is None else tprefix[tenant]
         return np.concatenate([
-            prefix, rng.integers(0, vocab, (prompt_len - prefix_len,))
+            head, rng.integers(0, vocab, (prompt_len - prefix_len,))
             .astype(np.int32)])
 
     pool = ([draw_prompt() for _ in range(distinct)] if distinct
@@ -104,6 +130,8 @@ def make_workload(n_requests: int, rate_rps: float, prompt_len: int,
     for i in range(n_requests):
         if pool is not None:
             prompt = pool[i % distinct]
+        elif tenants:
+            prompt = draw_prompt(int(rng.choice(tenants, p=tprobs)))
         else:
             prompt = draw_prompt()
         n_new = int(rng.integers(new_min, new_max + 1))
@@ -145,7 +173,7 @@ def run_continuous(params, mesh, cfg, serve_cfg, workload,
                    max_retries: int = 2, warm: list | None = None,
                    verify: bool = False, temperature: float = 0.0,
                    top_k: int = 0, top_p: float = 1.0,
-                   watch: bool = False) -> dict:
+                   watch: bool = False, rewarm: bool = False) -> dict:
     """Drive the engine over the arrival trace; returns the record.
     ``verify=True`` re-decodes every completed request through
     single-request ``greedy_generate`` — or, for sampled arms
@@ -173,6 +201,21 @@ def run_continuous(params, mesh, cfg, serve_cfg, workload,
         eng.submit(wp, 2, temperature=temperature, top_k=top_k,
                    top_p=top_p)
         eng.run()
+    if serve_cfg.host_cache_blocks > 0 or serve_cfg.store_dir:
+        # tier-program warm at POST-STEP arena shardings: jit keys on
+        # input shardings, so the spill-snapshot / restore-write
+        # variants the timed window's first eviction hits only exist
+        # once warmed AFTER a decode step has round-tripped the pool
+        # buffers (the warm_prompts sharding rule, extended to the
+        # tier programs) — then one more warm decode so the step
+        # program's post-flush variant is compiled too
+        eng.pool.warm_restore(
+            max(1, serve_cfg.prefill_chunk // serve_cfg.block_size),
+            max_evict=eng.nb_per_row)
+        wp = (warm if warm is not None else [workload[0][1]])[-1]
+        eng.submit(wp, 2, temperature=temperature, top_k=top_k,
+                   top_p=top_p)
+        eng.run()
     assert not eng.queue.failed
     eng.reset_stats()   # keep the warm-up out of occupancy/step figures
     w = None
@@ -190,6 +233,12 @@ def run_continuous(params, mesh, cfg, serve_cfg, workload,
                        temperature=temperature, top_k=top_k,
                        top_p=top_p)
             for off, p, n, rs in workload]
+    rewarm_blocks = 0
+    if rewarm:
+        # eager restart-rewarm INSIDE the timed window: the rewarm
+        # cost is part of time-to-first-completion, which is the
+        # honest quantity the cold-vs-rewarm A/B compares
+        rewarm_blocks = eng.rewarm(eng.queue.pending_prompts())
     eng.run(watch=w)
     makespan = time.monotonic() - t0
     ttft, tpot, qwait, gaps, tokens = [], [], [], [], 0
@@ -250,6 +299,8 @@ def run_continuous(params, mesh, cfg, serve_cfg, workload,
         "prefill_tokens_computed": prefix["prefill_tokens"],
         "prefix": prefix,
     }
+    if rewarm:
+        rec["rewarm_blocks"] = rewarm_blocks
     if watch:
         # per-run health verdict (None = watch asked for but metrics
         # disarmed — recorded as an explicit blind spot, not dropped)
@@ -407,7 +458,10 @@ def run_bench(preset: str, rows: int, n_requests: int, rate_rps: float,
               inflight_dedup: bool | str = "auto",
               motif: int = 0, model: tuple | None = None,
               workload: list | None = None,
-              watch: bool = False) -> list[dict]:
+              watch: bool = False, tenants: int = 0,
+              zipf: float = 1.0, host_blocks: int = 0,
+              store_dir: str | None = None,
+              rewarm: bool = False) -> list[dict]:
     """``model=(params, mesh, cfg)`` overrides the preset-constructed
     random-init model (the r12 study serves a Markov-TRAINED toy —
     random init has no confident regime, so low-temperature draws
@@ -470,14 +524,17 @@ def run_bench(preset: str, rows: int, n_requests: int, rate_rps: float,
                             prefix_cache=prefix_cache,
                             prefill_chunk=prefill_chunk,
                             drafter=drafter,
-                            inflight_dedup=inflight_dedup)
+                            inflight_dedup=inflight_dedup,
+                            host_cache_blocks=host_blocks,
+                            store_dir=store_dir)
     if workload is None:
         workload = make_workload(n_requests, rate_rps, prompt_len,
                                  new_min, new_max, cfg.vocab, seed,
                                  prefix_len=prefix_len,
                                  distinct=distinct,
                                  seed_per_request=seed_per_request,
-                                 motif=motif)
+                                 motif=motif, tenants=tenants,
+                                 zipf=zipf)
     warm = warm_prompts(workload, cfg.vocab, prefix_len, seed)
     common = {
         "kind": "serve",
@@ -508,6 +565,13 @@ def run_bench(preset: str, rows: int, n_requests: int, rate_rps: float,
         "inflight_dedup": (prefix_cache if inflight_dedup == "auto"
                            else bool(inflight_dedup)),
         "motif": motif,
+        # tiered KV (r16): the multi-tenant Zipf workload shape and
+        # the tier configuration — all part of the pairing key
+        "tenants": tenants,
+        "zipf": zipf,
+        "host_cache_blocks": host_blocks,
+        "store": bool(store_dir),
+        "rewarm": rewarm,
         # whether request-scoped tracing was armed for this row — the
         # serve_r15 overhead A/B pairs rows on this key
         "tracing": obs.tracing() is not None,
@@ -521,7 +585,7 @@ def run_bench(preset: str, rows: int, n_requests: int, rate_rps: float,
         recs.append({**common, **run_continuous(
             params, mesh, cfg, serve_cfg, workload, warm=warm,
             verify=verify, temperature=temperature, top_k=top_k,
-            top_p=top_p, watch=watch)})
+            top_p=top_p, watch=watch, rewarm=rewarm)})
     if mode in ("both", "static"):
         recs.append({**common, **run_static(
             params, mesh, cfg, rows, workload,
@@ -603,6 +667,28 @@ def main(argv=None) -> int:
                     help="repetitive workload: each prompt is a "
                          "random M-token motif tiled to the prompt "
                          "length (0 = fully random prompts)")
+    ap.add_argument("--tenants", type=int, default=0, metavar="N",
+                    help="multi-tenant workload (r16): N tenants each "
+                         "owning their OWN shared --prefix-token "
+                         "prompt head, arrivals Zipf-distributed "
+                         "across tenants (0 = single shared prefix)")
+    ap.add_argument("--zipf", type=float, default=1.0, metavar="S",
+                    help="Zipf exponent for --tenants (P(rank r) ∝ "
+                         "1/r^S; 0 = uniform)")
+    ap.add_argument("--host-blocks", type=int, default=0,
+                    help="host-memory spill tier capacity in blocks "
+                         "(0 = off): evicted indexed pages spill to "
+                         "host memory and swap back in on a prefix "
+                         "hit, digest-verified")
+    ap.add_argument("--store-dir", default=None, metavar="DIR",
+                    help="persistent content-addressed block store: "
+                         "finalized blocks write through and a "
+                         "restarted engine re-warms from disk")
+    ap.add_argument("--rewarm", action="store_true",
+                    help="eagerly rewarm the pool from --store-dir "
+                         "for the queued prompts before serving "
+                         "(inside the timed window — the rewarm "
+                         "cost is part of time-to-first-completion)")
     ap.add_argument("--speculate", type=int, default=1, metavar="K",
                     help="k-token ngram-drafted verify windows "
                          "(1 = single-token decode)")
@@ -649,7 +735,10 @@ def main(argv=None) -> int:
                      args.seed_per_request, args.distinct,
                      {"on": True, "off": False,
                       "auto": "auto"}[args.inflight_dedup],
-                     args.motif, watch=args.watch)
+                     args.motif, watch=args.watch,
+                     tenants=args.tenants, zipf=args.zipf,
+                     host_blocks=args.host_blocks,
+                     store_dir=args.store_dir, rewarm=args.rewarm)
     obs.emit_records(recs)
     if args.json_path:
         # append: record files accumulate across invocations

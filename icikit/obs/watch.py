@@ -26,6 +26,11 @@ Detectors (the serve catalog — docs/OBSERVABILITY.md):
 - :class:`GaugeWatermark` — high/low watermarks on gauges
   (``serve.kv.fragmentation`` high, ``serve.kv.occupancy`` high,
   ``serve.occupancy_rows`` low at saturation).
+- :class:`SpillThrash` — spill-tier thrash watermark (r16): windowed
+  restore rate ~ eviction rate with real volume on both means the
+  tiered KV cache is churning (restored blocks evicted again inside
+  one window) instead of serving — the device pool is under-sized
+  for the working set.
 - :class:`RateAlarm` — windowed counter-rate alarms where the healthy
   rate is (near) zero: duplicate commits, integrity failures,
   quarantined pages, reissues.
@@ -162,6 +167,42 @@ class GaugeWatermark(Watcher):
             out.append(Alert(self.name, self.gauge, v, self.low,
                              detail="below low watermark"))
         return out
+
+
+class SpillThrash(Watcher):
+    """Spill-tier thrash (r16): windowed restore rate ~ eviction rate
+    with real volume on both — blocks the tier swaps back in are
+    being evicted again within the window, so the tier is churning
+    memory bandwidth instead of serving the prefix population (the
+    device pool is simply too small for the working set). Both
+    counters must clear ``min_blocks`` and their ratio must sit
+    inside ``band`` of 1.0 — a healthy warm-up window restores
+    without evicting, and a healthy pressure window evicts cold
+    content without re-restoring it."""
+
+    def __init__(self, min_blocks: int = 16, band: float = 0.5,
+                 restores: str = "serve.prefix.restores",
+                 evictions: str = "serve.kv.evictions"):
+        self.min_blocks = min_blocks
+        self.band = band
+        self.restores = restores
+        self.evictions = evictions
+        self.name = f"spill_thrash[{restores}]"
+
+    def check(self, window: dict, snap: dict) -> list:
+        c = window["counters"]
+        r = c.get(self.restores, 0)
+        e = c.get(self.evictions, 0)
+        if r < self.min_blocks or e < self.min_blocks:
+            return []
+        ratio = r / e
+        if not (1.0 - self.band) <= ratio <= (1.0 + self.band):
+            return []
+        return [Alert(self.name, self.restores, round(ratio, 4),
+                      self.band,
+                      detail=f"{r} restores ~ {e} evictions in one "
+                             "window — spill tier churning, device "
+                             "pool under-sized for the working set")]
 
 
 class RateAlarm(Watcher):
@@ -301,8 +342,9 @@ def serve_watch(ttft_slo_ms: float = 5_000.0,
                 min_interval_s: float = 0.05) -> Watch:
     """The standard serving watch: SLO burn on the three latency
     histograms, speculation acceptance floor, KV
-    fragmentation/occupancy watermarks, and zero-tolerance alarms on
-    duplicate commits, integrity failures, and quarantined pages.
+    fragmentation/occupancy watermarks, the spill-tier thrash
+    detector, and zero-tolerance alarms on duplicate commits,
+    integrity failures, and quarantined pages.
     Defaults are deliberately loose for CPU-scale smoke traffic — a
     clean run must verdict healthy; tune per deployment."""
     return Watch(
@@ -312,6 +354,7 @@ def serve_watch(ttft_slo_ms: float = 5_000.0,
         AcceptanceDrop(acceptance_floor),
         GaugeWatermark("serve.kv.fragmentation", high=frag_high),
         GaugeWatermark("serve.kv.occupancy", high=occupancy_high),
+        SpillThrash(),
         RateAlarm("serve.duplicate_commits"),
         RateAlarm("serve.integrity_failures"),
         RateAlarm("serve.prefix.quarantined"),

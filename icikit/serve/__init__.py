@@ -26,6 +26,9 @@ from icikit.serve.ngram_draft import (  # noqa: F401
     ngram_propose,
     ngram_propose_host,
 )
+from icikit.serve.store import (  # noqa: F401
+    PrefixStore,
+)
 from icikit.serve.scheduler import (  # noqa: F401
     PoisonedPromptError,
     Request,

@@ -213,6 +213,24 @@ class ServeConfig:
     # identical to an unquantized engine (the containment pin in
     # tests/test_serve_quant.py)
     kv_quant: str = "auto"
+    # tiered KV (r16). host_cache_blocks > 0 attaches the host-memory
+    # spill tier: an indexed block evicted under allocation pressure
+    # copies its arena bytes out (scale pages included on the q8 side)
+    # and demotes to `spilled` instead of vanishing; a prefix lookup
+    # landing on a spilled chain swaps the blocks back in through the
+    # chunked-admission path (at most one chunk-width of blocks per
+    # engine loop pass — restore stalls are bounded by prefill_chunk
+    # exactly like compute stalls), each block re-verifying its
+    # content digest at swap-in. Requires prefix_cache (only indexed
+    # content can spill). 0 = off, the pre-r16 pool bitwise.
+    host_cache_blocks: int = 0
+    # persistent content-addressed block store directory: finalized
+    # blocks write through at registration, and a restarted engine
+    # re-warms from disk (demand-paged at admission, or eagerly via
+    # Engine.rewarm) instead of recomputing prefill. A loaded block
+    # that fails its digest verify is quarantined and recomputed.
+    # None = off. Requires prefix_cache.
+    store_dir: str | None = None
 
 
 @dataclass
@@ -241,6 +259,15 @@ class _Row:
     # in-flight dedup: True while this row is parked waiting for a
     # concurrent prefiller to finalize the blocks it announced
     waiting: bool = False
+    # tiered KV (r16): chain hashes pending swap-in from the host
+    # spill tier / persistent store (consecutive, starting at block
+    # `sealed`) — drained at most one chunk-width of blocks per
+    # engine loop pass so restore stalls stay bounded like compute
+    # stalls. tier_base is the device-hit token count at admission
+    # for a tier-planned row (-1 = no tier plan): the restored
+    # tokens' hit accounting lands only once their swap-in verifies.
+    restore: list = field(default_factory=list)
+    tier_base: int = -1
     # tokens accumulate HERE, not on the shared Request object: the
     # claim-seq fence covers queue mutations, but a stalled engine
     # resuming after its lease was reaped must also be unable to
@@ -364,7 +391,31 @@ class Engine:
         self.cfg = cfg
         self.serve = serve
         self.queue = queue if queue is not None else RequestQueue()
-        self.pool = KVPool(cfg, mesh, serve.n_blocks, bs, quant=kv)
+        if serve.host_cache_blocks < 0:
+            raise ValueError(
+                f"host_cache_blocks must be >= 0, got "
+                f"{serve.host_cache_blocks}")
+        if ((serve.host_cache_blocks > 0 or serve.store_dir)
+                and not serve.prefix_cache):
+            raise ValueError(
+                "the spill tier and the persistent store hold INDEXED "
+                "content; with prefix_cache off nothing is ever "
+                "registered, so host_cache_blocks/store_dir would be "
+                "silent no-ops — rejected loudly instead")
+        store = None
+        if serve.store_dir:
+            from icikit.serve.store import PrefixStore
+            store = PrefixStore(serve.store_dir)
+        self.pool = KVPool(cfg, mesh, serve.n_blocks, bs, quant=kv,
+                           host_blocks=serve.host_cache_blocks,
+                           store=store)
+        if serve.host_cache_blocks > 0 or store is not None:
+            # compile the tier programs at setup: the first eviction
+            # batch and the first spilled-chain hit must pay a
+            # memcpy, not an XLA compile, inside a request's TTFT
+            self.pool.warm_restore(
+                max(1, serve.prefill_chunk // bs),
+                max_evict=self.nb_per_row)
         B = serve.max_rows
         self.rows: list[_Row | None] = [None] * B
         self._toks = np.zeros(B, np.int32)
@@ -402,11 +453,22 @@ class Engine:
             collections.OrderedDict()
         # per-slot suffix-automaton drafter state (drafter="suffix")
         self._automata: dict = {}
-        self._prefix = {"hits": 0, "misses": 0, "hit_tokens": 0,
-                        "full_hits": 0, "cow": 0, "inflight_hits": 0,
-                        "inflight_hit_tokens": 0, "prefill_tokens": 0}
+        self._prefix = self._zero_prefix()
         self.n_steps = 0
         self._occ_rows = 0       # sum of active rows over steps
+
+    @staticmethod
+    def _zero_prefix() -> dict:
+        return {"hits": 0, "misses": 0, "hit_tokens": 0,
+                "full_hits": 0, "cow": 0, "inflight_hits": 0,
+                "inflight_hit_tokens": 0, "prefill_tokens": 0,
+                # tiered KV (r16): admissions that planned a swap-in,
+                # tokens they served from the tiers, restore traffic
+                # split by source, and the host-side restore time
+                "spill_hits": 0, "spill_hit_tokens": 0,
+                "restores": 0, "restores_host": 0,
+                "restores_store": 0, "restore_bytes": 0,
+                "restore_ms_total": 0.0}
 
     @staticmethod
     def _bucket_ladder(chunk: int) -> tuple:
@@ -1014,7 +1076,19 @@ class Engine:
                 waiting = (dedup and len(hit) < len(chain_hexes)
                            and self.pool.announced(
                                shard, chain_hexes[len(hit)]))
-                if not waiting:
+                # tiered KV (r16): blocks past the device hit that the
+                # host spill tier / persistent store can swap back in.
+                # Restores stream through _advance_restore (bounded
+                # per loop pass); table blocks for the remainder
+                # allocate only once the restores land, so the table
+                # stays position-ordered.
+                restore_plan: list = []
+                if (not waiting and self.serve.prefix_cache
+                        and side == "fp"
+                        and len(hit) < len(chain_hexes)):
+                    restore_plan = self.pool.tier_plan(
+                        shard, chain_hexes[len(hit):])
+                if not waiting and not restore_plan:
                     self.pool.ensure(owner, shard, s)
             except PoolExhausted:
                 # not the request's fault: back off without burning a
@@ -1047,22 +1121,33 @@ class Engine:
                     # a waiter is served by the in-flight prefill, not
                     # the settled index: it counts under inflight_hits
                     # below, never as a miss (and a p0==0 waiter emits
-                    # no hit_tokens sample — its blocks attach later)
+                    # no hit_tokens sample — its blocks attach later).
+                    # A tier-planned admission likewise defers: its
+                    # restored tokens count only once the swap-in
+                    # digest verifies (_advance_restore) — a corrupt
+                    # spill must not have inflated the hit ledger.
                     if p0:
                         self._prefix["hits"] += 1
                         self._prefix["hit_tokens"] += p0
                         if len(hit) * bs >= s:
                             self._prefix["full_hits"] += 1
                         obs.count("serve.prefix.hits")
-                        obs.observe("serve.prefix.hit_tokens",
-                                    float(p0))
-                    elif not waiting:
+                        if not restore_plan:
+                            # tier-planned admissions emit their ONE
+                            # hit_tokens sample at restore settle,
+                            # covering device + restored together
+                            obs.observe("serve.prefix.hit_tokens",
+                                        float(p0))
+                    elif not waiting and not restore_plan:
                         self._prefix["misses"] += 1
                         obs.count("serve.prefix.misses")
                         obs.observe("serve.prefix.hit_tokens", 0.0)
                 if waiting:
                     self._prefix["inflight_hits"] += 1
                     obs.count("serve.prefix.inflight_hits")
+                if restore_plan:
+                    self._prefix["spill_hits"] += 1
+                    obs.count("serve.prefix.spill_hits")
                 n_shared = len(hit)
                 # the hexdigest IS the chain state's hex encoding, so
                 # resuming the chain past the shared blocks is a
@@ -1073,7 +1158,9 @@ class Engine:
                     req=req, shard=shard, s_prompt=s, n_done=0,
                     sealed=n_shared, prefilled=p0, seq=req.claim_seq,
                     owner=owner, side=side, chain=chain,
-                    hashes=chain_hexes, waiting=waiting)
+                    hashes=chain_hexes, waiting=waiting,
+                    restore=list(restore_plan),
+                    tier_base=(p0 if restore_plan else -1))
                 self._toks[slot] = 0
                 self._curs[slot] = 0
                 self._active[slot] = False
@@ -1091,6 +1178,7 @@ class Engine:
                 req.trace.instant("serve.req.admitted",
                                   seq=req.claim_seq, slot=slot,
                                   prefix_hit=p0, waiting=waiting,
+                                  restoring=len(restore_plan),
                                   side=side)
                 if quant_row:
                     # the int8 path keeps whole-prompt admission (see
@@ -1144,6 +1232,15 @@ class Engine:
                 row = self.rows[slot]        # may have been evicted
                 if row is None or row.waiting:
                     continue
+            if row.restore:
+                # tiered swap-in: at most one chunk-width of blocks
+                # per pass, interleaved with decode exactly like a
+                # compute chunk; a row whose restores finished this
+                # pass falls straight through to its first own chunk
+                self._advance_restore(slot, row)
+                row = self.rows[slot]        # may have been evicted
+                if row is None or row.restore:
+                    continue
             if row.prefilled >= row.s_prompt:
                 continue
             self._prefill_chunk(slot, row)
@@ -1190,6 +1287,20 @@ class Engine:
         # (possibly prefilling) row; announce any full blocks WE will
         # now compute (a third duplicate should wait on us)
         row.waiting = False
+        if (self.serve.prefix_cache and row.side == "fp"
+                and row.sealed < len(row.hashes)):
+            # the vanished prefiller's finalized blocks may have been
+            # evicted INTO the spill tier in the meantime — check the
+            # tiers before recomputing (the restore phase does the
+            # ensure/announce once it settles)
+            plan = self.pool.tier_plan(row.shard,
+                                       row.hashes[row.sealed:])
+            if plan:
+                row.restore = plan
+                row.tier_base = row.prefilled
+                self._prefix["spill_hits"] += 1
+                obs.count("serve.prefix.spill_hits")
+                return
         try:
             added = self.pool.ensure(row.owner, row.shard, s)
         except PoolExhausted:
@@ -1201,6 +1312,99 @@ class Engine:
         if self.dedup and row.sealed < len(row.hashes):
             self.pool.announce(row.shard, row.owner,
                                row.hashes[row.sealed:])
+
+    def _advance_restore(self, slot: int, row: _Row) -> None:
+        """One pass of tiered swap-in for a row whose admission landed
+        on a spilled/persisted chain: restore at most one chunk-width
+        of blocks (``prefill_chunk // block_size``, min 1) from the
+        host tier or the store, each re-verifying its content digest
+        on arrival — so restore stalls on co-batched decoders are
+        bounded exactly like compute stalls, and a corrupt swap-in is
+        quarantined (the row falls back to recomputing the remainder
+        through the normal chunk stream, burning no retry). Hit
+        accounting for the restored tokens lands HERE, verified, not
+        at admission. Restoring renews the lease: swap-in is
+        progress, not death."""
+        self.queue.renew(row.req.rid, seq=row.seq)
+        bs = self.serve.block_size
+        s = row.s_prompt
+        n_pass = max(1, self.serve.prefill_chunk // bs)
+        t0 = time.monotonic()
+        try:
+            results, fell_back = self.pool.restore_run(
+                row.owner, row.shard, row.restore, n_pass,
+                side=row.side)
+        except PoolExhausted:
+            self._evict(slot)
+            self.queue.release(row.req.rid, delay=0.005,
+                               seq=row.seq)
+            return
+        n_done = len(results)
+        for out in results:
+            if isinstance(out, dict):
+                self._prefix["restores"] += 1
+                self._prefix["restores_" + out["src"]] += 1
+                self._prefix["restore_bytes"] += out["nbytes"]
+            h = row.restore.pop(0)
+            row.sealed += 1
+            row.chain = bytes.fromhex(h)
+        if fell_back:
+            # a block vanished (tier churn) or failed its swap-in
+            # verify (already quarantined by the pool): recompute the
+            # rest fresh — never trust, never retry the bytes
+            row.restore = []
+        if n_done:
+            dt_ms = (time.monotonic() - t0) * 1e3
+            self._prefix["restore_ms_total"] += dt_ms
+            obs.observe("serve.kv.restore_ms", dt_ms)
+            p0 = row.sealed * bs
+            if p0 >= s:
+                p0 = s - 1    # full tier hit: recompute s-1 only
+            row.prefilled = p0
+            row.req.prefix_hit_tokens = p0
+            self._refresh_btab(slot, row)
+            row.req.trace.instant("serve.req.restore", seq=row.seq,
+                                  blocks=n_done, prefilled=p0)
+        if row.restore:
+            self.queue.renew(row.req.rid, seq=row.seq)
+            return                    # more next pass (bounded stall)
+        # restore phase over (drained or fell back to compute):
+        # settle the deferred hit accounting against what actually
+        # verified, then allocate the remainder and rejoin the normal
+        # admission stream
+        p0 = row.prefilled
+        gained = max(0, p0 - max(row.tier_base, 0))
+        if gained:
+            if row.tier_base <= 0:
+                # no device-hit was counted at admission
+                self._prefix["hits"] += 1
+                obs.count("serve.prefix.hits")
+            if row.sealed * bs >= s:
+                self._prefix["full_hits"] += 1
+            self._prefix["hit_tokens"] += gained
+            self._prefix["spill_hit_tokens"] += gained
+        if p0:
+            # the admission's ONE hit_tokens sample (deferred from
+            # _admit): device-hit + verified-restored tokens together
+            obs.observe("serve.prefix.hit_tokens", float(p0))
+        elif row.tier_base <= 0:
+            # every planned restore fell through: a miss after all
+            self._prefix["misses"] += 1
+            obs.count("serve.prefix.misses")
+            obs.observe("serve.prefix.hit_tokens", 0.0)
+        row.tier_base = -1
+        try:
+            added = self.pool.ensure(row.owner, row.shard, s)
+        except PoolExhausted:
+            self._evict(slot)
+            self.queue.release(row.req.rid, delay=0.005, seq=row.seq)
+            return
+        if added:
+            self._refresh_btab(slot, row)
+        if self.dedup and row.sealed < len(row.hashes):
+            self.pool.announce(row.shard, row.owner,
+                               row.hashes[row.sealed:])
+        self.queue.renew(row.req.rid, seq=row.seq)
 
     def _chunk_width(self, rem: int) -> int:
         rem = min(rem, self.serve.prefill_chunk)
@@ -1665,10 +1869,21 @@ class Engine:
         gives the anomaly detectors their mid-run windows — the caller
         renders ``watch.verdict()`` afterwards."""
         done0 = len(self.queue.done)
+        tiered = (self.serve.host_cache_blocks > 0
+                  or self.pool.store is not None)
         while True:
             self.queue.reap_expired()
             self._admit()
             self._advance_prefill()
+            if tiered:
+                # bounded off-path tier maintenance per pass: settle
+                # one pending spill batch (device snapshot -> host
+                # bytes, so spilled content stops pinning device
+                # memory) and write a couple of queued host-tier
+                # demotions through to the store (the allocation path
+                # itself never materializes or touches disk)
+                self.pool.settle_spills(1)
+                self.pool.flush_demotions(2)
             if watch is not None:
                 watch.maybe_poll()
             if not self._active.any():
@@ -1685,6 +1900,15 @@ class Engine:
             self._step()
             if max_steps is not None and self.n_steps >= max_steps:
                 break
+        if self.pool.store is not None and self.queue.drained():
+            # drain-time persistence flush (r16): the whole surviving
+            # prefix corpus lands in the content-addressed store OFF
+            # the serving hot path (a per-finalize write-through was
+            # measured costing admission TTFT its tier win) — a
+            # restarted engine re-warms from these sealed pages; a
+            # crashed run still holds whatever the host-tier demotion
+            # cascade flushed
+            self.pool.persist_tiers()
         return len(self.queue.done) - done0
 
     @property
@@ -1703,7 +1927,7 @@ class Engine:
     def prefix_stats(self) -> dict:
         """Prefix-cache effectiveness counters for this engine's
         lifetime (bench records carry these)."""
-        return {
+        out = {
             **self._prefix,
             "evictions": sum(a.n_evictions
                              for a in self.pool.allocators),
@@ -1711,6 +1935,50 @@ class Engine:
                                  for a in self.pool.allocators),
             "chunk_programs": len(self._chunk_fns),
         }
+        if self.serve.host_cache_blocks > 0:
+            out["spills"] = sum(a.n_spills
+                                for a in self.pool.allocators)
+            out["spilled_blocks"] = self.pool.spilled_blocks()
+        if self.pool.store is not None:
+            out["store_blocks"] = self.pool.store.n_blocks()
+            out["store_writes"] = self.pool.store.n_writes
+            out["store_quarantined"] = self.pool.store.n_quarantined
+        return out
+
+    def rewarm(self, prompts=None, max_blocks: int | None = None) -> int:
+        """Eagerly re-warm the pool from the persistent store: restore
+        every consecutive persisted block of each prompt's chain into
+        the CACHED state (refcount-0, indexed — awaiting hits) on
+        every dp shard, before traffic flows. ``prompts`` defaults to
+        the queue's pending prompts (``RequestQueue.pending_prompts``,
+        the restart hook: a fresh engine pointed at a recovered queue
+        warms exactly the work it is about to serve). Demand paging at
+        admission covers whatever this skips — rewarm only moves the
+        disk reads off the first requests' critical path (the
+        cold-start-vs-rewarm A/B in tools/tiered_kv_study.py).
+        Returns the number of (shard, block) restores performed."""
+        if self.pool.store is None or not self.serve.prefix_cache:
+            return 0
+        if prompts is None:
+            prompts = self.queue.pending_prompts()
+        bs = self.serve.block_size
+        width = max(1, self.serve.prefill_chunk // bs)
+        n = 0
+        budget = 0       # DISTINCT blocks scheduled (the max_blocks
+        seen: set = set()    # unit; n counts per-shard restores)
+        for p in prompts:
+            hs = [h for h in block_hashes(np.asarray(p, np.int32),
+                                          bs, "fp")
+                  if h not in seen]
+            seen.update(hs)
+            if max_blocks is not None:
+                hs = hs[:max(0, max_blocks - budget)]
+            if hs:
+                budget += len(hs)
+                n += self.pool.rewarm_chain(hs, width)
+        if n:
+            obs.count("serve.store.rewarm_blocks", n)
+        return n
 
     def reset_stats(self) -> None:
         """Zero the step/occupancy accumulators — the bench calls this
@@ -1718,9 +1986,7 @@ class Engine:
         describe the measured traffic only."""
         self.n_steps = 0
         self._occ_rows = 0
-        self._prefix = {"hits": 0, "misses": 0, "hit_tokens": 0,
-                        "full_hits": 0, "cow": 0, "inflight_hits": 0,
-                        "inflight_hit_tokens": 0, "prefill_tokens": 0}
+        self._prefix = self._zero_prefix()
 
     # -- convenience -------------------------------------------------
 

@@ -38,6 +38,32 @@ SGLang radix-reuse move on this machinery):
   pressure. :class:`PoolExhausted` now means live + cached together
   cannot satisfy the request.
 
+Round 16 grows the pool **tiered** (the SGLang/Mooncake multi-tier
+move rebuilt on this allocator — ROADMAP item 2): the block state
+machine gains a fourth state, **spilled**. When allocation pressure
+would evict an indexed LRU page, a pool with a host tier attached
+(``host_blocks > 0``) copies the page's arena bytes (and, on the q8
+side, its scale pages) out to host memory *before* the device page is
+reused, and demotes the index entry to *spilled* instead of dropping
+it — the content survives, only its residence changed. A prefix
+lookup that lands on a spilled chain swaps the blocks back in through
+``restore_block``: a fresh device page is adopted, the payload's
+content digest (recorded at capture, before the bytes ever left the
+arena) is **re-verified at swap-in**, and a mismatch quarantines the
+content from every tier — a corrupt swap-in is recomputed, never
+trusted. Below the host tier sits an optional persistent
+content-addressed store (``serve/store.py``), fed OFF the serving hot
+path — host-tier LRU overflow demotes entries to disk (the device ->
+host -> disk cascade) and the engine flushes every surviving sealed
+block at queue drain — so a *restarted* engine re-warms from disk
+instead of recomputing prefill (same swap-in verify, same
+quarantine). Conservation: device pages still partition exactly into
+free / cached / live (free + cached + live == capacity); spilled
+entries hold **no device page** — they are reclaimable *capacity*
+(bounded by ``host_blocks``, LRU) but not device-resident, which is
+why ``occupancy`` stays live-only and :class:`PoolExhausted` reports
+the spilled count distinctly.
+
 Block 0 of every shard is the **trash block**: engine rows that are
 inactive (empty slots, padded chunk positions) still execute the step
 program — their writes are routed to block 0, whose contents are
@@ -60,7 +86,15 @@ import threading
 
 import numpy as np
 
-from icikit import obs
+from icikit import chaos, obs
+
+# tier-boundary probe sites (r16): spill = the eviction-time copy-out
+# to the host tier (corrupt drills in-host-memory rot AFTER the digest
+# was recorded, so the swap-in verify must catch it); restore = the
+# swap-in boundary (delay/die — a die here is an engine crash mid-
+# restore, healed by lease reissue). The disk tier's sites live in
+# icikit/serve/store.py.
+chaos.register_site("serve.kv.spill", "serve.kv.restore")
 
 
 class PoolExhausted(RuntimeError):
@@ -71,17 +105,26 @@ class PoolExhausted(RuntimeError):
     would stall every co-batched request behind an un-extendable row.
     The engine's policy on catching this is preempt-and-requeue, not
     crash — but the *allocator* never hands out partial allocations.
-    ``free`` counts every reclaimable page (free list + refcount-0
-    cached): only *live* blocks are unreclaimable.
+    ``free`` counts every DEVICE-reclaimable page (free list +
+    refcount-0 cached): only *live* blocks are unreclaimable.
+    ``spilled`` content is reported distinctly — a spilled block is
+    reclaimable *capacity* (its content survives in the host tier) but
+    holds no device page, so conflating it with ``free`` would
+    overstate what an allocation can actually take.
     """
 
-    def __init__(self, requested: int, free: int, capacity: int):
-        super().__init__(
-            f"KV pool exhausted: requested {requested} blocks, "
-            f"{free} reclaimable of {capacity}")
+    def __init__(self, requested: int, free: int, capacity: int,
+                 spilled: int = 0):
+        msg = (f"KV pool exhausted: requested {requested} blocks, "
+               f"{free} reclaimable of {capacity} device-resident")
+        if spilled:
+            msg += (f" ({spilled} more spilled to the host tier — "
+                    "reclaimable capacity, not device pages)")
+        super().__init__(msg)
         self.requested = requested
         self.free = free
         self.capacity = capacity
+        self.spilled = spilled
 
 
 def chain_seed(side: str = "fp") -> bytes:
@@ -127,22 +170,53 @@ class BlockAllocator:
     scheduler discipline elsewhere in this repo (``_LeaseQueue``) is
     that shared metadata takes a lock rather than an assumption.
 
-    Every page is in exactly one of three places:
+    Every DEVICE page is in exactly one of three places (their counts
+    conserve: free + cached + live == capacity, fuzz-pinned):
 
     - **live** — refcount >= 1, mapped by >= 1 block table;
     - **cached** — refcount 0 but content-indexed (``register``), held
       in LRU order awaiting either a ``share`` (cache hit revives it)
       or eviction under allocation pressure;
     - **free** — on the free list, content unknown.
+
+    With a host tier attached (``host_blocks > 0`` and ``spill_cb``
+    set) there is a fourth CONTENT state, **spilled**: an evicted
+    cached page whose payload the pool captured to host memory before
+    the device page was reused. A spilled entry is a chain hash with
+    no device page — it leaves the index at eviction and re-enters it
+    through ``adopt`` (restore: fresh page, payload re-verified by the
+    pool) or through ``register`` (a recompute raced the restore; the
+    stale host copy is dropped — content-addressing makes them
+    identical, but one source of truth is the rule). The spilled set
+    is LRU-bounded at ``host_blocks``; overflow drops the oldest entry
+    via ``drop_cb`` (whose payload may still live in the disk tier
+    below — that lookup is the pool's, not the allocator's).
     """
 
-    def __init__(self, n_blocks: int, block_size: int):
+    def __init__(self, n_blocks: int, block_size: int,
+                 host_blocks: int = 0):
         if n_blocks < 1:
             raise ValueError(f"n_blocks must be >= 1, got {n_blocks}")
         if block_size < 1:
             raise ValueError(f"block_size must be >= 1, got {block_size}")
+        if host_blocks < 0:
+            raise ValueError(
+                f"host_blocks must be >= 0, got {host_blocks}")
         self.capacity = n_blocks
         self.block_size = block_size
+        self.host_blocks = host_blocks
+        # tier callbacks (set by KVPool when a host tier is attached):
+        # spill_cb([(page, h), ...]) -> set of hashes captured to the
+        # host tier (an uncaptured entry drops like the untiered
+        # path) — ONE call per eviction batch so the capture is one
+        # device dispatch, not one per page; drop_cb(h) releases a
+        # captured payload (LRU overflow, restore consumption, or
+        # re-registration). Called UNDER the allocator lock: both are
+        # dispatch + dict ops (no host sync), and the engine's pool
+        # mutations are single-threaded by design — the lock is the
+        # safety net, not a contention point.
+        self.spill_cb = None
+        self.drop_cb = None
         self._free = collections.deque(range(1, n_blocks + 1))
         self._tables: dict = {}          # owner -> list[int]
         self._refs: dict = {}            # page -> live refcount
@@ -150,6 +224,10 @@ class BlockAllocator:
         self._hash_of: dict = {}         # page -> chain hash
         # refcount-0 pages kept for reuse, LRU -> MRU order
         self._cached: collections.OrderedDict = collections.OrderedDict()
+        # spilled CONTENT (no device page): chain hash -> True, LRU ->
+        # MRU, bounded by host_blocks
+        self._spilled: collections.OrderedDict = \
+            collections.OrderedDict()
         # in-flight prefill announcements (r12 dedup): chain hash ->
         # announcing owner, for blocks an admitted request is
         # CURRENTLY computing but has not yet finalized/registered.
@@ -161,6 +239,8 @@ class BlockAllocator:
         self._inflight: dict = {}        # chain hash -> owner
         self._lock = threading.Lock()
         self.n_evictions = 0
+        self.n_spills = 0
+        self.n_restores = 0
 
     # -- queries -----------------------------------------------------
 
@@ -194,29 +274,64 @@ class BlockAllocator:
         with self._lock:
             return tuple(self._tables.get(owner, ()))
 
+    @property
+    def n_spilled(self) -> int:
+        with self._lock:
+            return len(self._spilled)
+
     def indexed(self, h: str):
         """The page registered under chain hash ``h`` (None = miss)."""
         with self._lock:
             return self._index.get(h)
+
+    def spilled(self, h: str) -> bool:
+        """Is ``h``'s content in the host spill tier (no device page)?"""
+        with self._lock:
+            return h in self._spilled
 
     # -- mutation ----------------------------------------------------
 
     def _take(self, n: int) -> list:
         """Pop ``n`` pages (free list first, then LRU-evict cached),
         lock held. All-or-nothing; evicted pages lose their index
-        entry. Returns the pages; caller assigns refcounts."""
+        entry — with a host tier attached, their content SPILLS (the
+        payload is captured to host memory via ``spill_cb`` *before*
+        the device page is handed out for reuse, and the chain hash
+        demotes to the spilled set instead of vanishing). Returns the
+        pages; caller assigns refcounts."""
         if n > len(self._free) + len(self._cached):
             raise PoolExhausted(
-                n, len(self._free) + len(self._cached), self.capacity)
+                n, len(self._free) + len(self._cached), self.capacity,
+                spilled=len(self._spilled))
         got = []
         while len(got) < n and self._free:
             got.append(self._free.popleft())
+        evicted = []
         while len(got) < n:
             page, _ = self._cached.popitem(last=False)   # LRU victim
             h = self._hash_of.pop(page)
             del self._index[h]
             self.n_evictions += 1
+            obs.count("serve.kv.evictions")
+            evicted.append((page, h))
             got.append(page)
+        if (evicted and self.host_blocks > 0
+                and self.spill_cb is not None):
+            # ONE capture call for the whole eviction batch (the pool
+            # snapshots every victim page in one device dispatch —
+            # per-page capture calls were measured dominating the
+            # admission path); returns the hashes actually captured
+            captured = self.spill_cb(evicted)
+            for _, h in evicted:
+                if h not in captured:
+                    continue
+                self._spilled[h] = True
+                self._spilled.move_to_end(h)
+                self.n_spills += 1
+            while len(self._spilled) > self.host_blocks:
+                old, _ = self._spilled.popitem(last=False)
+                if self.drop_cb is not None:
+                    self.drop_cb(old)
         return got
 
     def alloc(self, owner, n: int) -> tuple:
@@ -344,8 +459,57 @@ class BlockAllocator:
             if self._refs.get(page, 0) < 1:
                 raise ValueError(
                     f"register: page {page} is not live")
+            # a recompute raced a spilled copy of the same content:
+            # the device page wins (content-addressing guarantees the
+            # two are bitwise identical, but the index must have ONE
+            # source of truth per hash — a later restore overwriting
+            # this registration would alias)
+            if h in self._spilled:
+                del self._spilled[h]
+                if self.drop_cb is not None:
+                    self.drop_cb(h, False)   # resident again: no demote
             self._index[h] = page
             self._hash_of[page] = h
+            return True
+
+    def adopt(self, owner, h: str):
+        """Re-materialize spilled/persisted content ``h`` onto a fresh
+        device page owned by ``owner`` — the allocator half of a
+        restore (the pool verifies the payload digest BEFORE calling
+        this, then writes the bytes after). The page comes out live
+        (refcount 1), appended to the owner's table, and registered
+        under ``h`` so the chain is index-resident again for every
+        later sharer; any spilled entry for ``h`` is consumed (its
+        host payload released via ``drop_cb``). Returns the page, or
+        None when ``h`` is already index-resident (a recompute or a
+        concurrent restore won the race — share that page instead).
+        Raises :class:`PoolExhausted` like any allocation."""
+        with self._lock:
+            if h in self._index:
+                return None
+            [page] = self._take(1)
+            self._refs[page] = 1
+            self._tables.setdefault(owner, []).append(page)
+            self._index[h] = page
+            self._hash_of[page] = h
+            if h in self._spilled:
+                del self._spilled[h]
+                if self.drop_cb is not None:
+                    self.drop_cb(h, False)   # consumed by the restore
+            self.n_restores += 1
+        return page
+
+    def purge_spilled(self, h: str) -> bool:
+        """Quarantine one spilled entry (the swap-in verify-failure
+        path): the content leaves the host tier and no future lookup
+        can plan a restore from it. Idempotent."""
+        with self._lock:
+            if h not in self._spilled:
+                return False
+            del self._spilled[h]
+            if self.drop_cb is not None:
+                # quarantine: the content is suspect — never demote it
+                self.drop_cb(h, False)
             return True
 
     # -- in-flight prefill announcements (r12 dedup) -----------------
@@ -418,6 +582,113 @@ def _page_copy(buf, shard: int, old: int, new: int):
     return _COPY_FN(buf, i32(shard), i32(old), i32(new))
 
 
+_SNAP_FNS: dict = {}
+
+
+def _snap_width(n: int) -> int:
+    """Pad an eviction batch to the next power of two (min 4): the
+    snapshot program compiles once per (geometry, width), so variable
+    batch sizes must bucket — padding gathers the trash page 0, whose
+    snapshot is discarded."""
+    w = 4
+    while w < n:
+        w *= 2
+    return w
+
+
+def _pages_snapshot(bufs_by_name: dict, shard: int, pages) -> dict:
+    """Snapshot a batch of physical pages out of the arenas in ONE
+    jitted dispatch (no donation — a pure read): returns arena name
+    -> device array (L, width, *page_shape). The result is a
+    consistent copy by jax immutability — later writes to (and
+    donation of) the arenas cannot touch it — and nothing syncs to
+    host here; ``_SpillBatch`` materializes lazily."""
+    import jax
+    import jax.numpy as jnp
+    width = _snap_width(len(pages))
+    pg = np.zeros(width, np.int32)
+    pg[:len(pages)] = pages
+    names = tuple(sorted(bufs_by_name))
+    key = tuple((n, len(bufs_by_name[n]), bufs_by_name[n][0].shape,
+                 str(bufs_by_name[n][0].dtype), width)
+                for n in names)
+    fn = _SNAP_FNS.get(key)
+    if fn is None:
+        def snap(bufs, s, p):
+            return {n: jnp.stack([b[s][p] for b in bufs[n]])
+                    for n in bufs}
+
+        fn = _SNAP_FNS[key] = jax.jit(snap)
+    return fn(dict(bufs_by_name), jnp.int32(shard),
+              jnp.asarray(pg, jnp.int32))
+
+
+class _SpillBatch:
+    """One eviction batch's device-side snapshot, shared by every
+    spilled page it captured: host materialization happens ONCE for
+    the batch, on the first consumer's path."""
+
+    def __init__(self, snaps: dict, names: tuple, n_layers: int):
+        self.snaps = snaps
+        self.names = names
+        self.n_layers = n_layers
+        self._np = None
+
+    def settle(self) -> bool:
+        """Materialize the snapshot to host bytes and release the
+        device copies; returns False when already settled."""
+        if self._np is not None:
+            return False
+        self._np = {n: np.asarray(a) for n, a in self.snaps.items()}
+        self.snaps = None             # release the device copies
+        return True
+
+    def page(self, idx: int) -> list:
+        self.settle()
+        return [np.array(self._np[n][li, idx])
+                for li in range(self.n_layers) for n in self.names]
+
+
+_WRITE_FNS: dict = {}
+
+
+def _pages_write(bufs_by_name: dict, shard: int, pages,
+                 blocks_by_name: dict) -> dict:
+    """Overwrite a batch of physical pages' content from host blocks
+    (the restore path's arena write): ONE donated jitted scatter for
+    the WHOLE run — every arena, every layer, all blocks in a single
+    dispatch. Restoring a chunk-width run of blocks must cost less
+    than recomputing it, and on CPU the per-dispatch overhead of
+    per-block (or even per-arena) writes exceeds the tiny-model
+    recompute it replaces — measured while scoping the r16 study.
+    Callers pad short runs to a fixed width with page 0 — the trash
+    block, whose contents are garbage by contract — so the compiled
+    program count is one per (arena geometry, run width).
+
+    ``bufs_by_name``: arena name -> per-layer buffer tuple (donated);
+    ``blocks_by_name``: arena name -> ndarray (L, width, *page_shape).
+    Returns the updated per-layer tuples by name."""
+    import jax
+    import jax.numpy as jnp
+    names = tuple(sorted(bufs_by_name))
+    key = tuple((n, len(bufs_by_name[n]), bufs_by_name[n][0].shape,
+                 str(bufs_by_name[n][0].dtype),
+                 blocks_by_name[n].shape) for n in names)
+    fn = _WRITE_FNS.get(key)
+    if fn is None:
+        def wr(bufs, s, pg, blks):
+            return {n: tuple(b.at[s, pg].set(blks[n][li])
+                             for li, b in enumerate(bufs[n]))
+                    for n in bufs}
+
+        fn = _WRITE_FNS[key] = jax.jit(wr, donate_argnums=(0,))
+    blocks = {n: jnp.asarray(blocks_by_name[n],
+                             bufs_by_name[n][0].dtype)
+              for n in names}
+    return fn(dict(bufs_by_name), jnp.int32(shard),
+              jnp.asarray(pages, jnp.int32), blocks)
+
+
 def _page_digest(arrays) -> str:
     """Checksum of one block's K and V content across layers (host
     bytes in layer order) — the sealed-page integrity fingerprint. On
@@ -462,7 +733,8 @@ class KVPool:
     SIDES = ("fp", "q8")
 
     def __init__(self, cfg, mesh, n_blocks: int, block_size: int,
-                 quant: str = "none"):
+                 quant: str = "none", host_blocks: int = 0,
+                 store=None):
         import jax
         import jax.numpy as jnp
         from jax.sharding import NamedSharding, PartitionSpec as P
@@ -506,8 +778,35 @@ class KVPool:
                              for _ in range(L))
             self.vsc = tuple(arena(sshape, jnp.float32, ssh)
                              for _ in range(L))
-        self.allocators = tuple(BlockAllocator(n_blocks, block_size)
-                                for _ in range(self.dp))
+        self.allocators = tuple(
+            BlockAllocator(n_blocks, block_size,
+                           host_blocks=host_blocks)
+            for _ in range(self.dp))
+        # tiered KV (r16): the host spill tier — (shard, chain hash)
+        # -> (side, digest, payload arrays) captured at eviction —
+        # and the optional persistent content-addressed block store
+        # beneath it (serve/store.py). The allocators' spilled-set LRU
+        # is the ONE bookkeeper of what the host dict holds: every
+        # mutation of _host goes through the spill/drop callbacks.
+        self.host_blocks = host_blocks
+        self.store = store
+        self._host: dict = {}
+        # demotion queue: host-tier records evicted by the LRU while
+        # a store is attached, awaiting their disk write — moved here
+        # under the allocator lock (dict ops only), flushed OFF-lock
+        # a bounded amount per engine loop pass (flush_demotions) and
+        # completely at drain (persist_tiers). Still restorable while
+        # queued (restore consults it after the host tier).
+        self._demote: dict = {}
+        # spill batches whose device snapshots have not yet
+        # materialized to host bytes: settled opportunistically one
+        # per engine pass (settle_spills) so spilled content does not
+        # pin device memory indefinitely when it is never re-hit
+        self._unsettled: collections.deque = collections.deque()
+        if host_blocks > 0:
+            for s, a in enumerate(self.allocators):
+                a.spill_cb = self._make_spill_cb(s)
+                a.drop_cb = self._make_drop_cb(s)
         # (shard, page) -> (side, digest) of the sealed page's payload
         # bytes across layers — content-keyed so shared pages carry
         # exactly one digest that every reader re-verifies
@@ -713,6 +1012,385 @@ class KVPool:
         self._gauges()
         return pair
 
+    # -- tiered KV (r16): host spill tier + persistent store ---------
+
+    def _make_spill_cb(self, shard: int):
+        """The eviction-time copy-out, ASYNCHRONOUS by construction:
+        capture every victim page of the eviction batch as ONE
+        device-side gather BEFORE the pages are reused — jax arrays
+        are immutable, so the dispatched snapshot reads the old
+        buffers, untouched by later arena writes (and donation into
+        the step program), and NO host sync happens on the eviction
+        path (per-page synchronous read-back was measured dominating
+        admission TTFT while scoping the r16 study; on TPU this
+        capture point is where the async D2H DMA goes). The bytes
+        materialize to host memory lazily at first use
+        (:meth:`_materialize` — swap-in or persist), which is also
+        where the content digest settles: a sealed page reuses its
+        SEALED digest (recorded at finalization, so the whole
+        device->host->device round trip is covered); an unsealed one
+        hashes at materialization (the host-tier dwell is covered;
+        arm ``integrity="pages"`` to cover the capture window too).
+        Runs under the allocator lock (dispatch + dict ops only)."""
+        def spill(pairs) -> set:
+            chaos.maybe_delay("serve.kv.spill")
+            by_side: dict = {}
+            for page, h in pairs:
+                rec = self._seals.get((shard, page))
+                side = (rec[0] if rec is not None
+                        else self._default_side())
+                names = (("kc", "vc") if side == "fp"
+                         else ("qkc", "qvc", "ksc", "vsc"))
+                if getattr(self, names[0]) is None:
+                    continue          # no such arena: drop like untiered
+                by_side.setdefault((side, names), []).append(
+                    (page, h, rec[1] if rec is not None else None))
+            captured: set = set()
+            for (side, names), group in by_side.items():
+                pages = [page for page, _, _ in group]
+                batch = _SpillBatch(
+                    _pages_snapshot(
+                        {n: getattr(self, n) for n in names}, shard,
+                        pages), names, self.cfg.n_layers)
+                self._unsettled.append(batch)
+                for i, (page, h, digest) in enumerate(group):
+                    self._host[(shard, h)] = [side, digest,
+                                              (batch, i), False]
+                    captured.add(h)
+                obs.count("serve.kv.spills", len(group))
+            return captured
+        return spill
+
+    def _settle_rec(self, rec: list) -> list:
+        """Settle one tier record to verified-shape host bytes IN
+        PLACE: device snapshot -> np arrays (the one sync, paid off
+        the admission path — on a consumer's path where it replaces
+        recompute, or in the bounded per-pass settle/demotion
+        flushes), digest settled (sealed digest, or hashed now), and
+        only THEN the ``serve.kv.spill`` corruption probe — injected
+        rot models the host copy decaying after capture, which the
+        swap-in verify must catch. Idempotent."""
+        side, digest, payload, settled = rec
+        if settled:
+            return rec
+        batch, idx = payload
+        payload = batch.page(idx)
+        if digest is None:
+            digest = _page_digest(payload)
+        payload[0] = chaos.maybe_corrupt("serve.kv.spill", payload[0])
+        rec[0:4] = [side, digest, payload, True]
+        return rec
+
+    def _materialize(self, shard: int, h: str):
+        rec = self._host.get((shard, h))
+        return None if rec is None else self._settle_rec(rec)
+
+    def _make_drop_cb(self, shard: int):
+        """Host-tier LRU overflow: with a store attached, a dropped
+        entry DEMOTES toward disk (the device -> host -> disk
+        cascade) rather than vanishing. The callback runs under the
+        allocator lock on the allocation path, so it does NO
+        materialization and NO I/O — the record just moves to the
+        demotion queue, which the engine flushes off-lock a bounded
+        amount per loop pass (:meth:`flush_demotions`; the drain
+        flush catches stragglers). Consumption drops
+        (restore/re-registration/quarantine) skip the demotion: the
+        content is resident again, or suspect."""
+        def drop(h: str, demote: bool = True) -> None:
+            rec = self._host.pop((shard, h), None)
+            if (demote and rec is not None and self.store is not None
+                    and not self.store.has(h)):
+                self._demote[(shard, h)] = rec
+        return drop
+
+    def tier_plan(self, shard: int, hashes) -> list:
+        """The longest consecutive run of ``hashes`` restorable from
+        the tiers below the device (host spill set first, then the
+        persistent store) — the admission-time continuation of
+        ``lookup``'s device walk. Chain discipline applies: a gap
+        breaks the run (a block whose predecessor is absent is
+        unreachable K/V)."""
+        a = self.allocators[shard]
+        out = []
+        for h in hashes:
+            if (a.spilled(h) or (shard, h) in self._demote
+                    or (self.store is not None
+                        and self.store.has(h))):
+                out.append(h)
+            else:
+                break
+        return out
+
+    def _restore_one(self, owner, shard: int, h: str, side: str,
+                     staged: list):
+        """One block of a restore run: fetch (host tier first, then
+        store), verify the content digest, adopt a device page —
+        DEFERRING the arena write onto ``staged`` so a run of blocks
+        flushes as one batched scatter per arena (``_flush_restores``).
+        Returns ``"shared"`` when the content is index-resident again
+        (raced recompute/restore — attached through the share path),
+        a ``{"src", "nbytes"}`` record on success, or None when the
+        content is gone or FAILED its swap-in verify — quarantined
+        from every tier, the caller recomputes fresh. Raises
+        :class:`PoolExhausted` like any allocation."""
+        a = self.allocators[shard]
+        page = a.indexed(h)
+        if page is not None:
+            a.share(owner, [page])
+            return "shared"
+        chaos.maybe_delay("serve.kv.restore")
+        chaos.maybe_die("serve.kv.restore")
+        rec = self._materialize(shard, h)
+        src = "host"
+        if rec is None:
+            # demotion limbo: dropped from the host LRU, disk write
+            # not yet flushed — still restorable, still "host"
+            rec = self._demote.get((shard, h))
+            if rec is not None:
+                rec = self._settle_rec(rec)
+        if rec is None and self.store is not None:
+            rec = self.store.get(h)
+            src = "store"
+        if rec is None:
+            return None
+        rside, digest, payload = rec[0], rec[1], rec[2]
+        if rside != side:
+            return None               # side-aware, like the index
+        if _page_digest(payload) != digest:
+            # a corrupt swap-in is quarantined, never trusted: the
+            # content leaves every tier so no retry re-reads it
+            a.purge_spilled(h)
+            self._demote.pop((shard, h), None)
+            if self.store is not None:
+                self.store.quarantine(h)
+            obs.count("serve.prefix.quarantined")
+            obs.emit("serve.kv.restore_failed", shard=shard,
+                     hash=h, src=src)
+            return None
+        page = a.adopt(owner, h)
+        if page is None:
+            a.share(owner, [a.indexed(h)])
+            return "shared"
+        staged.append((page, payload))
+        # the payload IS the sealed content — seal carries over
+        self._seals[(shard, page)] = (side, digest)
+        nbytes = int(sum(p.nbytes for p in payload))
+        obs.count("serve.prefix.restores")
+        obs.count("serve.prefix.restore_bytes", nbytes)
+        return {"src": src, "nbytes": nbytes}
+
+    def _flush_restores(self, shard: int, side: str, staged: list,
+                        width: int) -> None:
+        """Write a run of restored blocks into the arenas: one
+        batched donated scatter per (layer, arena), the run padded to
+        ``width`` with trash-page-0 writes so the compiled program
+        count stays one per (arena shape, width)."""
+        if not staged:
+            return
+        width = max(width, len(staged))
+        names = (("kc", "vc") if side == "fp"
+                 else ("qkc", "qvc", "ksc", "vsc"))
+        stride = len(names)
+        pages = np.zeros(width, np.int32)
+        for i, (pg, _) in enumerate(staged):
+            pages[i] = pg
+        blocks_by_name = {}
+        for j, name in enumerate(names):
+            per_layer = []
+            for li in range(self.cfg.n_layers):
+                blocks = [pay[li * stride + j] for _, pay in staged]
+                pad = width - len(blocks)
+                if pad:
+                    blocks += [np.zeros_like(blocks[0])] * pad
+                per_layer.append(np.stack(blocks))
+            blocks_by_name[name] = np.stack(per_layer)
+        out = _pages_write(
+            {n: getattr(self, n) for n in names}, shard, pages,
+            blocks_by_name)
+        for n, bufs in out.items():
+            setattr(self, n, tuple(bufs))
+
+    def restore_run(self, owner, shard: int, hashes,
+                    n_max: int, side: str | None = None) -> tuple:
+        """Swap up to ``n_max`` consecutive blocks back in for
+        ``owner`` (the engine's one-pass restore budget). Returns
+        ``(results, fell_back)``: ``results`` holds one
+        "shared"/record entry per block actually attached (in chain
+        order), ``fell_back`` is True when a block vanished or failed
+        its swap-in verify — the caller recomputes everything past
+        ``results``. Device writes for the whole run flush as ONE
+        batched scatter per arena per layer."""
+        side = side or self._default_side()
+        staged: list = []
+        results: list = []
+        fell_back = False
+        try:
+            for h in list(hashes)[:n_max]:
+                out = self._restore_one(owner, shard, h, side, staged)
+                if out is None:
+                    fell_back = True
+                    break
+                results.append(out)
+        finally:
+            self._flush_restores(shard, side, staged, n_max)
+            self._gauges()
+        return results, fell_back
+
+    def restore_block(self, owner, shard: int, h: str,
+                      side: str | None = None):
+        """Single-block restore (the pool-level unit surface and the
+        rewarm path): ``restore_run`` of one."""
+        results, _ = self.restore_run(owner, shard, [h], 1, side=side)
+        return results[0] if results else None
+
+    def warm_restore(self, width: int, max_evict: int | None = None,
+                     side: str | None = None) -> None:
+        """Compile the tier programs outside any timed window (the
+        engine calls this at setup when a host tier is armed): the
+        batched restore-write at ``width`` via an all-trash-page run
+        of zero blocks, and the eviction-snapshot gather at every
+        width bucket up to ``max_evict`` — page 0's contents are
+        garbage by contract, so the warm calls are no-ops
+        semantically and full compile+execute mechanically. Without
+        this, the FIRST spill/restore pays XLA compiles inside a
+        request's TTFT."""
+        side = side or self._default_side()
+        names = (("kc", "vc") if side == "fp"
+                 else ("qkc", "qvc", "ksc", "vsc"))
+        if getattr(self, names[0]) is None:
+            return
+        zero = [(0, [np.zeros(getattr(self, n)[0].shape[2:],
+                              getattr(self, n)[0].dtype)
+                     for _ in range(self.cfg.n_layers)
+                     for n in names])]
+        # one page-0 "restore" per shard covers every input sharding
+        for shard in range(self.dp):
+            self._flush_restores(shard, side, zero, width)
+            if max_evict is None or self.host_blocks <= 0:
+                continue    # store-only: nothing ever snapshots
+            w = 4
+            while True:
+                _pages_snapshot({n: getattr(self, n) for n in names},
+                                shard, [0] * min(w, max_evict))
+                if w >= max_evict:
+                    break
+                w *= 2
+
+    def persist(self, shard: int, page: int, h: str,
+                side: str | None = None) -> bool:
+        """Persist one indexed block to the store (content-addressed:
+        already-present hashes are a no-op). The digest is recorded
+        from the device bytes at write time — restores (this process
+        or a restarted one) re-verify it at swap-in. NOT called on
+        the serving hot path: a per-finalize write-through was
+        measured costing admission TTFT its tier win, so persistence
+        happens at the two off-path moments instead — host-tier LRU
+        demotion (the drop callback) and :meth:`persist_tiers` at
+        engine drain."""
+        if self.store is None:
+            return False
+        if self.store.has(h):
+            return False
+        side = side or self._default_side()
+        payload = self.page_bytes(shard, page, side)
+        rec = self._seals.get((shard, page))
+        digest = (rec[1] if rec is not None and rec[0] == side
+                  else _page_digest(payload))
+        return self.store.put(h, side, digest, payload)
+
+    def persist_tiers(self) -> int:
+        """Flush every surviving sealed block to the persistent store:
+        all index-resident pages (cached AND live-with-hash) plus
+        every host-tier entry — the engine calls this when its queue
+        drains, so a clean run's whole prefix corpus survives restart
+        without the hot path ever paying a disk write (a crashed
+        run's store still holds whatever the demotion cascade flushed
+        — partial rewarm beats no rewarm). Returns blocks written."""
+        if self.store is None:
+            return 0
+        n = self.flush_demotions()
+        for shard, a in enumerate(self.allocators):
+            with a._lock:
+                resident = list(a._hash_of.items())
+                spilled = list(a._spilled)
+            for page, h in resident:
+                if self.persist(shard, page, h):
+                    n += 1
+            for h in spilled:
+                if self.store.has(h):
+                    continue
+                rec = self._materialize(shard, h)
+                if rec is not None and self.store.put(
+                        h, rec[0], rec[1], rec[2]):
+                    n += 1
+        return n
+
+    def flush_demotions(self, max_n: int | None = None) -> int:
+        """Write queued host-tier demotions through to the store, OFF
+        the allocator lock — the engine calls this once per loop pass
+        with a small ``max_n`` so the demotion cascade costs bounded,
+        predictable time per pass instead of fsync-ing under an
+        allocation; ``persist_tiers`` (drain) flushes the remainder.
+        Entries consumed by a restore in the meantime were already
+        removed from the queue. Returns blocks written."""
+        if self.store is None:
+            self._demote.clear()
+            return 0
+        n = 0
+        while self._demote and (max_n is None or n < max_n):
+            (shard, h), rec = next(iter(self._demote.items()))
+            del self._demote[(shard, h)]
+            if self.store.has(h):
+                continue
+            rec = self._settle_rec(rec)
+            if self.store.put(h, rec[0], rec[1], rec[2]):
+                n += 1
+        return n
+
+    def settle_spills(self, max_batches: int = 1) -> int:
+        """Opportunistically materialize pending spill batches
+        (device snapshot -> host bytes), bounded per call — the
+        engine probes this once per loop pass so spilled content
+        stops pinning device memory even when it is never re-hit,
+        without the capture path ever paying a host sync. Batches
+        already settled by a consumer skip for free."""
+        n = 0
+        while self._unsettled and n < max_batches:
+            batch = self._unsettled.popleft()
+            if batch.settle():
+                n += 1
+        return n
+
+    def rewarm_chain(self, hashes, width: int,
+                     side: str = "fp") -> int:
+        """Eagerly restore one prompt's chain from the tiers into the
+        CACHED state on every dp shard (restart rewarm: refcount-0,
+        indexed, awaiting hits) — batched through the same
+        ``restore_run`` width the demand path uses, so the arena
+        writes stay one dispatch per run. Stops at the first gap or
+        failure (deeper blocks are unreachable K/V). Returns
+        (shard, block) restores performed."""
+        n = 0
+        for shard in range(self.dp):
+            a = self.allocators[shard]
+            todo = [h for h in hashes if a.indexed(h) is None]
+            owner = f"__rewarm.{shard}"
+            try:
+                while todo:
+                    try:
+                        results, fell = self.restore_run(
+                            owner, shard, todo, width, side=side)
+                    except PoolExhausted:
+                        break     # pool full: demand paging takes over
+                    n += sum(1 for r in results
+                             if isinstance(r, dict))
+                    todo = todo[len(results):]
+                    if fell or not results:
+                        break
+            finally:
+                self.release(owner, shard)
+        return n
+
     def occupancy(self) -> float:
         """Fraction of allocatable blocks currently LIVE (mean over dp
         shards). Cached refcount-0 blocks are reclaimable on demand
@@ -735,9 +1413,17 @@ class KVPool:
                    for (o, s), v in used_tokens.items())
         return 1.0 - used / alloc_slots
 
+    def spilled_blocks(self) -> int:
+        """Host-tier entries across shards — reclaimable CAPACITY but
+        not device-resident, hence reported beside (never inside) the
+        occupancy/cached gauges."""
+        return sum(a.n_spilled for a in self.allocators)
+
     def _gauges(self) -> None:
         obs.gauge("serve.kv.occupancy", self.occupancy())
         obs.gauge("serve.kv.blocks_free",
                   sum(a.n_free for a in self.allocators))
         obs.gauge("serve.kv.blocks_cached",
                   sum(a.n_cached for a in self.allocators))
+        if self.host_blocks > 0:
+            obs.gauge("serve.kv.spilled", self.spilled_blocks())

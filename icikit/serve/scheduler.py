@@ -431,6 +431,24 @@ class RequestQueue:
                 self._limbo -= len(reaped)
         return reaped
 
+    def pending_prompts(self) -> list:
+        """Prompts of every currently-queued request, in visibility
+        order — the restart-rewarm hook (r16): a fresh engine pointed
+        at a recovered queue (crash restart, lease reissue) hands this
+        to ``Engine.rewarm`` so the persistent prefix store is warmed
+        for exactly the work about to be served, before the first
+        claim. Copies are cheap (prompt arrays are shared, the list is
+        new); stale heap duplicates are filtered like ``claim`` does."""
+        with self._lock:
+            out = []
+            seen = set()
+            for _, _, rid in sorted(self._queued):
+                req = self._requests[rid]
+                if req.state == "queued" and rid not in seen:
+                    seen.add(rid)
+                    out.append(req.prompt)
+            return out
+
     def drained(self) -> bool:
         with self._lock:
             return (not self._queued and not self._leases

@@ -1,0 +1,182 @@
+"""Persistent content-addressed prefix store — the disk tier under
+the KV pool.
+
+The r11 prefix index and the r16 host spill tier both die with the
+process: a restarted engine recomputes every shared system prompt from
+scratch, which at production scale (long few-shot headers shared by
+millions of sessions) is exactly the prefill the cache existed to
+remove. This store is the Mooncake-style bottom tier rebuilt on the
+repo's integrity discipline:
+
+- **content-addressed** — one file per sealed KV block, named by the
+  block's chain hash (``kvpool.block_hashes``). The chain hash commits
+  to the block's entire token prefix *and* arena side, so the filename
+  IS the lookup key: no manifest, no index file, nothing to corrupt
+  besides the blocks themselves. Identical content written twice is
+  one file (**last-writer-wins**, the ``ChunkCheckpoint`` duplicate
+  rule — every writer of hash ``h`` holds bitwise the same bytes,
+  because K/V is a pure function of the token prefix).
+- **digest-carrying** — each file stores the block's payload arrays
+  (K and V per layer; the q8 side adds the scale pages) plus the
+  content digest computed *before* the bytes ever left the device
+  arena. A loaded block re-verifies that digest at swap-in
+  (``KVPool.restore_block``): a flipped disk byte, a torn write, or a
+  stale-format file is **quarantined** (file removed, counter bumped)
+  and the engine simply recomputes — a corrupt page is never trusted
+  (the "Cores that don't count" posture, extended to disks).
+- **crash-tolerant by validation, not by ceremony** — writes go
+  straight to the final path under the shared bounded-backoff I/O
+  retry (``chaos.io_retry``, the one retry policy every checkpoint
+  writer in this repo uses); a writer that dies mid-write leaves a
+  torn file that fails validation on load and is skipped/removed,
+  exactly like a torn ``ChunkCheckpoint`` tail line (drilled via the
+  ``serve.store.write`` die probe in ``tests/test_serve_tiered.py``).
+
+Rewarm protocol: a restarted engine needs no scan — the admission
+path's tier lookup (``KVPool.tier_plan``) consults ``has()`` on
+demand, so the first request for a persisted prefix pulls its chain
+straight from disk through the chunked restore path. ``Engine.rewarm``
+is the eager variant (prime the pool for the queue's pending prompts
+before serving — ``RequestQueue.pending_prompts`` is the restart
+hook); the cold-vs-rewarm A/B lives in ``tools/tiered_kv_study.py``.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import pathlib
+import zipfile
+
+import numpy as np
+
+from icikit import chaos, obs
+
+# the disk tier's probe sites: io (flaky filesystem, retried with
+# bounded backoff), die (torn-file drill — a write killed mid-bytes
+# must be skipped at rewarm), delay on reads (slow disk)
+chaos.register_site("serve.store.write", "serve.store.read")
+
+# bump when the on-disk payload layout changes: a version-mismatched
+# file is quarantined like a torn one (recompute beats misread)
+_FORMAT = 1
+
+
+class PrefixStore:
+    """One directory of chain-hash-named ``.npz`` block files.
+
+    The store is deliberately dumb: no manifest, no background
+    compaction, no locking beyond the OS's atomic directory ops —
+    every entry is independently valid or independently quarantined.
+    Capacity policy is the filesystem's problem (the host/device tiers
+    above do the LRU work); ``n_blocks``/``nbytes`` exist so benches
+    can report what a run persisted.
+    """
+
+    SUFFIX = ".npz"
+
+    def __init__(self, root):
+        self.root = pathlib.Path(root)
+        self.root.mkdir(parents=True, exist_ok=True)
+        self.n_writes = 0
+        self.n_reads = 0
+        self.n_quarantined = 0
+
+    def _path(self, h: str) -> pathlib.Path:
+        return self.root / f"{h}{self.SUFFIX}"
+
+    def has(self, h: str) -> bool:
+        return self._path(h).exists()
+
+    def n_blocks(self) -> int:
+        return sum(1 for _ in self.root.glob(f"*{self.SUFFIX}"))
+
+    def nbytes(self) -> int:
+        return sum(p.stat().st_size
+                   for p in self.root.glob(f"*{self.SUFFIX}"))
+
+    # -- write --------------------------------------------------------
+
+    def put(self, h: str, side: str, digest: str, arrays) -> bool:
+        """Persist one block's payload under its chain hash; returns
+        False when the content is already present (content-addressed:
+        a second writer of ``h`` holds identical bytes, so the first
+        file stands). The write is one buffered byte stream to the
+        final path — a crash mid-write leaves a torn file that
+        :meth:`get` quarantines, which is the honest recovery story
+        (recompute) rather than a pretend-atomic one."""
+        path = self._path(h)
+        if path.exists():
+            return False
+        meta = json.dumps({"format": _FORMAT, "side": side,
+                           "digest": digest,
+                           "n_arrays": len(arrays)}).encode()
+        buf = io.BytesIO()
+        np.savez(buf, meta=np.frombuffer(meta, np.uint8),
+                 **{f"a{i}": np.asarray(a) for i, a in
+                    enumerate(arrays)})
+        data = buf.getvalue()
+
+        def write():
+            with open(path, "wb") as f:
+                f.write(data[:len(data) // 2])
+                f.flush()
+                # the torn-file drill boundary: a die here leaves a
+                # half-written file on disk, which MUST be skipped
+                # (and removed) by the next get() — proven in
+                # tests/test_serve_tiered.py
+                chaos.maybe_die("serve.store.write")
+                f.write(data[len(data) // 2:])
+                f.flush()
+                os.fsync(f.fileno())
+
+        chaos.io_retry("serve.store.write", write)
+        self.n_writes += 1
+        return True
+
+    # -- read ---------------------------------------------------------
+
+    def get(self, h: str):
+        """Load one block: ``(side, digest, arrays)`` or None when the
+        hash is absent or the file fails validation (torn write, wrong
+        format, bad metadata) — invalid files are removed so rewarm
+        does not re-trip on them. Digest verification against the
+        payload happens at swap-in (``KVPool.restore_block``), AFTER
+        the ``serve.store.read`` corruption probe below, so an
+        injected flipped byte exercises the real detection path."""
+        path = self._path(h)
+        if not path.exists():
+            return None
+        chaos.maybe_delay("serve.store.read")
+        try:
+            def read():
+                with open(path, "rb") as f:
+                    return f.read()
+            raw = chaos.io_retry("serve.store.read", read)
+            with np.load(io.BytesIO(raw)) as z:
+                meta = json.loads(bytes(z["meta"].tobytes()))
+                if meta.get("format") != _FORMAT:
+                    raise ValueError("format mismatch")
+                arrays = [z[f"a{i}"]
+                          for i in range(int(meta["n_arrays"]))]
+        except (OSError, ValueError, KeyError, json.JSONDecodeError,
+                zipfile.BadZipFile):
+            self.quarantine(h)
+            return None
+        # the persisted-byte SDC drill: rot between disk and arena —
+        # applied after the bytes parsed, before the swap-in digest
+        # verify that must catch it
+        arrays[0] = chaos.maybe_corrupt("serve.store.read", arrays[0])
+        self.n_reads += 1
+        return meta["side"], meta["digest"], arrays
+
+    def quarantine(self, h: str) -> None:
+        """Remove one entry (validation/digest failure): no future
+        rewarm may re-read the bad bytes. Idempotent."""
+        try:
+            self._path(h).unlink()
+        except OSError:
+            pass
+        self.n_quarantined += 1
+        obs.count("serve.store.quarantined")

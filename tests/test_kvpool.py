@@ -504,3 +504,208 @@ def test_eviction_takes_chain_leaves_before_roots():
     assert got == t[2]             # the DEEPEST block, not the root
     assert a.lookup(["c0", "c1", "c2"]) == [t[0], t[1]]
     _check_sharing_invariants(a)
+
+
+# ---------------------------------------------------------------- r16:
+# the tiered allocator — spilled as the fourth content state, host-
+# tier conservation, restore/adopt, and the honest-accounting pins
+# (ISSUE 13).
+
+
+def _attach_fake_host(a: BlockAllocator):
+    """Allocator-level host tier: captures are plain dict entries, so
+    the fuzz can hold the tier-mirror invariant without any device
+    arenas. Returns the backing dict."""
+    host = {}
+
+    def spill(pairs):
+        for page, h in pairs:
+            host[h] = ("payload", page)
+        return {h for _, h in pairs}
+
+    def drop(h, demote=True):
+        host.pop(h, None)
+
+    a.spill_cb = spill
+    a.drop_cb = drop
+    return host
+
+
+def _check_tier_invariants(a: BlockAllocator, host: dict):
+    """The 4-state conservation laws on top of the r11 sharing laws:
+
+    - device pages still partition exactly into free/cached/live
+      (free + cached + live == capacity — spilled holds NO page);
+    - the spilled set mirrors the host tier exactly and is bounded by
+      host_blocks;
+    - spilled content is never simultaneously index-resident (one
+      source of truth per hash).
+    """
+    _check_sharing_invariants(a)
+    with a._lock:
+        spilled = set(a._spilled)
+        indexed = set(a._index)
+    assert spilled == set(host), "host tier drifted from spilled set"
+    assert len(spilled) <= a.host_blocks, "host tier over capacity"
+    assert not spilled & indexed, \
+        "hash both spilled and index-resident"
+
+
+def test_spill_tier_4state_conservation_fuzz():
+    """Random interleavings over the FULL tiered surface —
+    alloc/ensure/release/register/lookup+share/cow/adopt — holding
+    device conservation AND the tier mirror at every step. Restores
+    (adopt) must never alias: the adopted page is fresh, exclusive,
+    and index-resident under the restored hash."""
+    rng = np.random.default_rng(23)
+    for trial in range(12):
+        cap = int(rng.integers(6, 24))
+        bs = int(rng.integers(1, 5))
+        hb = int(rng.integers(1, 12))
+        a = BlockAllocator(cap, bs, host_blocks=hb)
+        host = _attach_fake_host(a)
+        owners = [f"r{i}" for i in range(int(rng.integers(2, 6)))]
+        minted = 0
+        for _ in range(300):
+            o = owners[int(rng.integers(0, len(owners)))]
+            op = rng.integers(0, 7)
+            try:
+                if op == 0:
+                    a.alloc(o, int(rng.integers(0, 4)))
+                elif op == 1:
+                    a.ensure(o, int(rng.integers(1, cap * bs + 1)))
+                elif op == 2:
+                    a.release(o)
+                elif op == 3:
+                    t = a.table(o)
+                    if t:
+                        p = t[int(rng.integers(0, len(t)))]
+                        a.register(p, f"h{minted}")
+                        minted += 1
+                elif op == 4:
+                    if minted:
+                        h = f"h{int(rng.integers(0, minted))}"
+                        pages = a.lookup([h])
+                        if pages:
+                            a.share(o, pages)
+                elif op == 5:
+                    # restore a random spilled hash: the page comes
+                    # back fresh, exclusive, and indexed
+                    with a._lock:
+                        sp = list(a._spilled)
+                    if sp:
+                        h = sp[int(rng.integers(0, len(sp)))]
+                        page = a.adopt(o, h)
+                        if page is not None:
+                            assert a.refcount(page) == 1
+                            assert a.indexed(h) == page
+                            assert not a.spilled(h)
+                else:
+                    t = a.table(o)
+                    if t:
+                        a.cow(o, int(rng.integers(0, len(t))))
+            except PoolExhausted as e:
+                assert e.requested > e.free     # raised honestly
+                assert e.spilled == a.n_spilled
+            _check_tier_invariants(a, host)
+        for o in owners:
+            a.release(o)
+        _check_tier_invariants(a, host)
+
+
+def test_pool_exhausted_accounts_spilled_distinctly():
+    """The r16 accounting fix: a spilled block is reclaimable
+    CAPACITY but not a device page — PoolExhausted must report it
+    beside (never inside) the device-reclaimable count, and pool
+    occupancy stays live-only."""
+    a = BlockAllocator(4, 4, host_blocks=8)
+    _attach_fake_host(a)
+    t = a.alloc("A", 4)
+    for i, p in enumerate(t):
+        a.register(p, f"s{i}")
+    a.release("A")
+    a.alloc("B", 4)               # evicts+spills all four
+    assert a.n_spilled == 4
+    with pytest.raises(PoolExhausted) as ei:
+        a.alloc("C", 2)
+    assert ei.value.free == 0            # nothing device-reclaimable
+    assert ei.value.spilled == 4         # reported distinctly
+    assert "spilled to the host tier" in str(ei.value)
+    # an allocator without a tier reports spilled == 0 and the
+    # pre-r16 message shape
+    b = BlockAllocator(2, 4)
+    b.alloc("A", 2)
+    with pytest.raises(PoolExhausted) as ei2:
+        b.alloc("B", 1)
+    assert ei2.value.spilled == 0
+    assert "spilled" not in str(ei2.value)
+
+
+def test_pool_occupancy_ignores_spilled_and_gauges_spilled():
+    """Occupancy counts LIVE blocks only: content in the host tier
+    must move neither occupancy nor the cached count — it is tracked
+    by its own figure (`spilled_blocks`, the serve.kv.spilled
+    gauge)."""
+    from icikit.models.transformer.model import make_model_mesh
+    from icikit.serve.kvpool import KVPool
+
+    mesh = make_model_mesh(dp=1, tp=1, sp=1)
+    pool = KVPool(_tiny_cfg(), mesh, n_blocks=4, block_size=4,
+                  host_blocks=8)
+    a = pool.allocators[0]
+    t = a.alloc("A", 4)
+    for i, p in enumerate(t):
+        pool.seal(0, p)
+        a.register(p, f"o{i}")
+    pool.release("A", 0)
+    assert pool.occupancy() == 0.0       # cached, not live
+    a.alloc("B", 4)                      # all four spill
+    assert pool.spilled_blocks() == 4
+    assert pool.occupancy() == 1.0       # B's live pages only
+    pool.release("B", 0)
+    assert pool.occupancy() == 0.0
+    assert pool.spilled_blocks() == 4    # spilled content unaffected
+
+
+def test_q8_spill_restores_scales_and_verifies_with_blocks():
+    """int8 arenas: the spilled payload must carry the SCALE pages
+    with the quantized blocks, the swap-in digest must cover both,
+    and a flipped scale in the host copy must fail the verify and
+    quarantine the content (never trusted, recompute instead)."""
+    from icikit.models.transformer.model import make_model_mesh
+    from icikit.serve.kvpool import KVPool, _page_digest
+
+    mesh = make_model_mesh(dp=1, tp=1, sp=1)
+    pool = KVPool(_tiny_cfg(), mesh, n_blocks=4, block_size=4,
+                  quant="int8", host_blocks=8)
+    a = pool.allocators[0]
+    [p1, p2] = a.alloc("A", 2)
+    data = np.arange(4 * 2 * 8, dtype=np.int8).reshape(4, 2, 8)
+    for li in range(2):
+        for p in (p1, p2):
+            pool.poke_page(0, p, li, data + p + li)
+    for i, p in enumerate((p1, p2)):
+        pool.seal(0, p)
+        a.register(p, f"q{i}")
+    pool.release("A", 0)
+    a.alloc("B", 4)                      # both spill
+    assert a.n_spilled == 2
+    pool.release("B", 0)                 # free device room to restore
+    # clean restore: scales ride along bitwise
+    out = pool.restore_block("C", 0, "q0")
+    assert isinstance(out, dict)
+    page = a.table("C")[0]
+    np.testing.assert_array_equal(
+        pool.read_page(0, page, 1, side="q8"), data + p1 + 1)
+    assert pool.verify("C", 0) == []
+    # the q8 payload interleaves scale pages: 4 arrays per layer
+    rec = pool._materialize(0, "q1")
+    assert len(rec[2]) == 4 * pool.cfg.n_layers
+    assert _page_digest(rec[2]) == rec[1]
+    # flip ONE scale value in the host copy -> swap-in verify fails,
+    # content quarantined from the tier
+    rec[2][2] = np.array(rec[2][2])      # ksc page of layer 0
+    rec[2][2].flat[3] += 0.5
+    assert pool.restore_block("D", 0, "q1") is None
+    assert not a.spilled("q1")           # quarantined, not retryable
+    assert a.table("D") == ()

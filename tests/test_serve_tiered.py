@@ -1,0 +1,344 @@
+"""Tiered KV cache: engine identity pins + tier chaos drills (r16).
+
+The tentpole contract, pinned by outputs rather than construction
+claims: spill and restore are **bitwise invisible** to committed
+tokens. Every test decodes through the real admission machinery —
+eviction pressure spills indexed blocks to the host tier, a later
+same-prefix admission swaps them back in through the bounded restore
+stream, a restarted engine re-warms from the persistent store — and
+every served continuation must equal single-request
+``greedy_generate`` exactly, across dp/tp meshes and with quantized
+co-batch neighbors.
+
+The failure drills exercise the real detection paths:
+
+- a flipped spilled byte (``corrupt:serve.kv.spill``) fails the
+  swap-in digest verify, the content is quarantined from every tier,
+  and the request recomputes fresh — burning no retry, with
+  co-batched rows bitwise unchanged;
+- a store write killed mid-bytes (``die:serve.store.write``) leaves
+  a torn file that rewarm skips (and removes) instead of trusting;
+- a never-firing armed plan leaves tiered traffic bit-identical to
+  the unarmed baseline (the probe sites are free).
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from icikit import chaos
+from icikit.models.transformer import (
+    TransformerConfig,
+    greedy_generate,
+    init_params,
+)
+from icikit.models.transformer.model import make_model_mesh
+from icikit.serve import Engine, PrefixStore, RequestQueue, ServeConfig
+
+CFG = TransformerConfig(vocab=61, d_model=32, n_heads=2, d_head=8,
+                        d_ff=64, n_layers=2, max_seq=64,
+                        compute_dtype="float32")
+
+SV = dict(max_rows=2, block_size=4, n_blocks=8, max_prompt=16,
+          max_new=16, host_cache_blocks=32)
+
+
+def _setup(mesh=None, seed=3, n_new=10):
+    mesh = mesh or make_model_mesh(dp=1, tp=1, sp=1)
+    params = init_params(jax.random.key(0), CFG, mesh)
+    rng = np.random.default_rng(seed)
+    target = rng.integers(0, CFG.vocab, (8,)).astype(np.int32)
+    fillers = [rng.integers(0, CFG.vocab, (8,)).astype(np.int32)
+               for _ in range(3)]
+    # the baseline decodes on a dp=1 mesh (a b=1 prompt cannot shard
+    # over dp=2); greedy tokens are mesh-invariant, which the serving
+    # identity pins elsewhere already rely on
+    m1 = make_model_mesh(dp=1, tp=1, sp=1)
+    p1 = init_params(jax.random.key(0), CFG, m1)
+    base = np.asarray(greedy_generate(
+        p1, jnp.asarray(target)[None], m1, CFG, n_new))[0, 8:]
+    return mesh, params, target, fillers, base
+
+
+def _spill_target(eng, target, fillers, n_new=10):
+    """Serve the target once (its prefix registers), then fill the
+    tiny pool with other traffic until the target's blocks are
+    EVICTED into the spill tier — the deterministic pressure recipe
+    every test below builds on."""
+    eng.submit(target, n_new)
+    eng.run()
+    for p in fillers:
+        eng.submit(p, n_new)
+        eng.run()
+    from icikit.serve.kvpool import block_hashes
+    hs = block_hashes(target, eng.serve.block_size)
+    a = eng.pool.allocators[0]
+    assert any(a.spilled(h) for h in hs), \
+        "pressure recipe failed to spill the target's chain"
+    return hs
+
+
+@pytest.mark.parametrize("dp,tp", [(1, 1), (2, 1), (1, 2)])
+def test_hit_on_spilled_chain_is_token_identical(dp, tp):
+    """An admission landing on a fully spilled chain restores it and
+    serves tokens bitwise equal to single-request generate, with the
+    restore accounted as a (spill) hit."""
+    mesh = make_model_mesh(dp=dp, tp=tp, sp=1)
+    mesh, params, target, fillers, base = _setup(mesh)
+    sv = dict(SV, max_rows=2 * dp) if dp > 1 else dict(SV)
+    eng = Engine(params, mesh, CFG, ServeConfig(**sv))
+    _spill_target(eng, target, fillers)
+    rid = eng.submit(target, 10)
+    eng.run()
+    req = eng.queue.request(rid)
+    assert req.state == "done" and req.attempts == 1
+    np.testing.assert_array_equal(np.asarray(req.tokens), base)
+    st = eng.prefix_stats()
+    assert st["spill_hits"] >= 1 and st["restores"] >= 1
+    assert st["restores_host"] == st["restores"]
+    assert st["spill_hit_tokens"] > 0
+    assert req.prefix_hit_tokens == 7     # full hit: s-1 recompute
+
+
+def test_partial_spill_mixes_device_and_host_tiers():
+    """Half the chain resident, half spilled: the admission shares
+    the device prefix and restores only the spilled remainder —
+    still token-identical."""
+    mesh, params, target, fillers, base = _setup()
+    eng = Engine(params, mesh, CFG, ServeConfig(**SV))
+    hs = _spill_target(eng, target, fillers)
+    # revive the ROOT block onto the device (cached) while the deeper
+    # block stays spilled: restore root into a temp owner and release
+    out = eng.pool.restore_block("__pin", 0, hs[0])
+    assert out is not None
+    eng.pool.release("__pin", 0)
+    a = eng.pool.allocators[0]
+    assert a.indexed(hs[0]) is not None and a.spilled(hs[1])
+    rid = eng.submit(target, 10)
+    eng.run()
+    req = eng.queue.request(rid)
+    np.testing.assert_array_equal(np.asarray(req.tokens), base)
+    st = eng.prefix_stats()
+    assert st["hits"] >= 1 and st["restores"] >= 1
+
+
+def test_rewarm_from_store_then_hit_is_token_identical(tmp_path):
+    """The restart story: engine 1 persists its sealed blocks at
+    drain; a FRESH engine over the same store rewarms the queued
+    prompts' chains from disk (the RequestQueue.pending_prompts
+    hook) and serves them token-identically, with the store as the
+    restore source."""
+    mesh, params, target, fillers, base = _setup()
+    sv = ServeConfig(**SV, store_dir=str(tmp_path / "store"))
+    eng1 = Engine(params, mesh, CFG, sv)
+    eng1.submit(target, 10)
+    eng1.run()                     # drain flush persists the chain
+    assert eng1.pool.store.n_blocks() >= 2
+    # restart: fresh engine, fresh pool, same store
+    q2 = RequestQueue()
+    eng2 = Engine(params, mesh, CFG, sv, queue=q2)
+    rid = eng2.submit(target, 10)
+    n = eng2.rewarm()              # defaults to pending_prompts()
+    assert n >= 2                  # the prompt's two full blocks
+    eng2.run()
+    req = q2.request(rid)
+    assert req.state == "done"
+    np.testing.assert_array_equal(np.asarray(req.tokens), base)
+    # rewarmed blocks were CACHED: the admission hit them on-device
+    assert eng2.prefix_stats()["hits"] >= 1
+
+
+def test_demand_paging_from_store_without_rewarm(tmp_path):
+    """No eager rewarm: the admission path's tier lookup pulls the
+    persisted chain from disk on demand — same identity, restores
+    sourced from the store."""
+    mesh, params, target, fillers, base = _setup()
+    sv = ServeConfig(**SV, store_dir=str(tmp_path / "store"))
+    eng1 = Engine(params, mesh, CFG, sv)
+    eng1.submit(target, 10)
+    eng1.run()
+    q2 = RequestQueue()
+    eng2 = Engine(params, mesh, CFG, sv, queue=q2)
+    rid = eng2.submit(target, 10)
+    eng2.run()
+    req = q2.request(rid)
+    np.testing.assert_array_equal(np.asarray(req.tokens), base)
+    st = eng2.prefix_stats()
+    assert st["restores_store"] >= 1 and st["spill_hits"] >= 1
+
+
+def test_mixed_engine_fp_restore_with_q8_cobatch():
+    """Containment: an fp row served through the restore path
+    co-batched with an int8 row — the fp tokens stay bitwise
+    generate's (the tier never touches the q8 arenas of a mixed
+    pool)."""
+    mesh, params, target, fillers, base = _setup()
+    sv = ServeConfig(**dict(SV, n_blocks=12), kv_quant="mixed")
+    eng = Engine(params, mesh, CFG, sv)
+    _spill_target(eng, target, fillers)
+    r_fp = eng.submit(target, 10)
+    r_q8 = eng.submit(fillers[0], 10, quant=True)
+    eng.run()
+    np.testing.assert_array_equal(
+        np.asarray(eng.queue.request(r_fp).tokens), base)
+    assert eng.queue.request(r_q8).state == "done"
+    assert eng.prefix_stats()["restores"] >= 1
+
+
+def test_spilled_byte_flip_quarantined_and_recomputed():
+    """The tier SDC drill: a flipped byte in the spilled payload
+    fails the swap-in digest verify, the content is quarantined from
+    the host tier, and the request recomputes fresh — same tokens,
+    SAME attempt (no retry burned), co-batched row bitwise
+    unchanged."""
+    mesh, params, target, fillers, base = _setup()
+    other = np.asarray([7, 11, 13, 17, 19, 23, 29, 31], np.int32)
+    other_base = np.asarray(greedy_generate(
+        params, jnp.asarray(other)[None], mesh, CFG, 10))[0, 8:]
+    eng = Engine(params, mesh, CFG, ServeConfig(**SV))
+    hs = _spill_target(eng, target, fillers)
+    rid = eng.submit(target, 10)
+    r_other = eng.submit(other, 10)
+    plan = chaos.FaultPlan(schedule={"corrupt:serve.kv.spill": (0,)})
+    with chaos.inject(plan):
+        eng.run()
+    assert plan.fired("corrupt", "serve.kv.spill") == 1
+    req = eng.queue.request(rid)
+    assert req.state == "done" and req.attempts == 1
+    np.testing.assert_array_equal(np.asarray(req.tokens), base)
+    np.testing.assert_array_equal(
+        np.asarray(eng.queue.request(r_other).tokens), other_base)
+    # the corrupt content left the tier (quarantined, not retryable)
+    a = eng.pool.allocators[0]
+    assert not a.spilled(hs[0])
+    st = eng.prefix_stats()
+    assert st["restores"] == 0         # nothing corrupt was trusted
+
+
+def test_torn_store_write_skipped_at_rewarm(tmp_path):
+    """The disk-tier die drill: a store write killed mid-bytes leaves
+    a torn file; a restarted engine's rewarm SKIPS it (validation
+    quarantine) and recomputes — tokens still identical."""
+    mesh, params, target, fillers, base = _setup()
+    sv = ServeConfig(**SV, store_dir=str(tmp_path / "store"))
+    eng1 = Engine(params, mesh, CFG, sv)
+    eng1.submit(target, 10)
+    plan = chaos.FaultPlan(schedule={"die:serve.store.write": (0,)})
+    with chaos.inject(plan):
+        with pytest.raises(chaos.InjectedDeath):
+            eng1.run()         # dies inside the drain flush
+    assert plan.fired("die", "serve.store.write") == 1
+    store = PrefixStore(str(tmp_path / "store"))
+    n_files = store.n_blocks()
+    assert n_files >= 1        # the torn file is on disk
+    torn = [p.stem for p in sorted(
+        (tmp_path / "store").glob("*.npz"))]
+    # the torn entry fails validation and is removed; intact ones
+    # (written before the kill) still load
+    loaded = [store.get(h) for h in torn]
+    assert any(rec is None for rec in loaded)
+    assert store.n_quarantined >= 1
+    # a fresh engine over the same store serves correctly regardless
+    q2 = RequestQueue()
+    eng2 = Engine(params, mesh, CFG, sv, queue=q2)
+    rid = eng2.submit(target, 10)
+    eng2.rewarm()
+    eng2.run()
+    np.testing.assert_array_equal(
+        np.asarray(q2.request(rid).tokens), base)
+
+
+def test_store_read_corruption_quarantined(tmp_path):
+    """The disk-tier SDC drill: a flipped persisted byte (injected on
+    the read path, after the bytes parsed) fails the swap-in verify;
+    the file is quarantined and the request recomputes fresh —
+    identical tokens, no retry burned."""
+    mesh, params, target, fillers, base = _setup()
+    sv = ServeConfig(**SV, store_dir=str(tmp_path / "store"))
+    eng1 = Engine(params, mesh, CFG, sv)
+    eng1.submit(target, 10)
+    eng1.run()
+    n0 = eng1.pool.store.n_blocks()
+    assert n0 >= 2
+    q2 = RequestQueue()
+    eng2 = Engine(params, mesh, CFG, sv, queue=q2)
+    rid = eng2.submit(target, 10)
+    plan = chaos.FaultPlan(schedule={"corrupt:serve.store.read": (0,)})
+    with chaos.inject(plan):
+        eng2.run()
+    assert plan.fired("corrupt", "serve.store.read") == 1
+    req = q2.request(rid)
+    assert req.state == "done" and req.attempts == 1
+    np.testing.assert_array_equal(np.asarray(req.tokens), base)
+    assert eng2.pool.store.n_quarantined >= 1
+
+
+def test_clean_armed_tiered_run_identical():
+    """A never-firing plan over tiered traffic (spills, restores,
+    store writes all live) leaves outputs bit-identical to the
+    unarmed baseline — the tier probe sites are free."""
+    mesh, params, target, fillers, base = _setup()
+    eng = Engine(params, mesh, CFG, ServeConfig(**SV))
+    _spill_target(eng, target, fillers)
+    rid = eng.submit(target, 10)
+    plan = chaos.FaultPlan(rates={"die:serve.kv.*": 0.0,
+                                  "delay:serve.store.*": 0.0})
+    with chaos.inject(plan):
+        eng.run()
+    assert plan.log == []
+    np.testing.assert_array_equal(
+        np.asarray(eng.queue.request(rid).tokens), base)
+
+
+def test_dead_engine_mid_restore_reissues_token_identically():
+    """An engine dying AT the restore boundary (die:serve.kv.restore)
+    abandons its lease; a second engine completes the request
+    token-identically — restores carry no engine state."""
+    mesh, params, target, fillers, base = _setup()
+    q = RequestQueue(lease_s=0.05)
+    eng1 = Engine(params, mesh, CFG, ServeConfig(**SV), queue=q)
+    _spill_target(eng1, target, fillers)
+    rid = eng1.submit(target, 10)
+    plan = chaos.FaultPlan(schedule={"die:serve.kv.restore": (0,)})
+    with chaos.inject(plan):
+        with pytest.raises(chaos.InjectedDeath):
+            eng1.run()
+        time.sleep(0.06)
+        eng2 = Engine(params, mesh, CFG, ServeConfig(**SV), queue=q)
+        eng2.run()
+    req = q.request(rid)
+    assert req.state == "done" and req.attempts == 2
+    np.testing.assert_array_equal(np.asarray(req.tokens), base)
+
+
+def test_tiers_require_prefix_cache():
+    mesh = make_model_mesh(dp=1, tp=1, sp=1)
+    params = init_params(jax.random.key(0), CFG, mesh)
+    with pytest.raises(ValueError, match="prefix_cache"):
+        Engine(params, mesh, CFG,
+               ServeConfig(**dict(SV, prefix_cache=False)))
+
+
+def test_prefix_store_roundtrip_and_validation(tmp_path):
+    """PrefixStore unit surface: put/get/has round trip, content
+    addressing (duplicate put is a no-op), format validation, torn
+    file quarantine."""
+    store = PrefixStore(tmp_path / "s")
+    arrays = [np.arange(12, dtype=np.float32).reshape(3, 4),
+              np.ones((2, 2), np.float32)]
+    assert store.put("abc", "fp", "d1gest", arrays)
+    assert not store.put("abc", "fp", "d1gest", arrays)  # LWW no-op
+    assert store.has("abc") and not store.has("zzz")
+    side, digest, back = store.get("abc")
+    assert side == "fp" and digest == "d1gest"
+    for a, b in zip(arrays, back):
+        np.testing.assert_array_equal(a, b)
+    assert store.n_blocks() == 1 and store.nbytes() > 0
+    # torn file: truncate -> get() quarantines (None + file removed)
+    path = store._path("abc")
+    path.write_bytes(path.read_bytes()[:20])
+    assert store.get("abc") is None
+    assert not store.has("abc") and store.n_quarantined == 1

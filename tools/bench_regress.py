@@ -57,6 +57,7 @@ NUMERIC_CONFIG = {
     "batch", "n_new", "prompt", "draft_layers", "n_layers",
     "train_steps", "distill_steps", "d_model", "n_heads", "d_head",
     "d_ff", "vocab", "max_seq", "runs", "reps", "tokens_per_s_reps",
+    "tenants", "zipf", "host_cache_blocks", "n_prompts",
 }
 
 # (path, direction, default relative tolerance) — applied when the
@@ -68,6 +69,12 @@ DEFAULT_METRICS = (
     ("tpot_ms.p50", "lower", 0.50),
     ("acceptance_rate", "higher", 0.10),
     ("tokens_per_step", "higher", 0.10),
+    # r16 tiered-KV rows: the rewarm A/B gates on time-to-first-
+    # completion, the spill arms on hit tokens (both noisy at CPU
+    # smoke scale, hence the wide bands — the seed-spread widening
+    # still applies on top)
+    ("ttfc_ms", "lower", 0.50),
+    ("prefix.hit_tokens", "higher", 0.25),
 )
 
 

@@ -5,8 +5,8 @@
 PY ?= python
 
 .PHONY: test test-fast chaos bench native clean sweep scaling northstar \
-	trace-demo check decode-smoke draft-smoke serve-smoke quant-smoke \
-	obs-smoke
+	trace-demo check analysis-smoke decode-smoke draft-smoke \
+	serve-smoke quant-smoke obs-smoke
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -33,30 +33,36 @@ trace-demo:
 		--sample-tokens 0 > /dev/null
 	$(PY) -m icikit.obs.check /tmp/icikit_trace_env.json
 
-# lint: telemetry goes through the icikit.obs event bus, not bare
-# prints — a new `print(json.dumps(...)` outside icikit/obs/ fails CI
+# static analysis: ONE entry point for the whole invariant suite
+# (docs/ANALYSIS.md) — the six former lint scripts, the two former
+# grep lints, and the host-sync + lock-discipline hot-path analyses,
+# all as rules of icikit.analysis. --gate fails on any unbaselined
+# finding; --self-check proves each seedable rule still catches its
+# planted violation (a gate that cannot fail is not a gate); --budget
+# asserts the suite stays cheap enough to run on every PR. The bench
+# regression self-check rides along: it gates measured records, not
+# source invariants, so it is not an analysis rule.
 check:
-	@bad=$$(grep -rn "print(json\.dumps" icikit --include='*.py' \
-		| grep -v '^icikit/obs/'); \
-	if [ -n "$$bad" ]; then \
-		echo "bare print(json.dumps telemetry — route it through icikit.obs:"; \
-		echo "$$bad"; exit 1; \
-	fi
-	@echo "check OK: no bare print(json.dumps telemetry outside icikit/obs/"
-	@bad=$$(grep -rn "time\.time(" icikit/serve --include='*.py'); \
-	if [ -n "$$bad" ]; then \
-		echo "wall clock in icikit/serve — SLO math must use time.monotonic:"; \
-		echo "$$bad"; exit 1; \
-	fi
-	@echo "check OK: icikit/serve SLO clocks are monotonic"
-	$(PY) tools/serve_key_lint.py
-	JAX_PLATFORMS=cpu $(PY) tools/quant_lint.py
-	JAX_PLATFORMS=cpu $(PY) tools/chaos_site_lint.py
-	$(PY) tools/tree_accept_lint.py
-	$(PY) tools/obs_catalog_lint.py
+	JAX_PLATFORMS=cpu $(PY) -m icikit.analysis --gate --self-check \
+		--budget 30
 	$(PY) tools/bench_regress.py --self-check serve_r12.jsonl \
 		serve_r15.jsonl serve_r16.jsonl decode_spec_r14.jsonl \
 		--verdict /tmp/icikit_bench_regress.json
+
+# machine-readable analysis output: the --json shape the tooling
+# consumes (report path, rule list, per-finding records with their
+# baselined flag) — exercised here so a shape change fails CI, not a
+# downstream consumer
+analysis-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m icikit.analysis \
+		--json /tmp/icikit_analysis.json
+	$(PY) -c "import json; d = json.load(open('/tmp/icikit_analysis.json')); \
+	assert d['version'] == 1 and len(d['rules']) >= 9, d['rules']; \
+	assert all({'rule','path','line','msg','baselined'} == set(f) \
+	    for f in d['findings']), 'finding shape drifted'; \
+	assert d['counts']['unbaselined'] == 0, d['counts']; \
+	print('analysis-smoke OK:', len(d['rules']), 'rules,', \
+	    d['counts']['findings'], 'findings, json shape stable')"
 
 # request-scoped tracing + anomaly watch, end to end: a tiny Poisson
 # serve session with the trace AND the watch armed — the exported

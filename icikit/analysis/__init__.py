@@ -1,0 +1,35 @@
+"""``icikit.analysis`` — one AST static-analysis pass over the tree.
+
+The repo's invariants used to be enforced by six disconnected scripts
+in ``tools/`` plus two grep pipelines in the Makefile, each with its
+own file walking, parsing, and escape-hatch conventions. This package
+is the consolidation: ONE tree walker with a per-file parse cache, a
+shared :class:`~icikit.analysis.core.Finding` model, per-line
+``# icikit-lint: off[rule]`` suppressions, a committed baseline file
+for grandfathered findings, and a single gated CLI entry point
+(``python -m icikit.analysis --gate``) that ``make check`` runs.
+
+Rules (see docs/ANALYSIS.md for the catalog):
+
+- ported, semantics pinned by tests: ``serve-key``, ``chaos-site``,
+  ``tree-accept``, ``obs-catalog``, ``quant-arena`` (runtime), plus
+  the two former Makefile greps ``obs-print`` and ``serve-clock``;
+- new hot-path analyses: ``host-sync`` (implicit device->host
+  synchronization inside the engine step / decode / train loops) and
+  ``lock-discipline`` (bus emits, device dispatch, file I/O and
+  ``time.*`` calls lexically under ``with self._lock``-style blocks).
+
+The old ``tools/*_lint.py`` scripts remain as thin shims re-exporting
+their rule for backward compatibility.
+"""
+
+from icikit.analysis.core import (  # noqa: F401
+    Finding,
+    Project,
+    Rule,
+    all_rules,
+    get_rule,
+    rule,
+    run_rules,
+    shim_main,
+)

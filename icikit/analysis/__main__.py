@@ -1,0 +1,3 @@
+from icikit.analysis.cli import main
+
+raise SystemExit(main())

@@ -1,0 +1,99 @@
+"""Committed baseline: grandfathered findings the gate tolerates.
+
+``tools/analysis_baseline.json`` holds a list of entries::
+
+    {"rule": ..., "path": ..., "msg": ..., "note": "why this is
+     grandfathered instead of fixed"}
+
+An entry matches findings on the stable ``(rule, path, msg)`` triple
+— line numbers shift under unrelated edits and are deliberately not
+part of the identity. Each entry absorbs at most ``count`` matching
+findings (default 1): a NEW violation that happens to render the
+same message as a grandfathered one must NOT ride its exemption —
+the (n+1)-th match comes out unbaselined and fails the gate. Every
+entry MUST carry a non-empty ``note``: a baseline without a recorded
+reason is just a muted alarm, and the loader fails loudly on one.
+The gate reports (without failing) any STALE entry whose findings no
+longer exist (or an over-counted entry), so fixed code sheds its
+baseline in the next PR instead of accreting dead exemptions.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+from icikit.analysis.core import Finding
+
+DEFAULT_BASELINE = os.path.join("tools", "analysis_baseline.json")
+
+
+def load(path: str) -> list[dict]:
+    if not os.path.isfile(path):
+        return []
+    with open(path, encoding="utf-8") as f:
+        entries = json.load(f)
+    if not isinstance(entries, list):
+        raise ValueError(f"{path}: baseline must be a JSON list")
+    for e in entries:
+        missing = {"rule", "path", "msg"} - set(e)
+        if missing:
+            raise ValueError(
+                f"{path}: baseline entry missing {sorted(missing)}: "
+                f"{e}")
+        if not str(e.get("note", "")).strip():
+            raise ValueError(
+                f"{path}: baseline entry for {e['rule']} @ "
+                f"{e['path']} has no justification note — say why "
+                "it is grandfathered or fix it")
+        if not isinstance(e.get("count", 1), int) \
+                or e.get("count", 1) < 1:
+            raise ValueError(
+                f"{path}: baseline entry for {e['rule']} @ "
+                f"{e['path']} has a non-positive count")
+    return entries
+
+
+def split(findings: list[Finding], entries: list[dict]):
+    """Partition ``findings`` into (unbaselined, baselined) and
+    report stale entries. Each entry absorbs at most its ``count``
+    matches (findings in sorted order, so the allocation is
+    deterministic); the overflow is fresh — a new same-message
+    violation cannot hide behind a grandfathered one. An entry whose
+    budget is not fully consumed is stale (partially or wholly): the
+    code improved, shrink or drop the entry."""
+    budget: dict[tuple, int] = {}
+    for e in entries:
+        key = (e["rule"], e["path"], e["msg"])
+        budget[key] = budget.get(key, 0) + int(e.get("count", 1))
+    fresh, grandfathered = [], []
+    for f in sorted(findings):
+        k = f.baseline_key()
+        if budget.get(k, 0) > 0:
+            budget[k] -= 1
+            grandfathered.append(f)
+        else:
+            fresh.append(f)
+    stale = [e for e in entries
+             if budget.get((e["rule"], e["path"], e["msg"]), 0) > 0]
+    return fresh, grandfathered, stale
+
+
+def write(path: str, findings: list[Finding],
+          note: str = "grandfathered at baseline capture — "
+                      "revisit before relying on this entry") -> int:
+    """Capture ``findings`` as the new baseline (CLI
+    ``--write-baseline``): one entry per (rule, path, msg) with its
+    exact match count. The shared placeholder note satisfies the
+    loader mechanically; replace it with the real reason per entry
+    before committing."""
+    counts: dict[tuple, int] = {}
+    for f in findings:
+        counts[f.baseline_key()] = counts.get(f.baseline_key(), 0) + 1
+    entries = [{"rule": rule, "path": path, "msg": msg,
+                "count": n, "note": note}
+               for (rule, path, msg), n in sorted(counts.items())]
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(entries, f, indent=2, sort_keys=True)
+        f.write("\n")
+    return len(entries)

@@ -1,0 +1,240 @@
+"""``python -m icikit.analysis`` — the one analysis entry point.
+
+Modes:
+
+- default: run all rules, print findings (baseline-annotated), exit 0
+  — the explorer's view;
+- ``--gate``: exit nonzero on any UNBASELINED finding — what ``make
+  check`` runs;
+- ``--json PATH|-``: machine-readable findings (``make
+  analysis-smoke`` asserts the shape);
+- ``--self-check``: seed one violation per seedable rule into a
+  synthetic mini-tree and assert each rule catches it — the drill
+  that proves the gate can actually fail;
+- ``--write-baseline``: capture current findings as the baseline
+  (placeholder notes — edit in the real reasons before committing);
+- ``--budget S``: fail if the whole invocation exceeded S seconds
+  (CI asserts the gate stays cheap enough to run on every PR).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+from icikit.analysis import baseline as _baseline
+from icikit.analysis.core import (
+    Project,
+    all_rules,
+    repo_root,
+    run_rules,
+)
+
+
+def _parse_args(argv):
+    p = argparse.ArgumentParser(
+        prog="python -m icikit.analysis",
+        description="unified AST static-analysis suite (docs/"
+                    "ANALYSIS.md)")
+    p.add_argument("--root", default=None,
+                   help="repo root to analyze (default: this repo)")
+    p.add_argument("--rules", default=None,
+                   help="comma-separated rule subset (default: all)")
+    p.add_argument("--gate", action="store_true",
+                   help="exit nonzero on any unbaselined finding")
+    p.add_argument("--json", default=None, metavar="PATH",
+                   help="write machine-readable findings ('-' = "
+                        "stdout)")
+    p.add_argument("--baseline", default=None, metavar="PATH",
+                   help="baseline file (default: tools/"
+                        "analysis_baseline.json under --root)")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="capture current findings as the baseline")
+    p.add_argument("--self-check", action="store_true",
+                   help="seeded-violation drill: prove each seedable "
+                        "rule still fires")
+    p.add_argument("--budget", type=float, default=None, metavar="S",
+                   help="fail if the run took more than S seconds")
+    p.add_argument("--list", action="store_true",
+                   help="list registered rules and exit")
+    return p.parse_args(argv)
+
+
+def main(argv=None) -> int:
+    t0 = time.monotonic()
+    args = _parse_args(argv)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+
+    if args.list:
+        for r in all_rules():
+            kind = "runtime" if r.runtime else "static"
+            print(f"{r.name:16s} [{kind}] {r.doc}")
+        return 0
+
+    root = os.path.abspath(args.root or repo_root())
+    names = ([n.strip() for n in args.rules.split(",") if n.strip()]
+             if args.rules else None)
+    project = Project(root)
+    findings = run_rules(project, names)
+
+    bl_path = args.baseline or os.path.join(
+        root, _baseline.DEFAULT_BASELINE)
+    if args.write_baseline:
+        n = _baseline.write(bl_path, findings)
+        print(f"analysis: wrote {n} baseline entries to {bl_path} — "
+              "replace the placeholder notes with real reasons")
+        return 0
+    rule_names = [r.name for r in all_rules()] if names is None \
+        else names
+    # a --rules subset judges only its own entries: an entry for a
+    # rule that did not run is unjudgeable, not stale
+    entries = [e for e in _baseline.load(bl_path)
+               if e["rule"] in set(rule_names)]
+    fresh, grandfathered, stale = _baseline.split(findings, entries)
+    if args.json:
+        # identity, not baseline key: with a count-capped entry, the
+        # overflow finding shares the key with absorbed ones but must
+        # report baselined:false (it is the fresh violation)
+        fresh_set = set(fresh)
+        payload = {
+            "version": 1,
+            "root": root,
+            "rules": rule_names,
+            "elapsed_s": round(time.monotonic() - t0, 3),
+            "counts": {"findings": len(findings),
+                       "unbaselined": len(fresh),
+                       "baselined": len(grandfathered),
+                       "stale_baseline": len(stale)},
+            "findings": [
+                {"rule": f.rule, "path": f.path, "line": f.line,
+                 "msg": f.msg,
+                 "baselined": f not in fresh_set}
+                for f in findings],
+        }
+        text = json.dumps(payload, indent=2, sort_keys=True)
+        if args.json == "-":
+            sys.stdout.write(text + "\n")
+        else:
+            with open(args.json, "w", encoding="utf-8") as f:
+                f.write(text + "\n")
+
+    for f in fresh:
+        print(f.render())
+    for f in grandfathered:
+        print(f"{f.render()}   [baselined]")
+    for e in stale:
+        print(f"analysis: stale baseline entry (nothing matches it "
+              f"any more — drop it): {e['rule']} @ {e['path']}: "
+              f"{e['msg']!r}")
+
+    rc = 0
+    if args.self_check:
+        rc = max(rc, _self_check())
+    elapsed = time.monotonic() - t0
+    if args.budget is not None and elapsed > args.budget:
+        print(f"analysis FAILED: run took {elapsed:.1f}s, over the "
+              f"--budget {args.budget:.0f}s ceiling — a gate this "
+              "slow stops being run on every PR")
+        rc = max(rc, 1)
+    n_rules = len(rule_names)
+    if fresh:
+        print(f"analysis: {len(fresh)} unbaselined finding(s) "
+              f"({len(grandfathered)} baselined) across {n_rules} "
+              f"rules in {elapsed:.1f}s")
+        if args.gate:
+            return 1
+        return rc
+    print(f"analysis OK: {n_rules} rules, "
+          f"{len(grandfathered)} baselined finding(s), 0 unbaselined, "
+          f"{elapsed:.1f}s")
+    return rc
+
+
+# -- the seeded-violation drill --------------------------------------
+
+# rule -> (relative path, file content): ONE violation each, planted
+# in a synthetic mini-tree. Runtime rules (quant-arena, chaos-site's
+# registry half) need the real package and are proven by the pytest
+# corpus instead; the drill covers every purely-static rule.
+SEEDS = {
+    "serve-key": ("icikit/serve/seeded.py",
+                  "import numpy as np\n"
+                  "tok = np.random.randint(0, 7)\n"),
+    "serve-clock": ("icikit/serve/clocked.py",
+                    "import time\nt0 = time.time()\n"),
+    "obs-print": (
+        "icikit/telemetry_leak.py",
+        "import json\n"
+        "print(json.dumps({'a': 1}))\n"),  # icikit-lint: off[obs-print]
+    "host-sync": ("icikit/serve/engine.py",
+                  "def _step(self):\n"
+                  "    outs = self._step_fns[0](1)\n"
+                  "    for o in range(4):\n"
+                  "        x = float(outs)\n"),
+    "lock-discipline": ("icikit/obs/locked.py",
+                        "import time\n"
+                        "class S:\n"
+                        "    def f(self):\n"
+                        "        with self._lock:\n"
+                        "            t = time.monotonic()\n"),
+    "tree-accept": (
+        "icikit/models/transformer/other.py",
+        "def _accept_window(x):\n    return x\n"),  # icikit-lint: off[tree-accept]
+}
+
+
+def _self_check() -> int:
+    """Plant each seed in a temp mini-tree and assert its rule fires
+    — the drill that distinguishes "the gate passed" from "the gate
+    can no longer fail"."""
+    import shutil
+    import tempfile
+
+    failed = []
+    for rule_name, (rel, content) in sorted(SEEDS.items()):
+        tmp = tempfile.mkdtemp(prefix="icikit_analysis_drill_")
+        try:
+            path = os.path.join(tmp, rel)
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            with open(path, "w", encoding="utf-8") as f:
+                f.write(content)
+            if rule_name == "tree-accept":
+                # the duplicate-definition seed needs the canonical
+                # home to exist, or every finding is about absence
+                spec = os.path.join(
+                    tmp, "icikit/models/transformer/speculative.py")
+                with open(spec, "w", encoding="utf-8") as f:
+                    f.write("def _accept_window(x):\n    return x\n"  # icikit-lint: off[tree-accept]
+                            "def _accept_tree(x):\n"  # icikit-lint: off[tree-accept]
+                            "    return _accept_window(x)\n")
+                eng = os.path.join(tmp, "icikit/serve/engine.py")
+                os.makedirs(os.path.dirname(eng), exist_ok=True)
+                with open(eng, "w", encoding="utf-8") as f:
+                    f.write("# _accept_window _accept_tree\n")
+            got = run_rules(Project(tmp), [rule_name])
+            if not any(f.rule == rule_name and f.path == rel
+                       for f in got):
+                failed.append(rule_name)
+        finally:
+            shutil.rmtree(tmp, ignore_errors=True)
+    if failed:
+        print("analysis self-check FAILED: seeded violations not "
+              f"caught by: {', '.join(failed)} — the gate cannot "
+              "fail any more; fix the rule before trusting a green "
+              "run")
+        return 1
+    print(f"analysis self-check OK: {len(SEEDS)} seeded violations "
+          "each caught by their rule")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,260 @@
+"""Framework core: file/parse cache, Finding model, rule registry,
+suppressions.
+
+Every rule sees the repo through one :class:`Project` — files are read
+and AST-parsed at most once per run no matter how many rules consume
+them, and findings flow back as :class:`Finding` records that the CLI
+renders (human or ``--json``), filters through per-line suppressions,
+and gates against the committed baseline.
+
+Escape hatches, in order of preference:
+
+- fix the code;
+- a per-line suppression ``# icikit-lint: off[rule]`` (or
+  ``off[rule-a,rule-b]``, or bare ``off`` for every rule) WITH a
+  justification in the surrounding comment — for documented fence
+  sites and deliberate negatives;
+- a baseline entry in ``tools/analysis_baseline.json`` with a
+  ``note`` saying why — for grandfathered findings a fix cannot ride
+  the current PR.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass, field
+
+# `# icikit-lint: off` or `# icikit-lint: off[rule-a,rule-b]` anywhere
+# in the line suppresses findings (all rules / the named rules) ON
+# that line. The legacy `# chaos-site-lint: off` marker is honored by
+# the chaos-site rule itself (pre-framework deliberate negatives).
+_SUPPRESS_RE = re.compile(
+    r"#\s*icikit-lint:\s*off(?:\[([A-Za-z0-9_,\s-]*)\])?")
+
+
+@dataclass(frozen=True, order=True)
+class Finding:
+    """One rule violation at one source location. ``path`` is
+    repo-relative (posix separators) so findings, suppressions, and
+    baseline entries compare stably across machines."""
+
+    rule: str
+    path: str
+    line: int
+    msg: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.msg}"
+
+    def baseline_key(self) -> tuple:
+        # line numbers shift under unrelated edits; grandfathering
+        # keys on the stable triple instead
+        return (self.rule, self.path, self.msg)
+
+
+class SourceFile:
+    """One cached source file: text, split lines, lazily-parsed AST,
+    and the per-line suppression table."""
+
+    def __init__(self, root: str, rel: str):
+        self.rel = rel
+        self.abspath = os.path.join(root, rel)
+        with open(self.abspath, encoding="utf-8") as f:
+            self.text = f.read()
+        self.lines = self.text.splitlines()
+        self._tree = None
+        self._parse_error: SyntaxError | None = None
+        self._suppress: dict[int, set | None] | None = None
+
+    @property
+    def tree(self) -> ast.Module | None:
+        """The parsed AST (cached), or None on a syntax error — the
+        runner reports unparsable files once, rules just skip them."""
+        if self._tree is None and self._parse_error is None:
+            try:
+                self._tree = ast.parse(self.text, self.rel)
+            except SyntaxError as e:
+                self._parse_error = e
+        return self._tree
+
+    @property
+    def parse_error(self) -> SyntaxError | None:
+        _ = self.tree
+        return self._parse_error
+
+    def suppressed(self, line: int, rule_name: str) -> bool:
+        """Is ``rule_name`` suppressed on 1-based ``line``?"""
+        if self._suppress is None:
+            table: dict[int, set | None] = {}
+            for i, text in enumerate(self.lines, 1):
+                m = _SUPPRESS_RE.search(text)
+                if not m:
+                    continue
+                names = m.group(1)
+                if names is None or not names.strip():
+                    table[i] = None          # bare off: every rule
+                else:
+                    table[i] = {n.strip() for n in names.split(",")
+                                if n.strip()}
+            self._suppress = table
+        rules = self._suppress.get(line, ())
+        return rules is None or rule_name in rules
+
+
+class Project:
+    """The analyzed tree. ``root`` is the repo root; ``file()`` and
+    the ``iter_*`` walkers hand out cached :class:`SourceFile`
+    objects, so N rules over M files parse each file once."""
+
+    #: data fixtures, not code under the invariants: the seeded-
+    #: violation corpus MUST stay out of the real tree's walk or the
+    #: gate would flag its own test fixtures
+    EXCLUDE = ("tests/analysis_corpus",)
+
+    def __init__(self, root: str):
+        self.root = os.path.abspath(root)
+        self._files: dict[str, SourceFile | None] = {}
+
+    def file(self, rel: str) -> SourceFile | None:
+        """The cached file at repo-relative ``rel`` (None if absent)."""
+        rel = rel.replace(os.sep, "/")
+        if rel not in self._files:
+            abspath = os.path.join(self.root, rel)
+            self._files[rel] = (SourceFile(self.root, rel)
+                                if os.path.isfile(abspath) else None)
+        return self._files[rel]
+
+    def iter_py(self, prefix: str = "", top_only: bool = False):
+        """Every ``.py`` file under ``prefix`` (repo-relative, sorted;
+        ``top_only`` pins the chaos-site rule's historical
+        non-recursive scan of tests/ and tools/)."""
+        base = os.path.join(self.root, prefix) if prefix else self.root
+        if not os.path.isdir(base):
+            return
+        if top_only:
+            for name in sorted(os.listdir(base)):
+                if name.endswith(".py"):
+                    rel = f"{prefix}/{name}" if prefix else name
+                    if not self._excluded(rel):
+                        yield self.file(rel)
+            return
+        for dirpath, dirnames, filenames in os.walk(base):
+            dirnames[:] = sorted(
+                d for d in dirnames if d != "__pycache__")
+            for name in sorted(filenames):
+                if not name.endswith(".py"):
+                    continue
+                rel = os.path.relpath(
+                    os.path.join(dirpath, name),
+                    self.root).replace(os.sep, "/")
+                if not self._excluded(rel):
+                    yield self.file(rel)
+
+    def _excluded(self, rel: str) -> bool:
+        return any(rel == e or rel.startswith(e + "/")
+                   for e in self.EXCLUDE)
+
+    def makefile_text(self) -> str:
+        path = os.path.join(self.root, "Makefile")
+        if not os.path.isfile(path):
+            return ""
+        with open(path, encoding="utf-8") as f:
+            return f.read()
+
+
+@dataclass
+class Rule:
+    """One registered analysis. ``check(project)`` returns raw
+    findings; the runner applies suppressions, dedupe, and ordering.
+    ``runtime=True`` marks rules that import icikit/jax and execute
+    code (the ported quant arena checks) — they are skipped by
+    ``--self-check``'s synthetic-tree drill, which has no package to
+    import."""
+
+    name: str
+    doc: str
+    check: object = field(repr=False)
+    runtime: bool = False
+
+
+_REGISTRY: dict[str, Rule] = {}
+
+
+def rule(name: str, doc: str, runtime: bool = False):
+    """Decorator: register ``fn(project) -> list[Finding]`` as a
+    rule."""
+    def deco(fn):
+        if name in _REGISTRY:
+            raise ValueError(f"duplicate rule {name!r}")
+        _REGISTRY[name] = Rule(name=name, doc=doc, check=fn,
+                               runtime=runtime)
+        return fn
+    return deco
+
+
+def all_rules() -> list[Rule]:
+    _load_rules()
+    return [_REGISTRY[k] for k in sorted(_REGISTRY)]
+
+
+def get_rule(name: str) -> Rule:
+    _load_rules()
+    if name not in _REGISTRY:
+        known = ", ".join(sorted(_REGISTRY))
+        raise KeyError(f"unknown rule {name!r} (known: {known})")
+    return _REGISTRY[name]
+
+
+def _load_rules() -> None:
+    # importing the package registers every rule via the decorator
+    import icikit.analysis.rules  # noqa: F401
+
+
+def repo_root() -> str:
+    """The repo root this installed package belongs to (two levels up
+    from icikit/analysis/)."""
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def shim_main(rule_name: str, ok_msg: str) -> int:
+    """The whole body of a ``tools/*_lint.py`` backward-compat shim:
+    run ONE rule against this repo, print findings, keep the old
+    exit-code contract (nonzero on a hit, the familiar OK line on a
+    pass). Shared here so rendering/exit semantics cannot drift
+    between the five shims."""
+    findings = run_rules(Project(repo_root()), [rule_name])
+    for f in findings:
+        print(f.render())
+    if findings:
+        return 1
+    print(ok_msg)
+    return 0
+
+
+def run_rules(project: Project, names=None) -> list[Finding]:
+    """Run the named rules (default: all) and return suppressed-
+    filtered, deduplicated findings in (path, line, rule) order.
+    Unparsable files surface as one ``parse-error`` finding each, so
+    a syntax error can never silently blind every rule at once."""
+    _load_rules()
+    rules = ([get_rule(n) for n in names] if names is not None
+             else all_rules())
+    findings: set[Finding] = set()
+    for r in rules:
+        for f in r.check(project):
+            sf = project.file(f.path)
+            if sf is not None and sf.suppressed(f.line, f.rule):
+                continue
+            findings.add(f)
+    for rel, sf in sorted(project._files.items()):
+        # .py only: the Makefile lands in the cache via suppression
+        # lookups on its findings and is not meant to parse
+        if (rel.endswith(".py") and sf is not None
+                and sf.parse_error is not None):
+            e = sf.parse_error
+            findings.add(Finding("parse-error", rel, e.lineno or 0,
+                                 f"syntax error: {e.msg}"))
+    return sorted(findings, key=lambda f: (f.path, f.line, f.rule))

@@ -1,0 +1,12 @@
+"""Rule modules — importing this package registers every rule."""
+
+from icikit.analysis.rules import (  # noqa: F401
+    chaos_site,
+    host_sync,
+    lock_discipline,
+    obs_catalog,
+    quant,
+    serve_key,
+    telemetry,
+    tree_accept,
+)

@@ -1,0 +1,132 @@
+"""``chaos-site`` — every chaos plan entry names a registered probe
+site.
+
+Port of ``tools/chaos_site_lint.py`` (semantics pinned by
+tests/test_analysis.py). Probe sites used to be bare strings: a typo
+in an ``ICIKIT_CHAOS`` spec or a drill's ``FaultPlan`` key silently
+never fired — the drill "passed" while exercising nothing. Modules
+register their sites at definition (``chaos.register_site``); this
+rule imports every instrumented module, then scans the TOP-LEVEL test
+and tool trees (the historical non-recursive walk — fixture subdirs
+are data) plus the Makefile for ``kind:site-glob`` literals and fails
+on any glob that cannot reach a registered site.
+
+Review-hardened twice with no direct coverage before the port — the
+helpers (:func:`collapse_holes`, the :data:`ENV_ENTRY` scanner) now
+carry their own unit tests in tests/test_analysis.py:
+
+- f-string holes collapse to a glob star BEFORE judging
+  (``f"die:solitaire.worker.{w}"`` drills the registered
+  ``solitaire.worker.*`` family);
+- ``ENV_ENTRY`` matches the env-spec form ``corrupt:site=@0`` where
+  the glob is followed by ``=value`` rather than a closing quote —
+  the PR 10 regex required a closing quote and matched the Makefile's
+  own spec form *never*.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import re
+
+from icikit.analysis.core import Finding, rule
+
+# A plan entry literal: "kind:site-glob" in quotes, f-string holes
+# collapsed to a glob star before judging.
+ENTRY = re.compile(
+    r"""["'](delay|die|corrupt|io):([A-Za-z0-9_.*?{}\[\]-]+)["']""")
+
+# An ICIKIT_CHAOS env-spec entry: the spec is one quoted semicolon-
+# separated string ('seed=0;corrupt:serve.kv.page=@0'), so the glob is
+# followed by '=value' rather than a closing quote — the Makefile's
+# drills (and any subprocess env strings in tests) are written this way.
+ENV_ENTRY = re.compile(
+    r"""(delay|die|corrupt|io):([A-Za-z0-9_.*?{}\[\]-]+)=""")
+
+# A direct probe call in the scanned file: the chaos-machinery unit
+# tests drill synthetic sites ("w.1", "x") they probe themselves —
+# those are defined, just locally. Same register-at-definition rule,
+# applied to the file under scan.
+LOCAL_PROBE = re.compile(
+    r"""(?:maybe_delay|maybe_die|maybe_corrupt|maybe_io_fail|io_retry|"""
+    r"""fires)\(\s*(?:["'][a-z]+["']\s*,\s*)?f?["']"""
+    r"""([A-Za-z0-9_.{}-]+)["']""")
+
+_HOLE = re.compile(r"\{[^}]*\}")
+
+
+def collapse_holes(glob: str) -> str:
+    """Collapse f-string holes to glob stars:
+    ``solitaire.worker.{w}`` -> ``solitaire.worker.*``."""
+    return _HOLE.sub("*", glob)
+
+
+def scan_entries(text: str):
+    """Every ``(lineno, kind, glob)`` plan entry in ``text`` (both
+    quoted-literal and env-spec forms), holes already collapsed;
+    lines carrying the legacy ``chaos-site-lint: off`` marker are
+    deliberate negatives (the warn-path tests) and skipped."""
+    out = []
+    for lineno, line in enumerate(text.splitlines(), 1):
+        if "chaos-site-lint: off" in line:
+            continue
+        for kind, glob in ENTRY.findall(line) + ENV_ENTRY.findall(line):
+            out.append((lineno, kind, collapse_holes(glob)))
+    return out
+
+
+def local_probes(text: str) -> set:
+    """Sites the scanned file probes itself (hole-collapsed)."""
+    return {collapse_holes(s) for s in LOCAL_PROBE.findall(text)}
+
+
+def _register_everything() -> None:
+    """Import every module that owns probe sites, so registration-at-
+    definition has happened before we judge the globs."""
+    import icikit.bench.harness  # noqa: F401
+    import icikit.models.solitaire.scheduler  # noqa: F401
+    import icikit.models.sort  # noqa: F401
+    import icikit.models.transformer.decode  # noqa: F401
+    import icikit.models.transformer.model  # noqa: F401
+    import icikit.models.transformer.speculative  # noqa: F401
+    import icikit.models.transformer.train  # noqa: F401
+    import icikit.parallel.integrity  # noqa: F401
+    import icikit.parallel.multihost  # noqa: F401
+    import icikit.serve.engine  # noqa: F401
+    import icikit.utils.checkpoint  # noqa: F401
+
+
+@rule("chaos-site",
+      "every tests/tools/Makefile chaos plan entry reaches a "
+      "registered probe site", runtime=True)
+def check_chaos_site(project) -> list:
+    _register_everything()
+    from icikit import chaos
+
+    out = []
+
+    def judge(rel, text, local):
+        for lineno, kind, glob in scan_entries(text):
+            if chaos.site_known(glob):
+                continue
+            if any(fnmatch.fnmatchcase(s, glob)
+                   or fnmatch.fnmatchcase(glob, s) for s in local):
+                continue  # the file probes that site itself
+            # msg names ONLY the offending entry: it is the baseline
+            # identity, and interpolating the (global, ever-growing)
+            # registered-site list here would turn every new
+            # register_site into baseline churn — list the registry
+            # with `python -m icikit.analysis --list` / chaos docs
+            out.append(Finding(
+                "chaos-site", rel, lineno,
+                f"chaos plan entry {kind}:{glob} names no registered "
+                "probe site (typo, or the owning module forgot "
+                "chaos.register_site)"))
+
+    for sub in ("tests", "tools"):
+        for sf in project.iter_py(sub, top_only=True):
+            judge(sf.rel, sf.text, local_probes(sf.text))
+    mk = project.makefile_text()
+    if mk:
+        judge("Makefile", mk, set())
+    return out

@@ -1,0 +1,338 @@
+"""``host-sync`` — no implicit device→host synchronization inside
+the hot loops.
+
+The discipline this rule enforces was established by hand twice: the
+r13 "drain verdicts at fences" fix (per-step device-guard verdicts
+accumulate un-synced and materialize at the logging fence) and the
+r16 "one async snapshot, no per-page readback" fix (batched eviction
+capture). A ``float()``/``int()``/``bool()``/``.item()``/
+``np.asarray()`` on a jax value, or iterating one, blocks the
+dispatch pipeline for a device round trip — once per call. On the
+engine step loop, the decode/speculative loops, and the train step,
+a per-item sync in a Python loop is exactly the regression class
+reviews keep catching.
+
+Mechanics (dataflow-lite, per scoped function):
+
+- **taint**: values returned by jitted/step-program calls (``*_fn``,
+  ``*_fns[...]``, ``_build_*(...)(...)``), ``jnp.*``/``jax.*``
+  constructors, pool arenas (``.buffers()``), and the generate entry
+  points are device-tainted; taint follows assignment, tuple
+  unpacking, method calls on tainted objects, and container append →
+  iterate;
+- **sinks**: ``float``/``int``/``bool`` on a tainted value,
+  ``.item()``/``.tolist()``, ``np.asarray``/``np.array``,
+  ``jax.device_get``, ``.block_until_ready()``, and ``for``-iteration
+  over a device value;
+- **fences**: functions in :data:`SCOPES` marked as fences are the
+  DOCUMENTED sync sites (the engine step's one batched
+  ``np.asarray`` drain, the prefill-completion tok0 readback, the
+  train loop's log-boundary materialization). In a fence, sinks
+  outside any loop are the contract and pass; sinks INSIDE a loop
+  (or in a non-fence scope) are findings. Iteration over a device
+  value is per-item by construction and always flagged.
+
+A sync the discipline genuinely requires per step (the host-guard
+sentinel) carries a justified ``# icikit-lint: off[host-sync]``.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from icikit.analysis.core import Finding, rule
+
+#: path -> {function name: is_documented_fence}. The hot loops this
+#: repo's perf story hangs on; extend when a new loop ships.
+SCOPES = {
+    "icikit/serve/engine.py": {
+        "_step": True, "_prefill_chunk": True, "_prefill_whole": True,
+        "_advance_prefill": False, "_advance_waiter": False,
+        "_advance_restore": False, "run": False,
+    },
+    "icikit/models/transformer/train.py": {"_guarded_main": True},
+    "icikit/models/transformer/decode.py": {
+        "greedy_generate": True, "sample_generate": True,
+    },
+    "icikit/models/transformer/speculative.py": {
+        # the host loop both public entry points delegate to
+        "_run_speculative": True,
+    },
+}
+
+# a call whose result lives on device: jitted/step programs, jax/jnp
+# constructors, pool arenas, the generate entry points
+_TAINT_CALL = re.compile(
+    r"(_fns?\[|\b\w+_fn\b|^fn$|\bjnp\.|\bjax\.(?!device_get)"
+    r"|\.buffers$|\b(?:sample|greedy|speculative)_generate$"
+    r"|_build_\w+\()")
+
+# host-materializing wrappers: applying one IS the sync event; the
+# RESULT is host memory (assignment through one clears taint)
+_SYNC_CALL = re.compile(
+    r"^(?:np|numpy)\.(?:asarray|array)$|^jax\.device_get$")
+
+_CONVERTERS = {"float", "int", "bool"}
+_SYNC_ATTRS = {"item", "tolist"}
+
+
+def _unparse(node) -> str:
+    try:
+        return ast.unparse(node)
+    except Exception:
+        return ""
+
+
+class _FnScan:
+    """One pass over one scoped function, statements in source order;
+    nested defs (the train drains) run last so closures see the
+    parent's final taint."""
+
+    def __init__(self, sf, fn: ast.FunctionDef, fence: bool):
+        self.sf = sf
+        self.fn = fn
+        self.fence = fence
+        self.device: set = set()      # names bound to device values
+        self.container: set = set()   # host containers OF device values
+        self.loop = 0
+        self.findings: list = []
+        self._deferred: list = []
+
+    def run(self) -> list:
+        for stmt in self.fn.body:
+            self.stmt(stmt)
+        while self._deferred:
+            inner = self._deferred.pop(0)
+            self.loop = 0
+            for stmt in inner.body:
+                self.stmt(stmt)
+        return self.findings
+
+    # -- taint queries ----------------------------------------------
+
+    def tainted(self, node) -> bool:
+        """Does evaluating ``node`` yield a device value? Sync
+        wrappers launder (their result is host); method calls on a
+        tainted object and taint-source calls taint."""
+        if isinstance(node, ast.Name):
+            return node.id in self.device
+        if isinstance(node, ast.Call):
+            src = _unparse(node.func)
+            if _SYNC_CALL.search(src) or (
+                    isinstance(node.func, ast.Name)
+                    and node.func.id in _CONVERTERS):
+                return False
+            if _TAINT_CALL.search(src):
+                return True
+            if (isinstance(node.func, ast.Attribute)
+                    and node.func.attr not in _SYNC_ATTRS
+                    and self.tainted(node.func.value)):
+                return True      # m.items() on a device-holding dict
+            return any(self.tainted(a) for a in node.args) or any(
+                self.tainted(kw.value) for kw in node.keywords)
+        if isinstance(node, (ast.Attribute, ast.Subscript,
+                             ast.Starred)):
+            return self.tainted(node.value)
+        if isinstance(node, (ast.BinOp, ast.BoolOp, ast.UnaryOp,
+                             ast.Compare, ast.Tuple, ast.List,
+                             ast.IfExp)):
+            return any(self.tainted(c)
+                       for c in ast.iter_child_nodes(node)
+                       if isinstance(c, ast.expr))
+        return False
+
+    def _sink(self, node, what: str, always: bool = False) -> None:
+        """Record a sync event. In a fence function, a sync OUTSIDE
+        any loop is the documented contract; everywhere else (and in
+        every loop) it is a finding."""
+        if not always and self.fence and self.loop == 0:
+            return
+        where = ("inside a loop — one device round trip PER "
+                 "ITERATION; batch the transfer at a fence "
+                 "(one jax.device_get / np.asarray of the whole "
+                 "batch)" if self.loop
+                 else "outside the documented fences — move it to a "
+                      "fence or batch it")
+        self.findings.append(Finding(
+            "host-sync", self.sf.rel, node.lineno,
+            f"implicit device->host sync: {what} {where}"))
+
+    # -- expression scan --------------------------------------------
+
+    def scan(self, node) -> None:
+        """Find sync events in an expression tree."""
+        if node is None:
+            return
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call):
+                src = _unparse(sub.func)
+                if (isinstance(sub.func, ast.Name)
+                        and sub.func.id in _CONVERTERS
+                        and any(self.tainted(a) for a in sub.args)):
+                    self._sink(sub, f"{sub.func.id}() materializes a "
+                                    "device value")
+                elif (_SYNC_CALL.search(src)
+                      and (any(self.tainted(a) for a in sub.args)
+                           or any(self.tainted(kw.value)
+                                  for kw in sub.keywords))):
+                    self._sink(sub, f"{src}() materializes a device "
+                                    "value")
+                elif (isinstance(sub.func, ast.Attribute)
+                      and sub.func.attr in _SYNC_ATTRS
+                      and self.tainted(sub.func.value)):
+                    self._sink(sub, f".{sub.func.attr}() on a device "
+                                    "value")
+                elif (isinstance(sub.func, ast.Attribute)
+                      and sub.func.attr == "block_until_ready"):
+                    self._sink(sub, ".block_until_ready()")
+            elif (isinstance(sub, (ast.ListComp, ast.SetComp,
+                                   ast.DictComp, ast.GeneratorExp))):
+                self._comp(sub)
+
+    def _comp(self, node) -> None:
+        """Comprehensions are loops: taint their targets from the
+        iterable and scan their element exprs one loop level down.
+        (ast.walk above will also revisit inner calls at the outer
+        depth, but a finding found at EITHER depth dedupes on line.)"""
+        for gen in node.generators:
+            if self.tainted(gen.iter):
+                self._sink(gen.iter, "iteration over a device value "
+                                     "(one sync per element)",
+                           always=True)
+            taints = self.tainted(gen.iter) or (
+                isinstance(gen.iter, ast.Name)
+                and gen.iter.id in self.container)
+            for t in ast.walk(gen.target):
+                if isinstance(t, ast.Name):
+                    # rebinding from a HOST iterable clears stale
+                    # taint an enclosing scope left on the name
+                    (self.device.add if taints
+                     else self.device.discard)(t.id)
+        self.loop += 1
+        for field in ("elt", "key", "value"):
+            self.scan(getattr(node, field, None))
+        self.loop -= 1
+
+    # -- statements --------------------------------------------------
+
+    def stmt(self, node) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            self._deferred.append(node)
+            return
+        if isinstance(node, ast.Assign):
+            self.scan(node.value)
+            self._assign(node.targets, node.value)
+            return
+        if isinstance(node, ast.AugAssign):
+            self.scan(node.value)
+            if self.tainted(node.value):
+                self._assign([node.target], node.value)
+            return
+        if isinstance(node, ast.AnnAssign):
+            self.scan(node.value)
+            if node.value is not None:
+                self._assign([node.target], node.value)
+            return
+        if isinstance(node, ast.For):
+            self.scan(node.iter)
+            if self.tainted(node.iter):
+                self._sink(node.iter,
+                           "for-iteration over a device value (one "
+                           "sync per element)", always=True)
+            taints = self.tainted(node.iter) or (
+                isinstance(node.iter, ast.Name)
+                and node.iter.id in self.container)
+            for t in ast.walk(node.target):
+                if isinstance(t, ast.Name):
+                    # rebinding from a HOST iterable clears stale
+                    # taint an enclosing scope left on the name
+                    (self.device.add if taints
+                     else self.device.discard)(t.id)
+            self.loop += 1
+            for s in node.body:
+                self.stmt(s)
+            self.loop -= 1
+            for s in node.orelse:
+                self.stmt(s)
+            return
+        if isinstance(node, ast.While):
+            # unlike a for-iter (evaluated once), the test re-runs
+            # every iteration: a sync in it is a per-iteration sync
+            self.loop += 1
+            self.scan(node.test)
+            for s in node.body:
+                self.stmt(s)
+            self.loop -= 1
+            for s in node.orelse:
+                self.stmt(s)
+            return
+        if isinstance(node, ast.If):
+            self.scan(node.test)
+            for s in node.body + node.orelse:
+                self.stmt(s)
+            return
+        if isinstance(node, ast.With):
+            for item in node.items:
+                self.scan(item.context_expr)
+            for s in node.body:
+                self.stmt(s)
+            return
+        if isinstance(node, ast.Try):
+            for s in (node.body + node.orelse + node.finalbody):
+                self.stmt(s)
+            for h in node.handlers:
+                for s in h.body:
+                    self.stmt(s)
+            return
+        if isinstance(node, ast.Expr):
+            self.scan(node.value)
+            # container taint: host_list.append(<device value>)
+            v = node.value
+            if (isinstance(v, ast.Call)
+                    and isinstance(v.func, ast.Attribute)
+                    and v.func.attr == "append"
+                    and isinstance(v.func.value, ast.Name)
+                    and any(self.tainted(a) for a in v.args)):
+                self.container.add(v.func.value.id)
+            return
+        if isinstance(node, (ast.Return, ast.Raise, ast.Assert,
+                             ast.Delete)):
+            for c in ast.iter_child_nodes(node):
+                if isinstance(c, ast.expr):
+                    self.scan(c)
+            return
+        # Pass/Break/Continue/Import/Global/Nonlocal: nothing to do
+
+    def _assign(self, targets, value) -> None:
+        tainted = self.tainted(value)
+        # assignment THROUGH a sync wrapper launders: x = np.asarray(x)
+        if (isinstance(value, ast.Call)
+                and (_SYNC_CALL.search(_unparse(value.func))
+                     or (isinstance(value.func, ast.Name)
+                         and value.func.id in _CONVERTERS))):
+            tainted = False
+        for tgt in targets:
+            for t in ast.walk(tgt):
+                if isinstance(t, ast.Name):
+                    (self.device.add if tainted
+                     else self.device.discard)(t.id)
+
+
+@rule("host-sync",
+      "no implicit device->host sync inside the engine step / decode "
+      "/ train hot loops (fences excepted)")
+def check_host_sync(project) -> list:
+    out = []
+    for rel, scope in SCOPES.items():
+        sf = project.file(rel)
+        if sf is None or sf.tree is None:
+            continue
+        seen: set = set()
+        for node in ast.walk(sf.tree):
+            if (isinstance(node, ast.FunctionDef)
+                    and node.name in scope
+                    and node.name not in seen):
+                seen.add(node.name)
+                out.extend(_FnScan(sf, node, scope[node.name]).run())
+    return out

@@ -1,0 +1,189 @@
+"""``lock-discipline`` — nothing slow or reentrant runs under a lock
+in ``icikit/serve/`` and ``icikit/obs/``.
+
+The two review-era incidents this rule mechanizes: the PR 2
+lease-queue stall (bus emission inside ``with self._lock`` — one slow
+sink stalled every admission; the fix moved every emit outside the
+lock, the ``mark_dead`` discipline) and the PR 12 torn histogram read
+(whose fix is the OPPOSITE shape — a single lock-scoped snapshot — so
+the rule flags work under locks, never lock-scoped copying of plain
+state).
+
+Flags, lexically inside any ``with <something lock-ish>:`` block:
+
+- bus/metric emission (``obs.emit/count/observe/gauge``) — a slow
+  sink must never stall the lock's other waiters;
+- device dispatch (``jnp.*``/``jax.*``, jitted ``*_fn`` programs,
+  ``block_until_ready``/``device_put``/``device_get``, and the pool's
+  ``*_cb`` capture callbacks) — dispatch latency is unbounded under
+  contention;
+- file I/O (``open``, ``json.dump``, ``os.replace``/``fsync``/...,
+  ``.flush()``) — the ChunkCheckpoint retry ladder can hold a lock
+  for three backoff rounds;
+- ``time.*`` calls — clock reads belong on the caller's side of the
+  critical section (and ``time.sleep`` under a lock is a stall by
+  definition);
+- with TWO locks held (lexically nested lock blocks), additionally
+  any blocking call (``sleep``/``join``/``wait``/``acquire``/
+  ``.result()``/``.get()``) — the deadlock-adjacent shape.
+
+One level of helper propagation: a method called under the lock
+(``self._take(...)`` from ``alloc``) is scanned for the same
+patterns, because "lock held" is that helper's documented contract —
+findings land at the helper's line. Deliberate exceptions (the
+FileSink whose per-sink lock exists to serialize exactly that write)
+are baselined with a note, not silenced in code.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from icikit.analysis.core import Finding, rule
+
+SCOPE_PREFIXES = ("icikit/serve/", "icikit/obs/")
+
+_LOCKISH = re.compile(r"lock", re.IGNORECASE)
+
+# callee-text pattern -> what it is (the finding's noun phrase)
+_BANNED = [
+    (re.compile(r"^obs\.(emit|count|observe|gauge)$"),
+     "bus/metric emission"),
+    (re.compile(r"(^|\.)(jnp|jax)\.|_fns?\[|\b\w+_fn$"
+                r"|block_until_ready$|device_(put|get)$|\w+_cb$"),
+     "device dispatch"),
+    (re.compile(r"^open$|^json\.dump(s)?$|^os\.(replace|rename|fsync"
+                r"|remove|unlink|makedirs)$|\.flush$|\.write_text$"
+                r"|\.read_text$|^shutil\."),
+     "file I/O"),
+    (re.compile(r"^time\.\w+$"), "a clock/time call"),
+]
+
+_BLOCKING = re.compile(
+    r"sleep$|\.join$|\.wait$|\.acquire$|\.result$|\.recv$")
+
+
+def _unparse(node) -> str:
+    try:
+        return ast.unparse(node)
+    except Exception:
+        return ""
+
+
+def _is_lock_with(node: ast.With) -> bool:
+    return any(_LOCKISH.search(_unparse(item.context_expr))
+               for item in node.items)
+
+
+def _method_index(tree) -> dict:
+    """qualname-free helper map: class name -> {method name: node}
+    (module-level defs under class "")."""
+    index: dict = {"": {}}
+    for node in tree.body:
+        if isinstance(node, ast.FunctionDef):
+            index[""][node.name] = node
+        elif isinstance(node, ast.ClassDef):
+            index[node.name] = {
+                m.name: m for m in node.body
+                if isinstance(m, ast.FunctionDef)}
+    return index
+
+
+def _banned_calls(body_nodes, *, two_locks: bool):
+    """Yield (node, label) for flagged calls lexically in
+    ``body_nodes`` — NOT descending into nested function defs (a def
+    under a lock runs later, without it) or nested lock blocks
+    (handled by the caller at the deeper lock count)."""
+    stack = list(body_nodes)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        if isinstance(node, ast.With) and _is_lock_with(node):
+            continue      # inner lock block: scanned at two-lock level
+        if isinstance(node, ast.Call):
+            src = _unparse(node.func)
+            for pat, label in _BANNED:
+                if pat.search(src):
+                    yield node, f"{label} ({src})"
+                    break
+            else:
+                if two_locks and _BLOCKING.search(src):
+                    yield node, f"a blocking call ({src})"
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _self_calls(body_nodes):
+    """Method names called as ``self.X(...)`` lexically in the block
+    (the one-level lock-held-helper propagation)."""
+    out = []
+    stack = list(body_nodes)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id == "self"):
+            out.append((node.func.attr, node.lineno))
+        stack.extend(ast.iter_child_nodes(node))
+    return out
+
+
+@rule("lock-discipline",
+      "no bus emission, device dispatch, file I/O, or time.* under "
+      "with-lock blocks in icikit/serve/ + icikit/obs/")
+def check_lock_discipline(project) -> list:
+    out = []
+    for prefix in SCOPE_PREFIXES:
+        for sf in project.iter_py(prefix.rstrip("/")):
+            if sf.tree is None:
+                continue
+            methods = _method_index(sf.tree)
+            # locate every lock-with and its enclosing class + depth
+            def walk(node, cls: str, locks: int):
+                for child in ast.iter_child_nodes(node):
+                    c_cls = (child.name
+                             if isinstance(child, ast.ClassDef)
+                             else cls)
+                    if (isinstance(child, ast.With)
+                            and _is_lock_with(child)):
+                        held = locks + 1
+                        lock_src = _unparse(
+                            child.items[0].context_expr)
+                        for call, label in _banned_calls(
+                                child.body, two_locks=held >= 2):
+                            out.append(Finding(
+                                "lock-discipline", sf.rel,
+                                call.lineno,
+                                f"{label} while holding "
+                                f"{'two locks' if held >= 2 else repr(lock_src)}"
+                                " — run it outside the critical "
+                                "section (the mark_dead discipline)"))
+                        # one-level helper propagation: lock-held
+                        # methods inherit the ban (the message omits
+                        # the caller line so one helper violation is
+                        # ONE finding however many locked callers it
+                        # has — baseline entries key on the message)
+                        for name, _at in _self_calls(child.body):
+                            helper = methods.get(cls, {}).get(name)
+                            if helper is None:
+                                continue
+                            for call, label in _banned_calls(
+                                    helper.body, two_locks=held >= 2):
+                                out.append(Finding(
+                                    "lock-discipline", sf.rel,
+                                    call.lineno,
+                                    f"{label} in lock-held helper "
+                                    f"{name}() (called under "
+                                    f"{lock_src!r}) — defer it past "
+                                    "the lock release"))
+                        walk(child, c_cls, held)
+                    else:
+                        walk(child, c_cls, locks)
+            walk(sf.tree, "", 0)
+    return out

@@ -1,0 +1,62 @@
+"""``obs-catalog`` — every emitted telemetry name is documented.
+
+Port of ``tools/obs_catalog_lint.py`` (semantics pinned by
+tests/test_analysis.py). The watch layer and the bench regression
+gate both key on metric NAMES; a counter that exists in code but not
+in docs/OBSERVABILITY.md is telemetry nobody can alarm on, and a
+renamed counter silently orphans its alert rule. Walks ``icikit/``
+for literal ``obs.count/observe/gauge/emit`` names under the
+``serve.*`` / ``decode.spec.*`` prefixes, plus the async request-span
+names the trace_ctx layer opens, and fails on any name the catalog
+does not mention. The doc may document MORE than code emits — planned
+names are fine; the failure mode is only code the doc lost track of.
+"""
+
+from __future__ import annotations
+
+import re
+
+from icikit.analysis.core import Finding, rule
+
+DOC = "docs/OBSERVABILITY.md"
+
+EMIT_RE = re.compile(
+    r'obs\.(?:count|observe|gauge|emit)\(\s*"'
+    r'((?:serve|decode\.spec)\.[^"]+)"')
+# request-scoped async span/instant names (trace_ctx call sites in
+# serve/: self-opens inside trace_ctx.py itself count too)
+CTX_RE = re.compile(
+    r'\.(?:open|close|instant|span)\(\s*"(serve\.req[^"]*)"')
+
+
+def emitted_names(project) -> dict:
+    """name -> (path, line) of its first emitting site."""
+    names: dict = {}
+    for sf in project.iter_py("icikit"):
+        for ln, text in enumerate(sf.lines, 1):
+            for pat in (EMIT_RE, CTX_RE):
+                for name in pat.findall(text):
+                    names.setdefault(name, (sf.rel, ln))
+    return names
+
+
+@rule("obs-catalog",
+      "every serve.*/decode.spec.* telemetry name is in "
+      "docs/OBSERVABILITY.md")
+def check_obs_catalog(project) -> list:
+    import os
+    doc_path = os.path.join(project.root, DOC)
+    if not os.path.isfile(doc_path):
+        return [Finding("obs-catalog", DOC, 0,
+                        "docs/OBSERVABILITY.md missing — the "
+                        "telemetry catalog has no home")]
+    with open(doc_path, encoding="utf-8") as f:
+        doc = f.read()
+    out = []
+    for name, (rel, ln) in sorted(emitted_names(project).items()):
+        if name not in doc:
+            out.append(Finding(
+                "obs-catalog", rel, ln,
+                f"telemetry name {name!r} emitted in code but absent "
+                "from docs/OBSERVABILITY.md's catalog"))
+    return out

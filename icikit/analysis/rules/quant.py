@@ -1,0 +1,226 @@
+"""``quant-arena`` — no high-precision KV is ALLOCATED on the int8
+decode path.
+
+Port of ``tools/quant_lint.py``. Unlike the AST rules this one is a
+RUNTIME check (``runtime=True``): it builds the actual pool / traces
+the actual programs, because the invariant lives in jaxprs and buffer
+dtypes, not in source text. "Allocated" means the persistent cache
+stores — pool arenas and the loop-carried cache buffers — not
+transient fused values (an int8 operand upcast inside a matmul never
+owns HBM). Four mechanical checks, each a finding on violation:
+
+1. ``KVPool(quant="int8")`` holds ONLY int8 arenas + fp32 scale pages;
+2. the int8 generate program's decode loop carries int8 caches (no
+   floating-point cache-shaped aval in the scan/while carries);
+3. the int8 engine's step-program buffer pytree round-trips int8;
+4. the sealed-block digest covers the int8 arena's SCALE pages — a
+   flipped scale corrupts decoded tokens exactly like a flipped int8
+   byte, so it must flip the digest too.
+
+Requires ``JAX_PLATFORMS=cpu`` (the CLI sets it defensively).
+"""
+
+from __future__ import annotations
+
+from icikit.analysis.core import Finding, rule
+
+KVPOOL = "icikit/serve/kvpool.py"
+DECODE = "icikit/models/transformer/decode.py"
+
+
+def _tiny_cfg(max_seq: int, **kw):
+    from icikit.models.transformer import TransformerConfig
+    return TransformerConfig(vocab=31, d_model=16, n_heads=2, d_head=8,
+                             d_ff=32, n_layers=2, max_seq=max_seq,
+                             compute_dtype="float32", **kw)
+
+
+def check_pool() -> list:
+    import jax.numpy as jnp
+
+    from icikit.models.transformer.model import make_model_mesh
+    from icikit.serve.kvpool import KVPool
+
+    mesh = make_model_mesh(dp=1, tp=1, sp=1)
+    pool = KVPool(_tiny_cfg(32), mesh, n_blocks=4, block_size=4,
+                  quant="int8")
+    if pool.kc is not None or pool.vc is not None:
+        return [Finding("quant-arena", KVPOOL, 0,
+                        "int8 pool allocated a high-precision KV "
+                        "arena")]
+    out = []
+    for name, want in (("qkc", jnp.int8), ("qvc", jnp.int8),
+                       ("ksc", jnp.float32), ("vsc", jnp.float32)):
+        for buf in getattr(pool, name):
+            if buf.dtype != want:
+                out.append(Finding(
+                    "quant-arena", KVPOOL, 0,
+                    f"int8 pool arena {name} is {buf.dtype}, "
+                    f"expected {want}"))
+    if set(pool.buffers()) != {"qkc", "qvc", "ksc", "vsc"}:
+        out.append(Finding(
+            "quant-arena", KVPOOL, 0,
+            f"int8 pool buffers() exposes {set(pool.buffers())}, "
+            "expected exactly qkc/qvc/ksc/vsc"))
+    return out
+
+
+def _float_cache_avals(jaxpr, cache_shape_tail):
+    """Recursively collect scan/while carry avals that are floating
+    point AND cache-shaped — the allocation smoking gun."""
+    import jax.numpy as jnp
+    bad = []
+
+    def visit(jx):
+        for eqn in jx.eqns:
+            sub = []
+            if eqn.primitive.name == "scan":
+                inner = eqn.params["jaxpr"].jaxpr
+                n_carry = eqn.params["num_carry"]
+                sub = [v.aval for v in inner.invars[:n_carry]]
+                visit(inner)
+            elif eqn.primitive.name == "while":
+                inner = eqn.params["body_jaxpr"].jaxpr
+                sub = [v.aval for v in inner.invars]
+                visit(inner)
+            else:
+                for p in eqn.params.values():
+                    core = getattr(p, "jaxpr", None)
+                    if core is not None and hasattr(core, "eqns"):
+                        visit(core)
+            for a in sub:
+                shape = getattr(a, "shape", ())
+                if (len(shape) >= len(cache_shape_tail)
+                        and tuple(shape[-len(cache_shape_tail):])
+                        == cache_shape_tail
+                        and jnp.issubdtype(a.dtype, jnp.floating)):
+                    bad.append(a)
+
+    visit(jaxpr)
+    return bad
+
+
+def check_generate() -> list:
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+
+    from icikit.models.transformer import init_params
+    from icikit.models.transformer.decode import (
+        _build_generate,
+        maybe_quantize_params,
+    )
+    from icikit.models.transformer.model import make_model_mesh
+
+    cfg = _tiny_cfg(64, decode_quant="int8")
+    mesh = make_model_mesh(dp=1, tp=1, sp=1)
+    params = init_params(
+        jax.random.key(0),
+        dataclasses.replace(cfg, decode_quant="none"), mesh)
+    qp = maybe_quantize_params(params, mesh, cfg)
+    s_prompt, n_new = 8, 12
+    fn = _build_generate(mesh, cfg, s_prompt, n_new)
+    prompt = jnp.zeros((2, s_prompt), jnp.int32)
+    seeds = jnp.zeros((2,), jnp.int32)
+    key_data = jax.random.key_data(jax.random.key(0))
+    knobs = jnp.ones((3,), jnp.float32)
+    jaxpr = jax.make_jaxpr(fn)(qp, prompt, seeds, key_data, knobs)
+    kv = cfg.n_kv_heads or cfg.n_heads
+    tail = (s_prompt + n_new, kv, cfg.d_head)
+    bad = _float_cache_avals(jaxpr.jaxpr, tail)
+    if bad:
+        return [Finding(
+            "quant-arena", DECODE, 0,
+            "int8 generate carries a high-precision cache-shaped "
+            f"buffer through its decode loop: {bad}")]
+    return []
+
+
+def check_engine() -> list:
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from icikit.models.transformer import init_params
+    from icikit.models.transformer.model import make_model_mesh
+    from icikit.serve import Engine, ServeConfig
+
+    cfg = _tiny_cfg(64, decode_quant="int8")
+    mesh = make_model_mesh(dp=1, tp=1, sp=1)
+    params = init_params(
+        jax.random.key(0),
+        dataclasses.replace(cfg, decode_quant="none"), mesh)
+    eng = Engine(params, mesh, cfg,
+                 ServeConfig(max_rows=2, block_size=4, n_blocks=8,
+                             max_prompt=8, max_new=8))
+    eng.submit(np.arange(5, dtype=np.int32), 6)
+    eng.run()
+    bufs = eng.pool.buffers()
+    out = []
+    if set(bufs) != {"qkc", "qvc", "ksc", "vsc"}:
+        out.append(Finding(
+            "quant-arena", KVPOOL, 0,
+            f"int8 engine pool buffers() exposes {set(bufs)}"))
+    elif not all(b.dtype == jnp.int8
+                 for b in bufs["qkc"] + bufs["qvc"]):
+        out.append(Finding(
+            "quant-arena", KVPOOL, 0,
+            "int8 engine step program does not round-trip int8 "
+            "arenas"))
+    return out
+
+
+def check_block_hash_covers_scales() -> list:
+    """Prefix-cache era integrity: the sealed-block digest — the one
+    fingerprint every sharer of a page re-verifies — must cover the
+    int8 arena's SCALE pages, not just the quantized payload."""
+    import numpy as np
+
+    from icikit.models.transformer.model import make_model_mesh
+    from icikit.serve.kvpool import KVPool
+
+    cfg = _tiny_cfg(32)
+    mesh = make_model_mesh(dp=1, tp=1, sp=1)
+    pool = KVPool(cfg, mesh, n_blocks=4, block_size=4, quant="int8")
+    [page] = pool.allocators[0].alloc("lint", 1)
+    per_layer = len(pool.page_bytes(0, page, "q8")) // cfg.n_layers
+    if per_layer != 4:
+        return [Finding(
+            "quant-arena", KVPOOL, 0,
+            "q8 page_bytes must return qk, qv, ksc, vsc per layer, "
+            f"got {per_layer} arrays")]
+    data = np.arange(4 * 2 * 8, dtype=np.int8).reshape(4, 2, 8)
+    pool.poke_page(0, page, 0, data)
+    pool.seal(0, page)
+    if pool.verify("lint", 0) != []:
+        return [Finding("quant-arena", KVPOOL, 0,
+                        "freshly sealed page failed its own verify")]
+    vsc = list(pool.vsc)
+    vsc[1] = vsc[1].at[0, page, 1, 0].add(0.5)   # ONLY a scale moves
+    pool.vsc = tuple(vsc)
+    if pool.verify("lint", 0) != [0]:
+        return [Finding(
+            "quant-arena", KVPOOL, 0,
+            "a flipped scale page did NOT fail the sealed-block "
+            "verify — the block hash does not cover the quantized "
+            "payload's scales")]
+    return []
+
+
+@rule("quant-arena",
+      "no high-precision KV allocated on the int8 path; block "
+      "digests cover scale pages (runtime check)", runtime=True)
+def check_quant(project) -> list:
+    out = []
+    for check in (check_pool, check_generate, check_engine,
+                  check_block_hash_covers_scales):
+        try:
+            out.extend(check())
+        except Exception as e:  # a crash is a finding, not a pass
+            out.append(Finding(
+                "quant-arena", KVPOOL, 0,
+                f"{check.__name__} raised {type(e).__name__}: {e}"))
+    return out

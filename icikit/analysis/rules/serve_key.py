@@ -1,0 +1,50 @@
+"""``serve-key`` — no unkeyed randomness inside ``icikit/serve/``.
+
+Port of ``tools/serve_key_lint.py`` (semantics pinned by
+tests/test_analysis.py): every random draw in the serving path is
+keyed by the schedule-invariant per-request counter
+``fold_in(fold_in(key(0), seed), position)``, derived in ONE place
+(``icikit.models.transformer.decode.request_stream_data``) and
+threaded through as data. Any other randomness — ``np.random``, a
+bare ``PRNGKey``/``jax.random.key`` minted at a sample site, host RNG
+seeding, a time-seeded key — would silently re-tie sampled tokens to
+engine state (batch slot, step count, wall clock) and break both the
+engine ≡ ``sample_generate`` identity pin and bitwise reissue after a
+lease reap. The ancestor stripped ``#`` comments before matching;
+this port does the same.
+"""
+
+from __future__ import annotations
+
+import re
+
+from icikit.analysis.core import Finding, rule
+
+# pattern -> why it is banned in icikit/serve/
+BANNED = [
+    (re.compile(r"np\.random|numpy\.random"),
+     "np.random draws are unkeyed — route randomness through the "
+     "request's counter stream (decode.request_stream_data)"),
+    (re.compile(r"\bPRNGKey\s*\("),
+     "bare PRNGKey at a sample site — streams must come from the "
+     "per-request seed (decode.request_stream_data)"),
+    (re.compile(r"jax\.random\.key\s*\(|random\.key\s*\("),
+     "key construction inside icikit/serve — the ONE stream "
+     "derivation lives in decode.request_stream_data"),
+    (re.compile(r"\brandom\.seed\s*\(|\bdefault_rng\s*\("),
+     "host RNG seeding in the serving path"),
+    (re.compile(r"key\s*\(\s*int\s*\(\s*time|seed\s*=\s*time\."),
+     "time-seeded keys are schedule-dependent by construction"),
+]
+
+
+@rule("serve-key", "no unkeyed randomness inside icikit/serve/")
+def check_serve_key(project) -> list:
+    out = []
+    for sf in project.iter_py("icikit/serve"):
+        for ln, text in enumerate(sf.lines, 1):
+            stripped = text.split("#", 1)[0]
+            for pat, why in BANNED:
+                if pat.search(stripped):
+                    out.append(Finding("serve-key", sf.rel, ln, why))
+    return out

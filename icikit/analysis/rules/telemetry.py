@@ -1,0 +1,62 @@
+"""The two former Makefile grep lints, as framework rules.
+
+``obs-print`` — telemetry goes through the ``icikit.obs`` event bus,
+not bare prints: a bare print of a ``json.dumps`` payload outside
+``icikit/obs/`` is a telemetry line no sink, filter, or metrics
+registry will ever see. (The grep ancestor piped the print-of-dumps
+pattern through ``grep -v '^icikit/obs/'``.)
+
+``serve-clock`` — SLO math in ``icikit/serve/`` must use
+``time.monotonic``: ``time.time()`` steps under NTP adjustment and a
+stepped clock turns one TTFT sample negative and every percentile
+after it garbage. (The grep ancestor: ``grep -rn "time\\.time("
+icikit/serve``.)
+
+Both keep the ancestors' raw line-match semantics (comments count —
+the greps never stripped them); the framework's suppression comment
+is the one new escape hatch.
+"""
+
+from __future__ import annotations
+
+import re
+
+from icikit.analysis.core import Finding, rule
+
+_PRINT_DUMPS = re.compile(r"print\(json\.dumps")
+_WALL_CLOCK = re.compile(r"time\.time\(")
+
+
+@rule("obs-print",
+      "no bare print of json.dumps telemetry outside icikit/obs/")
+def check_obs_print(project) -> list:
+    out = []
+    for sf in project.iter_py("icikit"):
+        # icikit/obs/ is the one legitimate home — everything else
+        # (the analysis package included) answers to the rule; the
+        # few self-matching literal sites carry per-line suppressions
+        if sf.rel.startswith("icikit/obs/"):
+            continue
+        for ln, text in enumerate(sf.lines, 1):
+            if _PRINT_DUMPS.search(text):
+                # msg deliberately avoids quoting the matched pattern
+                # (the rule would flag its own message otherwise)
+                out.append(Finding(
+                    "obs-print", sf.rel, ln,
+                    "bare print of json.dumps telemetry — route it "
+                    "through the icikit.obs event bus"))
+    return out
+
+
+@rule("serve-clock",
+      "icikit/serve SLO clocks are monotonic (no time.time)")
+def check_serve_clock(project) -> list:
+    out = []
+    for sf in project.iter_py("icikit/serve"):
+        for ln, text in enumerate(sf.lines, 1):
+            if _WALL_CLOCK.search(text):
+                out.append(Finding(
+                    "serve-clock", sf.rel, ln,
+                    "wall clock in icikit/serve — SLO math must use "
+                    "time.monotonic"))
+    return out

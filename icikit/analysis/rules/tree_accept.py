@@ -1,0 +1,96 @@
+"""``tree-accept`` — ONE speculative accept implementation.
+
+Port of ``tools/tree_accept_lint.py`` (round 14; semantics pinned by
+tests/test_analysis.py). The token-tree verify path's exactness
+argument leans on the primary chain being accepted by the *existing*
+chain rule:
+
+1. ``_accept_window`` and ``_accept_tree`` are each defined exactly
+   once, in ``icikit/models/transformer/speculative.py``;
+2. ``_accept_tree``'s body CALLS ``_accept_window`` (the primary
+   chain goes through the one rule, not a fork of its semantics);
+3. nothing else in ``icikit/`` defines its own accept, and the
+   serving engine references both names (it imports the shared rule —
+   the engine-vs-generate identity contract hangs on it).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from icikit.analysis.core import Finding, rule
+
+SPEC = "icikit/models/transformer/speculative.py"
+ENGINE = "icikit/serve/engine.py"
+ACCEPT_NAMES = ("_accept_window", "_accept_tree")
+
+
+def _called_names(fn: ast.FunctionDef) -> set:
+    names = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            f = node.func
+            if isinstance(f, ast.Name):
+                names.add(f.id)
+            elif isinstance(f, ast.Attribute):
+                names.add(f.attr)
+    return names
+
+
+@rule("tree-accept",
+      "one accept implementation (_accept_tree wraps _accept_window)")
+def check_tree_accept(project) -> list:
+    out = []
+    spec = project.file(SPEC)
+    if spec is None or spec.tree is None:
+        return [Finding("tree-accept", SPEC, 0,
+                        f"{SPEC} missing or unparsable — the shared "
+                        "accept rule has no home")]
+    defs: dict = {}
+    for node in ast.walk(spec.tree):
+        if (isinstance(node, ast.FunctionDef)
+                and node.name in ACCEPT_NAMES):
+            if node.name in defs:
+                out.append(Finding(
+                    "tree-accept", SPEC, node.lineno,
+                    f"{node.name} defined more than once"))
+            defs[node.name] = node
+    for name in ACCEPT_NAMES:
+        if name not in defs:
+            out.append(Finding("tree-accept", SPEC, 0,
+                               f"{name} not defined"))
+    if ("_accept_tree" in defs
+            and "_accept_window" not in _called_names(
+                defs["_accept_tree"])):
+        out.append(Finding(
+            "tree-accept", SPEC, defs["_accept_tree"].lineno,
+            "_accept_tree does not call _accept_window — the primary "
+            "chain must run the ONE chain accept rule, not a "
+            "re-implementation"))
+    # no second definition anywhere else in the package (the few
+    # sites quoting the sentinel text — this scan, the self-check
+    # seeds — carry per-line suppressions, not a blanket pass)
+    for sf in project.iter_py("icikit"):
+        if sf.rel == SPEC:
+            continue
+        for ln, text in enumerate(sf.lines, 1):
+            if ("def _accept_window" in text  # icikit-lint: off[tree-accept]
+                    or "def _accept_tree" in text):  # icikit-lint: off[tree-accept]
+                out.append(Finding(
+                    "tree-accept", sf.rel, ln,
+                    "defines its own accept — import the shared rule "
+                    "from speculative.py instead"))
+    # the engine consumes the shared rule, not a local fork
+    eng = project.file(ENGINE)
+    if eng is None:
+        out.append(Finding("tree-accept", ENGINE, 0,
+                           "engine missing — nothing imports the "
+                           "shared accept"))
+    else:
+        for name in ACCEPT_NAMES:
+            if name not in eng.text:
+                out.append(Finding(
+                    "tree-accept", ENGINE, 0,
+                    f"does not reference {name} — the engine's "
+                    "verify windows must run the shared accept"))
+    return out

@@ -311,8 +311,10 @@ class BlockAllocator:
             page, _ = self._cached.popitem(last=False)   # LRU victim
             h = self._hash_of.pop(page)
             del self._index[h]
+            # counted here, EMITTED by the public callers once the
+            # lock drops (the mark_dead discipline: a slow metrics
+            # sink must never stall the allocation path)
             self.n_evictions += 1
-            obs.count("serve.kv.evictions")
             evicted.append((page, h))
             got.append(page)
         if (evicted and self.host_blocks > 0
@@ -341,10 +343,14 @@ class BlockAllocator:
         if n < 0:
             raise ValueError(f"n must be >= 0, got {n}")
         with self._lock:
+            ev0 = self.n_evictions
             got = self._take(n)
+            evicted = self.n_evictions - ev0
             for p in got:
                 self._refs[p] = 1
             self._tables.setdefault(owner, []).extend(got)
+        if evicted:
+            obs.count("serve.kv.evictions", evicted)
         return tuple(got)
 
     def ensure(self, owner, n_tokens: int) -> tuple:
@@ -417,10 +423,14 @@ class BlockAllocator:
             old = table[index]
             if self._refs[old] <= 1:
                 return None
+            ev0 = self.n_evictions
             [new] = self._take(1)
+            evicted = self.n_evictions - ev0
             self._refs[old] -= 1
             self._refs[new] = 1
             table[index] = new
+        if evicted:
+            obs.count("serve.kv.evictions", evicted)
         return old, new
 
     # -- prefix index ------------------------------------------------
@@ -487,7 +497,9 @@ class BlockAllocator:
         with self._lock:
             if h in self._index:
                 return None
+            ev0 = self.n_evictions
             [page] = self._take(1)
+            evicted = self.n_evictions - ev0
             self._refs[page] = 1
             self._tables.setdefault(owner, []).append(page)
             self._index[h] = page
@@ -497,6 +509,8 @@ class BlockAllocator:
                 if self.drop_cb is not None:
                     self.drop_cb(h, False)   # consumed by the restore
             self.n_restores += 1
+        if evicted:
+            obs.count("serve.kv.evictions", evicted)
         return page
 
     def purge_spilled(self, h: str) -> bool:

@@ -265,7 +265,8 @@ class RequestQueue:
         with self._lock:
             if not self._queued:
                 return None
-            return self._queued[0][0] - time.monotonic()
+            head = self._queued[0][0]
+        return head - time.monotonic()
 
     def _lease_live(self, rid: str, seq: int | None) -> bool:
         """Caller-holds-the-lease check (lock held): with a ``seq``
@@ -280,9 +281,10 @@ class RequestQueue:
     def renew(self, rid: str, seq: int | None = None) -> None:
         """Heartbeat: push the lease deadline out (the engine calls
         this for every in-flight request at every step boundary)."""
+        now = time.monotonic()
         with self._lock:
             if rid in self._leases and self._lease_live(rid, seq):
-                self._leases[rid] = (time.monotonic() + self.lease_s,
+                self._leases[rid] = (now + self.lease_s,
                                      self._leases[rid][1])
 
     def complete(self, rid: str, tokens,
@@ -291,6 +293,7 @@ class RequestQueue:
         commits (request already terminal, or the caller's lease was
         reaped and reissued) change nothing — a ``failed`` request is
         never resurrected by a straggler."""
+        now = time.monotonic()
         with self._lock:
             req = self._requests.get(rid)
             dup = (req is None or req.state in ("done", "failed")
@@ -299,7 +302,7 @@ class RequestQueue:
                 self._leases.pop(rid, None)
                 req.state = "done"
                 req.tokens = list(tokens)
-                req.done_t = time.monotonic()
+                req.done_t = now
                 self.done[rid] = req
         if dup:
             self.n_duplicate_commits += 1
@@ -321,6 +324,7 @@ class RequestQueue:
         spent; returns the request's new state. Stale callers (lease
         reaped and reissued elsewhere) are no-ops."""
         requeued = False
+        now = time.monotonic()
         with self._lock:
             req = self._requests.get(rid)
             if req is None or req.state in ("done", "failed") \
@@ -330,7 +334,7 @@ class RequestQueue:
             req.error = repr(exc)
             if retry and req.attempts <= req.max_retries:
                 delay = self.backoff_s * (2 ** (req.attempts - 1))
-                vis = time.monotonic() + delay
+                vis = now + delay
                 req.state = "queued"
                 req.tokens = []
                 req.first_token_t = None
@@ -381,10 +385,9 @@ class RequestQueue:
         req.trace.end_attempt(outcome="preempted")
         req.trace.instant("serve.req.preempted")
         req.trace.open("serve.req.queued")
+        vis = time.monotonic() + delay
         with self._lock:
-            heapq.heappush(self._queued,
-                           (time.monotonic() + delay,
-                            next(self._ids), rid))
+            heapq.heappush(self._queued, (vis, next(self._ids), rid))
             self._limbo -= 1
 
     # -- monitor side ------------------------------------------------

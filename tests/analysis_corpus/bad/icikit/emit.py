@@ -1,0 +1,4 @@
+"""obs-catalog seeded violation: an uncatalogued telemetry name."""
+from icikit import obs
+
+obs.count("serve.bogus_counter")

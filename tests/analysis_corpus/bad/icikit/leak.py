@@ -1,0 +1,4 @@
+"""obs-print seeded violation: bare JSON telemetry print."""
+import json
+
+print(json.dumps({"event": "leak"}))

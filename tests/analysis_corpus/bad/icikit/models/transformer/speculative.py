@@ -1,0 +1,10 @@
+"""tree-accept seeded violation: _accept_tree forks the chain rule
+instead of calling _accept_window."""
+
+
+def _accept_window(draft, target):
+    return draft == target
+
+
+def _accept_tree(draft, target):
+    return draft == target      # re-implements the accept: banned

@@ -1,0 +1,15 @@
+"""host-sync seeded violation: a per-item sync inside the step loop.
+
+(References _accept_window and _accept_tree so the tree-accept rule's
+engine-imports-the-shared-rule check stays out of this twin's frame.)
+"""
+
+
+class Engine:
+    def _step(self):
+        outs = self._step_fns[0](self.params)
+        g, a = outs
+        x = 0
+        for slot in range(4):
+            x += float(a[slot])
+        return x

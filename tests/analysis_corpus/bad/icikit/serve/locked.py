@@ -1,0 +1,15 @@
+"""lock-discipline seeded violation: bus emission under the lock."""
+import threading
+
+from icikit import obs
+
+
+class Leases:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.n = 0
+
+    def bump(self):
+        with self._lock:
+            self.n += 1
+            obs.count("serve.submitted")

@@ -1,0 +1,4 @@
+"""serve-key seeded violation: an unkeyed host RNG draw."""
+import numpy as np
+
+tok = np.random.randint(0, 7)

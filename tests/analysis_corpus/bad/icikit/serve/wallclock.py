@@ -1,0 +1,4 @@
+"""serve-clock seeded violation: wall clock in the serving path."""
+import time
+
+t0 = time.time()

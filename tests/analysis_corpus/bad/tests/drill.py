@@ -1,0 +1,4 @@
+"""chaos-site seeded violation: a plan entry naming no registered
+probe site."""
+
+PLAN = {"die:definitely.not.a.site": "@0"}

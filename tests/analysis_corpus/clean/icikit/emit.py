@@ -1,0 +1,4 @@
+"""obs-catalog clean twin: the emitted name IS catalogued."""
+from icikit import obs
+
+obs.count("serve.bogus_counter")

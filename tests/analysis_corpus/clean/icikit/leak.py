@@ -1,0 +1,8 @@
+"""obs-print clean twin: machine-readable output that is not bare
+print telemetry — the pinned grep semantics match only a print of a
+json dump, so a stream write stays clean (exactly like the grep
+ancestor)."""
+import json
+import sys
+
+sys.stdout.write(json.dumps({"event": "ok"}) + "\n")

@@ -1,0 +1,9 @@
+"""tree-accept clean twin: the tree accept RUNS the chain accept."""
+
+
+def _accept_window(draft, target):
+    return draft == target
+
+
+def _accept_tree(draft, target):
+    return _accept_window(draft, target)

@@ -1,0 +1,16 @@
+"""lock-discipline clean twin: the emit runs after the lock drops
+(the mark_dead discipline)."""
+import threading
+
+from icikit import obs
+
+
+class Leases:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.n = 0
+
+    def bump(self):
+        with self._lock:
+            self.n += 1
+        obs.count("serve.submitted")

@@ -1,0 +1,6 @@
+"""serve-key clean twin: randomness rides the per-request counter
+stream, threaded in as data (no key construction here)."""
+
+
+def next_token(stream_data, pos):
+    return stream_data[pos]

@@ -1,0 +1,4 @@
+"""serve-clock clean twin: SLO math on the monotonic clock."""
+import time
+
+t0 = time.monotonic()

@@ -1,0 +1,3 @@
+"""chaos-site clean twin: the plan entry names a registered site."""
+
+PLAN = {"corrupt:serve.kv.page": "@0"}

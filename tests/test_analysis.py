@@ -1,0 +1,502 @@
+"""icikit.analysis — framework + rule tests.
+
+Covers the ISSUE-14 contract: golden findings on the seeded-violation
+corpus (one violation per rule, each with a clean twin that must stay
+quiet), suppression-comment and baseline round trips, both directions
+of the migrated Makefile greps, parity pins (each ported rule
+reproduces its predecessor's clean verdict on the real tree), the
+chaos-site helpers that were review-hardened twice without direct
+coverage, and the CLI's --json shape + --self-check drill.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import pytest
+
+from icikit.analysis import Project, run_rules
+from icikit.analysis import baseline as bl
+from icikit.analysis.cli import main as cli_main
+from icikit.analysis.core import Finding, repo_root
+from icikit.analysis.rules.chaos_site import (
+    ENV_ENTRY,
+    collapse_holes,
+    local_probes,
+    scan_entries,
+)
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+CORPUS = os.path.join(HERE, "analysis_corpus")
+BAD = os.path.join(CORPUS, "bad")
+CLEAN = os.path.join(CORPUS, "clean")
+
+# every static rule (quant-arena is runtime: it checks the REAL
+# package's arenas/jaxprs regardless of project root, so the corpus
+# cannot seed it — its parity pin below covers it)
+STATIC_RULES = ["serve-key", "serve-clock", "obs-print", "tree-accept",
+                "obs-catalog", "host-sync", "lock-discipline",
+                "chaos-site"]
+
+# rule -> the ONE seeded violation in the bad twin
+GOLDEN = {
+    "serve-key": ("icikit/serve/unkeyed.py", 4),
+    "serve-clock": ("icikit/serve/wallclock.py", 4),
+    "obs-print": ("icikit/leak.py", 4),
+    "tree-accept": ("icikit/models/transformer/speculative.py", 9),
+    "obs-catalog": ("icikit/emit.py", 4),
+    "host-sync": ("icikit/serve/engine.py", 14),
+    "lock-discipline": ("icikit/serve/locked.py", 15),
+    "chaos-site": ("tests/drill.py", 4),
+}
+
+
+def _findings(root, rules):
+    return run_rules(Project(root), rules)
+
+
+# -- golden corpus ---------------------------------------------------
+
+@pytest.mark.parametrize("rule", sorted(GOLDEN))
+def test_seeded_violation_fires(rule):
+    path, line = GOLDEN[rule]
+    got = [(f.path, f.line) for f in _findings(BAD, [rule])]
+    assert got == [(path, line)], (
+        f"{rule}: expected exactly the seeded violation at "
+        f"{path}:{line}, got {got}")
+
+
+@pytest.mark.parametrize("rule", sorted(GOLDEN))
+def test_clean_twin_quiet(rule):
+    got = _findings(CLEAN, [rule])
+    assert got == [], (
+        f"{rule}: clean twin should be finding-free, got "
+        f"{[f.render() for f in got]}")
+
+
+def test_all_static_rules_together_on_bad():
+    got = {(f.rule, f.path, f.line)
+           for f in _findings(BAD, STATIC_RULES)}
+    want = {(r, p, ln) for r, (p, ln) in GOLDEN.items()}
+    assert got == want
+
+
+# -- suppressions ----------------------------------------------------
+
+def _mini(tmp_path, rel, text):
+    p = tmp_path / rel
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(text)
+    return str(tmp_path)
+
+
+def test_suppression_comment_silences_named_rule(tmp_path):
+    root = _mini(tmp_path, "icikit/serve/x.py",
+                 "import time\n"
+                 "t = time.time()  # icikit-lint: off[serve-clock]\n")
+    assert _findings(root, ["serve-clock"]) == []
+
+
+def test_suppression_is_per_rule(tmp_path):
+    # the off[] names serve-clock only: serve-key still fires on the
+    # same line
+    root = _mini(
+        tmp_path, "icikit/serve/x.py",
+        "import numpy as np, time\n"
+        "t = np.random.rand() * time.time()"
+        "  # icikit-lint: off[serve-clock]\n")
+    assert [f.rule for f in _findings(
+        root, ["serve-clock", "serve-key"])] == ["serve-key"]
+
+
+def test_bare_off_silences_everything(tmp_path):
+    root = _mini(tmp_path, "icikit/serve/x.py",
+                 "import numpy as np, time\n"
+                 "t = np.random.rand() * time.time()"
+                 "  # icikit-lint: off\n")
+    assert _findings(root, ["serve-clock", "serve-key"]) == []
+
+
+def test_unsuppressed_twin_fires(tmp_path):
+    root = _mini(tmp_path, "icikit/serve/x.py",
+                 "import time\nt = time.time()\n")
+    assert [f.rule for f in _findings(root, ["serve-clock"])] \
+        == ["serve-clock"]
+
+
+# -- baseline round trip ---------------------------------------------
+
+def test_baseline_round_trip(tmp_path):
+    root = _mini(tmp_path, "icikit/serve/x.py",
+                 "import time\nt = time.time()\n")
+    found = _findings(root, ["serve-clock"])
+    assert len(found) == 1
+    path = str(tmp_path / "baseline.json")
+    bl.write(path, found)
+    entries = bl.load(path)
+    fresh, grandfathered, stale = bl.split(found, entries)
+    assert fresh == [] and len(grandfathered) == 1 and stale == []
+    # dropping the entry re-arms the finding
+    fresh2, _, _ = bl.split(found, [])
+    assert fresh2 == found
+    # a fixed finding turns its entry stale (reported, not fatal)
+    _, _, stale2 = bl.split([], entries)
+    assert len(stale2) == 1
+
+
+def test_baseline_count_caps_absorption(tmp_path):
+    """An entry absorbs at most its count: a NEW violation that
+    renders the same message as a grandfathered one must come out
+    unbaselined, not ride the exemption."""
+    root = _mini(tmp_path, "icikit/serve/x.py",
+                 "import time\n"
+                 "t = time.time()\n"
+                 "u = time.time()\n")
+    found = _findings(root, ["serve-clock"])
+    assert len(found) == 2 and found[0].msg == found[1].msg
+    entries = [{"rule": "serve-clock", "path": "icikit/serve/x.py",
+                "msg": found[0].msg, "note": "one grandfathered"}]
+    fresh, grandfathered, stale = bl.split(found, entries)
+    assert len(fresh) == 1 and len(grandfathered) == 1
+    assert stale == []
+    # count=2 absorbs both; an unconsumed budget turns the entry stale
+    entries[0]["count"] = 2
+    fresh, grandfathered, stale = bl.split(found, entries)
+    assert fresh == [] and len(grandfathered) == 2 and stale == []
+    fresh, grandfathered, stale = bl.split(found[:1], entries)
+    assert fresh == [] and len(stale) == 1
+
+
+def test_baseline_requires_note(tmp_path):
+    path = tmp_path / "baseline.json"
+    path.write_text(json.dumps(
+        [{"rule": "serve-clock", "path": "icikit/serve/x.py",
+          "msg": "wall clock", "note": "  "}]))
+    with pytest.raises(ValueError, match="justification"):
+        bl.load(str(path))
+
+
+def test_committed_baseline_entries_all_match_live_findings():
+    """Every entry in the real tools/analysis_baseline.json matches a
+    live finding — a stale entry means the code was fixed and the
+    baseline should shed it."""
+    root = repo_root()
+    entries = bl.load(os.path.join(root, bl.DEFAULT_BASELINE))
+    found = _findings(root, ["lock-discipline", "host-sync"])
+    _, _, stale = bl.split(found, entries)
+    assert stale == [], [e["msg"] for e in stale]
+
+
+# -- migrated Makefile greps: both directions ------------------------
+
+def test_obs_print_seeded_fails_and_obs_is_exempt(tmp_path):
+    root = _mini(tmp_path, "icikit/x.py",
+                 "import json\nprint(json.dumps({}))\n")
+    _mini(tmp_path, "icikit/obs/y.py",
+          "import json\nprint(json.dumps({}))\n")
+    got = _findings(root, ["obs-print"])
+    assert [(f.path, f.line) for f in got] == [("icikit/x.py", 2)]
+
+
+def test_serve_clock_only_polices_serve_tree(tmp_path):
+    root = _mini(tmp_path, "icikit/serve/x.py",
+                 "import time\nt = time.time()\n")
+    _mini(tmp_path, "icikit/bench/y.py",
+          "import time\nt = time.time()\n")
+    got = _findings(root, ["serve-clock"])
+    assert [(f.path, f.line) for f in got] \
+        == [("icikit/serve/x.py", 2)]
+
+
+# -- parity pins: ported rules on the real tree ----------------------
+
+@pytest.mark.parametrize("rule", ["serve-key", "serve-clock",
+                                  "obs-print", "tree-accept",
+                                  "obs-catalog"])
+def test_ported_rule_parity_on_real_tree(rule):
+    """Each ported rule reproduces its predecessor's verdict on the
+    real tree: the predecessors all pass today, so the port must
+    report zero findings (modulo the committed baseline, which these
+    rules have no entries in)."""
+    got = _findings(repo_root(), [rule])
+    assert got == [], [f.render() for f in got]
+
+
+def test_chaos_site_parity_on_real_tree():
+    got = _findings(repo_root(), ["chaos-site"])
+    assert got == [], [f.render() for f in got]
+
+
+@pytest.mark.slow
+def test_quant_rule_parity_on_real_tree():
+    """The runtime quant-arena port reproduces tools/quant_lint.py's
+    passing verdict (slow: builds pools, runs a tiny engine)."""
+    got = _findings(repo_root(), ["quant-arena"])
+    assert got == [], [f.render() for f in got]
+
+
+def test_corpus_is_excluded_from_real_walk():
+    got = _findings(repo_root(), STATIC_RULES)
+    leaked = [f for f in got
+              if f.path.startswith("tests/analysis_corpus")]
+    assert leaked == [], [f.render() for f in leaked]
+
+
+def test_new_rules_gate_green_on_real_tree_with_baseline():
+    """The acceptance bar: zero UNBASELINED host-sync /
+    lock-discipline findings post-PR."""
+    root = repo_root()
+    found = _findings(root, ["host-sync", "lock-discipline"])
+    entries = bl.load(os.path.join(root, bl.DEFAULT_BASELINE))
+    fresh, _, _ = bl.split(found, entries)
+    assert fresh == [], [f.render() for f in fresh]
+
+
+# -- chaos-site helpers (review-hardened, now unit-covered) ----------
+
+def test_collapse_holes():
+    assert collapse_holes("solitaire.worker.{w}") \
+        == "solitaire.worker.*"
+    assert collapse_holes("a.{i}.b.{j}") == "a.*.b.*"
+    assert collapse_holes("serve.kv.page") == "serve.kv.page"
+
+
+def test_env_entry_matches_makefile_spec_form():
+    """The PR 10 regression: the env-spec glob is followed by
+    '=value', not a closing quote — the original ENTRY regex matched
+    the Makefile's own spec form NEVER."""
+    line = 'ICIKIT_CHAOS="seed=0;corrupt:serve.kv.page=@0"'
+    assert ENV_ENTRY.findall(line) == [("corrupt", "serve.kv.page")]
+
+
+def test_scan_entries_quoted_and_env_forms():
+    text = ('plan = {"die:solitaire.worker.{w}": 1}\n'
+            'env = "seed=1;delay:serve.step=0.1"\n')
+    assert scan_entries(text) == [
+        (1, "die", "solitaire.worker.*"),
+        (2, "delay", "serve.step"),
+    ]
+
+
+def test_scan_entries_honors_legacy_off_marker():
+    text = 'bad = "die:nope.nope"  # chaos-site-lint: off\n'
+    assert scan_entries(text) == []
+
+
+def test_local_probes_collapse():
+    text = 'chaos.maybe_die(f"w.{i}")\nfires("delay", "x")\n'
+    assert local_probes(text) == {"w.*", "x"}
+
+
+# -- lock-discipline specifics ---------------------------------------
+
+def test_two_lock_blocking_call_flagged(tmp_path):
+    root = _mini(tmp_path, "icikit/serve/d.py",
+                 "class D:\n"
+                 "    def f(self, ev):\n"
+                 "        with self._lock:\n"
+                 "            with self._page_lock:\n"
+                 "                ev.wait()\n")
+    got = _findings(root, ["lock-discipline"])
+    assert len(got) == 1 and "two locks" in got[0].msg
+
+
+def test_single_lock_plain_wait_not_flagged(tmp_path):
+    # .wait() is only banned at two locks; under ONE lock it is the
+    # condition-variable idiom
+    root = _mini(tmp_path, "icikit/serve/d.py",
+                 "class D:\n"
+                 "    def f(self, ev):\n"
+                 "        with self._lock:\n"
+                 "            ev.wait()\n")
+    assert _findings(root, ["lock-discipline"]) == []
+
+
+def test_lock_held_helper_propagation(tmp_path):
+    root = _mini(tmp_path, "icikit/serve/h.py",
+                 "import time\n"
+                 "class H:\n"
+                 "    def _inner(self):\n"
+                 "        time.sleep(0.1)\n"
+                 "    def outer(self):\n"
+                 "        with self._lock:\n"
+                 "            self._inner()\n")
+    got = _findings(root, ["lock-discipline"])
+    assert [(f.path, f.line) for f in got] \
+        == [("icikit/serve/h.py", 4)]
+    assert "lock-held helper" in got[0].msg
+
+
+# -- host-sync specifics ---------------------------------------------
+
+def test_host_sync_iteration_over_device_always_flagged(tmp_path):
+    root = _mini(tmp_path, "icikit/serve/engine.py",
+                 "class E:\n"
+                 "    def _step(self):\n"
+                 "        outs = self._step_fns[0](self.p)\n"
+                 "        for t in outs:\n"
+                 "            self.emit(t)\n")
+    got = _findings(root, ["host-sync"])
+    assert len(got) == 1 and "iteration" in got[0].msg
+
+
+def test_host_sync_nonfence_scope_flags_top_level_sync(tmp_path):
+    # run() is a scoped NON-fence function: even a loop-free sync
+    # belongs at a documented fence
+    root = _mini(tmp_path, "icikit/serve/engine.py",
+                 "import numpy as np\n"
+                 "class E:\n"
+                 "    def run(self):\n"
+                 "        outs = self._step_fns[0](self.p)\n"
+                 "        return np.asarray(outs)\n")
+    got = _findings(root, ["host-sync"])
+    assert len(got) == 1 and "documented fences" in got[0].msg
+
+
+def test_host_sync_device_get_batch_is_clean(tmp_path):
+    # the prescribed fix shape: one batched device_get, then host math
+    root = _mini(tmp_path, "icikit/serve/engine.py",
+                 "import jax\n"
+                 "class E:\n"
+                 "    def _step(self):\n"
+                 "        pend = []\n"
+                 "        outs = self._step_fns[0](self.p)\n"
+                 "        pend.append(outs)\n"
+                 "        for o in jax.device_get(pend):\n"
+                 "            x = float(o)\n"
+                 "        return x\n")
+    assert _findings(root, ["host-sync"]) == []
+
+
+def test_host_sync_container_of_device_values_flagged(tmp_path):
+    # append device values, then sync per item in the drain loop —
+    # the r13 drain-at-fence regression shape
+    root = _mini(tmp_path, "icikit/serve/engine.py",
+                 "class E:\n"
+                 "    def _step(self):\n"
+                 "        pend = []\n"
+                 "        outs = self._step_fns[0](self.p)\n"
+                 "        pend.append(outs)\n"
+                 "        acc = 0.0\n"
+                 "        for o in pend:\n"
+                 "            acc += float(o)\n"
+                 "        return acc\n")
+    got = _findings(root, ["host-sync"])
+    assert len(got) == 1 and got[0].line == 8
+
+
+def test_makefile_finding_stays_a_chaos_finding(tmp_path):
+    # a Makefile finding routes through the suppression lookup like
+    # any other — and must NOT drag the (unparsable-as-python)
+    # Makefile into the parse-error sweep
+    (tmp_path / "Makefile").write_text(
+        'drill:\n\tICIKIT_CHAOS='
+        '"seed=0;die:not.a.site=@0" run\n')  # chaos-site-lint: off
+    got = _findings(str(tmp_path), ["chaos-site"])
+    assert [(f.rule, f.path) for f in got] \
+        == [("chaos-site", "Makefile")]
+
+
+def test_host_sync_while_test_is_per_iteration(tmp_path):
+    # a while CONDITION re-evaluates every pass: a sync in it is a
+    # per-iteration sync even at the top of a fence function
+    root = _mini(tmp_path, "icikit/serve/engine.py",
+                 "class E:\n"
+                 "    def _step(self):\n"
+                 "        outs = self._step_fns[0](self.p)\n"
+                 "        while float(outs) > 0:\n"
+                 "            outs = self._step_fns[0](self.p)\n")
+    got = _findings(root, ["host-sync"])
+    assert len(got) == 1 and got[0].line == 4
+
+
+def test_cli_json_overflow_finding_not_marked_baselined(tmp_path):
+    # count-capped entry: the overflow (fresh) finding shares the
+    # baseline KEY with the absorbed one but must report
+    # baselined:false in the machine-readable output
+    root = _mini(tmp_path, "icikit/serve/x.py",
+                 "import time\n"
+                 "t = time.time()\n"
+                 "u = time.time()\n")
+    found = _findings(root, ["serve-clock"])
+    blpath = tmp_path / "bl.json"
+    blpath.write_text(json.dumps(
+        [{"rule": "serve-clock", "path": "icikit/serve/x.py",
+          "msg": found[0].msg, "count": 1, "note": "one only"}]))
+    out = tmp_path / "report.json"
+    rc = cli_main(["--root", root, "--rules", "serve-clock",
+                   "--gate", "--baseline", str(blpath),
+                   "--json", str(out)])
+    assert rc == 1
+    payload = json.loads(out.read_text())
+    flags = {f["line"]: f["baselined"] for f in payload["findings"]}
+    assert flags == {2: True, 3: False}
+    assert payload["counts"]["unbaselined"] == 1
+
+
+# -- parse errors ----------------------------------------------------
+
+def test_parse_error_is_a_finding_not_a_crash(tmp_path):
+    root = _mini(tmp_path, "icikit/serve/broken.py",
+                 "def f(:\n")
+    got = _findings(root, ["host-sync", "lock-discipline"])
+    assert [f.rule for f in got] == ["parse-error"]
+
+
+# -- CLI -------------------------------------------------------------
+
+def test_cli_json_shape(tmp_path):
+    out = tmp_path / "report.json"
+    rc = cli_main(["--root", BAD, "--rules", "serve-clock",
+                   "--json", str(out)])
+    assert rc == 0          # findings without --gate exit 0
+    payload = json.loads(out.read_text())
+    assert payload["version"] == 1
+    assert payload["rules"] == ["serve-clock"]
+    assert payload["counts"]["findings"] == 1
+    assert payload["counts"]["unbaselined"] == 1
+    [f] = payload["findings"]
+    assert f["rule"] == "serve-clock"
+    assert f["path"] == "icikit/serve/wallclock.py"
+    assert f["line"] == 4 and f["baselined"] is False
+    assert set(f) == {"rule", "path", "line", "msg", "baselined"}
+
+
+def test_cli_gate_fails_on_bad_and_passes_on_clean():
+    assert cli_main(["--root", BAD, "--rules", "serve-clock",
+                     "--gate"]) == 1
+    assert cli_main(["--root", CLEAN, "--rules", "serve-clock",
+                     "--gate"]) == 0
+
+
+def test_cli_self_check_drill():
+    """The seeded-violation drill proves every seedable rule can
+    still fail the gate."""
+    assert cli_main(["--root", CLEAN, "--rules", "serve-clock",
+                     "--self-check"]) == 0
+
+
+def test_cli_write_baseline_then_gate_green(tmp_path):
+    blpath = tmp_path / "bl.json"
+    assert cli_main(["--root", BAD, "--rules", "serve-clock",
+                     "--write-baseline",
+                     "--baseline", str(blpath)]) == 0
+    assert cli_main(["--root", BAD, "--rules", "serve-clock",
+                     "--gate", "--baseline", str(blpath)]) == 0
+
+
+# -- backward-compat shims -------------------------------------------
+
+@pytest.mark.parametrize("mod", ["serve_key_lint", "chaos_site_lint",
+                                 "tree_accept_lint",
+                                 "obs_catalog_lint"])
+def test_tool_shims_still_pass(mod):
+    # the old entry points (quant_lint is the slow runtime one —
+    # exercised by make check) still exist and still pass
+    import importlib.util
+    path = os.path.join(repo_root(), "tools", f"{mod}.py")
+    spec = importlib.util.spec_from_file_location(mod, path)
+    shim = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(shim)
+    assert shim.main() == 0

@@ -1,118 +1,42 @@
-"""Lint: every chaos plan entry in tests/tools must name a registered
-probe site.
+"""Thin shim: this lint is now the ``chaos-site`` rule of the unified
+analysis framework (``icikit.analysis``, docs/ANALYSIS.md) — every
+chaos plan entry in tests/tools/Makefile must name a registered probe
+site. The scanners (``ENTRY``/``ENV_ENTRY``/``LOCAL_PROBE``, plus the
+``collapse_holes`` f-string-glob helper — both now unit-tested in
+tests/test_analysis.py) live in ``icikit.analysis.rules.chaos_site``;
+``make check`` runs the whole suite as
+``python -m icikit.analysis --gate``.
 
-Probe sites used to be bare strings: a typo in an ``ICIKIT_CHAOS``
-spec or a drill's ``FaultPlan`` key silently never fired — the drill
-"passed" while exercising nothing. Modules now register their sites at
-definition (``chaos.register_site``, next to the probes themselves);
-this lint imports every instrumented module, then scans the test and
-tool trees (plus the Makefile's ``ICIKIT_CHAOS`` specs) for
-``kind:site-glob`` literals and fails on any glob that cannot reach a
-registered site (``chaos.site_known``). ``inject()`` gives the same
-feedback at runtime as a ``RuntimeWarning``; this makes it a CI
-failure (wired into ``make check``).
-
-Run: ``python tools/chaos_site_lint.py`` — exits nonzero with the
-offending entries on a hit.
+Run standalone: ``JAX_PLATFORMS=cpu python tools/chaos_site_lint.py``.
 """
 
 from __future__ import annotations
 
 import os
-import re
 import sys
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-if ROOT not in sys.path:  # runnable as `python tools/chaos_site_lint.py`
+if ROOT not in sys.path:
     sys.path.insert(0, ROOT)
 
-# A plan entry literal: "kind:site-glob" in quotes, f-string holes
-# collapsed to a glob star (f"die:solitaire.worker.{w}" drills the
-# registered solitaire.worker.* family).
-ENTRY = re.compile(
-    r"""["'](delay|die|corrupt|io):([A-Za-z0-9_.*?{}\[\]-]+)["']""")
+from icikit.analysis.rules.chaos_site import (  # noqa: E402,F401
+    ENTRY,
+    ENV_ENTRY,
+    LOCAL_PROBE,
+    check_chaos_site,
+    collapse_holes,
+    local_probes,
+    scan_entries,
+)
 
-# An ICIKIT_CHAOS env-spec entry: the spec is one quoted semicolon-
-# separated string ('seed=0;corrupt:serve.kv.page=@0'), so the glob is
-# followed by '=value' rather than a closing quote — the Makefile's
-# drills (and any subprocess env strings in tests) are written this way.
-ENV_ENTRY = re.compile(
-    r"""(delay|die|corrupt|io):([A-Za-z0-9_.*?{}\[\]-]+)=""")
-
-# A direct probe call in the scanned file: the chaos-machinery unit
-# tests drill synthetic sites ("w.1", "x") they probe themselves —
-# those are defined, just locally. Same register-at-definition rule,
-# applied to the file under scan.
-LOCAL_PROBE = re.compile(
-    r"""(?:maybe_delay|maybe_die|maybe_corrupt|maybe_io_fail|io_retry|"""
-    r"""fires)\(\s*(?:["'][a-z]+["']\s*,\s*)?f?["']"""
-    r"""([A-Za-z0-9_.{}-]+)["']""")
-
-
-def _register_everything() -> None:
-    """Import every module that owns probe sites, so registration-at-
-    definition has happened before we judge the globs."""
-    import icikit.bench.harness  # noqa: F401
-    import icikit.models.solitaire.scheduler  # noqa: F401
-    import icikit.models.sort  # noqa: F401
-    import icikit.models.transformer.decode  # noqa: F401
-    import icikit.models.transformer.model  # noqa: F401
-    import icikit.models.transformer.speculative  # noqa: F401
-    import icikit.models.transformer.train  # noqa: F401
-    import icikit.parallel.integrity  # noqa: F401
-    import icikit.parallel.multihost  # noqa: F401
-    import icikit.serve.engine  # noqa: F401
-    import icikit.utils.checkpoint  # noqa: F401
-
-
-def _scan_paths():
-    for sub in ("tests", "tools"):
-        d = os.path.join(ROOT, sub)
-        for name in sorted(os.listdir(d)):
-            if name.endswith(".py"):
-                yield os.path.join(d, name)
-    yield os.path.join(ROOT, "Makefile")
+RULE = "chaos-site"
 
 
 def main() -> int:
-    _register_everything()
-    from icikit import chaos
-
-    import fnmatch
-
-    bad = []
-    for path in _scan_paths():
-        with open(path) as f:
-            text = f.read()
-        local = {re.sub(r"\{[^}]*\}", "*", s)
-                 for s in LOCAL_PROBE.findall(text)}
-        for lineno, line in enumerate(text.splitlines(), 1):
-            if "chaos-site-lint: off" in line:
-                continue  # deliberate negative (the warn-path tests)
-            entries = ENTRY.findall(line) + ENV_ENTRY.findall(line)
-            for kind, glob in entries:
-                # collapse f-string holes to globs before judging
-                glob = re.sub(r"\{[^}]*\}", "*", glob)
-                if chaos.site_known(glob):
-                    continue
-                if any(fnmatch.fnmatchcase(s, glob)
-                       or fnmatch.fnmatchcase(glob, s)
-                       for s in local):
-                    continue  # the file probes that site itself
-                rel = os.path.relpath(path, ROOT)
-                bad.append(f"{rel}:{lineno}: {kind}:{glob}")
-    if bad:
-        print("chaos plan entries naming no registered probe site "
-              "(typo, or the owning module forgot "
-              "chaos.register_site):")
-        print("\n".join("  " + b for b in bad))
-        print(f"registered sites: "
-              f"{sorted(chaos.registered_sites())}")
-        return 1
-    n = len(chaos.registered_sites())
-    print(f"chaos-site lint OK: every tests/tools plan entry reaches "
-          f"one of the {n} registered sites")
-    return 0
+    from icikit.analysis import shim_main
+    return shim_main(RULE, "chaos-site lint OK (via icikit.analysis):"
+                           " every tests/tools plan entry reaches a "
+                           "registered site")
 
 
 if __name__ == "__main__":

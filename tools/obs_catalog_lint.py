@@ -1,61 +1,40 @@
 #!/usr/bin/env python
-"""Lint: every serving / speculation telemetry name emitted in code
-must appear in docs/OBSERVABILITY.md.
+"""Thin shim: this lint is now the ``obs-catalog`` rule of the
+unified analysis framework (``icikit.analysis``, docs/ANALYSIS.md) —
+every serving / speculation telemetry name emitted in code must
+appear in docs/OBSERVABILITY.md. Backward compatible as an ENTRY
+POINT (same exit codes); the re-exported helpers are the framework
+forms — ``emitted_names`` now takes a ``Project`` and returns a
+``name -> (path, line)`` dict, not the old zero-arg set. ``make
+check`` runs the whole suite as ``python -m icikit.analysis --gate``.
 
-The watch layer and the bench regression gate both key on metric NAMES
-(``serve.ttft_ms``, ``decode.spec.draft_accepted``, ...). A counter
-that exists in code but not in the catalog is telemetry nobody can
-alarm on or will remember exists; a renamed counter silently orphans
-its alert rule. This lint walks ``icikit/`` for literal
-``obs.count/observe/gauge/emit`` names under the ``serve.*`` and
-``decode.spec.*`` prefixes — plus the async request-span names the
-trace_ctx layer opens — and fails on any name the catalog does not
-mention. (The doc may document MORE than code emits — planned names
-are fine; the failure mode is only code the doc lost track of.)
+Run standalone: ``python tools/obs_catalog_lint.py``.
 """
 
 from __future__ import annotations
 
-import pathlib
-import re
+import os
 import sys
 
-ROOT = pathlib.Path(__file__).resolve().parent.parent
-DOC = ROOT / "docs" / "OBSERVABILITY.md"
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if ROOT not in sys.path:
+    sys.path.insert(0, ROOT)
 
-EMIT_RE = re.compile(
-    r'obs\.(?:count|observe|gauge|emit)\(\s*"'
-    r'((?:serve|decode\.spec)\.[^"]+)"')
-# request-scoped async span/instant names (trace_ctx call sites in
-# serve/: self-opens inside trace_ctx.py itself count too)
-CTX_RE = re.compile(
-    r'\.(?:open|close|instant|span)\(\s*"(serve\.req[^"]*)"')
+from icikit.analysis.rules.obs_catalog import (  # noqa: E402,F401
+    CTX_RE,
+    EMIT_RE,
+    check_obs_catalog,
+    emitted_names,
+)
 
-
-def emitted_names() -> set:
-    names = set()
-    for path in sorted((ROOT / "icikit").rglob("*.py")):
-        text = path.read_text()
-        names.update(EMIT_RE.findall(text))
-        names.update(CTX_RE.findall(text))
-    return names
+RULE = "obs-catalog"
 
 
 def main() -> int:
-    if not DOC.exists():
-        print(f"obs catalog lint: {DOC} missing", file=sys.stderr)
-        return 1
-    doc = DOC.read_text()
-    missing = sorted(n for n in emitted_names() if n not in doc)
-    if missing:
-        print("telemetry emitted in code but absent from "
-              "docs/OBSERVABILITY.md's catalog:", file=sys.stderr)
-        for n in missing:
-            print(f"  {n}", file=sys.stderr)
-        return 1
-    print(f"obs catalog lint OK: {len(emitted_names())} "
-          "serve.*/decode.spec.* names all catalogued")
-    return 0
+    from icikit.analysis import shim_main
+    return shim_main(RULE, "obs catalog lint OK (via icikit."
+                           "analysis): serve.*/decode.spec.* names "
+                           "all catalogued")
 
 
 if __name__ == "__main__":

@@ -1,19 +1,14 @@
-"""``make check`` lint: no high-precision KV tensor is ALLOCATED on
-the int8 decode path.
+"""Thin shim: this lint is now the ``quant-arena`` rule of the
+unified analysis framework (``icikit.analysis``, docs/ANALYSIS.md) —
+no high-precision KV tensor is ALLOCATED on the int8 decode path, and
+sealed-block digests cover the int8 scale pages. Unlike the AST rules
+it is a RUNTIME check. Backward compatible as an ENTRY POINT (same
+exit codes); the re-exported check bodies are the framework forms —
+they RETURN ``Finding`` lists now instead of asserting, so call sites
+must check the return value, not rely on an exception. ``make check``
+runs the whole suite as ``python -m icikit.analysis --gate``.
 
-"Allocated" means the persistent cache stores — pool arenas and the
-loop-carried cache buffers — not transient fused values (an int8
-operand upcast inside a matmul never owns HBM). Three mechanical
-checks, each failing loudly:
-
-1. ``KVPool(quant="int8")`` holds ONLY int8 arenas + fp32 scale pages
-   (no compute-dtype KV arena attribute exists at all);
-2. the int8 generate program's decode loop carries int8 caches: the
-   jaxpr's scan/while carry avals contain NO floating-point tensor of
-   the cache shape;
-3. the int8 engine's step-program buffer pytree round-trips int8.
-
-Run: ``JAX_PLATFORMS=cpu python tools/quant_lint.py``
+Run standalone: ``JAX_PLATFORMS=cpu python tools/quant_lint.py``.
 """
 
 from __future__ import annotations
@@ -27,182 +22,26 @@ if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(
-    os.path.abspath(__file__))))
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if ROOT not in sys.path:
+    sys.path.insert(0, ROOT)
 
+from icikit.analysis.rules.quant import (  # noqa: E402,F401
+    check_block_hash_covers_scales,
+    check_engine,
+    check_generate,
+    check_pool,
+    check_quant,
+)
 
-def check_pool() -> None:
-    import jax.numpy as jnp
-
-    from icikit.models.transformer import TransformerConfig
-    from icikit.models.transformer.model import make_model_mesh
-    from icikit.serve.kvpool import KVPool
-
-    cfg = TransformerConfig(vocab=31, d_model=16, n_heads=2, d_head=8,
-                            d_ff=32, n_layers=2, max_seq=32,
-                            compute_dtype="float32")
-    mesh = make_model_mesh(dp=1, tp=1, sp=1)
-    pool = KVPool(cfg, mesh, n_blocks=4, block_size=4, quant="int8")
-    assert pool.kc is None and pool.vc is None, \
-        "int8 pool allocated a high-precision KV arena"
-    for name in ("qkc", "qvc"):
-        for buf in getattr(pool, name):
-            assert buf.dtype == jnp.int8, (name, buf.dtype)
-    for name in ("ksc", "vsc"):
-        for buf in getattr(pool, name):
-            assert buf.dtype == jnp.float32, (name, buf.dtype)
-    bufs = pool.buffers()
-    assert set(bufs) == {"qkc", "qvc", "ksc", "vsc"}, set(bufs)
-    print("quant-lint: KVPool int8 arenas OK (no fp KV allocated)")
-
-
-def _float_cache_avals(jaxpr, cache_shape_tail):
-    """Recursively collect scan/while carry avals that are floating
-    point AND cache-shaped — the allocation smoking gun."""
-    import jax.numpy as jnp
-    bad = []
-
-    def visit(jx):
-        for eqn in jx.eqns:
-            sub = []
-            if eqn.primitive.name == "scan":
-                inner = eqn.params["jaxpr"].jaxpr
-                n_carry = eqn.params["num_carry"]
-                sub = [v.aval for v in inner.invars[:n_carry]]
-                visit(inner)
-            elif eqn.primitive.name == "while":
-                inner = eqn.params["body_jaxpr"].jaxpr
-                sub = [v.aval for v in inner.invars]
-                visit(inner)
-            else:
-                for p in eqn.params.values():
-                    core = getattr(p, "jaxpr", None)
-                    if core is not None and hasattr(core, "eqns"):
-                        visit(core)
-            for a in sub:
-                shape = getattr(a, "shape", ())
-                if (len(shape) >= len(cache_shape_tail)
-                        and tuple(shape[-len(cache_shape_tail):])
-                        == cache_shape_tail
-                        and jnp.issubdtype(a.dtype, jnp.floating)):
-                    bad.append(a)
-
-    visit(jaxpr)
-    return bad
-
-
-def check_generate() -> None:
-    import jax
-    import jax.numpy as jnp
-
-    from icikit.models.transformer import TransformerConfig, init_params
-    from icikit.models.transformer.decode import (
-        _build_generate,
-        maybe_quantize_params,
-    )
-    from icikit.models.transformer.model import make_model_mesh
-
-    cfg = TransformerConfig(vocab=31, d_model=16, n_heads=2, d_head=8,
-                            d_ff=32, n_layers=2, max_seq=64,
-                            compute_dtype="float32",
-                            decode_quant="int8")
-    mesh = make_model_mesh(dp=1, tp=1, sp=1)
-    import dataclasses
-    params = init_params(
-        jax.random.key(0),
-        dataclasses.replace(cfg, decode_quant="none"), mesh)
-    qp = maybe_quantize_params(params, mesh, cfg)
-    s_prompt, n_new = 8, 12
-    fn = _build_generate(mesh, cfg, s_prompt, n_new)
-    prompt = jnp.zeros((2, s_prompt), jnp.int32)
-    seeds = jnp.zeros((2,), jnp.int32)
-    key_data = jax.random.key_data(jax.random.key(0))
-    knobs = jnp.ones((3,), jnp.float32)
-    jaxpr = jax.make_jaxpr(fn)(qp, prompt, seeds, key_data, knobs)
-    kv = cfg.n_kv_heads or cfg.n_heads
-    tail = (s_prompt + n_new, kv, cfg.d_head)
-    bad = _float_cache_avals(jaxpr.jaxpr, tail)
-    assert not bad, (
-        "int8 generate carries a high-precision cache-shaped buffer "
-        f"through its decode loop: {bad}")
-    print("quant-lint: int8 generate loop carries are int8 OK")
-
-
-def check_engine() -> None:
-    import dataclasses
-
-    import jax
-    import jax.numpy as jnp
-    import numpy as np
-
-    from icikit.models.transformer import TransformerConfig, init_params
-    from icikit.models.transformer.model import make_model_mesh
-    from icikit.serve import Engine, ServeConfig
-
-    cfg = TransformerConfig(vocab=31, d_model=16, n_heads=2, d_head=8,
-                            d_ff=32, n_layers=2, max_seq=64,
-                            compute_dtype="float32",
-                            decode_quant="int8")
-    mesh = make_model_mesh(dp=1, tp=1, sp=1)
-    params = init_params(
-        jax.random.key(0),
-        dataclasses.replace(cfg, decode_quant="none"), mesh)
-    eng = Engine(params, mesh, cfg,
-                 ServeConfig(max_rows=2, block_size=4, n_blocks=8,
-                             max_prompt=8, max_new=8))
-    eng.submit(np.arange(5, dtype=np.int32), 6)
-    eng.run()
-    bufs = eng.pool.buffers()
-    assert set(bufs) == {"qkc", "qvc", "ksc", "vsc"}, set(bufs)
-    assert all(b.dtype == jnp.int8 for b in bufs["qkc"] + bufs["qvc"])
-    print("quant-lint: int8 engine pool round-trips int8 OK")
-
-
-def check_block_hash_covers_scales() -> None:
-    """Prefix-cache era integrity: the sealed-block digest — the one
-    fingerprint every sharer of a page re-verifies — must cover the
-    int8 arena's SCALE pages, not just the quantized payload. A
-    flipped scale corrupts decoded tokens exactly like a flipped int8
-    byte, so it must flip the digest too; a digest over payload bytes
-    alone would let scale corruption ride shared blocks undetected."""
-    import numpy as np
-
-    from icikit.models.transformer import TransformerConfig
-    from icikit.models.transformer.model import make_model_mesh
-    from icikit.serve.kvpool import KVPool
-
-    cfg = TransformerConfig(vocab=31, d_model=16, n_heads=2, d_head=8,
-                            d_ff=32, n_layers=2, max_seq=32,
-                            compute_dtype="float32")
-    mesh = make_model_mesh(dp=1, tp=1, sp=1)
-    pool = KVPool(cfg, mesh, n_blocks=4, block_size=4, quant="int8")
-    # the q8 read-back must interleave payload AND scales per layer
-    [page] = pool.allocators[0].alloc("lint", 1)
-    per_layer = len(pool.page_bytes(0, page, "q8")) // cfg.n_layers
-    assert per_layer == 4, (
-        "q8 page_bytes must return qk, qv, ksc, vsc per layer, got "
-        f"{per_layer} arrays")
-    data = np.arange(4 * 2 * 8, dtype=np.int8).reshape(4, 2, 8)
-    pool.poke_page(0, page, 0, data)
-    pool.seal(0, page)
-    assert pool.verify("lint", 0) == []
-    vsc = list(pool.vsc)
-    vsc[1] = vsc[1].at[0, page, 1, 0].add(0.5)   # ONLY a scale moves
-    pool.vsc = tuple(vsc)
-    assert pool.verify("lint", 0) == [0], (
-        "a flipped scale page did NOT fail the sealed-block verify — "
-        "the block hash does not cover the quantized payload's scales")
-    print("quant-lint: sealed-block digest covers int8 scale pages OK")
+RULE = "quant-arena"
 
 
 def main() -> int:
-    check_pool()
-    check_generate()
-    check_engine()
-    check_block_hash_covers_scales()
-    print("quant-lint OK: no high-precision KV allocated on the "
-          "int8 path; block digests cover scale pages")
-    return 0
+    from icikit.analysis import shim_main
+    return shim_main(RULE, "quant-lint OK (via icikit.analysis): no "
+                           "high-precision KV allocated on the int8 "
+                           "path; block digests cover scale pages")
 
 
 if __name__ == "__main__":

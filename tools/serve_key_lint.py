@@ -1,72 +1,37 @@
-"""Lint: no unkeyed randomness inside ``icikit/serve/``.
+"""Thin shim: this lint is now the ``serve-key`` rule of the unified
+analysis framework (``icikit.analysis``, docs/ANALYSIS.md) — no
+unkeyed randomness inside ``icikit/serve/``. Backward compatible as
+an ENTRY POINT (same exit codes); the semantics and the ``BANNED``
+pattern table (same ``(regex, why)`` shape as before) live in
+``icikit.analysis.rules.serve_key``; ``make check`` runs the whole
+suite as ``python -m icikit.analysis --gate``.
 
-The r12 sampled-serving contract is that EVERY random draw in the
-serving path is keyed by the schedule-invariant per-request counter
-``fold_in(fold_in(key(0), seed), position)`` — derived in ONE place
-(``icikit.models.transformer.decode.request_stream_data`` /
-``fold_streams``/``fold_positions``) and threaded through as data.
-Any other randomness inside ``icikit/serve/`` (a ``np.random`` call, a
-time-seeded key, a bare ``PRNGKey(0)``/``jax.random.key(...)`` minted
-at a sample site) would silently re-tie sampled tokens to engine
-state — batch slot, step count, wall clock — and break both the
-engine ≡ ``sample_generate`` identity pin and bitwise reissue after a
-lease reap. This lint makes that a CI failure instead of a review
-hope (wired into ``make check``).
-
-Run: ``python tools/serve_key_lint.py`` — exits nonzero with the
-offending lines on a hit.
+Run standalone: ``python tools/serve_key_lint.py`` — exits nonzero
+with the offending lines on a hit, exactly like the pre-framework
+script.
 """
 
 from __future__ import annotations
 
 import os
-import re
 import sys
 
-SERVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
-    os.path.abspath(__file__))), "icikit", "serve")
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if ROOT not in sys.path:
+    sys.path.insert(0, ROOT)
 
-# pattern -> why it is banned in icikit/serve/
-BANNED = [
-    (re.compile(r"np\.random|numpy\.random"),
-     "np.random draws are unkeyed — route randomness through the "
-     "request's counter stream (decode.request_stream_data)"),
-    (re.compile(r"\bPRNGKey\s*\("),
-     "bare PRNGKey at a sample site — streams must come from the "
-     "per-request seed (decode.request_stream_data)"),
-    (re.compile(r"jax\.random\.key\s*\(|random\.key\s*\("),
-     "key construction inside icikit/serve — the ONE stream "
-     "derivation lives in decode.request_stream_data"),
-    (re.compile(r"\brandom\.seed\s*\(|\bdefault_rng\s*\("),
-     "host RNG seeding in the serving path"),
-    (re.compile(r"key\s*\(\s*int\s*\(\s*time|seed\s*=\s*time\."),
-     "time-seeded keys are schedule-dependent by construction"),
-]
+from icikit.analysis.rules.serve_key import (  # noqa: E402,F401
+    BANNED,
+    check_serve_key,
+)
+
+RULE = "serve-key"
 
 
 def main() -> int:
-    bad = []
-    for root, _, files in os.walk(SERVE_DIR):
-        for fn in sorted(files):
-            if not fn.endswith(".py"):
-                continue
-            path = os.path.join(root, fn)
-            with open(path) as f:
-                for ln, line in enumerate(f, 1):
-                    stripped = line.split("#", 1)[0]
-                    for pat, why in BANNED:
-                        if pat.search(stripped):
-                            rel = os.path.relpath(path, SERVE_DIR)
-                            bad.append(
-                                f"icikit/serve/{rel}:{ln}: "
-                                f"{line.strip()}\n    -> {why}")
-    if bad:
-        print("unkeyed randomness inside icikit/serve/ — every draw "
-              "must ride the per-request counter streams:")
-        print("\n".join(bad))
-        return 1
-    print("serve-key-lint OK: no unkeyed randomness in icikit/serve/")
-    return 0
+    from icikit.analysis import shim_main
+    return shim_main(RULE, "serve-key-lint OK (via icikit.analysis): "
+                           "no unkeyed randomness in icikit/serve/")
 
 
 if __name__ == "__main__":

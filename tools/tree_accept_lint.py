@@ -1,97 +1,36 @@
-"""`make check` lint (round 14): ONE accept implementation.
+"""Thin shim: this lint is now the ``tree-accept`` rule of the
+unified analysis framework (``icikit.analysis``, docs/ANALYSIS.md) —
+ONE speculative accept implementation (``_accept_tree`` runs
+``_accept_window`` verbatim; the engine imports both). The AST check
+lives in ``icikit.analysis.rules.tree_accept``; ``make check`` runs
+the whole suite as ``python -m icikit.analysis --gate``.
 
-The token-tree verify path's exactness argument leans on the primary
-chain being accepted by the *existing* chain rule — `_accept_tree`
-must run `_accept_window` verbatim (so the b=1 tree path and the
-chain path cannot drift apart semantically), and nothing else in the
-tree may re-implement either accept. Mechanically enforced:
-
-1. `_accept_window` and `_accept_tree` are each defined exactly once,
-   in `icikit/models/transformer/speculative.py`;
-2. `_accept_tree`'s body CALLS `_accept_window` (the primary chain
-   goes through the one rule, not a fork of its semantics);
-3. the serving engine defines no accept of its own — it imports both
-   from speculative.py (the engine-vs-generate identity contract
-   hangs on the shared rule).
-
-Run: JAX_PLATFORMS=cpu python tools/tree_accept_lint.py
+Run standalone: ``python tools/tree_accept_lint.py``.
 """
 
 from __future__ import annotations
 
-import ast
 import os
 import sys
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-SPEC = os.path.join(ROOT, "icikit", "models", "transformer",
-                    "speculative.py")
+if ROOT not in sys.path:
+    sys.path.insert(0, ROOT)
 
+from icikit.analysis.rules.tree_accept import (  # noqa: E402,F401
+    ACCEPT_NAMES,
+    check_tree_accept,
+)
 
-def fail(msg: str) -> None:
-    print(f"tree-accept lint FAILED: {msg}")
-    sys.exit(1)
-
-
-def defs_in(path: str, names: set[str]) -> dict[str, ast.FunctionDef]:
-    with open(path) as f:
-        tree = ast.parse(f.read(), path)
-    out: dict[str, ast.FunctionDef] = {}
-    for node in ast.walk(tree):
-        if isinstance(node, ast.FunctionDef) and node.name in names:
-            if node.name in out:
-                fail(f"{node.name} defined more than once in {path}")
-            out[node.name] = node
-    return out
-
-
-def calls_in(fn: ast.FunctionDef) -> set[str]:
-    names = set()
-    for node in ast.walk(fn):
-        if isinstance(node, ast.Call):
-            f = node.func
-            if isinstance(f, ast.Name):
-                names.add(f.id)
-            elif isinstance(f, ast.Attribute):
-                names.add(f.attr)
-    return names
+RULE = "tree-accept"
 
 
 def main() -> int:
-    accept_names = {"_accept_window", "_accept_tree"}
-    spec_defs = defs_in(SPEC, accept_names)
-    for name in accept_names:
-        if name not in spec_defs:
-            fail(f"{name} not defined in {SPEC}")
-    if "_accept_window" not in calls_in(spec_defs["_accept_tree"]):
-        fail("_accept_tree does not call _accept_window — the "
-             "primary chain must run the ONE chain accept rule, "
-             "not a re-implementation")
-    # no second definition anywhere else in the package
-    for dirpath, _, files in os.walk(os.path.join(ROOT, "icikit")):
-        for fn in files:
-            if not fn.endswith(".py"):
-                continue
-            path = os.path.join(dirpath, fn)
-            if os.path.abspath(path) == os.path.abspath(SPEC):
-                continue
-            with open(path) as f:
-                src = f.read()
-            if ("def _accept_window" in src
-                    or "def _accept_tree" in src):
-                fail(f"{path} defines its own accept — import the "
-                     "shared rule from speculative.py instead")
-    # the engine consumes the shared rule, not a local fork
-    eng = os.path.join(ROOT, "icikit", "serve", "engine.py")
-    with open(eng) as f:
-        esrc = f.read()
-    for name in accept_names:
-        if name not in esrc:
-            fail(f"{eng} does not reference {name} — the engine's "
-                 "verify windows must run the shared accept")
-    print("tree-accept lint OK: one accept implementation "
-          "(_accept_tree wraps _accept_window; engine imports both)")
-    return 0
+    from icikit.analysis import shim_main
+    return shim_main(RULE, "tree-accept lint OK (via icikit."
+                           "analysis): one accept implementation "
+                           "(_accept_tree wraps _accept_window; "
+                           "engine imports both)")
 
 
 if __name__ == "__main__":

@@ -6,7 +6,7 @@ PY ?= python
 
 .PHONY: test test-fast chaos bench native clean sweep scaling northstar \
 	trace-demo check analysis-smoke decode-smoke draft-smoke \
-	serve-smoke quant-smoke obs-smoke
+	serve-smoke quant-smoke obs-smoke fleet-smoke
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -46,7 +46,8 @@ check:
 	JAX_PLATFORMS=cpu $(PY) -m icikit.analysis --gate --self-check \
 		--budget 30
 	$(PY) tools/bench_regress.py --self-check serve_r12.jsonl \
-		serve_r15.jsonl serve_r16.jsonl decode_spec_r14.jsonl \
+		serve_r15.jsonl serve_r16.jsonl serve_fleet_r17.jsonl \
+		decode_spec_r14.jsonl \
 		--verdict /tmp/icikit_bench_regress.json
 
 # machine-readable analysis output: the --json shape the tooling
@@ -210,6 +211,32 @@ serve-smoke:
 	$(PY) -m icikit.obs.check /tmp/icikit_serve_rewarm_trace.json
 	@grep -q '"serve.store.rewarm_blocks"' /tmp/icikit_serve_rewarm_metrics.json && \
 		echo "serve-smoke rewarm OK: restarted engine re-warmed the pending prompts from the persisted store, identity-audited"
+
+# multi-engine fleet smoke: a 2-engine disaggregated Poisson run
+# (prefill + decode worker PROCESSES behind the coordinator) under an
+# armed obs session — the coordinator-side trace must pass the
+# structural checker and the metrics snapshot must show the fleet
+# alive-gauge and at least one cross-engine KV migration on the bus;
+# then the kill-one-engine drill: one worker dies mid-decode at its
+# 6th lease renewal (die:fleet.engine.die), the coordinator reissues
+# its leases, and the run must still complete every request
+# identity-clean with >= 1 reissue observed
+fleet-smoke:
+	JAX_PLATFORMS=cpu \
+	ICIKIT_OBS="trace=/tmp/icikit_fleet_trace.json;metrics=/tmp/icikit_fleet_metrics.json;jsonl=off" \
+	$(PY) -m icikit.bench.fleet --engines 2 --roles disagg \
+		--requests 8 --rate 20 --prompt 12 --new-min 4 --new-max 8 \
+		--prefix 8 --verify-identity --seed 0 > /dev/null
+	$(PY) -m icikit.obs.check /tmp/icikit_fleet_trace.json
+	@grep -q '"fleet.engines.alive"' /tmp/icikit_fleet_metrics.json && \
+		grep -q '"fleet.kv.migrations"' /tmp/icikit_fleet_metrics.json && \
+		grep -q '"fleet.handoffs"' /tmp/icikit_fleet_metrics.json && \
+		echo "fleet-smoke OK: trace valid, engines alive + cross-engine migration on the bus"
+	JAX_PLATFORMS=cpu $(PY) -m icikit.bench.fleet --engines 2 \
+		--requests 8 --rate 50 --prompt 12 --new-min 4 --new-max 8 \
+		--lease 2 --kill 1:6 --expect-reissue --verify-identity \
+		--seed 0 > /dev/null
+	@echo "fleet-smoke kill-drill OK: engine died mid-decode, leases reissued, all requests completed bitwise"
 
 bench:
 	$(PY) bench.py

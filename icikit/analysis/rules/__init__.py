@@ -2,6 +2,7 @@
 
 from icikit.analysis.rules import (  # noqa: F401
     chaos_site,
+    fleet_control_plane,
     host_sync,
     lock_discipline,
     obs_catalog,

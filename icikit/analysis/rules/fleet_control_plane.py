@@ -1,0 +1,54 @@
+"""``fleet-control-plane`` — the fleet control plane stays host-only.
+
+The coordinator/transport/bridge layer (``icikit/fleet/transport.py``,
+``coordinator.py``, ``kvbridge.py``) must keep working while a
+defective engine's device schedules are exactly what is under
+suspicion, and must never stall a claim RPC behind an XLA dispatch —
+so it performs NO jax device dispatch and NO jnp allocation: control
+frames and KV bytes move over host sockets only (numpy views are
+fine; they are host memory). The data plane (``roles.py``/
+``worker.py`` — the engine lives there) is explicitly out of scope.
+
+Mechanically: flag any ``import jax``/``from jax ...`` and any
+``jax.``/``jnp.`` attribute use in the control-plane modules,
+comments stripped (the serve-key rule's discipline)."""
+
+from __future__ import annotations
+
+import re
+
+from icikit.analysis.core import Finding, rule
+
+CONTROL_PLANE = ("icikit/fleet/transport.py",
+                 "icikit/fleet/coordinator.py",
+                 "icikit/fleet/kvbridge.py")
+
+BANNED = [
+    (re.compile(r"^\s*(?:import|from)\s+jax\b"),
+     "jax import in fleet control-plane code — the coordinator/"
+     "transport/bridge layer is host-only by contract"),
+    (re.compile(r"\bjnp\s*\."),
+     "jnp allocation in fleet control-plane code — device arrays "
+     "have no business on the claim/lease/bridge path"),
+    (re.compile(r"\bjax\s*\."),
+     "jax device dispatch in fleet control-plane code — the control "
+     "plane must keep flowing while device schedules are suspect"),
+]
+
+
+@rule("fleet-control-plane",
+      "no jax device dispatch / jnp allocation in the fleet "
+      "coordinator/transport/bridge (control plane stays host-only)")
+def check_fleet_control_plane(project) -> list:
+    out = []
+    for rel in CONTROL_PLANE:
+        sf = project.file(rel)
+        if sf is None:
+            continue
+        for ln, text in enumerate(sf.lines, 1):
+            stripped = text.split("#", 1)[0]
+            for pat, why in BANNED:
+                if pat.search(stripped):
+                    out.append(Finding("fleet-control-plane",
+                                       sf.rel, ln, why))
+    return out

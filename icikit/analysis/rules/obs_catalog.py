@@ -6,9 +6,9 @@ gate both key on metric NAMES; a counter that exists in code but not
 in docs/OBSERVABILITY.md is telemetry nobody can alarm on, and a
 renamed counter silently orphans its alert rule. Walks ``icikit/``
 for literal ``obs.count/observe/gauge/emit`` names under the
-``serve.*`` / ``decode.spec.*`` prefixes, plus the async request-span
-names the trace_ctx layer opens, and fails on any name the catalog
-does not mention. The doc may document MORE than code emits — planned
+``serve.*`` / ``decode.spec.*`` / ``fleet.*`` prefixes, plus the
+async request-span names the trace_ctx layer opens, and fails on any
+name the catalog does not mention. The doc may document MORE than code emits — planned
 names are fine; the failure mode is only code the doc lost track of.
 """
 
@@ -22,7 +22,7 @@ DOC = "docs/OBSERVABILITY.md"
 
 EMIT_RE = re.compile(
     r'obs\.(?:count|observe|gauge|emit)\(\s*"'
-    r'((?:serve|decode\.spec)\.[^"]+)"')
+    r'((?:serve|decode\.spec|fleet)\.[^"]+)"')
 # request-scoped async span/instant names (trace_ctx call sites in
 # serve/: self-opens inside trace_ctx.py itself count too)
 CTX_RE = re.compile(

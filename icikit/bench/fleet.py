@@ -1,0 +1,422 @@
+"""Multi-engine fleet benchmark: tokens/s + TTFT vs engine count.
+
+The fleet analog of ``icikit.bench.serve``: the SAME seeded Poisson /
+shared-prefix workloads, served by N ``serve.Engine`` worker
+PROCESSES (``python -m icikit.fleet.worker``, each with its own jax
+runtime and compiled programs) behind one coordinator. The portable
+claims are the ratios across engine counts and the identity audit —
+on this CPU image the engines share physical cores, so absolute
+scaling under-reports what N separate hosts (or TPU slices) would do;
+every record is backend-stamped and the protocol note says so.
+
+Protocol notes:
+
+- **warm-up inside the worker lifetime** — each arm submits a warm
+  batch first (sized so every engine admits and compiles its
+  programs) while the coordinator ``hold()`` barrier keeps workers
+  from draining out, then stamps ``t0`` and submits the timed trace.
+  Workers also arm jax's persistent compilation cache, so repeated
+  arms pay cache hits, not fresh XLA compiles.
+- **identity audit** (``--verify-identity``) — every completed
+  request re-decodes through single-request ``greedy_generate`` /
+  ``sample_generate`` on a coordinator-side model built from the SAME
+  deterministic recipe the workers use: bitwise equality is the bar,
+  across engine deaths, reissues, handoffs, and migrations.
+- **disaggregation arms** (``--roles disagg``) — half the engines are
+  dedicated prefill, half dedicated decode; every request migrates
+  its KV over the block bridge, so ``migrations`` in the record
+  counts the traffic the DistServe split actually moved.
+
+CLI::
+
+    python -m icikit.bench.fleet --engines 2 --requests 16 --rate 4 \
+        --prompt 16 --new-min 8 --new-max 16 --verify-identity
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import pathlib
+import subprocess
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+from icikit import obs
+from icikit.bench.serve import _pcts, make_workload, warm_prompts
+
+REPO = pathlib.Path(__file__).resolve().parents[2]
+
+# serve-geometry defaults shared by every worker in an arm
+DEF_SERVE = dict(max_rows=2, block_size=4, n_blocks=0,
+                 prefill_chunk=16)
+
+
+def roles_for(n_engines: int, roles: str) -> list:
+    """``"both"`` -> homogeneous fleet; ``"disagg"`` -> half dedicated
+    prefill, half dedicated decode (n_engines >= 2)."""
+    if roles == "both":
+        return ["both"] * n_engines
+    if roles == "disagg":
+        if n_engines < 2:
+            raise ValueError("disagg needs >= 2 engines")
+        n_pre = n_engines // 2
+        return ["prefill"] * n_pre + ["decode"] * (n_engines - n_pre)
+    raise ValueError(f"unknown roles {roles!r} (known: both, disagg)")
+
+
+def worker_env(extra: dict | None = None) -> dict:
+    env = dict(os.environ)
+    keep = [x for x in env.get("PYTHONPATH", "").split(os.pathsep)
+            if x]
+    env["PYTHONPATH"] = os.pathsep.join([str(REPO)] + keep)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)      # workers run single-device
+    # persistent compile cache: repeated arms hit disk, not XLA
+    env.setdefault("JAX_COMPILATION_CACHE_DIR",
+                   "/tmp/icikit_jax_cache")
+    env.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS",
+                   "0.1")
+    if extra:
+        env.update(extra)
+    return env
+
+
+def spawn_worker(addr, engine_id: str, role: str, model_spec: dict,
+                 serve_kw: dict, tmpdir: str,
+                 env_extra: dict | None = None,
+                 rewarm: bool = False) -> subprocess.Popen:
+    cfg = {"addr": list(addr), "engine_id": engine_id, "role": role,
+           "model": model_spec, "serve": serve_kw, "rewarm": rewarm}
+    path = os.path.join(tmpdir, f"{engine_id}.json")
+    with open(path, "w") as f:
+        json.dump(cfg, f)
+    return subprocess.Popen(
+        [sys.executable, "-m", "icikit.fleet.worker", path],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        cwd=REPO, env=worker_env(env_extra))
+
+
+def _wait(coord, procs, timeout: float, require: int = 1) -> None:
+    """Block until the queue drains. Dead workers are tolerated down
+    to ``require`` survivors (the soak's whole point); a fully dead
+    fleet or a timeout raises."""
+    deadline = time.monotonic() + timeout
+    while not coord.drained():
+        alive = sum(p.poll() is None for p in procs)
+        if alive < require:
+            raise RuntimeError(
+                f"fleet collapsed: {alive} alive < {require} required")
+        if time.monotonic() > deadline:
+            raise TimeoutError("fleet did not drain in time")
+        time.sleep(0.05)
+
+
+def _collect_worker_stats(procs) -> list:
+    out = []
+    for p in procs:
+        try:
+            text = p.communicate(timeout=60)[0] or ""
+        except subprocess.TimeoutExpired:
+            p.kill()
+            text = p.communicate()[0] or ""
+        stats = None
+        for line in text.splitlines():
+            if line.startswith("FLEET_WORKER_OK "):
+                stats = json.loads(line[len("FLEET_WORKER_OK "):])
+        out.append({"returncode": p.returncode, "stats": stats,
+                    "tail": None if stats else text[-800:]})
+    return out
+
+
+def _verify_identity(model, coord, rids, workload, temperature,
+                     top_k, top_p) -> dict:
+    import jax
+    import jax.numpy as jnp
+
+    from icikit.models.transformer import greedy_generate
+    from icikit.models.transformer.decode import sample_generate
+    params, mesh, cfg = model
+    by_n: dict = {}
+    for rid, (_, p, n, rs) in zip(rids, workload):
+        req = coord.queue.request(rid)
+        if req.state == "done":
+            by_n.setdefault(n, []).append((req, p, rs))
+    checked, bad = 0, 0
+    for n, group in by_n.items():
+        prompts = np.stack([p for _, p, _ in group])
+        if temperature > 0.0:
+            out = np.asarray(sample_generate(
+                params, jnp.asarray(prompts), mesh, cfg, n,
+                jax.random.key(0), temperature=temperature,
+                top_k=top_k, top_p=top_p,
+                seeds=np.asarray([rs for _, _, rs in group],
+                                 np.int32)))
+        else:
+            out = np.asarray(greedy_generate(
+                params, jnp.asarray(prompts), mesh, cfg, n))
+        s = prompts.shape[1]
+        for (req, _, _), row in zip(group, out):
+            checked += 1
+            if [int(t) for t in row[s:s + len(req.tokens)]] \
+                    != [int(t) for t in req.tokens]:
+                bad += 1
+    return {"identity_checked": checked, "identity_mismatches": bad,
+            "identity_ok": bad == 0}
+
+
+def run_fleet(n_engines: int, n_requests: int, rate_rps: float,
+              prompt_len: int, new_min: int, new_max: int,
+              preset: str = "tiny", roles: str = "both",
+              prefix_len: int = 0, temperature: float = 0.0,
+              top_k: int = 0, top_p: float = 1.0,
+              seed_per_request: bool = False, seed: int = 0,
+              rows: int = 2, block_size: int = 4,
+              prefill_chunk: int = 16, speculate: int = 1,
+              integrity: str = "none", verify: bool = False,
+              lease_s: float = 10.0, timeout_s: float = 900.0,
+              store_dir: str | None = None,
+              env_extra_per_engine: dict | None = None,
+              require_alive: int = 1) -> dict:
+    """One fleet arm. ``env_extra_per_engine`` maps engine-id ->
+    extra env (the soak's per-victim ``ICIKIT_CHAOS`` plans);
+    ``require_alive`` is the survivor floor the drain wait tolerates
+    (p−1-survive soaks pass 1)."""
+    import jax
+
+    from icikit.fleet.coordinator import Coordinator
+    from icikit.fleet.worker import build_model
+
+    horizon = prompt_len + 1 + new_max + max(0, speculate - 1)
+    model_spec = {"preset": preset,
+                  "overrides": {"max_seq": max(64, horizon)},
+                  "compute_dtype": "float32", "dp": 1, "tp": 1,
+                  "init_seed": 0}
+    per_row = -(-horizon // block_size)
+    serve_kw = dict(max_rows=rows, block_size=block_size,
+                    n_blocks=per_row * rows + per_row,
+                    max_prompt=prompt_len + 1, max_new=new_max,
+                    prefill_chunk=prefill_chunk,
+                    speculate_k=speculate, integrity=integrity)
+    model = build_model(model_spec)
+    _, _, cfg = model
+    workload = make_workload(n_requests, rate_rps, prompt_len,
+                             new_min, new_max, cfg.vocab, seed,
+                             prefix_len=prefix_len,
+                             seed_per_request=seed_per_request)
+    role_list = roles_for(n_engines, roles)
+    tmpdir = tempfile.mkdtemp(prefix="icikit_fleet_")
+    own_store = store_dir is None
+    store = store_dir or os.path.join(tmpdir, "bridge")
+    coord = Coordinator(store, lease_s=lease_s)
+    procs = []
+    try:
+        for i, role in enumerate(role_list):
+            eid = f"{role}{i}"
+            extra = (env_extra_per_engine or {}).get(eid)
+            procs.append(spawn_worker(
+                coord.addr, eid, role, model_spec, serve_kw, tmpdir,
+                env_extra=extra))
+        # registration barrier: submit nothing until every worker has
+        # said hello — phase assignment (disaggregation) keys on the
+        # registry, and the warm batch must warm the REAL role split
+        deadline = time.monotonic() + timeout_s
+        while len(coord.engines()) < n_engines:
+            if time.monotonic() > deadline:
+                raise TimeoutError("workers never registered")
+            if any(p.poll() is not None for p in procs):
+                raise RuntimeError("a worker died before hello")
+            time.sleep(0.05)
+        # warm phase: every engine must admit + compile before the
+        # clock starts; hold keeps drained() False at the boundary
+        coord.hold(True)
+        warm = warm_prompts(workload, cfg.vocab, prefix_len, seed)
+        n_warm = max(2 * rows * n_engines, len(warm))
+        rng = np.random.default_rng(seed + 7)
+        warm_rids = []
+        for i in range(n_warm):
+            wp = warm[i % len(warm)] if prefix_len else \
+                rng.integers(0, cfg.vocab, (prompt_len,)) \
+                .astype(np.int32)
+            warm_rids.append(coord.submit(
+                wp, 2, temperature=temperature, top_k=top_k,
+                top_p=top_p))
+        deadline = time.monotonic() + timeout_s
+        while any(coord.queue.request(r).state != "done"
+                  for r in warm_rids):
+            if time.monotonic() > deadline:
+                raise TimeoutError("fleet warm-up did not complete")
+            if sum(p.poll() is None for p in procs) < require_alive:
+                # a kill-drill victim may die during warm-up (its
+                # renewal counter does not know about phases); the
+                # warm batch then drains via lease reissue like any
+                # other abandoned work
+                raise RuntimeError("fleet collapsed during warm-up")
+            time.sleep(0.05)
+        # timed window
+        t0 = time.monotonic()
+        rids = [coord.submit(p, n, not_before=t0 + off, seed=rs,
+                             temperature=temperature, top_k=top_k,
+                             top_p=top_p)
+                for off, p, n, rs in workload]
+        coord.hold(False)
+        _wait(coord, procs, timeout_s, require=require_alive)
+        makespan = time.monotonic() - t0
+        # let the surviving workers drain-flush their sealed blocks to
+        # the bridge and exit cleanly BEFORE the coordinator goes away
+        # (the store RPCs must still be answerable)
+        for p in procs:
+            if p.poll() is None:
+                try:
+                    p.wait(timeout=60)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+    finally:
+        coord.shutdown()
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    workers = _collect_worker_stats(procs)
+    ttft, tpot, qwait, tokens, failed = [], [], [], 0, 0
+    for rid in rids:
+        req = coord.queue.request(rid)
+        if req.state != "done":
+            failed += 1
+            continue
+        slo = req.slo()
+        tokens += len(req.tokens)
+        if "ttft_ms" in slo:
+            ttft.append(slo["ttft_ms"])
+        if "tpot_ms" in slo:
+            tpot.append(slo["tpot_ms"])
+        if "queue_wait_ms" in slo:
+            qwait.append(slo["queue_wait_ms"])
+    rec = {
+        "kind": "serve_fleet",
+        "preset": preset,
+        "backend": jax.default_backend(),
+        "n_engines": n_engines,
+        "roles": roles,
+        "rows": rows,
+        "n_requests": n_requests,
+        "rate_rps": rate_rps,
+        "prompt_len": prompt_len,
+        "new_min": new_min, "new_max": new_max,
+        "block_size": block_size,
+        "prefill_chunk": prefill_chunk,
+        "speculate": speculate,
+        "integrity": integrity,
+        "prefix_len": prefix_len,
+        "temperature": temperature,
+        "top_k": top_k, "top_p": top_p,
+        "seed_per_request": seed_per_request,
+        "seed": seed,
+        "compute_dtype": "float32",
+        "tokens": tokens,
+        "makespan_s": round(makespan, 4),
+        "tokens_per_s": round(tokens / makespan, 2),
+        "completed": len(rids) - failed,
+        "failed": failed,
+        "ttft_ms": _pcts(ttft),
+        "tpot_ms": _pcts(tpot),
+        "queue_wait_ms": _pcts(qwait),
+        "reissues": coord.queue.n_reissues,
+        "duplicate_commits": coord.queue.n_duplicate_commits,
+        "handoffs": coord.n_handoffs,
+        "bridge": coord.bridge.stats(),
+        "engines": [{"returncode": w["returncode"],
+                     **(w["stats"] or {"stats": None})}
+                    for w in workers],
+        "note": ("CPU-measured; engines share physical cores — "
+                 "ratios under-report separate-host scaling"
+                 if jax.default_backend() == "cpu"
+                 else "device-measured"),
+    }
+    if verify:
+        rec.update(_verify_identity(model, coord, rids, workload,
+                                    temperature, top_k, top_p))
+    if own_store:
+        import shutil
+        shutil.rmtree(tmpdir, ignore_errors=True)
+    return rec
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--engines", type=int, default=2)
+    ap.add_argument("--roles", default="both",
+                    choices=["both", "disagg"])
+    ap.add_argument("--preset", default="tiny")
+    ap.add_argument("--requests", type=int, default=12)
+    ap.add_argument("--rate", type=float, default=4.0)
+    ap.add_argument("--prompt", type=int, default=16)
+    ap.add_argument("--new-min", type=int, default=8)
+    ap.add_argument("--new-max", type=int, default=16)
+    ap.add_argument("--rows", type=int, default=2)
+    ap.add_argument("--block-size", type=int, default=4)
+    ap.add_argument("--prefill-chunk", type=int, default=16)
+    ap.add_argument("--prefix", type=int, default=0)
+    ap.add_argument("--speculate", type=int, default=1)
+    ap.add_argument("--integrity", default="none",
+                    choices=["none", "pages"])
+    ap.add_argument("--temperature", type=float, default=0.0)
+    ap.add_argument("--top-k", type=int, default=0)
+    ap.add_argument("--top-p", type=float, default=1.0)
+    ap.add_argument("--seed-per-request", action="store_true")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--timeout", type=float, default=900.0)
+    ap.add_argument("--lease", type=float, default=10.0,
+                    help="lease duration (s): kill drills recover at "
+                         "this granularity")
+    ap.add_argument("--verify-identity", action="store_true")
+    ap.add_argument("--kill", action="append", default=[],
+                    metavar="IDX:N",
+                    help="kill drill: arm die:fleet.engine.die on "
+                         "engine IDX at its N-th lease renewal (the "
+                         "worker process dies mid-decode; repeatable)")
+    ap.add_argument("--expect-reissue", action="store_true",
+                    help="exit nonzero unless the run reissued at "
+                         "least one lease (the kill drill's "
+                         "assertion)")
+    ap.add_argument("--json", dest="json_path", default=None)
+    args = ap.parse_args(argv)
+    role_list = roles_for(args.engines, args.roles)
+    env_extra = {}
+    for i, spec in enumerate(args.kill):
+        idx, _, at = spec.partition(":")
+        eid = f"{role_list[int(idx)]}{int(idx)}"
+        env_extra[eid] = {"ICIKIT_CHAOS":
+                          f"seed={i + 1};die:fleet.engine.die=@{at}"}
+    rec = run_fleet(args.engines, args.requests, args.rate,
+                    args.prompt, args.new_min, args.new_max,
+                    preset=args.preset, roles=args.roles,
+                    prefix_len=args.prefix,
+                    temperature=args.temperature, top_k=args.top_k,
+                    top_p=args.top_p,
+                    seed_per_request=args.seed_per_request,
+                    seed=args.seed, rows=args.rows,
+                    block_size=args.block_size,
+                    prefill_chunk=args.prefill_chunk,
+                    speculate=args.speculate,
+                    integrity=args.integrity,
+                    verify=args.verify_identity,
+                    lease_s=args.lease,
+                    timeout_s=args.timeout,
+                    env_extra_per_engine=env_extra or None)
+    obs.emit_records([rec])
+    if args.json_path:
+        with open(args.json_path, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+    if args.expect_reissue and rec["reissues"] < 1:
+        print("expected at least one lease reissue, saw none")
+        return 1
+    return 0 if rec.get("identity_ok", True) and not rec["failed"] \
+        else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,33 @@
+"""icikit.fleet — multi-engine serving coordinator.
+
+ROADMAP item 1's production shape: N ``serve.Engine`` processes behind
+one coordinator-owned ``RequestQueue``, prefill/decode roles split
+DistServe-style, KV blocks migrating between engines through a
+content-addressed block bridge (the r16 persistent tier, fleet-shared
+over a checksummed host-socket transport), and defect-aware leasing
+that distinguishes "host died" (lease expiry → reissue) from "host
+computes garbage" (integrity-verify failures → quarantine the engine,
+reissue its in-flight work). See docs/FLEET.md.
+
+Layering: ``transport`` (frames/checksums/RPC, host-only) →
+``kvbridge`` (store-shaped block migration) → ``coordinator`` (queue
+owner, roles, defect ledger, fleet metrics) → ``roles`` (queue-shaped
+engine proxy + workers) → ``worker`` (subprocess entry). The control
+plane (transport/coordinator/kvbridge) never touches jax — enforced
+by the ``fleet-control-plane`` analysis rule.
+"""
+
+from icikit.fleet.coordinator import Coordinator  # noqa: F401
+from icikit.fleet.kvbridge import BlockBridge, BridgeStore  # noqa: F401
+from icikit.fleet.roles import (  # noqa: F401
+    EngineWorker,
+    RemoteQueue,
+    engine_stats,
+)
+from icikit.fleet.transport import (  # noqa: F401
+    ChecksumError,
+    RpcClient,
+    RpcError,
+    RpcServer,
+    TransportError,
+)

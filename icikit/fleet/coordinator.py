@@ -1,0 +1,441 @@
+"""Fleet coordinator: one queue, N engines, defect-aware leasing.
+
+The production shape ROADMAP item 1 named: N ``serve.Engine``
+processes behind ONE coordinator-owned :class:`RequestQueue`. Every
+hard property was already built single-process and composes across
+the wire because none of it ever depended on being in one process:
+
+- **leases + claim generations** — an engine that stops renewing
+  (death, stall, partition) loses its lease; ``reap_expired`` requeues
+  the work and the claim-seq fence turns the stale engine's late RPCs
+  into counted no-ops. Commits are idempotent. Sampled outputs are
+  schedule-invariant (counter keys carry no engine state), so a
+  reissue replays **bitwise on any engine** — the p−1-survive soak's
+  exit bar.
+- **prefill/decode disaggregation** (DistServe/Mooncake-style) —
+  engines register a role; when the fleet holds dedicated prefill AND
+  decode engines, a fresh request enters *prefill phase*: a
+  prefill-capable engine claims it with ``n_new`` clamped to 1
+  (prefill + first token = the TTFT-owning phase), streams its
+  finalized sealed blocks to the block bridge, and the coordinator
+  turns that completion into a :meth:`RequestQueue.handoff` — the
+  request requeues for decode-capable engines with the committed
+  token folded into the prompt. Absolute-position counter keys make
+  the spliced stream bitwise the unsplit one.
+- **defect-aware scheduling** — the r13 distinction ("host died" vs
+  "host computes garbage") drives two different reactions: a dead
+  engine is reaped by lease expiry / heartbeat timeout and its work
+  reissued; an engine whose *completions fail KV integrity verify*
+  (an ``IntegrityError`` fail RPC — the sealed-page checksums are the
+  detector) is **quarantined**: no further claims, its in-flight
+  leases force-expired and reissued to survivors. Content quarantine
+  (a corrupt bridged block) is NOT an engine defect — the block is
+  purged bridge-wide and recomputed, exactly the r16 swap-in rule.
+- **SLO aggregation** — engines report heartbeat snapshots;
+  per-request SLO marks (admit / first-token / worst-gap, monotonic —
+  one host, one clock domain) ride the complete RPC onto the
+  authoritative Request, and fleet-level gauges/counters
+  (``fleet.engines.alive``, ``fleet.kv.migrations``, ...) land on
+  the coordinator's obs bus.
+
+Control plane rule (``fleet-control-plane`` analysis rule): this
+module performs no jax device dispatch and allocates no jnp arrays —
+claims, leases and KV bytes move over host sockets only.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+
+from icikit import obs
+from icikit.fleet.kvbridge import BlockBridge
+from icikit.fleet.transport import RpcServer
+from icikit.serve.scheduler import RequestQueue
+from icikit.serve.store import PrefixStore
+
+ROLES = ("prefill", "decode", "both")
+
+DEFAULT_HEARTBEAT_TIMEOUT_S = 60.0
+
+
+class Coordinator:
+    """Owns the queue, the engine registry, the block bridge, and the
+    RPC surface the engine workers speak.
+
+    ``store_dir`` backs the bridge with a real on-disk
+    :class:`PrefixStore` — which is what makes the bridge a
+    *persistent* fleet tier: a restarted coordinator re-serves every
+    block the previous life persisted (the restart-rewarm drill in
+    ``tests/test_fleet.py``).
+    """
+
+    def __init__(self, store_dir, lease_s: float = 5.0,
+                 heartbeat_timeout_s: float =
+                 DEFAULT_HEARTBEAT_TIMEOUT_S,
+                 reap_interval_s: float = 0.25,
+                 defect_threshold: int = 1,
+                 host: str = "127.0.0.1", port: int = 0):
+        self.queue = RequestQueue(lease_s=lease_s)
+        self.bridge = BlockBridge(PrefixStore(store_dir))
+        self.heartbeat_timeout_s = heartbeat_timeout_s
+        self.defect_threshold = defect_threshold
+        self._lock = threading.Lock()
+        self._engines: dict = {}    # id -> {role,state,last_seen,...}
+        self._owner: dict = {}      # rid -> engine id of live claim
+        self._phase: dict = {}      # rid -> "prefill"|"decode"|"any"
+        self.n_handoffs = 0
+        self._hold = False
+        self._stop = threading.Event()
+        self.server = RpcServer(self._handle, host=host, port=port)
+        self.addr = self.server.addr
+        self._reaper = threading.Thread(
+            target=self._reap_loop, args=(reap_interval_s,),
+            daemon=True, name="fleet-reaper")
+        self._reaper.start()
+
+    # -- client side (the bench / the driving process) ---------------
+
+    def submit(self, prompt, n_new: int, **kw) -> str:
+        """Queue one request. With disaggregation active (the registry
+        holds a dedicated prefill engine AND a decode-capable one),
+        the request enters prefill phase; otherwise any-role."""
+        rid = self.queue.submit(prompt, n_new, **kw)
+        with self._lock:
+            roles = {e["role"] for e in self._engines.values()
+                     if e["state"] == "live"}
+            disagg = "prefill" in roles and (
+                "decode" in roles or "both" in roles)
+            self._phase[rid] = "prefill" if disagg else "any"
+        return rid
+
+    def drained(self) -> bool:
+        return self.queue.drained()
+
+    def hold(self, flag: bool) -> None:
+        """While held, engines are told the queue is NOT drained even
+        when it momentarily is — the bench's warm-up barrier: workers
+        must idle between the warm batch completing and the timed
+        trace's first arrival instead of exiting their run loop."""
+        self._hold = bool(flag)
+
+    def engines(self) -> dict:
+        """Registry snapshot (states/roles/defects) for benches."""
+        with self._lock:
+            return {eid: dict(role=e["role"], state=e["state"],
+                              defects=e["defects"],
+                              stats=dict(e["stats"]))
+                    for eid, e in self._engines.items()}
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        self.server.close()
+
+    # -- eligibility / phases ----------------------------------------
+
+    def _eligible(self, rid: str, role: str, has_prefill: bool,
+                  has_decode: bool) -> bool:
+        """Role-eligibility for one queued request. Runs under the
+        QUEUE lock (the claim predicate), so the registry facts it
+        needs (``has_prefill``/``has_decode`` = does any live engine
+        of that capability remain) are snapshotted by the caller
+        under the coordinator lock BEFORE the claim — never read
+        here (the locks must not nest queue→coordinator; _untrack
+        nests the other way). The degraded modes keep the fleet
+        LIVE: when the last prefill-capable engine dies, decode
+        engines may serve prefill-phase requests to completion (a
+        full-token handoff finishes in one hop), and symmetrically —
+        a stranded phase must never hang the queue."""
+        phase = self._phase.get(rid, "any")
+        if phase == "prefill":
+            return role in ("prefill", "both") or not has_prefill
+        # decode phase and undisaggregated requests both want an
+        # engine that can run the request to completion
+        return role in ("decode", "both") or not has_decode
+
+    def _serialize_claim(self, req, role: str) -> dict:
+        remaining = req.n_new - len(req.tokens)
+        phase = self._phase.get(req.rid, "any")
+        if phase == "prefill" and role == "prefill":
+            # the DistServe split: prefill + first token, then handoff
+            remaining = 1
+        return {"rid": req.rid,
+                "prompt": np.asarray(req.prompt).tolist(),
+                "n_new": int(remaining),
+                "eos_id": req.eos_id,
+                "checksum": req.checksum,
+                "quant": bool(req.quant),
+                "seed": int(req.seed),
+                "temperature": float(req.temperature),
+                "top_k": int(req.top_k),
+                "top_p": float(req.top_p),
+                "max_retries": int(req.max_retries),
+                "claim_seq": int(req.claim_seq),
+                "attempts": int(req.attempts),
+                "arrival_t": float(req.arrival_t),
+                "admit_t": req.admit_t,
+                "prefix_hit_tokens": 0,
+                "phase": phase,
+                "trace_id": req.trace.trace_id}
+
+    # -- RPC handler -------------------------------------------------
+
+    def _handle(self, op: str, msg: dict, blobs):
+        if op is None:
+            raise ValueError("message without an op")
+        if op.startswith("store."):
+            self._touch(msg.get("engine"))
+            return self.bridge.handle(op, msg, blobs)
+        fn = getattr(self, "_op_" + op, None)
+        if fn is None:
+            raise ValueError(f"unknown fleet op {op!r}")
+        return fn(msg, blobs)
+
+    def _touch(self, engine_id) -> None:
+        if engine_id is None:
+            return
+        with self._lock:
+            e = self._engines.get(engine_id)
+            if e is not None:
+                e["last_seen"] = time.monotonic()
+
+    def _op_hello(self, msg, blobs):
+        engine_id, role = msg["engine"], msg["role"]
+        if role not in ROLES:
+            raise ValueError(f"unknown role {role!r} (known: {ROLES})")
+        with self._lock:
+            self._engines[engine_id] = {
+                "role": role, "state": "live",
+                "last_seen": time.monotonic(), "defects": 0,
+                "stats": {}}
+        obs.count("fleet.engine.registered")
+        obs.emit("fleet.engine.registered", engine=engine_id,
+                 role=role)
+        self._gauges()
+        return {"lease_s": self.queue.lease_s}, ()
+
+    def _op_claim(self, msg, blobs):
+        engine_id = msg["engine"]
+        self._touch(engine_id)
+        with self._lock:
+            e = self._engines.get(engine_id)
+            if e is None or e["state"] != "live":
+                return {"req": None,
+                        "denied": e["state"] if e else "unknown"}, ()
+            role = e["role"]
+            live = [x["role"] for x in self._engines.values()
+                    if x["state"] == "live"]
+        has_prefill = any(r in ("prefill", "both") for r in live)
+        has_decode = any(r in ("decode", "both") for r in live)
+        req = self.queue.claim(
+            accept=lambda r: self._eligible(r.rid, role, has_prefill,
+                                            has_decode))
+        if req is None:
+            return {"req": None}, ()
+        # serialize BEFORE any possible expire below: the wire claim
+        # must carry THIS claim's generation — an expire-then-reissue
+        # bumps claim_seq, and serializing after it could hand the
+        # stale engine the live generation
+        wire = self._serialize_claim(req, role)
+        with self._lock:
+            self._owner[req.rid] = engine_id
+            still_live = self._engines[engine_id]["state"] == "live"
+        if not still_live:
+            # a quarantine/death raced the claim between the state
+            # check and the owner registration: its rid escaped the
+            # force-expire sweep, so expire it NOW — the engine still
+            # receives the claim, but its generation is already
+            # invalid and every mutation it sends fences out
+            self.queue.expire([req.rid])
+        obs.count("fleet.claims")
+        return {"req": wire}, ()
+
+    def _op_renew(self, msg, blobs):
+        self._touch(msg["engine"])
+        self.queue.renew(msg["rid"], seq=msg.get("seq"))
+        return {}, ()
+
+    def _stamp_marks(self, req, marks: dict) -> None:
+        """Fold engine-side SLO marks onto the authoritative Request
+        (only after a successful, fenced commit — stale engines never
+        reach here). Monotonic times are cross-process comparable on
+        one host (CLOCK_MONOTONIC is machine-wide)."""
+        if not marks:
+            return
+        if req.admit_t is None and marks.get("admit_t") is not None:
+            req.admit_t = float(marks["admit_t"])
+        if (req.first_token_t is None
+                and marks.get("first_token_t") is not None):
+            req.first_token_t = float(marks["first_token_t"])
+        if marks.get("max_gap_ms") is not None:
+            req.max_gap_ms = max(req.max_gap_ms or 0.0,
+                                 float(marks["max_gap_ms"]))
+        if marks.get("prefix_hit_tokens"):
+            req.prefix_hit_tokens += int(marks["prefix_hit_tokens"])
+
+    def _op_complete(self, msg, blobs):
+        engine_id, rid = msg["engine"], msg["rid"]
+        seq = msg.get("seq")
+        tokens = [int(t) for t in msg["tokens"]]
+        self._touch(engine_id)
+        req = self.queue.request(rid)
+        # the commit decision is TOKEN ARITHMETIC, never the phase
+        # map: the authoritative stream is the handoff-committed
+        # prefix (req.tokens — empty before any handoff; only our
+        # live lease can be mutating it, stale callers fence out
+        # below) plus this engine's continuation. A partial stream
+        # hands off; a complete one terminates. The phase map only
+        # drives claim ELIGIBILITY and the prefill n_new clamp, where
+        # a racy read costs at most one extra handoff hop — it can
+        # never truncate a committed result.
+        full = list(req.tokens) + tokens
+        finished = (len(full) >= req.n_new
+                    or (req.eos_id is not None and tokens
+                        and tokens[-1] == req.eos_id))
+        if not finished:
+            state = self.queue.handoff(rid, tokens, seq=seq)
+            if state == "stale":
+                return {"state": "stale", "committed": False}, ()
+            self._stamp_marks(req, msg.get("marks"))
+            if state == "queued":
+                with self._lock:
+                    self._phase[rid] = "decode"
+                    self.n_handoffs += 1
+                    self._owner.pop(rid, None)
+                obs.count("fleet.handoffs")
+            else:
+                self._untrack(rid)
+            return {"state": state, "committed": True}, ()
+        committed = self.queue.complete(rid, full, seq=seq)
+        if committed:
+            self._stamp_marks(req, msg.get("marks"))
+            self._untrack(rid)
+        return {"state": req.state, "committed": committed}, ()
+
+    def _op_fail(self, msg, blobs):
+        engine_id, rid = msg["engine"], msg["rid"]
+        self._touch(engine_id)
+        exc = RuntimeError(msg.get("error", "engine failure"))
+        state = self.queue.fail(rid, exc,
+                                retry=bool(msg.get("retry", True)),
+                                seq=msg.get("seq"))
+        if state != "stale":
+            self._untrack(rid, requeued=state == "queued")
+        if msg.get("etype") == "IntegrityError":
+            # "host computes garbage": the sealed-page checksums on
+            # THIS engine's completions failed — that is the defect
+            # signal, distinct from death (lease expiry) and from
+            # content rot on the bridge (purged + recomputed, no
+            # engine blamed)
+            self._defect(engine_id, msg.get("error", ""))
+        return {"state": state}, ()
+
+    def _op_release(self, msg, blobs):
+        self._touch(msg["engine"])
+        self.queue.release(msg["rid"],
+                           delay=float(msg.get("delay", 0.0)),
+                           seq=msg.get("seq"))
+        self._untrack(msg["rid"], requeued=True)
+        return {}, ()
+
+    def _op_report(self, msg, blobs):
+        """Heartbeat + per-engine snapshot: keeps ``last_seen`` fresh
+        independent of the engine loop (XLA compiles stall renewals,
+        not the report thread) and aggregates fleet SLO gauges."""
+        engine_id = msg["engine"]
+        with self._lock:
+            e = self._engines.get(engine_id)
+            if e is None:
+                return {"state": "unknown"}, ()
+            e["last_seen"] = time.monotonic()
+            e["stats"] = {k: msg.get(k) for k in
+                          ("tokens", "steps", "occupancy",
+                           "integrity_failures")
+                          if msg.get(k) is not None}
+            state = e["state"]
+        return {"state": state}, ()
+
+    def _op_drained(self, msg, blobs):
+        return {"drained": self.queue.drained()
+                and not self._hold}, ()
+
+    def _op_next_visible(self, msg, blobs):
+        return {"wait": self.queue.next_visible_in()}, ()
+
+    def _op_pending_prompts(self, msg, blobs):
+        return {"prompts": [np.asarray(p).tolist()
+                            for p in self.queue.pending_prompts()]}, ()
+
+    def _op_bye(self, msg, blobs):
+        with self._lock:
+            e = self._engines.get(msg["engine"])
+            if e is not None and e["state"] == "live":
+                e["state"] = "gone"
+        self._gauges()
+        return {}, ()
+
+    # -- defect / death handling -------------------------------------
+
+    def _untrack(self, rid: str, requeued: bool = False) -> None:
+        with self._lock:
+            self._owner.pop(rid, None)
+            if not requeued and self.queue.request(rid).state in (
+                    "done", "failed"):
+                self._phase.pop(rid, None)
+
+    def _rids_of(self, engine_id: str) -> list:
+        with self._lock:
+            return [rid for rid, eid in self._owner.items()
+                    if eid == engine_id]
+
+    def _defect(self, engine_id: str, reason: str) -> None:
+        with self._lock:
+            e = self._engines.get(engine_id)
+            if e is None:
+                return
+            e["defects"] += 1
+            quarantine = (e["defects"] >= self.defect_threshold
+                          and e["state"] == "live")
+            if quarantine:
+                e["state"] = "quarantined"
+        if not quarantine:
+            return
+        # drain -> quarantine -> reissue: no new leases for this
+        # engine (claims denied), and its in-flight work force-expires
+        # to survivors NOW — its late commits are already fenced by
+        # claim seq, so the reissue replays bitwise elsewhere
+        reaped = self.queue.expire(self._rids_of(engine_id))
+        obs.count("fleet.engine.quarantined")
+        obs.emit("fleet.engine.quarantined", engine=engine_id,
+                 reason=reason, reissued=reaped)
+        self._gauges()
+
+    def _reap_loop(self, interval: float) -> None:
+        while not self._stop.wait(interval):
+            self.queue.reap_expired()
+            now = time.monotonic()
+            dead = []
+            with self._lock:
+                for eid, e in self._engines.items():
+                    if (e["state"] == "live" and
+                            now - e["last_seen"]
+                            > self.heartbeat_timeout_s):
+                        e["state"] = "dead"
+                        dead.append(eid)
+            for eid in dead:
+                obs.count("fleet.engine.dead")
+                obs.emit("fleet.engine.dead", engine=eid)
+                self.queue.expire(self._rids_of(eid))
+            self._gauges()
+
+    def _gauges(self) -> None:
+        with self._lock:
+            alive = sum(e["state"] == "live"
+                        for e in self._engines.values())
+            quarantined = sum(e["state"] == "quarantined"
+                              for e in self._engines.values())
+        obs.gauge("fleet.engines.alive", float(alive))
+        obs.gauge("fleet.engines.quarantined", float(quarantined))
+        obs.gauge("fleet.pending", float(self.queue.pending()))

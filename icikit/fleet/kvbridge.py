@@ -1,0 +1,206 @@
+"""Cross-engine KV block bridge: the fleet-shared bottom tier.
+
+The r16 tiered KV cache ends at a per-process ``PrefixStore``; this
+module lifts that tier over the transport so N engines share ONE
+content-addressed block population (ROADMAP item 2b, Mooncake-style):
+
+- the **server half** (:class:`BlockBridge`) lives in the coordinator
+  process and wraps a real :class:`icikit.serve.store.PrefixStore` —
+  blocks on the bridge are chain-hash-named ``.npz`` files with the
+  exact ``serve/store.py`` layout, so a coordinator restart re-serves
+  them (the restart-rewarm drill) and every torn-file/quarantine
+  behavior is inherited, not reimplemented;
+- the **client half** (:class:`BridgeStore`) is *store-shaped*: it
+  duck-types ``PrefixStore`` (``has/get/put/quarantine`` plus the
+  stats surface), so an engine constructed with ``store=BridgeStore``
+  gets demand paging, ``tier_plan``, digest-verified restore,
+  quarantine-and-recompute, drain-time persistence, and
+  ``Engine.rewarm`` against the bridge with ZERO engine changes —
+  the r13/r16 integrity story composes across the process boundary
+  because the content digest rides next to the bytes.
+
+Migration accounting: the bridge remembers which engine pushed each
+hash; a pull by a *different* engine is a cross-engine KV migration
+(``fleet.kv.migrations``) — the quantity the disaggregation bench and
+the fleet smoke assert on.
+
+Verification layering (deliberate, drilled): transport checksums catch
+wire rot frame-by-frame; the ``fleet.kv.pull`` probe below corrupts
+*after* those checksums pass, so the only detector left is the block's
+content-keyed digest at ``KVPool`` swap-in — a mismatch quarantines
+the content from every tier (a bridge-wide ``quarantine`` RPC removes
+the file so no OTHER engine re-pulls the bad bytes), the row
+recomputes fresh, and no retry is burned: the r16 swap-in semantics,
+verbatim, across processes.
+
+Control plane rule: no jax imports here (``fleet-control-plane``).
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+from icikit import chaos, obs
+
+# the migrate-SDC drill site: rot between the coordinator's disk and
+# the pulling engine's arena that the wire checksums cannot see
+chaos.register_site("fleet.kv.pull")
+
+
+def encode_arrays(arrays):
+    """``(meta_list, blobs)`` for a block payload: dtype/shape in the
+    control frame, raw bytes as blob frames."""
+    meta, blobs = [], []
+    for a in arrays:
+        a = np.ascontiguousarray(a)
+        meta.append({"dtype": a.dtype.str, "shape": list(a.shape)})
+        blobs.append(a.tobytes())
+    return meta, blobs
+
+
+def decode_arrays(meta, blobs):
+    out = []
+    for m, b in zip(meta, blobs):
+        out.append(np.frombuffer(b, np.dtype(m["dtype"]))
+                   .reshape(m["shape"]).copy())
+    return out
+
+
+class BlockBridge:
+    """Coordinator-side bridge: a :class:`PrefixStore` plus per-hash
+    writer provenance. ``handle`` is the RPC dispatch surface the
+    coordinator delegates ``store.*`` ops to."""
+
+    def __init__(self, store):
+        self.store = store
+        self._lock = threading.Lock()
+        self._writer: dict = {}      # hash -> engine_id that pushed it
+        self.n_migrations = 0
+        self.n_pushed = 0
+        self.n_pulled = 0
+
+    # -- dispatch ----------------------------------------------------
+
+    def handle(self, op: str, msg: dict, blobs):
+        if op == "store.has":
+            return {"found": self.store.has(msg["h"])}, ()
+        if op == "store.get":
+            return self._get(msg.get("engine", ""), msg["h"])
+        if op == "store.put":
+            return self._put(msg.get("engine", ""), msg["h"],
+                             msg["side"], msg["digest"],
+                             msg["meta"], blobs)
+        if op == "store.quarantine":
+            self.store.quarantine(msg["h"])
+            with self._lock:
+                self._writer.pop(msg["h"], None)
+            obs.count("fleet.kv.quarantined")
+            return {}, ()
+        if op == "store.stats":
+            return self.stats(), ()
+        raise ValueError(f"unknown bridge op {op!r}")
+
+    # -- ops ---------------------------------------------------------
+
+    def _put(self, engine: str, h: str, side: str, digest: str,
+             meta, blobs):
+        arrays = decode_arrays(meta, blobs)
+        wrote = self.store.put(h, side, digest, arrays)
+        if wrote:
+            with self._lock:
+                self._writer[h] = engine
+                self.n_pushed += 1
+            obs.count("fleet.kv.pushed")
+            obs.gauge("fleet.kv.bridge_blocks",
+                      float(self.store.n_blocks()))
+        return {"wrote": wrote}, ()
+
+    def _get(self, engine: str, h: str):
+        rec = self.store.get(h)
+        if rec is None:
+            return {"found": False}, ()
+        side, digest, arrays = rec
+        migrated = False
+        with self._lock:
+            self.n_pulled += 1
+            writer = self._writer.get(h)
+            if writer is not None and writer != engine:
+                self.n_migrations += 1
+                migrated = True
+        obs.count("fleet.kv.pulled")
+        if migrated:
+            obs.count("fleet.kv.migrations")
+        meta, blobs = encode_arrays(arrays)
+        return {"found": True, "side": side, "digest": digest,
+                "meta": meta, "migrated": migrated}, blobs
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"blocks": self.store.n_blocks(),
+                    "pushed": self.n_pushed,
+                    "pulled": self.n_pulled,
+                    "migrations": self.n_migrations,
+                    "quarantined": self.store.n_quarantined}
+
+
+class BridgeStore:
+    """Engine-side, store-shaped client for the coordinator's bridge.
+
+    Duck-types :class:`icikit.serve.store.PrefixStore` exactly as the
+    :class:`KVPool` consumes it — ``has``/``get``/``put``/
+    ``quarantine`` plus the ``n_blocks()/n_writes/n_reads/
+    n_quarantined`` stats surface — so it plugs into
+    ``Engine(store=...)`` unchanged. All payload verification stays in
+    the pool (digest at swap-in): this client only moves bytes and
+    applies the ``fleet.kv.pull`` SDC probe after the transport has
+    vouched for the wire."""
+
+    def __init__(self, client, engine_id: str):
+        self._client = client
+        self.engine_id = engine_id
+        self.n_writes = 0
+        self.n_reads = 0
+        self.n_quarantined = 0
+
+    def has(self, h: str) -> bool:
+        reply, _ = self._client.call("store.has", {"h": h})
+        return bool(reply["found"])
+
+    def n_blocks(self) -> int:
+        reply, _ = self._client.call("store.stats")
+        return int(reply["blocks"])
+
+    def put(self, h: str, side: str, digest: str, arrays) -> bool:
+        meta, blobs = encode_arrays(arrays)
+        reply, _ = self._client.call(
+            "store.put", {"engine": self.engine_id, "h": h,
+                          "side": side, "digest": digest,
+                          "meta": meta}, blobs)
+        if reply["wrote"]:
+            self.n_writes += 1
+        return bool(reply["wrote"])
+
+    def get(self, h: str):
+        reply, blobs = self._client.call(
+            "store.get", {"engine": self.engine_id, "h": h})
+        if not reply["found"]:
+            return None
+        arrays = decode_arrays(reply["meta"], blobs)
+        # the migrate-SDC drill boundary: past the wire checksums,
+        # before the pool's swap-in digest verify — the only detector
+        # for a flip HERE is the content digest, which is the point
+        arrays[0] = chaos.maybe_corrupt("fleet.kv.pull", arrays[0])
+        self.n_reads += 1
+        return reply["side"], reply["digest"], arrays
+
+    def quarantine(self, h: str) -> None:
+        """Bridge-wide: the file leaves the coordinator's store so no
+        OTHER engine can re-pull the corrupt content either."""
+        try:
+            self._client.call("store.quarantine", {"h": h})
+        except (ConnectionError, OSError):
+            pass     # quarantine is advisory cleanup; recompute wins
+        self.n_quarantined += 1
+        obs.count("serve.store.quarantined")

@@ -1,0 +1,304 @@
+"""Engine-side fleet glue: the queue-shaped RPC proxy + role workers.
+
+The design move that keeps this PR small relative to what it does:
+``serve.Engine`` never learns it is in a fleet. It is constructed with
+a **queue-shaped** object (:class:`RemoteQueue` — every verb the
+engine calls forwards over the transport to the coordinator's real
+:class:`RequestQueue`, fenced by the same claim generations) and a
+**store-shaped** object (``kvbridge.BridgeStore``), and every
+single-process behavior — admission, chunked prefill, tier restore
+with digest verify, speculation, integrity verify at completion —
+composes across the process boundary unchanged.
+
+Roles:
+
+- ``"both"`` — a full engine: claims any-phase and decode-phase work.
+- ``"prefill"`` — claims prefill-phase work only; the coordinator
+  clamps its claims to ``n_new=1`` (prefill + first token), and this
+  worker pushes the request's finalized sealed blocks to the block
+  bridge BEFORE sending ``complete`` — so by the time the coordinator
+  hands the request off, a decode engine's admission already finds
+  the chain on the bridge and *migrates* it instead of recomputing.
+- ``"decode"`` — claims decode-phase (and undisaggregated) work; its
+  pool's ``tier_plan`` consults the bridge, pulls blocks over the
+  transport, re-verifies each content-keyed seal at swap-in
+  (mismatch: quarantine bridge-wide, recompute fresh, no retry
+  burned), and adopts them through the ordinary restore path.
+
+``fleet.engine.die`` is the cross-process chaos boundary: it fires
+inside the per-step lease renewal, i.e. mid-decode — the p−1-survive
+soak kills workers there and the reissued work must replay bitwise on
+survivors.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+
+from icikit import chaos, obs
+from icikit.fleet.kvbridge import BridgeStore
+from icikit.fleet.transport import RpcClient, RpcError
+from icikit.obs import trace_ctx
+from icikit.serve.scheduler import Request
+
+chaos.register_site("fleet.engine.die")
+
+
+class RemoteQueue:
+    """Queue-shaped proxy over the coordinator RPC surface.
+
+    Local bookkeeping mirrors just enough for the engine's host loop:
+    claimed requests live in ``_local`` until a terminal RPC settles
+    them, ``done``/``failed`` hold THIS engine's commits (``run()``
+    returns their delta — per-engine completion counts), and SLO marks
+    stamped by the engine on its local copy ride the complete RPC to
+    the coordinator's authoritative Request. ``reap_expired`` is a
+    no-op: lease reaping is the coordinator's job, engines only renew.
+    """
+
+    def __init__(self, client: RpcClient, engine_id: str):
+        self._client = client
+        self.engine_id = engine_id
+        self._local: dict = {}
+        self.done: dict = {}
+        self.failed: dict = {}
+        self.n_integrity_fails = 0
+        # the engine completes/fails through this hook BEFORE the RPC
+        # lands: the prefill worker pushes its sealed chain here so
+        # the bridge holds the blocks before the handoff requeues the
+        # request
+        self.on_complete = None
+
+    def _call(self, op: str, extra: dict | None = None):
+        msg = {"engine": self.engine_id}
+        if extra:
+            msg.update(extra)
+        reply, _ = self._client.call(op, msg)
+        return reply
+
+    # -- engine verbs -------------------------------------------------
+
+    def claim(self) -> Request | None:
+        reply = self._call("claim")
+        w = reply.get("req")
+        if w is None:
+            return None
+        req = Request(
+            rid=w["rid"],
+            prompt=np.asarray(w["prompt"], np.int32),
+            n_new=int(w["n_new"]),
+            checksum=w["checksum"], eos_id=w["eos_id"],
+            quant=bool(w["quant"]), seed=int(w["seed"]),
+            temperature=float(w["temperature"]),
+            top_k=int(w["top_k"]), top_p=float(w["top_p"]),
+            max_retries=int(w["max_retries"]),
+            state="running", attempts=int(w["attempts"]),
+            claim_seq=int(w["claim_seq"]),
+            visible_after=float(w["arrival_t"]),
+            arrival_t=float(w["arrival_t"]))
+        if w.get("admit_t") is not None:
+            # a decode-phase claim keeps the prefill phase's admission
+            # mark: the SLO record is per-request, not per-attempt
+            req.admit_t = float(w["admit_t"])
+        # the trace id rode the RPC: engine-side spans/instants land
+        # under the SAME async track as the coordinator's root/attempt
+        # spans — one request, one tree, across processes
+        req.trace = trace_ctx.adopt(w["rid"], w["trace_id"],
+                                    int(w["claim_seq"]))
+        self._local[req.rid] = req
+        return req
+
+    def renew(self, rid: str, seq: int | None = None) -> None:
+        # the kill-drill boundary: fires mid-decode, between steps —
+        # the process dies holding live leases, which is exactly the
+        # abandonment the coordinator's reaper must heal
+        chaos.maybe_die("fleet.engine.die")
+        self._call("renew", {"rid": rid, "seq": seq})
+
+    def _marks(self, req: Request) -> dict:
+        return {"admit_t": req.admit_t,
+                "first_token_t": req.first_token_t,
+                "max_gap_ms": req.max_gap_ms,
+                "prefix_hit_tokens": req.prefix_hit_tokens}
+
+    def complete(self, rid: str, tokens,
+                 seq: int | None = None) -> bool:
+        req = self._local.get(rid)
+        tokens = [int(t) for t in tokens]
+        if req is not None:
+            req.tokens = tokens
+            req.done_t = time.monotonic()
+            if self.on_complete is not None:
+                self.on_complete(req, tokens)
+        reply = self._call("complete", {
+            "rid": rid, "seq": seq, "tokens": tokens,
+            "marks": self._marks(req) if req is not None else {}})
+        committed = bool(reply.get("committed"))
+        if committed and req is not None:
+            req.state = "done"
+            self.done[rid] = self._local.pop(rid)
+        return committed
+
+    def fail(self, rid: str, exc: BaseException, retry: bool = True,
+             seq: int | None = None) -> str:
+        etype = type(exc).__name__
+        if etype == "IntegrityError":
+            self.n_integrity_fails += 1
+        reply = self._call("fail", {
+            "rid": rid, "seq": seq, "error": repr(exc),
+            "etype": etype, "retry": bool(retry)})
+        state = reply.get("state", "stale")
+        req = self._local.pop(rid, None)
+        if state == "failed" and req is not None:
+            req.state = "failed"
+            req.error = repr(exc)
+            self.failed[rid] = req
+        return state
+
+    def release(self, rid: str, delay: float = 0.0,
+                seq: int | None = None) -> None:
+        self._call("release", {"rid": rid, "seq": seq,
+                               "delay": float(delay)})
+        self._local.pop(rid, None)
+
+    # -- loop support -------------------------------------------------
+
+    def reap_expired(self) -> list:
+        return []       # the coordinator's reaper owns lease expiry
+
+    def drained(self) -> bool:
+        return bool(self._call("drained")["drained"])
+
+    def next_visible_in(self):
+        return self._call("next_visible")["wait"]
+
+    def pending_prompts(self) -> list:
+        return [np.asarray(p, np.int32)
+                for p in self._call("pending_prompts")["prompts"]]
+
+    def request(self, rid: str) -> Request:
+        for table in (self._local, self.done, self.failed):
+            if rid in table:
+                return table[rid]
+        raise KeyError(f"{rid} is not resident on engine "
+                       f"{self.engine_id}")
+
+
+class EngineWorker:
+    """One fleet engine: a ``serve.Engine`` wired to the coordinator.
+
+    Heartbeats run on their OWN thread and connection
+    (``report_interval_s``): an XLA compile stalls the engine loop's
+    renewals for seconds, and declaring a merely-slow engine dead
+    would churn reissues — the report thread keeps ``last_seen``
+    honest about process liveness specifically.
+    """
+
+    def __init__(self, addr, engine_id: str, role: str,
+                 params, mesh, cfg, serve_cfg,
+                 report_interval_s: float = 0.5,
+                 rewarm: bool = False):
+        from icikit.serve.engine import Engine
+        self.engine_id = engine_id
+        self.role = role
+        self.addr = tuple(addr)
+        self.client = RpcClient(self.addr)
+        reply, _ = self.client.call("hello", {"engine": engine_id,
+                                              "role": role})
+        self.queue = RemoteQueue(self.client, engine_id)
+        self.bridge = BridgeStore(self.client, engine_id)
+        if not serve_cfg.prefix_cache:
+            raise ValueError(
+                "fleet engines require prefix_cache=True: the KV "
+                "bridge is consumed through the content-addressed "
+                "index (tier_plan/restore), which does not exist "
+                "with the cache off")
+        self.engine = Engine(params, mesh, cfg, serve_cfg,
+                             queue=self.queue, store=self.bridge)
+        if role == "prefill":
+            # stream finalized sealed blocks to the bridge BEFORE the
+            # complete RPC triggers the handoff: the decode engine's
+            # admission must find the chain already bridged
+            self.queue.on_complete = self._push_chain
+        self.report_interval_s = report_interval_s
+        self._stop = threading.Event()
+        self._report_thread: threading.Thread | None = None
+        # restart-rewarm hook: pull the pending prompts' chains from
+        # the bridge into the CACHED state before the first claim
+        self.rewarm_blocks = (
+            self.engine.rewarm(self.queue.pending_prompts())
+            if rewarm else 0)
+
+    def _push_chain(self, req: Request, tokens) -> None:
+        n = self.engine.export_chain(
+            np.concatenate([req.prompt,
+                            np.asarray(tokens, np.int32)]))
+        if n:
+            req.trace.instant("serve.req.bridged", seq=req.claim_seq,
+                              blocks=n)
+
+    def _report_loop(self) -> None:
+        client = RpcClient(self.addr)
+        try:
+            while not self._stop.wait(self.report_interval_s):
+                try:
+                    # list() snapshots the dict in one GIL-atomic C
+                    # call: the engine thread inserts into done
+                    # concurrently, and a generator iterating it
+                    # would raise mid-report — killing the heartbeat
+                    # thread and getting a HEALTHY engine declared
+                    # dead at the timeout
+                    done = list(self.queue.done.values())
+                    client.call("report", {
+                        "engine": self.engine_id,
+                        "tokens": sum(len(r.tokens) for r in done),
+                        "steps": self.engine.n_steps,
+                        "occupancy": self.engine.occupancy_mean(),
+                        "integrity_failures":
+                            self.queue.n_integrity_fails})
+                except (ConnectionError, OSError, RpcError):
+                    return      # coordinator gone: the loop will see
+                except Exception:   # noqa: BLE001 - heartbeat must
+                    continue        # outlive any stats hiccup
+        finally:
+            client.close()
+
+    def run(self, drain: bool = True, max_steps: int | None = None):
+        """Serve until the coordinator's queue drains. Returns this
+        engine's completed-request count. An ``InjectedDeath`` from
+        ``fleet.engine.die`` propagates — the worker process exits
+        holding its leases, which is the drill."""
+        self._report_thread = threading.Thread(
+            target=self._report_loop, daemon=True,
+            name=f"fleet-report-{self.engine_id}")
+        self._report_thread.start()
+        clean = False
+        try:
+            out = self.engine.run(drain=drain, max_steps=max_steps)
+            clean = True
+            return out
+        finally:
+            self._stop.set()
+            if clean:
+                # a DYING worker must not say goodbye: death is
+                # detected by heartbeat/lease expiry, that is the drill
+                try:
+                    self.queue._call("bye")
+                except (ConnectionError, OSError, RpcError):
+                    pass
+
+    def close(self) -> None:
+        self._stop.set()
+        self.client.close()
+
+
+def engine_stats(worker: EngineWorker) -> dict:
+    """Per-engine bench snapshot (records carry one per worker)."""
+    return {"engine": worker.engine_id, "role": worker.role,
+            "completed": len(worker.queue.done),
+            "steps": worker.engine.n_steps,
+            "occupancy_mean": worker.engine.occupancy_mean(),
+            "prefix": worker.engine.prefix_stats()}

@@ -1,0 +1,288 @@
+"""Host-side fleet transport: length-prefixed frames, checksums, RPC.
+
+The fleet control plane cannot ride device collectives — this image's
+jaxlib has no CPU multiprocess collectives ("Multiprocess computations
+aren't implemented", the multihost bring-up skip), and a control plane
+that *could* use them still must not: coordinator traffic (claims,
+lease renewals, quarantine reports) has to keep flowing while a
+defective engine's device schedules are exactly what is under
+suspicion. So the seam is plain TCP on the host, with the repo's
+integrity discipline applied to the wire:
+
+- **framing** — every frame is ``MAGIC | u64 length | payload |
+  blake2b-128(payload)``. The magic catches stream desync (a corrupted
+  length prefix), the trailing digest catches payload rot in flight:
+  a flipped wire byte is *detected mechanically* at receive
+  (:class:`ChecksumError`), never parsed. One message = one strict-JSON
+  control frame (``allow_nan=False`` — the bus's NaN rule, hardened to
+  a parse error) followed by ``msg["blobs"]`` raw binary frames (KV
+  block payloads ride here; base64-in-JSON would double the bytes).
+- **bounded reconnect** — the client retries a failed call on a fresh
+  connection with bounded exponential backoff (the ``chaos.io_retry``
+  policy shape). Every fleet RPC is at-least-once safe by construction:
+  queue mutations are idempotent/fenced (claim-seq), store puts are
+  content-addressed, so a lost reply costs a retry, never corruption.
+- **chaos sites** — ``fleet.rpc.send`` (delay / die / corrupt the
+  outbound payload *after* its digest: wire rot, which the receiver's
+  checksum must catch) and ``fleet.rpc.recv`` (corrupt the inbound
+  payload *before* verification: same detection path from the other
+  end). End-to-end content rot that never touches the wire is the KV
+  bridge's ``fleet.kv.pull`` site — only the content digest catches
+  that one, by design.
+
+Control plane rule (enforced by the ``fleet-control-plane`` analysis
+rule): this module never imports jax — no device dispatch, no jnp
+allocation. numpy appears only to hand ``chaos.maybe_corrupt`` a byte
+view.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import socket
+import struct
+import threading
+import time
+
+import numpy as np
+
+from icikit import chaos, obs
+
+chaos.register_site("fleet.rpc.send", "fleet.rpc.recv")
+
+MAGIC = b"icfl"
+_LEN = struct.Struct(">Q")
+DIGEST_BYTES = 16
+# a corrupted length prefix must fail loudly, not allocate garbage
+MAX_FRAME = 1 << 31
+
+
+class TransportError(ConnectionError):
+    """Structural failure on the fleet wire (desync, short read)."""
+
+
+class ChecksumError(TransportError):
+    """A frame's payload failed its blake2b re-verify at receive —
+    wire corruption, detected mechanically."""
+
+
+class RpcError(RuntimeError):
+    """The remote handler raised; ``etype`` carries the remote
+    exception type name so callers can dispatch on it."""
+
+    def __init__(self, msg: str, etype: str = "RuntimeError"):
+        super().__init__(msg)
+        self.etype = etype
+
+
+def _digest(payload: bytes) -> bytes:
+    return hashlib.blake2b(payload, digest_size=DIGEST_BYTES).digest()
+
+
+def _maybe_corrupt_bytes(site: str, payload: bytes) -> bytes:
+    """Route payload bytes through the SDC probe (zero-copy when the
+    plan is cold — the common case is `is`-identity and no copy)."""
+    if chaos.active() is None:
+        return payload
+    arr = np.frombuffer(payload, np.uint8)
+    out = chaos.maybe_corrupt(site, arr)
+    return payload if out is arr else out.tobytes()
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(min(n - len(buf), 1 << 20))
+        if not chunk:
+            raise TransportError(
+                f"connection closed mid-frame ({len(buf)}/{n} bytes)")
+        buf += chunk
+    return bytes(buf)
+
+
+def send_frame(sock: socket.socket, payload: bytes) -> None:
+    chaos.maybe_delay("fleet.rpc.send")
+    chaos.maybe_die("fleet.rpc.send")
+    digest = _digest(payload)
+    # the corruption probe sits AFTER the digest: it models rot on the
+    # wire, which the receiver's re-verify must detect — the drill in
+    # tests/test_fleet_transport.py asserts exactly that
+    payload = _maybe_corrupt_bytes("fleet.rpc.send", payload)
+    sock.sendall(MAGIC + _LEN.pack(len(payload)) + payload + digest)
+
+
+def recv_frame(sock: socket.socket) -> bytes:
+    head = _recv_exact(sock, len(MAGIC) + _LEN.size)
+    if head[:len(MAGIC)] != MAGIC:
+        raise TransportError("frame desync: bad magic")
+    (n,) = _LEN.unpack(head[len(MAGIC):])
+    if n > MAX_FRAME:
+        raise TransportError(f"frame length {n} exceeds cap")
+    payload = _recv_exact(sock, n)
+    digest = _recv_exact(sock, DIGEST_BYTES)
+    chaos.maybe_delay("fleet.rpc.recv")
+    payload = _maybe_corrupt_bytes("fleet.rpc.recv", payload)
+    if _digest(payload) != digest:
+        obs.count("fleet.rpc.checksum_failures")
+        raise ChecksumError("frame payload failed checksum re-verify")
+    return payload
+
+
+def send_msg(sock: socket.socket, msg: dict, blobs=()) -> None:
+    """One message: a strict-JSON control frame announcing
+    ``blobs`` raw frames, then the frames themselves."""
+    msg = dict(msg)
+    msg["blobs"] = len(blobs)
+    send_frame(sock, json.dumps(msg, allow_nan=False).encode())
+    for b in blobs:
+        send_frame(sock, bytes(b))
+
+
+def recv_msg(sock: socket.socket):
+    head = recv_frame(sock)
+    try:
+        msg = json.loads(head.decode())
+    except (UnicodeDecodeError, json.JSONDecodeError) as e:
+        raise TransportError(f"control frame is not strict JSON: {e}")
+    if not isinstance(msg, dict):
+        raise TransportError("control frame must be a JSON object")
+    blobs = [recv_frame(sock) for _ in range(int(msg.pop("blobs", 0)))]
+    return msg, blobs
+
+
+class RpcServer:
+    """Threaded request/reply server over the frame protocol.
+
+    ``handler(op, msg, blobs) -> (reply_dict, reply_blobs)`` runs on a
+    per-connection thread; an exception becomes an error reply
+    (``ok: False``) raised client-side as :class:`RpcError`, and the
+    connection survives. A frame-level failure (desync, checksum)
+    drops the connection — the client reconnects; at-least-once RPC
+    semantics are the contract (see module docstring)."""
+
+    def __init__(self, handler, host: str = "127.0.0.1",
+                 port: int = 0):
+        from icikit.utils.net import server_socket
+        self._handler = handler
+        self._sock = server_socket(host, port)
+        self.addr = self._sock.getsockname()
+        self._stop = threading.Event()
+        self._conns: list = []
+        self._lock = threading.Lock()
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True,
+            name="fleet-rpc-accept")
+        self._accept_thread.start()
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return          # socket closed: shutdown
+            with self._lock:
+                self._conns.append(conn)
+            threading.Thread(target=self._serve_conn, args=(conn,),
+                             daemon=True,
+                             name="fleet-rpc-conn").start()
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        try:
+            while not self._stop.is_set():
+                try:
+                    msg, blobs = recv_msg(conn)
+                except (TransportError, OSError):
+                    return      # drop the connection; client retries
+                op = msg.pop("op", None)
+                try:
+                    reply, rblobs = self._handler(op, msg, blobs)
+                    reply = {"ok": True, **(reply or {})}
+                except Exception as e:  # noqa: BLE001 - wire boundary
+                    obs.count("fleet.rpc.errors")
+                    reply, rblobs = {"ok": False, "error": str(e),
+                                     "etype": type(e).__name__}, ()
+                try:
+                    send_msg(conn, reply, rblobs)
+                except (TransportError, OSError):
+                    return
+        finally:
+            conn.close()
+
+    def close(self) -> None:
+        self._stop.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        with self._lock:
+            conns, self._conns = self._conns, []
+        for c in conns:
+            try:
+                c.close()
+            except OSError:
+                pass
+
+
+class RpcClient:
+    """One connection to an :class:`RpcServer` with bounded
+    reconnect-and-retry. ``call`` is serialized under a lock (one
+    outstanding RPC per connection — the engine loop is single-
+    threaded; the report thread opens its own client)."""
+
+    def __init__(self, addr, retries: int = 3,
+                 first_backoff: float = 0.05,
+                 connect_timeout: float = 5.0):
+        self.addr = tuple(addr)
+        self.retries = retries
+        self.first_backoff = first_backoff
+        self.connect_timeout = connect_timeout
+        self._lock = threading.Lock()
+        self._sock: socket.socket | None = None
+
+    def _connect(self) -> socket.socket:
+        if self._sock is None:
+            s = socket.create_connection(
+                self.addr, timeout=self.connect_timeout)
+            s.settimeout(None)
+            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._sock = s
+        return self._sock
+
+    def _drop(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+    def call(self, op: str, msg: dict | None = None, blobs=()):
+        """One RPC round trip -> ``(reply_dict, reply_blobs)``.
+        Transport failures (refused, reset, checksum) retry on a fresh
+        connection with bounded exponential backoff; a remote handler
+        error raises :class:`RpcError` immediately (retrying an
+        application error is the caller's policy, not the wire's)."""
+        payload = {"op": op, **(msg or {})}
+        backoff = self.first_backoff
+        with self._lock:
+            for attempt in range(self.retries + 1):
+                try:
+                    sock = self._connect()
+                    send_msg(sock, payload, blobs)
+                    reply, rblobs = recv_msg(sock)
+                    break
+                except (TransportError, OSError):
+                    self._drop()
+                    if attempt == self.retries:
+                        raise
+                    time.sleep(backoff)
+                    backoff *= 2
+        if not reply.get("ok"):
+            raise RpcError(reply.get("error", "remote error"),
+                           reply.get("etype", "RuntimeError"))
+        return reply, rblobs
+
+    def close(self) -> None:
+        with self._lock:
+            self._drop()

@@ -1,0 +1,90 @@
+"""Fleet engine worker process: ``python -m icikit.fleet.worker cfg.json``.
+
+One OS process = one engine. The config file carries the coordinator
+address, the engine's identity/role, the model recipe, and the serve
+geometry. The model is built DETERMINISTICALLY from the recipe
+(``init_params(jax.random.key(init_seed))`` over the preset config):
+every worker — and the coordinator-side identity audit — holds bitwise
+the same weights without any weight shipping, which is what makes the
+fleet's exit bar ("every completed request bitwise identical to
+single-request generate") checkable from the driving process.
+
+Chaos arming rides the ordinary ``ICIKIT_CHAOS`` env var per worker
+process (the soak arms ``die:fleet.engine.die`` on victims and
+``corrupt:serve.kv.page`` on the defective-engine drill's target), and
+observability rides ``ICIKIT_OBS`` (per-process trace/metrics files).
+
+On a clean drain the worker prints one ``FLEET_WORKER_OK {json}``
+line (the parent's structured handshake, like the multihost bring-up
+worker's ``WORKER_OK``) and exits 0; an injected death propagates and
+exits nonzero holding its leases — the reaper's problem, by design.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+
+def build_model(spec: dict):
+    """``(params, mesh, cfg)`` from a model recipe dict — shared by
+    workers and the coordinator-side audit so both construct bitwise
+    identical weights. Keys: ``preset`` (bench.train.PRESETS name),
+    ``overrides`` (TransformerConfig field overrides, e.g. max_seq),
+    ``compute_dtype``, ``decode_quant``, ``dp``/``tp``,
+    ``init_seed``."""
+    import jax
+
+    from icikit.bench.train import PRESETS
+    from icikit.models.transformer import (
+        TransformerConfig,
+        init_params,
+    )
+    from icikit.models.transformer.model import make_model_mesh
+
+    over = dict(PRESETS[spec.get("preset", "tiny")])
+    over.update(spec.get("overrides") or {})
+    if spec.get("compute_dtype"):
+        over["compute_dtype"] = spec["compute_dtype"]
+    cfg = TransformerConfig(
+        **over, decode_quant=spec.get("decode_quant", "none"))
+    mesh = make_model_mesh(dp=int(spec.get("dp", 1)),
+                           tp=int(spec.get("tp", 1)), sp=1)
+    params = init_params(
+        jax.random.key(int(spec.get("init_seed", 0))), cfg, mesh)
+    return params, mesh, cfg
+
+
+def run_worker(config: dict) -> dict:
+    from icikit.fleet.roles import EngineWorker, engine_stats
+    from icikit.serve.engine import ServeConfig
+
+    params, mesh, cfg = build_model(config.get("model") or {})
+    serve_cfg = ServeConfig(**(config.get("serve") or {}))
+    worker = EngineWorker(tuple(config["addr"]),
+                          config["engine_id"], config["role"],
+                          params, mesh, cfg, serve_cfg,
+                          rewarm=bool(config.get("rewarm")))
+    try:
+        completed = worker.run(
+            max_steps=config.get("max_steps"))
+    finally:
+        worker.close()
+    return {"completed": completed, **engine_stats(worker)}
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if len(argv) != 1:
+        print("usage: python -m icikit.fleet.worker CONFIG.json",
+              file=sys.stderr)
+        return 2
+    with open(argv[0]) as f:
+        config = json.load(f)
+    stats = run_worker(config)
+    print("FLEET_WORKER_OK " + json.dumps(stats))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -57,6 +57,25 @@ def mint(rid: str) -> "TraceCtx":
     return TraceCtx(rid)
 
 
+def adopt(rid: str, trace_id: str, seq: int) -> "TraceCtx":
+    """A context bound to an EXISTING request tree — the fleet case:
+    the trace id rode the claim RPC from the coordinator, whose queue
+    owns the root/queued/attempt spans. Engine-side spans and instants
+    land under the same ``(cat, id)`` async track (in another process
+    they land in that process's buffer; merged or co-resident, the
+    validator pairs them by id, not by thread/process), so one request
+    reads as ONE continuous tree across the engine handoff — the
+    ``reissued_from`` edge the coordinator emits at a lease reap spans
+    processes because this id does. ``seq`` is the claim generation
+    the engine holds: its fenced calls stay live exactly while the
+    coordinator's lease does."""
+    ctx = TraceCtx(rid)
+    ctx.trace_id = trace_id
+    ctx._seq = seq
+    ctx._adopted = True
+    return ctx
+
+
 class TraceCtx:
     """Per-request async-span tree state, carried on the Request.
 
@@ -68,11 +87,14 @@ class TraceCtx:
     """
 
     __slots__ = ("trace_id", "rid", "_open", "_seq", "_reissued_from",
-                 "_lock")
+                 "_lock", "_adopted")
 
     def __init__(self, rid: str):
         self.trace_id = f"req-{next(_IDS)}"
         self.rid = rid
+        # True for fleet engine-side contexts (see adopt()): paired
+        # spans then emit as THREAD spans instead of async pairs
+        self._adopted = False
         self._open: list = []       # open async span names, LIFO
         self._seq = None            # live claim generation
         self._reissued_from = None  # claim seq abandoned by a reap
@@ -134,9 +156,19 @@ class TraceCtx:
     def span(self, name: str, seq=None, **attrs):
         """Context-manager form for strictly scoped regions (prefill
         chunks); the shared no-op singleton when tracing is off or the
-        caller's claim is stale."""
+        caller's claim is stale. Adopted (fleet engine-side) contexts
+        emit these as ordinary THREAD spans carrying the trace id as
+        an attr instead of async pairs: the coordinator's reaper owns
+        the async stack and cannot know what a dead remote engine
+        left open — as thread spans, a killed engine's danglers are
+        exactly the abandoned-straggler case ``chrome.close_dangling``
+        already heals at export, while the request's async tree stays
+        structurally valid."""
         if _tracer._TRACE is None or not self._live(seq):
             return _tracer.NOOP_SPAN
+        if self._adopted:
+            return _tracer.span(name, rid=self.rid,
+                                req=self.trace_id, **attrs)
         return _CtxSpan(self, name, seq, attrs)
 
     # -- lifecycle edges (called by scheduler + engine) --------------

@@ -287,7 +287,7 @@ class Engine:
     """
 
     def __init__(self, params, mesh, cfg, serve: ServeConfig,
-                 queue: RequestQueue | None = None):
+                 queue: RequestQueue | None = None, store=None):
         from icikit.models.transformer.model import DP_AXIS
         if cfg.n_experts:
             raise ValueError(
@@ -395,17 +395,26 @@ class Engine:
             raise ValueError(
                 f"host_cache_blocks must be >= 0, got "
                 f"{serve.host_cache_blocks}")
-        if ((serve.host_cache_blocks > 0 or serve.store_dir)
-                and not serve.prefix_cache):
+        if ((serve.host_cache_blocks > 0 or serve.store_dir
+                or store is not None) and not serve.prefix_cache):
             raise ValueError(
                 "the spill tier and the persistent store hold INDEXED "
                 "content; with prefix_cache off nothing is ever "
                 "registered, so host_cache_blocks/store_dir would be "
                 "silent no-ops — rejected loudly instead")
-        store = None
         if serve.store_dir:
+            if store is not None:
+                raise ValueError(
+                    "store_dir and an injected store= object are "
+                    "exclusive — the engine can write through to one "
+                    "bottom tier, not two")
             from icikit.serve.store import PrefixStore
             store = PrefixStore(serve.store_dir)
+        # else: `store` may be any store-SHAPED object (has/get/put/
+        # quarantine with the PrefixStore payload contract) — the
+        # fleet's KV bridge client rides in here, which is what makes
+        # the host tier fleet-shared: tier_plan/restore/persist compose
+        # against the duck type, digest re-verify at swap-in included
         self.pool = KVPool(cfg, mesh, serve.n_blocks, bs, quant=kv,
                            host_blocks=serve.host_cache_blocks,
                            store=store)
@@ -1978,6 +1987,35 @@ class Engine:
                 n += self.pool.rewarm_chain(hs, width)
         if n:
             obs.count("serve.store.rewarm_blocks", n)
+        return n
+
+    def export_chain(self, tokens) -> int:
+        """Persist the full-block chain of ``tokens`` (a served
+        request's prompt ++ committed tokens) to the attached store —
+        the fleet prefill engine's streaming half of a KV migration:
+        after its 1-token prefill claim completes, the finalized sealed
+        blocks (arena bytes + scale pages + seals, chain-hash-named
+        exactly like ``serve/store.py`` files) ship to the block bridge
+        BEFORE the handoff requeues the request, so the decode engine's
+        admission finds them with ``tier_plan`` and adopts them through
+        the ordinary digest-verified restore path. Only index-resident
+        pages export (content-addressed: already-present hashes are
+        no-ops); returns the number of blocks written. fp side only,
+        BY DESIGN: quantized pages never enter the prefix index (the
+        r11 parity rule — a cached q8 page cannot reproduce the raw
+        prompt-column attention the deployed int8 prefill computes),
+        so a quant request has no indexed chain to migrate and its
+        decode phase recomputes."""
+        if self.pool.store is None or not self.serve.prefix_cache:
+            return 0
+        bs = self.serve.block_size
+        n = 0
+        for h in block_hashes(np.asarray(tokens, np.int32), bs, "fp"):
+            for shard in range(self.dp):
+                page = self.pool.allocators[shard].indexed(h)
+                if page is not None and self.pool.persist(
+                        shard, page, h):
+                    n += 1
         return n
 
     def reset_stats(self) -> None:

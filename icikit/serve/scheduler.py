@@ -107,6 +107,13 @@ class Request:
     # stalled engine whose lease was reaped and reissued can no longer
     # act on the request (its stamp no longer matches the live lease)
     claim_seq: int = 0
+    # tokens COMMITTED by a prefill->decode handoff (the fleet role
+    # split): this prefix is already folded into the prompt and is
+    # part of the request's answer — a later requeue (fail / release
+    # / lease reap) resets the attempt's tokens back to this
+    # frontier, never past it, or the reissued decode would recompute
+    # one position too many and drop the handed-off token(s)
+    handoff_tokens: int = 0
     tokens: list = field(default_factory=list)
     error: str | None = None
     preempted: int = 0
@@ -232,27 +239,39 @@ class RequestQueue:
 
     # -- engine side -------------------------------------------------
 
-    def claim(self) -> Request | None:
+    def claim(self, accept=None) -> Request | None:
         """Pop the oldest *visible* queued request under a fresh lease,
         or None (nothing visible right now — ``next_visible_in`` says
         how long until something is). Heap entries are lazily deleted:
         an entry whose request is no longer ``queued`` (a stale
         duplicate from a reap racing a stale engine's fail) is
-        discarded, so one request can never be admitted twice."""
+        discarded, so one request can never be admitted twice.
+        ``accept`` is an optional cheap pure predicate over the
+        Request (it runs under the queue lock): requests it declines
+        are skipped WITHOUT losing their heap position — the fleet
+        coordinator's role-eligibility filter (a prefill-phase request
+        is invisible to a decode-only engine and vice versa)."""
         now = time.monotonic()
         claimed = None
+        skipped = []
         with self._lock:
             while self._queued and self._queued[0][0] <= now:
-                _, _, rid = heapq.heappop(self._queued)
+                entry = heapq.heappop(self._queued)
+                rid = entry[2]
                 req = self._requests[rid]
                 if req.state != "queued":
                     continue        # stale duplicate entry
+                if accept is not None and not accept(req):
+                    skipped.append(entry)   # ineligible, not stale
+                    continue
                 req.state = "running"
                 req.attempts += 1
                 req.claim_seq += 1
                 self._leases[rid] = (now + self.lease_s, req.claim_seq)
                 claimed = req
                 break
+            for entry in skipped:
+                heapq.heappush(self._queued, entry)
         if claimed is not None:
             claimed.trace.close("serve.req.queued")
             claimed.trace.begin_attempt(claimed.claim_seq,
@@ -317,6 +336,79 @@ class RequestQueue:
                         n_tokens=len(req.tokens))
         return True
 
+    def handoff(self, rid: str, tokens, seq: int | None = None) -> str:
+        """Prefill → decode handoff (the fleet's DistServe-style role
+        split): commit this attempt's ``tokens`` (the prefill engine's
+        first token(s)), EXTEND the prompt by them, and requeue the
+        request so a decode-capable engine claims the continuation.
+        Because sampled draws are keyed by *absolute position* under
+        the per-request counter stream (r12), the continuation decoded
+        from the extended prompt is bitwise the tail of the original
+        request's stream — the handoff is invisible in the committed
+        tokens. Returns the request's new state (``"done"`` when the
+        handed-off tokens already finish it — n_new exhausted or EOS —
+        ``"queued"`` otherwise, ``"stale"`` for fenced-out callers).
+        Like ``release``, a handoff burns no retry (attempts counts
+        *failures*, and this attempt succeeded); like ``complete``,
+        a stale caller (lease reaped and reissued) is a no-op counted
+        as a duplicate commit. One request stays ONE trace tree: the
+        attempt segment closes with ``outcome="handoff"`` and the next
+        queued segment opens under the same trace id."""
+        tokens = list(tokens)
+        now = time.monotonic()
+        finished = False
+        with self._lock:
+            req = self._requests.get(rid)
+            if req is None or req.state in ("done", "failed") \
+                    or not self._lease_live(rid, seq):
+                dup = True
+            else:
+                dup = False
+                self._leases.pop(rid, None)
+                req.tokens = list(req.tokens) + tokens
+                req.handoff_tokens = len(req.tokens)
+                finished = (len(req.tokens) >= req.n_new
+                            or (req.eos_id is not None and tokens
+                                and tokens[-1] == req.eos_id))
+                if finished:
+                    req.state = "done"
+                    req.done_t = now
+                    self.done[rid] = req
+                else:
+                    # the committed tokens become prompt: the decode
+                    # phase admits (prompt ++ tokens) and generates the
+                    # remaining n_new - len(tokens) positions. The
+                    # checksum re-stamps BEFORE the request is
+                    # claimable again, preserving the submit-time
+                    # fingerprint contract at the new prompt.
+                    req.prompt = np.concatenate(
+                        [req.prompt,
+                         np.asarray(tokens, np.int32)])
+                    req.checksum = prompt_checksum(req.prompt)
+                    req.state = "queued"
+                    req.attempts -= 1     # a handoff is not a failure
+                    self._limbo += 1
+        if dup:
+            self.n_duplicate_commits += 1
+            obs.emit("serve.duplicate_commit", rid=rid)
+            obs.count("serve.duplicate_commits")
+            return "stale"
+        obs.count("serve.handoffs")
+        obs.emit("serve.request_handoff", rid=rid,
+                 n_tokens=len(tokens), finished=finished)
+        req.trace.end_attempt(outcome="handoff")
+        if finished:
+            req.trace.close("serve.req", state="done",
+                            n_tokens=len(req.tokens))
+            obs.count("serve.completed")
+            return "done"
+        req.trace.instant("serve.req.handoff", n_tokens=len(tokens))
+        req.trace.open("serve.req.queued")
+        with self._lock:
+            heapq.heappush(self._queued, (now, next(self._ids), rid))
+            self._limbo -= 1
+        return "queued"
+
     def fail(self, rid: str, exc: BaseException,
              retry: bool = True, seq: int | None = None) -> str:
         """Record a failed attempt. Retryable failures re-queue with
@@ -336,8 +428,9 @@ class RequestQueue:
                 delay = self.backoff_s * (2 ** (req.attempts - 1))
                 vis = now + delay
                 req.state = "queued"
-                req.tokens = []
-                req.first_token_t = None
+                req.tokens = req.tokens[:req.handoff_tokens]
+                if not req.handoff_tokens:
+                    req.first_token_t = None
                 self._limbo += 1    # claimable only after ctx settles
                 requeued = True
             else:
@@ -376,8 +469,9 @@ class RequestQueue:
             self._leases.pop(rid, None)
             req.state = "queued"
             req.attempts -= 1
-            req.tokens = []
-            req.first_token_t = None
+            req.tokens = req.tokens[:req.handoff_tokens]
+            if not req.handoff_tokens:
+                req.first_token_t = None
             req.preempted += 1
             self._limbo += 1        # claimable only after ctx settles
         obs.emit("serve.request_preempted", rid=rid)
@@ -405,8 +499,9 @@ class RequestQueue:
                 del self._leases[rid]
                 req = self._requests[rid]
                 req.state = "queued"
-                req.tokens = []
-                req.first_token_t = None
+                req.tokens = req.tokens[:req.handoff_tokens]
+                if not req.handoff_tokens:
+                    req.first_token_t = None
                 reaped.append(rid)
                 reaped_reqs.append((req, seq))
             self.n_reissues += len(reaped)
@@ -433,6 +528,22 @@ class RequestQueue:
                                    (now, next(self._ids), req.rid))
                 self._limbo -= len(reaped)
         return reaped
+
+    def expire(self, rids) -> list:
+        """Force the named leases to expire NOW and reap them — the
+        fleet coordinator's move when it *knows* an engine is gone or
+        defective (heartbeat stopped, or its results failed integrity
+        verification): waiting out the natural lease deadline would
+        just delay the reissue. Requests the caller names that hold no
+        live lease are ignored. Returns the reaped rids (a superset
+        may reap if other leases happen to be expired too — reap is
+        global by design)."""
+        with self._lock:
+            for rid in rids:
+                if rid in self._leases:
+                    self._leases[rid] = (float("-inf"),
+                                         self._leases[rid][1])
+        return self.reap_expired()
 
     def pending_prompts(self) -> list:
         """Prompts of every currently-queued request, in visibility

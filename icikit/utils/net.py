@@ -1,0 +1,58 @@
+"""Hardened local-socket helpers shared by every test/tool that needs
+a port.
+
+Extracted from ``tests/test_multihost.py``'s two-process bring-up test,
+which learned these the hard way (both were real flake modes on CI
+hosts):
+
+- a plain claim/release of an OS-assigned port leaves the socket in
+  ``TIME_WAIT`` on some hosts, so the next binder of that port fails —
+  ``SO_REUSEADDR`` on the probe socket (and on the real server socket)
+  lets the port rebind immediately;
+- port races are transient: two probes can hand out the same port
+  before either binder claims it for real. The honest policy is
+  retry-on-a-fresh-port a bounded number of times, and only *then*
+  treat the failure as environmental.
+
+Users: the multihost bring-up test, the fleet coordinator/transport
+(``icikit.fleet``), and their tests — one implementation, not copies.
+"""
+
+from __future__ import annotations
+
+import socket
+
+# stderr signatures of a lost port race (vs a structural failure) —
+# shared so retry loops in tests and tools agree on what "transient"
+# means
+PORT_RACE_SIGS = ("Address already in use", "Failed to bind",
+                  "errno: 98")
+
+
+def free_port(host: str = "localhost") -> int:
+    """Claim-then-release an OS-assigned port with ``SO_REUSEADDR`` so
+    the caller can rebind it immediately. Raises ``OSError`` when no
+    local port can be bound at all (callers in tests typically map
+    that to a skip — the failure is environmental, not logical)."""
+    with socket.socket() as s:
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        s.bind((host, 0))
+        return s.getsockname()[1]
+
+
+def server_socket(host: str, port: int, backlog: int = 16,
+                  reuse: bool = True) -> socket.socket:
+    """A bound, listening TCP socket (``port=0`` = OS-assigned).
+    ``SO_REUSEADDR`` by default: a restarted server (the coordinator
+    restart-rewarm path) must be able to rebind its old port without
+    waiting out ``TIME_WAIT``."""
+    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    try:
+        if reuse:
+            s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        s.bind((host, port))
+        s.listen(backlog)
+    except BaseException:
+        s.close()
+        raise
+    return s

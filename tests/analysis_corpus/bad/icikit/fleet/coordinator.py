@@ -1,0 +1,4 @@
+"""fleet-control-plane seeded violation: a jnp allocation on the
+claim path (the import is elsewhere; the allocation is the sin)."""
+
+LEASE_TABLE = jnp.zeros((8,))  # noqa: F821 - corpus fixture

@@ -1,0 +1,5 @@
+"""fleet-control-plane clean twin: host-only control plane — leases
+and claims live in host structures, KV bytes move as numpy views."""
+import numpy as np
+
+LEASE_TABLE = np.zeros((8,))

@@ -37,7 +37,7 @@ CLEAN = os.path.join(CORPUS, "clean")
 # cannot seed it — its parity pin below covers it)
 STATIC_RULES = ["serve-key", "serve-clock", "obs-print", "tree-accept",
                 "obs-catalog", "host-sync", "lock-discipline",
-                "chaos-site"]
+                "chaos-site", "fleet-control-plane"]
 
 # rule -> the ONE seeded violation in the bad twin
 GOLDEN = {
@@ -49,6 +49,7 @@ GOLDEN = {
     "host-sync": ("icikit/serve/engine.py", 14),
     "lock-discipline": ("icikit/serve/locked.py", 15),
     "chaos-site": ("tests/drill.py", 4),
+    "fleet-control-plane": ("icikit/fleet/coordinator.py", 4),
 }
 
 

@@ -1,0 +1,456 @@
+"""icikit.fleet — coordinator, roles, migration, defect scheduling.
+
+The cross-process composition claims under test (in-process workers
+over REAL sockets — the transport serializes everything, so these pins
+cover the wire contract; the subprocess soak lives in
+tests/test_fleet_soak.py):
+
+- multi-engine serving is bitwise single-request generate /
+  sample_generate per request (counter keys carry no engine state);
+- prefill/decode disaggregation hands off through the block bridge:
+  the decode engine MIGRATES the prefill engine's sealed blocks
+  (digest-verified at swap-in) instead of recomputing them, and the
+  spliced token stream is bitwise the unsplit one;
+- claim-seq fencing across processes: a stalled engine whose request
+  was reaped cannot complete it via RPC;
+- a flipped bridged byte is quarantined bridge-wide and recomputed
+  fresh (no retry burned), co-batched rows bitwise unchanged;
+- an engine whose completions fail KV integrity verify is quarantined
+  (no further claims) and its in-flight work reissues bitwise;
+- a restarted coordinator re-serves the persisted bridge and a fresh
+  engine re-warms from it;
+- one request stays ONE trace tree across a cross-engine reissue.
+"""
+
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from icikit import chaos, obs
+from icikit.fleet import Coordinator, EngineWorker, RpcClient
+from icikit.fleet.worker import build_model
+from icikit.models.transformer import greedy_generate
+from icikit.models.transformer.decode import sample_generate
+from icikit.obs import trace_ctx
+from icikit.serve.engine import ServeConfig
+from icikit.serve.scheduler import RequestQueue, prompt_checksum
+
+MODEL_SPEC = {
+    "preset": "tiny",
+    "overrides": {"vocab": 64, "d_model": 32, "n_heads": 2,
+                  "d_head": 16, "d_ff": 64, "n_layers": 2,
+                  "max_seq": 64},
+    "compute_dtype": "float32", "dp": 1, "tp": 1, "init_seed": 0,
+}
+
+SERVE_KW = dict(max_rows=2, block_size=4, n_blocks=32,
+                max_prompt=20, max_new=12, prefill_chunk=8)
+
+
+@pytest.fixture(scope="module")
+def fleet_model():
+    return build_model(MODEL_SPEC)
+
+
+def _prompts(n, vocab, s=10, seed=0):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(0, vocab, (s,)).astype(np.int32)
+            for _ in range(n)]
+
+
+def _run_workers(workers, timeout=180):
+    threads = [threading.Thread(target=w.run, daemon=True)
+               for w in workers]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=timeout)
+    assert not any(t.is_alive() for t in threads), \
+        "fleet run did not drain in time"
+
+
+def _audit(coord, rids, prompts, n_new, model, temperature=0.0,
+           top_p=1.0, seeds=None):
+    """Every completed request bitwise vs its single-request decode."""
+    params, mesh, cfg = model
+    batch = jnp.asarray(np.stack(prompts))
+    if temperature > 0.0:
+        out = np.asarray(sample_generate(
+            params, batch, mesh, cfg, n_new, jax.random.key(0),
+            temperature=temperature, top_p=top_p,
+            seeds=np.asarray(seeds, np.int32)))
+    else:
+        out = np.asarray(greedy_generate(
+            params, batch, mesh, cfg, n_new))
+    for rid, p, row in zip(rids, prompts, out):
+        req = coord.queue.request(rid)
+        assert req.state == "done", (rid, req.state, req.error)
+        exp = [int(t) for t in row[len(p):len(p) + n_new]]
+        got = [int(t) for t in req.tokens]
+        assert got == exp[:len(got)] and len(got) == n_new, \
+            (rid, got, exp)
+
+
+def test_two_engines_share_one_queue_bitwise(fleet_model, tmp_path):
+    params, mesh, cfg = fleet_model
+    coord = Coordinator(tmp_path / "bridge", lease_s=10.0)
+    try:
+        sv = ServeConfig(**SERVE_KW)
+        workers = [EngineWorker(coord.addr, f"e{i}", "both",
+                                params, mesh, cfg, sv)
+                   for i in range(2)]
+        prompts = _prompts(5, cfg.vocab)
+        rids = [coord.submit(p, 6) for p in prompts]
+        _run_workers(workers)
+        _audit(coord, rids, prompts, 6, fleet_model)
+        # both engines really served (the queue is shared)
+        assert sum(len(w.queue.done) for w in workers) == 5
+        for w in workers:
+            w.close()
+    finally:
+        coord.shutdown()
+
+
+def test_disaggregation_migrates_kv_and_stays_bitwise(
+        fleet_model, tmp_path):
+    """The DistServe split: prefill engine computes the prompt + first
+    token, streams sealed blocks to the bridge; the decode engine
+    pulls them (cross-engine migration), re-verifies each content
+    digest, and continues — greedy AND sampled streams bitwise the
+    unsplit single-request decode."""
+    params, mesh, cfg = fleet_model
+    coord = Coordinator(tmp_path / "bridge", lease_s=10.0)
+    try:
+        sv = ServeConfig(**SERVE_KW)
+        pre = EngineWorker(coord.addr, "pre0", "prefill",
+                           params, mesh, cfg, sv)
+        dec = EngineWorker(coord.addr, "dec0", "decode",
+                           params, mesh, cfg, sv)
+        prompts = _prompts(4, cfg.vocab, seed=1)
+        rids = [coord.submit(p, 6) for p in prompts[:2]]
+        srids = [coord.submit(p, 6, seed=i, temperature=0.7,
+                              top_p=0.9)
+                 for i, p in enumerate(prompts[2:])]
+        _run_workers([pre, dec])
+        _audit(coord, rids, prompts[:2], 6, fleet_model)
+        _audit(coord, srids, prompts[2:], 6, fleet_model,
+               temperature=0.7, top_p=0.9, seeds=[0, 1])
+        assert coord.n_handoffs == 4
+        stats = coord.bridge.stats()
+        assert stats["migrations"] > 0, stats
+        # the decode engine restored the bridged chain instead of
+        # recomputing the prompt: its computed prefill positions are
+        # the one spliced token per request, not the whole prompt
+        dstats = dec.engine.prefix_stats()
+        assert dstats["restores"] > 0
+        # per request: 2 full 4-token blocks of the 10-token prompt
+        # migrate; the tail (2 positions + the spliced first token)
+        # recomputes — 3 positions, not 11
+        assert dstats["prefill_tokens"] <= 3 * len(prompts)
+        pre.close(); dec.close()
+    finally:
+        coord.shutdown()
+
+
+def test_claim_seq_fencing_across_processes(tmp_path):
+    """A stalled engine whose request was reaped cannot complete it
+    via RPC: the late commit is a counted no-op and the reissued
+    claim's tokens stand."""
+    coord = Coordinator(tmp_path / "bridge", lease_s=0.2,
+                        reap_interval_s=0.05)
+    try:
+        cli = RpcClient(coord.addr)
+        cli.call("hello", {"engine": "stale", "role": "both"})
+        cli.call("hello", {"engine": "live", "role": "both"})
+        rid = coord.submit(np.arange(4, dtype=np.int32), 3)
+        reply, _ = cli.call("claim", {"engine": "stale"})
+        w = reply["req"]
+        assert w["rid"] == rid and w["claim_seq"] == 1
+        # the stale engine stops renewing; the reaper reissues
+        deadline = time.monotonic() + 5.0
+        while coord.queue.request(rid).state != "queued":
+            assert time.monotonic() < deadline
+            time.sleep(0.02)
+        reply, _ = cli.call("claim", {"engine": "live"})
+        w2 = reply["req"]
+        assert w2["rid"] == rid and w2["claim_seq"] == 2
+        # late commit under the reaped generation: fenced, counted
+        reply, _ = cli.call("complete", {
+            "engine": "stale", "rid": rid, "seq": 1,
+            "tokens": [9, 9, 9], "marks": {}})
+        assert reply["committed"] is False
+        assert coord.queue.n_duplicate_commits >= 1
+        # the live claimant's commit stands
+        reply, _ = cli.call("complete", {
+            "engine": "live", "rid": rid, "seq": 2,
+            "tokens": [1, 2, 3], "marks": {}})
+        assert reply["committed"] is True
+        assert [int(t) for t in coord.queue.request(rid).tokens] \
+            == [1, 2, 3]
+        assert coord.queue.n_reissues >= 1
+        cli.close()
+    finally:
+        coord.shutdown()
+
+
+def test_bridged_byte_flip_quarantined_and_recomputed(
+        fleet_model, tmp_path):
+    """The seal-verify-on-migrate drill: one bridged block's bytes rot
+    between the coordinator's disk and the decode engine's arena
+    (past the wire checksums — ``fleet.kv.pull``). The swap-in digest
+    catches it, the content is quarantined from EVERY tier (the
+    bridge file is removed), the row recomputes fresh without burning
+    a retry, and co-batched rows are bitwise unchanged."""
+    params, mesh, cfg = fleet_model
+    coord = Coordinator(tmp_path / "bridge", lease_s=10.0)
+    try:
+        sv = ServeConfig(**SERVE_KW)
+        pre = EngineWorker(coord.addr, "pre0", "prefill",
+                           params, mesh, cfg, sv)
+        dec = EngineWorker(coord.addr, "dec0", "decode",
+                           params, mesh, cfg, sv)
+        prompts = _prompts(3, cfg.vocab, seed=2)
+        rids = [coord.submit(p, 6) for p in prompts]
+        plan = chaos.FaultPlan(
+            schedule={"corrupt:fleet.kv.pull": (0,)}, seed=7)
+        with chaos.inject(plan):
+            _run_workers([pre, dec])
+        assert plan.fired("corrupt", "fleet.kv.pull") == 1
+        _audit(coord, rids, prompts, 6, fleet_model)
+        # quarantined bridge-wide + recomputed, no retry burned
+        assert coord.bridge.store.n_quarantined >= 1
+        # handoff and preemption both hand back their attempt, and the
+        # corrupt pull recomputes same-attempt — so no completed
+        # request shows a burned retry
+        assert all(coord.queue.request(r).attempts == 1
+                   for r in rids), \
+            [(r, coord.queue.request(r).attempts) for r in rids]
+        pre.close(); dec.close()
+    finally:
+        coord.shutdown()
+
+
+def test_defective_engine_quarantined_work_reissued_bitwise(
+        fleet_model, tmp_path):
+    """'Host computes garbage': the victim engine's sealed KV page is
+    corrupted in-arena (``serve.kv.page``); its completion fails the
+    integrity re-verify, the IntegrityError fail RPC marks the engine
+    defective, the coordinator quarantines it (claims denied) and
+    force-reissues its in-flight work — the healthy engine completes
+    everything bitwise."""
+    params, mesh, cfg = fleet_model
+    coord = Coordinator(tmp_path / "bridge", lease_s=10.0,
+                        defect_threshold=1)
+    try:
+        # only the victim arms page integrity, so the process-global
+        # chaos plan can only fire inside it
+        victim = EngineWorker(coord.addr, "bad0", "both", params,
+                              mesh, cfg,
+                              ServeConfig(**SERVE_KW,
+                                          integrity="pages"))
+        prompts = _prompts(4, cfg.vocab, seed=3)
+        rids = [coord.submit(p, 6) for p in prompts]
+        plan = chaos.FaultPlan(
+            schedule={"corrupt:serve.kv.page": (0,)}, seed=8)
+        healthy = [None]
+
+        def launch_healthy():
+            # joins after the victim has had time to claim first
+            time.sleep(0.3)
+            healthy[0] = EngineWorker(coord.addr, "ok0", "both",
+                                      params, mesh, cfg,
+                                      ServeConfig(**SERVE_KW))
+            healthy[0].run()
+
+        t = threading.Thread(target=launch_healthy, daemon=True)
+        with chaos.inject(plan):
+            t.start()
+            victim.run()
+            t.join(timeout=180)
+        assert not t.is_alive()
+        assert plan.fired("corrupt", "serve.kv.page") >= 1
+        _audit(coord, rids, prompts, 6, fleet_model)
+        reg = coord.engines()
+        assert reg["bad0"]["state"] == "quarantined", reg
+        assert reg["bad0"]["defects"] >= 1
+        # quarantined engines are denied claims
+        cli = RpcClient(coord.addr)
+        reply, _ = cli.call("claim", {"engine": "bad0"})
+        assert reply["req"] is None and reply["denied"] == "quarantined"
+        cli.close()
+        victim.close()
+        if healthy[0] is not None:
+            healthy[0].close()
+    finally:
+        coord.shutdown()
+
+
+def test_coordinator_restart_rewarms_from_persistent_bridge(
+        fleet_model, tmp_path):
+    """The bridge is a real on-disk PrefixStore: a restarted
+    coordinator re-serves every block the previous life persisted,
+    and a fresh engine's rewarm hook pulls the pending prompts' chains
+    before serving — restored work is bitwise and the second life's
+    prefill is mostly cache hits."""
+    params, mesh, cfg = fleet_model
+    store_dir = tmp_path / "bridge"
+    prompts = _prompts(3, cfg.vocab, seed=4)
+    sv = ServeConfig(**SERVE_KW)
+
+    coord = Coordinator(store_dir, lease_s=10.0)
+    w = EngineWorker(coord.addr, "life1", "both", params, mesh, cfg,
+                     sv)
+    rids = [coord.submit(p, 6) for p in prompts]
+    _run_workers([w])
+    _audit(coord, rids, prompts, 6, fleet_model)
+    w.close()
+    coord.shutdown()
+    persisted = coord.bridge.store.n_blocks()
+    assert persisted > 0
+
+    # second life: same store dir, fresh coordinator + engine; the
+    # SAME prompts are pending, so rewarm pulls their chains from the
+    # bridge before the first claim
+    coord2 = Coordinator(store_dir, lease_s=10.0)
+    try:
+        rids2 = [coord2.submit(p, 6) for p in prompts]
+        w2 = EngineWorker(coord2.addr, "life2", "both", params, mesh,
+                          cfg, sv, rewarm=True)
+        _run_workers([w2])
+        _audit(coord2, rids2, prompts, 6, fleet_model)
+        # rewarm pulled the chains into the CACHED state before the
+        # first claim, so serving sees device hits, not restores
+        assert w2.rewarm_blocks > 0
+        stats = w2.engine.prefix_stats()
+        assert stats["hits"] >= len(prompts), stats
+        w2.close()
+    finally:
+        coord2.shutdown()
+
+
+def test_trace_tree_continuous_across_cross_engine_reissue(
+        fleet_model, tmp_path):
+    """One request, ONE tree: engine A dies mid-decode
+    (``fleet.engine.die``), the reaper abandons its spans and the
+    next attempt opens with the ``reissued_from`` edge; engine B's
+    spans ride the SAME trace id (it rode the claim RPC), so the
+    exported trace validates and holds exactly one tree per request."""
+    params, mesh, cfg = fleet_model
+    coord = Coordinator(tmp_path / "bridge", lease_s=0.4,
+                        reap_interval_s=0.05)
+    tb = obs.start_tracing()
+    try:
+        sv = ServeConfig(**SERVE_KW)
+        prompts = _prompts(2, cfg.vocab, seed=5)
+        rids = [coord.submit(p, 8) for p in prompts]
+        plan = chaos.FaultPlan(
+            schedule={"die:fleet.engine.die": (3,)}, seed=9)
+        va = EngineWorker(coord.addr, "dies", "both", params, mesh,
+                          cfg, sv)
+        with chaos.inject(plan):
+            with pytest.raises(chaos.InjectedDeath):
+                va.run()
+        assert plan.fired("die", "fleet.engine.die") == 1
+        vb = EngineWorker(coord.addr, "lives", "both", params, mesh,
+                          cfg, sv)
+        _run_workers([vb])
+        _audit(coord, rids, prompts, 8, fleet_model)
+        assert coord.queue.n_reissues >= 1
+        va.close(); vb.close()
+    finally:
+        obs.stop_tracing()
+        coord.shutdown()
+    # validate like export does: the dead engine's thread spans are
+    # the abandoned-straggler case close_dangling exists for
+    events = list(tb.events)
+    events += obs.chrome.close_dangling(events)
+    errors = obs.validate_trace(obs.chrome.to_chrome(events))
+    assert errors == [], errors[:5]
+    trees = trace_ctx.request_trees(events)
+    assert len(trees) == len(rids)
+    reissued = [ev for evs in trees.values() for ev in evs
+                if ev.get("ph") == "b"
+                and ev.get("name") == "serve.req.attempt"
+                and "reissued_from" in (ev.get("args") or {})]
+    assert reissued, "no reissued_from edge in any request tree"
+
+
+# -- scheduler handoff unit surface ----------------------------------
+
+def test_handoff_extends_prompt_and_burns_no_retry():
+    q = RequestQueue(lease_s=10.0)
+    rid = q.submit(np.arange(5, dtype=np.int32), 4)
+    req = q.claim()
+    assert q.handoff(rid, [7], seq=req.claim_seq) == "queued"
+    req = q.request(rid)
+    assert req.state == "queued"
+    assert list(req.prompt) == [0, 1, 2, 3, 4, 7]
+    assert req.checksum == prompt_checksum(req.prompt)
+    assert list(req.tokens) == [7]
+    assert req.attempts == 0        # not a failure, like release
+    # the decode claim sees the extended prompt and remaining budget
+    req2 = q.claim()
+    assert req2.rid == rid and req2.n_new == 4
+    assert q.complete(rid, [7, 1, 2, 3], seq=req2.claim_seq)
+    assert q.drained()
+
+
+def test_handoff_finishes_on_exhaustion_and_eos():
+    q = RequestQueue(lease_s=10.0)
+    rid = q.submit(np.arange(4, dtype=np.int32), 1)
+    req = q.claim()
+    assert q.handoff(rid, [3], seq=req.claim_seq) == "done"
+    assert q.request(rid).state == "done"
+    assert q.drained()
+    rid2 = q.submit(np.arange(4, dtype=np.int32), 8, eos_id=2)
+    req2 = q.claim()
+    assert q.handoff(rid2, [2], seq=req2.claim_seq) == "done"
+    assert list(q.request(rid2).tokens) == [2]
+
+
+def test_handoff_prefix_survives_reissue():
+    """The soak's race, pinned deterministically: a decode-phase
+    request reaped mid-decode must keep its handoff-committed
+    token(s) — a requeue that cleared them would make the reissued
+    claim decode one position too many and drop the handed-off token
+    from the committed stream."""
+    q = RequestQueue(lease_s=10.0)
+    rid = q.submit(np.arange(5, dtype=np.int32), 4)
+    req = q.claim()
+    assert q.handoff(rid, [7], seq=req.claim_seq) == "queued"
+    req2 = q.claim()
+    assert req2.n_new - len(req2.tokens) == 3   # remaining budget
+    q.expire([rid])                             # decode engine dies
+    req3 = q.request(rid)
+    assert list(req3.tokens) == [7], "handoff prefix lost on reap"
+    req4 = q.claim()
+    assert req4.n_new - len(req4.tokens) == 3
+    assert q.complete(rid, [7, 1, 2, 3], seq=req4.claim_seq)
+
+
+def test_handoff_stale_caller_fenced():
+    q = RequestQueue(lease_s=10.0)
+    rid = q.submit(np.arange(4, dtype=np.int32), 4)
+    req = q.claim()
+    q.expire([rid])
+    assert q.request(rid).state == "queued"
+    dups = q.n_duplicate_commits
+    assert q.handoff(rid, [9], seq=req.claim_seq) == "stale"
+    assert q.n_duplicate_commits == dups + 1
+    assert list(q.request(rid).prompt) == [0, 1, 2, 3]
+
+
+def test_claim_accept_predicate_preserves_order():
+    q = RequestQueue(lease_s=10.0)
+    r0 = q.submit(np.arange(3, dtype=np.int32), 2)
+    r1 = q.submit(np.arange(3, dtype=np.int32), 2)
+    # a filter that declines r0 must leave it queued, in place
+    got = q.claim(accept=lambda r: r.rid != r0)
+    assert got.rid == r1
+    assert q.claim(accept=lambda r: r.rid != r0) is None
+    got0 = q.claim()
+    assert got0.rid == r0

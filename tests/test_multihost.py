@@ -174,12 +174,13 @@ def test_real_two_process_bringup():
     Skips when the coordinator port cannot be claimed (busy CI host).
     """
     import os
-    import socket
     import subprocess
     import sys
     from pathlib import Path
 
     import jax
+
+    from icikit.utils.net import PORT_RACE_SIGS, free_port
 
     repo = Path(__file__).resolve().parents[1]
     worker = Path(__file__).resolve().parent / "multihost_worker.py"
@@ -190,17 +191,13 @@ def test_real_two_process_bringup():
                     "(jax.distributed.initialize missing)")
 
     def _free_port() -> int:
-        """Claim-then-release with SO_REUSEADDR so the coordinator can
-        rebind the port immediately (a plain claim/release leaves the
-        socket in TIME_WAIT on some hosts — one of the two flake
-        modes this test had)."""
-        with socket.socket() as s:
-            s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-            try:
-                s.bind(("localhost", 0))
-            except OSError as e:  # pragma: no cover
-                pytest.skip(f"cannot bind a local port: {e}")
-            return s.getsockname()[1]
+        """The shared hardened helper (icikit.utils.net — claim with
+        SO_REUSEADDR then release, so the coordinator can rebind the
+        port immediately); an unbindable host maps to a skip here."""
+        try:
+            return free_port()
+        except OSError as e:  # pragma: no cover
+            pytest.skip(f"cannot bind a local port: {e}")
 
     env = dict(os.environ)
     keep = [x for x in env.get("PYTHONPATH", "").split(os.pathsep)
@@ -212,8 +209,7 @@ def test_real_two_process_bringup():
     # free port instead of skipping on the first collision — a skip is
     # only honest once the failure mode is environmental, not a race
     # this loop can win.
-    PORT_SIGS = ("Address already in use", "Failed to bind",
-                 "errno: 98")
+    PORT_SIGS = PORT_RACE_SIGS
     UNAVAILABLE_SIGS = (
         "UNAVAILABLE", "DEADLINE_EXCEEDED",
         "distributed runtime is not available",

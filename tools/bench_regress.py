@@ -58,6 +58,9 @@ NUMERIC_CONFIG = {
     "train_steps", "distill_steps", "d_model", "n_heads", "d_head",
     "d_ff", "vocab", "max_seq", "runs", "reps", "tokens_per_s_reps",
     "tenants", "zipf", "host_cache_blocks", "n_prompts",
+    # fleet rows (serve_fleet_r17.jsonl): engine count is a workload
+    # knob — a 4-engine arm must never gate a 1-engine arm
+    "n_engines", "lease_s",
 }
 
 # (path, direction, default relative tolerance) — applied when the

@@ -6,7 +6,7 @@ PY ?= python
 
 .PHONY: test test-fast chaos bench native clean sweep scaling northstar \
 	trace-demo check analysis-smoke decode-smoke draft-smoke \
-	serve-smoke quant-smoke obs-smoke fleet-smoke
+	serve-smoke quant-smoke obs-smoke fleet-smoke fleet-ha-smoke
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -47,7 +47,7 @@ check:
 		--budget 30
 	$(PY) tools/bench_regress.py --self-check serve_r12.jsonl \
 		serve_r15.jsonl serve_r16.jsonl serve_fleet_r17.jsonl \
-		decode_spec_r14.jsonl \
+		serve_fleet_ha_r18.jsonl decode_spec_r14.jsonl \
 		--verdict /tmp/icikit_bench_regress.json
 
 # machine-readable analysis output: the --json shape the tooling
@@ -237,6 +237,18 @@ fleet-smoke:
 		--lease 2 --kill 1:6 --expect-reissue --verify-identity \
 		--seed 0 > /dev/null
 	@echo "fleet-smoke kill-drill OK: engine died mid-decode, leases reissued, all requests completed bitwise"
+
+# the r18 HA drill: 2 engines + 1 warm standby, the leader SIGKILLed
+# mid-decode — the standby must promote inside 2x the lease timeout
+# (asserted by the bench), every completion stays bitwise vs
+# single-request decode, and the failover lands as fleet.leader.*
+# events on the obs bus
+fleet-ha-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m icikit.bench.fleet --ha --engines 2 \
+		--standbys 1 --requests 8 --rate 8 --prompt 8 \
+		--new-min 6 --new-max 10 --rows 2 --verify-identity \
+		--lease 5 --lease-timeout 1.5 --seed 0 > /dev/null
+	@echo "fleet-ha-smoke OK: leader killed mid-decode, standby promoted inside the failover bound, completions bitwise"
 
 bench:
 	$(PY) bench.py

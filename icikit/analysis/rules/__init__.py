@@ -4,6 +4,7 @@ from icikit.analysis.rules import (  # noqa: F401
     chaos_site,
     fleet_control_plane,
     host_sync,
+    journal_discipline,
     lock_discipline,
     obs_catalog,
     quant,

@@ -84,6 +84,8 @@ def _register_everything() -> None:
     """Import every module that owns probe sites, so registration-at-
     definition has happened before we judge the globs."""
     import icikit.bench.harness  # noqa: F401
+    import icikit.fleet.ha  # noqa: F401 - fleet.ha.*
+    import icikit.fleet.journal  # noqa: F401 - fleet.journal/leader
     import icikit.fleet.roles  # noqa: F401 - fleet.engine.die
     import icikit.fleet.transport  # noqa: F401 - fleet.rpc.*
     import icikit.models.solitaire.scheduler  # noqa: F401

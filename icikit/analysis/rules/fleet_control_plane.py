@@ -21,7 +21,9 @@ from icikit.analysis.core import Finding, rule
 
 CONTROL_PLANE = ("icikit/fleet/transport.py",
                  "icikit/fleet/coordinator.py",
-                 "icikit/fleet/kvbridge.py")
+                 "icikit/fleet/kvbridge.py",
+                 "icikit/fleet/journal.py",
+                 "icikit/fleet/ha.py")
 
 BANNED = [
     (re.compile(r"^\s*(?:import|from)\s+jax\b"),

@@ -1,0 +1,114 @@
+"""``journal-discipline`` — queue mutations journal, fleet code
+never reaches around the journal.
+
+The r18 HA contract: the coordinator's ``RequestQueue`` is rebuilt
+bitwise from its journal, which only works if EVERY mutation verb
+appends a record before the RPC that caused it is acked. Two ways to
+silently break that:
+
+1. a new (or edited) ``RequestQueue`` verb mutates queue state —
+   pushes to the heap, touches the lease table, lands a request in
+   ``done``/``failed`` — without calling ``self._journal(...)``.
+   Replay then reconstructs a queue that never saw the mutation: the
+   standby promotes with a DIFFERENT state and the bitwise bar breaks
+   at the worst time (mid-failover).
+2. fleet-layer code pokes the queue's private state directly
+   (``queue._leases[...] = ...``) instead of going through a verb —
+   same corruption, committed from outside the file.
+
+Exemptions are the verbs whose non-journaling is the DESIGN:
+``renew`` (deadlines are re-based at restore, journaling every
+heartbeat would bloat the log), ``expire`` (only poisons deadlines;
+the reap that follows journals the effect), and the replay/restore
+helpers themselves (``apply_record`` etc. — journaling replay would
+double every record).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from icikit.analysis.core import Finding, rule
+
+SCHEDULER = "icikit/serve/scheduler.py"
+
+# state-mutating shapes inside RequestQueue methods (comment-stripped
+# line text)
+MUTATIONS = [
+    re.compile(r"heapq\.heappush\(\s*self\._queued"),
+    re.compile(r"self\._leases\[[^\]]*\]\s*="),
+    re.compile(r"self\._leases\.pop\b"),
+    re.compile(r"del\s+self\._leases"),
+    re.compile(r"self\.done\[[^\]]*\]\s*="),
+    re.compile(r"self\.failed\[[^\]]*\]\s*="),
+]
+
+_JOURNAL_CALL = re.compile(r"self\._journal\(")
+
+# verbs whose non-journaling is deliberate (see module docstring) and
+# the replay/restore machinery itself
+EXEMPT = {
+    "__init__", "renew", "expire", "_lease_live",
+    "apply_record", "_restore_locked", "_requeue_locked",
+    "_apply_handoff_locked", "_discard_entry_locked",
+    "finalize_replay",
+}
+
+# fleet code reaching into the queue's journaled-state internals
+REACH_IN = re.compile(
+    r"queue\._(queued|leases|requests|limbo|ids|seq_hwm|lock)\b")
+
+FLEET_PREFIX = "icikit/fleet"
+# the journal module IS the replay machinery: it owns the reach
+FLEET_EXEMPT = ("icikit/fleet/journal.py",)
+
+
+def _methods(tree):
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef) and node.name == "RequestQueue":
+            for m in node.body:
+                if isinstance(m, ast.FunctionDef):
+                    yield m
+
+
+@rule("journal-discipline",
+      "RequestQueue mutation verbs journal before ack; fleet code "
+      "never pokes queue internals around the journal")
+def check_journal_discipline(project) -> list:
+    out = []
+    sf = project.file(SCHEDULER)
+    if sf is not None and sf.tree is not None:
+        for m in _methods(sf.tree):
+            if m.name in EXEMPT:
+                continue
+            body = sf.lines[m.lineno - 1:(m.end_lineno or m.lineno)]
+            stripped = [ln.split("#", 1)[0] for ln in body]
+            journals = any(_JOURNAL_CALL.search(ln)
+                           for ln in stripped)
+            if journals:
+                continue
+            for off, ln in enumerate(stripped):
+                if any(pat.search(ln) for pat in MUTATIONS):
+                    out.append(Finding(
+                        "journal-discipline", sf.rel,
+                        m.lineno + off,
+                        f"RequestQueue.{m.name}() mutates journaled "
+                        "state without self._journal(...) — replay "
+                        "would rebuild a queue that never saw this "
+                        "mutation (add a verb record, or add the "
+                        "method to the rule's EXEMPT set with the "
+                        "why)"))
+                    break
+    for fsf in project.iter_py(FLEET_PREFIX):
+        if fsf.rel in FLEET_EXEMPT:
+            continue
+        for ln_no, text in enumerate(fsf.lines, 1):
+            stripped = text.split("#", 1)[0]
+            if REACH_IN.search(stripped):
+                out.append(Finding(
+                    "journal-discipline", fsf.rel, ln_no,
+                    "fleet code touches RequestQueue internals "
+                    "directly — mutations must go through a "
+                    "journaled verb or replay diverges"))
+    return out

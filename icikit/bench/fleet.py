@@ -89,9 +89,13 @@ def worker_env(extra: dict | None = None) -> dict:
 def spawn_worker(addr, engine_id: str, role: str, model_spec: dict,
                  serve_kw: dict, tmpdir: str,
                  env_extra: dict | None = None,
-                 rewarm: bool = False) -> subprocess.Popen:
-    cfg = {"addr": list(addr), "engine_id": engine_id, "role": role,
-           "model": model_spec, "serve": serve_kw, "rewarm": rewarm}
+                 rewarm: bool = False,
+                 ha_dir: str | None = None,
+                 token: str | None = None) -> subprocess.Popen:
+    cfg = {"addr": list(addr) if addr is not None else None,
+           "engine_id": engine_id, "role": role,
+           "model": model_spec, "serve": serve_kw, "rewarm": rewarm,
+           "ha_dir": ha_dir, "token": token}
     path = os.path.join(tmpdir, f"{engine_id}.json")
     with open(path, "w") as f:
         json.dump(cfg, f)
@@ -133,8 +137,12 @@ def _collect_worker_stats(procs) -> list:
     return out
 
 
-def _verify_identity(model, coord, rids, workload, temperature,
+def _verify_identity(model, lookup, rids, workload, temperature,
                      top_k, top_p) -> dict:
+    """Bitwise audit: re-decode every completed request single-file.
+    ``lookup(rid)`` returns anything with ``.state``/``.tokens`` —
+    the in-process queue's ``request`` or an RPC adapter (the HA
+    driver audits a coordinator in another process)."""
     import jax
     import jax.numpy as jnp
 
@@ -143,7 +151,7 @@ def _verify_identity(model, coord, rids, workload, temperature,
     params, mesh, cfg = model
     by_n: dict = {}
     for rid, (_, p, n, rs) in zip(rids, workload):
-        req = coord.queue.request(rid)
+        req = lookup(rid)
         if req.state == "done":
             by_n.setdefault(n, []).append((req, p, rs))
     checked, bad = 0, 0
@@ -337,11 +345,389 @@ def run_fleet(n_engines: int, n_requests: int, rate_rps: float,
                  else "device-measured"),
     }
     if verify:
-        rec.update(_verify_identity(model, coord, rids, workload,
-                                    temperature, top_k, top_p))
+        rec.update(_verify_identity(model, coord.queue.request, rids,
+                                    workload, temperature, top_k,
+                                    top_p))
     if own_store:
         import shutil
         shutil.rmtree(tmpdir, ignore_errors=True)
+    return rec
+
+
+# -- HA arm (r18): out-of-process coordinators, kill-the-leader ------
+
+
+def spawn_coordinator(cfg: dict, tmpdir: str, name: str,
+                      env_extra: dict | None = None
+                      ) -> subprocess.Popen:
+    """One coordinator process (``python -m icikit.fleet.ha``) —
+    role ``leader`` elects immediately, ``standby`` tails the journal
+    until the lease expires. The obs bus is armed to a per-process
+    JSONL file so the driver can assert ``fleet.leader.elected``
+    events after the fact."""
+    path = os.path.join(tmpdir, f"{name}.json")
+    with open(path, "w") as f:
+        json.dump(cfg, f)
+    env = {"ICIKIT_OBS": f"jsonl={tmpdir}/obs-{name}.jsonl;"
+                         "trace=off;metrics=off",
+           **(env_extra or {})}
+    out = open(os.path.join(tmpdir, f"{name}.out"), "w")
+    return subprocess.Popen(
+        [sys.executable, "-m", "icikit.fleet.ha", path],
+        stdout=out, stderr=out, text=True,
+        cwd=REPO, env=worker_env(env))
+
+
+def _obs_events(tmpdir: str, name: str) -> list:
+    """Structured events one coordinator process emitted."""
+    out = []
+    try:
+        with open(os.path.join(tmpdir, f"obs-{name}.jsonl")) as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    try:
+                        out.append(json.loads(line))
+                    except ValueError:
+                        pass
+    except OSError:
+        pass
+    return out
+
+
+def _rpc_lookup(client):
+    """``lookup(rid)`` adapter over the ``request`` RPC — feeds the
+    identity audit when the queue lives in another process."""
+    class _Req:
+        __slots__ = ("state", "tokens", "error")
+
+    def lookup(rid):
+        reply, _ = client.call("request", {"rid": rid})
+        if not reply.get("known"):
+            raise KeyError(rid)
+        r = _Req()
+        r.state = reply["state"]
+        r.tokens = reply["tokens"]
+        r.error = reply.get("error")
+        return r
+    return lookup
+
+
+def run_fleet_ha(n_engines: int, n_requests: int, rate_rps: float,
+                 prompt_len: int, new_min: int, new_max: int,
+                 preset: str = "tiny", n_standbys: int = 1,
+                 kill_leader_at=(0.4,), kill_engine_at=None,
+                 join_engine: bool = True,
+                 temperature: float = 0.0, top_k: int = 0,
+                 top_p: float = 1.0, seed_per_request: bool = False,
+                 seed: int = 0, rows: int = 2, block_size: int = 4,
+                 prefill_chunk: int = 16,
+                 lease_s: float = 6.0,
+                 lease_timeout_s: float = 1.5,
+                 heartbeat_timeout_s: float = 2.0,
+                 snapshot_every: int = 64,
+                 join_token: str = "icikit-fleet-r18",
+                 pending_high: float = 4.0,
+                 verify: bool = True, timeout_s: float = 900.0,
+                 coord_env: dict | None = None,
+                 engine_env: dict | None = None) -> dict:
+    """The kill-the-leader arm: ``1 + n_standbys`` coordinator
+    PROCESSES over one shared ``ha_dir`` (journal + lease) and
+    ``n_engines`` workers that resolve the leader through the lease
+    file. ``kill_leader_at`` lists completed-fractions of the timed
+    workload at which the driver SIGKILLs the current leader
+    (``n_standbys`` must cover them); ``kill_engine_at``/
+    ``engine_env`` arm engine-side chaos; ``join_engine`` spawns one
+    extra engine (bridge-rewarmed, token-authenticated) when the
+    coordinator's queue-depth watch alerts — scale-up-to-first-token
+    is measured from that decision instant. ``coord_env`` maps
+    coordinator name -> extra env (the soak's per-process chaos
+    plans)."""
+    from icikit.fleet.ha import LeaderClient, LeaderLease
+    from icikit.fleet.worker import build_model
+
+    horizon = prompt_len + 1 + new_max
+    model_spec = {"preset": preset,
+                  "overrides": {"max_seq": max(64, horizon)},
+                  "compute_dtype": "float32", "dp": 1, "tp": 1,
+                  "init_seed": 0}
+    per_row = -(-horizon // block_size)
+    serve_kw = dict(max_rows=rows, block_size=block_size,
+                    n_blocks=per_row * rows + per_row,
+                    max_prompt=prompt_len + 1, max_new=new_max,
+                    prefill_chunk=prefill_chunk)
+    model = build_model(model_spec)
+    _, _, cfg = model
+    workload = make_workload(n_requests, rate_rps, prompt_len,
+                             new_min, new_max, cfg.vocab, seed,
+                             seed_per_request=seed_per_request)
+    tmpdir = tempfile.mkdtemp(prefix="icikit_fleet_ha_")
+    ha_dir = os.path.join(tmpdir, "ha")
+    store = os.path.join(tmpdir, "bridge")
+    coord_cfg = {"ha_dir": ha_dir, "store_dir": store,
+                 "lease_s": lease_s,
+                 "lease_timeout_s": lease_timeout_s,
+                 "heartbeat_timeout_s": heartbeat_timeout_s,
+                 "reap_interval_s": 0.1,
+                 "snapshot_every": snapshot_every,
+                 "join_token": join_token,
+                 "watch": {"pending_high": pending_high}}
+    coords: dict = {}
+    coords["coord0"] = spawn_coordinator(
+        {**coord_cfg, "owner": "coord0", "role": "leader"},
+        tmpdir, "coord0", env_extra=(coord_env or {}).get("coord0"))
+    lc = LeaderClient(ha_dir, resolve_timeout_s=max(
+        30.0, lease_timeout_s * 10))
+    lease = LeaderLease(ha_dir, timeout_s=lease_timeout_s)
+    # seed-leader barrier BEFORE the standbys exist: a standby that
+    # boots into a lease-less dir would race coord0 for epoch 1
+    _seed_deadline = time.monotonic() + 60.0
+    while True:
+        _cur, _status = lease.read()
+        if _status == "ok" and _cur.get("addr"):
+            break
+        if coords["coord0"].poll() is not None:
+            raise RuntimeError("seed leader died before acquiring "
+                               "the lease")
+        if time.monotonic() > _seed_deadline:
+            raise TimeoutError("seed leader never acquired the lease")
+        time.sleep(0.05)
+    for i in range(1, 1 + n_standbys):
+        name = f"coord{i}"
+        coords[name] = spawn_coordinator(
+            {**coord_cfg, "owner": name, "role": "standby"},
+            tmpdir, name, env_extra=(coord_env or {}).get(name))
+    kill_at = sorted(max(1, int(f * n_requests))
+                     for f in (kill_leader_at or ()))
+    if len(kill_at) > n_standbys:
+        raise ValueError("more leader kills than standbys")
+    procs: dict = {}
+    failovers: list = []
+    joined_eid, t_join, join_alert = None, None, None
+    rec: dict = {}
+    try:
+        stats, _ = lc.call("fleet_stats")      # leader-up barrier
+        epoch0 = stats["epoch"]
+        lc.call("hold", {"flag": True})
+        for i in range(n_engines):
+            eid = f"both{i}"
+            procs[eid] = spawn_worker(
+                None, eid, "both", model_spec, serve_kw, tmpdir,
+                env_extra=(engine_env or {}).get(eid),
+                ha_dir=ha_dir, token=join_token)
+        deadline = time.monotonic() + timeout_s
+        while True:
+            stats, _ = lc.call("fleet_stats")
+            live = sum(1 for e in stats["engines"].values()
+                       if e["state"] == "live")
+            if live >= n_engines:
+                break
+            if time.monotonic() > deadline:
+                raise TimeoutError("workers never registered")
+            if any(p.poll() is not None for p in procs.values()):
+                raise RuntimeError("a worker died before hello")
+            time.sleep(0.05)
+        # warm phase (under hold): every engine compiles before the
+        # clock starts; the kill thresholds key on TIMED completions
+        rng = np.random.default_rng(seed + 7)
+        warm_rids = []
+        for _ in range(2 * rows * n_engines):
+            wp = rng.integers(0, cfg.vocab,
+                              (prompt_len,)).astype(np.int32)
+            r, _ = lc.call("submit", {
+                "prompt": wp.tolist(), "n_new": 2,
+                "temperature": temperature, "top_k": top_k,
+                "top_p": top_p})
+            warm_rids.append(r["rid"])
+        lookup = _rpc_lookup(lc)
+        deadline = time.monotonic() + timeout_s
+        while any(lookup(r).state != "done" for r in warm_rids):
+            if time.monotonic() > deadline:
+                raise TimeoutError("fleet warm-up did not complete")
+            time.sleep(0.05)
+        warm_base = len(warm_rids)
+        # timed window
+        t0 = time.monotonic()
+        rids = []
+        for off, p, n, rs in workload:
+            r, _ = lc.call("submit", {
+                "prompt": np.asarray(p).tolist(), "n_new": int(n),
+                "not_before": t0 + off, "seed": int(rs),
+                "temperature": temperature, "top_k": top_k,
+                "top_p": top_p})
+            rids.append(r["rid"])
+        lc.call("hold", {"flag": False})
+        deadline = time.monotonic() + timeout_s
+        kills_done = 0
+        while True:
+            stats, _ = lc.call("fleet_stats")
+            progress = stats["completed"] - warm_base
+            if kills_done < len(kill_at) \
+                    and progress >= kill_at[kills_done]:
+                cur, status = lease.read()
+                owner = cur.get("owner") if status == "ok" else None
+                victim = coords.get(owner)
+                if victim is not None and victim.poll() is None:
+                    prev_epoch = stats["epoch"]
+                    t_kill = time.monotonic()
+                    victim.kill()          # SIGKILL mid-decode
+                    kills_done += 1
+                    # block until a successor answers with a higher
+                    # epoch — LeaderClient retargets through the lease
+                    while True:
+                        stats, _ = lc.call("fleet_stats")
+                        if stats["epoch"] > prev_epoch:
+                            break
+                        if time.monotonic() > deadline:
+                            raise TimeoutError("failover never "
+                                               "completed")
+                        time.sleep(0.02)
+                    failovers.append({
+                        "ms": round((time.monotonic() - t_kill)
+                                    * 1e3, 1),
+                        "from_epoch": prev_epoch,
+                        "to_epoch": stats["epoch"],
+                        "killed": owner})
+            if join_engine and joined_eid is None:
+                alerts = (stats.get("watch") or {}).get("alerts", [])
+                hit = [a for a in alerts
+                       if a.get("metric") == "fleet.pending"]
+                if hit:
+                    join_alert = hit[0]
+                    t_join = time.monotonic()
+                    joined_eid = "joiner"
+                    procs[joined_eid] = spawn_worker(
+                        None, joined_eid, "both", model_spec,
+                        serve_kw, tmpdir, rewarm=True,
+                        ha_dir=ha_dir, token=join_token)
+            if stats["pending"] == 0 and progress >= len(rids):
+                break
+            if sum(p.poll() is None for p in procs.values()) < 1:
+                raise RuntimeError("fleet collapsed: no engine alive")
+            if time.monotonic() > deadline:
+                raise TimeoutError("fleet did not drain in time")
+            time.sleep(0.05)
+        makespan = time.monotonic() - t0
+        # audit BEFORE shutdown: the tokens live in the leader
+        audit = {}
+        for rid in rids:
+            reply, _ = lc.call("request", {"rid": rid})
+            audit[rid] = reply
+        # engines exit through their normal drained path
+        for eid, p in procs.items():
+            if p.poll() is None:
+                try:
+                    p.wait(timeout=60)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+        # kill surviving UNPROMOTED standbys before stopping the
+        # leader — otherwise one of them would helpfully take over
+        # the moment the lease expires
+        cur, _status = lease.read()
+        owner = (cur or {}).get("owner")
+        for name, p in coords.items():
+            if name != owner and p.poll() is None:
+                p.kill()
+        # final stats FIRST, shutdown best-effort afterwards: the
+        # coordinator tears its RPC server down right after setting
+        # the shutdown event, so the shutdown reply can lose the race
+        # to the socket close — stats must already be in hand
+        final, _ = lc.call("fleet_stats")
+        try:
+            lc.call("shutdown")
+        except (TimeoutError, OSError):
+            pass
+        failed = [r for r in rids
+                  if audit[r].get("state") != "done"]
+        scaleup = None
+        if joined_eid is not None:
+            fc = (final["engines"].get(joined_eid) or {}) \
+                .get("first_commit_t")
+            if fc is not None and t_join is not None:
+                # CLOCK_MONOTONIC is host-wide: the coordinator's
+                # commit stamp and the driver's join decision share
+                # a clock domain
+                scaleup = round((fc - t_join) * 1e3, 1)
+        coord_events = [e for name in coords
+                        for e in _obs_events(tmpdir, name)]
+        elected = [e for e in coord_events
+                   if e.get("event") == "fleet.leader.elected"]
+        drill_names = [e.get("event") for e in coord_events]
+        tokens = sum(len(audit[r]["tokens"]) for r in rids
+                     if audit[r].get("state") == "done")
+        rec = {
+            "kind": "serve_fleet_ha",
+            "preset": preset,
+            "n_engines": n_engines,
+            "n_standbys": n_standbys,
+            "n_requests": n_requests,
+            "rate_rps": rate_rps,
+            "prompt_len": prompt_len,
+            "new_min": new_min, "new_max": new_max,
+            "rows": rows, "block_size": block_size,
+            "prefill_chunk": prefill_chunk,
+            "temperature": temperature,
+            "top_k": top_k, "top_p": top_p,
+            "seed_per_request": seed_per_request,
+            "seed": seed,
+            "lease_s": lease_s,
+            "lease_timeout_s": lease_timeout_s,
+            "snapshot_every": snapshot_every,
+            "compute_dtype": "float32",
+            "tokens": tokens,
+            "makespan_s": round(makespan, 4),
+            "tokens_per_s": round(tokens / makespan, 2),
+            "completed": len(rids) - len(failed),
+            "failed": len(failed),
+            "leader_kills": kills_done,
+            "failovers": failovers,
+            "failover_ms": [f["ms"] for f in failovers],
+            "final_epoch": final["epoch"],
+            "first_epoch": epoch0,
+            "elected_events": len(elected),
+            # chaos-induced failovers are invisible to the driver's
+            # own kill loop; the elected events carry their takeover
+            # cost so the ledger gets the FULL failover distribution
+            "elected": [{k: e.get(k) for k in
+                         ("owner", "epoch", "takeover_ms",
+                          "replayed", "torn")} for e in elected],
+            "reissues": final.get("reissues"),
+            "duplicate_commits": final.get("duplicate_commits"),
+            "handoffs": final.get("handoffs"),
+            "journal": final.get("journal"),
+            "joined_engine": joined_eid,
+            "join_alert": join_alert,
+            "scaleup_ttft_ms": scaleup,
+            "chaos_events": {
+                "epoch_collision": drill_names.count(
+                    "fleet.leader.epoch_collision"),
+                "lease_corrupt": drill_names.count(
+                    "fleet.leader.lease_corrupt"),
+            },
+            "note": "CPU-measured; coordinators+engines share "
+                    "physical cores — failover times include "
+                    "co-tenant scheduling noise",
+        }
+        if verify:
+            class _A:
+                __slots__ = ("state", "tokens")
+            def _audit_lookup(rid):
+                a = _A()
+                a.state = audit[rid].get("state")
+                a.tokens = audit[rid].get("tokens") or []
+                return a
+            rec.update(_verify_identity(model, _audit_lookup, rids,
+                                        workload, temperature,
+                                        top_k, top_p))
+    finally:
+        lc.close()
+        for p in list(procs.values()) + list(coords.values()):
+            if p.poll() is None:
+                p.kill()
+    rec["engines"] = _collect_worker_stats(list(procs.values()))
+    rec["coordinators"] = {
+        name: {"returncode": p.returncode}
+        for name, p in coords.items()}
     return rec
 
 
@@ -383,7 +769,58 @@ def main(argv=None) -> int:
                          "least one lease (the kill drill's "
                          "assertion)")
     ap.add_argument("--json", dest="json_path", default=None)
+    ap.add_argument("--ha", action="store_true",
+                    help="HA arm: out-of-process journaled "
+                         "coordinators + warm standby; implies the "
+                         "kill-the-leader drill")
+    ap.add_argument("--standbys", type=int, default=1)
+    ap.add_argument("--kill-leader-at", action="append", type=float,
+                    default=[], metavar="FRAC",
+                    help="SIGKILL the leader when FRAC of the timed "
+                         "workload has completed (repeatable; "
+                         "default 0.4)")
+    ap.add_argument("--no-join", action="store_true",
+                    help="HA arm: skip the elastic scale-up engine")
+    ap.add_argument("--lease-timeout", type=float, default=1.5,
+                    help="leader lease timeout (s): failover must "
+                         "complete inside 2x this")
     args = ap.parse_args(argv)
+    if args.ha:
+        rec = run_fleet_ha(
+            args.engines, args.requests, args.rate, args.prompt,
+            args.new_min, args.new_max, preset=args.preset,
+            n_standbys=args.standbys,
+            kill_leader_at=tuple(args.kill_leader_at) or (0.4,),
+            join_engine=not args.no_join,
+            temperature=args.temperature, top_k=args.top_k,
+            top_p=args.top_p,
+            seed_per_request=args.seed_per_request, seed=args.seed,
+            rows=args.rows, block_size=args.block_size,
+            prefill_chunk=args.prefill_chunk,
+            lease_s=args.lease,
+            lease_timeout_s=args.lease_timeout,
+            verify=args.verify_identity, timeout_s=args.timeout)
+        obs.emit_records([rec])
+        if args.json_path:
+            with open(args.json_path, "a") as f:
+                f.write(json.dumps(rec) + "\n")
+        # CLI summary line, not telemetry: the record already went
+        # through the bus (emit_records above)
+        print(json.dumps({k: rec.get(k) for k in  # icikit-lint: off[obs-print]
+                          ("completed", "failed", "leader_kills",
+                           "failover_ms", "elected_events",
+                           "duplicate_commits", "scaleup_ttft_ms",
+                           "identity_ok")}))
+        bound_ms = args.lease_timeout * 2 * 1e3
+        ok = (not rec["failed"]
+              and rec.get("identity_ok", True)
+              and rec["leader_kills"] >= 1
+              and rec["elected_events"] >= rec["leader_kills"]
+              and rec["duplicate_commits"] == 0
+              and all(ms < bound_ms for ms in rec["failover_ms"]))
+        if not ok:
+            print(f"HA smoke failed (failover bound {bound_ms}ms)")
+        return 0 if ok else 1
     role_list = roles_for(args.engines, args.roles)
     env_extra = {}
     for i, spec in enumerate(args.kill):

@@ -10,14 +10,30 @@ computes garbage" (integrity-verify failures → quarantine the engine,
 reissue its in-flight work). See docs/FLEET.md.
 
 Layering: ``transport`` (frames/checksums/RPC, host-only) →
-``kvbridge`` (store-shaped block migration) → ``coordinator`` (queue
-owner, roles, defect ledger, fleet metrics) → ``roles`` (queue-shaped
-engine proxy + workers) → ``worker`` (subprocess entry). The control
-plane (transport/coordinator/kvbridge) never touches jax — enforced
-by the ``fleet-control-plane`` analysis rule.
+``kvbridge`` (store-shaped block migration) → ``journal``
+(append-before-ack verb log + replay, r18) → ``ha`` (leader lease,
+warm standby, failover-aware client) → ``coordinator`` (queue owner,
+roles, defect ledger, fleet metrics) → ``roles`` (queue-shaped engine
+proxy + workers) → ``worker`` (subprocess entry). The control plane
+(transport/coordinator/kvbridge/journal/ha) never touches jax —
+enforced by the ``fleet-control-plane`` analysis rule.
 """
 
-from icikit.fleet.coordinator import Coordinator  # noqa: F401
+from icikit.fleet.coordinator import Coordinator, DeposedError  # noqa: F401
+from icikit.fleet.ha import (  # noqa: F401
+    HaContext,
+    LeaderClient,
+    LeaderLease,
+    LostElection,
+    Standby,
+    become_leader,
+)
+from icikit.fleet.journal import (  # noqa: F401
+    EpochCollision,
+    Journal,
+    JournalTail,
+    replay,
+)
 from icikit.fleet.kvbridge import BlockBridge, BridgeStore  # noqa: F401
 from icikit.fleet.roles import (  # noqa: F401
     EngineWorker,

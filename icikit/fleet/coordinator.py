@@ -38,6 +38,19 @@ the wire because none of it ever depended on being in one process:
   (``fleet.engines.alive``, ``fleet.kv.migrations``, ...) land on
   the coordinator's obs bus.
 
+- **HA (r18)** — with an :mod:`icikit.fleet.ha` context attached, the
+  queue journals every verb append-before-ack
+  (:mod:`icikit.fleet.journal`), the reap loop renews the leader
+  lease and snapshots periodically, and a renewal failure **deposes**
+  this coordinator: every mutating op raises
+  :class:`DeposedError` from then on, bounding the stale-write
+  window to one renewal interval — and even inside that window,
+  stale appends land in this epoch's own journal segments, which the
+  successor's takeover snapshot supersedes. Engine joins are
+  authenticated by a shared ``join_token``; a fresh leader's replayed
+  queue denies claims from engines it has never seen, and the engine
+  re-registers (``fleet.roster.joins``) — the elastic-roster path.
+
 Control plane rule (``fleet-control-plane`` analysis rule): this
 module performs no jax device dispatch and allocates no jnp arrays —
 claims, leases and KV bytes move over host sockets only.
@@ -61,6 +74,13 @@ ROLES = ("prefill", "decode", "both")
 DEFAULT_HEARTBEAT_TIMEOUT_S = 60.0
 
 
+class DeposedError(RuntimeError):
+    """This coordinator lost its leader lease: it must not mutate the
+    queue again (its journal epoch is dead). Surfaces to RPC clients
+    as ``RpcError(etype="DeposedError")`` — the resolving client's
+    cue to re-read the lease file and retarget the successor."""
+
+
 class Coordinator:
     """Owns the queue, the engine registry, the block bridge, and the
     RPC surface the engine workers speak.
@@ -77,8 +97,15 @@ class Coordinator:
                  DEFAULT_HEARTBEAT_TIMEOUT_S,
                  reap_interval_s: float = 0.25,
                  defect_threshold: int = 1,
-                 host: str = "127.0.0.1", port: int = 0):
-        self.queue = RequestQueue(lease_s=lease_s)
+                 host: str = "127.0.0.1", port: int = 0,
+                 ha=None, join_token: str | None = None,
+                 snapshot_every: int = 512, watch=None):
+        if ha is not None and ha.queue is not None:
+            # a replayed queue (takeover or restart): already holds
+            # every in-flight request the previous leader journaled
+            self.queue = ha.queue
+        else:
+            self.queue = RequestQueue(lease_s=lease_s)
         self.bridge = BlockBridge(PrefixStore(store_dir))
         self.heartbeat_timeout_s = heartbeat_timeout_s
         self.defect_threshold = defect_threshold
@@ -89,8 +116,31 @@ class Coordinator:
         self.n_handoffs = 0
         self._hold = False
         self._stop = threading.Event()
+        self._ha = ha
+        self.join_token = join_token
+        self.snapshot_every = int(snapshot_every)
+        self.epoch = ha.epoch if ha is not None else 0
+        self._deposed = False
+        self._watch = watch
+        self.shutdown_requested = threading.Event()
+        if ha is not None:
+            meta = ha.meta.to_dict() if ha.meta is not None else {}
+            self._phase = dict(meta.get("phases") or {})
+            # replayed owners: engines of the PREVIOUS life — kept so
+            # a heartbeat-timeout sweep can expire their rids; the
+            # engines themselves must re-hello before claiming again
+            self._owner = dict(meta.get("owners") or {})
+            self.n_handoffs = int(meta.get("n_handoffs") or 0)
+            self.queue.journal = ha.journal.append
         self.server = RpcServer(self._handle, host=host, port=port)
         self.addr = self.server.addr
+        if ha is not None:
+            # publish the bound address on the lease, then pin a
+            # takeover snapshot: replay for the NEXT life starts at
+            # this epoch's first segment, superseding every record a
+            # deposed predecessor might still append
+            ha.publish(self.addr)
+            self._checkpoint()
         self._reaper = threading.Thread(
             target=self._reap_loop, args=(reap_interval_s,),
             daemon=True, name="fleet-reaper")
@@ -98,10 +148,23 @@ class Coordinator:
 
     # -- client side (the bench / the driving process) ---------------
 
+    def _check_leader(self) -> None:
+        if self._deposed:
+            raise DeposedError(
+                f"coordinator epoch {self.epoch} lost its lease")
+
+    def _journal_meta(self, verb: str, rec: dict) -> None:
+        """Append one coordinator-side record (``cphase``/``cowner``)
+        — called under ``self._lock`` so meta records serialize with
+        the snapshot the same way queue verbs do under theirs."""
+        if self._ha is not None:
+            self._ha.journal.append(verb, rec)
+
     def submit(self, prompt, n_new: int, **kw) -> str:
         """Queue one request. With disaggregation active (the registry
         holds a dedicated prefill engine AND a decode-capable one),
         the request enters prefill phase; otherwise any-role."""
+        self._check_leader()
         rid = self.queue.submit(prompt, n_new, **kw)
         with self._lock:
             roles = {e["role"] for e in self._engines.values()
@@ -109,6 +172,8 @@ class Coordinator:
             disagg = "prefill" in roles and (
                 "decode" in roles or "both" in roles)
             self._phase[rid] = "prefill" if disagg else "any"
+            self._journal_meta("cphase", {"rid": rid,
+                                          "phase": self._phase[rid]})
         return rid
 
     def drained(self) -> bool:
@@ -202,21 +267,33 @@ class Coordinator:
                 e["last_seen"] = time.monotonic()
 
     def _op_hello(self, msg, blobs):
+        self._check_leader()
         engine_id, role = msg["engine"], msg["role"]
         if role not in ROLES:
             raise ValueError(f"unknown role {role!r} (known: {ROLES})")
+        if self.join_token is not None \
+                and msg.get("token") != self.join_token:
+            obs.count("fleet.roster.join_denied")
+            raise PermissionError(
+                f"engine {engine_id!r}: join token mismatch")
         with self._lock:
+            rejoin = engine_id in self._engines
             self._engines[engine_id] = {
                 "role": role, "state": "live",
                 "last_seen": time.monotonic(), "defects": 0,
-                "stats": {}}
+                "first_commit_t": None, "stats": {}}
         obs.count("fleet.engine.registered")
         obs.emit("fleet.engine.registered", engine=engine_id,
                  role=role)
+        obs.count("fleet.roster.joins")
+        obs.emit("fleet.roster.joined", engine=engine_id, role=role,
+                 rejoin=rejoin, epoch=self.epoch)
         self._gauges()
-        return {"lease_s": self.queue.lease_s}, ()
+        return {"lease_s": self.queue.lease_s,
+                "epoch": self.epoch}, ()
 
     def _op_claim(self, msg, blobs):
+        self._check_leader()
         engine_id = msg["engine"]
         self._touch(engine_id)
         with self._lock:
@@ -241,6 +318,8 @@ class Coordinator:
         wire = self._serialize_claim(req, role)
         with self._lock:
             self._owner[req.rid] = engine_id
+            self._journal_meta("cowner", {"rid": req.rid,
+                                          "engine": engine_id})
             still_live = self._engines[engine_id]["state"] == "live"
         if not still_live:
             # a quarantine/death raced the claim between the state
@@ -257,25 +336,26 @@ class Coordinator:
         self.queue.renew(msg["rid"], seq=msg.get("seq"))
         return {}, ()
 
-    def _stamp_marks(self, req, marks: dict) -> None:
-        """Fold engine-side SLO marks onto the authoritative Request
-        (only after a successful, fenced commit — stale engines never
-        reach here). Monotonic times are cross-process comparable on
-        one host (CLOCK_MONOTONIC is machine-wide)."""
-        if not marks:
-            return
-        if req.admit_t is None and marks.get("admit_t") is not None:
-            req.admit_t = float(marks["admit_t"])
-        if (req.first_token_t is None
-                and marks.get("first_token_t") is not None):
-            req.first_token_t = float(marks["first_token_t"])
-        if marks.get("max_gap_ms") is not None:
-            req.max_gap_ms = max(req.max_gap_ms or 0.0,
-                                 float(marks["max_gap_ms"]))
-        if marks.get("prefix_hit_tokens"):
-            req.prefix_hit_tokens += int(marks["prefix_hit_tokens"])
+    def _first_commit(self, engine_id: str) -> None:
+        """Stamp the engine's first successful commit instant — the
+        elastic-roster scale-up metric (join decision -> first token
+        served; monotonic is cross-process comparable on one host)."""
+        now = time.monotonic()
+        with self._lock:
+            e = self._engines.get(engine_id)
+            if e is not None and e.get("first_commit_t") is None:
+                e["first_commit_t"] = now
+
+    def _observe_slo(self, rid: str) -> None:
+        """Feed the request's terminal TTFT into this process's
+        histogram registry — what the fleet_watch SLO-burn detector
+        windows over for the scale-up signal."""
+        slo = self.queue.request(rid).slo()
+        if slo.get("ttft_ms") is not None:
+            obs.observe("serve.ttft_ms", float(slo["ttft_ms"]))
 
     def _op_complete(self, msg, blobs):
+        self._check_leader()
         engine_id, rid = msg["engine"], msg["rid"]
         seq = msg.get("seq")
         tokens = [int(t) for t in msg["tokens"]]
@@ -298,23 +378,30 @@ class Coordinator:
             state = self.queue.handoff(rid, tokens, seq=seq)
             if state == "stale":
                 return {"state": "stale", "committed": False}, ()
-            self._stamp_marks(req, msg.get("marks"))
+            self.queue.stamp_marks(rid, msg.get("marks"))
+            self._first_commit(engine_id)
             if state == "queued":
                 with self._lock:
                     self._phase[rid] = "decode"
                     self.n_handoffs += 1
                     self._owner.pop(rid, None)
+                    self._journal_meta("cphase", {"rid": rid,
+                                                  "phase": "decode"})
                 obs.count("fleet.handoffs")
             else:
                 self._untrack(rid)
+                self._observe_slo(rid)
             return {"state": state, "committed": True}, ()
         committed = self.queue.complete(rid, full, seq=seq)
         if committed:
-            self._stamp_marks(req, msg.get("marks"))
+            self.queue.stamp_marks(rid, msg.get("marks"))
+            self._first_commit(engine_id)
             self._untrack(rid)
+            self._observe_slo(rid)
         return {"state": req.state, "committed": committed}, ()
 
     def _op_fail(self, msg, blobs):
+        self._check_leader()
         engine_id, rid = msg["engine"], msg["rid"]
         self._touch(engine_id)
         exc = RuntimeError(msg.get("error", "engine failure"))
@@ -333,12 +420,99 @@ class Coordinator:
         return {"state": state}, ()
 
     def _op_release(self, msg, blobs):
+        self._check_leader()
         self._touch(msg["engine"])
         self.queue.release(msg["rid"],
                            delay=float(msg.get("delay", 0.0)),
                            seq=msg.get("seq"))
         self._untrack(msg["rid"], requeued=True)
         return {}, ()
+
+    # -- driver-side RPC surface (the HA bench/soak process) ---------
+
+    def _op_submit(self, msg, blobs):
+        """Remote submit — the HA driver runs out-of-process (it must
+        survive this coordinator's death), so admission is an RPC."""
+        rid = self.submit(
+            np.asarray(msg["prompt"], np.int32),
+            int(msg["n_new"]),
+            eos_id=msg.get("eos_id"),
+            not_before=msg.get("not_before"),
+            max_retries=int(msg.get("max_retries", 2)),
+            quant=bool(msg.get("quant", False)),
+            seed=int(msg.get("seed", 0)),
+            temperature=float(msg.get("temperature", 0.0)),
+            top_k=int(msg.get("top_k", 0)),
+            top_p=float(msg.get("top_p", 1.0)))
+        return {"rid": rid}, ()
+
+    def _op_request(self, msg, blobs):
+        """Serialized view of one request — the driver's post-drain
+        audit read (tokens compared bitwise against single-request
+        decode)."""
+        try:
+            req = self.queue.request(msg["rid"])
+        except KeyError:
+            return {"known": False}, ()
+        return {"known": True, "state": req.state,
+                "tokens": [int(t) for t in req.tokens],
+                "error": req.error, "slo": req.slo()}, ()
+
+    def _op_hold(self, msg, blobs):
+        self.hold(bool(msg["flag"]))
+        return {}, ()
+
+    def _op_fleet_stats(self, msg, blobs):
+        with self._lock:
+            engines = {eid: {"role": e["role"], "state": e["state"],
+                             "defects": e["defects"],
+                             "first_commit_t": e.get("first_commit_t"),
+                             "stats": dict(e["stats"])}
+                       for eid, e in self._engines.items()}
+            n_handoffs = self.n_handoffs
+        out = {"epoch": self.epoch,
+               "deposed": self._deposed,
+               "pending": self.queue.pending(),
+               "completed": len(self.queue.done),
+               "failed": len(self.queue.failed),
+               "reissues": self.queue.n_reissues,
+               "duplicate_commits": self.queue.n_duplicate_commits,
+               "handoffs": n_handoffs,
+               "hold": self._hold,
+               "engines": engines,
+               "bridge": self.bridge.stats()}
+        if self._ha is not None:
+            out["journal"] = self._ha.journal.stats()
+        if self._watch is not None:
+            out["watch"] = self._watch.verdict()
+        return out, ()
+
+    def _op_retire(self, msg, blobs):
+        """Graceful scale-down: no further claims for this engine; it
+        drains its in-flight work, then ``drained`` answers True for
+        it and the worker exits through its normal path."""
+        self._check_leader()
+        engine_id = msg["engine"]
+        with self._lock:
+            e = self._engines.get(engine_id)
+            known = e is not None and e["state"] == "live"
+            if known:
+                e["state"] = "retired"
+        if known:
+            obs.count("fleet.roster.retired")
+            obs.emit("fleet.roster.retired", engine=engine_id)
+            self._gauges()
+        return {"retired": known}, ()
+
+    def _op_shutdown(self, msg, blobs):
+        """Driver-initiated clean exit (the CLI main loop watches the
+        event) — replies with final stats first. The event is set on
+        a short timer, not inline: the serve loop tears the RPC
+        server down as soon as it fires, and an inline set races the
+        handler thread's reply write against the socket close."""
+        out, _ = self._op_fleet_stats(msg, blobs)
+        threading.Timer(0.25, self.shutdown_requested.set).start()
+        return out, ()
 
     def _op_report(self, msg, blobs):
         """Heartbeat + per-engine snapshot: keeps ``last_seen`` fresh
@@ -358,6 +532,15 @@ class Coordinator:
         return {"state": state}, ()
 
     def _op_drained(self, msg, blobs):
+        engine_id = msg.get("engine")
+        if engine_id is not None:
+            with self._lock:
+                e = self._engines.get(engine_id)
+                retired = e is not None and e["state"] == "retired"
+            if retired and not self._rids_of(engine_id):
+                # a retired engine leaves as soon as ITS plate is
+                # clean — the rest of the fleet keeps serving
+                return {"drained": True}, ()
         return {"drained": self.queue.drained()
                 and not self._hold}, ()
 
@@ -412,8 +595,39 @@ class Coordinator:
                  reason=reason, reissued=reaped)
         self._gauges()
 
+    def _checkpoint(self) -> None:
+        """Snapshot queue + coordinator meta as ONE compaction point.
+        Holds the coordinator lock across the queue snapshot so no
+        ``cphase``/``cowner`` record lands between the meta capture
+        and the ``snap`` append (replay would supersede it with the
+        stale copy). May no-op (queue mid-requeue) — retried next
+        reap tick."""
+        if self._ha is None:
+            return
+        with self._lock:
+            meta = {"phases": dict(self._phase),
+                    "owners": dict(self._owner),
+                    "n_handoffs": self.n_handoffs}
+            self.queue.checkpoint(meta=meta)
+
+    def _ha_tick(self) -> None:
+        """Renew the leader lease (a failed renewal deposes us — from
+        then on every mutating op raises DeposedError) and keep
+        replay bounded with a periodic snapshot."""
+        if self._ha is None or self._deposed:
+            return
+        if not self._ha.renew():
+            self._deposed = True
+            obs.count("fleet.leader.losses")
+            obs.emit("fleet.leader.lost", epoch=self.epoch)
+            return
+        if (self._ha.journal.records_since_snap
+                >= self.snapshot_every):
+            self._checkpoint()
+
     def _reap_loop(self, interval: float) -> None:
         while not self._stop.wait(interval):
+            self._ha_tick()
             self.queue.reap_expired()
             now = time.monotonic()
             dead = []
@@ -429,6 +643,8 @@ class Coordinator:
                 obs.emit("fleet.engine.dead", engine=eid)
                 self.queue.expire(self._rids_of(eid))
             self._gauges()
+            if self._watch is not None:
+                self._watch.maybe_poll()
 
     def _gauges(self) -> None:
         with self._lock:

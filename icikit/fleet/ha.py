@@ -1,0 +1,468 @@
+"""Leader election + warm standby for the fleet coordinator (r18).
+
+The control plane's HA story has three legs, all host-side (no jax —
+the ``fleet-control-plane`` analysis rule enforces it):
+
+- **Leader lease** — ``<ha_dir>/leader.json`` is a checksummed,
+  atomically-replaced claim ``{epoch, owner, addr, deadline}``. The
+  deadline is ``time.monotonic()``-based: CLOCK_MONOTONIC is shared by
+  every process on the box (the single-host fleet's clock domain), so
+  a standby can compare the leader's deadline against its own clock.
+  A reader that fails the checksum treats the file as UNKNOWN, not
+  expired: promotion on one corrupt read would make a half-written
+  lease a double-leader factory. Two consecutive corrupt reads mean
+  the file is rotten at rest — then the journal's own epoch floor
+  (:func:`icikit.fleet.journal.epoch_floor`) substitutes for the
+  unreadable epoch and the standby promotes over it.
+- **Epoch fencing** — every acquisition mints ``max(seen, floor)+1``.
+  If two candidates still mint the same epoch (the lease file lied),
+  the journal's ``O_EXCL`` segment creation is the backstop: the loser
+  gets :class:`~icikit.fleet.journal.EpochCollision`, bumps its floor
+  past the collision, and re-elects. A deposed leader keeps its OLD
+  epoch; its stale appends land in old-epoch segments that the
+  successor's takeover snapshot supersedes (see journal docstring).
+- **Warm standby** — :class:`Standby` tails the journal into a live
+  :class:`~icikit.serve.scheduler.RequestQueue` replica while
+  watching the lease. On expiry it acquires, drains the tail, and
+  hands the coordinator a ready :class:`HaContext` — takeover cost is
+  one final ``poll`` plus the snapshot, not a full replay.
+
+Chaos sites: ``fleet.ha.lease`` (corrupt the lease bytes at read —
+the corrupt-leader-file drill) and ``fleet.ha.epoch`` (io-fail at
+epoch mint time, modeled as "the candidate read a stale epoch": it
+re-mints an already-used epoch and must recover through the
+``EpochCollision`` path — the double-leader drill).
+
+``python -m icikit.fleet.ha cfg.json`` runs one coordinator process
+(leader or standby role) for the HA soak and ``make fleet-ha-smoke``.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import threading
+import time
+
+import numpy as np
+
+from icikit import chaos, obs
+from icikit.fleet import journal as jlog
+from icikit.serve.scheduler import DEFAULT_LEASE_S
+
+chaos.register_site("fleet.ha.lease", "fleet.ha.epoch")
+
+DIGEST_BYTES = 16
+DEFAULT_LEASE_TIMEOUT_S = 2.0
+DEFAULT_RENEW_S = 0.25
+
+
+class LostElection(RuntimeError):
+    """A candidate raced for the lease and lost to a live leader.
+    Recoverable by design: a standby goes back to tailing, a cold
+    starter retries within its ``wait_s`` budget."""
+
+
+def _lease_path(ha_dir: str) -> str:
+    return os.path.join(ha_dir, "leader.json")
+
+
+class LeaderLease:
+    """The checksummed leader claim file. All methods are single-shot
+    and crash-safe: writes go through ``tmp + os.replace``, reads
+    verify a trailing blake2b line before parsing."""
+
+    def __init__(self, ha_dir: str,
+                 timeout_s: float = DEFAULT_LEASE_TIMEOUT_S):
+        self.ha_dir = ha_dir
+        self.timeout_s = float(timeout_s)
+
+    def read(self):
+        """-> ``(claim_dict | None, status)`` with status ``"ok"``,
+        ``"missing"`` or ``"corrupt"``. Corrupt is NOT expired — the
+        caller owns the promote-or-wait policy."""
+        try:
+            with open(_lease_path(self.ha_dir), "rb") as f:
+                raw = f.read()
+        except FileNotFoundError:
+            return None, "missing"
+        if chaos.active() is not None and raw:
+            arr = np.frombuffer(raw, np.uint8).copy()
+            out = chaos.maybe_corrupt("fleet.ha.lease", arr)
+            raw = out.tobytes()
+        payload, _, digest = raw.rpartition(b"\n")
+        want = hashlib.blake2b(
+            payload, digest_size=DIGEST_BYTES).hexdigest().encode()
+        if digest.strip() != want:
+            obs.count("fleet.leader.lease_corrupt")
+            obs.emit("fleet.leader.lease_corrupt")
+            return None, "corrupt"
+        try:
+            return json.loads(payload.decode()), "ok"
+        except (UnicodeDecodeError, ValueError):
+            obs.count("fleet.leader.lease_corrupt")
+            obs.emit("fleet.leader.lease_corrupt")
+            return None, "corrupt"
+
+    def _write(self, claim: dict) -> None:
+        payload = json.dumps(claim, allow_nan=False).encode()
+        digest = hashlib.blake2b(
+            payload, digest_size=DIGEST_BYTES).hexdigest().encode()
+        os.makedirs(self.ha_dir, exist_ok=True)
+        tmp = _lease_path(self.ha_dir) + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(payload + b"\n" + digest)
+        os.replace(tmp, _lease_path(self.ha_dir))
+
+    def try_acquire(self, owner: str, addr=None,
+                    floor: int = 0) -> int | None:
+        """Claim leadership if the current lease is expired, missing,
+        ours, or (caller's policy) rotten. Returns the minted epoch,
+        or None while another live owner holds the lease."""
+        now = time.monotonic()
+        cur, status = self.read()
+        if (status == "ok" and cur.get("owner") != owner
+                and float(cur.get("deadline", 0)) > now):
+            return None
+        seen = int(cur.get("epoch", 0)) if cur else 0
+        epoch = max(seen, floor) + 1
+        try:
+            chaos.maybe_io_fail("fleet.ha.epoch")
+        except chaos.InjectedIOError:
+            # drill: this candidate minted from a STALE epoch read —
+            # collide with an epoch the journal already holds, so the
+            # O_EXCL backstop has to catch it downstream
+            stale = max(seen, floor)
+            if stale >= 1:
+                epoch = stale
+        self._write({"epoch": epoch, "owner": owner,
+                     "addr": list(addr) if addr else None,
+                     "deadline": now + self.timeout_s})
+        return epoch
+
+    def renew(self, owner: str, epoch: int, addr=None) -> bool:
+        """Push the deadline out; False means DEPOSED (a higher epoch
+        or a different live owner took over) and the caller must stop
+        acting as leader immediately."""
+        now = time.monotonic()
+        cur, status = self.read()
+        if status == "ok":
+            if int(cur.get("epoch", 0)) > int(epoch):
+                return False
+            if (cur.get("owner") != owner
+                    and float(cur.get("deadline", 0)) > now):
+                return False
+        # missing/corrupt/ours: (re)assert — the leader repairs its
+        # own rotten lease file rather than deposing itself
+        self._write({"epoch": int(epoch), "owner": owner,
+                     "addr": list(addr) if addr else None,
+                     "deadline": now + self.timeout_s})
+        return True
+
+
+class HaContext:
+    """What a coordinator needs to BE the leader: the minted epoch,
+    the started journal, the replayed queue + meta (None/empty on a
+    fresh cluster), and the lease to keep renewing."""
+
+    def __init__(self, ha_dir: str, owner: str, lease: LeaderLease,
+                 journal: jlog.Journal, epoch: int,
+                 queue=None, meta=None):
+        self.ha_dir = ha_dir
+        self.owner = owner
+        self.lease = lease
+        self.journal = journal
+        self.epoch = epoch
+        self.queue = queue
+        self.meta = meta
+        self.addr = None
+
+    def publish(self, addr) -> None:
+        """Stamp the bound RPC address on the lease so resolvers
+        (:class:`LeaderClient`) can find the new leader."""
+        self.addr = tuple(addr)
+        self.lease.renew(self.owner, self.epoch, addr=self.addr)
+
+    def renew(self) -> bool:
+        return self.lease.renew(self.owner, self.epoch,
+                                addr=self.addr)
+
+    def close(self) -> None:
+        self.journal.close()
+
+
+def _elect(ha_dir: str, owner: str, lease: LeaderLease,
+           queue, meta, floor: int, t0: float,
+           replayed: int, torn: int) -> HaContext:
+    """Mint an epoch + start its journal, riding out epoch collisions
+    by re-acquiring above the colliding epoch."""
+    while True:
+        epoch = lease.try_acquire(owner, floor=floor)
+        if epoch is None:
+            raise LostElection(
+                f"{owner}: lease held by a live leader")
+        journal = jlog.Journal(ha_dir)
+        try:
+            journal.start(epoch)
+        except jlog.EpochCollision:
+            obs.count("fleet.leader.epoch_collisions")
+            obs.emit("fleet.leader.epoch_collision", owner=owner,
+                     epoch=epoch)
+            floor = max(floor, epoch, jlog.epoch_floor(ha_dir))
+            continue
+        obs.count("fleet.leader.elections")
+        obs.gauge("fleet.leader.epoch", float(epoch))
+        obs.emit("fleet.leader.elected", owner=owner, epoch=epoch,
+                 takeover_ms=(time.monotonic() - t0) * 1e3,
+                 replayed=replayed, torn=torn)
+        return HaContext(ha_dir, owner, lease, journal, epoch,
+                         queue=queue, meta=meta)
+
+
+def become_leader(ha_dir: str, owner: str,
+                  lease_timeout_s: float = DEFAULT_LEASE_TIMEOUT_S,
+                  lease_s: float = DEFAULT_LEASE_S,
+                  wait_s: float = 0.0) -> HaContext:
+    """Cold-start election: replay whatever journal exists, then mint
+    the next epoch. ``wait_s`` > 0 keeps retrying while another live
+    leader holds the lease (the restart-into-running-cluster case)."""
+    t0 = time.monotonic()
+    lease = LeaderLease(ha_dir, timeout_s=lease_timeout_s)
+    deadline = t0 + wait_s
+    while True:
+        cur, status = lease.read()
+        live = (status == "ok" and cur.get("owner") != owner
+                and float(cur.get("deadline", 0)) > time.monotonic())
+        if live:
+            if time.monotonic() >= deadline:
+                raise TimeoutError(f"{owner}: lease held by "
+                                   f"{cur.get('owner')} past wait_s")
+            time.sleep(min(0.05, lease_timeout_s / 10))
+            continue
+        queue, meta, info = jlog.replay(ha_dir, lease_s=lease_s)
+        try:
+            return _elect(ha_dir, owner, lease, queue, meta,
+                          jlog.epoch_floor(ha_dir), t0,
+                          info["records"], info["torn"])
+        except LostElection:
+            # someone grabbed the lease between our read and acquire;
+            # loop back into the wait (or raise once wait_s is spent)
+            if time.monotonic() >= deadline:
+                raise
+            obs.count("fleet.leader.lost_elections")
+
+
+class Standby:
+    """Warm replica: tail the journal, watch the lease, promote on
+    expiry. One instance per standby process."""
+
+    def __init__(self, ha_dir: str, owner: str,
+                 lease_timeout_s: float = DEFAULT_LEASE_TIMEOUT_S,
+                 lease_s: float = DEFAULT_LEASE_S,
+                 poll_s: float = 0.05):
+        self.ha_dir = ha_dir
+        self.owner = owner
+        self.lease = LeaderLease(ha_dir, timeout_s=lease_timeout_s)
+        self.lease_s = lease_s
+        self.poll_s = poll_s
+        self.tail = jlog.JournalTail(ha_dir, lease_s=lease_s)
+        self._corrupt_streak = 0
+        self._boot = time.monotonic()
+
+    def _should_promote(self) -> bool:
+        cur, status = self.read_lease()
+        if status == "corrupt":
+            # one rotten read could be a half-landed write; two in a
+            # row is rot at rest — promote over it using the journal's
+            # epoch floor (the lease's epoch is unreadable)
+            self._corrupt_streak += 1
+            return self._corrupt_streak >= 2
+        self._corrupt_streak = 0
+        if status == "missing":
+            # cold-start grace: a standby launched alongside the seed
+            # leader sees "missing" before the leader's first acquire
+            # lands — promoting instantly would steal the cluster
+            return (time.monotonic() - self._boot
+                    >= self.lease.timeout_s)
+        if cur.get("owner") == self.owner:
+            return True
+        return float(cur.get("deadline", 0)) <= time.monotonic()
+
+    def read_lease(self):
+        return self.lease.read()
+
+    def run_until_leader(self, stop: threading.Event | None = None):
+        """Block (tailing the journal) until the lease says the
+        leader is gone, then promote. Returns the ready
+        :class:`HaContext`, or None if ``stop`` was set first."""
+        while stop is None or not stop.is_set():
+            self.tail.poll()
+            if self._should_promote():
+                t0 = time.monotonic()
+                queue, meta = self.tail.finish()
+                try:
+                    return _elect(self.ha_dir, self.owner,
+                                  self.lease, queue, meta,
+                                  jlog.epoch_floor(self.ha_dir), t0,
+                                  self.tail.records, self.tail.torn)
+                except LostElection:
+                    # a sibling standby (or a restarting leader) won
+                    # the race — go back to being a warm replica.
+                    # finish() consumed the tail; rebuild it, which
+                    # re-reads snapshot + tail from the journal.
+                    obs.count("fleet.leader.lost_elections")
+                    self.tail = jlog.JournalTail(
+                        self.ha_dir, lease_s=self.lease_s)
+                    self._corrupt_streak = 0
+            time.sleep(self.poll_s)
+        return None
+
+
+class LeaderClient:
+    """Failover-aware RPC client: resolves the current leader's
+    address from the lease file, retargets on transport failure or a
+    ``DeposedError`` reply, and keeps retrying within
+    ``resolve_timeout_s`` — long enough to span one election."""
+
+    def __init__(self, ha_dir: str, fallback_addr=None,
+                 resolve_timeout_s: float = 20.0,
+                 retry_s: float = 0.1):
+        from icikit.fleet.transport import RpcClient
+        self.ha_dir = ha_dir
+        self.fallback_addr = (tuple(fallback_addr)
+                              if fallback_addr else None)
+        self.resolve_timeout_s = resolve_timeout_s
+        self.retry_s = retry_s
+        self._RpcClient = RpcClient
+        self._lease = LeaderLease(ha_dir)
+        self._client = None
+        self._addr = None
+
+    def _resolve(self):
+        cur, status = self._lease.read()
+        if status == "ok" and cur.get("addr"):
+            return tuple(cur["addr"])
+        return self.fallback_addr
+
+    def _get_client(self):
+        addr = self._resolve()
+        if addr is None:
+            return None
+        if self._client is None or addr != self._addr:
+            if self._client is not None:
+                self._client.close()
+            # few in-client retries; the failover loop out here owns
+            # the long game (capped backoff keeps latency ~ lease)
+            self._client = self._RpcClient(
+                addr, retries=1, first_backoff=0.05, max_backoff=0.5)
+            self._addr = addr
+        return self._client
+
+    def call(self, op: str, msg: dict | None = None, blobs=()):
+        from icikit.fleet.transport import RpcError, TransportError
+        deadline = time.monotonic() + self.resolve_timeout_s
+        last = None
+        while time.monotonic() < deadline:
+            client = self._get_client()
+            if client is None:
+                time.sleep(self.retry_s)
+                continue
+            try:
+                return client.call(op, msg, blobs)
+            except RpcError as e:
+                if e.etype != "DeposedError":
+                    raise
+                last = e            # stale leader: re-resolve
+            except (TransportError, OSError) as e:
+                last = e
+            self._client.close()
+            self._client = None
+            obs.count("fleet.client.retargets")
+            time.sleep(self.retry_s)
+        raise TimeoutError(
+            f"no leader reachable within {self.resolve_timeout_s}s "
+            f"(last: {last!r})")
+
+    def close(self) -> None:
+        if self._client is not None:
+            self._client.close()
+            self._client = None
+
+
+# -- coordinator process entry point (soak / smoke harness) ----------
+
+
+def serve(cfg: dict) -> int:
+    """Run one coordinator process until shutdown or deposal.
+    Prints ``FLEET_HA_LEADER_OK {json}`` once leading (the harness
+    barrier) and ``FLEET_HA_COORD_DONE {json}`` on clean exit."""
+    from icikit.fleet.coordinator import Coordinator
+    from icikit.obs.watch import fleet_watch
+
+    ha_dir = cfg["ha_dir"]
+    owner = cfg["owner"]
+    role = cfg.get("role", "leader")
+    lease_timeout_s = float(cfg.get("lease_timeout_s",
+                                    DEFAULT_LEASE_TIMEOUT_S))
+    lease_s = float(cfg.get("lease_s", 5.0))
+
+    if role == "standby":
+        standby = Standby(ha_dir, owner,
+                          lease_timeout_s=lease_timeout_s,
+                          lease_s=lease_s)
+        ctx = standby.run_until_leader()
+    else:
+        ctx = become_leader(ha_dir, owner,
+                            lease_timeout_s=lease_timeout_s,
+                            lease_s=lease_s,
+                            wait_s=float(cfg.get("wait_s", 0.0)))
+
+    watch = None
+    if cfg.get("watch") is not None:
+        from icikit import obs as _obs
+        _obs.enable_metrics()   # the watch windows THIS process's
+        watch = fleet_watch(**cfg["watch"]).attach()
+    coord = Coordinator(
+        cfg["store_dir"], lease_s=lease_s,
+        heartbeat_timeout_s=float(cfg.get("heartbeat_timeout_s", 2.0)),
+        reap_interval_s=float(cfg.get("reap_interval_s", 0.1)),
+        defect_threshold=int(cfg.get("defect_threshold", 1)),
+        host=cfg.get("host", "127.0.0.1"),
+        port=int(cfg.get("port", 0)),
+        ha=ctx, join_token=cfg.get("join_token"),
+        snapshot_every=int(cfg.get("snapshot_every", 512)),
+        watch=watch)
+    print("FLEET_HA_LEADER_OK "
+          + json.dumps({"owner": owner, "epoch": ctx.epoch,
+                        "addr": list(coord.addr)}),
+          flush=True)
+    try:
+        while not coord.shutdown_requested.wait(0.1):
+            if coord._deposed:
+                print("FLEET_HA_DEPOSED "
+                      + json.dumps({"owner": owner,
+                                    "epoch": ctx.epoch}), flush=True)
+                return 3
+        stats, _ = coord._op_fleet_stats({}, ())
+        print("FLEET_HA_COORD_DONE " + json.dumps(stats), flush=True)
+        return 0
+    finally:
+        coord.shutdown()
+        ctx.close()
+
+
+def main(argv=None) -> int:
+    import sys
+    argv = sys.argv[1:] if argv is None else argv
+    if len(argv) != 1:
+        print("usage: python -m icikit.fleet.ha <cfg.json>",
+              file=sys.stderr)
+        return 2
+    with open(argv[0]) as f:
+        cfg = json.load(f)
+    return serve(cfg)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,533 @@
+"""Durable coordinator journal: append-before-ack verb log + replay.
+
+The HA half of the fleet control plane (r18). Every
+:class:`~icikit.serve.scheduler.RequestQueue` mutation verb appends
+one checksummed, length-prefixed record here — from inside the verb's
+final lock section, i.e. strictly before the RPC ack leaves the
+coordinator — so a leader that dies mid-decode leaves a log whose
+replay reconstructs the queue **bitwise**
+(``RequestQueue.state_digest`` equality is the tested bar).
+
+Layout under ``<ha_dir>/journal/``::
+
+    seg-<epoch:08d>-<k:08d>.log      one append-only segment per
+                                     (leader epoch, rotation index)
+    epoch-<epoch:08d>.lock           O_EXCL epoch-ownership marker
+                                     (empty; survives compaction)
+    ../cursor.json                   latest compaction point (best
+                                     effort; corrupt/missing -> full
+                                     scan from the oldest segment)
+
+Record framing: ``b"icjl" | u32 len | strict-JSON {"v","rec"} |
+blake2b-16(payload)`` — the same detect-mechanically contract as the
+RPC frames in :mod:`icikit.fleet.transport`. A record that fails the
+magic/length/checksum is **torn**: replay stops reading that segment
+(a single sequential writer can only tear its tail — the mid-write
+kill) and moves to the next one.
+
+Snapshots are ordinary ``snap`` records (the queue serializes itself
+under its own lock via ``RequestQueue.checkpoint``); the journal
+reacts by rotating to a fresh segment whose FIRST record is the
+snapshot, advancing the cursor, and deleting every earlier segment —
+replay cost stays bounded by ``snapshot_every`` records regardless of
+uptime.
+
+Epoch fencing: ``start`` claims ``epoch-<epoch:08d>.lock`` with
+``O_EXCL`` before opening the first segment, so two leaders that
+somehow mint the same epoch collide on the marker file
+(:class:`EpochCollision`) — the loser re-elects with a higher floor.
+The marker (not the segment) is the ownership witness because
+compaction deletes rotated-away segments: after the owner's first
+snapshot rotation the epoch's ``k=0`` segment is gone, and without a
+compaction-proof witness a second candidate could re-create it and
+the epoch would have two writers. Markers are empty files, removed
+only for epochs strictly below the current writer's. A deposed
+leader's stale appends land in its OWN old-epoch segment; the
+successor's takeover snapshot (first record of the new epoch's first
+segment) supersedes everything that sorts before it, so stale writes
+are structurally unable to reach replayed state.
+
+Chaos sites: ``fleet.leader.die`` (process killed between records —
+the kill-the-leader soak's mid-decode probe) and
+``fleet.journal.write`` (process killed mid-record: half the frame
+reaches the file, then ``os._exit`` — the torn-tail drill). Both
+model ``kill -9``, so they exit the PROCESS rather than raise: a
+torn record anywhere but a dead writer's tail would be a data-loss
+bug, not a drill.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import struct
+import threading
+
+from icikit import chaos, obs
+from icikit.serve.scheduler import DEFAULT_LEASE_S, RequestQueue
+
+chaos.register_site("fleet.leader.die", "fleet.journal.write")
+
+MAGIC = b"icjl"
+_LEN = struct.Struct(">I")
+DIGEST_BYTES = 16
+MAX_RECORD = 1 << 28
+
+
+class JournalError(RuntimeError):
+    pass
+
+
+class EpochCollision(JournalError):
+    """Two leaders minted the same epoch: the segment file already
+    exists. The caller must re-acquire the lease with a higher epoch
+    floor — the double-leader defense of last resort."""
+
+
+def _seg_name(epoch: int, k: int) -> str:
+    return f"seg-{epoch:08d}-{k:08d}.log"
+
+
+def _seg_epoch(name: str) -> int:
+    return int(name[4:12])
+
+
+def _marker_name(epoch: int) -> str:
+    return f"epoch-{epoch:08d}.lock"
+
+
+def _marker_epoch(name: str) -> int:
+    return int(name[6:14])
+
+
+def _markers(ha_dir: str) -> list:
+    try:
+        names = os.listdir(journal_dir(ha_dir))
+    except FileNotFoundError:
+        return []
+    return sorted(n for n in names
+                  if n.startswith("epoch-") and n.endswith(".lock"))
+
+
+def journal_dir(ha_dir: str) -> str:
+    return os.path.join(ha_dir, "journal")
+
+
+def segments(ha_dir: str) -> list:
+    """Segment file names in replay order (epoch, then rotation
+    index — the zero-padded names sort exactly that way)."""
+    try:
+        names = os.listdir(journal_dir(ha_dir))
+    except FileNotFoundError:
+        return []
+    return sorted(n for n in names
+                  if n.startswith("seg-") and n.endswith(".log"))
+
+
+def epoch_floor(ha_dir: str) -> int:
+    """Highest epoch ever claimed on disk — the floor a candidate
+    leader must acquire strictly above, even when the lease file
+    itself is gone or corrupt. Markers count alongside segments: a
+    claimed-but-not-yet-written epoch still fences."""
+    seg_hi = max((_seg_epoch(n) for n in segments(ha_dir)), default=0)
+    mark_hi = max((_marker_epoch(n) for n in _markers(ha_dir)),
+                  default=0)
+    return max(seg_hi, mark_hi)
+
+
+def frame(verb: str, rec: dict) -> bytes:
+    payload = json.dumps({"v": verb, "rec": rec},
+                         allow_nan=False).encode()
+    digest = hashlib.blake2b(payload,
+                             digest_size=DIGEST_BYTES).digest()
+    return MAGIC + _LEN.pack(len(payload)) + payload + digest
+
+
+def read_records(path: str, offset: int = 0):
+    """Decode records from ``offset``; returns ``(records,
+    end_offset, status)`` with status ``"ok"`` (clean EOF),
+    ``"partial"`` (trailing bytes too short for their claimed record —
+    a write may still be in flight) or ``"torn"`` (bad magic/length/
+    checksum — the writer died mid-record). ``end_offset`` always
+    points at the first undecoded byte, so a tailing reader can
+    resume there once more bytes land."""
+    with open(path, "rb") as f:
+        f.seek(offset)
+        data = f.read()
+    records = []
+    pos, n = 0, len(data)
+    status = "ok"
+    while pos < n:
+        if pos + len(MAGIC) + _LEN.size > n:
+            status = "partial"
+            break
+        if data[pos:pos + len(MAGIC)] != MAGIC:
+            status = "torn"
+            break
+        (length,) = _LEN.unpack(
+            data[pos + len(MAGIC):pos + len(MAGIC) + _LEN.size])
+        if length > MAX_RECORD:
+            status = "torn"
+            break
+        body = pos + len(MAGIC) + _LEN.size
+        end = body + length + DIGEST_BYTES
+        if end > n:
+            status = "partial"
+            break
+        payload = data[body:body + length]
+        digest = data[body + length:end]
+        if hashlib.blake2b(
+                payload, digest_size=DIGEST_BYTES).digest() != digest:
+            status = "torn"
+            break
+        obj = json.loads(payload.decode())
+        records.append((obj["v"], obj["rec"]))
+        pos = end
+    return records, offset + pos, status
+
+
+def _cursor_path(ha_dir: str) -> str:
+    return os.path.join(ha_dir, "cursor.json")
+
+
+def read_cursor(ha_dir: str) -> str | None:
+    """Name of the segment replay may start from (it begins with a
+    snap record). Best effort: anything wrong -> None -> full scan
+    from the oldest surviving segment, which is always safe."""
+    try:
+        with open(_cursor_path(ha_dir)) as f:
+            cur = json.load(f)
+        return cur.get("seg")
+    except (OSError, ValueError):
+        return None
+
+
+def _write_cursor(ha_dir: str, seg: str) -> None:
+    tmp = _cursor_path(ha_dir) + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump({"seg": seg}, f)
+    os.replace(tmp, _cursor_path(ha_dir))
+
+
+class Journal:
+    """Single-writer append log for one leader epoch.
+
+    ``append`` is what ``RequestQueue.journal`` points at: it runs
+    under the queue's (or the coordinator's, for ``cphase``/
+    ``cowner`` meta records) lock, serialized further by its own lock
+    since the two callers interleave. A ``snap`` verb triggers
+    rotation + compaction inline — snapshots are rare by
+    construction (``snapshot_every``), so the held-lock file work is
+    a bounded, amortized cost the module docstring owns."""
+
+    def __init__(self, ha_dir: str):
+        self.ha_dir = ha_dir
+        self._lock = threading.Lock()
+        self._f = None
+        self._epoch = None
+        self._k = 0
+        self._seg = None
+        self._count_in_seg = 0
+        self.records_since_snap = 0
+        self.n_records = 0
+        self.n_snapshots = 0
+
+    def start(self, epoch: int) -> None:
+        """Claim ``epoch-<epoch>.lock`` then open the epoch's first
+        segment, both with ``O_EXCL`` — raises
+        :class:`EpochCollision` if any leader (us in a previous life
+        included) already owns the epoch. The marker is the witness
+        that survives compaction: the ``k=0`` segment is deleted by
+        the owner's own first snapshot rotation, so it alone cannot
+        fence a late second candidate."""
+        os.makedirs(journal_dir(self.ha_dir), exist_ok=True)
+        marker = os.path.join(journal_dir(self.ha_dir),
+                              _marker_name(epoch))
+        try:
+            os.close(os.open(marker, os.O_CREAT | os.O_EXCL))
+        except FileExistsError:
+            raise EpochCollision(
+                f"epoch marker for epoch {epoch} already exists: "
+                f"another leader claimed this epoch") from None
+        name = _seg_name(epoch, 0)
+        path = os.path.join(journal_dir(self.ha_dir), name)
+        try:
+            f = open(path, "xb")
+        except FileExistsError:
+            raise EpochCollision(
+                f"journal segment for epoch {epoch} already exists "
+                f"({name}): another leader holds this epoch") from None
+        with self._lock:
+            self._f = f
+            self._epoch = int(epoch)
+            self._k = 0
+            self._seg = name
+            self._count_in_seg = 0
+
+    def append(self, verb: str, rec: dict) -> None:
+        buf = frame(verb, rec)
+        snapped = False
+        with self._lock:
+            if self._f is None:
+                raise JournalError("journal not started")
+            if verb == "snap" and self._count_in_seg:
+                self._rotate_locked()
+            if chaos.active() is not None:
+                self._write_with_drills_locked(buf)
+            else:
+                self._f.write(buf)
+                self._f.flush()
+            self._count_in_seg += 1
+            self.n_records += 1
+            if verb == "snap":
+                # this segment now STARTS with a full snapshot:
+                # everything earlier is dead weight — advance the
+                # cursor and compact
+                self.records_since_snap = 0
+                self.n_snapshots += 1
+                _write_cursor(self.ha_dir, self._seg)
+                self._compact_locked()
+                snapped = True
+            else:
+                self.records_since_snap += 1
+        obs.count("fleet.journal.records")
+        if snapped:
+            obs.count("fleet.journal.snapshots")
+
+    def _write_with_drills_locked(self, buf: bytes) -> None:
+        # both sites model kill -9: the process must die, not the
+        # handler thread — an InjectedDeath swallowed by the RPC
+        # server would leave a mid-file torn record, which replay
+        # correctly treats as data loss
+        try:
+            chaos.maybe_die("fleet.leader.die")
+        except chaos.InjectedDeath:
+            os._exit(17)
+        try:
+            chaos.maybe_die("fleet.journal.write")
+        except chaos.InjectedDeath:
+            self._f.write(buf[:max(1, len(buf) // 2)])
+            self._f.flush()
+            os._exit(23)
+        self._f.write(buf)
+        self._f.flush()
+
+    def _rotate_locked(self) -> None:
+        self._f.close()
+        self._k += 1
+        name = _seg_name(self._epoch, self._k)
+        path = os.path.join(journal_dir(self.ha_dir), name)
+        try:
+            self._f = open(path, "xb")
+        except FileExistsError:
+            raise JournalError(
+                f"rotation target {name} exists: epoch "
+                f"{self._epoch} has two writers") from None
+        self._seg = name
+        self._count_in_seg = 0
+
+    def _compact_locked(self) -> None:
+        jdir = journal_dir(self.ha_dir)
+        for name in segments(self.ha_dir):
+            if name < self._seg:
+                try:
+                    os.remove(os.path.join(jdir, name))
+                except OSError:
+                    pass
+        # markers below the current epoch can never be re-minted
+        # (epoch_floor includes OUR marker, so every future mint is
+        # strictly above it) — safe to sweep; ours must stay
+        for name in _markers(self.ha_dir):
+            if _marker_epoch(name) < self._epoch:
+                try:
+                    os.remove(os.path.join(jdir, name))
+                except OSError:
+                    pass
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"records": self.n_records,
+                    "snapshots": self.n_snapshots,
+                    "records_since_snap": self.records_since_snap,
+                    "epoch": self._epoch, "segment": self._seg}
+
+    def close(self) -> None:
+        with self._lock:
+            if self._f is not None:
+                self._f.close()
+                self._f = None
+
+
+class MetaTracker:
+    """Coordinator-side state that rides the journal next to the
+    queue: request phases (the prefill/decode disaggregation router),
+    the rid->engine ownership map (what a dead engine's expiry
+    sweeps), and the handoff counter. Derived from ``cphase``/
+    ``cowner`` meta records plus the terminal queue verbs."""
+
+    def __init__(self):
+        self.phases: dict = {}
+        self.owners: dict = {}
+        self.n_handoffs = 0
+
+    def to_dict(self) -> dict:
+        return {"phases": dict(self.phases),
+                "owners": dict(self.owners),
+                "n_handoffs": self.n_handoffs}
+
+    def apply(self, verb: str, rec: dict) -> None:
+        if verb == "snap":
+            m = rec.get("meta") or {}
+            self.phases = dict(m.get("phases") or {})
+            self.owners = dict(m.get("owners") or {})
+            self.n_handoffs = int(m.get("n_handoffs") or 0)
+        elif verb == "cphase":
+            self.phases[rec["rid"]] = rec["phase"]
+        elif verb == "cowner":
+            if rec.get("engine") is None:
+                self.owners.pop(rec["rid"], None)
+            else:
+                self.owners[rec["rid"]] = rec["engine"]
+        elif verb == "complete" and not rec.get("dup"):
+            self.owners.pop(rec["rid"], None)
+            self.phases.pop(rec["rid"], None)
+        elif verb == "handoff":
+            outcome = rec.get("outcome")
+            if outcome in ("done", "queued"):
+                self.owners.pop(rec["rid"], None)
+            if outcome == "done":
+                self.phases.pop(rec["rid"], None)
+            elif outcome == "queued":
+                self.n_handoffs += 1
+        elif verb == "fail":
+            self.owners.pop(rec["rid"], None)
+            if not rec.get("requeued"):
+                self.phases.pop(rec["rid"], None)
+        elif verb == "release":
+            self.owners.pop(rec["rid"], None)
+        elif verb == "reap":
+            for rid, _seq in rec["reaped"]:
+                self.owners.pop(rid, None)
+
+
+def apply_one(queue: RequestQueue, meta: MetaTracker,
+              verb: str, rec: dict) -> None:
+    """Route one record: meta verbs to the tracker, queue verbs to
+    both (the tracker derives terminal pops from them)."""
+    meta.apply(verb, rec)
+    if verb not in ("cphase", "cowner"):
+        queue.apply_record(verb, rec)
+
+
+def replay_records(records, lease_s: float = DEFAULT_LEASE_S):
+    """Rebuild (queue, meta) from an in-memory record list — the
+    property test's any-prefix-replays-bitwise entry point."""
+    queue = RequestQueue(lease_s=lease_s)
+    meta = MetaTracker()
+    for verb, rec in records:
+        apply_one(queue, meta, verb, rec)
+    queue.finalize_replay()
+    return queue, meta
+
+
+def replay(ha_dir: str, lease_s: float = DEFAULT_LEASE_S):
+    """Full recovery read: cursor segment (or oldest surviving) to
+    the end of the log. Returns ``(queue, meta, info)`` where info
+    counts segments/records consumed and torn tails skipped. Safe on
+    an empty/missing journal (fresh cluster -> empty queue)."""
+    queue = RequestQueue(lease_s=lease_s)
+    meta = MetaTracker()
+    info = {"segments": 0, "records": 0, "torn": 0}
+    segs = segments(ha_dir)
+    cur = read_cursor(ha_dir)
+    start = segs.index(cur) if cur in segs else 0
+    for name in segs[start:]:
+        path = os.path.join(journal_dir(ha_dir), name)
+        try:
+            recs, _end, status = read_records(path)
+        except FileNotFoundError:
+            continue          # compacted away under us
+        info["segments"] += 1
+        for verb, rec in recs:
+            apply_one(queue, meta, verb, rec)
+        info["records"] += len(recs)
+        if status != "ok":
+            # a dead writer's torn tail: nothing after it in THIS
+            # file can be valid; later segments are later epochs
+            info["torn"] += 1
+    queue.finalize_replay()
+    if info["records"]:
+        obs.count("fleet.journal.replayed", info["records"])
+    if info["torn"]:
+        obs.count("fleet.journal.torn", info["torn"])
+    return queue, meta, info
+
+
+class JournalTail:
+    """Incremental reader — the warm standby's replica. ``poll()``
+    applies whatever landed since the last call; an incomplete or
+    suspect tail is retried (the writer may be mid-append) until a
+    NEWER segment exists or ``finalize=True`` declares the writer
+    dead, at which point the bad tail is counted torn and the reader
+    moves on. Compaction deleting the reader's segment is handled by
+    jumping to the cursor segment, whose leading snap record
+    supersedes everything missed."""
+
+    def __init__(self, ha_dir: str, lease_s: float = DEFAULT_LEASE_S):
+        self.ha_dir = ha_dir
+        self.queue = RequestQueue(lease_s=lease_s)
+        self.meta = MetaTracker()
+        self.records = 0
+        self.torn = 0
+        self._seg = None
+        self._offset = 0
+
+    def poll(self, finalize: bool = False) -> int:
+        applied = 0
+        while True:
+            segs = segments(self.ha_dir)
+            if not segs:
+                return applied
+            if self._seg is None or self._seg not in segs:
+                cur = read_cursor(self.ha_dir)
+                self._seg = cur if cur in segs else segs[0]
+                self._offset = 0
+            path = os.path.join(journal_dir(self.ha_dir), self._seg)
+            try:
+                recs, end, status = read_records(path, self._offset)
+            except FileNotFoundError:
+                self._seg = None
+                continue
+            for verb, rec in recs:
+                apply_one(self.queue, self.meta, verb, rec)
+            applied += len(recs)
+            self.records += len(recs)
+            self._offset = end
+            idx = segs.index(self._seg)
+            has_newer = idx + 1 < len(segs)
+            if status == "ok":
+                if not has_newer:
+                    return applied
+                self._seg = segs[idx + 1]
+                self._offset = 0
+                continue
+            # partial/torn tail: only a dead writer leaves one for
+            # good — wait unless the writer provably moved on (a
+            # newer segment exists) or the caller says it is dead
+            if not (has_newer or finalize):
+                return applied
+            self.torn += 1
+            obs.count("fleet.journal.torn")
+            if has_newer:
+                self._seg = segs[idx + 1]
+                self._offset = 0
+                continue
+            return applied
+
+    def finish(self):
+        """Final drain + promote-ready (queue, meta): the standby
+        calls this once the lease says the leader is gone."""
+        self.poll(finalize=True)
+        self.queue.finalize_replay()
+        return self.queue, self.meta

@@ -59,9 +59,13 @@ class RemoteQueue:
     no-op: lease reaping is the coordinator's job, engines only renew.
     """
 
-    def __init__(self, client: RpcClient, engine_id: str):
+    def __init__(self, client, engine_id: str, hello=None):
         self._client = client
         self.engine_id = engine_id
+        # re-registration hook (HA, r18): a failed-over coordinator
+        # replays the QUEUE but not the roster — engines are expected
+        # to re-hello; claim answers ``denied: "unknown"`` until then
+        self._hello = hello
         self._local: dict = {}
         self.done: dict = {}
         self.failed: dict = {}
@@ -85,6 +89,13 @@ class RemoteQueue:
         reply = self._call("claim")
         w = reply.get("req")
         if w is None:
+            if reply.get("denied") == "unknown" \
+                    and self._hello is not None:
+                # the coordinator that answered has never met us — a
+                # failover successor. Re-register and retry on the
+                # next loop pass; in-flight leases survived the
+                # replay, only the roster entry is fresh.
+                self._hello()
             return None
         req = Request(
             rid=w["rid"],
@@ -200,15 +211,19 @@ class EngineWorker:
     def __init__(self, addr, engine_id: str, role: str,
                  params, mesh, cfg, serve_cfg,
                  report_interval_s: float = 0.5,
-                 rewarm: bool = False):
+                 rewarm: bool = False,
+                 ha_dir: str | None = None,
+                 token: str | None = None):
         from icikit.serve.engine import Engine
         self.engine_id = engine_id
         self.role = role
-        self.addr = tuple(addr)
-        self.client = RpcClient(self.addr)
-        reply, _ = self.client.call("hello", {"engine": engine_id,
-                                              "role": role})
-        self.queue = RemoteQueue(self.client, engine_id)
+        self.addr = tuple(addr) if addr is not None else None
+        self.ha_dir = ha_dir
+        self.token = token
+        self.client = self._make_client()
+        self._say_hello()
+        self.queue = RemoteQueue(self.client, engine_id,
+                                 hello=self._say_hello)
         self.bridge = BridgeStore(self.client, engine_id)
         if not serve_cfg.prefix_cache:
             raise ValueError(
@@ -232,6 +247,21 @@ class EngineWorker:
             self.engine.rewarm(self.queue.pending_prompts())
             if rewarm else 0)
 
+    def _make_client(self):
+        """A lease-resolving :class:`~icikit.fleet.ha.LeaderClient`
+        when the fleet runs HA (``ha_dir`` set) — it retargets across
+        failovers — else a plain bounded-backoff RpcClient."""
+        if self.ha_dir is not None:
+            from icikit.fleet.ha import LeaderClient
+            return LeaderClient(self.ha_dir, fallback_addr=self.addr)
+        return RpcClient(self.addr)
+
+    def _say_hello(self) -> None:
+        msg = {"engine": self.engine_id, "role": self.role}
+        if self.token is not None:
+            msg["token"] = self.token
+        self.client.call("hello", msg)
+
     def _push_chain(self, req: Request, tokens) -> None:
         n = self.engine.export_chain(
             np.concatenate([req.prompt,
@@ -241,7 +271,7 @@ class EngineWorker:
                               blocks=n)
 
     def _report_loop(self) -> None:
-        client = RpcClient(self.addr)
+        client = self._make_client()
         try:
             while not self._stop.wait(self.report_interval_s):
                 try:

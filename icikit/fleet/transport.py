@@ -232,11 +232,16 @@ class RpcClient:
 
     def __init__(self, addr, retries: int = 3,
                  first_backoff: float = 0.05,
-                 connect_timeout: float = 5.0):
+                 connect_timeout: float = 5.0,
+                 max_backoff: float | None = None):
         self.addr = tuple(addr)
         self.retries = retries
         self.first_backoff = first_backoff
         self.connect_timeout = connect_timeout
+        # failover clients ride many retries across a leader election:
+        # capping the backoff keeps reconnect latency ~ lease timeout
+        # instead of doubling past it
+        self.max_backoff = max_backoff
         self._lock = threading.Lock()
         self._sock: socket.socket | None = None
 
@@ -278,6 +283,8 @@ class RpcClient:
                         raise
                     time.sleep(backoff)
                     backoff *= 2
+                    if self.max_backoff is not None:
+                        backoff = min(backoff, self.max_backoff)
         if not reply.get("ok"):
             raise RpcError(reply.get("error", "remote error"),
                            reply.get("etype", "RuntimeError"))

@@ -61,10 +61,13 @@ def run_worker(config: dict) -> dict:
 
     params, mesh, cfg = build_model(config.get("model") or {})
     serve_cfg = ServeConfig(**(config.get("serve") or {}))
-    worker = EngineWorker(tuple(config["addr"]),
+    worker = EngineWorker(tuple(config["addr"])
+                          if config.get("addr") else None,
                           config["engine_id"], config["role"],
                           params, mesh, cfg, serve_cfg,
-                          rewarm=bool(config.get("rewarm")))
+                          rewarm=bool(config.get("rewarm")),
+                          ha_dir=config.get("ha_dir"),
+                          token=config.get("token"))
     try:
         completed = worker.run(
             max_steps=config.get("max_steps"))

@@ -360,3 +360,26 @@ def serve_watch(ttft_slo_ms: float = 5_000.0,
         RateAlarm("serve.prefix.quarantined"),
         registry=registry, min_interval_s=min_interval_s,
     )
+
+
+def fleet_watch(pending_high: float = 8.0,
+                ttft_slo_ms: float = 30_000.0,
+                burn_budget: float = 0.5,
+                min_count: int = 4,
+                registry=None,
+                min_interval_s: float = 0.1) -> Watch:
+    """The coordinator-side watch driving the elastic roster (r18):
+    queue-depth watermark on ``fleet.pending`` (sustained backlog →
+    spawn) and SLO burn on coordinator-observed TTFT (commit-time
+    minus submit-time — survives engine death, unlike engine-local
+    marks). The harness polls ``verdict()`` via the ``fleet_stats``
+    RPC and turns alerts into join/retire decisions; the coordinator
+    itself only measures. Duplicate commits stay zero-tolerance — a
+    failover that double-commits is a fencing bug, not load."""
+    return Watch(
+        GaugeWatermark("fleet.pending", high=pending_high),
+        SloBurnRate("serve.ttft_ms", ttft_slo_ms, burn_budget,
+                    min_count=min_count),
+        RateAlarm("serve.duplicate_commits"),
+        registry=registry, min_interval_s=min_interval_s,
+    )

@@ -27,6 +27,19 @@ self-healing (``models/solitaire/scheduler.py:_LeaseQueue``) from
 Deterministic ids, monotonic clocks (SLO math must survive wall-clock
 steps), bus/metric emission outside the lock (the ``mark_dead``
 discipline: a slow sink must never stall admission).
+
+**Journal hooks (fleet HA, r18)**: when a durable journal is attached
+(``self.journal = icikit.fleet.journal.Journal(...).append``), every
+mutation verb appends one record describing its *effect* — resolved
+ids, computed visibility instants, popped heap entries — from inside
+the verb's final lock section, i.e. BEFORE the verb returns and
+therefore before any RPC ack reaches an engine. Replay
+(:meth:`apply_record`) re-applies effects verbatim and never
+re-decides anything, so a journal prefix reconstructs the queue
+bitwise (:meth:`state_digest`). Lease *deadlines* are deliberately
+not journaled: they are leader-local liveness state, re-based to
+``now + lease_s`` on restore — a replayed leader re-times every
+in-flight claim and lets its own reaper settle the truth.
 """
 
 from __future__ import annotations
@@ -34,6 +47,7 @@ from __future__ import annotations
 import hashlib
 import heapq
 import itertools
+import json
 import threading
 import time
 from dataclasses import dataclass, field
@@ -171,6 +185,16 @@ class RequestQueue:
         self.backoff_s = backoff_s
         self._lock = threading.Lock()
         self._ids = itertools.count()
+        # high-water mark of minted heap seqs: rides every journal
+        # record and snapshot so a REPLAYED queue resumes minting
+        # strictly past everything the dead leader ever allocated
+        # (rids are f"r{seq}" — a collision would alias two requests)
+        self._seq_hwm = -1
+        # journal hook (fleet HA): None, or a callable
+        # ``(verb, record_dict) -> None`` that appends to a durable
+        # log. Called via _journal() from inside each verb's final
+        # lock section — append-before-ack by construction.
+        self.journal = None
         # min-heap of (visible_after, seq, rid): time-gated FIFO
         self._queued: list = []
         self._requests: dict = {}     # rid -> Request
@@ -185,6 +209,27 @@ class RequestQueue:
         self.failed: dict = {}        # rid -> Request
         self.n_reissues = 0
         self.n_duplicate_commits = 0
+
+    # -- journal plumbing --------------------------------------------
+
+    def _next_seq(self) -> int:
+        """Mint one heap seq (lock held) and advance the high-water
+        mark the journal/snapshot carries."""
+        seq = next(self._ids)
+        if seq > self._seq_hwm:
+            self._seq_hwm = seq
+        return seq
+
+    def _journal(self, verb: str, rec: dict) -> None:
+        """Append one verb record to the attached journal (lock held —
+        the append lands before the verb returns, so the RPC ack the
+        coordinator sends afterwards is always covered). A plain
+        callable indirection: the actual file I/O lives in
+        ``icikit.fleet.journal`` so this module stays free of it.
+        The ``journal-discipline`` analysis rule checks every mutating
+        verb routes through here."""
+        if self.journal is not None:
+            self.journal(verb, rec)
 
     # -- producer side -----------------------------------------------
 
@@ -213,7 +258,8 @@ class RequestQueue:
             raise ValueError(f"top_k must be >= 0, got {top_k}")
         now = time.monotonic()
         vis = now if not_before is None else float(not_before)
-        seq = next(self._ids)        # itertools.count: atomic
+        with self._lock:
+            seq = self._next_seq()
         rid = f"r{seq}"
         req = Request(rid=rid, prompt=prompt, n_new=int(n_new),
                       checksum=prompt_checksum(prompt),
@@ -234,6 +280,16 @@ class RequestQueue:
         with self._lock:
             self._requests[rid] = req
             heapq.heappush(self._queued, (vis, seq, rid))
+            self._journal("submit", {
+                "rid": rid, "seq": seq,
+                "prompt": [int(t) for t in prompt],
+                "n_new": int(n_new),
+                "eos_id": None if eos_id is None else int(eos_id),
+                "vis": vis, "max_retries": int(max_retries),
+                "quant": bool(quant), "seed": int(seed),
+                "temperature": float(temperature),
+                "top_k": int(top_k), "top_p": float(top_p),
+                "trace_id": req.trace.trace_id})
         obs.count("serve.submitted")
         return rid
 
@@ -253,14 +309,17 @@ class RequestQueue:
         is invisible to a decode-only engine and vice versa)."""
         now = time.monotonic()
         claimed = None
+        claimed_entry = None
         skipped = []
+        dropped = []
         with self._lock:
             while self._queued and self._queued[0][0] <= now:
                 entry = heapq.heappop(self._queued)
                 rid = entry[2]
                 req = self._requests[rid]
                 if req.state != "queued":
-                    continue        # stale duplicate entry
+                    dropped.append(entry)   # stale duplicate entry
+                    continue
                 if accept is not None and not accept(req):
                     skipped.append(entry)   # ineligible, not stale
                     continue
@@ -269,9 +328,20 @@ class RequestQueue:
                 req.claim_seq += 1
                 self._leases[rid] = (now + self.lease_s, req.claim_seq)
                 claimed = req
+                claimed_entry = entry
                 break
             for entry in skipped:
                 heapq.heappush(self._queued, entry)
+            if claimed is not None or dropped:
+                # skipped entries went back untouched — only the
+                # claim and the lazy deletions are state changes
+                self._journal("claim", {
+                    "rid": claimed.rid if claimed else None,
+                    "claim_seq":
+                        claimed.claim_seq if claimed else None,
+                    "entry": list(claimed_entry)
+                        if claimed_entry else None,
+                    "dropped": [list(e) for e in dropped]})
         if claimed is not None:
             claimed.trace.close("serve.req.queued")
             claimed.trace.begin_attempt(claimed.claim_seq,
@@ -299,7 +369,10 @@ class RequestQueue:
 
     def renew(self, rid: str, seq: int | None = None) -> None:
         """Heartbeat: push the lease deadline out (the engine calls
-        this for every in-flight request at every step boundary)."""
+        this for every in-flight request at every step boundary).
+        Deliberately NOT journaled: deadlines are leader-local
+        liveness state (see the module docstring) — journaling every
+        heartbeat would dominate the log for zero replay value."""
         now = time.monotonic()
         with self._lock:
             if rid in self._leases and self._lease_live(rid, seq):
@@ -320,9 +393,13 @@ class RequestQueue:
             if not dup:
                 self._leases.pop(rid, None)
                 req.state = "done"
-                req.tokens = list(tokens)
+                req.tokens = [int(t) for t in tokens]
                 req.done_t = now
                 self.done[rid] = req
+            self._journal("complete", {
+                "rid": rid, "dup": bool(dup),
+                "tokens": None if dup else list(req.tokens),
+                "done_t": None if dup else now})
         if dup:
             self.n_duplicate_commits += 1
             obs.emit("serve.duplicate_commit", rid=rid)
@@ -354,7 +431,7 @@ class RequestQueue:
         as a duplicate commit. One request stays ONE trace tree: the
         attempt segment closes with ``outcome="handoff"`` and the next
         queued segment opens under the same trace id."""
-        tokens = list(tokens)
+        tokens = [int(t) for t in tokens]
         now = time.monotonic()
         finished = False
         with self._lock:
@@ -362,6 +439,8 @@ class RequestQueue:
             if req is None or req.state in ("done", "failed") \
                     or not self._lease_live(rid, seq):
                 dup = True
+                self._journal("handoff", {"rid": rid,
+                                          "outcome": "stale"})
             else:
                 dup = False
                 self._leases.pop(rid, None)
@@ -374,6 +453,9 @@ class RequestQueue:
                     req.state = "done"
                     req.done_t = now
                     self.done[rid] = req
+                    self._journal("handoff", {
+                        "rid": rid, "outcome": "done",
+                        "tokens": tokens, "done_t": now})
                 else:
                     # the committed tokens become prompt: the decode
                     # phase admits (prompt ++ tokens) and generates the
@@ -405,8 +487,16 @@ class RequestQueue:
         req.trace.instant("serve.req.handoff", n_tokens=len(tokens))
         req.trace.open("serve.req.queued")
         with self._lock:
-            heapq.heappush(self._queued, (now, next(self._ids), rid))
+            push_seq = self._next_seq()
+            heapq.heappush(self._queued, (now, push_seq, rid))
             self._limbo -= 1
+            # one record covers both lock phases: between them the rid
+            # is out of the heap with its lease popped, so no other
+            # verb can interleave a mutation of THIS request — the
+            # record is still a serialization point for it
+            self._journal("handoff", {
+                "rid": rid, "outcome": "queued", "tokens": tokens,
+                "vis": now, "push_seq": push_seq})
         return "queued"
 
     def fail(self, rid: str, exc: BaseException,
@@ -436,6 +526,9 @@ class RequestQueue:
             else:
                 req.state = "failed"
                 self.failed[rid] = req
+                self._journal("fail", {
+                    "rid": rid, "error": req.error,
+                    "requeued": False})
         obs.emit("serve.request_failed", rid=rid, error=repr(exc),
                  requeued=requeued)
         obs.count("serve.retries" if requeued else "serve.failed")
@@ -448,9 +541,13 @@ class RequestQueue:
             # rid and open the next attempt segment
             req.trace.open("serve.req.queued")
             with self._lock:
-                heapq.heappush(self._queued,
-                               (vis, next(self._ids), rid))
+                push_seq = self._next_seq()
+                heapq.heappush(self._queued, (vis, push_seq, rid))
                 self._limbo -= 1
+                self._journal("fail", {
+                    "rid": rid, "error": req.error,
+                    "requeued": True, "vis": vis,
+                    "push_seq": push_seq})
         else:
             req.trace.close("serve.req", state="failed")
         return "queued" if requeued else "failed"
@@ -481,8 +578,45 @@ class RequestQueue:
         req.trace.open("serve.req.queued")
         vis = time.monotonic() + delay
         with self._lock:
-            heapq.heappush(self._queued, (vis, next(self._ids), rid))
+            push_seq = self._next_seq()
+            heapq.heappush(self._queued, (vis, push_seq, rid))
             self._limbo -= 1
+            self._journal("release", {
+                "rid": rid, "vis": vis, "push_seq": push_seq})
+
+    def stamp_marks(self, rid: str, marks: dict | None) -> None:
+        """Fold engine-side SLO marks (admit/first-token instants,
+        worst inter-token gap, prefix-cache hits) onto the
+        authoritative Request — the fleet coordinator's per-commit
+        call, moved into the queue (r18) so the fold is journaled and
+        a replayed leader reports the same SLO rows. The fold is
+        idempotent and first-writer-wins for the instants, max() for
+        the gap, so duplicate commits cannot skew the numbers."""
+        if not marks:
+            return
+        with self._lock:
+            req = self._requests.get(rid)
+            if req is None:
+                return
+            if req.admit_t is None and \
+                    marks.get("admit_t") is not None:
+                req.admit_t = float(marks["admit_t"])
+            if req.first_token_t is None and \
+                    marks.get("first_token_t") is not None:
+                req.first_token_t = float(marks["first_token_t"])
+            if marks.get("max_gap_ms") is not None:
+                req.max_gap_ms = max(req.max_gap_ms or 0.0,
+                                     float(marks["max_gap_ms"]))
+            if marks.get("prefix_hit_tokens"):
+                # accumulates: a handoff chain's prefill AND decode
+                # admissions both contribute cache hits
+                req.prefix_hit_tokens += \
+                    int(marks["prefix_hit_tokens"])
+            self._journal("marks", {
+                "rid": rid,
+                "marks": {k: marks.get(k) for k in (
+                    "admit_t", "first_token_t", "max_gap_ms",
+                    "prefix_hit_tokens") if marks.get(k) is not None}})
 
     # -- monitor side ------------------------------------------------
 
@@ -523,10 +657,14 @@ class RequestQueue:
                 req.trace.instant("serve.req.reissued", from_seq=seq)
                 req.trace.open("serve.req.queued")
             with self._lock:
+                pushes = []
                 for req, _ in reaped_reqs:
+                    push_seq = self._next_seq()
                     heapq.heappush(self._queued,
-                                   (now, next(self._ids), req.rid))
+                                   (now, push_seq, req.rid))
+                    pushes.append([req.rid, push_seq])
                 self._limbo -= len(reaped)
+                self._journal("reap", {"reaped": pushes, "vis": now})
         return reaped
 
     def expire(self, rids) -> list:
@@ -537,7 +675,9 @@ class RequestQueue:
         just delay the reissue. Requests the caller names that hold no
         live lease are ignored. Returns the reaped rids (a superset
         may reap if other leases happen to be expired too — reap is
-        global by design)."""
+        global by design). The deadline poisoning itself is not
+        journaled (deadlines never are); the ``reap`` record emitted
+        by :meth:`reap_expired` carries the whole durable effect."""
         with self._lock:
             for rid in rids:
                 if rid in self._leases:
@@ -575,3 +715,279 @@ class RequestQueue:
     def request(self, rid: str) -> Request:
         with self._lock:
             return self._requests[rid]
+
+    # -- journal / HA side (fleet r18) -------------------------------
+    #
+    # Serialization, snapshot and replay live ON the queue (not in
+    # icikit.fleet.journal) so every touch of the private containers
+    # stays in this file — the journal-discipline rule bans the fleet
+    # layer from reaching into queue internals. apply_record() is the
+    # replay twin of the verbs above: it applies recorded EFFECTS
+    # verbatim (no clocks consulted except to re-base lease deadlines,
+    # no ids minted, no trace/obs emission re-fired) so that
+    # state_digest() after replaying any record prefix equals the live
+    # queue's digest at the same point — the property
+    # tests/test_fleet_ha.py fuzzes.
+
+    def _ser_req_locked(self, req: Request) -> dict:
+        return {
+            "rid": req.rid, "prompt": [int(t) for t in req.prompt],
+            "n_new": req.n_new, "checksum": req.checksum,
+            "eos_id": req.eos_id, "quant": req.quant,
+            "seed": req.seed, "temperature": req.temperature,
+            "top_k": req.top_k, "top_p": req.top_p,
+            "visible_after": req.visible_after,
+            "max_retries": req.max_retries,
+            "prefix_hit_tokens": req.prefix_hit_tokens,
+            "state": req.state, "attempts": req.attempts,
+            "claim_seq": req.claim_seq,
+            "handoff_tokens": req.handoff_tokens,
+            "tokens": [int(t) for t in req.tokens],
+            "error": req.error, "preempted": req.preempted,
+            "arrival_t": req.arrival_t, "admit_t": req.admit_t,
+            "first_token_t": req.first_token_t,
+            "done_t": req.done_t, "max_gap_ms": req.max_gap_ms,
+            "trace_id":
+                req.trace.trace_id if req.trace else None,
+        }
+
+    def _serialize_locked(self) -> dict:
+        """Canonical full-state dict (lock held). The heap is emitted
+        SORTED: heapq's internal array order depends on push/pop
+        history, but the set of entries plus the heap property is the
+        whole semantic content — canonicalizing makes live-vs-replayed
+        digests comparable."""
+        return {
+            "next_seq": self._seq_hwm + 1,
+            "queued": [list(e) for e in sorted(self._queued)],
+            "leases": {rid: lease[1]
+                       for rid, lease in self._leases.items()},
+            "limbo": self._limbo,
+            "requests": {rid: self._ser_req_locked(req)
+                         for rid, req in self._requests.items()},
+            "done": sorted(self.done),
+            "failed": sorted(self.failed),
+            "n_reissues": self.n_reissues,
+            "n_duplicate_commits": self.n_duplicate_commits,
+        }
+
+    def state_digest(self) -> str:
+        """Order-independent fingerprint of the queue's durable state
+        (lease deadlines excluded — leader-local). Bitwise equality of
+        digests is the replay acceptance bar."""
+        with self._lock:
+            state = self._serialize_locked()
+        blob = json.dumps(state, sort_keys=True,
+                          separators=(",", ":"), allow_nan=False)
+        return hashlib.blake2b(blob.encode(),
+                               digest_size=16).hexdigest()
+
+    def checkpoint(self, meta: dict | None = None) -> dict | None:
+        """Append the full state as one ``snap`` journal record — the
+        compaction point replay starts from. Refuses (returns None)
+        while a two-phase requeue is settling: a snapshot taken inside
+        that window would capture the first half of a verb whose
+        single record then re-applies the whole effect on replay —
+        the caller (the coordinator's reap loop) just retries next
+        tick. ``meta`` carries coordinator-side state (phases, owners)
+        that must ride the same compaction point."""
+        with self._lock:
+            if self._limbo:
+                return None
+            state = self._serialize_locked()
+            self._journal("snap", {"state": state,
+                                   "meta": meta or {}})
+        return state
+
+    def _restore_locked(self, state: dict, now: float) -> None:
+        self._seq_hwm = int(state["next_seq"]) - 1
+        self._ids = itertools.count(self._seq_hwm + 1)
+        self._queued = [(e[0], e[1], e[2])
+                        for e in state["queued"]]
+        heapq.heapify(self._queued)
+        self._limbo = int(state["limbo"])
+        self._requests = {}
+        for rid, s in state["requests"].items():
+            req = Request(
+                rid=rid,
+                prompt=np.asarray(s["prompt"], np.int32),
+                n_new=int(s["n_new"]), checksum=s["checksum"],
+                eos_id=s["eos_id"], quant=bool(s["quant"]),
+                seed=int(s["seed"]),
+                temperature=float(s["temperature"]),
+                top_k=int(s["top_k"]), top_p=float(s["top_p"]),
+                visible_after=s["visible_after"],
+                max_retries=int(s["max_retries"]),
+                arrival_t=s["arrival_t"])
+            req.prefix_hit_tokens = int(s["prefix_hit_tokens"])
+            req.state = s["state"]
+            req.attempts = int(s["attempts"])
+            req.claim_seq = int(s["claim_seq"])
+            req.handoff_tokens = int(s["handoff_tokens"])
+            req.tokens = list(s["tokens"])
+            req.error = s["error"]
+            req.preempted = int(s["preempted"])
+            req.admit_t = s["admit_t"]
+            req.first_token_t = s["first_token_t"]
+            req.done_t = s["done_t"]
+            req.max_gap_ms = s["max_gap_ms"]
+            req.trace = trace_ctx.adopt(rid, s["trace_id"],
+                                        req.claim_seq)
+            self._requests[rid] = req
+        # deadlines re-based: the restoring leader re-times every
+        # in-flight claim and lets its own reaper settle liveness
+        self._leases = {rid: (now + self.lease_s, int(seq))
+                        for rid, seq in state["leases"].items()}
+        self.done = {rid: self._requests[rid]
+                     for rid in state["done"]}
+        self.failed = {rid: self._requests[rid]
+                       for rid in state["failed"]}
+        self.n_reissues = int(state["n_reissues"])
+        self.n_duplicate_commits = int(state["n_duplicate_commits"])
+
+    def _discard_entry_locked(self, e) -> None:
+        """Remove one recorded heap entry during replay (the live verb
+        popped it; lazy deletions and claims name entries exactly)."""
+        entry = (e[0], e[1], e[2])
+        try:
+            self._queued.remove(entry)
+        except ValueError:
+            return
+        heapq.heapify(self._queued)
+
+    def apply_record(self, verb: str, rec: dict) -> None:
+        """Replay one journal record (the standby/takeover path). Must
+        only run on a queue that is not serving live traffic."""
+        now = time.monotonic()   # lease re-basing only (not digested)
+        with self._lock:
+            if verb == "snap":
+                self._restore_locked(rec["state"], now)
+                return
+            if verb == "submit":
+                rid, seq = rec["rid"], int(rec["seq"])
+                prompt = np.asarray(rec["prompt"], np.int32)
+                req = Request(
+                    rid=rid, prompt=prompt, n_new=int(rec["n_new"]),
+                    checksum=prompt_checksum(prompt),
+                    eos_id=rec["eos_id"], visible_after=rec["vis"],
+                    max_retries=int(rec["max_retries"]),
+                    arrival_t=rec["vis"], quant=bool(rec["quant"]),
+                    seed=int(rec["seed"]),
+                    temperature=float(rec["temperature"]),
+                    top_k=int(rec["top_k"]),
+                    top_p=float(rec["top_p"]))
+                req.trace = trace_ctx.adopt(rid, rec["trace_id"], 0)
+                self._requests[rid] = req
+                heapq.heappush(self._queued,
+                               (rec["vis"], seq, rid))
+                if seq > self._seq_hwm:
+                    self._seq_hwm = seq
+            elif verb == "claim":
+                for e in rec["dropped"]:
+                    self._discard_entry_locked(e)
+                if rec["rid"] is not None:
+                    self._discard_entry_locked(rec["entry"])
+                    req = self._requests[rec["rid"]]
+                    req.state = "running"
+                    req.attempts += 1
+                    req.claim_seq = int(rec["claim_seq"])
+                    self._leases[rec["rid"]] = (
+                        now + self.lease_s, req.claim_seq)
+            elif verb == "complete":
+                if rec["dup"]:
+                    self.n_duplicate_commits += 1
+                else:
+                    req = self._requests[rec["rid"]]
+                    self._leases.pop(rec["rid"], None)
+                    req.state = "done"
+                    req.tokens = list(rec["tokens"])
+                    req.done_t = rec["done_t"]
+                    self.done[rec["rid"]] = req
+            elif verb == "handoff":
+                self._apply_handoff_locked(rec)
+            elif verb == "fail":
+                req = self._requests[rec["rid"]]
+                self._leases.pop(rec["rid"], None)
+                req.error = rec["error"]
+                if rec["requeued"]:
+                    self._requeue_locked(req, rec["vis"],
+                                         int(rec["push_seq"]))
+                else:
+                    req.state = "failed"
+                    self.failed[rec["rid"]] = req
+            elif verb == "release":
+                req = self._requests[rec["rid"]]
+                self._leases.pop(rec["rid"], None)
+                req.attempts -= 1
+                req.preempted += 1
+                self._requeue_locked(req, rec["vis"],
+                                     int(rec["push_seq"]))
+            elif verb == "reap":
+                for rid, push_seq in rec["reaped"]:
+                    req = self._requests[rid]
+                    self._leases.pop(rid, None)
+                    self._requeue_locked(req, rec["vis"],
+                                         int(push_seq))
+                self.n_reissues += len(rec["reaped"])
+            elif verb == "marks":
+                req = self._requests.get(rec["rid"])
+                m = rec["marks"]
+                if req is not None:
+                    if req.admit_t is None and \
+                            m.get("admit_t") is not None:
+                        req.admit_t = float(m["admit_t"])
+                    if req.first_token_t is None and \
+                            m.get("first_token_t") is not None:
+                        req.first_token_t = \
+                            float(m["first_token_t"])
+                    if m.get("max_gap_ms") is not None:
+                        req.max_gap_ms = max(
+                            req.max_gap_ms or 0.0,
+                            float(m["max_gap_ms"]))
+                    if m.get("prefix_hit_tokens"):
+                        req.prefix_hit_tokens += \
+                            int(m["prefix_hit_tokens"])
+            else:
+                raise ValueError(
+                    f"unknown journal verb {verb!r}")
+
+    def _requeue_locked(self, req: Request, vis, push_seq: int):
+        req.state = "queued"
+        req.tokens = req.tokens[:req.handoff_tokens]
+        if not req.handoff_tokens:
+            req.first_token_t = None
+        heapq.heappush(self._queued, (vis, push_seq, req.rid))
+        if push_seq > self._seq_hwm:
+            self._seq_hwm = push_seq
+
+    def _apply_handoff_locked(self, rec: dict) -> None:
+        if rec["outcome"] == "stale":
+            self.n_duplicate_commits += 1
+            return
+        rid = rec["rid"]
+        req = self._requests[rid]
+        self._leases.pop(rid, None)
+        tokens = list(rec["tokens"])
+        req.tokens = list(req.tokens) + tokens
+        req.handoff_tokens = len(req.tokens)
+        if rec["outcome"] == "done":
+            req.state = "done"
+            req.done_t = rec["done_t"]
+            self.done[rid] = req
+        else:
+            req.prompt = np.concatenate(
+                [req.prompt, np.asarray(tokens, np.int32)])
+            req.checksum = prompt_checksum(req.prompt)
+            req.state = "queued"
+            req.attempts -= 1
+            heapq.heappush(self._queued,
+                           (rec["vis"], int(rec["push_seq"]), rid))
+            if rec["push_seq"] > self._seq_hwm:
+                self._seq_hwm = int(rec["push_seq"])
+
+    def finalize_replay(self) -> None:
+        """Re-seed the id mint past every seq the journal recorded —
+        called once when a replayed queue is promoted to live duty, so
+        fresh submits can never collide with a dead leader's rids."""
+        with self._lock:
+            self._ids = itertools.count(self._seq_hwm + 1)

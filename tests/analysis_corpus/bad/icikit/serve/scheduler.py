@@ -1,0 +1,22 @@
+"""journal-discipline seeded violation: a RequestQueue verb mutates
+the lease table without journaling — replay would never see it."""
+import threading
+
+
+class RequestQueue:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._leases = {}
+        self.log = []
+
+    def _journal(self, verb, rec):
+        self.log.append((verb, rec))
+
+    def claim(self, rid, seq):
+        with self._lock:
+            self._leases[rid] = (0.0, seq)
+            self._journal("claim", {"rid": rid, "seq": seq})
+
+    def promote(self, rid, seq):
+        with self._lock:
+            self._leases[rid] = (-1.0, seq)

@@ -1,0 +1,23 @@
+"""journal-discipline clean twin: every mutating verb appends its
+record before returning, so replay reconstructs the queue bitwise."""
+import threading
+
+
+class RequestQueue:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._leases = {}
+        self.log = []
+
+    def _journal(self, verb, rec):
+        self.log.append((verb, rec))
+
+    def claim(self, rid, seq):
+        with self._lock:
+            self._leases[rid] = (0.0, seq)
+            self._journal("claim", {"rid": rid, "seq": seq})
+
+    def promote(self, rid, seq):
+        with self._lock:
+            self._leases[rid] = (-1.0, seq)
+            self._journal("promote", {"rid": rid, "seq": seq})

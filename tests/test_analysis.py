@@ -37,7 +37,7 @@ CLEAN = os.path.join(CORPUS, "clean")
 # cannot seed it — its parity pin below covers it)
 STATIC_RULES = ["serve-key", "serve-clock", "obs-print", "tree-accept",
                 "obs-catalog", "host-sync", "lock-discipline",
-                "chaos-site", "fleet-control-plane"]
+                "chaos-site", "fleet-control-plane", "journal-discipline"]
 
 # rule -> the ONE seeded violation in the bad twin
 GOLDEN = {
@@ -50,6 +50,7 @@ GOLDEN = {
     "lock-discipline": ("icikit/serve/locked.py", 15),
     "chaos-site": ("tests/drill.py", 4),
     "fleet-control-plane": ("icikit/fleet/coordinator.py", 4),
+    "journal-discipline": ("icikit/serve/scheduler.py", 22),
 }
 
 

@@ -1,0 +1,466 @@
+"""Fleet HA (r18): journal replay, leader lease, failover, roster.
+
+The process-local half of the kill-the-leader story (the subprocess
+soak lives in tests/test_fleet_ha_soak.py):
+
+- journal framing survives a round trip; a torn tail (writer died
+  mid-record) is detected and replay stops at the last good record;
+- **replay determinism** (the property test): a live RequestQueue
+  driven through a seeded random verb storm journals records whose
+  EVERY prefix replays to the live queue's digest at that point —
+  effects-based records re-apply decisions, they never re-make them;
+- snapshots compact: after ``checkpoint`` the journal is one segment
+  whose first record rebuilds the whole queue, and a tailing standby
+  rides the compaction without losing state;
+- the leader lease: acquire/renew/depose ordering, the corrupt-file
+  drill (one rotten read is UNKNOWN, two promote over the journal's
+  epoch floor), and the double-leader epoch-collision drill recovered
+  through the journal's O_EXCL backstop;
+- in-process failover: a coordinator that stops renewing is replaced
+  by a standby whose replayed queue still holds the in-flight
+  request; the deposed leader answers every mutation with
+  ``DeposedError`` and a ``LeaderClient`` retargets through the
+  lease file;
+- elastic roster: token-authenticated join (bad token → denied +
+  counted) and graceful retire (no further claims; ``drained``
+  answers per-engine once its plate is clean).
+
+No jax: everything here is control plane.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from icikit import chaos, obs
+from icikit.fleet import journal as jlog
+from icikit.fleet.coordinator import Coordinator
+from icikit.fleet.ha import (
+    LeaderClient,
+    LeaderLease,
+    Standby,
+    become_leader,
+)
+from icikit.fleet.transport import RpcClient, RpcError
+from icikit.serve.scheduler import RequestQueue
+
+
+def _mkq(journal=None, lease_s=30.0):
+    q = RequestQueue(lease_s=lease_s)
+    if journal is not None:
+        q.journal = journal
+    return q
+
+
+def _submit(q, rng, n_new=None):
+    return q.submit(
+        rng.integers(0, 64, (int(rng.integers(2, 8)),))
+        .astype(np.int32),
+        int(n_new if n_new is not None else rng.integers(1, 6)),
+        max_retries=3, seed=int(rng.integers(0, 100)),
+        temperature=float(rng.choice([0.0, 0.7])))
+
+
+# -- journal file format ---------------------------------------------
+
+
+def test_journal_roundtrip_and_torn_tail(tmp_path):
+    d = str(tmp_path)
+    j = jlog.Journal(d)
+    j.start(1)
+    recs = [("submit", {"rid": f"r{i}", "seq": i, "prompt": [i],
+                        "n_new": 2, "eos_id": None, "vis": 0.0,
+                        "max_retries": 2, "quant": False, "seed": 0,
+                        "temperature": 0.0, "top_k": 0, "top_p": 1.0,
+                        "trace_id": f"t{i}"}) for i in range(4)]
+    for v, r in recs:
+        j.append(v, r)
+    j.close()
+    seg = jlog.segments(d)
+    assert seg == ["seg-00000001-00000000.log"]
+    path = tmp_path / "journal" / seg[0]
+    got, end, status = jlog.read_records(str(path))
+    assert status == "ok" and got == recs
+    assert end == path.stat().st_size
+    # tear the tail: drop 5 bytes off the last record — the reader
+    # must surface every record before it and flag the damage
+    raw = path.read_bytes()
+    path.write_bytes(raw[:-5])
+    got, _, status = jlog.read_records(str(path))
+    assert status == "partial" and got == recs[:-1]
+    # corrupt (not truncate) the tail: checksum catches it as torn
+    bad = bytearray(raw)
+    bad[-3] ^= 0xFF
+    path.write_bytes(bytes(bad))
+    got, _, status = jlog.read_records(str(path))
+    assert status == "torn" and got == recs[:-1]
+
+
+def test_journal_epoch_collision_is_excl(tmp_path):
+    d = str(tmp_path)
+    a = jlog.Journal(d)
+    a.start(3)
+    b = jlog.Journal(d)
+    with pytest.raises(jlog.EpochCollision):
+        b.start(3)
+    a.close()
+    assert jlog.epoch_floor(d) == 3
+
+
+# -- replay determinism (the property test) --------------------------
+
+
+def _drive(q, rng, n_ops, eos_every=0):
+    """One seeded storm of live verbs against ``q``; returns the rids
+    it touched. Covers every journaled verb: submit, claim (incl.
+    drops via max_retries exhaustion), complete (incl. duplicate),
+    handoff (partial stream → requeued), fail (retry and terminal),
+    release, expire→reap, stamp_marks."""
+    claimed = []
+    for _ in range(n_ops):
+        op = rng.integers(0, 10)
+        if op <= 2 or not claimed:
+            _submit(q, rng)
+            r = q.claim()
+            if r is not None:
+                claimed.append(r)
+        elif op == 3:
+            r = q.claim()
+            if r is not None:
+                claimed.append(r)
+        elif op == 4:
+            r = claimed.pop(rng.integers(0, len(claimed)))
+            q.complete(r.rid, [1, 2, 3][:max(1, r.n_new)],
+                       seq=r.claim_seq)
+            if rng.integers(0, 2):     # duplicate commit path
+                q.complete(r.rid, [9], seq=r.claim_seq)
+        elif op == 5:
+            r = claimed.pop(rng.integers(0, len(claimed)))
+            q.handoff(r.rid, [7], seq=r.claim_seq)
+        elif op == 6:
+            r = claimed.pop(rng.integers(0, len(claimed)))
+            q.fail(r.rid, RuntimeError("boom"),
+                   retry=bool(rng.integers(0, 2)), seq=r.claim_seq)
+        elif op == 7:
+            r = claimed.pop(rng.integers(0, len(claimed)))
+            q.release(r.rid, delay=0.0, seq=r.claim_seq)
+        elif op == 8:
+            r = claimed.pop(rng.integers(0, len(claimed)))
+            q.expire([r.rid])
+            q.reap_expired()
+        else:
+            r = claimed[rng.integers(0, len(claimed))]
+            q.stamp_marks(r.rid, {
+                "admit_t": 1.0, "first_token_t": 2.0,
+                "max_gap_ms": float(rng.integers(1, 50)),
+                "prefix_hit_tokens": int(rng.integers(0, 4))})
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3, 4])
+def test_replay_every_prefix_is_bitwise(seed):
+    """Any prefix of the verb log replays to the live queue's exact
+    digest at that point — the journal's core contract."""
+    records, digests = [], []
+
+    def tap(verb, rec):
+        records.append((verb, rec))
+
+    q = _mkq(journal=tap)
+    rng = np.random.default_rng(seed)
+    n_before = 0
+    for _ in range(40):
+        _drive(q, rng, 1)
+        if len(records) != n_before:
+            # digest after each batch of appends: live state at every
+            # record boundary the storm produced
+            digests.append((len(records), q.state_digest()))
+            n_before = len(records)
+    assert records, "storm journaled nothing"
+    for upto, want in digests:
+        rq, _meta = jlog.replay_records(records[:upto])
+        assert rq.state_digest() == want, \
+            f"seed {seed}: prefix {upto}/{len(records)} diverged"
+
+
+def test_replay_through_snapshot_is_bitwise(tmp_path):
+    """A snapshot mid-stream supersedes the records before it: replay
+    of snap+tail equals live, and the compacted on-disk journal
+    rebuilds the same digest through the file path too."""
+    d = str(tmp_path)
+    j = jlog.Journal(d)
+    j.start(1)
+    q = _mkq(journal=j.append)
+    rng = np.random.default_rng(7)
+    _drive(q, rng, 12)
+    assert q.checkpoint(meta={"phases": {}, "owners": {},
+                             "n_handoffs": 0}) is not None
+    _drive(q, rng, 12)
+    live = q.state_digest()
+    j.close()
+    assert len(jlog.segments(d)) == 1      # compaction ran
+    rq, _meta, info = jlog.replay(d)
+    assert rq.state_digest() == live
+    assert info["torn"] == 0
+    # the replayed queue mints FRESH rids above the journaled range —
+    # no collision with anything the previous life handed out
+    new_rid = _submit(rq, rng)
+    assert new_rid not in {r for r in q._requests}
+
+
+def test_replayed_leader_continues_bitwise():
+    """A successor restored from the journal keeps tracking the live
+    queue verb-for-verb: after replay, every further journaled verb
+    replays onto the replica to the exact live digest.  (Parallel
+    live driving can NOT be the bar — verbs stamp wall-clock instants
+    like ``visible_after`` at append time, so two live queues diverge
+    by nanoseconds; the journal records those instants, which is
+    precisely why replay is exact.)"""
+    records = []
+    q = _mkq(journal=lambda v, r: records.append((v, r)))
+    rng = np.random.default_rng(11)
+    _drive(q, rng, 25)
+    rq, _ = jlog.replay_records(records)
+    assert rq.state_digest() == q.state_digest()
+    # continuation: keep journaling the live queue and check the
+    # replica stays digest-locked at every step of the tail
+    for _ in range(15):
+        _drive(q, rng, 1)
+        rq, _ = jlog.replay_records(records)
+        assert rq.state_digest() == q.state_digest()
+
+
+def test_journal_tail_rides_compaction(tmp_path):
+    d = str(tmp_path)
+    j = jlog.Journal(d)
+    j.start(1)
+    q = _mkq(journal=j.append)
+    rng = np.random.default_rng(3)
+    tail = jlog.JournalTail(d)
+    _drive(q, rng, 10)
+    tail.poll()
+    q.checkpoint(meta=None)                # compacts under the tail
+    _drive(q, rng, 10)
+    tail.poll()
+    rq, _meta = tail.finish()
+    assert rq.state_digest() == q.state_digest()
+    j.close()
+
+
+# -- leader lease ----------------------------------------------------
+
+
+def test_lease_acquire_renew_depose(tmp_path):
+    lease = LeaderLease(str(tmp_path), timeout_s=0.3)
+    e1 = lease.try_acquire("a")
+    assert e1 == 1
+    # live lease blocks a second owner, not the holder
+    assert lease.try_acquire("b") is None
+    assert lease.renew("a", e1)
+    time.sleep(0.35)
+    e2 = lease.try_acquire("b")
+    assert e2 == 2
+    assert lease.renew("a", e1) is False   # deposed by higher epoch
+    assert lease.renew("b", e2)
+
+
+def test_lease_corrupt_read_is_unknown_then_floor(tmp_path):
+    lease = LeaderLease(str(tmp_path), timeout_s=10.0)
+    lease.try_acquire("a")
+    with chaos.inject(chaos.plan_from_spec(
+            "seed=3;corrupt:fleet.ha.lease=@0+1")) as plan:
+        sb = Standby(str(tmp_path), "b", lease_timeout_s=10.0)
+        # first rotten read: UNKNOWN, no promotion
+        assert sb._should_promote() is False
+        # second consecutive rotten read: rot at rest — promote
+        assert sb._should_promote() is True
+        assert plan.fired("corrupt", "fleet.ha.lease") == 2
+
+
+def test_epoch_collision_drill_recovers(tmp_path):
+    """The double-leader drill: an io fault at epoch mint re-mints a
+    stale (already-journaled) epoch; the O_EXCL segment is the
+    backstop and election recovers above the collision."""
+    d = str(tmp_path)
+    a = become_leader(d, "a", lease_timeout_s=0.2)
+    a.journal.append("cphase", {"rid": "x", "phase": "any"})
+    a.journal.close()
+    time.sleep(0.25)
+    with obs.session() as sess, \
+            chaos.inject(chaos.plan_from_spec(
+                "seed=7;io:fleet.ha.epoch=@0")) as plan:
+        b = become_leader(d, "b", lease_timeout_s=0.2)
+        assert plan.fired("io", "fleet.ha.epoch") == 1
+    assert b.epoch > a.epoch
+    snap = sess.registry.snapshot()
+    assert snap["counters"].get(
+        "fleet.leader.epoch_collisions", 0) >= 1
+    b.close()
+
+
+# -- in-process failover ---------------------------------------------
+
+
+def _coord(store, ctx, **kw):
+    return Coordinator(str(store), lease_s=5.0, reap_interval_s=0.05,
+                       ha=ctx, **kw)
+
+
+def test_failover_preserves_inflight_request(tmp_path):
+    d = str(tmp_path / "ha")
+    store = tmp_path / "store"
+    ctx = become_leader(d, "c0", lease_timeout_s=0.5)
+    coord = _coord(store, ctx)
+    client = RpcClient(coord.addr)
+    try:
+        client.call("hello", {"engine": "e0", "role": "both"})
+        reply, _ = client.call("submit", {"prompt": [1, 2, 3],
+                                          "n_new": 4})
+        rid = reply["rid"]
+        # leader "dies": its reaper (the renewal heartbeat) stops
+        coord._stop.set()
+        sb = Standby(d, "c1", lease_timeout_s=0.5, poll_s=0.02)
+        t0 = time.monotonic()
+        ctx2 = sb.run_until_leader()
+        assert time.monotonic() - t0 < 1.0   # < 2x lease timeout
+        coord2 = _coord(store, ctx2)
+        try:
+            assert coord2.epoch > coord.epoch
+            assert coord2.queue.pending() == 1
+            assert coord2._phase.get(rid) == "any"
+            # the deposed leader fences every mutation...
+            coord._deposed = True
+            with pytest.raises(RpcError) as ei:
+                client.call("submit", {"prompt": [9], "n_new": 1})
+            assert ei.value.etype == "DeposedError"
+            # ...and a lease-resolving client lands on the successor
+            lc = LeaderClient(d, fallback_addr=coord.addr,
+                              resolve_timeout_s=5.0)
+            try:
+                stats, _ = lc.call("fleet_stats")
+                assert stats["epoch"] == coord2.epoch
+                got, _ = lc.call("request", {"rid": rid})
+                assert got["known"] and got["state"] == "queued"
+            finally:
+                lc.close()
+        finally:
+            coord2.shutdown()
+            ctx2.close()
+    finally:
+        client.close()
+        coord.shutdown()
+        ctx.close()
+
+
+def test_takeover_snapshot_supersedes_stale_appends(tmp_path):
+    """A zombie predecessor appending after the successor's takeover
+    snapshot cannot reach the NEXT replay: its records sort into an
+    old-epoch segment below the snapshot."""
+    d = str(tmp_path / "ha")
+    store = tmp_path / "store"
+    ctx = become_leader(d, "c0", lease_timeout_s=0.4)
+    coord = _coord(store, ctx)
+    rid = coord.submit(np.asarray([1, 2], np.int32), 3)
+    coord._stop.set()
+    time.sleep(0.45)
+    ctx2 = become_leader(d, "c1", lease_timeout_s=0.4)
+    coord2 = _coord(store, ctx2)    # writes the takeover snapshot
+    # zombie writes AFTER the takeover — a stale submit-like record
+    ctx.journal.append("cphase", {"rid": "zombie", "phase": "any"})
+    coord2._stop.set()
+    time.sleep(0.45)
+    ctx3 = become_leader(d, "c2", lease_timeout_s=0.4)
+    assert rid in ctx3.queue._requests
+    assert "zombie" not in ctx3.meta.phases
+    coord.shutdown(); coord2.shutdown()
+    ctx.close(); ctx2.close(); ctx3.close()
+
+
+# -- elastic roster --------------------------------------------------
+
+
+def test_authenticated_join(tmp_path):
+    coord = Coordinator(str(tmp_path), reap_interval_s=0.1,
+                        join_token="sekrit")
+    client = RpcClient(coord.addr)
+    try:
+        with obs.session() as sess:
+            with pytest.raises(RpcError) as ei:
+                client.call("hello", {"engine": "e0", "role": "both",
+                                      "token": "wrong"})
+            assert ei.value.etype == "PermissionError"
+            reply, _ = client.call("hello", {
+                "engine": "e0", "role": "both", "token": "sekrit"})
+            assert reply["lease_s"] == coord.queue.lease_s
+        snap = sess.registry.snapshot()
+        assert snap["counters"]["fleet.roster.join_denied"] == 1
+        assert snap["counters"]["fleet.roster.joins"] == 1
+    finally:
+        client.close()
+        coord.shutdown()
+
+
+def test_retire_drains_per_engine(tmp_path):
+    coord = Coordinator(str(tmp_path), reap_interval_s=0.1)
+    client = RpcClient(coord.addr)
+    try:
+        client.call("hello", {"engine": "e0", "role": "both"})
+        client.call("hello", {"engine": "e1", "role": "both"})
+        coord.submit(np.asarray([1, 2, 3], np.int32), 2)
+        r, _ = client.call("claim", {"engine": "e0"})
+        assert r["req"] is not None
+        rid = r["req"]["rid"]
+        reply, _ = client.call("retire", {"engine": "e1"})
+        assert reply["retired"]
+        # retired with an empty plate: out immediately, even though
+        # the fleet still has work in flight
+        d1, _ = client.call("drained", {"engine": "e1"})
+        assert d1["drained"] is True
+        # a retired engine gets no further claims
+        c1, _ = client.call("claim", {"engine": "e1"})
+        assert c1["req"] is None and c1["denied"] == "retired"
+        # the working engine still drains normally
+        d0, _ = client.call("drained", {"engine": "e0"})
+        assert d0["drained"] is False
+        client.call("complete", {"engine": "e0", "rid": rid,
+                                 "seq": r["req"]["claim_seq"],
+                                 "tokens": [5, 6]})
+        d0, _ = client.call("drained", {"engine": "e0"})
+        assert d0["drained"] is True
+    finally:
+        client.close()
+        coord.shutdown()
+
+
+def test_rejoin_after_failover_unknown_denial():
+    """The RemoteQueue re-hello hook: a claim denied ``unknown``
+    (failover successor never met this engine) triggers exactly one
+    re-registration and the next claim succeeds."""
+    from icikit.fleet.roles import RemoteQueue
+
+    class FakeClient:
+        def __init__(self):
+            self.known = False
+            self.calls = []
+
+        def call(self, op, msg, blobs=()):
+            self.calls.append(op)
+            if op == "hello":
+                self.known = True
+                return {"ok": True, "lease_s": 5.0, "epoch": 2}, ()
+            if op == "claim":
+                if not self.known:
+                    return {"ok": True, "req": None,
+                            "denied": "unknown"}, ()
+                return {"ok": True, "req": None}, ()
+            raise AssertionError(op)
+
+    c = FakeClient()
+    hellos = []
+    q = RemoteQueue(c, "e0", hello=lambda: (
+        hellos.append(1), c.call("hello", {}))[-1])
+    assert q.claim() is None
+    assert hellos == [1]
+    assert q.claim() is None
+    assert hellos == [1]       # no re-hello once known

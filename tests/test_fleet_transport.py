@@ -154,6 +154,51 @@ def test_rpc_client_reconnects_after_server_restart():
             srv2.close()
 
 
+def test_rpc_reconnect_during_partial_frame():
+    """The peer dies AFTER the length prefix, BEFORE the payload —
+    the nastiest tear: the reader is committed to a frame that will
+    never finish. The short read must surface as ``TransportError``
+    (not a hang, not a parse of garbage) and the bounded-backoff
+    reconnect must carry the SAME call to a real server."""
+    import struct
+
+    from icikit.fleet.transport import MAGIC
+    from icikit.utils.net import free_port
+
+    try:
+        port = free_port("127.0.0.1")
+    except OSError as e:  # pragma: no cover
+        pytest.skip(f"cannot bind a local port: {e}")
+    lsn = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    lsn.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    lsn.bind(("127.0.0.1", port))
+    lsn.listen(1)
+    srv2 = None
+
+    def half_frame_then_die():
+        nonlocal srv2
+        conn, _ = lsn.accept()
+        conn.recv(1 << 16)             # swallow the request
+        # a frame header promising 4096 bytes that never arrive
+        conn.sendall(MAGIC + struct.pack(">Q", 4096))
+        conn.close()
+        lsn.close()
+        srv2 = RpcServer(_echo_handler, port=port)
+
+    t = threading.Thread(target=half_frame_then_die)
+    t.start()
+    cli = RpcClient(("127.0.0.1", port), retries=6,
+                    first_backoff=0.05, max_backoff=0.5)
+    try:
+        reply, _ = cli.call("ping", {"n": 9})
+        assert reply["echo"] == "ping" and reply["n"] == 9
+    finally:
+        t.join()
+        cli.close()
+        if srv2 is not None:
+            srv2.close()
+
+
 def test_rpc_checksum_retry_is_bounded():
     """Permanent wire rot exhausts the bounded retries and raises —
     the transport never spins forever."""

@@ -61,6 +61,9 @@ NUMERIC_CONFIG = {
     # fleet rows (serve_fleet_r17.jsonl): engine count is a workload
     # knob — a 4-engine arm must never gate a 1-engine arm
     "n_engines", "lease_s",
+    # HA rows (serve_fleet_ha_r18.jsonl): failover timing is priced
+    # BY these knobs, so arms only pair within identical HA config
+    "n_standbys", "lease_timeout_s", "snapshot_every",
 }
 
 # (path, direction, default relative tolerance) — applied when the

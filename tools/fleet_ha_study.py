@@ -1,0 +1,134 @@
+#!/usr/bin/env python
+"""Fleet round-18 study: the kill-the-leader soak.
+
+One campaign, appending to ``serve_fleet_ha_r18.jsonl``: a fleet of
+2 engines + 3 coordinators (1 leader, 2 warm standbys) serves a
+greedy trace while every HA failure mode fires at once:
+
+- **leader death #1** (chaos): the seed leader is armed with
+  ``die:fleet.journal.write`` — it dies MID-APPEND, leaving a torn
+  half-frame at the journal tail that the promoting standby must
+  detect (``torn`` counted in its elected event) and replay past.
+- **leader death #2** (driver): the successor is SIGKILLed mid-decode
+  once half the timed trace has completed; the last standby promotes.
+- **double-leader drill**: the first standby is armed with
+  ``io:fleet.ha.epoch`` — at promotion it mints a stale epoch and
+  must recover through the journal's O_EXCL ``EpochCollision``
+  backstop (observable as a ``fleet.leader.epoch_collision`` event).
+- **rotten lease drill**: the second standby is armed with
+  ``corrupt:fleet.ha.lease`` on two CONSECUTIVE reads — streak
+  policy promotes it over the unreadable file, it loses the election
+  to the live leader, and must fall back to tailing (the
+  ``LostElection`` recovery path) instead of crashing.
+- **engine churn**: one engine is chaos-killed mid-decode
+  (``die:fleet.engine.die``) and the queue-depth watch alert spawns a
+  token-authenticated joiner whose bridge-rewarmed first commit
+  prices scale-up-to-first-token.
+
+Exit bar: every request completes, every completed request's tokens
+are bitwise identical to single-request decode, ZERO duplicate
+commits, each driver-measured failover under 2x the lease timeout,
+and every drill observed in the record.
+
+Reproduce::
+
+    python tools/fleet_ha_study.py --json serve_fleet_ha_r18.jsonl
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from icikit.bench.fleet import run_fleet_ha  # noqa: E402
+
+
+def soak(json_path: str | None = None, n_requests: int = 32,
+         seed: int = 0, lease_timeout_s: float = 1.5,
+         timeout_s: float = 900.0) -> dict:
+    """The kill-the-leader soak; returns the record (and raises on
+    any violated bar). Coordinators: coord0 (seed leader, dies
+    mid-append), coord1 (promotes through the epoch-collision drill,
+    then SIGKILLed), coord2 (rides the rotten-lease drill, finishes
+    the trace). Engines: both0 (survivor), both1 (chaos-killed),
+    joiner (alert-spawned)."""
+    rec = run_fleet_ha(
+        # decode lengths sized so the backlog outlives the join
+        # alert: the scale-up-to-first-token bar needs the joiner to
+        # claim work before the fleet drains
+        n_engines=2, n_requests=n_requests, rate_rps=16.0,
+        prompt_len=8, new_min=24, new_max=32, rows=2,
+        n_standbys=2, kill_leader_at=(0.5,), join_engine=True,
+        seed=seed, lease_s=5.0, lease_timeout_s=lease_timeout_s,
+        heartbeat_timeout_s=2.0, snapshot_every=64,
+        pending_high=4.0, verify=True, timeout_s=timeout_s,
+        coord_env={
+            # die mid-append once the decode window is under way:
+            # write #60 lands after the warm phase (~30 records) and
+            # the 32-submit burst, inside the timed claim/commit flow
+            "coord0": {"ICIKIT_CHAOS":
+                       "seed=11;die:fleet.journal.write=@60"},
+            "coord1": {"ICIKIT_CHAOS":
+                       "seed=12;io:fleet.ha.epoch=@0"},
+            "coord2": {"ICIKIT_CHAOS":
+                       "seed=13;corrupt:fleet.ha.lease=@6+7"},
+        },
+        engine_env={
+            "both1": {"ICIKIT_CHAOS":
+                      "seed=2;die:fleet.engine.die=@12"},
+        })
+    # the soak's bars, enforced loudly
+    assert rec["completed"] == n_requests and not rec["failed"], rec
+    assert rec["identity_ok"], rec
+    assert rec["duplicate_commits"] == 0, rec
+    # leader died twice: once mid-append (exit 23 is the
+    # fleet.journal.write drill's signature), once by SIGKILL
+    assert rec["coordinators"]["coord0"]["returncode"] == 23, rec
+    assert rec["leader_kills"] >= 1, rec
+    assert rec["elected_events"] >= 3, rec
+    bar_ms = lease_timeout_s * 2 * 1e3
+    assert all(ms < bar_ms for ms in rec["failover_ms"]), rec
+    # the torn half-frame was seen and replayed past by a successor
+    assert any(e.get("torn", 0) >= 1 for e in rec["elected"]), rec
+    assert rec["chaos_events"]["epoch_collision"] >= 1, rec
+    assert rec["chaos_events"]["lease_corrupt"] >= 2, rec
+    # engine churn: both1 chaos-died (spawn order both0, both1,
+    # joiner; a chaos-killed engine exits before its stats line, so
+    # index by order), its work was reissued, and the alert-spawned
+    # joiner priced scale-up-to-first-token
+    assert rec["engines"][1]["returncode"] != 0, rec
+    assert rec["reissues"] >= 1, rec
+    assert rec["joined_engine"] is not None, rec
+    assert rec["scaleup_ttft_ms"] is not None, rec
+    if json_path:
+        with open(json_path, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+    return rec
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--json", dest="json_path",
+                    default="serve_fleet_ha_r18.jsonl")
+    ap.add_argument("--requests", type=int, default=32)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--lease-timeout", type=float, default=1.5)
+    args = ap.parse_args(argv)
+    rec = soak(args.json_path, n_requests=args.requests,
+               seed=args.seed, lease_timeout_s=args.lease_timeout)
+    print("SOAK_OK", json.dumps({
+        "failover_ms": rec["failover_ms"],
+        "elected": [e["takeover_ms"] for e in rec["elected"]],
+        "scaleup_ttft_ms": rec["scaleup_ttft_ms"],
+        "duplicate_commits": rec["duplicate_commits"],
+        "chaos_events": rec["chaos_events"]}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -179,10 +179,10 @@ def soak(json_path: str | None = None, n_requests: int = 14,
         rids, [(0.0, p, n, rs) for p, n, rs in workload]))
         if i % 2 == 1]
     audit_g = _verify_identity(
-        model, coord, [r for r, _ in greedy],
+        model, coord.queue.request, [r for r, _ in greedy],
         [w for _, w in greedy], 0.0, 0, 1.0)
     audit_s = _verify_identity(
-        model, coord, [r for r, _ in sampled],
+        model, coord.queue.request, [r for r, _ in sampled],
         [w for _, w in sampled], 0.7, 0, 0.9)
     reg = coord.engines()
     rec = {

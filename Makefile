@@ -6,7 +6,8 @@ PY ?= python
 
 .PHONY: test test-fast chaos bench native clean sweep scaling northstar \
 	trace-demo check analysis-smoke decode-smoke draft-smoke \
-	serve-smoke quant-smoke obs-smoke fleet-smoke fleet-ha-smoke
+	serve-smoke quant-smoke obs-smoke fleet-smoke fleet-ha-smoke \
+	fleet-obs-smoke
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -47,7 +48,8 @@ check:
 		--budget 30
 	$(PY) tools/bench_regress.py --self-check serve_r12.jsonl \
 		serve_r15.jsonl serve_r16.jsonl serve_fleet_r17.jsonl \
-		serve_fleet_ha_r18.jsonl decode_spec_r14.jsonl \
+		serve_fleet_ha_r18.jsonl serve_fleet_obs_r19.jsonl \
+		decode_spec_r14.jsonl \
 		--verdict /tmp/icikit_bench_regress.json
 
 # machine-readable analysis output: the --json shape the tooling
@@ -237,6 +239,36 @@ fleet-smoke:
 		--lease 2 --kill 1:6 --expect-reissue --verify-identity \
 		--seed 0 > /dev/null
 	@echo "fleet-smoke kill-drill OK: engine died mid-decode, leases reissued, all requests completed bitwise"
+
+# the r19 fleet obs plane: 2-engine disaggregated run with the
+# telemetry plane armed end-to-end — workers forward bus events /
+# metrics / trace deltas to the coordinator-side collector, which
+# must yield ONE merged checker-valid trace containing at least one
+# async request tree spanning both engine processes
+# (prefill -> handoff -> decode), with zero telemetry loss
+# (dropped == corrupt_frames == lost_batches == 0) and a healthy
+# aggregated-watch verdict
+fleet-obs-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m icikit.bench.fleet --engines 2 \
+		--roles disagg --requests 8 --rate 20 --prompt 12 \
+		--new-min 4 --new-max 8 --prefix 8 --verify-identity \
+		--seed 0 --fleet-obs \
+		--obs-out /tmp/icikit_fleet_obs_trace.json \
+		--json /tmp/icikit_fleet_obs_rec.jsonl \
+		> /tmp/icikit_fleet_obs_out.txt
+	$(PY) -m icikit.obs.check /tmp/icikit_fleet_obs_trace.json
+	@$(PY) -c "import json; \
+		line = [l for l in open('/tmp/icikit_fleet_obs_out.txt') \
+		        if l.startswith('FLEET_OBS ')][-1]; \
+		r = json.loads(line[len('FLEET_OBS '):]); \
+		assert r['dropped'] == 0, f'telemetry dropped: {r}'; \
+		assert r['corrupt_frames'] == 0, f'corrupt frames: {r}'; \
+		assert r['lost_batches'] == 0, f'lost batches: {r}'; \
+		assert r['cross_process_trees'] >= 1, f'no cross-process tree: {r}'; \
+		assert r['healthy'], f'unhealthy verdict: {r}'; \
+		print('fleet-obs-smoke OK: merged trace checker-valid,', \
+		      r['cross_process_trees'], 'cross-process trees,', \
+		      r['batches'], 'batches, zero telemetry loss')"
 
 # the r18 HA drill: 2 engines + 1 warm standby, the leader SIGKILLed
 # mid-decode — the standby must promote inside 2x the lease timeout

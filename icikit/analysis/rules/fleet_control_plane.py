@@ -6,8 +6,12 @@ defective engine's device schedules are exactly what is under
 suspicion, and must never stall a claim RPC behind an XLA dispatch —
 so it performs NO jax device dispatch and NO jnp allocation: control
 frames and KV bytes move over host sockets only (numpy views are
-fine; they are host memory). The data plane (``roles.py``/
-``worker.py`` — the engine lives there) is explicitly out of scope.
+fine; they are host memory). Since r19 the telemetry/collector path
+(``fleet/telemetry.py``, ``obs/aggregate.py``) is held to the same
+contract: observability must keep flowing — and the collector must
+keep answering inside the coordinator — while engine device
+schedules are suspect. The data plane (``roles.py``/``worker.py`` —
+the engine lives there) is explicitly out of scope.
 
 Mechanically: flag any ``import jax``/``from jax ...`` and any
 ``jax.``/``jnp.`` attribute use in the control-plane modules,
@@ -23,7 +27,9 @@ CONTROL_PLANE = ("icikit/fleet/transport.py",
                  "icikit/fleet/coordinator.py",
                  "icikit/fleet/kvbridge.py",
                  "icikit/fleet/journal.py",
-                 "icikit/fleet/ha.py")
+                 "icikit/fleet/ha.py",
+                 "icikit/fleet/telemetry.py",
+                 "icikit/obs/aggregate.py")
 
 BANNED = [
     (re.compile(r"^\s*(?:import|from)\s+jax\b"),

@@ -91,11 +91,12 @@ def spawn_worker(addr, engine_id: str, role: str, model_spec: dict,
                  env_extra: dict | None = None,
                  rewarm: bool = False,
                  ha_dir: str | None = None,
-                 token: str | None = None) -> subprocess.Popen:
+                 token: str | None = None,
+                 telemetry: dict | None = None) -> subprocess.Popen:
     cfg = {"addr": list(addr) if addr is not None else None,
            "engine_id": engine_id, "role": role,
            "model": model_spec, "serve": serve_kw, "rewarm": rewarm,
-           "ha_dir": ha_dir, "token": token}
+           "ha_dir": ha_dir, "token": token, "telemetry": telemetry}
     path = os.path.join(tmpdir, f"{engine_id}.json")
     with open(path, "w") as f:
         json.dump(cfg, f)
@@ -189,11 +190,17 @@ def run_fleet(n_engines: int, n_requests: int, rate_rps: float,
               lease_s: float = 10.0, timeout_s: float = 900.0,
               store_dir: str | None = None,
               env_extra_per_engine: dict | None = None,
-              require_alive: int = 1) -> dict:
+              require_alive: int = 1,
+              fleet_obs: bool = False,
+              obs_out: str | None = None) -> dict:
     """One fleet arm. ``env_extra_per_engine`` maps engine-id ->
     extra env (the soak's per-victim ``ICIKIT_CHAOS`` plans);
     ``require_alive`` is the survivor floor the drain wait tolerates
-    (p−1-survive soaks pass 1)."""
+    (p−1-survive soaks pass 1). ``fleet_obs`` arms the r19 telemetry
+    plane end-to-end: workers forward bus events/metrics/trace deltas
+    to a coordinator-side :class:`~icikit.obs.aggregate.FleetCollector`,
+    and the record grows the merged-trace/verdict fields (the merged
+    checker-valid trace lands at ``obs_out`` when given)."""
     import jax
 
     from icikit.fleet.coordinator import Coordinator
@@ -220,7 +227,15 @@ def run_fleet(n_engines: int, n_requests: int, rate_rps: float,
     tmpdir = tempfile.mkdtemp(prefix="icikit_fleet_")
     own_store = store_dir is None
     store = store_dir or os.path.join(tmpdir, "bridge")
-    coord = Coordinator(store, lease_s=lease_s)
+    collector = None
+    if fleet_obs:
+        from icikit.obs import tracer as _tracer
+        from icikit.obs.aggregate import FleetCollector
+        obs.enable_metrics()
+        _tracer.start_tracing()     # coordinator-side root spans
+        collector = FleetCollector()
+    coord = Coordinator(store, lease_s=lease_s, collector=collector)
+    tele_cfg = ({"addr": list(coord.addr)} if fleet_obs else None)
     procs = []
     try:
         for i, role in enumerate(role_list):
@@ -228,7 +243,7 @@ def run_fleet(n_engines: int, n_requests: int, rate_rps: float,
             extra = (env_extra_per_engine or {}).get(eid)
             procs.append(spawn_worker(
                 coord.addr, eid, role, model_spec, serve_kw, tmpdir,
-                env_extra=extra))
+                env_extra=extra, telemetry=tele_cfg))
         # registration barrier: submit nothing until every worker has
         # said hello — phase assignment (disaggregation) keys on the
         # registry, and the warm batch must warm the REAL role split
@@ -289,6 +304,23 @@ def run_fleet(n_engines: int, n_requests: int, rate_rps: float,
             if p.poll() is None:
                 p.kill()
     workers = _collect_worker_stats(procs)
+    obs_fields = {}
+    if collector is not None:
+        from icikit.obs import chrome as _chrome
+        from icikit.obs import tracer as _tracer
+        tb = _tracer.stop_tracing()
+        local = list(tb.events) if tb is not None else []
+        merged = collector.merge_traces(local)
+        obs_fields = {
+            "fleet_obs": True,
+            "telemetry": collector.stats(),
+            "obs_verdict": collector.verdict(),
+            "cross_process_trees": collector.cross_process_trees(
+                merged, exclude_pid=os.getpid()),
+        }
+        if obs_out:
+            _chrome.export(obs_out, merged)
+            obs_fields["trace_path"] = obs_out
     ttft, tpot, qwait, tokens, failed = [], [], [], 0, 0
     for rid in rids:
         req = coord.queue.request(rid)
@@ -343,6 +375,7 @@ def run_fleet(n_engines: int, n_requests: int, rate_rps: float,
                  "ratios under-report separate-host scaling"
                  if jax.default_backend() == "cpu"
                  else "device-measured"),
+        **obs_fields,
     }
     if verify:
         rec.update(_verify_identity(model, coord.queue.request, rids,
@@ -430,7 +463,8 @@ def run_fleet_ha(n_engines: int, n_requests: int, rate_rps: float,
                  pending_high: float = 4.0,
                  verify: bool = True, timeout_s: float = 900.0,
                  coord_env: dict | None = None,
-                 engine_env: dict | None = None) -> dict:
+                 engine_env: dict | None = None,
+                 fleet_obs: bool = False) -> dict:
     """The kill-the-leader arm: ``1 + n_standbys`` coordinator
     PROCESSES over one shared ``ha_dir`` (journal + lease) and
     ``n_engines`` workers that resolve the leader through the lease
@@ -471,7 +505,9 @@ def run_fleet_ha(n_engines: int, n_requests: int, rate_rps: float,
                  "reap_interval_s": 0.1,
                  "snapshot_every": snapshot_every,
                  "join_token": join_token,
+                 "fleet_obs": fleet_obs,
                  "watch": {"pending_high": pending_high}}
+    tele_cfg = {"ha_dir": ha_dir} if fleet_obs else None
     coords: dict = {}
     coords["coord0"] = spawn_coordinator(
         {**coord_cfg, "owner": "coord0", "role": "leader"},
@@ -514,7 +550,8 @@ def run_fleet_ha(n_engines: int, n_requests: int, rate_rps: float,
             procs[eid] = spawn_worker(
                 None, eid, "both", model_spec, serve_kw, tmpdir,
                 env_extra=(engine_env or {}).get(eid),
-                ha_dir=ha_dir, token=join_token)
+                ha_dir=ha_dir, token=join_token,
+                telemetry=tele_cfg)
         deadline = time.monotonic() + timeout_s
         while True:
             stats, _ = lc.call("fleet_stats")
@@ -599,7 +636,8 @@ def run_fleet_ha(n_engines: int, n_requests: int, rate_rps: float,
                     procs[joined_eid] = spawn_worker(
                         None, joined_eid, "both", model_spec,
                         serve_kw, tmpdir, rewarm=True,
-                        ha_dir=ha_dir, token=join_token)
+                        ha_dir=ha_dir, token=join_token,
+                        telemetry=tele_cfg)
             if stats["pending"] == 0 and progress >= len(rids):
                 break
             if sum(p.poll() is None for p in procs.values()) < 1:
@@ -695,6 +733,7 @@ def run_fleet_ha(n_engines: int, n_requests: int, rate_rps: float,
             "duplicate_commits": final.get("duplicate_commits"),
             "handoffs": final.get("handoffs"),
             "journal": final.get("journal"),
+            "telemetry": final.get("telemetry"),
             "joined_engine": joined_eid,
             "join_alert": join_alert,
             "scaleup_ttft_ms": scaleup,
@@ -769,6 +808,14 @@ def main(argv=None) -> int:
                          "least one lease (the kill drill's "
                          "assertion)")
     ap.add_argument("--json", dest="json_path", default=None)
+    ap.add_argument("--fleet-obs", action="store_true",
+                    help="arm the r19 fleet telemetry plane: workers "
+                         "forward obs streams to a coordinator-side "
+                         "collector; the record grows merged-trace + "
+                         "verdict fields")
+    ap.add_argument("--obs-out", default=None, metavar="PATH",
+                    help="write the merged cross-process Chrome trace "
+                         "here (checker-valid; implies --fleet-obs)")
     ap.add_argument("--ha", action="store_true",
                     help="HA arm: out-of-process journaled "
                          "coordinators + warm standby; implies the "
@@ -799,7 +846,8 @@ def main(argv=None) -> int:
             prefill_chunk=args.prefill_chunk,
             lease_s=args.lease,
             lease_timeout_s=args.lease_timeout,
-            verify=args.verify_identity, timeout_s=args.timeout)
+            verify=args.verify_identity, timeout_s=args.timeout,
+            fleet_obs=args.fleet_obs)
         obs.emit_records([rec])
         if args.json_path:
             with open(args.json_path, "a") as f:
@@ -843,11 +891,24 @@ def main(argv=None) -> int:
                     verify=args.verify_identity,
                     lease_s=args.lease,
                     timeout_s=args.timeout,
-                    env_extra_per_engine=env_extra or None)
+                    env_extra_per_engine=env_extra or None,
+                    fleet_obs=args.fleet_obs or bool(args.obs_out),
+                    obs_out=args.obs_out)
     obs.emit_records([rec])
     if args.json_path:
         with open(args.json_path, "a") as f:
             f.write(json.dumps(rec) + "\n")
+    if rec.get("fleet_obs"):
+        # structured handshake for the smoke harness, not telemetry:
+        # the full record already went through the bus above
+        print("FLEET_OBS " + json.dumps({  # icikit-lint: off[obs-print]
+            "dropped": rec["telemetry"]["dropped"],
+            "corrupt_frames": rec["telemetry"]["corrupt_frames"],
+            "lost_batches": rec["telemetry"]["lost_batches"],
+            "batches": rec["telemetry"]["batches"],
+            "cross_process_trees": rec["cross_process_trees"],
+            "healthy": rec["obs_verdict"]["healthy"],
+            "trace": rec.get("trace_path")}))
     if args.expect_reissue and rec["reissues"] < 1:
         print("expected at least one lease reissue, saw none")
         return 1

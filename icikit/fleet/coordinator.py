@@ -99,7 +99,8 @@ class Coordinator:
                  defect_threshold: int = 1,
                  host: str = "127.0.0.1", port: int = 0,
                  ha=None, join_token: str | None = None,
-                 snapshot_every: int = 512, watch=None):
+                 snapshot_every: int = 512, watch=None,
+                 collector=None):
         if ha is not None and ha.queue is not None:
             # a replayed queue (takeover or restart): already holds
             # every in-flight request the previous leader journaled
@@ -122,6 +123,10 @@ class Coordinator:
         self.epoch = ha.epoch if ha is not None else 0
         self._deposed = False
         self._watch = watch
+        # fleet obs plane (r19): an obs.aggregate.FleetCollector —
+        # telemetry.* RPCs route into it, heartbeats feed it, and the
+        # reap loop drives its aggregated-stream watch poll
+        self.collector = collector
         self.shutdown_requested = threading.Event()
         if ha is not None:
             meta = ha.meta.to_dict() if ha.meta is not None else {}
@@ -253,9 +258,24 @@ class Coordinator:
         if op.startswith("store."):
             self._touch(msg.get("engine"))
             return self.bridge.handle(op, msg, blobs)
+        if op.startswith("telemetry."):
+            # telemetry is deliberately NOT journaled: it mutates no
+            # queue state, and a deposed coordinator may keep
+            # collecting while the successor takes over
+            if self.collector is None:
+                raise ValueError("fleet telemetry plane is not armed")
+            return self.collector.handle(op, msg, blobs)
         fn = getattr(self, "_op_" + op, None)
         if fn is None:
             raise ValueError(f"unknown fleet op {op!r}")
+        if self.collector is not None and op in ("claim", "renew"):
+            # control-plane op latency into the fleet registry —
+            # lease-path stalls are a coordinator health signal
+            t0 = time.monotonic()
+            out = fn(msg, blobs)
+            self.collector.observe_latency(
+                f"fleet.{op}_ms", (time.monotonic() - t0) * 1000.0)
+            return out
         return fn(msg, blobs)
 
     def _touch(self, engine_id) -> None:
@@ -346,13 +366,19 @@ class Coordinator:
             if e is not None and e.get("first_commit_t") is None:
                 e["first_commit_t"] = now
 
-    def _observe_slo(self, rid: str) -> None:
+    def _observe_slo(self, rid: str,
+                     engine_id: str | None = None) -> None:
         """Feed the request's terminal TTFT into this process's
         histogram registry — what the fleet_watch SLO-burn detector
-        windows over for the scale-up signal."""
+        windows over for the scale-up signal — and, with the obs
+        plane armed, the full SLO record into the collector's
+        PER-ENGINE watch windows (straggler/outlier detection needs
+        to know which engine served it)."""
         slo = self.queue.request(rid).slo()
         if slo.get("ttft_ms") is not None:
             obs.observe("serve.ttft_ms", float(slo["ttft_ms"]))
+        if self.collector is not None:
+            self.collector.observe_slo(engine_id or "unknown", slo)
 
     def _op_complete(self, msg, blobs):
         self._check_leader()
@@ -390,14 +416,14 @@ class Coordinator:
                 obs.count("fleet.handoffs")
             else:
                 self._untrack(rid)
-                self._observe_slo(rid)
+                self._observe_slo(rid, engine_id)
             return {"state": state, "committed": True}, ()
         committed = self.queue.complete(rid, full, seq=seq)
         if committed:
             self.queue.stamp_marks(rid, msg.get("marks"))
             self._first_commit(engine_id)
             self._untrack(rid)
-            self._observe_slo(rid)
+            self._observe_slo(rid, engine_id)
         return {"state": req.state, "committed": committed}, ()
 
     def _op_fail(self, msg, blobs):
@@ -485,7 +511,17 @@ class Coordinator:
             out["journal"] = self._ha.journal.stats()
         if self._watch is not None:
             out["watch"] = self._watch.verdict()
+        if self.collector is not None:
+            out["telemetry"] = self.collector.stats()
         return out, ()
+
+    def _op_resident_chains(self, msg, blobs):
+        """Roster residency query: per-engine resident-chain bloom
+        summaries from the heartbeats — the substrate cache-aware
+        ``claim(accept=)`` routing will consume (ROADMAP 1a)."""
+        if self.collector is None:
+            return {"resident": {}}, ()
+        return {"resident": self.collector.resident_summaries()}, ()
 
     def _op_retire(self, msg, blobs):
         """Graceful scale-down: no further claims for this engine; it
@@ -519,16 +555,24 @@ class Coordinator:
         independent of the engine loop (XLA compiles stall renewals,
         not the report thread) and aggregates fleet SLO gauges."""
         engine_id = msg["engine"]
+        stats = {k: msg.get(k) for k in
+                 ("tokens", "steps", "occupancy",
+                  "integrity_failures")
+                 if msg.get(k) is not None}
         with self._lock:
             e = self._engines.get(engine_id)
             if e is None:
                 return {"state": "unknown"}, ()
             e["last_seen"] = time.monotonic()
-            e["stats"] = {k: msg.get(k) for k in
-                          ("tokens", "steps", "occupancy",
-                           "integrity_failures")
-                          if msg.get(k) is not None}
+            e["stats"] = stats
             state = e["state"]
+        if self.collector is not None:
+            # roster state into the obs plane (outside our lock —
+            # the collector takes its own)
+            self.collector.update_report(engine_id, stats)
+            if msg.get("resident") is not None:
+                self.collector.update_resident(engine_id,
+                                               msg["resident"])
         return {"state": state}, ()
 
     def _op_drained(self, msg, blobs):
@@ -645,6 +689,8 @@ class Coordinator:
             self._gauges()
             if self._watch is not None:
                 self._watch.maybe_poll()
+            if self.collector is not None:
+                self.collector.maybe_poll()
 
     def _gauges(self) -> None:
         with self._lock:

@@ -407,6 +407,22 @@ def serve(cfg: dict) -> int:
                                     DEFAULT_LEASE_TIMEOUT_S))
     lease_s = float(cfg.get("lease_s", 5.0))
 
+    tele = None
+    fleet_obs = bool(cfg.get("fleet_obs"))
+    if fleet_obs:
+        from icikit import obs as _obs
+        _obs.enable_metrics()
+        _obs.start_tracing()
+        if role == "standby":
+            # a WARM standby forwards its own obs stream to whoever
+            # currently leads (lease-resolving client): its tail
+            # progress and election telemetry land in the fleet
+            # picture before it ever serves a claim
+            from icikit.fleet.telemetry import TelemetryForwarder
+            tele = TelemetryForwarder(
+                client=LeaderClient(ha_dir), source=owner,
+                role="standby").start()
+
     if role == "standby":
         standby = Standby(ha_dir, owner,
                           lease_timeout_s=lease_timeout_s,
@@ -423,6 +439,15 @@ def serve(cfg: dict) -> int:
         from icikit import obs as _obs
         _obs.enable_metrics()   # the watch windows THIS process's
         watch = fleet_watch(**cfg["watch"]).attach()
+    collector = None
+    if fleet_obs:
+        # promoted (or seed leader): we ARE the collector now — stop
+        # forwarding to ourselves and stand the aggregation plane up
+        if tele is not None:
+            tele.stop()
+            tele = None
+        from icikit.obs.aggregate import FleetCollector
+        collector = FleetCollector()
     coord = Coordinator(
         cfg["store_dir"], lease_s=lease_s,
         heartbeat_timeout_s=float(cfg.get("heartbeat_timeout_s", 2.0)),
@@ -432,7 +457,7 @@ def serve(cfg: dict) -> int:
         port=int(cfg.get("port", 0)),
         ha=ctx, join_token=cfg.get("join_token"),
         snapshot_every=int(cfg.get("snapshot_every", 512)),
-        watch=watch)
+        watch=watch, collector=collector)
     print("FLEET_HA_LEADER_OK "
           + json.dumps({"owner": owner, "epoch": ctx.epoch,
                         "addr": list(coord.addr)}),

@@ -40,6 +40,7 @@ import numpy as np
 
 from icikit import chaos, obs
 from icikit.fleet.kvbridge import BridgeStore
+from icikit.fleet.telemetry import chain_bloom
 from icikit.fleet.transport import RpcClient, RpcError
 from icikit.obs import trace_ctx
 from icikit.serve.scheduler import Request
@@ -119,6 +120,12 @@ class RemoteQueue:
         # spans — one request, one tree, across processes
         req.trace = trace_ctx.adopt(w["rid"], w["trace_id"],
                                     int(w["claim_seq"]))
+        # stamp THIS process into the tree immediately: even an
+        # attempt that dies before any other instant leaves the
+        # claiming engine's pid in the merged cross-process tree
+        req.trace.instant("serve.req.claimed",
+                          seq=int(w["claim_seq"]),
+                          engine=self.engine_id)
         self._local[req.rid] = req
         return req
 
@@ -288,7 +295,11 @@ class EngineWorker:
                         "steps": self.engine.n_steps,
                         "occupancy": self.engine.occupancy_mean(),
                         "integrity_failures":
-                            self.queue.n_integrity_fails})
+                            self.queue.n_integrity_fails,
+                        # residency summary for the collector: the
+                        # substrate cache-aware claim routing consumes
+                        "resident": chain_bloom(
+                            self.engine.resident_chains())})
                 except (ConnectionError, OSError, RpcError):
                     return      # coordinator gone: the loop will see
                 except Exception:   # noqa: BLE001 - heartbeat must

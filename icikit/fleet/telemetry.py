@@ -1,0 +1,328 @@
+"""Fleet telemetry forwarding: obs events over the coordinator RPC.
+
+Since r17 the serving system is N processes, but the r15 obs stack is
+process-local: each engine's bus events, metrics and trace live in a
+buffer only that process can read. This module is the engine/standby
+half of the fleet observability plane — the coordinator half is
+:mod:`icikit.obs.aggregate`:
+
+- a :class:`TelemetryForwarder` owns a **bounded** local queue and a
+  daemon flusher thread. The bus sink (:class:`TelemetrySink`) and the
+  trace delta capture are non-blocking appends; the flusher ships
+  batches over the ordinary checksummed fleet RPC
+  (``telemetry.batch``) on its OWN client connection. A slow or dead
+  collector can therefore NEVER stall or perturb token generation:
+  overflow and failed sends *drop and count* — the
+  ``fleet.telemetry.dropped`` counter is the honest record, surfaced
+  in the collector's health verdict, never silently absorbed.
+- **clock alignment** — each process's trace timestamps come from its
+  own ``perf_counter`` monotonic domain. The ``telemetry.hello``
+  handshake echoes the collector's clock (NTP-style: client marks t0,
+  collector replies with its clock t_s, client marks t1; offset =
+  t_s − (t0+t1)/2), and every batch carries the offset so the
+  collector can shift a source's events into its own domain. A
+  constant per-process shift preserves per-(pid, tid) monotonicity,
+  which is what keeps the merged trace checker-valid.
+- **content integrity** — the batch payload carries its own
+  blake2b-128 digest *inside* the RPC (the transport's frame checksum
+  is computed after the ``fleet.telemetry.send`` corruption probe, so
+  a flipped telemetry frame passes the wire and is caught by this
+  layer's re-verify at the collector: content rot detected
+  mechanically, batch dropped and counted, tokens untouched).
+- chaos sites ``fleet.telemetry.send`` / ``fleet.telemetry.recv``
+  drill the channel: delay (slow collector), die (dead channel — the
+  flusher thread exits, the queue fills, drops count), corrupt
+  (frame rot). All three must leave committed tokens bitwise
+  identical to a disarmed run.
+
+Also here (host-only, hashlib-based — the heartbeat payload must obey
+the control-plane rule): :func:`chain_bloom` compresses an engine's
+resident KV chain hashes into a compact bloom summary that rides the
+heartbeat ``report`` RPC, giving the coordinator the per-engine
+residency picture ROADMAP 1a's cache-aware routing will consume.
+
+Control-plane rule (enforced by the ``fleet-control-plane`` analysis
+rule): no jax import, no device dispatch — telemetry must keep
+flowing while an engine's device schedules are exactly what is under
+suspicion.
+"""
+
+from __future__ import annotations
+
+import collections
+import hashlib
+import os
+import threading
+import time
+
+from icikit import chaos, obs
+from icikit.fleet.transport import RpcClient, _maybe_corrupt_bytes
+from icikit.obs import bus as _bus
+from icikit.obs import tracer as _tracer
+
+chaos.register_site("fleet.telemetry.send", "fleet.telemetry.recv")
+
+DIGEST_BYTES = 16
+
+
+def _now_us() -> int:
+    # the tracer's clock (perf_counter microseconds) — handshake and
+    # trace events must live in the SAME per-process monotonic domain
+    # or the computed offset would not align the trace
+    return time.perf_counter_ns() // 1000
+
+
+def payload_digest(payload: bytes) -> str:
+    """Content digest of one batch payload (hex blake2b-128). Computed
+    sender-side before the send-corruption probe, re-verified
+    collector-side after the recv probe — the telemetry layer's own
+    rot detector, independent of the transport frame checksum."""
+    return hashlib.blake2b(payload,
+                           digest_size=DIGEST_BYTES).hexdigest()
+
+
+# -- resident-chain summaries (heartbeat payload) --------------------
+
+def chain_bloom(hashes, bits: int = 1024, k: int = 4) -> dict:
+    """Compress chain hashes into a bloom summary dict
+    (``{"bloom": hex, "bits", "k", "n"}``) compact enough to ride
+    every heartbeat. False positives only (a set bit collision says
+    "maybe resident"), never false negatives — the right polarity for
+    cache-aware routing, where a miss costs one migration, not
+    correctness."""
+    if k > DIGEST_BYTES // 4:
+        raise ValueError(f"k={k} needs more than {DIGEST_BYTES} "
+                         "digest bytes")
+    nbytes = max(1, bits // 8)
+    buf = bytearray(nbytes)
+    n = 0
+    for h in hashes:
+        n += 1
+        for pos in _bloom_positions(h, nbytes * 8, k):
+            buf[pos >> 3] |= 1 << (pos & 7)
+    return {"bloom": bytes(buf).hex(), "bits": nbytes * 8, "k": k,
+            "n": n}
+
+
+def _bloom_positions(h, bits: int, k: int):
+    d = hashlib.blake2b(str(h).encode(), digest_size=4 * k).digest()
+    return [int.from_bytes(d[4 * i:4 * i + 4], "little") % bits
+            for i in range(k)]
+
+
+def bloom_contains(summary: dict, h) -> bool:
+    """Is ``h`` (possibly) in the summarized set?"""
+    buf = bytes.fromhex(summary["bloom"])
+    return all(buf[p >> 3] & (1 << (p & 7))
+               for p in _bloom_positions(h, int(summary["bits"]),
+                                         int(summary["k"])))
+
+
+def bloom_hits(summary: dict, hashes) -> int:
+    """Longest consecutive *prefix* of ``hashes`` present in the
+    summary — chain hashes are prefix-lineage keys, so only an
+    unbroken resident prefix is reusable KV."""
+    n = 0
+    for h in hashes:
+        if not bloom_contains(summary, h):
+            break
+        n += 1
+    return n
+
+
+# -- forwarding ------------------------------------------------------
+
+class TelemetrySink(_bus.Sink):
+    """Bus sink that hands every event to a forwarder's bounded queue
+    (non-blocking: overflow drops and counts, the producer never
+    waits)."""
+
+    def __init__(self, forwarder: "TelemetryForwarder"):
+        self._fwd = forwarder
+
+    def write(self, ev: dict) -> None:
+        self._fwd.enqueue(ev)
+
+
+class TelemetryForwarder:
+    """Ships this process's obs stream to the fleet collector.
+
+    ``start()`` performs the clock handshake, installs the bus sink,
+    captures the armed trace buffer, and starts the daemon flusher;
+    ``stop()`` drains one final batch and closes the client. Every
+    loss mode — queue overflow, serialization failure, transport
+    failure, injected death — increments ``dropped`` (mirrored into
+    the local metrics registry and stamped on every batch header, so
+    the collector's verdict sees it even when the metrics snapshot
+    itself was the casualty).
+    """
+
+    def __init__(self, addr=None, source: str = "engine",
+                 role: str = "engine", client=None,
+                 queue_cap: int = 4096, flush_s: float = 0.25):
+        if client is None:
+            # ONE bounded retry: the flusher is the only caller, and a
+            # dead collector must cost a drop, not minutes of backoff
+            client = RpcClient(addr, retries=1, connect_timeout=2.0)
+        self._client = client
+        self.source = source
+        self.role = role
+        self.flush_s = flush_s
+        self._cap = queue_cap
+        self._events: collections.deque = collections.deque()
+        self._lock = threading.Lock()
+        self.dropped = 0
+        self._dropped_counted = 0
+        self.sent_batches = 0
+        self.offset_us: int | None = None
+        self._seq = 0
+        self._trace = None
+        self._trace_idx = 0
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self.sink = TelemetrySink(self)
+        self._sink_installed = False
+
+    # -- producer side (engine threads) ------------------------------
+
+    def enqueue(self, ev: dict) -> None:
+        with self._lock:
+            if len(self._events) >= self._cap:
+                self.dropped += 1
+                return
+            self._events.append(ev)
+
+    # -- lifecycle ----------------------------------------------------
+
+    def start(self, install_sink: bool = True) -> "TelemetryForwarder":
+        self._trace = _tracer.tracing()
+        try:
+            self._hello()
+        except Exception:  # noqa: BLE001 - collector may come up later
+            pass           # offset stays None; re-handshake per flush
+        if install_sink:
+            _bus.add_sink(self.sink)
+            self._sink_installed = True
+        self._thread = threading.Thread(
+            target=self._run, daemon=True,
+            name=f"fleet-telemetry-{self.source}")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        if self._sink_installed:
+            _bus.remove_sink(self.sink)
+            self._sink_installed = False
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=2.0)
+
+    def alive(self) -> bool:
+        """Is the channel still flushing? False after the die drill
+        killed the flusher (the engine keeps generating; drops count)."""
+        t = self._thread
+        return t is not None and t.is_alive()
+
+    def stats(self) -> dict:
+        return {"source": self.source, "sent_batches": self.sent_batches,
+                "dropped": self.dropped, "offset_us": self.offset_us,
+                "alive": self.alive()}
+
+    # -- flusher thread ----------------------------------------------
+
+    def _hello(self) -> None:
+        t0 = _now_us()
+        reply, _ = self._client.call("telemetry.hello", {
+            "source": self.source, "role": self.role,
+            "pid": os.getpid()})
+        t1 = _now_us()
+        # NTP handshake-echo: the collector's clock read sits between
+        # our two marks; half the round trip is the best offset bound
+        self.offset_us = int(reply["clock_us"]) - (t0 + t1) // 2
+
+    def _run(self) -> None:
+        try:
+            while not self._stop.wait(self.flush_s):
+                self._flush_once()
+            self._flush_once()      # final drain on clean stop
+        except chaos.InjectedDeath:
+            # the dead-channel drill: the CHANNEL dies, the engine
+            # does not — the queue fills and drops count from here on
+            pass
+        finally:
+            try:
+                self._client.close()
+            except Exception:  # noqa: BLE001 - teardown
+                pass
+
+    def _collect(self) -> tuple:
+        with self._lock:
+            events = list(self._events)
+            self._events.clear()
+        trace_delta: list = []
+        tb = self._trace
+        if tb is not None:
+            evs = tb.events          # append-only; len() then slice is
+            n = len(evs)             # safe against concurrent appends
+            if n > self._trace_idx:
+                trace_delta = evs[self._trace_idx:n]
+                self._trace_idx = n
+        snap = obs.metrics_snapshot()
+        return events, trace_delta, snap
+
+    def _flush_once(self) -> None:
+        # surface queue-overflow drops into the local registry first,
+        # so even a never-sending channel leaves an honest counter in
+        # this process's own metrics snapshot
+        with self._lock:
+            new_drops = self.dropped - self._dropped_counted
+            self._dropped_counted = self.dropped
+        obs.count("fleet.telemetry.dropped", new_drops)
+        events, trace_delta, snap = self._collect()
+        if not events and not trace_delta and snap is None:
+            return
+        if self.offset_us is None:
+            try:
+                self._hello()
+            except Exception:  # noqa: BLE001 - keep shipping unaligned
+                pass
+        n = len(events) + len(trace_delta)
+        try:
+            payload = _bus.dumps_strict(
+                {"events": events, "trace": trace_delta,
+                 "metrics": snap}).encode()
+        except Exception:  # noqa: BLE001 - a hostile event payload
+            self._count_drop(max(1, n))
+            return
+        digest = payload_digest(payload)
+        self._seq += 1
+        try:
+            chaos.maybe_delay("fleet.telemetry.send")
+            chaos.maybe_die("fleet.telemetry.send")
+            # corruption AFTER the content digest: wire/content rot the
+            # collector's re-verify must catch (the transport's frame
+            # checksum is computed later, over the already-rotten
+            # bytes, so it passes — by design)
+            payload = _maybe_corrupt_bytes("fleet.telemetry.send",
+                                           payload)
+            self._client.call("telemetry.batch", {
+                "source": self.source, "seq": self._seq,
+                "offset_us": self.offset_us, "digest": digest,
+                "dropped": self.dropped}, blobs=(payload,))
+            self.sent_batches += 1
+        except chaos.InjectedDeath:
+            self._count_drop(max(1, n))
+            raise
+        except Exception:  # noqa: BLE001 - dead/slow collector: drop,
+            self._count_drop(max(1, n))    # count, never stall
+            # a failed send may mean a failed-over collector with a
+            # fresh clock domain: force a re-handshake before the next
+            # batch ships an offset into the wrong domain
+            self.offset_us = None
+
+    def _count_drop(self, n: int) -> None:
+        with self._lock:
+            self.dropped += n
+            self._dropped_counted = self.dropped
+        obs.count("fleet.telemetry.dropped", n)

@@ -56,9 +56,33 @@ def build_model(spec: dict):
 
 
 def run_worker(config: dict) -> dict:
+    from icikit import obs
     from icikit.fleet.roles import EngineWorker, engine_stats
     from icikit.serve.engine import ServeConfig
 
+    tele = None
+    tcfg = config.get("telemetry")
+    if tcfg:
+        # fleet obs plane armed: local trace buffer + metrics feed the
+        # forwarder, which ships deltas to the coordinator's collector
+        # on its own connection — started BEFORE the engine so compile
+        # and admission telemetry is captured too
+        from icikit.fleet.telemetry import TelemetryForwarder
+        obs.enable_metrics()
+        obs.start_tracing()
+        client = None
+        if tcfg.get("ha_dir"):
+            # HA fleet: forward to whoever currently leads — the
+            # lease-resolving client retargets across failovers, and
+            # the forwarder re-handshakes the clock on send failure
+            from icikit.fleet.ha import LeaderClient
+            client = LeaderClient(tcfg["ha_dir"],
+                                  resolve_timeout_s=2.0)
+        tele = TelemetryForwarder(
+            tuple(tcfg["addr"]) if tcfg.get("addr") else None,
+            source=config["engine_id"], role=config["role"],
+            client=client,
+            flush_s=float(tcfg.get("flush_s", 0.25))).start()
     params, mesh, cfg = build_model(config.get("model") or {})
     serve_cfg = ServeConfig(**(config.get("serve") or {}))
     worker = EngineWorker(tuple(config["addr"])
@@ -73,7 +97,12 @@ def run_worker(config: dict) -> dict:
             max_steps=config.get("max_steps"))
     finally:
         worker.close()
-    return {"completed": completed, **engine_stats(worker)}
+        if tele is not None:
+            tele.stop()
+    out = {"completed": completed, **engine_stats(worker)}
+    if tele is not None:
+        out["telemetry"] = tele.stats()
+    return out
 
 
 def main(argv=None) -> int:

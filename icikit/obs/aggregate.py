@@ -1,0 +1,378 @@
+"""Coordinator-side fleet telemetry collector.
+
+The other half of the fleet observability plane
+(:mod:`icikit.fleet.telemetry` is the engine/standby half): the
+coordinator owns a :class:`FleetCollector` and routes every
+``telemetry.*`` RPC into it. The collector
+
+- **ingests batches** — re-verifies each batch's content digest (the
+  telemetry layer's own rot detector: a frame the
+  ``fleet.telemetry.send`` probe flipped passes the transport
+  checksum by design and is caught HERE), tracks per-source sequence
+  gaps, and keeps honest per-source loss counters
+  (``dropped``/``corrupt_frames``/``lost_batches``) that the health
+  verdict reports — telemetry loss is never silently absorbed;
+- **merges traces** — every source's Chrome events are shifted by its
+  handshake clock offset into the collector's monotonic domain (a
+  constant per-process shift preserves per-(pid, tid) monotonicity),
+  pid-collision-remapped onto distinct process tracks with
+  ``process_name`` metadata, and stably sorted into ONE checker-valid
+  event list in which the r15 async request trees span processes:
+  the coordinator's ``serve.req`` root/attempt pairs plus each
+  engine's adopted instants and thread spans — prefill engine →
+  handoff → decode engine, one tree (``cross_process_trees`` counts
+  them). A killed engine's dangling thread spans are exactly the
+  abandoned-straggler case ``chrome.close_dangling`` heals at export;
+- **maintains the fleet metrics registry** — per-engine labeled
+  gauges (``fleet.engine.<id>.<name>`` mirrors of each source's
+  gauges plus heartbeat occupancy), control-plane op latencies
+  (``fleet.claim_ms``/``fleet.renew_ms``), and the
+  ``fleet.tokens_per_s`` rollup windowed from heartbeat token counts;
+- **runs the watch detectors on the aggregated stream** — a
+  :class:`~icikit.obs.watch.MultiWatch` with per-engine windows
+  (one engine's burst cannot mask another's SLO burn) and the
+  :class:`~icikit.obs.watch.StragglerOutlier` cross-source detector
+  (TPOT k× fleet median → ``obs.alert`` with the engine as
+  ``source`` — the coordinator feeds these into its defect ledger);
+- **tracks roster residency** — per-engine resident-chain bloom
+  summaries from the heartbeat (``update_resident``), queryable via
+  the coordinator's ``resident_chains`` op: the substrate ROADMAP
+  1a's cache-aware ``claim(accept=)`` routing consumes.
+
+Control-plane rule compliant (enforced by ``fleet-control-plane``):
+no jax import, no device dispatch — the collector runs inside the
+coordinator process, whose claim path must keep flowing while engine
+device schedules are under suspicion.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+from icikit import chaos, obs
+from icikit.fleet.telemetry import payload_digest
+from icikit.fleet.transport import _maybe_corrupt_bytes
+from icikit.obs import trace_ctx
+from icikit.obs import watch as _watch
+from icikit.obs.metrics import Registry
+
+
+def _now_us() -> int:
+    return time.perf_counter_ns() // 1000
+
+
+class _Source:
+    """Per-source collector state (one engine/standby/process)."""
+
+    __slots__ = ("name", "pid", "role", "offset_us", "last_seq",
+                 "dropped", "batches", "corrupt", "lost", "events",
+                 "trace", "metrics", "report", "resident")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.pid = None
+        self.role = "engine"
+        self.offset_us = 0
+        self.last_seq = 0
+        self.dropped = 0        # sender-reported (queue/send losses)
+        self.batches = 0
+        self.corrupt = 0        # digest-failed batches dropped here
+        self.lost = 0           # sequence gaps (batches never seen)
+        self.events = 0
+        self.trace: list = []
+        self.metrics: dict | None = None
+        self.report: dict | None = None
+        self.resident: dict | None = None
+
+
+class FleetCollector:
+    """Aggregates the fleet's telemetry inside the coordinator."""
+
+    def __init__(self, registry=None, watch=None,
+                 ttft_slo_ms: float = 30_000.0,
+                 tpot_slo_ms: float = 5_000.0,
+                 burn_budget: float = 0.5,
+                 min_count: int = 4,
+                 straggler_factor: float = 3.0,
+                 poll_interval_s: float = 0.5,
+                 rate_window_s: float = 0.5,
+                 on_alert=None):
+        self.registry = registry if registry is not None else Registry()
+        if watch is None:
+            def make():
+                return [
+                    _watch.SloBurnRate("serve.ttft_ms", ttft_slo_ms,
+                                       burn_budget,
+                                       min_count=min_count),
+                    _watch.SloBurnRate("serve.tpot_ms", tpot_slo_ms,
+                                       burn_budget,
+                                       min_count=min_count),
+                ]
+            watch = _watch.MultiWatch(
+                make,
+                cross=(_watch.StragglerOutlier(
+                    factor=straggler_factor, min_count=min_count),),
+                min_interval_s=poll_interval_s)
+        self.watch = watch
+        self.on_alert = on_alert
+        self._lock = threading.Lock()
+        self._sources: dict = {}
+        self._rate_window_s = rate_window_s
+        self._tokens_at = (0, time.monotonic())
+
+    # -- RPC surface (routed by the coordinator's _handle) ------------
+
+    def handle(self, op: str, msg: dict, blobs) -> tuple:
+        if op == "telemetry.hello":
+            return self._hello(msg)
+        if op == "telemetry.batch":
+            return self._batch(msg, blobs)
+        raise ValueError(f"unknown telemetry op {op!r}")
+
+    def _source(self, name: str) -> _Source:
+        with self._lock:
+            s = self._sources.get(name)
+            if s is None:
+                s = self._sources[name] = _Source(name)
+            return s
+
+    def _hello(self, msg: dict) -> tuple:
+        s = self._source(str(msg.get("source") or "unknown"))
+        with self._lock:
+            if msg.get("pid") is not None:
+                s.pid = int(msg["pid"])
+            s.role = str(msg.get("role") or s.role)
+        obs.count("fleet.telemetry.handshakes")
+        # the handshake echo: the caller brackets this read with its
+        # own clock marks and derives its offset into OUR domain
+        return {"clock_us": _now_us()}, ()
+
+    def _batch(self, msg: dict, blobs) -> tuple:
+        chaos.maybe_delay("fleet.telemetry.recv")
+        chaos.maybe_die("fleet.telemetry.recv")
+        s = self._source(str(msg.get("source") or "unknown"))
+        payload = bytes(blobs[0]) if blobs else b""
+        # recv-side rot probe BEFORE the digest re-verify — the drill
+        # must be caught by this layer, batch dropped and counted
+        payload = _maybe_corrupt_bytes("fleet.telemetry.recv", payload)
+        obs.count("fleet.telemetry.batches")
+        seq = int(msg.get("seq") or 0)
+        with self._lock:
+            if s.last_seq and seq > s.last_seq + 1:
+                gap = seq - s.last_seq - 1
+                s.lost += gap
+            else:
+                gap = 0
+            s.last_seq = max(s.last_seq, seq)
+            if msg.get("offset_us") is not None:
+                s.offset_us = int(msg["offset_us"])
+            s.dropped = max(s.dropped, int(msg.get("dropped") or 0))
+            s.batches += 1
+        if gap:
+            obs.count("fleet.telemetry.lost_batches", gap)
+        if payload_digest(payload) != msg.get("digest"):
+            with self._lock:
+                s.corrupt += 1
+            obs.count("fleet.telemetry.corrupt_frames")
+            # rotten content is dropped, never parsed — the honest
+            # counter above is the whole story
+            return {"accepted": False}, ()
+        batch = json.loads(payload.decode())
+        events = batch.get("events") or []
+        trace = batch.get("trace") or []
+        snap = batch.get("metrics")
+        with self._lock:
+            s.events += len(events)
+            s.trace.extend(trace)
+            if snap is not None:
+                s.metrics = snap
+        self._rollup(s.name, snap)
+        return {"accepted": True}, ()
+
+    # -- roster feeds (called by the coordinator directly) ------------
+
+    def update_report(self, source: str, stats: dict | None) -> None:
+        """Heartbeat stats from the coordinator's ``report`` op."""
+        s = self._source(source)
+        with self._lock:
+            s.report = dict(stats or {})
+        occ = (stats or {}).get("occupancy")
+        if occ is not None:
+            self.registry.gauge(
+                f"fleet.engine.{source}.occupancy").set(occ)
+
+    def update_resident(self, source: str, summary) -> None:
+        """Per-engine resident-chain bloom summary (heartbeat)."""
+        s = self._source(source)
+        with self._lock:
+            s.resident = dict(summary) if summary else None
+
+    def resident_summaries(self) -> dict:
+        with self._lock:
+            return {name: dict(s.resident)
+                    for name, s in self._sources.items()
+                    if s.resident}
+
+    def observe_slo(self, source: str, slo: dict | None) -> None:
+        """Feed one request's terminal SLO marks into the per-engine
+        watch stream (the coordinator calls this at commit)."""
+        source = source or "unknown"
+        for metric, key in (("serve.ttft_ms", "ttft_ms"),
+                            ("serve.tpot_ms", "tpot_ms"),
+                            ("serve.queue_wait_ms", "queue_wait_ms")):
+            v = (slo or {}).get(key)
+            if v is not None:
+                self.watch.observe(source, metric, v)
+
+    def observe_latency(self, name: str, ms: float) -> None:
+        """Control-plane op latency (``fleet.claim_ms``,
+        ``fleet.renew_ms``) into the fleet registry."""
+        self.registry.histogram(name).observe(ms)
+
+    def _rollup(self, source: str, snap: dict | None) -> None:
+        if not snap:
+            return
+        for name, v in (snap.get("gauges") or {}).items():
+            self.registry.gauge(f"fleet.engine.{source}.{name}").set(v)
+
+    # -- polling (driven from the coordinator's reap loop) ------------
+
+    def maybe_poll(self) -> list:
+        now = time.monotonic()
+        with self._lock:
+            total = sum(int((s.report or {}).get("tokens") or 0)
+                        for s in self._sources.values())
+        prev_total, prev_t = self._tokens_at
+        if now - prev_t >= self._rate_window_s:
+            rate = (total - prev_total) / max(now - prev_t, 1e-9)
+            self._tokens_at = (total, now)
+            self.registry.gauge("fleet.tokens_per_s").set(rate)
+            obs.gauge("fleet.tokens_per_s", rate)
+        alerts = self.watch.maybe_poll()
+        if alerts and self.on_alert is not None:
+            for a in alerts:
+                try:
+                    self.on_alert(a)
+                except Exception:  # noqa: BLE001 - a listener bug must
+                    pass           # not stall the reap loop
+        return alerts
+
+    # -- trace merge ---------------------------------------------------
+
+    def merge_traces(self, local_events=()) -> list:
+        """ONE checker-valid event list across every process.
+
+        Per-source events are clock-shifted by the handshake offset
+        (constant per process → per-(pid, tid) monotonicity survives),
+        colliding pids are remapped onto fresh tracks (two in-process
+        test "engines" share an OS pid; real worker processes never
+        collide), ``process_name`` metadata labels each track, and the
+        final list is STABLY sorted by ts — stable keeps each track's
+        internal (already monotonic) order, so B/E and async b/e
+        discipline survive the interleave.
+        """
+        merged = [dict(ev) for ev in local_events]
+        used = {ev.get("pid") for ev in merged
+                if ev.get("pid") is not None}
+        next_pid = (max(used) + 1) if used else 1
+        with self._lock:
+            sources = [(name, s.role, int(s.offset_us or 0),
+                        [dict(ev) for ev in s.trace])
+                       for name, s in sorted(self._sources.items())]
+        for name, role, off, trace in sources:
+            if not trace:
+                continue
+            src_pids = sorted({ev.get("pid") for ev in trace
+                               if ev.get("pid") is not None})
+            remap = {}
+            for p in src_pids:
+                q = p
+                while q in used:
+                    q = next_pid
+                    next_pid += 1
+                used.add(q)
+                remap[p] = q
+                merged.append({"ph": "M", "name": "process_name",
+                               "pid": q,
+                               "args": {"name": f"{role}:{name}"}})
+            for ev in trace:
+                p = ev.get("pid")
+                if p in remap:
+                    ev["pid"] = remap[p]
+                ts = ev.get("ts")
+                if isinstance(ts, (int, float)) \
+                        and not isinstance(ts, bool):
+                    ev["ts"] = ts + off
+                merged.append(ev)
+        merged.sort(key=_sort_ts)
+        return merged
+
+    @staticmethod
+    def cross_process_trees(events, exclude_pid=None) -> int:
+        """How many ``serve.req`` trees span ≥2 distinct processes
+        besides ``exclude_pid`` (pass the coordinator's own pid to
+        count prefill→handoff→decode trees specifically)."""
+        n = 0
+        for evs in trace_ctx.request_trees(events).values():
+            pids = {e.get("pid") for e in evs
+                    if e.get("pid") is not None}
+            pids.discard(exclude_pid)
+            if len(pids) >= 2:
+                n += 1
+        return n
+
+    # -- reporting -----------------------------------------------------
+
+    def stats(self) -> dict:
+        with self._lock:
+            src = {name: {"pid": s.pid, "role": s.role,
+                          "batches": s.batches, "events": s.events,
+                          "trace_events": len(s.trace),
+                          "dropped": s.dropped,
+                          "corrupt_frames": s.corrupt,
+                          "lost_batches": s.lost,
+                          "offset_us": s.offset_us,
+                          "resident_n": (s.resident or {}).get("n")}
+                   for name, s in sorted(self._sources.items())}
+        return {
+            "sources": src,
+            "batches": sum(v["batches"] for v in src.values()),
+            "dropped": sum(v["dropped"] for v in src.values()),
+            "corrupt_frames": sum(v["corrupt_frames"]
+                                  for v in src.values()),
+            "lost_batches": sum(v["lost_batches"]
+                                for v in src.values()),
+        }
+
+    def verdict(self) -> dict:
+        """Health verdict over the aggregated stream: watch alerts
+        PLUS telemetry loss — a channel that dropped or rotted frames
+        is reported here even when every detector stayed quiet."""
+        st = self.stats()
+        wv = self.watch.verdict()
+        losses = []
+        for name, s in sorted(st["sources"].items()):
+            for kind in ("dropped", "corrupt_frames", "lost_batches"):
+                if s[kind]:
+                    losses.append({"source": name, "kind": kind,
+                                   "n": s[kind]})
+        return {
+            "healthy": wv["healthy"] and not losses,
+            "n_alerts": wv["n_alerts"],
+            "polls": wv["polls"],
+            "sources": wv["sources"],
+            "alerts": wv["alerts"],
+            "telemetry_loss": losses,
+            "batches": st["batches"],
+        }
+
+
+def _sort_ts(ev: dict):
+    # M metadata carries no ts; pin it ahead of the timeline
+    ts = ev.get("ts")
+    if ev.get("ph") == "M" or not isinstance(ts, (int, float)) \
+            or isinstance(ts, bool):
+        return float("-inf")
+    return ts

@@ -34,6 +34,12 @@ Detectors (the serve catalog — docs/OBSERVABILITY.md):
 - :class:`RateAlarm` — windowed counter-rate alarms where the healthy
   rate is (near) zero: duplicate commits, integrity failures,
   quarantined pages, reissues.
+- :class:`StragglerOutlier` — cross-source outlier detection for the
+  fleet: one engine's windowed mean latency k× the fleet median. Runs
+  under a :class:`MultiWatch`, which keeps a **per-source** window per
+  engine-labeled stream so one engine's burst cannot mask another's
+  SLO burn (the fleet collector's harness —
+  :mod:`icikit.obs.aggregate`).
 
 Zero-overhead contract: the watch only costs when polled, and polling
 a disabled registry is a no-op; the one hot-path addition is the
@@ -43,6 +49,7 @@ when no threshold is armed, i.e. always nothing unless a Watch is).
 
 from __future__ import annotations
 
+import threading
 import time
 from dataclasses import dataclass, field
 
@@ -60,11 +67,16 @@ class Alert:
     threshold: float        # the configured bound it crossed
     severity: str = "warn"
     detail: str = ""
+    source: str = ""        # emitting stream ("eng0") in multi-source
+                            # watches; empty for process-local watches
 
     def to_event(self) -> dict:
-        return {"watch": self.watch, "metric": self.metric,
-                "value": self.value, "threshold": self.threshold,
-                "severity": self.severity, "detail": self.detail}
+        ev = {"watch": self.watch, "metric": self.metric,
+              "value": self.value, "threshold": self.threshold,
+              "severity": self.severity, "detail": self.detail}
+        if self.source:
+            ev["source"] = self.source
+        return ev
 
 
 class Watcher:
@@ -226,6 +238,54 @@ class RateAlarm(Watcher):
                       detail="window count over alarm bound")]
 
 
+class StragglerOutlier:
+    """Cross-source detector: one source's windowed mean latency at
+    ``factor``× the fleet median ("Cores that don't count": a
+    garbage-computing or merely-sick host shows up as the outlier
+    against its peers, not against an absolute bound). Consumes the
+    per-source windows a :class:`MultiWatch` assembles —
+    ``check_sources(windows)`` instead of the single-stream
+    ``check(window, snap)`` — because an outlier is only definable
+    against the other sources' same-window behavior. Sources offering
+    fewer than ``min_count`` observations in the window are excluded
+    from both the median and the verdict, and fewer than
+    ``min_sources`` participating sources means no verdict at all (a
+    1-engine fleet has no peers to be an outlier against)."""
+
+    def __init__(self, metric: str = "serve.tpot_ms",
+                 factor: float = 3.0, min_count: int = 4,
+                 min_sources: int = 2, severity: str = "warn"):
+        self.metric = metric
+        self.factor = factor
+        self.min_count = min_count
+        self.min_sources = min_sources
+        self.severity = severity
+        self.name = f"straggler[{metric}]"
+
+    def check_sources(self, windows: dict) -> list:
+        means = {}
+        for src, w in windows.items():
+            h = (w or {}).get("histograms", {}).get(self.metric)
+            if h and h["count"] >= self.min_count:
+                means[src] = h["sum"] / h["count"]
+        if len(means) < self.min_sources:
+            return []
+        ranked = sorted(means.values())
+        mid = len(ranked) // 2
+        median = (ranked[mid] if len(ranked) % 2
+                  else (ranked[mid - 1] + ranked[mid]) / 2.0)
+        if median <= 0:
+            return []
+        bound = self.factor * median
+        return [Alert(self.name, self.metric, round(m, 3),
+                      round(bound, 3), severity=self.severity,
+                      source=src,
+                      detail=f"windowed mean {self.factor}x over "
+                             f"fleet median {median:.3f} ms "
+                             f"({len(means)} sources)")
+                for src, m in sorted(means.items()) if m > bound]
+
+
 @dataclass
 class _WatchState:
     prev: dict | None = None
@@ -247,10 +307,12 @@ class Watch:
     """
 
     def __init__(self, *watchers: Watcher, registry=None,
-                 min_interval_s: float = 0.05):
+                 min_interval_s: float = 0.05, source: str = ""):
         self.watchers = list(watchers)
         self._registry = registry
         self.min_interval_s = min_interval_s
+        self.source = source
+        self.last_window: dict | None = None
         self._st = _WatchState()
         self._armed = False
 
@@ -290,11 +352,14 @@ class Watch:
         now = time.monotonic()
         window = _window(st.prev, snap, now - st.prev_t)
         st.prev, st.prev_t = snap, now
+        self.last_window = window
         st.polls += 1
         alerts = []
         for w in self.watchers:
             alerts.extend(w.check(window, snap))
         for a in alerts:
+            if self.source and not a.source:
+                a.source = self.source
             _bus.emit("obs.alert", **a.to_event())
         st.alerts.extend(alerts)
         return alerts
@@ -310,6 +375,88 @@ class Watch:
             "polls": st.polls,
             "watchers": [w.name for w in self.watchers],
             "alerts": [a.to_event() for a in st.alerts],
+        }
+
+
+class MultiWatch:
+    """Detector harness over MANY labeled streams (the fleet
+    collector's shape).
+
+    The r15 :class:`Watch` differences ONE registry — aggregating N
+    engines' observations into it would let one engine's burst mask
+    another's SLO burn (the burn *fraction* averages out). Here every
+    source gets its OWN registry, detector set, and window:
+    ``observe(source, metric, v)`` feeds the per-source stream,
+    ``poll()`` windows each source independently (alerts stamped with
+    their source), then hands the side-by-side window dict to the
+    cross-source detectors (:class:`StragglerOutlier`) that only make
+    sense over peers. Per-source detectors come from ``make_watchers``
+    — a factory, not instances, because detector state (armed
+    thresholds) must not be shared across sources."""
+
+    def __init__(self, make_watchers=None, cross=(),
+                 min_interval_s: float = 0.25):
+        self.make_watchers = make_watchers or (lambda: [])
+        self.cross = list(cross)
+        self.min_interval_s = min_interval_s
+        self._lock = threading.Lock()
+        self._sources: dict = {}    # source -> (Registry, Watch)
+        self.alerts: list = []
+        self.polls = 0
+        self._prev_t = time.monotonic()
+
+    def registry(self, source: str):
+        """The per-source registry (created on first touch)."""
+        with self._lock:
+            entry = self._sources.get(source)
+            if entry is None:
+                reg = _metrics.Registry()
+                w = Watch(*self.make_watchers(), registry=reg,
+                          source=source, min_interval_s=0.0)
+                w.attach()
+                entry = self._sources[source] = (reg, w)
+            return entry[0]
+
+    def observe(self, source: str, metric: str, value) -> None:
+        self.registry(source).histogram(metric).observe(value)
+
+    def count(self, source: str, metric: str, n=1) -> None:
+        self.registry(source).counter(metric).add(n)
+
+    def sources(self) -> list:
+        with self._lock:
+            return sorted(self._sources)
+
+    def maybe_poll(self) -> list:
+        if time.monotonic() - self._prev_t < self.min_interval_s:
+            return []
+        return self.poll()
+
+    def poll(self) -> list:
+        with self._lock:
+            entries = list(self._sources.items())
+        self._prev_t = time.monotonic()
+        self.polls += 1
+        alerts: list = []
+        windows: dict = {}
+        for source, (_, w) in entries:
+            alerts.extend(w.poll())
+            windows[source] = w.last_window
+        for det in self.cross:
+            for a in det.check_sources(windows):
+                _bus.emit("obs.alert", **a.to_event())
+                alerts.append(a)
+        self.alerts.extend(alerts)
+        return alerts
+
+    def verdict(self) -> dict:
+        self.poll()
+        return {
+            "healthy": not self.alerts,
+            "n_alerts": len(self.alerts),
+            "polls": self.polls,
+            "sources": self.sources(),
+            "alerts": [a.to_event() for a in self.alerts],
         }
 
 

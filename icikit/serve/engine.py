@@ -1933,6 +1933,14 @@ class Engine:
             return 0.0
         return self._occ_rows / (self.n_steps * self.serve.max_rows)
 
+    def resident_chains(self) -> list:
+        """Union of chain hashes resident (indexed) on any dp shard —
+        what the fleet heartbeat's bloom summary compresses."""
+        seen: set = set()
+        for a in self.pool.allocators:
+            seen.update(a.indexed_hashes())
+        return sorted(seen)
+
     def prefix_stats(self) -> dict:
         """Prefix-cache effectiveness counters for this engine's
         lifetime (bench records carry these)."""

@@ -284,6 +284,12 @@ class BlockAllocator:
         with self._lock:
             return self._index.get(h)
 
+    def indexed_hashes(self) -> list:
+        """Every chain hash with a registered page — the residency
+        set fleet heartbeats summarize for cache-aware routing."""
+        with self._lock:
+            return list(self._index.keys())
+
     def spilled(self, h: str) -> bool:
         """Is ``h``'s content in the host spill tier (no device page)?"""
         with self._lock:

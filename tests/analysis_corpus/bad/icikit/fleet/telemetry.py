@@ -1,0 +1,5 @@
+"""fleet-control-plane seeded violation (r19): a jax import in the
+telemetry forwarder — the channel must keep flowing while device
+schedules are suspect, so jax has no business here."""
+
+import jax  # noqa: F401 - corpus fixture

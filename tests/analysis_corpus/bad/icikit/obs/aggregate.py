@@ -1,0 +1,5 @@
+"""fleet-control-plane seeded violation (r19): a jax dispatch inside
+the collector — aggregation runs in the coordinator process, whose
+claim path must never stall behind an XLA dispatch."""
+
+ROLLUP = jax.numpy.zeros((4,))  # noqa: F821 - corpus fixture

@@ -1,0 +1,5 @@
+"""fleet-control-plane clean twin (r19): host-only telemetry — batch
+payloads are bytes + hashlib digests, queues are host structures."""
+import hashlib
+
+DIGEST = hashlib.blake2b(b"batch", digest_size=16).hexdigest()

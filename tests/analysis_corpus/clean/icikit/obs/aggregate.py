@@ -1,0 +1,4 @@
+"""fleet-control-plane clean twin (r19): host-only aggregation —
+rollups are plain floats in a host registry."""
+
+ROLLUP = sum([0.0, 1.0]) / 2.0

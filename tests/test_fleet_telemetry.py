@@ -1,0 +1,413 @@
+"""Fleet telemetry plane (`icikit.fleet.telemetry` +
+`icikit.obs.aggregate`): the engine-side forwarder and the chaos
+drills on the channel itself.
+
+The load-bearing claims:
+
+- the forwarder's queue is BOUNDED and every loss mode (overflow,
+  serialization failure, transport failure, injected death) drops and
+  counts — a slow or dead collector can never stall the producer;
+- batch content integrity is the telemetry layer's own: the digest is
+  computed before the ``fleet.telemetry.send`` corruption probe, so a
+  flipped frame passes the transport checksum and is caught by the
+  collector's re-verify — dropped, counted, never parsed;
+- ALL channel drills (corrupt send, corrupt recv, dead channel) leave
+  committed tokens bitwise identical to the single-request decode,
+  and the loss shows up in the collector's health verdict;
+- the heartbeat's resident-chain bloom summary reaches the
+  coordinator's roster state (false positives only — never a false
+  negative, the polarity cache-aware routing needs).
+"""
+
+import threading
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from icikit import chaos
+from icikit.fleet import Coordinator, EngineWorker, RpcClient
+from icikit.fleet.telemetry import (TelemetryForwarder, bloom_contains,
+                                    bloom_hits, chain_bloom,
+                                    payload_digest)
+from icikit.fleet.worker import build_model
+from icikit.models.transformer import greedy_generate
+from icikit.obs.aggregate import FleetCollector
+from icikit.serve.engine import ServeConfig
+
+MODEL_SPEC = {
+    "preset": "tiny",
+    "overrides": {"vocab": 64, "d_model": 32, "n_heads": 2,
+                  "d_head": 16, "d_ff": 64, "n_layers": 2,
+                  "max_seq": 64},
+    "compute_dtype": "float32", "dp": 1, "tp": 1, "init_seed": 0,
+}
+
+SERVE_KW = dict(max_rows=2, block_size=4, n_blocks=32,
+                max_prompt=20, max_new=12, prefill_chunk=8)
+
+
+@pytest.fixture(scope="module")
+def fleet_model():
+    return build_model(MODEL_SPEC)
+
+
+def _prompts(n, vocab, s=10, seed=0):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(0, vocab, (s,)).astype(np.int32)
+            for _ in range(n)]
+
+
+def _run_workers(workers, timeout=180):
+    threads = [threading.Thread(target=w.run, daemon=True)
+               for w in workers]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=timeout)
+    assert not any(t.is_alive() for t in threads), \
+        "fleet run did not drain in time"
+
+
+def _audit(coord, rids, prompts, n_new, model):
+    """Every completed request bitwise vs its single-request decode."""
+    params, mesh, cfg = model
+    batch = jnp.asarray(np.stack(prompts))
+    out = np.asarray(greedy_generate(params, batch, mesh, cfg, n_new))
+    for rid, p, row in zip(rids, prompts, out):
+        req = coord.queue.request(rid)
+        assert req.state == "done", (rid, req.state, req.error)
+        exp = [int(t) for t in row[len(p):len(p) + n_new]]
+        got = [int(t) for t in req.tokens]
+        assert got == exp and len(got) == n_new, (rid, got, exp)
+
+
+# -- resident-chain bloom summaries ---------------------------------
+
+def test_chain_bloom_no_false_negatives():
+    chains = [f"chain-{i:04d}" for i in range(64)]
+    s = chain_bloom(chains)
+    assert s["n"] == 64 and s["bits"] == 1024 and s["k"] == 4
+    # every inserted hash answers "maybe resident" — a false negative
+    # would make cache-aware routing skip real KV
+    assert all(bloom_contains(s, h) for h in chains)
+
+
+def test_chain_bloom_mostly_rejects_absent_hashes():
+    s = chain_bloom([f"chain-{i}" for i in range(16)])
+    # false positives are allowed but must be rare at this load
+    # (16 keys in 1024 bits); absent probes overwhelmingly miss
+    misses = sum(not bloom_contains(s, f"other-{i}")
+                 for i in range(200))
+    assert misses >= 190, misses
+
+
+def test_bloom_hits_counts_resident_prefix_only():
+    chains = [f"c{i}" for i in range(8)]
+    s = chain_bloom(chains[:5])
+    # chain hashes are prefix-lineage keys: only the unbroken resident
+    # prefix is reusable KV, so a mid-chain miss ends the count
+    assert bloom_hits(s, chains) == 5
+    assert bloom_hits(s, ["absent"] + chains[:5]) == 0
+    assert bloom_hits(chain_bloom([]), chains) == 0
+
+
+def test_chain_bloom_rejects_oversized_k():
+    with pytest.raises(ValueError):
+        chain_bloom(["x"], k=16)
+
+
+# -- forwarder unit behavior (no sockets, no jax) -------------------
+
+class _NullClient:
+    """Never reachable — every call fails like a dead collector."""
+
+    def call(self, op, msg, blobs=()):
+        raise ConnectionError("collector down")
+
+    def close(self):
+        pass
+
+
+class _CollectorClient:
+    """Routes RPCs straight into a FleetCollector (loopback minus the
+    socket — the payload bytes and digests are the real thing)."""
+
+    def __init__(self, collector):
+        self.collector = collector
+
+    def call(self, op, msg, blobs=()):
+        return self.collector.handle(op, msg, blobs)
+
+    def close(self):
+        pass
+
+
+def test_bounded_queue_overflow_drops_and_counts():
+    f = TelemetryForwarder(client=_NullClient(), source="e0",
+                           queue_cap=4)
+    for i in range(7):
+        f.enqueue({"event": "x", "i": i})
+    assert f.dropped == 3
+    st = f.stats()
+    assert st["source"] == "e0" and st["dropped"] == 3
+    assert st["sent_batches"] == 0 and st["offset_us"] is None
+    assert st["alive"] is False      # never started
+
+
+def test_failed_send_drops_counts_and_forces_rehandshake():
+    f = TelemetryForwarder(client=_NullClient(), source="e0")
+    f.offset_us = 123                # pretend a handshake succeeded
+    f.enqueue({"event": "x"})
+    f._flush_once()
+    # the batch is gone (drop, count) and the stale clock offset is
+    # cleared: the next reachable collector may be a failed-over
+    # standby in a fresh clock domain
+    assert f.dropped >= 1
+    assert f.offset_us is None
+
+
+def test_hostile_event_payload_ships_sanitized_never_wedges():
+    # a non-JSON event value (a set) rides the bus sink's strict-JSON
+    # slow path: stringified, shipped, digest-verified — the channel
+    # neither wedges nor drops over one hostile payload
+    col = FleetCollector()
+    f = TelemetryForwarder(client=_CollectorClient(col), source="e0")
+    f.enqueue({"event": "bad", "payload": {1, 2, 3}})   # not JSON
+    f._flush_once()
+    assert f.dropped == 0
+    st = col.stats()
+    assert st["batches"] == 1 and st["corrupt_frames"] == 0
+    assert st["sources"]["e0"]["events"] == 1
+
+
+def test_flusher_ships_digest_verified_batches():
+    col = FleetCollector()
+    f = TelemetryForwarder(client=_CollectorClient(col), source="e0",
+                           role="engine", flush_s=0.01)
+    f.start(install_sink=False)
+    try:
+        f.enqueue({"event": "drill", "n": 1})
+        deadline = time.monotonic() + 5.0
+        while col.stats()["batches"] < 1:
+            assert time.monotonic() < deadline, col.stats()
+            time.sleep(0.005)
+    finally:
+        f.stop()
+    assert f.offset_us is not None   # handshake completed
+    st = col.stats()
+    src = st["sources"]["e0"]
+    assert src["events"] >= 1 and src["corrupt_frames"] == 0
+    assert src["offset_us"] == f.offset_us
+    assert col.verdict()["telemetry_loss"] == []
+
+
+def test_send_corrupt_drill_caught_by_collector_reverify():
+    """The content-rot drill end to end over the real payload path:
+    the digest rides inside the RPC, the probe flips the payload after
+    the digest is computed, the collector's re-verify refuses the
+    batch without parsing it."""
+    col = FleetCollector()
+    f = TelemetryForwarder(client=_CollectorClient(col), source="e0")
+    f._hello()
+    plan = chaos.FaultPlan(
+        schedule={"corrupt:fleet.telemetry.send": (0,)}, seed=5)
+    with chaos.inject(plan):
+        f.enqueue({"event": "drill", "n": 1})
+        f._flush_once()              # batch 1: rotten in flight
+        f.enqueue({"event": "drill", "n": 2})
+        f._flush_once()              # batch 2: clean
+    assert plan.fired("corrupt", "fleet.telemetry.send") == 1
+    st = col.stats()
+    assert st["corrupt_frames"] == 1
+    assert st["sources"]["e0"]["events"] == 1     # only batch 2 parsed
+    v = col.verdict()
+    assert v["healthy"] is False
+    assert {"source": "e0", "kind": "corrupt_frames", "n": 1} \
+        in v["telemetry_loss"]
+
+
+def test_recv_corrupt_drill_caught_before_parse():
+    col = FleetCollector()
+    f = TelemetryForwarder(client=_CollectorClient(col), source="e0")
+    plan = chaos.FaultPlan(
+        schedule={"corrupt:fleet.telemetry.recv": (0,)}, seed=6)
+    with chaos.inject(plan):
+        f.enqueue({"event": "drill"})
+        f._flush_once()
+    assert plan.fired("corrupt", "fleet.telemetry.recv") == 1
+    assert col.stats()["corrupt_frames"] == 1
+    assert col.verdict()["healthy"] is False
+
+
+def test_digest_is_independent_of_transport_checksum():
+    # the layer's own detector: same payload -> same digest, one
+    # flipped byte -> different digest (what the collector re-verifies)
+    p = b'{"events": [], "trace": [], "metrics": null}'
+    d = payload_digest(p)
+    assert d == payload_digest(bytes(p))
+    assert d != payload_digest(p[:-1] + b"?")
+
+
+# -- chaos drills against a live fleet (the bitwise pins) -----------
+
+def _fleet(coord, fleet_model, n_workers=2):
+    params, mesh, cfg = fleet_model
+    sv = ServeConfig(**SERVE_KW)
+    return [EngineWorker(coord.addr, f"e{i}", "both", params, mesh,
+                         cfg, sv, report_interval_s=0.05)
+            for i in range(n_workers)]
+
+
+def test_corrupt_telemetry_frame_leaves_tokens_bitwise(
+        fleet_model, tmp_path):
+    """A flipped telemetry frame is a counted drop at the collector —
+    and NOTHING else: the engines' committed tokens stay bitwise the
+    single-request decode (the telemetry plane observes the data
+    plane, it must never perturb it)."""
+    _, _, cfg = fleet_model
+    col = FleetCollector()
+    coord = Coordinator(tmp_path / "bridge", lease_s=10.0,
+                        collector=col)
+    tele = TelemetryForwarder(coord.addr, source="tele0",
+                              role="engine", flush_s=0.02)
+    try:
+        workers = _fleet(coord, fleet_model)
+        prompts = _prompts(3, cfg.vocab, seed=4)
+        rids = [coord.submit(p, 6) for p in prompts]
+        plan = chaos.FaultPlan(
+            schedule={"corrupt:fleet.telemetry.send": (0,)}, seed=7)
+        with chaos.inject(plan):
+            tele.start()
+            tele.enqueue({"event": "drill"})
+            deadline = time.monotonic() + 10.0
+            while col.stats()["corrupt_frames"] < 1:
+                assert time.monotonic() < deadline, col.stats()
+                time.sleep(0.01)
+            _run_workers(workers)
+        assert plan.fired("corrupt", "fleet.telemetry.send") == 1
+        _audit(coord, rids, prompts, 6, fleet_model)
+        v = col.verdict()
+        assert v["healthy"] is False
+        assert any(loss["source"] == "tele0"
+                   and loss["kind"] == "corrupt_frames"
+                   for loss in v["telemetry_loss"]), v
+        for w in workers:
+            w.close()
+    finally:
+        tele.stop()
+        coord.shutdown()
+
+
+def test_dead_channel_drops_count_generation_unperturbed(
+        fleet_model, tmp_path):
+    """The dead-channel drill: ``die:fleet.telemetry.send`` kills the
+    flusher THREAD, not the engine — the channel goes quiet, drops
+    count from then on, and every committed token is bitwise the
+    single-request decode."""
+    _, _, cfg = fleet_model
+    col = FleetCollector()
+    coord = Coordinator(tmp_path / "bridge", lease_s=10.0,
+                        collector=col)
+    tele = TelemetryForwarder(coord.addr, source="tele0",
+                              role="engine", flush_s=0.02)
+    try:
+        workers = _fleet(coord, fleet_model)
+        prompts = _prompts(3, cfg.vocab, seed=5)
+        rids = [coord.submit(p, 6) for p in prompts]
+        plan = chaos.FaultPlan(
+            schedule={"die:fleet.telemetry.send": (0,)}, seed=8)
+        with chaos.inject(plan):
+            tele.start()
+            tele.enqueue({"event": "drill"})
+            deadline = time.monotonic() + 10.0
+            while tele.alive():
+                assert time.monotonic() < deadline
+                time.sleep(0.01)
+            _run_workers(workers)
+        assert plan.fired("die", "fleet.telemetry.send") == 1
+        assert tele.alive() is False
+        assert tele.dropped >= 1         # the dying batch is counted
+        assert tele.stats()["sent_batches"] == 0
+        # the producer side never blocks on the dead channel
+        tele.enqueue({"event": "after-death"})
+        _audit(coord, rids, prompts, 6, fleet_model)
+        for w in workers:
+            w.close()
+    finally:
+        tele.stop()
+        coord.shutdown()
+
+
+# -- heartbeat bloom -> coordinator roster state --------------------
+
+def test_heartbeat_bloom_reaches_coordinator_roster(
+        fleet_model, tmp_path):
+    """Engines summarize their resident KV chains into every
+    heartbeat; the collector keeps the per-engine roster state and the
+    coordinator serves it via the ``resident_chains`` op — the
+    substrate for cache-aware claim routing."""
+    _, _, cfg = fleet_model
+    col = FleetCollector()
+    coord = Coordinator(tmp_path / "bridge", lease_s=10.0,
+                        collector=col)
+    try:
+        workers = _fleet(coord, fleet_model)
+        prompts = _prompts(4, cfg.vocab, seed=6)
+        rids = [coord.submit(p, 6) for p in prompts]
+        _run_workers(workers)
+        _audit(coord, rids, prompts, 6, fleet_model)
+        summaries = col.resident_summaries()
+        assert set(summaries) == {"e0", "e1"}, summaries
+        # at least one engine served, so its summary saw real chains
+        assert any(s["n"] >= 1 for s in summaries.values()), summaries
+        # the roster answers over RPC too
+        cli = RpcClient(coord.addr)
+        reply, _ = cli.call("resident_chains", {})
+        cli.close()
+        assert reply["resident"] == summaries
+        # no false negatives: whatever is STILL resident on an engine
+        # that its last heartbeat also saw must answer "maybe"
+        for w in workers:
+            s = summaries[w.engine_id]
+            if s["n"]:
+                chains = w.engine.resident_chains()
+                assert bloom_hits(s, chains) >= 0   # prefix-counting
+                hits = sum(bloom_contains(s, h) for h in chains)
+                assert hits >= min(len(chains), 1) or not chains
+        for w in workers:
+            w.close()
+    finally:
+        coord.shutdown()
+
+
+def test_unarmed_coordinator_refuses_telemetry_ops(tmp_path):
+    coord = Coordinator(tmp_path / "bridge", lease_s=10.0)
+    try:
+        cli = RpcClient(coord.addr, retries=1)
+        with pytest.raises(Exception, match="not armed"):
+            cli.call("telemetry.hello", {"source": "x", "role": "e",
+                                         "pid": 1})
+        # the roster query degrades to empty, not an error
+        reply, _ = cli.call("resident_chains", {})
+        assert reply["resident"] == {}
+        cli.close()
+    finally:
+        coord.shutdown()
+
+
+def test_forwarder_thread_name_and_clean_stop():
+    col = FleetCollector()
+    f = TelemetryForwarder(client=_CollectorClient(col), source="eX",
+                           flush_s=0.01)
+    f.start(install_sink=False)
+    try:
+        assert f.alive()
+        names = [t.name for t in threading.enumerate()]
+        assert "fleet-telemetry-eX" in names
+    finally:
+        f.stop()
+    assert not f.alive()
+
+

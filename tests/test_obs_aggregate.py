@@ -1,0 +1,346 @@
+"""Coordinator-side fleet collector (`icikit.obs.aggregate`): batch
+ingestion honesty, the clock-aligned multi-process trace merge, and
+the aggregated watch/roster surfaces.
+
+The merge claims under test:
+
+- a constant per-source clock shift (the handshake offset) preserves
+  per-(pid, tid) monotonicity, so the merged file stays checker-valid
+  for ANY offset assignment (property test);
+- colliding pids (two in-process sources sharing one OS pid) are
+  remapped onto fresh tracks with ``process_name`` metadata — B/E and
+  async b/e discipline survive the interleave;
+- a killed engine's dangling spans are exactly what
+  ``chrome.close_dangling`` heals at export: the merged file on disk
+  passes ``python -m icikit.obs.check``;
+- ``cross_process_trees`` counts ``serve.req`` trees whose events
+  span ≥2 processes — the prefill→handoff→decode acceptance shape.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from icikit.fleet.telemetry import chain_bloom, payload_digest
+from icikit.obs import chrome
+from icikit.obs.aggregate import FleetCollector
+from icikit.obs.metrics import Registry
+
+
+def _send(col, source, seq, trace=(), events=(), metrics=None,
+          dropped=0, offset_us=None, digest=None):
+    payload = json.dumps({"events": list(events),
+                          "trace": list(trace),
+                          "metrics": metrics}).encode()
+    reply, _ = col.handle("telemetry.batch", {
+        "source": source, "seq": seq, "offset_us": offset_us,
+        "digest": digest if digest is not None
+        else payload_digest(payload),
+        "dropped": dropped}, (payload,))
+    return reply
+
+
+def _hello(col, source, pid, role="engine"):
+    reply, _ = col.handle("telemetry.hello",
+                          {"source": source, "role": role,
+                           "pid": pid}, ())
+    return reply
+
+
+def _spans(pid, tid, t0, names=("outer", "inner")):
+    """A nested B/E pair stack starting at ``t0`` (local clock)."""
+    evs = []
+    t = t0
+    for n in names:
+        evs.append({"ph": "B", "name": n, "pid": pid, "tid": tid,
+                    "ts": t})
+        t += 10
+    for n in reversed(names):
+        evs.append({"ph": "E", "name": n, "pid": pid, "tid": tid,
+                    "ts": t})
+        t += 10
+    return evs
+
+
+# -- ingestion honesty ----------------------------------------------
+
+def test_hello_echoes_collector_clock_and_registers_source():
+    col = FleetCollector()
+    r = _hello(col, "e0", pid=4242, role="decode")
+    assert isinstance(r["clock_us"], int)
+    st = col.stats()
+    assert st["sources"]["e0"]["pid"] == 4242
+    assert st["sources"]["e0"]["role"] == "decode"
+
+
+def test_digest_mismatch_drops_without_parsing():
+    col = FleetCollector()
+    # payload is not even JSON — if the collector tried to parse a
+    # digest-failed batch this would raise instead of counting
+    rotten = b"\x00\xffnot json at all"
+    reply, _ = col.handle("telemetry.batch", {
+        "source": "e0", "seq": 1, "offset_us": 0,
+        "digest": payload_digest(b"what the sender hashed"),
+        "dropped": 0}, (rotten,))
+    assert reply["accepted"] is False
+    st = col.stats()
+    assert st["corrupt_frames"] == 1
+    assert st["sources"]["e0"]["events"] == 0
+    v = col.verdict()
+    assert v["healthy"] is False
+    assert v["telemetry_loss"] == [
+        {"source": "e0", "kind": "corrupt_frames", "n": 1}]
+
+
+def test_sequence_gap_counts_lost_batches():
+    col = FleetCollector()
+    _send(col, "e0", seq=1)
+    _send(col, "e0", seq=4)          # 2 and 3 never arrived
+    st = col.stats()
+    assert st["lost_batches"] == 2
+    assert st["sources"]["e0"]["batches"] == 2
+    assert {"source": "e0", "kind": "lost_batches", "n": 2} \
+        in col.verdict()["telemetry_loss"]
+
+
+def test_sender_reported_drops_surface_in_verdict():
+    col = FleetCollector()
+    # the header's dropped counter is cumulative sender-side — the
+    # collector keeps the high-water mark, not the sum
+    _send(col, "e0", seq=1, dropped=3)
+    _send(col, "e0", seq=2, dropped=5)
+    st = col.stats()
+    assert st["dropped"] == 5
+    v = col.verdict()
+    assert v["healthy"] is False
+    assert {"source": "e0", "kind": "dropped", "n": 5} \
+        in v["telemetry_loss"]
+
+
+def test_clean_stream_is_healthy():
+    col = FleetCollector()
+    _send(col, "e0", seq=1, events=[{"event": "x"}])
+    _send(col, "e0", seq=2, events=[{"event": "y"}])
+    v = col.verdict()
+    assert v["telemetry_loss"] == []
+    assert v["healthy"] is True
+    assert col.stats()["sources"]["e0"]["events"] == 2
+
+
+def test_unknown_telemetry_op_rejected():
+    col = FleetCollector()
+    with pytest.raises(ValueError, match="unknown telemetry op"):
+        col.handle("telemetry.bogus", {}, ())
+
+
+# -- trace merge ----------------------------------------------------
+
+def test_merge_shifts_sources_into_collector_domain():
+    col = FleetCollector()
+    _hello(col, "e0", pid=111)
+    # e0's local clock runs 1000us behind the collector's
+    _send(col, "e0", seq=1, offset_us=1000,
+          trace=_spans(111, 1, t0=0))
+    local = _spans(999, 1, t0=500)
+    merged = col.merge_traces(local)
+    assert chrome.validate(merged) == []
+    shifted = [ev["ts"] for ev in merged
+               if ev.get("pid") == 111 and "ts" in ev]
+    assert shifted == [1000, 1010, 1020, 1030]
+    # local (collector-domain) events are never shifted
+    assert [ev["ts"] for ev in merged if ev.get("pid") == 999] \
+        == [500, 510, 520, 530]
+
+
+def test_merge_remaps_colliding_pids_onto_fresh_tracks():
+    """Two in-process test "engines" share one OS pid; the merge gives
+    each its own track (real worker processes never collide)."""
+    col = FleetCollector()
+    _hello(col, "a", pid=1234, role="prefill")
+    _hello(col, "b", pid=1234, role="decode")
+    _send(col, "a", seq=1, offset_us=0, trace=_spans(1234, 1, t0=0))
+    _send(col, "b", seq=1, offset_us=0, trace=_spans(1234, 1, t0=5))
+    local = _spans(1234, 1, t0=100)
+    merged = col.merge_traces(local)
+    assert chrome.validate(merged) == []
+    pids = {ev.get("pid") for ev in merged if ev.get("ph") != "M"}
+    assert len(pids) == 3, pids          # local + two remapped tracks
+    names = {ev["args"]["name"] for ev in merged
+             if ev.get("ph") == "M"
+             and ev.get("name") == "process_name"}
+    assert names == {"prefill:a", "decode:b"}
+    # the local track keeps its true pid; sources moved off it
+    assert 1234 in pids
+
+
+def test_merge_property_arbitrary_offsets_stay_checker_valid():
+    """The load-bearing invariant: a constant per-source shift plus a
+    stable sort keeps EVERY track internally monotonic, so the merged
+    file is checker-valid for any clock-offset assignment."""
+    rng = np.random.default_rng(0)
+    for trial in range(20):
+        col = FleetCollector()
+        n_sources = int(rng.integers(1, 4))
+        for i in range(n_sources):
+            name = f"e{i}"
+            _hello(col, name, pid=100 + i)
+            off = int(rng.integers(-50_000, 50_000))
+            trace = []
+            for tid in range(int(rng.integers(1, 3))):
+                trace += _spans(100 + i, tid,
+                                t0=int(rng.integers(0, 1000)))
+                # async request-tree events ride the same clock
+                t = int(rng.integers(0, 1000))
+                trace += [
+                    {"ph": "b", "name": "serve.req", "cat": "serve.req",
+                     "id": f"r{i}-{tid}", "pid": 100 + i, "tid": tid,
+                     "ts": t},
+                    {"ph": "n", "name": "serve.req.claimed",
+                     "cat": "serve.req", "id": f"r{i}-{tid}",
+                     "pid": 100 + i, "tid": tid, "ts": t + 5},
+                    {"ph": "e", "name": "serve.req", "cat": "serve.req",
+                     "id": f"r{i}-{tid}", "pid": 100 + i, "tid": tid,
+                     "ts": t + 9},
+                ]
+            _send(col, name, seq=1, offset_us=off, trace=trace)
+        merged = col.merge_traces(_spans(999, 0, t0=0))
+        problems = chrome.validate(merged)
+        assert problems == [], (trial, problems)
+        # per-(pid, tid) timestamps are non-decreasing in list order
+        last = {}
+        for ev in merged:
+            if ev.get("ph") == "M" or "ts" not in ev:
+                continue
+            key = (ev["pid"], ev.get("tid"))
+            assert ev["ts"] >= last.get(key, float("-inf"))
+            last[key] = ev["ts"]
+
+
+def test_killed_engine_dangling_spans_close_at_export(tmp_path):
+    """An engine killed mid-trace leaves unclosed B and async b spans;
+    the merged list is honestly invalid in memory, and the EXPORT path
+    (close_dangling) writes a checker-valid file — the acceptance
+    pipeline for a run that survived an engine death."""
+    col = FleetCollector()
+    _hello(col, "dead0", pid=77)
+    trace = [
+        {"ph": "B", "name": "decode.step", "pid": 77, "tid": 1,
+         "ts": 10},
+        {"ph": "b", "name": "serve.req", "cat": "serve.req",
+         "id": "r-dead", "pid": 77, "tid": 1, "ts": 12},
+        # ... killed here: no E, no e
+    ]
+    _send(col, "dead0", seq=1, offset_us=0, trace=trace)
+    merged = col.merge_traces(_spans(999, 0, t0=0))
+    assert chrome.validate(merged) != []        # honest: dangling
+    path = tmp_path / "merged.json"
+    chrome.export(path, merged)
+    assert chrome.validate(str(path)) == []     # healed on disk
+    obj = json.load(open(path))
+    closed = [ev for ev in obj["traceEvents"]
+              if (ev.get("args") or {}).get("closed_by") == "export"]
+    assert {ev["ph"] for ev in closed} == {"E", "e"}
+
+
+def test_cross_process_trees_counts_spanning_trees_only():
+    base = {"cat": "serve.req", "id": "r1"}
+    spanning = [
+        {"ph": "b", "name": "serve.req", "pid": 1, "tid": 0, "ts": 0,
+         **base},
+        {"ph": "n", "name": "serve.req.claimed", "pid": 2, "tid": 0,
+         "ts": 5, **base},
+        {"ph": "n", "name": "serve.req.handoff", "pid": 3, "tid": 0,
+         "ts": 8, **base},
+        {"ph": "e", "name": "serve.req", "pid": 1, "tid": 0, "ts": 9,
+         **base},
+    ]
+    single = [
+        {"ph": "b", "name": "serve.req", "cat": "serve.req",
+         "id": "r2", "pid": 4, "tid": 0, "ts": 0},
+        {"ph": "e", "name": "serve.req", "cat": "serve.req",
+         "id": "r2", "pid": 4, "tid": 0, "ts": 3},
+    ]
+    events = spanning + single
+    assert FleetCollector.cross_process_trees(events) == 1
+    # excluding the coordinator's pid: the tree still spans the two
+    # ENGINE processes (2 and 3)
+    assert FleetCollector.cross_process_trees(
+        events, exclude_pid=1) == 1
+    # excluding an engine pid leaves only coordinator+one engine
+    assert FleetCollector.cross_process_trees(
+        [e for e in spanning if e["pid"] != 3], exclude_pid=1) == 0
+
+
+# -- roster + registry surfaces -------------------------------------
+
+def test_update_report_rolls_up_occupancy_and_token_rate():
+    reg = Registry()
+    col = FleetCollector(registry=reg, rate_window_s=0.0)
+    col.update_report("e0", {"occupancy": 0.75, "tokens": 0})
+    col.update_report("e1", {"occupancy": 0.25, "tokens": 0})
+    col.maybe_poll()                 # baseline window
+    col.update_report("e0", {"occupancy": 0.75, "tokens": 90})
+    col.update_report("e1", {"occupancy": 0.25, "tokens": 10})
+    col.maybe_poll()
+    snap = reg.snapshot()
+    assert snap["gauges"]["fleet.engine.e0.occupancy"] == 0.75
+    assert snap["gauges"]["fleet.engine.e1.occupancy"] == 0.25
+    assert snap["gauges"]["fleet.tokens_per_s"] > 0.0
+
+
+def test_metrics_snapshot_gauges_mirrored_per_engine():
+    reg = Registry()
+    col = FleetCollector(registry=reg)
+    _send(col, "e0", seq=1,
+          metrics={"gauges": {"serve.occupancy_rows": 0.5},
+                   "counters": {}, "histograms": {}})
+    snap = reg.snapshot()
+    assert snap["gauges"][
+        "fleet.engine.e0.serve.occupancy_rows"] == 0.5
+
+
+def test_observe_latency_feeds_fleet_histograms():
+    reg = Registry()
+    col = FleetCollector(registry=reg)
+    col.observe_latency("fleet.claim_ms", 2.5)
+    col.observe_latency("fleet.claim_ms", 3.5)
+    h = reg.snapshot()["histograms"]["fleet.claim_ms"]
+    assert h["count"] == 2 and h["sum"] == 6.0
+
+
+def test_straggler_engine_alerts_with_source_and_callback():
+    """One engine's TPOT at k× the fleet median raises an `obs.alert`
+    stamped with THAT engine as source; the coordinator's on_alert
+    listener hears it, and a listener bug never propagates."""
+    heard = []
+
+    def listener(a):
+        heard.append(a)
+        raise RuntimeError("listener bug must not stall the reaper")
+
+    col = FleetCollector(poll_interval_s=0.0, min_count=4,
+                         straggler_factor=3.0, on_alert=listener)
+    for _ in range(6):
+        col.observe_slo("e0", {"tpot_ms": 1.0})
+        col.observe_slo("e1", {"tpot_ms": 1.0})
+        col.observe_slo("e2", {"tpot_ms": 50.0})   # the straggler
+    alerts = col.maybe_poll()
+    stragglers = [a for a in alerts
+                  if a.watch.startswith("straggler")]
+    assert len(stragglers) == 1
+    assert stragglers[0].source == "e2"
+    assert stragglers[0].metric == "serve.tpot_ms"
+    assert heard == alerts
+    v = col.verdict()
+    assert v["healthy"] is False
+    assert sorted(v["sources"]) == ["e0", "e1", "e2"]
+
+
+def test_resident_summaries_roundtrip():
+    col = FleetCollector()
+    s0 = chain_bloom(["a", "b", "c"])
+    col.update_resident("e0", s0)
+    col.update_resident("e1", None)      # engine with nothing resident
+    assert col.resident_summaries() == {"e0": s0}
+    assert col.stats()["sources"]["e0"]["resident_n"] == 3

@@ -192,3 +192,112 @@ def test_bench_serve_stamps_health(tmp_path):
     assert h["healthy"] is True and h["n_alerts"] == 0
     assert h["polls"] >= 1
     assert rec["tracing"] is False
+
+
+# -- multi-source watches (the fleet collector's shape, r19) --------
+
+def test_multiwatch_per_source_windows_resist_masking():
+    """The reason MultiWatch exists: e0 burns 100% of its SLO budget
+    while e1 is clean. Aggregated into ONE registry the combined burn
+    fraction (0.5) would sit under a 0.6 budget and the detector would
+    stay silent — per-source windows fire on e0 alone, stamped with
+    its source."""
+    mw = watch.MultiWatch(
+        lambda: [watch.SloBurnRate("serve.ttft_ms", 100.0,
+                                   budget=0.6, min_count=4)],
+        min_interval_s=0.0)
+    # interleaved arrival order, as the coordinator's commit path
+    # would feed them
+    for _ in range(8):
+        mw.observe("e0", "serve.ttft_ms", 500.0)   # over SLO
+        mw.observe("e1", "serve.ttft_ms", 10.0)    # under
+    alerts = mw.poll()
+    assert [a.source for a in alerts] == ["e0"]
+    assert alerts[0].watch == "slo_burn[serve.ttft_ms]"
+    v = mw.verdict()
+    assert v["healthy"] is False
+    assert v["sources"] == ["e0", "e1"]
+    assert v["alerts"][0]["source"] == "e0"
+
+
+def test_multiwatch_detector_state_not_shared_across_sources():
+    """make_watchers is a FACTORY: each source arms its own detector
+    instances, so one source's armed thresholds/state never leak into
+    a peer's window."""
+    built = []
+
+    def make():
+        w = watch.SloBurnRate("serve.ttft_ms", 100.0, budget=0.25,
+                              min_count=2)
+        built.append(w)
+        return [w]
+
+    mw = watch.MultiWatch(make, min_interval_s=0.0)
+    mw.observe("e0", "serve.ttft_ms", 1.0)
+    mw.observe("e1", "serve.ttft_ms", 1.0)
+    assert len(built) == 2 and built[0] is not built[1]
+
+
+def test_straggler_outlier_flags_engine_over_fleet_median():
+    det = watch.StragglerOutlier(factor=3.0, min_count=4,
+                                 min_sources=2)
+    windows = {
+        "e0": {"histograms": {"serve.tpot_ms":
+                              {"count": 8, "sum": 8.0}}},
+        "e1": {"histograms": {"serve.tpot_ms":
+                              {"count": 8, "sum": 8.0}}},
+        "e2": {"histograms": {"serve.tpot_ms":
+                              {"count": 8, "sum": 400.0}}},
+    }
+    (a,) = det.check_sources(windows)
+    assert a.source == "e2" and a.metric == "serve.tpot_ms"
+    assert a.value == 50.0 and a.threshold == 3.0  # 3x median 1.0
+
+
+def test_straggler_outlier_excludes_thin_and_lonely_sources():
+    det = watch.StragglerOutlier(factor=3.0, min_count=4,
+                                 min_sources=2)
+    # a source with too few observations joins neither the median nor
+    # the verdict — a cold engine is not a straggler
+    windows = {
+        "e0": {"histograms": {"serve.tpot_ms":
+                              {"count": 8, "sum": 8.0}}},
+        "thin": {"histograms": {"serve.tpot_ms":
+                                {"count": 2, "sum": 1000.0}}},
+    }
+    assert det.check_sources(windows) == []     # 1 eligible < 2
+    # a 1-engine fleet has no peers to be an outlier against
+    assert det.check_sources({"e0": windows["e0"]}) == []
+
+
+def test_multiwatch_interleaved_multi_engine_stream():
+    """Interleaved observations + a cross-source detector in one
+    harness: per-source SLO burn fires for the burning engine, the
+    straggler fires for the slow one, and both alerts land on the bus
+    with their sources."""
+    ring = obs.RingSink()
+    with bus.installed(ring):
+        mw = watch.MultiWatch(
+            lambda: [watch.SloBurnRate("serve.tpot_ms", 100.0,
+                                       budget=0.5, min_count=4)],
+            cross=(watch.StragglerOutlier(factor=3.0, min_count=4),),
+            min_interval_s=0.0)
+        for _ in range(8):
+            mw.observe("e0", "serve.tpot_ms", 1.0)
+            mw.observe("e1", "serve.tpot_ms", 2.0)
+            mw.observe("e2", "serve.tpot_ms", 500.0)  # burns AND lags
+        alerts = mw.poll()
+    kinds = sorted((a.watch.split("[")[0], a.source)
+                   for a in alerts)
+    assert kinds == [("slo_burn", "e2"), ("straggler", "e2")]
+    evs = ring.of_type("obs.alert")
+    assert sorted(e["source"] for e in evs) == ["e2", "e2"]
+
+
+def test_multiwatch_maybe_poll_throttles():
+    mw = watch.MultiWatch(lambda: [], min_interval_s=3600.0)
+    mw.observe("e0", "serve.tpot_ms", 1.0)
+    assert mw.maybe_poll() == []        # throttled window
+    assert mw.polls == 0
+    assert mw.poll() is not None        # forced
+    assert mw.polls == 1

@@ -1,0 +1,135 @@
+#!/usr/bin/env python
+"""Fleet round-19 study: pricing the armed fleet telemetry plane.
+
+A/B protocol, appending to ``serve_fleet_obs_r19.jsonl``: the SAME
+2-engine fleet workload (Poisson arrivals, ``--verify-identity``
+audited) runs disarmed and armed (``fleet_obs``: every worker
+forwards bus events + metrics snapshots + trace deltas over the
+coordinator RPC into the :class:`~icikit.obs.aggregate.FleetCollector`,
+which also runs the watch detectors and merges the per-process
+traces). Paired per seed, the armed/disarmed tokens/s ratio prices the
+plane; the bar is **<5% overhead** — the forwarder's bounded queue and
+drop-don't-stall design is what makes that possible, and the study
+enforces it loudly.
+
+Each armed row additionally pins the acceptance shape:
+
+- zero telemetry loss (``dropped``/``corrupt_frames``/``lost_batches``
+  all 0 — a healthy channel under a healthy run);
+- the merged trace passes the structural checker
+  (``python -m icikit.obs.check``) and carries ≥1 async request tree
+  spanning two ENGINE processes (prefill → handoff → decode);
+- the collector's health verdict is healthy.
+
+CPU protocol note: the engine processes share this host's physical
+cores with the coordinator, so the overhead measured here is an UPPER
+bound on separate-host overhead (the collector steals cycles from the
+same socket the engines decode on). The TPU/multi-host session
+re-prices absolutes (ROADMAP item 5 ledger).
+
+Reproduce::
+
+    python tools/fleet_obs_study.py --json serve_fleet_obs_r19.jsonl
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from icikit.bench.fleet import run_fleet  # noqa: E402
+from icikit.obs import chrome  # noqa: E402
+
+ARM_KW = dict(
+    prompt_len=12, new_min=4, new_max=8, roles="disagg",
+    prefix_len=8, verify=True, timeout_s=900.0)
+
+
+def study(json_path: str | None, seeds=(0, 1), n_engines: int = 2,
+          requests: int = 24, rate: float = 60.0,
+          overhead_bar_pct: float = 5.0) -> list:
+    recs = []
+    for seed in seeds:
+        base = run_fleet(n_engines, requests, rate, seed=seed,
+                         **ARM_KW)
+        assert base["identity_ok"] and not base["failed"], base
+        trace_path = os.path.join(
+            tempfile.mkdtemp(prefix="icikit_fleet_obs_"),
+            "merged_trace.json")
+        armed = run_fleet(n_engines, requests, rate, seed=seed,
+                          fleet_obs=True, obs_out=trace_path,
+                          **ARM_KW)
+        assert armed["identity_ok"] and not armed["failed"], armed
+        tel = armed["telemetry"]
+        assert tel["dropped"] == 0, tel
+        assert tel["corrupt_frames"] == 0, tel
+        assert tel["lost_batches"] == 0, tel
+        assert tel["batches"] >= 1, tel
+        assert armed["obs_verdict"]["healthy"], armed["obs_verdict"]
+        assert armed["cross_process_trees"] >= 1, armed
+        problems = chrome.validate(trace_path)
+        assert problems == [], problems
+        overhead_pct = 100.0 * (1.0 - armed["tokens_per_s"]
+                                / base["tokens_per_s"])
+        rec = {
+            "kind": "serve_fleet_obs",
+            "n_engines": n_engines,
+            "n_requests": requests,
+            "seed": seed,
+            "tokens_per_s_base": base["tokens_per_s"],
+            "tokens_per_s_armed": armed["tokens_per_s"],
+            "overhead_pct": round(overhead_pct, 2),
+            "overhead_bar_pct": overhead_bar_pct,
+            "telemetry": tel,
+            "obs_verdict": armed["obs_verdict"],
+            "cross_process_trees": armed["cross_process_trees"],
+            "identity_ok": armed["identity_ok"]
+            and base["identity_ok"],
+            "note": "paired armed/disarmed 2-engine disagg arm; CPU "
+                    "co-located collector, so overhead is an upper "
+                    "bound on separate-host overhead",
+        }
+        recs.append(rec)
+        if json_path:
+            with open(json_path, "a") as f:
+                f.write(json.dumps(rec) + "\n")
+        print(json.dumps({  # icikit-lint: off[obs-print]
+            "seed": seed,
+            "base": base["tokens_per_s"],
+            "armed": armed["tokens_per_s"],
+            "overhead_pct": rec["overhead_pct"],
+            "cross_process_trees": rec["cross_process_trees"]}))
+    mean_overhead = sum(r["overhead_pct"] for r in recs) / len(recs)
+    print(json.dumps({  # icikit-lint: off[obs-print]
+        "mean_overhead_pct": round(mean_overhead, 2),
+        "bar_pct": overhead_bar_pct,
+        "within_bar": mean_overhead < overhead_bar_pct}))
+    assert mean_overhead < overhead_bar_pct, \
+        f"armed fleet obs costs {mean_overhead:.2f}% tokens/s " \
+        f"(bar {overhead_bar_pct}%)"
+    return recs
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--json", dest="json_path",
+                    default="serve_fleet_obs_r19.jsonl")
+    ap.add_argument("--seeds", type=int, nargs="+", default=[0, 1])
+    ap.add_argument("--engines", type=int, default=2)
+    ap.add_argument("--requests", type=int, default=24)
+    ap.add_argument("--rate", type=float, default=60.0)
+    args = ap.parse_args(argv)
+    study(args.json_path, seeds=tuple(args.seeds),
+          n_engines=args.engines, requests=args.requests,
+          rate=args.rate)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -7,7 +7,7 @@ PY ?= python
 .PHONY: test test-fast chaos bench native clean sweep scaling northstar \
 	trace-demo check analysis-smoke decode-smoke draft-smoke \
 	serve-smoke quant-smoke obs-smoke fleet-smoke fleet-ha-smoke \
-	fleet-obs-smoke
+	fleet-obs-smoke fleet-route-smoke
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -49,7 +49,7 @@ check:
 	$(PY) tools/bench_regress.py --self-check serve_r12.jsonl \
 		serve_r15.jsonl serve_r16.jsonl serve_fleet_r17.jsonl \
 		serve_fleet_ha_r18.jsonl serve_fleet_obs_r19.jsonl \
-		decode_spec_r14.jsonl \
+		serve_fleet_route_r20.jsonl decode_spec_r14.jsonl \
 		--verdict /tmp/icikit_bench_regress.json
 
 # machine-readable analysis output: the --json shape the tooling
@@ -269,6 +269,26 @@ fleet-obs-smoke:
 		print('fleet-obs-smoke OK: merged trace checker-valid,', \
 		      r['cross_process_trees'], 'cross-process trees,', \
 		      r['batches'], 'batches, zero telemetry loss')"
+
+# the r20 cache-aware dispatch plane: a 3-engine disaggregated Zipf
+# multi-tenant run (1 prefill + 2 decode) with prefix-locality claim
+# routing and the host-RAM bridge tier armed — the coordinator-side
+# trace must pass the structural checker and the metrics snapshot
+# must show steered claims (the router actually re-ordered who won a
+# decode lease) and RAM-tier bridge hits (migrated KV served from
+# host memory, not the .npz disk tier), every completion
+# identity-audited
+fleet-route-smoke:
+	JAX_PLATFORMS=cpu \
+	ICIKIT_OBS="trace=/tmp/icikit_fleet_route_trace.json;metrics=/tmp/icikit_fleet_route_metrics.json;jsonl=off" \
+	$(PY) -m icikit.bench.fleet --engines 3 --roles disagg \
+		--requests 16 --rate 12 --prompt 24 --prefix 20 \
+		--tenants 4 --zipf 1.2 --new-min 4 --new-max 8 --route \
+		--verify-identity --seed 0 > /dev/null
+	$(PY) -m icikit.obs.check /tmp/icikit_fleet_route_trace.json
+	@grep -q '"fleet.route.steered"' /tmp/icikit_fleet_route_metrics.json && \
+		grep -q '"fleet.bridge.ram_hits"' /tmp/icikit_fleet_route_metrics.json && \
+		echo "fleet-route-smoke OK: trace valid, steered claims + RAM-tier bridge hits on the bus"
 
 # the r18 HA drill: 2 engines + 1 warm standby, the leader SIGKILLed
 # mid-decode — the standby must promote inside 2x the lease timeout

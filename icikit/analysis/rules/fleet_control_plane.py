@@ -10,8 +10,11 @@ fine; they are host memory). Since r19 the telemetry/collector path
 (``fleet/telemetry.py``, ``obs/aggregate.py``) is held to the same
 contract: observability must keep flowing — and the collector must
 keep answering inside the coordinator — while engine device
-schedules are suspect. The data plane (``roles.py``/``worker.py`` —
-the engine lives there) is explicitly out of scope.
+schedules are suspect. Same for the r20 autoscale supervisor
+(``fleet/supervisor.py``): the scale policy must keep deciding while
+engines' devices are the thing under load. The data plane
+(``roles.py``/``worker.py`` — the engine lives there) is explicitly
+out of scope.
 
 Mechanically: flag any ``import jax``/``from jax ...`` and any
 ``jax.``/``jnp.`` attribute use in the control-plane modules,
@@ -29,6 +32,7 @@ CONTROL_PLANE = ("icikit/fleet/transport.py",
                  "icikit/fleet/journal.py",
                  "icikit/fleet/ha.py",
                  "icikit/fleet/telemetry.py",
+                 "icikit/fleet/supervisor.py",
                  "icikit/obs/aggregate.py")
 
 BANNED = [

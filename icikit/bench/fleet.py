@@ -26,6 +26,14 @@ Protocol notes:
   dedicated prefill, half dedicated decode; every request migrates
   its KV over the block bridge, so ``migrations`` in the record
   counts the traffic the DistServe split actually moved.
+- **cache-aware arms** (r20: ``--route`` / ``--bridge-ram`` /
+  ``--tenants``/``--zipf`` / ``--supervise``) — routed dispatch is
+  priced against the blind control as prefix hit-ratio ×
+  migration-bytes × tokens/s on the SAME seeded Zipf multi-tenant
+  workload; the host-RAM bridge tier against disk-only by tier-fetch
+  latency; the autoscale supervisor's spawn/retire timeline lands in
+  the record. Routing changes WHERE a claim lands, never what it
+  computes — every arm holds the identity audit.
 
 CLI::
 
@@ -48,6 +56,7 @@ import numpy as np
 
 from icikit import obs
 from icikit.bench.serve import _pcts, make_workload, warm_prompts
+from icikit.fleet.kvbridge import DEFAULT_RAM_BLOCKS
 
 REPO = pathlib.Path(__file__).resolve().parents[2]
 
@@ -92,11 +101,14 @@ def spawn_worker(addr, engine_id: str, role: str, model_spec: dict,
                  rewarm: bool = False,
                  ha_dir: str | None = None,
                  token: str | None = None,
-                 telemetry: dict | None = None) -> subprocess.Popen:
+                 telemetry: dict | None = None,
+                 weight_cache: str | None = None
+                 ) -> subprocess.Popen:
     cfg = {"addr": list(addr) if addr is not None else None,
            "engine_id": engine_id, "role": role,
            "model": model_spec, "serve": serve_kw, "rewarm": rewarm,
-           "ha_dir": ha_dir, "token": token, "telemetry": telemetry}
+           "ha_dir": ha_dir, "token": token, "telemetry": telemetry,
+           "weight_cache": weight_cache}
     path = os.path.join(tmpdir, f"{engine_id}.json")
     with open(path, "w") as f:
         json.dump(cfg, f)
@@ -192,7 +204,14 @@ def run_fleet(n_engines: int, n_requests: int, rate_rps: float,
               env_extra_per_engine: dict | None = None,
               require_alive: int = 1,
               fleet_obs: bool = False,
-              obs_out: str | None = None) -> dict:
+              obs_out: str | None = None,
+              tenants: int = 0, zipf: float = 1.0,
+              route: bool = False,
+              bridge_ram: int = DEFAULT_RAM_BLOCKS,
+              weight_cache: str | None = None,
+              supervise: bool = False,
+              pending_high: float = 4.0,
+              supervise_kw: dict | None = None) -> dict:
     """One fleet arm. ``env_extra_per_engine`` maps engine-id ->
     extra env (the soak's per-victim ``ICIKIT_CHAOS`` plans);
     ``require_alive`` is the survivor floor the drain wait tolerates
@@ -200,7 +219,19 @@ def run_fleet(n_engines: int, n_requests: int, rate_rps: float,
     plane end-to-end: workers forward bus events/metrics/trace deltas
     to a coordinator-side :class:`~icikit.obs.aggregate.FleetCollector`,
     and the record grows the merged-trace/verdict fields (the merged
-    checker-valid trace lands at ``obs_out`` when given)."""
+    checker-valid trace lands at ``obs_out`` when given).
+
+    r20 knobs: ``tenants``/``zipf`` shape the multi-tenant
+    shared-prefix workload (``bench.serve.make_workload``); ``route``
+    turns on prefix-locality-aware dispatch (claims steered by the
+    engines' heartbeat residency blooms — the OFF arm is the priced
+    control); ``bridge_ram`` sizes the coordinator's host-RAM block
+    tier (0 disables it — the disk-only control arm);
+    ``weight_cache`` names a cross-process weight-recipe cache dir
+    for spawn acceleration; ``supervise`` runs the
+    :class:`~icikit.fleet.supervisor.Supervisor` autoscale loop over
+    the run (spawn on watch pressure, retire on sustained idle — the
+    record grows the decision timeline)."""
     import jax
 
     from icikit.fleet.coordinator import Coordinator
@@ -217,14 +248,23 @@ def run_fleet(n_engines: int, n_requests: int, rate_rps: float,
                     max_prompt=prompt_len + 1, max_new=new_max,
                     prefill_chunk=prefill_chunk,
                     speculate_k=speculate, integrity=integrity)
-    model = build_model(model_spec)
+    tmpdir = tempfile.mkdtemp(prefix="icikit_fleet_")
+    # "off" pins the cache OFF even under supervise — the study's
+    # before-arm for the scale-up TTFT fix
+    wc_dir = None if weight_cache == "off" else weight_cache
+    if wc_dir is None and supervise and weight_cache != "off":
+        # a supervisor joiner's scale-up TTFT is weight-rebuild
+        # dominated without this: the base workers populate the
+        # shared cache at spawn, the joiner reads it
+        wc_dir = os.path.join(tmpdir, "weights")
+    model = build_model(model_spec, weight_cache=wc_dir)
     _, _, cfg = model
     workload = make_workload(n_requests, rate_rps, prompt_len,
                              new_min, new_max, cfg.vocab, seed,
                              prefix_len=prefix_len,
-                             seed_per_request=seed_per_request)
+                             seed_per_request=seed_per_request,
+                             tenants=tenants, zipf=zipf)
     role_list = roles_for(n_engines, roles)
-    tmpdir = tempfile.mkdtemp(prefix="icikit_fleet_")
     own_store = store_dir is None
     store = store_dir or os.path.join(tmpdir, "bridge")
     collector = None
@@ -234,16 +274,30 @@ def run_fleet(n_engines: int, n_requests: int, rate_rps: float,
         obs.enable_metrics()
         _tracer.start_tracing()     # coordinator-side root spans
         collector = FleetCollector()
-    coord = Coordinator(store, lease_s=lease_s, collector=collector)
+    watch = None
+    if supervise:
+        from icikit.obs.watch import fleet_watch
+        obs.enable_metrics()
+        # built now (the coordinator's reap loop polls it) but
+        # attached at t0: warm-phase backlog must not count as
+        # scale-up pressure
+        watch = fleet_watch(pending_high=pending_high)
+    coord = Coordinator(store, lease_s=lease_s, collector=collector,
+                        watch=watch,
+                        bridge_ram_blocks=bridge_ram,
+                        route_block_size=(block_size if route
+                                          else None))
     tele_cfg = ({"addr": list(coord.addr)} if fleet_obs else None)
     procs = []
+    sup = None
     try:
         for i, role in enumerate(role_list):
             eid = f"{role}{i}"
             extra = (env_extra_per_engine or {}).get(eid)
             procs.append(spawn_worker(
                 coord.addr, eid, role, model_spec, serve_kw, tmpdir,
-                env_extra=extra, telemetry=tele_cfg))
+                env_extra=extra, telemetry=tele_cfg,
+                weight_cache=wc_dir))
         # registration barrier: submit nothing until every worker has
         # said hello — phase assignment (disaggregation) keys on the
         # registry, and the warm batch must warm the REAL role split
@@ -286,9 +340,55 @@ def run_fleet(n_engines: int, n_requests: int, rate_rps: float,
                              temperature=temperature, top_k=top_k,
                              top_p=top_p)
                 for off, p, n, rs in workload]
-        coord.hold(False)
+        if watch is not None:
+            watch.attach()      # pressure counts from t0 only
+        if supervise:
+            from icikit.fleet.supervisor import Supervisor
+
+            def _spawn_auto(eid):
+                procs.append(spawn_worker(
+                    coord.addr, eid, "both", model_spec, serve_kw,
+                    tmpdir, telemetry=tele_cfg,
+                    weight_cache=wc_dir))
+
+            sup = Supervisor(
+                lambda: coord._op_fleet_stats({}, ())[0],
+                _spawn_auto,
+                lambda eid: coord._op_retire({"engine": eid}, ()),
+                floor=n_engines, ceiling=n_engines + 1,
+                **(supervise_kw or {})).start()
+        if not supervise:
+            # under supervision hold STAYS on through the drain: the
+            # scale-down half of the policy needs the base fleet
+            # still polling (not exited) while the supervisor's own
+            # joiners retire through the drain path
+            coord.hold(False)
         _wait(coord, procs, timeout_s, require=require_alive)
         makespan = time.monotonic() - t0
+        scaleups = []
+        if sup is not None:
+            # post-drain idle: give the policy its scale-down — every
+            # joiner it spawned should retire (LIFO, one per
+            # cooldown) before the fleet is released
+            deadline = time.monotonic() + min(60.0, timeout_s)
+            while (sup.n_retires < sup.n_spawns
+                   and time.monotonic() < deadline):
+                time.sleep(0.1)
+            sup.stop()
+            coord.hold(False)
+            # spawn decision -> joiner's first commit (one host, one
+            # monotonic clock): the scale-up TTFT the weight cache
+            # exists to shrink
+            fs = coord._op_fleet_stats({}, ())[0]["engines"]
+            for ev in sup.timeline():
+                if ev["action"] != "spawn":
+                    continue
+                fc = (fs.get(ev["engine"]) or {}).get(
+                    "first_commit_t")
+                scaleups.append(
+                    {"engine": ev["engine"],
+                     "ttft_ms": round((fc - ev["t"]) * 1e3, 1)
+                     if fc is not None else None})
         # let the surviving workers drain-flush their sealed blocks to
         # the bridge and exit cleanly BEFORE the coordinator goes away
         # (the store RPCs must still be answerable)
@@ -299,6 +399,8 @@ def run_fleet(n_engines: int, n_requests: int, rate_rps: float,
                 except subprocess.TimeoutExpired:
                     p.kill()
     finally:
+        if sup is not None:
+            sup.stop()
         coord.shutdown()
         for p in procs:
             if p.poll() is None:
@@ -322,13 +424,19 @@ def run_fleet(n_engines: int, n_requests: int, rate_rps: float,
             _chrome.export(obs_out, merged)
             obs_fields["trace_path"] = obs_out
     ttft, tpot, qwait, tokens, failed = [], [], [], 0, 0
-    for rid in rids:
+    hit_tokens, prompt_tokens = 0, 0
+    for rid, (_, p, _, _) in zip(rids, workload):
         req = coord.queue.request(rid)
         if req.state != "done":
             failed += 1
             continue
         slo = req.slo()
         tokens += len(req.tokens)
+        # routed dispatch is priced by how much prompt prefix the
+        # claiming engines already held resident (the marks ride the
+        # complete RPC onto the authoritative Request)
+        hit_tokens += int(req.prefix_hit_tokens)
+        prompt_tokens += len(p)
         if "ttft_ms" in slo:
             ttft.append(slo["ttft_ms"])
         if "tpot_ms" in slo:
@@ -351,6 +459,13 @@ def run_fleet(n_engines: int, n_requests: int, rate_rps: float,
         "speculate": speculate,
         "integrity": integrity,
         "prefix_len": prefix_len,
+        "tenants": tenants, "zipf": zipf,
+        "bridge_ram": bridge_ram,
+        # top-level bools so config_key separates the r20 arms: a
+        # routed row must never gate a blind one, nor a supervised
+        # row an unsupervised one
+        "routed": bool(coord.route_block_size),
+        "supervised": sup is not None,
         "temperature": temperature,
         "top_k": top_k, "top_p": top_p,
         "seed_per_request": seed_per_request,
@@ -367,6 +482,21 @@ def run_fleet(n_engines: int, n_requests: int, rate_rps: float,
         "reissues": coord.queue.n_reissues,
         "duplicate_commits": coord.queue.n_duplicate_commits,
         "handoffs": coord.n_handoffs,
+        "prefix_hit_tokens": hit_tokens,
+        "prefix_hit_ratio": round(hit_tokens / prompt_tokens, 4)
+        if prompt_tokens else None,
+        "route": {"enabled": bool(coord.route_block_size),
+                  "hits": coord.n_route_hits,
+                  "misses": coord.n_route_misses,
+                  "steered": coord.n_route_steered,
+                  "escaped": coord.n_route_escaped},
+        "autoscale": ({"spawns": sup.n_spawns,
+                       "retires": sup.n_retires,
+                       "scaleup_ttft_ms": scaleups,
+                       "timeline": [{**ev,
+                                     "t": round(ev["t"] - t0, 3)}
+                                    for ev in sup.timeline()]}
+                      if sup is not None else None),
         "bridge": coord.bridge.stats(),
         "engines": [{"returncode": w["returncode"],
                      **(w["stats"] or {"stats": None})}
@@ -785,6 +915,30 @@ def main(argv=None) -> int:
     ap.add_argument("--block-size", type=int, default=4)
     ap.add_argument("--prefill-chunk", type=int, default=16)
     ap.add_argument("--prefix", type=int, default=0)
+    ap.add_argument("--tenants", type=int, default=0,
+                    help="multi-tenant workload: N tenants sharing "
+                         "per-tenant prefixes, Zipf-ranked arrivals "
+                         "(needs --prefix > 0)")
+    ap.add_argument("--zipf", type=float, default=1.0,
+                    help="Zipf exponent for tenant popularity")
+    ap.add_argument("--route", action="store_true",
+                    help="prefix-locality-aware dispatch: steer "
+                         "claims to the engine whose heartbeat bloom "
+                         "holds the deepest resident prefix chain")
+    ap.add_argument("--bridge-ram", type=int,
+                    default=DEFAULT_RAM_BLOCKS, metavar="BLOCKS",
+                    help="host-RAM bridge tier capacity in blocks "
+                         "(0 = disk-only)")
+    ap.add_argument("--weight-cache", default=None, metavar="DIR",
+                    help="cross-process weight-recipe cache dir "
+                         "(spawn acceleration)")
+    ap.add_argument("--supervise", action="store_true",
+                    help="run the autoscale supervisor over the arm: "
+                         "spawn on watch pressure, retire on "
+                         "sustained idle")
+    ap.add_argument("--pending-high", type=float, default=4.0,
+                    help="queue-depth watermark feeding the "
+                         "supervisor's scale-up signal")
     ap.add_argument("--speculate", type=int, default=1)
     ap.add_argument("--integrity", default="none",
                     choices=["none", "pages"])
@@ -893,7 +1047,12 @@ def main(argv=None) -> int:
                     timeout_s=args.timeout,
                     env_extra_per_engine=env_extra or None,
                     fleet_obs=args.fleet_obs or bool(args.obs_out),
-                    obs_out=args.obs_out)
+                    obs_out=args.obs_out,
+                    tenants=args.tenants, zipf=args.zipf,
+                    route=args.route, bridge_ram=args.bridge_ram,
+                    weight_cache=args.weight_cache,
+                    supervise=args.supervise,
+                    pending_high=args.pending_high)
     obs.emit_records([rec])
     if args.json_path:
         with open(args.json_path, "a") as f:

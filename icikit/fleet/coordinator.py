@@ -64,8 +64,13 @@ import time
 import numpy as np
 
 from icikit import obs
-from icikit.fleet.kvbridge import BlockBridge
+from icikit.fleet.kvbridge import DEFAULT_RAM_BLOCKS, BlockBridge
+from icikit.fleet.telemetry import bloom_prefix_hits
 from icikit.fleet.transport import RpcServer
+# kvpool's hashing helpers are numpy+hashlib only (no jax at module
+# scope) — the coordinator may compute chain hashes without breaking
+# the control-plane rule
+from icikit.serve.kvpool import block_hashes
 from icikit.serve.scheduler import RequestQueue
 from icikit.serve.store import PrefixStore
 
@@ -100,14 +105,46 @@ class Coordinator:
                  host: str = "127.0.0.1", port: int = 0,
                  ha=None, join_token: str | None = None,
                  snapshot_every: int = 512, watch=None,
-                 collector=None):
+                 collector=None,
+                 bridge_ram_blocks: int = DEFAULT_RAM_BLOCKS,
+                 route_block_size: int | None = None,
+                 route_staleness_s: float = 5.0,
+                 route_escape_rounds: int = 32,
+                 route_escape_s: float = 2.0):
         if ha is not None and ha.queue is not None:
             # a replayed queue (takeover or restart): already holds
             # every in-flight request the previous leader journaled
             self.queue = ha.queue
         else:
             self.queue = RequestQueue(lease_s=lease_s)
-        self.bridge = BlockBridge(PrefixStore(store_dir))
+        self.bridge = BlockBridge(PrefixStore(store_dir),
+                                  ram_blocks=bridge_ram_blocks)
+        # -- cache-aware routing (r20) --------------------------------
+        # route_block_size=None keeps dispatch cache-BLIND (the r19
+        # behavior, and the bench's control arm). With a block size,
+        # submit() hashes each prompt's block-aligned chain; claims
+        # are steered to the engine whose heartbeat bloom advertises
+        # the deepest resident prefix. ALL of this state is a
+        # preference, never correctness: it is deliberately
+        # unjournaled (a failed-over coordinator starts cache-blind
+        # and re-learns from the next heartbeats), and every deny has
+        # the starvation escape below it.
+        self.route_block_size = route_block_size
+        self.route_staleness_s = float(route_staleness_s)
+        self.route_escape_rounds = int(route_escape_rounds)
+        self.route_escape_s = float(route_escape_s)
+        self._chains: dict = {}         # rid -> [chain hash hex, ...]
+        self._resident: dict = {}       # eid -> (bloom summary, t)
+        self._resident_ver = 0
+        self._route_cache: dict = {}    # rid -> (ver, {eid: score})
+        self._route_skips: dict = {}    # rid -> claim rounds passed over
+        self._route_escaped: set = set()
+        # mirrors of the fleet.route.* counters (mutated only inside
+        # the claim predicate, i.e. serialized under the queue lock)
+        self.n_route_hits = 0
+        self.n_route_misses = 0
+        self.n_route_steered = 0
+        self.n_route_escaped = 0
         self.heartbeat_timeout_s = heartbeat_timeout_s
         self.defect_threshold = defect_threshold
         self._lock = threading.Lock()
@@ -171,12 +208,22 @@ class Coordinator:
         the request enters prefill phase; otherwise any-role."""
         self._check_leader()
         rid = self.queue.submit(prompt, n_new, **kw)
+        chains = None
+        if self.route_block_size:
+            # the routing key: the prompt's block-aligned chain-hash
+            # lineage, same hash space the engines' heartbeat blooms
+            # summarize (kvpool.block_hashes, fp/q8 arena side)
+            chains = block_hashes(
+                prompt, self.route_block_size,
+                side="q8" if kw.get("quant") else "fp")
         with self._lock:
             roles = {e["role"] for e in self._engines.values()
                      if e["state"] == "live"}
             disagg = "prefill" in roles and (
                 "decode" in roles or "both" in roles)
             self._phase[rid] = "prefill" if disagg else "any"
+            if chains:
+                self._chains[rid] = chains
             self._journal_meta("cphase", {"rid": rid,
                                           "phase": self._phase[rid]})
         return rid
@@ -224,6 +271,78 @@ class Coordinator:
         # decode phase and undisaggregated requests both want an
         # engine that can run the request to completion
         return role in ("decode", "both") or not has_decode
+
+    # -- cache-aware routing (r20) -----------------------------------
+
+    def _route_scores(self, rid: str, chains, peers, ver: int) -> dict:
+        """Per-engine longest-resident-prefix scores for one request,
+        cached per residency-roster version (heartbeats bump the
+        version ~2/s per engine; between bumps the same queued request
+        is re-scored for free across claim polls). Runs under the
+        QUEUE lock — everything it reads was snapshotted by
+        ``_op_claim`` under the coordinator lock (``peers``) or is a
+        coordinator-private dict only ever mutated under the queue
+        lock (the cache itself)."""
+        ent = self._route_cache.get(rid)
+        if ent is not None and ent[0] == ver:
+            return ent[1]
+        scores = {eid: bloom_prefix_hits(summary, chains)
+                  for eid, (_, summary) in peers.items()
+                  if summary is not None}
+        self._route_cache[rid] = (ver, scores)
+        return scores
+
+    def _route_accept(self, r, engine_id: str, role: str,
+                      has_prefill: bool, has_decode: bool,
+                      peers: dict, ver: int, now: float) -> bool:
+        """The steered claim predicate (queue lock): eligibility is
+        still the hard gate; on top of it, a request whose chain
+        prefix scores strictly higher on some OTHER live, fresh,
+        eligible engine is passed over — it keeps its heap position
+        and the better engine's next poll wins it. Ties (including
+        all-zero: nobody resident) go to whoever asked first, so a
+        cold fleet is exactly blind dispatch. The escape hatch makes
+        starvation impossible: after ``route_escape_rounds``
+        pass-overs or ``route_escape_s`` of visibility the request is
+        claimable by anyone, permanently — routing is a preference,
+        never a correctness constraint."""
+        if not self._eligible(r.rid, role, has_prefill, has_decode):
+            return False
+        chains = self._chains.get(r.rid)
+        if not chains or r.rid in self._route_escaped:
+            return True
+        visible = max(r.arrival_t, r.visible_after)
+        if (now - visible >= self.route_escape_s
+                or self._route_skips.get(r.rid, 0)
+                >= self.route_escape_rounds):
+            self._route_escaped.add(r.rid)
+            self.n_route_escaped += 1
+            obs.count("fleet.route.escaped")
+            return True
+        scores = self._route_scores(r.rid, chains, peers, ver)
+        mine = scores.get(engine_id, 0)
+        best = mine
+        for eid, (peer_role, summary) in peers.items():
+            if eid == engine_id or summary is None:
+                continue
+            if not self._eligible(r.rid, peer_role, has_prefill,
+                                  has_decode):
+                continue
+            best = max(best, scores.get(eid, 0))
+        if mine >= best:
+            self._route_skips.pop(r.rid, None)
+            if mine > 0:
+                self.n_route_hits += 1
+                obs.count("fleet.route.hits")
+            else:
+                self.n_route_misses += 1
+                obs.count("fleet.route.misses")
+            return True
+        self._route_skips[r.rid] = \
+            self._route_skips.get(r.rid, 0) + 1
+        self.n_route_steered += 1
+        obs.count("fleet.route.steered")
+        return False
 
     def _serialize_claim(self, req, role: str) -> dict:
         remaining = req.n_new - len(req.tokens)
@@ -316,6 +435,7 @@ class Coordinator:
         self._check_leader()
         engine_id = msg["engine"]
         self._touch(engine_id)
+        now = time.monotonic()
         with self._lock:
             e = self._engines.get(engine_id)
             if e is None or e["state"] != "live":
@@ -324,11 +444,33 @@ class Coordinator:
             role = e["role"]
             live = [x["role"] for x in self._engines.values()
                     if x["state"] == "live"]
+            peers = None
+            if self.route_block_size and len(live) > 1:
+                # routing snapshot (coordinator lock, BEFORE the
+                # claim — the _eligible lock discipline): live roles
+                # plus each engine's residency summary, already
+                # demoted to None past the staleness window so a
+                # silent engine just looks cold
+                peers = {}
+                for eid, x in self._engines.items():
+                    if x["state"] != "live":
+                        continue
+                    ent = self._resident.get(eid)
+                    fresh = (ent is not None and
+                             now - ent[1] <= self.route_staleness_s)
+                    peers[eid] = (x["role"],
+                                  ent[0] if fresh else None)
+            ver = self._resident_ver
         has_prefill = any(r in ("prefill", "both") for r in live)
         has_decode = any(r in ("decode", "both") for r in live)
-        req = self.queue.claim(
-            accept=lambda r: self._eligible(r.rid, role, has_prefill,
-                                            has_decode))
+        if peers is None:
+            accept = lambda r: self._eligible(  # noqa: E731
+                r.rid, role, has_prefill, has_decode)
+        else:
+            accept = lambda r: self._route_accept(  # noqa: E731
+                r, engine_id, role, has_prefill, has_decode,
+                peers, ver, now)
+        req = self.queue.claim(accept=accept)
         if req is None:
             return {"req": None}, ()
         # serialize BEFORE any possible expire below: the wire claim
@@ -506,7 +648,12 @@ class Coordinator:
                "handoffs": n_handoffs,
                "hold": self._hold,
                "engines": engines,
-               "bridge": self.bridge.stats()}
+               "bridge": self.bridge.stats(),
+               "route": {"enabled": bool(self.route_block_size),
+                         "hits": self.n_route_hits,
+                         "misses": self.n_route_misses,
+                         "steered": self.n_route_steered,
+                         "escaped": self.n_route_escaped}}
         if self._ha is not None:
             out["journal"] = self._ha.journal.stats()
         if self._watch is not None:
@@ -566,6 +713,13 @@ class Coordinator:
             e["last_seen"] = time.monotonic()
             e["stats"] = stats
             state = e["state"]
+            if msg.get("resident") is not None:
+                # the routing roster: latest bloom summary plus its
+                # arrival instant (the staleness clock). Version bump
+                # invalidates the per-request score cache.
+                self._resident[engine_id] = (msg["resident"],
+                                             time.monotonic())
+                self._resident_ver += 1
         if self.collector is not None:
             # roster state into the obs plane (outside our lock —
             # the collector takes its own)
@@ -611,6 +765,10 @@ class Coordinator:
             if not requeued and self.queue.request(rid).state in (
                     "done", "failed"):
                 self._phase.pop(rid, None)
+                self._chains.pop(rid, None)
+                self._route_cache.pop(rid, None)
+                self._route_skips.pop(rid, None)
+                self._route_escaped.discard(rid)
 
     def _rids_of(self, engine_id: str) -> list:
         with self._lock:

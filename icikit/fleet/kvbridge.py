@@ -38,7 +38,9 @@ Control plane rule: no jax imports here (``fleet-control-plane``).
 
 from __future__ import annotations
 
+import collections
 import threading
+import time
 
 import numpy as np
 
@@ -47,6 +49,11 @@ from icikit import chaos, obs
 # the migrate-SDC drill site: rot between the coordinator's disk and
 # the pulling engine's arena that the wire checksums cannot see
 chaos.register_site("fleet.kv.pull")
+
+# default host-RAM tier capacity, in blocks: sized so the whole toy
+# working set fits (the bench's Zipf shared prefixes are dozens of
+# blocks); a real deployment sizes this in bytes against host RAM
+DEFAULT_RAM_BLOCKS = 256
 
 
 def encode_arrays(arrays):
@@ -70,16 +77,50 @@ def decode_arrays(meta, blobs):
 
 class BlockBridge:
     """Coordinator-side bridge: a :class:`PrefixStore` plus per-hash
-    writer provenance. ``handle`` is the RPC dispatch surface the
-    coordinator delegates ``store.*`` ops to."""
+    writer provenance and an LRU **host-RAM tier** in front of the
+    ``.npz`` disk tier (the r17 re-scope: a hot cross-engine migration
+    should cost a memcpy + RPC, not a disk round trip). ``handle`` is
+    the RPC dispatch surface the coordinator delegates ``store.*``
+    ops to.
 
-    def __init__(self, store):
+    RAM-tier contract:
+
+    - **write-through** — a prefill push lands in RAM *and* on disk in
+      the same ``store.put``, so coordinator restart/rewarm semantics
+      are exactly the disk tier's (the RAM tier is a cache, never the
+      system of record);
+    - **promote-on-pull** — a disk hit is promoted into RAM so the
+      second puller of a hot chain skips the disk;
+    - **digest rides both tiers** — the content digest is stored next
+      to the cached arrays and returned unchanged, so ``KVPool``
+      swap-in verification is identical whichever tier served the
+      bytes: a flipped cached byte fails the same digest check and
+      the resulting ``store.quarantine`` purges BOTH tiers
+      (bridge-wide, same as disk);
+    - ``ram_blocks=0`` disables the tier (the bench's blind arm).
+
+    The ``die:fleet.kv.pull`` drill fires on the RAM *hit* path: a
+    host-tier fault (poisoned cache page, allocator failure) evicts
+    the entry and falls back to the disk tier — and if disk can't
+    serve either, the engine recomputes, so the tier degrades in the
+    same recompute-beats-misread order as every other cache here."""
+
+    def __init__(self, store, ram_blocks: int = DEFAULT_RAM_BLOCKS):
         self.store = store
         self._lock = threading.Lock()
         self._writer: dict = {}      # hash -> engine_id that pushed it
+        self.ram_blocks = int(ram_blocks)
+        # hash -> (side, digest, arrays); OrderedDict as LRU
+        self._ram: collections.OrderedDict = collections.OrderedDict()
         self.n_migrations = 0
+        self.migration_bytes = 0
         self.n_pushed = 0
         self.n_pulled = 0
+        self.n_ram_hits = 0
+        self.n_disk_hits = 0
+        self.n_ram_faults = 0
+        self._ram_hit_s = 0.0       # summed tier-fetch wall time
+        self._disk_hit_s = 0.0
 
     # -- dispatch ----------------------------------------------------
 
@@ -96,6 +137,11 @@ class BlockBridge:
             self.store.quarantine(msg["h"])
             with self._lock:
                 self._writer.pop(msg["h"], None)
+                # bridge-wide means EVERY tier: a digest failure at
+                # any engine's swap-in purges the RAM copy too, so no
+                # other engine can be served the suspect content from
+                # the fast path the disk purge didn't cover
+                self._ram.pop(msg["h"], None)
             obs.count("fleet.kv.quarantined")
             return {}, ()
         if op == "store.stats":
@@ -104,11 +150,26 @@ class BlockBridge:
 
     # -- ops ---------------------------------------------------------
 
+    def _ram_insert(self, h: str, side: str, digest: str,
+                    arrays) -> None:
+        """LRU insert (lock held by caller NOT required — takes it):
+        newest at the tail, evict from the head past capacity."""
+        if self.ram_blocks <= 0:
+            return
+        with self._lock:
+            self._ram[h] = (side, digest, arrays)
+            self._ram.move_to_end(h)
+            while len(self._ram) > self.ram_blocks:
+                self._ram.popitem(last=False)
+
     def _put(self, engine: str, h: str, side: str, digest: str,
              meta, blobs):
         arrays = decode_arrays(meta, blobs)
         wrote = self.store.put(h, side, digest, arrays)
         if wrote:
+            # write-through: disk is the system of record (restart
+            # rewarm unchanged), RAM makes the NEXT puller fast
+            self._ram_insert(h, side, digest, arrays)
             with self._lock:
                 self._writer[h] = engine
                 self.n_pushed += 1
@@ -117,32 +178,89 @@ class BlockBridge:
                       float(self.store.n_blocks()))
         return {"wrote": wrote}, ()
 
-    def _get(self, engine: str, h: str):
+    def _fetch(self, h: str):
+        """Tiered block fetch: RAM, then disk (promoting the hit).
+        Returns ``(side, digest, arrays)`` or None. Per-tier hit
+        counters and wall time accumulate here — the quantities the
+        r20 study prices the tier by."""
+        t0 = time.perf_counter()
+        hit = None
+        with self._lock:
+            if h in self._ram:
+                hit = self._ram[h]
+                self._ram.move_to_end(h)
+        if hit is not None:
+            try:
+                # the host-tier fault drill: a die here means the RAM
+                # copy can't be served — evict it and fall back to
+                # disk (and, past disk, to recompute at the engine)
+                chaos.maybe_die("fleet.kv.pull")
+            except chaos.InjectedDeath:
+                with self._lock:
+                    self._ram.pop(h, None)
+                    self.n_ram_faults += 1
+                hit = None
+            if hit is not None:
+                with self._lock:
+                    self.n_ram_hits += 1
+                    self._ram_hit_s += time.perf_counter() - t0
+                obs.count("fleet.bridge.ram_hits")
+                return hit
         rec = self.store.get(h)
+        if rec is None:
+            return None
+        side, digest, arrays = rec
+        self._ram_insert(h, side, digest, arrays)   # promote-on-pull
+        with self._lock:
+            self.n_disk_hits += 1
+            self._disk_hit_s += time.perf_counter() - t0
+        obs.count("fleet.bridge.disk_hits")
+        return side, digest, arrays
+
+    def _get(self, engine: str, h: str):
+        rec = self._fetch(h)
         if rec is None:
             return {"found": False}, ()
         side, digest, arrays = rec
+        meta, blobs = encode_arrays(arrays)
         migrated = False
         with self._lock:
             self.n_pulled += 1
             writer = self._writer.get(h)
             if writer is not None and writer != engine:
                 self.n_migrations += 1
+                # the pricing quantity routed dispatch exists to
+                # shrink: bytes moved because the claim landed on an
+                # engine that did not write this block
+                self.migration_bytes += sum(len(b) for b in blobs)
                 migrated = True
         obs.count("fleet.kv.pulled")
         if migrated:
             obs.count("fleet.kv.migrations")
-        meta, blobs = encode_arrays(arrays)
         return {"found": True, "side": side, "digest": digest,
                 "meta": meta, "migrated": migrated}, blobs
 
     def stats(self) -> dict:
         with self._lock:
+            n_ram = self.n_ram_hits
+            n_disk = self.n_disk_hits
             return {"blocks": self.store.n_blocks(),
                     "pushed": self.n_pushed,
                     "pulled": self.n_pulled,
                     "migrations": self.n_migrations,
-                    "quarantined": self.store.n_quarantined}
+                    "migration_bytes": self.migration_bytes,
+                    "quarantined": self.store.n_quarantined,
+                    "ram_blocks": len(self._ram),
+                    "ram_capacity": self.ram_blocks,
+                    "ram_hits": n_ram,
+                    "disk_hits": n_disk,
+                    "ram_faults": self.n_ram_faults,
+                    "ram_hit_us_mean":
+                        round(self._ram_hit_s / n_ram * 1e6, 2)
+                        if n_ram else None,
+                    "disk_hit_us_mean":
+                        round(self._disk_hit_s / n_disk * 1e6, 2)
+                        if n_disk else None}
 
 
 class BridgeStore:

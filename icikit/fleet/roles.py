@@ -41,7 +41,8 @@ import numpy as np
 from icikit import chaos, obs
 from icikit.fleet.kvbridge import BridgeStore
 from icikit.fleet.telemetry import chain_bloom
-from icikit.fleet.transport import RpcClient, RpcError
+from icikit.fleet.transport import (RpcClient, RpcError,
+                                    _maybe_corrupt_bytes)
 from icikit.obs import trace_ctx
 from icikit.serve.scheduler import Request
 
@@ -289,6 +290,21 @@ class EngineWorker:
                     # thread and getting a HEALTHY engine declared
                     # dead at the timeout
                     done = list(self.queue.done.values())
+                    # residency summary for the coordinator's routing
+                    # roster + the collector. The corrupt probe flips
+                    # summary bits past every checksum — the stale/
+                    # corrupt-bloom drill: routing built on a rotten
+                    # summary may MIS-ROUTE (a claim lands on a cold
+                    # engine, costing one migration), but can never
+                    # mis-compute — the claim path replays bitwise on
+                    # any engine
+                    resident = chain_bloom(
+                        self.engine.resident_chains())
+                    raw = bytes.fromhex(resident["bloom"])
+                    rot = _maybe_corrupt_bytes(
+                        "fleet.telemetry.send", raw)
+                    if rot is not raw:
+                        resident["bloom"] = rot.hex()
                     client.call("report", {
                         "engine": self.engine_id,
                         "tokens": sum(len(r.tokens) for r in done),
@@ -296,10 +312,7 @@ class EngineWorker:
                         "occupancy": self.engine.occupancy_mean(),
                         "integrity_failures":
                             self.queue.n_integrity_fails,
-                        # residency summary for the collector: the
-                        # substrate cache-aware claim routing consumes
-                        "resident": chain_bloom(
-                            self.engine.resident_chains())})
+                        "resident": resident})
                 except (ConnectionError, OSError, RpcError):
                     return      # coordinator gone: the loop will see
                 except Exception:   # noqa: BLE001 - heartbeat must

@@ -1,0 +1,191 @@
+"""Autoscale supervisor: watch-signal-driven spawn AND retire.
+
+r18 proved the mechanisms one at a time, single-shot, inside the
+bench driver: a ``fleet.pending`` watch alert spawned one
+authenticated joiner (``run_fleet_ha --join``), and ``retire`` +
+``drained`` let a worker leave gracefully. This module lifts that
+into the policy loop a production fleet actually runs — the
+coordinator-side half of "elasticity" (ROADMAP 1c):
+
+- **scale up** when the watch verdict fires on queue depth
+  (``fleet.pending`` watermark) or SLO burn (``serve.ttft_ms``
+  burn-rate window) — the same :mod:`icikit.obs.watch` detectors that
+  already gate the fleet's health verdict, so the supervisor invents
+  no second monitoring path;
+- **scale down** when the fleet has been *sustainedly* idle (queue
+  depth at zero, no alert firing) — retire drains through the
+  existing ``retire`` → ``drained`` RPC path, so an in-flight request
+  on the victim finishes (or reissues via its lease) before the
+  worker exits: scale-down can never lose work, for the same reason
+  engine death can't;
+- **cooldowns** on both directions bound the policy's thrash rate
+  (an alert that keeps firing while a joiner is still compiling must
+  not spawn a second joiner), and a roster **floor/ceiling** bounds
+  its authority;
+- only engines the supervisor itself spawned are retire candidates
+  (LIFO) — the operator's base fleet is never scaled away.
+
+The class is deliberately process-agnostic: it sees the fleet through
+three callables (``stats_fn`` → the coordinator's ``fleet_stats``
+dict, ``spawn_fn(engine_id)``, ``retire_fn(engine_id)``), so unit
+tests drive the policy with fakes and a fake clock, and the bench
+wires in real ``spawn_worker`` subprocesses + the ``retire`` RPC.
+Every decision lands in ``events`` (monotonic-stamped) — the
+scale-up/scale-down timeline the r20 study records.
+
+Control plane rule (``fleet-control-plane``): no jax — the
+supervisor must keep deciding while engines' devices are the thing
+under load.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from icikit import obs
+
+DEFAULT_ALERT_METRICS = ("fleet.pending", "serve.ttft_ms")
+
+
+class Supervisor:
+    """One fleet's scale policy. Call :meth:`tick` from your own loop
+    (tests), or :meth:`start`/:meth:`stop` for the daemon-thread
+    variant the bench uses."""
+
+    def __init__(self, stats_fn, spawn_fn, retire_fn,
+                 floor: int = 1, ceiling: int = 4,
+                 spawn_cooldown_s: float = 3.0,
+                 retire_cooldown_s: float = 3.0,
+                 scale_down_idle_s: float = 1.5,
+                 poll_s: float = 0.25,
+                 alert_metrics=DEFAULT_ALERT_METRICS,
+                 clock=time.monotonic):
+        if floor < 0 or ceiling < max(1, floor):
+            raise ValueError(
+                f"need 0 <= floor <= ceiling (>=1), got "
+                f"floor={floor} ceiling={ceiling}")
+        self.stats_fn = stats_fn
+        self.spawn_fn = spawn_fn
+        self.retire_fn = retire_fn
+        self.floor = int(floor)
+        self.ceiling = int(ceiling)
+        self.spawn_cooldown_s = float(spawn_cooldown_s)
+        self.retire_cooldown_s = float(retire_cooldown_s)
+        self.scale_down_idle_s = float(scale_down_idle_s)
+        self.poll_s = float(poll_s)
+        self.alert_metrics = tuple(alert_metrics)
+        self._clock = clock
+        self.events: list = []
+        self.spawned: list = []     # our joiners, spawn order
+        self.n_spawns = 0
+        self.n_retires = 0
+        self._last_spawn_t: float | None = None
+        self._last_retire_t: float | None = None
+        self._idle_since: float | None = None
+        self._seen_alerts = 0
+        self._seq = 0
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # -- policy ------------------------------------------------------
+
+    def _cooled(self, last_t, cooldown: float, now: float) -> bool:
+        return last_t is None or now - last_t >= cooldown
+
+    def tick(self, now: float | None = None) -> dict | None:
+        """One policy decision against the current fleet stats.
+        Returns the event dict when the tick scaled, else None."""
+        now = self._clock() if now is None else now
+        stats = self.stats_fn()
+        alerts = (stats.get("watch") or {}).get("alerts", [])
+        # the watch verdict is CUMULATIVE over the run; pressure is
+        # alerts NEW since the last tick (sustained pressure keeps
+        # producing them — one per polled window). A shrunken list
+        # means the watch restarted (coordinator failover): rebase.
+        if len(alerts) < self._seen_alerts:
+            self._seen_alerts = 0
+        fired = [a for a in alerts[self._seen_alerts:]
+                 if a.get("metric") in self.alert_metrics]
+        self._seen_alerts = len(alerts)
+        engines = stats.get("engines") or {}
+        live = sorted(eid for eid, e in engines.items()
+                      if e.get("state") == "live")
+        pending = int(stats.get("pending") or 0)
+        if fired or pending > 0:
+            self._idle_since = None
+        if fired:
+            if (len(live) < self.ceiling
+                    and self._cooled(self._last_spawn_t,
+                                     self.spawn_cooldown_s, now)):
+                return self._spawn(now, fired[0])
+            return None
+        # no pressure signal: consider giving capacity back
+        if pending == 0:
+            if self._idle_since is None:
+                self._idle_since = now
+            if (now - self._idle_since >= self.scale_down_idle_s
+                    and len(live) > self.floor
+                    and self._cooled(self._last_retire_t,
+                                     self.retire_cooldown_s, now)):
+                # LIFO among OUR joiners still live: the base fleet
+                # is not ours to shrink
+                victim = next((eid for eid in reversed(self.spawned)
+                               if eid in live), None)
+                if victim is not None:
+                    return self._retire(now, victim)
+        return None
+
+    def _spawn(self, now: float, alert: dict) -> dict:
+        engine_id = f"auto{self._seq}"
+        self._seq += 1
+        self.spawn_fn(engine_id)
+        self.spawned.append(engine_id)
+        self._last_spawn_t = now
+        self.n_spawns += 1
+        ev = {"t": now, "action": "spawn", "engine": engine_id,
+              "reason": alert.get("metric")}
+        self.events.append(ev)
+        obs.count("fleet.autoscale.spawns")
+        obs.emit("fleet.autoscale.spawned", engine=engine_id,
+                 reason=ev["reason"])
+        return ev
+
+    def _retire(self, now: float, engine_id: str) -> dict:
+        self.retire_fn(engine_id)
+        self._last_retire_t = now
+        self._idle_since = None    # re-observe idleness from scratch
+        self.n_retires += 1
+        ev = {"t": now, "action": "retire", "engine": engine_id,
+              "reason": "idle"}
+        self.events.append(ev)
+        obs.count("fleet.autoscale.retires")
+        obs.emit("fleet.autoscale.retired", engine=engine_id)
+        return ev
+
+    # -- daemon-thread driver ----------------------------------------
+
+    def start(self) -> "Supervisor":
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="fleet-supervisor")
+        self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.poll_s):
+            try:
+                self.tick()
+            except Exception:  # noqa: BLE001 - a stats hiccup (e.g.
+                continue       # coordinator mid-failover) must not
+                               # kill the policy loop
+
+    def stop(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=2.0)
+
+    def timeline(self) -> list:
+        """Copy of the decision events (the study's record field)."""
+        return [dict(ev) for ev in self.events]

@@ -130,6 +130,43 @@ def bloom_hits(summary: dict, hashes) -> int:
     return n
 
 
+def bloom_prefix_hits(summary, hashes) -> int:
+    """Routing score: length of the longest *block-aligned prefix* of
+    ``hashes`` (a request's chain-hash lineage, oldest block first)
+    that the residency summary claims resident. This is the quantity
+    cache-aware dispatch ranks engines by — a deep unbroken prefix is
+    reusable KV; scattered mid-chain membership is worth nothing,
+    because chain hash ``h_j`` only pays off if ``h_0..h_{j-1}`` are
+    resident too.
+
+    Hardened for the claim path: a missing, empty or malformed
+    summary (an engine that never heartbeated, or a corrupt frame the
+    digest check dropped) scores 0 — the engine just looks cold, which
+    degrades routing to today's blind dispatch, never to an error.
+    Bloom polarity guarantees no false negatives (a truly resident
+    prefix always scores at least its length ... against the summary
+    that advertised it); false positives can only INFLATE a score, and
+    an inflated score mis-routes to a migration — the path every
+    request could already take."""
+    if not summary or not hashes:
+        return 0
+    try:
+        buf = bytes.fromhex(summary["bloom"])
+        bits = int(summary["bits"])
+        k = int(summary["k"])
+        if bits <= 0 or k <= 0 or len(buf) * 8 < bits:
+            return 0
+    except (KeyError, TypeError, ValueError):
+        return 0
+    n = 0
+    for h in hashes:
+        if not all(buf[p >> 3] & (1 << (p & 7))
+                   for p in _bloom_positions(h, bits, k)):
+            break
+        n += 1
+    return n
+
+
 # -- forwarding ------------------------------------------------------
 
 class TelemetrySink(_bus.Sink):

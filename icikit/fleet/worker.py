@@ -22,17 +22,116 @@ exits nonzero holding its leases — the reaper's problem, by design.
 
 from __future__ import annotations
 
+import hashlib
 import json
+import os
 import sys
+import threading
+
+# scale-up TTFT was weight-rebuild dominated (3.4 s in r18, CPU):
+# every joiner re-derived the SAME deterministic weights from the
+# recipe. Two cache layers fix it without ever shipping weights:
+# an in-process memo (the bench driver + test fixtures rebuild one
+# recipe many times), and an on-disk host-array cache shared between
+# worker processes (``ICIKIT_WEIGHT_CACHE``) so a joiner skips the
+# init computation entirely. Both are keyed by the canonical recipe
+# JSON; the disk payload carries a content digest re-verified at
+# load — a torn or rotten cache file falls back to the honest
+# rebuild, never into wrong weights (recompute beats misread).
+_BUILD_MEMO: dict = {}
+_BUILD_LOCK = threading.Lock()
+_WEIGHT_FORMAT = 1
 
 
-def build_model(spec: dict):
+def _spec_key(spec: dict) -> str:
+    return json.dumps(spec or {}, sort_keys=True)
+
+
+def _weights_digest(host_arrays) -> str:
+    h = hashlib.blake2b(digest_size=16)
+    for a in host_arrays:
+        h.update(a.tobytes())
+    return h.hexdigest()
+
+
+def _weight_cache_path(cache_dir: str, key: str) -> str:
+    tag = hashlib.blake2b(key.encode(), digest_size=12).hexdigest()
+    return os.path.join(cache_dir, f"weights-{tag}.npz")
+
+
+def _load_cached_params(path: str, shapes_tree):
+    """Rebuild the params pytree from a cached host-array file, or
+    None when the file is absent/torn/rotten/shape-mismatched (any
+    failure means rebuild — the file is removed so the next spawn
+    doesn't re-trip)."""
+    import numpy as np
+
+    import jax
+
+    if not os.path.exists(path):
+        return None
+    leaves, treedef = jax.tree_util.tree_flatten(shapes_tree)
+    try:
+        with np.load(path) as z:
+            meta = json.loads(bytes(z["meta"].tobytes()))
+            if (meta.get("format") != _WEIGHT_FORMAT
+                    or meta.get("n") != len(leaves)):
+                raise ValueError("weight cache layout mismatch")
+            arrs = [z[f"a{i}"] for i in range(len(leaves))]
+        if _weights_digest(arrs) != meta.get("digest"):
+            raise ValueError("weight cache digest mismatch")
+        for a, leaf in zip(arrs, leaves):
+            if (tuple(a.shape) != tuple(leaf.shape)
+                    or a.dtype != leaf.dtype):
+                raise ValueError("weight cache leaf mismatch")
+    except Exception:  # noqa: BLE001 - any rot -> rebuild honestly
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+        return None
+    return jax.tree_util.tree_unflatten(
+        treedef, [jax.device_put(a) for a in arrs])
+
+
+def _save_cached_params(path: str, params) -> None:
+    import numpy as np
+
+    import jax
+
+    host = [np.asarray(x) for x in
+            jax.tree_util.tree_leaves(params)]
+    meta = json.dumps({"format": _WEIGHT_FORMAT, "n": len(host),
+                       "digest": _weights_digest(host)}).encode()
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "wb") as f:
+            np.savez(f, meta=np.frombuffer(meta, np.uint8),
+                     **{f"a{i}": a for i, a in enumerate(host)})
+        os.replace(tmp, path)   # last-writer-wins: identical content
+    except OSError:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+
+
+def build_model(spec: dict, weight_cache: str | None = None):
     """``(params, mesh, cfg)`` from a model recipe dict — shared by
     workers and the coordinator-side audit so both construct bitwise
     identical weights. Keys: ``preset`` (bench.train.PRESETS name),
     ``overrides`` (TransformerConfig field overrides, e.g. max_seq),
     ``compute_dtype``, ``decode_quant``, ``dp``/``tp``,
-    ``init_seed``."""
+    ``init_seed``. ``weight_cache`` (or ``ICIKIT_WEIGHT_CACHE``)
+    names a directory of cached host arrays for cross-process spawn
+    acceleration; determinism is unaffected either way because the
+    cache stores exactly the bytes the recipe derives."""
+    key = _spec_key(spec)
+    with _BUILD_LOCK:
+        hit = _BUILD_MEMO.get(key)
+    if hit is not None:
+        return hit
+
     import jax
 
     from icikit.bench.train import PRESETS
@@ -48,11 +147,33 @@ def build_model(spec: dict):
         over["compute_dtype"] = spec["compute_dtype"]
     cfg = TransformerConfig(
         **over, decode_quant=spec.get("decode_quant", "none"))
-    mesh = make_model_mesh(dp=int(spec.get("dp", 1)),
-                           tp=int(spec.get("tp", 1)), sp=1)
-    params = init_params(
-        jax.random.key(int(spec.get("init_seed", 0))), cfg, mesh)
-    return params, mesh, cfg
+    dp, tp = int(spec.get("dp", 1)), int(spec.get("tp", 1))
+    mesh = make_model_mesh(dp=dp, tp=tp, sp=1)
+    init_key = jax.random.key(int(spec.get("init_seed", 0)))
+    cache_dir = weight_cache or os.environ.get("ICIKIT_WEIGHT_CACHE")
+    params = None
+    path = None
+    if cache_dir and dp == 1 and tp == 1:
+        # single-device placement only: a sharded pytree's layout is
+        # the mesh's business, not a flat npz's
+        os.makedirs(cache_dir, exist_ok=True)
+        path = _weight_cache_path(cache_dir, key)
+        try:
+            # abstract trace only — the treedef + leaf shapes the
+            # cached flat arrays are validated against, at zero FLOPs
+            shapes = jax.eval_shape(
+                lambda k: init_params(k, cfg, mesh), init_key)
+            params = _load_cached_params(path, shapes)
+        except Exception:  # noqa: BLE001 - cache is best-effort
+            params = None
+    if params is None:
+        params = init_params(init_key, cfg, mesh)
+        if path is not None:
+            _save_cached_params(path, params)
+    out = (params, mesh, cfg)
+    with _BUILD_LOCK:
+        _BUILD_MEMO[key] = out
+    return out
 
 
 def run_worker(config: dict) -> dict:
@@ -83,7 +204,9 @@ def run_worker(config: dict) -> dict:
             source=config["engine_id"], role=config["role"],
             client=client,
             flush_s=float(tcfg.get("flush_s", 0.25))).start()
-    params, mesh, cfg = build_model(config.get("model") or {})
+    params, mesh, cfg = build_model(
+        config.get("model") or {},
+        weight_cache=config.get("weight_cache"))
     serve_cfg = ServeConfig(**(config.get("serve") or {}))
     worker = EngineWorker(tuple(config["addr"])
                           if config.get("addr") else None,

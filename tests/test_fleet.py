@@ -32,12 +32,17 @@ import pytest
 
 from icikit import chaos, obs
 from icikit.fleet import Coordinator, EngineWorker, RpcClient
+from icikit.fleet import worker as fleet_worker
+from icikit.fleet.kvbridge import BlockBridge, encode_arrays
+from icikit.fleet.telemetry import chain_bloom
 from icikit.fleet.worker import build_model
 from icikit.models.transformer import greedy_generate
 from icikit.models.transformer.decode import sample_generate
 from icikit.obs import trace_ctx
 from icikit.serve.engine import ServeConfig
+from icikit.serve.kvpool import block_hashes
 from icikit.serve.scheduler import RequestQueue, prompt_checksum
+from icikit.serve.store import PrefixStore
 
 MODEL_SPEC = {
     "preset": "tiny",
@@ -377,6 +382,219 @@ def test_trace_tree_continuous_across_cross_engine_reissue(
                 and ev.get("name") == "serve.req.attempt"
                 and "reissued_from" in (ev.get("args") or {})]
     assert reissued, "no reissued_from edge in any request tree"
+
+
+# -- cache-aware routing (r20) ---------------------------------------
+
+def test_routed_dispatch_stays_bitwise(fleet_model, tmp_path):
+    """Routing changes WHERE a claim lands, never what it computes:
+    a routed 2-engine run commits tokens bitwise identical to the
+    single-request decode (hence to the blind run, which carries the
+    same pin), and the route counters account for every decision."""
+    params, mesh, cfg = fleet_model
+    coord = Coordinator(tmp_path / "bridge", lease_s=10.0,
+                        route_block_size=4)
+    try:
+        sv = ServeConfig(**SERVE_KW)
+        workers = [EngineWorker(coord.addr, f"e{i}", "both",
+                                params, mesh, cfg, sv,
+                                report_interval_s=0.05)
+                   for i in range(2)]
+        prompts = _prompts(6, cfg.vocab, seed=6)
+        rids = [coord.submit(p, 6) for p in prompts]
+        _run_workers(workers)
+        _audit(coord, rids, prompts, 6, fleet_model)
+        # every granted claim went through the routed predicate
+        assert coord.n_route_hits + coord.n_route_misses \
+            + coord.n_route_escaped >= len(rids)
+        for w in workers:
+            w.close()
+    finally:
+        coord.shutdown()
+
+
+def test_steered_claim_prefers_resident_engine_then_escapes(tmp_path):
+    """The routing policy at the RPC surface, no engines: the engine
+    whose heartbeat bloom holds the request's chain wins the claim;
+    the cold engine is passed over (entry re-pushed untouched — its
+    claim generation does not burn) until the starvation escape hatch
+    makes the request claimable by anyone."""
+    coord = Coordinator(tmp_path / "bridge", lease_s=10.0,
+                        route_block_size=4, route_escape_rounds=2,
+                        route_escape_s=30.0)
+    try:
+        cli = RpcClient(coord.addr)
+        cli.call("hello", {"engine": "hot", "role": "both"})
+        cli.call("hello", {"engine": "cold", "role": "both"})
+        prompt = np.arange(8, dtype=np.int32)
+        chains = block_hashes(prompt, 4, side="fp")
+        assert len(chains) == 2
+        cli.call("report", {"engine": "hot",
+                            "resident": chain_bloom(chains)})
+        cli.call("report", {"engine": "cold",
+                            "resident": chain_bloom([])})
+        rid = coord.submit(prompt, 3)
+        # cold asks first but is steered away ...
+        reply, _ = cli.call("claim", {"engine": "cold"})
+        assert reply["req"] is None
+        assert coord.n_route_steered == 1
+        # ... and hot wins it with an UNTOUCHED generation: the
+        # pass-over re-pushed the entry, seq still 1 (claim fencing
+        # is unchanged under steering)
+        reply, _ = cli.call("claim", {"engine": "hot"})
+        assert reply["req"]["rid"] == rid
+        assert reply["req"]["claim_seq"] == 1
+        assert coord.n_route_hits == 1
+        reply, _ = cli.call("complete", {
+            "engine": "hot", "rid": rid, "seq": 1,
+            "tokens": [1, 2, 3], "marks": {}})
+        assert reply["committed"] is True
+        # second request, same chain: hot never polls this time —
+        # after route_escape_rounds pass-overs the cold engine gets
+        # it anyway (routing is a preference, not a constraint)
+        rid2 = coord.submit(prompt, 3)
+        for _ in range(2):
+            reply, _ = cli.call("claim", {"engine": "cold"})
+            assert reply["req"] is None
+        reply, _ = cli.call("claim", {"engine": "cold"})
+        assert reply["req"]["rid"] == rid2
+        assert reply["req"]["claim_seq"] == 1
+        assert coord.n_route_escaped == 1
+        reply, _ = cli.call("complete", {
+            "engine": "cold", "rid": rid2, "seq": 1,
+            "tokens": [1, 2, 3], "marks": {}})
+        assert reply["committed"] is True
+        assert coord.queue.n_duplicate_commits == 0
+        cli.close()
+    finally:
+        coord.shutdown()
+
+
+@pytest.mark.chaos
+def test_corrupt_resident_bloom_misroutes_never_miscomputes(
+        fleet_model, tmp_path):
+    """The r20 telemetry drill with routing armed: a corrupted
+    heartbeat bloom (``corrupt:fleet.telemetry.send`` on the summary
+    hex) can at worst mis-route a claim — the malformed summary
+    scores the engine cold, routing degrades toward blind dispatch —
+    and every committed token stays bitwise the single-request
+    decode."""
+    params, mesh, cfg = fleet_model
+    coord = Coordinator(tmp_path / "bridge", lease_s=10.0,
+                        route_block_size=4)
+    try:
+        sv = ServeConfig(**SERVE_KW)
+        workers = [EngineWorker(coord.addr, f"e{i}", "both",
+                                params, mesh, cfg, sv,
+                                report_interval_s=0.05)
+                   for i in range(2)]
+        prompts = _prompts(4, cfg.vocab, seed=7)
+        rids = [coord.submit(p, 6) for p in prompts]
+        plan = chaos.FaultPlan(
+            schedule={"corrupt:fleet.telemetry.send": (0,)}, seed=11)
+        with chaos.inject(plan):
+            _run_workers(workers)
+        assert plan.fired("corrupt", "fleet.telemetry.send") >= 1
+        _audit(coord, rids, prompts, 6, fleet_model)
+        for w in workers:
+            w.close()
+    finally:
+        coord.shutdown()
+
+
+# -- host-RAM bridge tier (r20) --------------------------------------
+
+def _bridge_block():
+    arrays = [np.arange(16, dtype=np.float32)]
+    meta, blobs = encode_arrays(arrays)
+    return arrays, meta, blobs
+
+
+def test_ram_tier_fault_falls_back_to_disk(tmp_path):
+    """``die:fleet.kv.pull`` on the RAM *hit* path: the poisoned host
+    copy is evicted and the pull falls through to the disk tier —
+    same digest, counted fault, and the disk hit re-promotes so the
+    next pull is fast again."""
+    bridge = BlockBridge(PrefixStore(tmp_path / "store"),
+                         ram_blocks=8)
+    _, meta, blobs = _bridge_block()
+    bridge._put("e0", "h0", "fp", "digest0", meta, blobs)
+    plan = chaos.FaultPlan(schedule={"die:fleet.kv.pull": (0,)},
+                           seed=3)
+    with chaos.inject(plan):
+        reply, out = bridge._get("e1", "h0")
+    assert plan.fired("die", "fleet.kv.pull") == 1
+    assert reply["found"] and reply["digest"] == "digest0"
+    assert out == blobs              # identical bytes from disk
+    st = bridge.stats()
+    assert st["ram_faults"] == 1 and st["disk_hits"] == 1 \
+        and st["ram_hits"] == 0, st
+    reply, _ = bridge._get("e1", "h0")   # promoted on the way out
+    assert reply["found"]
+    assert bridge.stats()["ram_hits"] == 1
+
+
+def test_quarantine_purges_ram_tier_too(tmp_path):
+    """Bridge-wide means EVERY tier: after a quarantine the RAM copy
+    must be gone — no engine may be served suspect content from the
+    fast path the disk purge didn't cover."""
+    bridge = BlockBridge(PrefixStore(tmp_path / "store"),
+                         ram_blocks=8)
+    _, meta, blobs = _bridge_block()
+    bridge._put("e0", "h0", "fp", "digest0", meta, blobs)
+    reply, _ = bridge._get("e1", "h0")
+    assert reply["found"] and bridge.stats()["ram_hits"] == 1
+    bridge.handle("store.quarantine", {"h": "h0"}, ())
+    reply, _ = bridge._get("e1", "h0")
+    assert reply == {"found": False}
+    assert bridge.stats()["ram_hits"] == 1   # no further RAM serve
+
+
+def test_ram_lru_evicts_oldest_and_disk_still_serves(tmp_path):
+    bridge = BlockBridge(PrefixStore(tmp_path / "store"),
+                         ram_blocks=2)
+    _, meta, blobs = _bridge_block()
+    for i in range(3):
+        bridge._put("e0", f"h{i}", "fp", f"d{i}", meta, blobs)
+    # h0 was LRU-evicted from RAM; disk (the system of record) serves
+    # it and the fetch counts as a disk hit
+    reply, _ = bridge._get("e1", "h0")
+    assert reply["found"]
+    st = bridge.stats()
+    assert st["disk_hits"] == 1 and st["ram_blocks"] == 2
+    # write-through kept everything on disk
+    assert st["blocks"] == 3
+
+
+# -- cross-process weight cache (r20 scale-up TTFT) ------------------
+
+def test_weight_cache_roundtrip_and_corrupt_fallback(tmp_path):
+    """The scale-up TTFT fix: a joiner's ``build_model`` loads the
+    deterministic recipe's host arrays from the digest-verified disk
+    cache instead of re-initializing — bitwise the honest init — and
+    a rotten cache file falls back to the honest rebuild (unlink, no
+    error, same bytes)."""
+    wc = str(tmp_path / "weights")
+    fleet_worker._BUILD_MEMO.clear()
+    params1, _, _ = build_model(dict(MODEL_SPEC), weight_cache=wc)
+    files = list((tmp_path / "weights").glob("weights-*.npz"))
+    assert len(files) == 1, files
+    leaves1 = [np.asarray(x)
+               for x in jax.tree_util.tree_leaves(params1)]
+    # a fresh process (memo cleared) loads the SAME bytes from disk
+    fleet_worker._BUILD_MEMO.clear()
+    params2, _, _ = build_model(dict(MODEL_SPEC), weight_cache=wc)
+    leaves2 = [np.asarray(x)
+               for x in jax.tree_util.tree_leaves(params2)]
+    assert len(leaves1) == len(leaves2)
+    for a, b in zip(leaves1, leaves2):
+        assert a.dtype == b.dtype and np.array_equal(a, b)
+    # rot the cache: the loader must unlink and rebuild honestly
+    files[0].write_bytes(b"not an npz")
+    fleet_worker._BUILD_MEMO.clear()
+    params3, _, _ = build_model(dict(MODEL_SPEC), weight_cache=wc)
+    for a, b in zip(leaves1, jax.tree_util.tree_leaves(params3)):
+        assert np.array_equal(a, np.asarray(b))
 
 
 # -- scheduler handoff unit surface ----------------------------------

@@ -1,0 +1,213 @@
+"""Autoscale supervisor policy (`icikit.fleet.supervisor`): fakes +
+a fake clock drive every decision path — no processes, no sockets.
+
+The load-bearing claims:
+
+- scale-up fires only on *new* watch alerts (the verdict is
+  cumulative over the run; a stale alert must not read as permanent
+  pressure), filtered to the configured metrics, bounded by the
+  ceiling and the spawn cooldown;
+- scale-down requires *sustained* idle (pending at zero, no alert),
+  honors the floor and retire cooldown, and retires LIFO among the
+  supervisor's OWN joiners only — the operator's base fleet is never
+  scaled away;
+- a coordinator failover (the watch restarts, the alert list
+  shrinks) rebases the cursor instead of wedging or double-firing;
+- the daemon loop outlives stats hiccups (a coordinator
+  mid-failover must not kill the policy thread).
+"""
+
+import time
+
+import pytest
+
+from icikit.fleet.supervisor import Supervisor
+
+
+class _Fleet:
+    """Fake coordinator surface: mutable stats + spawn/retire logs."""
+
+    def __init__(self, engines=("base0",)):
+        self.engines = {e: "live" for e in engines}
+        self.pending = 0
+        self.alerts: list = []
+        self.spawns: list = []
+        self.retires: list = []
+
+    def stats(self):
+        return {"engines": {e: {"state": s}
+                            for e, s in self.engines.items()},
+                "pending": self.pending,
+                "watch": {"alerts": list(self.alerts)}}
+
+    def spawn(self, eid):
+        self.spawns.append(eid)
+        self.engines[eid] = "live"
+
+    def retire(self, eid):
+        self.retires.append(eid)
+        self.engines[eid] = "retired"
+
+    def alert(self, metric="fleet.pending"):
+        self.alerts.append({"metric": metric})
+
+
+def _sup(fleet, **kw):
+    kw.setdefault("floor", 1)
+    kw.setdefault("ceiling", 3)
+    kw.setdefault("spawn_cooldown_s", 5.0)
+    kw.setdefault("retire_cooldown_s", 5.0)
+    kw.setdefault("scale_down_idle_s", 2.0)
+    return Supervisor(fleet.stats, fleet.spawn, fleet.retire, **kw)
+
+
+def test_alert_spawns_and_cooldown_bounds_thrash():
+    f = _Fleet()
+    sup = _sup(f)
+    f.alert()
+    ev = sup.tick(now=0.0)
+    assert ev["action"] == "spawn" and ev["reason"] == "fleet.pending"
+    assert f.spawns == ["auto0"]
+    # a second alert while the first joiner is still compiling must
+    # not spawn a second joiner inside the cooldown
+    f.alert()
+    assert sup.tick(now=1.0) is None
+    f.alert()
+    assert sup.tick(now=6.0)["action"] == "spawn"
+    assert f.spawns == ["auto0", "auto1"]
+    assert sup.n_spawns == 2
+
+
+def test_cumulative_alert_list_is_not_sustained_pressure():
+    """`Watch.verdict()` accumulates alerts over the run: the SAME
+    old alert re-read every tick must not spawn-loop once per
+    cooldown window — pressure is the alert *delta*."""
+    f = _Fleet()
+    sup = _sup(f)
+    f.alert()
+    assert sup.tick(now=0.0)["action"] == "spawn"
+    f.pending = 1             # backlog keeps the idle path quiet
+    assert sup.tick(now=10.0) is None
+    assert sup.tick(now=20.0) is None
+    assert f.spawns == ["auto0"]
+
+
+def test_watch_restart_rebases_alert_cursor():
+    f = _Fleet()
+    sup = _sup(f)
+    for _ in range(3):
+        f.alert()
+    assert sup.tick(now=0.0)["action"] == "spawn"
+    # failover: the successor's watch starts fresh, the list SHRANK —
+    # its first alert is new pressure, not history
+    f.alerts = [{"metric": "fleet.pending"}]
+    assert sup.tick(now=6.0)["action"] == "spawn"
+    assert f.spawns == ["auto0", "auto1"]
+
+
+def test_ceiling_bounds_scale_up():
+    f = _Fleet(engines=("base0", "base1", "base2"))
+    sup = _sup(f)           # ceiling 3, roster already there
+    f.alert()
+    assert sup.tick(now=0.0) is None
+    assert f.spawns == []
+
+
+def test_unlisted_alert_metrics_do_not_spawn():
+    f = _Fleet()
+    sup = _sup(f)
+    f.alert(metric="serve.tpot_ms")    # not a scale-up signal
+    assert sup.tick(now=0.0) is None
+    assert f.spawns == []
+
+
+def test_idle_retires_own_joiners_lifo_never_base_fleet():
+    f = _Fleet()
+    sup = _sup(f, retire_cooldown_s=0.0)
+    for t in (0.0, 6.0):
+        f.alert()
+        assert sup.tick(now=t)["action"] == "spawn"
+    assert f.spawns == ["auto0", "auto1"]
+    # idleness must SUSTAIN scale_down_idle_s before the first retire
+    assert sup.tick(now=12.0) is None
+    assert sup.tick(now=13.0) is None
+    ev = sup.tick(now=14.5)
+    assert ev["action"] == "retire" and ev["engine"] == "auto1"
+    # idleness re-observes from scratch after each retire
+    assert sup.tick(now=14.6) is None
+    assert sup.tick(now=17.0)["action"] == "retire"
+    assert f.retires == ["auto1", "auto0"]
+    # the floor holds and the base fleet is not ours to shrink
+    assert sup.tick(now=30.0) is None
+    assert sup.tick(now=33.0) is None
+    assert "base0" not in f.retires
+    assert sup.n_retires == 2
+
+
+def test_pending_backlog_suppresses_idle_but_only_alerts_spawn():
+    f = _Fleet()
+    sup = _sup(f, retire_cooldown_s=0.0)
+    f.alert()
+    sup.tick(now=0.0)
+    f.pending = 4
+    assert sup.tick(now=10.0) is None      # backlog is not an alert…
+    assert f.spawns == ["auto0"]
+    assert sup.tick(now=20.0) is None      # …but it suppresses idle
+    f.pending = 0
+    assert sup.tick(now=30.0) is None      # idle clock starts here
+    assert sup.tick(now=32.5)["action"] == "retire"
+
+
+def test_retire_cooldown_spaces_scale_down():
+    f = _Fleet()
+    sup = _sup(f, spawn_cooldown_s=0.0, retire_cooldown_s=10.0,
+               scale_down_idle_s=0.0)
+    for t in (0.0, 1.0):
+        f.alert()
+        sup.tick(now=t)
+    assert sup.tick(now=2.0)["action"] == "retire"
+    assert sup.tick(now=5.0) is None       # cooling down
+    assert sup.tick(now=12.5)["action"] == "retire"
+    assert f.retires == ["auto1", "auto0"]
+
+
+def test_timeline_is_a_copy_and_events_are_stamped():
+    f = _Fleet()
+    sup = _sup(f)
+    f.alert()
+    sup.tick(now=1.5)
+    tl = sup.timeline()
+    assert tl == [{"t": 1.5, "action": "spawn", "engine": "auto0",
+                   "reason": "fleet.pending"}]
+    tl[0]["action"] = "mutated"
+    assert sup.timeline()[0]["action"] == "spawn"
+
+
+def test_floor_ceiling_validation():
+    f = _Fleet()
+    with pytest.raises(ValueError):
+        Supervisor(f.stats, f.spawn, f.retire, floor=3, ceiling=2)
+    with pytest.raises(ValueError):
+        Supervisor(f.stats, f.spawn, f.retire, floor=-1)
+    with pytest.raises(ValueError):
+        Supervisor(f.stats, f.spawn, f.retire, floor=0, ceiling=0)
+
+
+def test_daemon_loop_survives_stats_hiccup_and_stops():
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        raise ConnectionError("coordinator mid-failover")
+
+    sup = Supervisor(flaky, lambda e: None, lambda e: None,
+                     poll_s=0.01)
+    sup.start()
+    try:
+        deadline = time.monotonic() + 5.0
+        while calls["n"] < 3 and time.monotonic() < deadline:
+            time.sleep(0.01)
+    finally:
+        sup.stop()
+    assert calls["n"] >= 3       # the loop outlived the exceptions
+    assert sup._thread is not None and not sup._thread.is_alive()

@@ -29,8 +29,8 @@ import pytest
 from icikit import chaos
 from icikit.fleet import Coordinator, EngineWorker, RpcClient
 from icikit.fleet.telemetry import (TelemetryForwarder, bloom_contains,
-                                    bloom_hits, chain_bloom,
-                                    payload_digest)
+                                    bloom_hits, bloom_prefix_hits,
+                                    chain_bloom, payload_digest)
 from icikit.fleet.worker import build_model
 from icikit.models.transformer import greedy_generate
 from icikit.obs.aggregate import FleetCollector
@@ -116,6 +116,60 @@ def test_bloom_hits_counts_resident_prefix_only():
 def test_chain_bloom_rejects_oversized_k():
     with pytest.raises(ValueError):
         chain_bloom(["x"], k=16)
+
+
+# -- bloom_prefix_hits: the r20 routing score -----------------------
+
+def test_bloom_prefix_hits_no_false_negatives():
+    # a truly resident chain always scores its full depth against the
+    # summary that advertised it — bloom polarity can inflate a
+    # score (collision), never deflate it, so routing can never skip
+    # real KV
+    chains = [f"lineage-{i:03d}" for i in range(32)]
+    s = chain_bloom(chains)
+    assert bloom_prefix_hits(s, chains) == 32
+    for cut in (1, 7, 31):
+        assert bloom_prefix_hits(s, chains[:cut]) == cut
+
+
+def test_bloom_prefix_hits_counts_unbroken_prefix_only():
+    chains = [f"c{i}" for i in range(8)]
+    s = chain_bloom(chains[:4])
+    # chain hash h_j only pays off if h_0..h_{j-1} are resident too:
+    # a deep unbroken prefix scores, scattered membership does not
+    assert bloom_prefix_hits(s, chains) >= 4
+    assert not bloom_contains(s, "absent-head")
+    assert bloom_prefix_hits(s, ["absent-head"] + chains[:4]) == 0
+
+
+def test_bloom_prefix_hits_false_positive_only_inflates():
+    # worst-case false positive — a saturated summary claims
+    # everything resident: the score inflates to the whole chain,
+    # which mis-routes to a migration (a path every request may take
+    # anyway), never to wrong tokens
+    sat = {"bloom": "ff" * 128, "bits": 1024, "k": 4, "n": 1}
+    chains = [f"x{i}" for i in range(6)]
+    assert bloom_prefix_hits(sat, chains) == 6
+
+
+def test_bloom_prefix_hits_malformed_summary_scores_cold():
+    """The claim-path hardening: any summary a corrupt heartbeat (or
+    an engine that never reported) could present scores 0 — the
+    engine looks cold and routing degrades to blind dispatch, never
+    to an exception inside the queue lock."""
+    chains = ["a", "b"]
+    good = chain_bloom(chains)
+    assert bloom_prefix_hits(good, chains) == 2
+    for bad in (None, {},
+                {"bloom": "zz", "bits": 1024, "k": 4},   # not hex
+                {"bloom": "00", "bits": 1024, "k": 4},   # truncated
+                {"bloom": good["bloom"], "bits": 0, "k": 4},
+                {"bloom": good["bloom"], "bits": 1024, "k": 0},
+                {"bits": 1024, "k": 4},                  # no bloom
+                {"bloom": 7, "bits": 1024, "k": 4},      # wrong type
+                {"bloom": good["bloom"], "bits": "x", "k": 4}):
+        assert bloom_prefix_hits(bad, chains) == 0, bad
+    assert bloom_prefix_hits(good, []) == 0
 
 
 # -- forwarder unit behavior (no sockets, no jax) -------------------

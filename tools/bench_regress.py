@@ -64,6 +64,10 @@ NUMERIC_CONFIG = {
     # HA rows (serve_fleet_ha_r18.jsonl): failover timing is priced
     # BY these knobs, so arms only pair within identical HA config
     "n_standbys", "lease_timeout_s", "snapshot_every",
+    # cache-aware dispatch rows (serve_fleet_route_r20.jsonl): the
+    # host-RAM bridge capacity is a tier knob — a RAM-tier arm must
+    # never gate a disk-only arm
+    "bridge_ram",
 }
 
 # (path, direction, default relative tolerance) — applied when the
@@ -81,6 +85,12 @@ DEFAULT_METRICS = (
     # still applies on top)
     ("ttfc_ms", "lower", 0.50),
     ("prefix.hit_tokens", "higher", 0.25),
+    # r20 cache-aware dispatch rows: the study's pairing lands nested
+    # per-arm, so the gate reads the routed arms' locality/traffic
+    # wins and the weight-rebuild component of scale-up TTFT directly
+    ("homog.routed.prefix_hit_ratio", "higher", 0.10),
+    ("disagg.routed.migration_bytes", "lower", 0.20),
+    ("build_s_cache_warm", "lower", 0.50),
 )
 
 

@@ -1,0 +1,283 @@
+#!/usr/bin/env python
+"""Fleet round-20 study: pricing cache-aware dispatch, the host-RAM
+bridge tier, and the autoscale supervisor.
+
+Paired-per-seed protocol on the SAME seeded Zipf multi-tenant
+shared-prefix workload (``make_workload(tenants=, zipf=)``), every
+arm ``--verify-identity`` audited — routing changes WHERE a claim
+lands, never what it computes, and the audit is what makes that a
+measurement instead of a hope. Arms, interleaved per seed, appending
+to ``serve_fleet_route_r20.jsonl``:
+
+- **routed vs blind, homogeneous** (2 engines, ``both``): the win is
+  locality — steering a tenant's requests to the engine already
+  holding its prefix chain raises the local radix-cache hit ratio
+  (``prefix_hit_ratio``) instead of re-prefilling the same blocks on
+  every engine. Bar: mean hit-ratio strictly up, tokens/s within
+  ``tps_tolerance_pct`` of blind.
+- **routed vs blind, disaggregated** (3 engines: 1 prefill +
+  2 decode): the win is traffic — a tenant's decode claims stick to
+  the decode engine that already pulled its shared prefix, so the
+  bridge moves fewer migrated bytes. Bar: mean ``migration_bytes``
+  strictly down, tokens/s within tolerance.
+- **host-RAM bridge tier vs disk-only** (2-engine disagg,
+  ``bridge_ram`` 256 vs 0): same pulls, different tier — the record
+  compares per-fetch wall time (``ram_hit_us_mean`` vs
+  ``disk_hit_us_mean``). Bar: RAM tier strictly faster, identity
+  holds on both.
+- **autoscale supervisor** (1 base engine, hot Poisson burst): the
+  watch's ``fleet.pending`` watermark spawns a joiner, sustained
+  post-drain idle retires it — the decision timeline and the
+  spawn->first-commit scale-up TTFT land in the record, with the
+  cross-process weight cache ON vs OFF (the r18 3.4 s scale-up was
+  weight-rebuild dominated).
+- **weight-rebuild microbench** (fresh subprocesses, ``small``
+  preset): ``build_model`` cold (no cache) vs cache-write vs
+  cache-warm — the component cost the supervisor arm's TTFT delta
+  comes from.
+
+CPU protocol note: engines share this host's physical cores, so
+absolute tokens/s under-reports separate-host scaling; the portable
+claims are the paired ratios. The TPU/multi-host session re-prices
+absolutes (ROADMAP item 5 ledger).
+
+Reproduce::
+
+    python tools/fleet_route_study.py --json serve_fleet_route_r20.jsonl
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from icikit.bench.fleet import run_fleet, worker_env  # noqa: E402
+
+# shared-prefix-dominated prompts: 5 of 7 blocks (block_size 4) are
+# the tenant's — the regime cache-aware routing exists for
+ARM_KW = dict(
+    prompt_len=24, new_min=4, new_max=8, prefix_len=20,
+    tenants=4, zipf=1.2, verify=True, timeout_s=900.0)
+
+SUP_KW = dict(
+    prompt_len=16, new_min=4, new_max=8, supervise=True,
+    pending_high=3.0,
+    supervise_kw=dict(spawn_cooldown_s=2.0, retire_cooldown_s=1.0,
+                      scale_down_idle_s=0.5),
+    verify=True, timeout_s=900.0)
+
+# the weight-rebuild microbench runs a REAL-sized recipe: tiny's
+# init is milliseconds either way, small's is the visible cost
+BUILD_SPEC = {"preset": "small", "overrides": {"max_seq": 64},
+              "compute_dtype": "float32", "dp": 1, "tp": 1,
+              "init_seed": 0}
+
+_BUILD_PROBE = """\
+import json, sys, time
+spec = json.loads(sys.argv[1])
+cache = sys.argv[2] or None
+from icikit.fleet.worker import build_model
+t0 = time.perf_counter()
+build_model(spec, weight_cache=cache)
+print("BUILD_S", time.perf_counter() - t0)
+"""
+
+
+def _build_time(cache_dir: str | None) -> float:
+    """``build_model`` wall time in a FRESH subprocess (the in-process
+    memo must not flatter the numbers)."""
+    probe = os.path.join(tempfile.gettempdir(),
+                         "icikit_build_probe.py")
+    with open(probe, "w") as f:
+        f.write(_BUILD_PROBE)
+    out = subprocess.run(
+        [sys.executable, probe, json.dumps(BUILD_SPEC),
+         cache_dir or ""],
+        capture_output=True, text=True, timeout=300,
+        env=worker_env())
+    for line in out.stdout.splitlines():
+        if line.startswith("BUILD_S "):
+            return float(line.split()[1])
+    raise RuntimeError(f"build probe failed: {out.stdout[-500:]} "
+                       f"{out.stderr[-500:]}")
+
+
+def _route_pair(rec: dict) -> dict:
+    b = rec["bridge"]
+    return {"tokens_per_s": rec["tokens_per_s"],
+            "prefix_hit_ratio": rec["prefix_hit_ratio"],
+            "migration_bytes": b["migration_bytes"],
+            "migrations": b["migrations"],
+            "route": rec["route"],
+            "identity_ok": rec["identity_ok"]}
+
+
+def study(json_path: str | None, seeds=(0, 1), requests: int = 24,
+          rate: float = 12.0,
+          tps_tolerance_pct: float = 10.0) -> list:
+    recs = []
+    for seed in seeds:
+        # -- routed vs blind, homogeneous locality arm ---------------
+        homog = {}
+        for arm, route in (("blind", False), ("routed", True)):
+            r = run_fleet(2, requests, rate, seed=seed, route=route,
+                          **ARM_KW)
+            assert r["identity_ok"] and not r["failed"], r
+            homog[arm] = _route_pair(r)
+        # -- routed vs blind, disagg migration-traffic arm -----------
+        disagg = {}
+        for arm, route in (("blind", False), ("routed", True)):
+            r = run_fleet(3, requests, rate, seed=seed, route=route,
+                          roles="disagg", **ARM_KW)
+            assert r["identity_ok"] and not r["failed"], r
+            disagg[arm] = _route_pair(r)
+        # -- host-RAM bridge tier vs disk-only -----------------------
+        bridge = {}
+        for arm, ram in (("ram", 256), ("disk", 0)):
+            r = run_fleet(2, requests, rate, seed=seed, route=False,
+                          roles="disagg", bridge_ram=ram, **ARM_KW)
+            assert r["identity_ok"] and not r["failed"], r
+            b = r["bridge"]
+            bridge[arm] = {
+                "pulled": b["pulled"],
+                "ram_hits": b["ram_hits"],
+                "disk_hits": b["disk_hits"],
+                "ram_hit_us_mean": b["ram_hit_us_mean"],
+                "disk_hit_us_mean": b["disk_hit_us_mean"],
+                "tokens_per_s": r["tokens_per_s"],
+                "identity_ok": r["identity_ok"]}
+        assert bridge["ram"]["ram_hits"] >= 1, bridge
+        assert bridge["disk"]["disk_hits"] >= 1, bridge
+        # -- autoscale supervisor, weight cache on vs off ------------
+        autoscale = {}
+        for arm, wc in (("cache", None), ("no_cache", "off")):
+            r = run_fleet(1, 16, 16.0, seed=seed, weight_cache=wc,
+                          **SUP_KW)
+            assert r["identity_ok"] and not r["failed"], r
+            a = r["autoscale"]
+            assert a["spawns"] >= 1 and a["retires"] >= 1, a
+            autoscale[arm] = a
+        rec = {
+            "kind": "serve_fleet_route",
+            "n_requests": requests,
+            "rate_rps": rate,
+            "seed": seed,
+            **{k: ARM_KW[k] for k in
+               ("prompt_len", "prefix_len", "tenants", "zipf")},
+            "homog": homog,
+            "disagg": disagg,
+            "bridge_tier": bridge,
+            "autoscale": autoscale,
+            "note": "paired per-seed arms on one Zipf multi-tenant "
+                    "workload; every arm identity-audited; CPU "
+                    "co-located engines, ratios are the portable "
+                    "claim",
+        }
+        recs.append(rec)
+        if json_path:
+            with open(json_path, "a") as f:
+                f.write(json.dumps(rec) + "\n")
+        print(json.dumps({  # icikit-lint: off[obs-print]
+            "seed": seed,
+            "homog_hit": [homog["blind"]["prefix_hit_ratio"],
+                          homog["routed"]["prefix_hit_ratio"]],
+            "disagg_mig_bytes": [disagg["blind"]["migration_bytes"],
+                                 disagg["routed"]["migration_bytes"]],
+            "tier_us": [bridge["ram"]["ram_hit_us_mean"],
+                        bridge["disk"]["disk_hit_us_mean"]],
+            "scaleup_ms": {
+                arm: [s["ttft_ms"] for s in a["scaleup_ttft_ms"]]
+                for arm, a in autoscale.items()}}))
+
+    # -- weight-rebuild microbench (once; deterministic recipe) ------
+    wc_dir = tempfile.mkdtemp(prefix="icikit_wc_study_")
+    try:
+        t_none = _build_time(None)
+        t_write = _build_time(wc_dir)       # cold: build + save
+        t_warm = _build_time(wc_dir)        # warm: load + verify
+    finally:
+        shutil.rmtree(wc_dir, ignore_errors=True)
+    build_rec = {
+        "kind": "serve_fleet_route_build",
+        "preset": BUILD_SPEC["preset"],
+        "build_s_no_cache": round(t_none, 3),
+        "build_s_cache_write": round(t_write, 3),
+        "build_s_cache_warm": round(t_warm, 3),
+        "speedup": round(t_none / t_warm, 2),
+        "note": "build_model in fresh subprocesses: the weight-"
+                "rebuild component of scale-up TTFT, before "
+                "(no cache) vs after (warm cross-process cache)",
+    }
+    recs.append(build_rec)
+    if json_path:
+        with open(json_path, "a") as f:
+            f.write(json.dumps(build_rec) + "\n")
+    print(json.dumps({  # icikit-lint: off[obs-print]
+        k: build_rec[k] for k in
+        ("build_s_no_cache", "build_s_cache_warm", "speedup")}))
+
+    # -- acceptance bars (means across seeds: single-seed CPU noise
+    # must not flip a verdict the pairing was designed to settle) ----
+    arms = [r for r in recs if r["kind"] == "serve_fleet_route"]
+    n = len(arms)
+
+    def mean(path_a, path_b, key):
+        return sum(r[path_a][path_b][key] for r in arms) / n
+
+    hit_blind = mean("homog", "blind", "prefix_hit_ratio")
+    hit_routed = mean("homog", "routed", "prefix_hit_ratio")
+    assert hit_routed > hit_blind, \
+        f"routing did not raise prefix hit-ratio: " \
+        f"{hit_routed:.4f} vs {hit_blind:.4f}"
+    mig_blind = mean("disagg", "blind", "migration_bytes")
+    mig_routed = mean("disagg", "routed", "migration_bytes")
+    assert mig_routed < mig_blind, \
+        f"routing did not cut migration bytes: " \
+        f"{mig_routed:.0f} vs {mig_blind:.0f}"
+    for arm_name in ("homog", "disagg"):
+        tb = mean(arm_name, "blind", "tokens_per_s")
+        tr = mean(arm_name, "routed", "tokens_per_s")
+        assert tr >= tb * (1 - tps_tolerance_pct / 100), \
+            f"{arm_name}: routed tokens/s {tr:.2f} degraded past " \
+            f"{tps_tolerance_pct}% of blind {tb:.2f}"
+    ram_us = mean("bridge_tier", "ram", "ram_hit_us_mean")
+    disk_us = mean("bridge_tier", "disk", "disk_hit_us_mean")
+    assert ram_us < disk_us, \
+        f"RAM tier not faster than disk: {ram_us} vs {disk_us}"
+    assert build_rec["build_s_cache_warm"] \
+        < build_rec["build_s_no_cache"], build_rec
+    print(json.dumps({  # icikit-lint: off[obs-print]
+        "hit_ratio": [round(hit_blind, 4), round(hit_routed, 4)],
+        "migration_bytes": [round(mig_blind), round(mig_routed)],
+        "tier_us": [round(ram_us, 1), round(disk_us, 1)],
+        "all_bars_pass": True}))
+    return recs
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--json", dest="json_path",
+                    default="serve_fleet_route_r20.jsonl")
+    ap.add_argument("--seeds", type=int, nargs="+", default=[0, 1])
+    ap.add_argument("--requests", type=int, default=24)
+    ap.add_argument("--rate", type=float, default=12.0)
+    args = ap.parse_args(argv)
+    t0 = time.monotonic()
+    study(args.json_path, seeds=tuple(args.seeds),
+          requests=args.requests, rate=args.rate)
+    print(json.dumps({  # icikit-lint: off[obs-print]
+        "study_s": round(time.monotonic() - t0, 1)}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
